@@ -1,0 +1,52 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+Tasks, actors, and a shared-memory object plane (ray_tpu.core), with
+JAX/XLA/Pallas AI libraries on top: sharded training (ray_tpu.train),
+parallelism primitives (ray_tpu.parallel), TPU kernels (ray_tpu.ops),
+streaming data (ray_tpu.data), tuning (ray_tpu.tune), serving
+(ray_tpu.serve), and RL (ray_tpu.rl).
+
+This module stays import-light: no jax import at the top level, so core
+worker processes and CLI tools start fast. AI-library subpackages import
+jax lazily on first use.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu import exceptions
+
+__all__ = [
+    "__version__",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "ObjectRef",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

@@ -1,0 +1,154 @@
+"""Public API: init/remote/get/put/wait and friends.
+
+Capability parity with the reference's top-level API
+(reference: python/ray/_private/worker.py — init:1427, get:2852,
+put:2995, wait, kill, cancel; python/ray/__init__.py exports).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu.core import runtime as runtime_mod
+from ray_tpu.core.actor import ActorClass, ActorHandle, get_actor
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+from ray_tpu.core.runtime import DriverRuntime
+
+
+def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         labels: Optional[Dict[str, str]] = None,
+         object_store_memory: Optional[int] = None,
+         namespace: str = "",
+         system_config: Optional[dict] = None,
+         ignore_reinit_error: bool = False) -> DriverRuntime:
+    """Start the single-node runtime (head + worker pool + object store)."""
+    existing = runtime_mod.get_runtime_or_none()
+    if existing is not None:
+        if ignore_reinit_error:
+            return existing
+        raise RuntimeError("ray_tpu is already initialized; call shutdown() first")
+    res = dict(resources or {})
+    if num_cpus is not None:
+        res["CPU"] = float(num_cpus)
+    if num_tpus is not None:
+        res["TPU"] = float(num_tpus)
+    rt = DriverRuntime(resources=res or None, labels=labels,
+                       object_store_memory=object_store_memory,
+                       system_config=system_config, namespace=namespace)
+    runtime_mod.set_runtime(rt)
+    return rt
+
+
+def shutdown() -> None:
+    rt = runtime_mod.get_runtime_or_none()
+    if rt is not None and getattr(rt, "is_driver", False):
+        rt.shutdown()
+
+
+def is_initialized() -> bool:
+    return runtime_mod.get_runtime_or_none() is not None
+
+
+def remote(*args, **options):
+    """Decorator turning a function into a RemoteFunction or a class into
+    an ActorClass. Usable bare (``@remote``) or with options
+    (``@remote(num_cpus=2)``)."""
+    def make(target):
+        if inspect.isclass(target):
+            return ActorClass(target, options)
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not options and (inspect.isfunction(args[0])
+                                           or inspect.isclass(args[0])):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return make
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    return runtime_mod.get_runtime().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return runtime_mod.get_runtime().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return runtime_mod.get_runtime().wait(
+        list(refs), num_returns=num_returns, timeout=timeout,
+        fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    runtime_mod.get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    runtime_mod.get_runtime().cancel(ref.id, force=force)
+
+
+def cluster_resources() -> Dict[str, float]:
+    rt = runtime_mod.get_runtime()
+    if rt.is_driver:
+        return rt.cluster_resources()
+    return rt.gcs_call("cluster_resources")
+
+
+def available_resources() -> Dict[str, float]:
+    rt = runtime_mod.get_runtime()
+    if rt.is_driver:
+        return rt.available_resources()
+    return rt.gcs_call("available_resources")
+
+
+def nodes() -> List[dict]:
+    rt = runtime_mod.get_runtime()
+    out = []
+    for rec in rt.gcs.alive_nodes():
+        out.append({
+            "NodeID": rec.node_id.hex(),
+            "Alive": rec.alive,
+            "Resources": dict(rec.resources_total),
+            "Labels": dict(rec.labels),
+        })
+    return out
+
+
+class _RuntimeContext:
+    """reference: python/ray/runtime_context.py"""
+
+    @property
+    def is_initialized(self) -> bool:
+        return is_initialized()
+
+    def get_node_id(self) -> Optional[str]:
+        rt = runtime_mod.get_runtime_or_none()
+        if rt is None:
+            return None
+        if rt.is_driver:
+            return rt.head_node_id.hex()
+        return rt.node_id.hex()
+
+    def get_actor_id(self) -> Optional[str]:
+        rt = runtime_mod.get_runtime_or_none()
+        actor_id = getattr(rt, "actor_id", None)
+        return actor_id.hex() if actor_id else None
+
+    def get_job_id(self) -> Optional[str]:
+        rt = runtime_mod.get_runtime_or_none()
+        job_id = getattr(rt, "job_id", None)
+        return job_id.hex() if job_id else None
+
+
+def get_runtime_context() -> _RuntimeContext:
+    return _RuntimeContext()

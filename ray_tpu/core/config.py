@@ -1,0 +1,112 @@
+"""Framework configuration flags, overridable via environment variables.
+
+Capability parity with the reference's RAY_CONFIG macro system
+(reference: src/ray/common/ray_config_def.h — 229 flags, env override
+``RAY_<name>`` parsed in ray_config.cc). Here a flag declared as
+``FLAG(name, default)`` is overridden by ``RTPU_<NAME>`` in the environment,
+and a ``system_config`` dict can be passed to ``init()`` for per-session
+overrides.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(f"RTPU_{name.upper()}")
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    if isinstance(default, (list, dict)):
+        return json.loads(raw)
+    return raw
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    # Bytes of shared memory for the node-local object store arena.
+    object_store_memory: int = 256 * 1024 * 1024
+    # Objects smaller than this are kept inline in the in-process memory
+    # store / task replies instead of the shm store
+    # (reference: max_direct_call_object_size, ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    # Seconds between eviction scans when the store is under pressure.
+    object_store_full_retry_s: float = 0.05
+    object_store_full_max_retries: int = 100
+
+    # --- workers / scheduling ---
+    # Max workers a node's pool will fork (0 => num_cpus).
+    max_workers_per_node: int = 0
+    # Idle workers kept warm for reuse (reference: worker_pool prestart).
+    min_idle_workers: int = 1
+    worker_start_timeout_s: float = 30.0
+    # Queue-depth threshold at which the hybrid policy spills to other nodes
+    # (reference: scheduler_spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Max consecutive task retries on worker failure.
+    task_max_retries: int = 3
+    # Polling interval of the node-manager control loops.
+    control_loop_interval_s: float = 0.005
+
+    # --- actors ---
+    actor_default_max_restarts: int = 0
+    actor_method_default_max_task_retries: int = 0
+
+    # --- health / failure detection ---
+    health_check_interval_s: float = 0.5
+    health_check_failure_threshold: int = 5
+    # Grace period before a dead worker's in-flight tasks are failed.
+    worker_death_grace_s: float = 0.5
+
+    # --- logging / events ---
+    task_events_enabled: bool = True
+    task_events_buffer_size: int = 100_000
+    log_to_driver: bool = True
+
+    # --- rpc chaos (fault injection; reference: rpc_chaos.h) ---
+    # JSON map of "method" -> failure probability in [0,1].
+    testing_rpc_failure: dict = field(default_factory=dict)
+    testing_delay_us: int = 0
+
+    def __post_init__(self):
+        for f in fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def apply_overrides(self, overrides: dict | None):
+        if not overrides:
+            return
+        for key, value in overrides.items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown config flag: {key}")
+            setattr(self, key, value)
+
+
+_config_lock = threading.Lock()
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    with _config_lock:
+        if _config is None:
+            _config = Config()
+        return _config
+
+
+def reset_config(overrides: dict | None = None) -> Config:
+    global _config
+    with _config_lock:
+        _config = Config()
+        _config.apply_overrides(overrides)
+        return _config

@@ -1,0 +1,243 @@
+"""GCS — the cluster control plane.
+
+Capability parity with the reference's Global Control Service
+(reference: src/ray/gcs/gcs_server.h:98): node table
+(gcs_node_manager.h:47), actor table + restart policy
+(gcs_actor_manager.h:93), job table (gcs_job_manager.h:50), cluster-wide
+KV (gcs_kv_manager.cc), function store (gcs_function_manager.h), pubsub
+(pubsub_handler.cc), task-event store (gcs_task_manager.h:97), placement
+groups (gcs_placement_group_manager.h:50), and health checking
+(gcs_health_check_manager.h:45).
+
+The GCS lives in the head (driver) process; workers reach it through
+their node manager socket (GCS_REQUEST messages). All tables share one
+lock — the control plane is low-rate (scheduling, registration, state
+changes), while the data plane rides shared memory / ICI and never
+touches the GCS, matching the reference's separation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
+from ray_tpu.core.task_spec import TaskEvent, TaskSpec
+from ray_tpu.exceptions import PlacementGroupUnschedulableError
+
+
+@dataclass
+class NodeRecord:
+    node_id: NodeID
+    address: str
+    resources_total: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.time)
+    node_manager: Any = None  # in-process handle to the Node (single-host runtime)
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    state: str  # PENDING | ALIVE | RESTARTING | DEAD
+    node_id: Optional[NodeID] = None
+    spec: Optional[TaskSpec] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    death_cause: Optional[str] = None
+
+
+@dataclass
+class JobRecord:
+    job_id: JobID
+    state: str = "RUNNING"  # RUNNING | SUCCEEDED | FAILED
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: Dict[str, float]
+    node_id: Optional[NodeID] = None
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: PlacementGroupID
+    name: str
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    bundles: List[Bundle]
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+
+
+class KVStore:
+    """Namespaced key-value store (reference: gcs_kv_manager.cc,
+    python/ray/experimental/internal_kv.py)."""
+
+    def __init__(self):
+        self._data: Dict[Tuple[str, bytes], bytes] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: bytes, value: bytes, namespace: str = "") -> None:
+        with self._lock:
+            self._data[(namespace, key)] = value
+
+    def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
+        with self._lock:
+            return self._data.get((namespace, key))
+
+    def delete(self, key: bytes, namespace: str = "") -> bool:
+        with self._lock:
+            return self._data.pop((namespace, key), None) is not None
+
+    def keys(self, prefix: bytes = b"", namespace: str = "") -> List[bytes]:
+        with self._lock:
+            return [k for (ns, k) in self._data if ns == namespace and k.startswith(prefix)]
+
+    def exists(self, key: bytes, namespace: str = "") -> bool:
+        with self._lock:
+            return (namespace, key) in self._data
+
+
+class Pubsub:
+    """In-process pub/sub with per-subscriber queues
+    (reference: src/ray/pubsub/publisher.h:245)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: Dict[str, List[Callable[[Any], None]]] = defaultdict(list)
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        with self._lock:
+            self._subs[channel].append(callback)
+
+    def unsubscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        with self._lock:
+            try:
+                self._subs[channel].remove(callback)
+            except ValueError:
+                pass
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+class Gcs:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.kv = KVStore()
+        self.pubsub = Pubsub()
+        self.nodes: Dict[NodeID, NodeRecord] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self.jobs: Dict[JobID, JobRecord] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupRecord] = {}
+        self.functions: Dict[str, bytes] = {}  # function/class store
+        cfg = get_config()
+        self.task_events: deque = deque(maxlen=cfg.task_events_buffer_size)
+
+    # --- nodes ---------------------------------------------------------
+    def register_node(self, record: NodeRecord) -> None:
+        with self.lock:
+            self.nodes[record.node_id] = record
+        self.pubsub.publish("node", ("ALIVE", record.node_id))
+
+    def mark_node_dead(self, node_id: NodeID) -> None:
+        with self.lock:
+            rec = self.nodes.get(node_id)
+            if rec:
+                rec.alive = False
+        self.pubsub.publish("node", ("DEAD", node_id))
+
+    def alive_nodes(self) -> List[NodeRecord]:
+        with self.lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self.lock:
+            rec = self.nodes.get(node_id)
+            if rec:
+                rec.last_heartbeat = time.time()
+
+    # --- functions -----------------------------------------------------
+    def put_function(self, function_id: str, blob: bytes) -> None:
+        with self.lock:
+            self.functions[function_id] = blob
+
+    def get_function(self, function_id: str) -> Optional[bytes]:
+        with self.lock:
+            return self.functions.get(function_id)
+
+    # --- actors --------------------------------------------------------
+    def register_actor(self, record: ActorRecord) -> None:
+        with self.lock:
+            if record.name:
+                key = (record.namespace, record.name)
+                if key in self.named_actors:
+                    existing = self.actors.get(self.named_actors[key])
+                    if existing and existing.state != "DEAD":
+                        raise ValueError(
+                            f"actor name {record.name!r} already taken in "
+                            f"namespace {record.namespace!r}"
+                        )
+                self.named_actors[key] = record.actor_id
+            self.actors[record.actor_id] = record
+
+    def update_actor_state(self, actor_id: ActorID, state: str,
+                           node_id: Optional[NodeID] = None,
+                           death_cause: Optional[str] = None) -> None:
+        with self.lock:
+            rec = self.actors.get(actor_id)
+            if rec is None:
+                return
+            rec.state = state
+            if node_id is not None:
+                rec.node_id = node_id
+            if death_cause is not None:
+                rec.death_cause = death_cause
+        self.pubsub.publish("actor", (state, actor_id))
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorRecord]:
+        with self.lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "") -> Optional[ActorRecord]:
+        with self.lock:
+            actor_id = self.named_actors.get((namespace, name))
+            return self.actors.get(actor_id) if actor_id else None
+
+    # --- jobs ----------------------------------------------------------
+    def register_job(self, record: JobRecord) -> None:
+        with self.lock:
+            self.jobs[record.job_id] = record
+
+    # --- placement groups ----------------------------------------------
+    def register_placement_group(self, record: PlacementGroupRecord) -> None:
+        with self.lock:
+            self.placement_groups[record.pg_id] = record
+
+    def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroupRecord]:
+        with self.lock:
+            return self.placement_groups.get(pg_id)
+
+    # --- task events (observability) -----------------------------------
+    def add_task_event(self, event: TaskEvent) -> None:
+        if get_config().task_events_enabled:
+            self.task_events.append(event)
+
+    def list_task_events(self, limit: int = 1000) -> List[TaskEvent]:
+        return list(self.task_events)[-limit:]

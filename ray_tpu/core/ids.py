@@ -1,0 +1,111 @@
+"""Unique identifiers for objects, tasks, actors, nodes, and jobs.
+
+Capability parity with the reference's ID substrate
+(reference: src/ray/common/id.h) — fixed-width binary IDs with hex
+rendering, random generation, and deterministic derivation of return-object
+IDs from task IDs. The layout here is simpler (no embedded flag words): a
+TaskID is 16 random bytes; the i-th return object of a task is
+sha1(task_id || index)[:16].
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import ClassVar
+
+
+class BaseID:
+    SIZE: ClassVar[int] = 16
+    __slots__ = ("_bytes",)
+
+    def __init__(self, id_bytes: bytes):
+        if len(id_bytes) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
+            )
+        self._bytes = id_bytes
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self._bytes))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class TaskID(BaseID):
+    SIZE = 16
+
+    @classmethod
+    def for_actor_creation(cls, actor_id: "ActorID") -> "TaskID":
+        h = hashlib.sha1(b"actor_creation:" + actor_id.binary()).digest()
+        return cls(h[: cls.SIZE])
+
+
+class ActorID(BaseID):
+    SIZE = 12
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 12
+
+
+class ObjectID(BaseID):
+    """An object id, derived from the producing task (ownership model).
+
+    reference: src/ray/common/id.h ObjectID::FromIndex — return objects are
+    addressable before the task runs, enabling futures and lineage.
+    """
+
+    SIZE = 16
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        h = hashlib.sha1(task_id.binary() + index.to_bytes(4, "little")).digest()
+        return cls(h[: cls.SIZE])
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        h = hashlib.sha1(
+            b"put:" + task_id.binary() + put_index.to_bytes(4, "little")
+        ).digest()
+        return cls(h[: cls.SIZE])

@@ -1,0 +1,76 @@
+"""ObjectRef — a future for a (possibly remote) immutable object.
+
+Capability parity with the reference's ObjectRef
+(reference: python/ray/includes/object_ref.pxi; ownership model in
+src/ray/core_worker/reference_counter.h:43): refs are created by task
+submission or ``put``; holding a ref pins the object via the owner's
+reference counter; refs are serializable and passable as task arguments
+(dependency edges); dropping the last ref deletes the object.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: str = "driver",
+                 _register: bool = True):
+        self._id = object_id
+        self._owner = owner
+        self._registered = False
+        if _register:
+            from ray_tpu.core import runtime
+            rt = runtime.get_runtime_or_none()
+            if rt is not None:
+                rt.reference_counter.add_local_reference(object_id)
+                self._registered = True
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    @property
+    def owner(self) -> str:
+        return self._owner
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __hash__(self) -> int:
+        return hash(self._id)
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        # Serialized refs re-register on deserialization (borrowing).
+        return (ObjectRef, (self._id, self._owner))
+
+    def __del__(self):
+        if self._registered:
+            try:
+                from ray_tpu.core import runtime
+            except ImportError:
+                return  # interpreter shutdown
+            rt = runtime.get_runtime_or_none()
+            if rt is not None:
+                try:
+                    rt.reference_counter.remove_local_reference(self._id)
+                except Exception:
+                    pass
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from ray_tpu.core import runtime
+        return runtime.get_runtime().as_future(self)

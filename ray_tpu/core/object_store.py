@@ -1,0 +1,222 @@
+"""Node-local object storage: shared-memory store + in-process memory store.
+
+Capability parity with the reference's two-tier object storage
+(reference: src/ray/core_worker/store_provider/memory_store/memory_store.h:48
+for small/inline objects; store_provider/plasma_store_provider.h:94 +
+src/ray/object_manager/plasma/ for large shared-memory objects). Small
+objects live in the owner's in-process store and are inlined into task
+specs; large objects are packed once into the node's shared-memory arena
+(native C++ store, ray_tpu/native/src/shm_store.cc) and read zero-copy by
+every worker on the node.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from multiprocessing import shared_memory
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+from ray_tpu.native import _lib
+
+
+class SharedMemoryStore:
+    """A view onto the node's shared-memory object arena."""
+
+    def __init__(self, name: str, size: int = 0, create: bool = False,
+                 max_objects: int = 8192):
+        self._lib = _lib.load()
+        self.name = name
+        if create:
+            overhead = self._lib.shm_required_overhead(max_objects)
+            total = size + overhead
+            self._shm = shared_memory.SharedMemory(name=name, create=True, size=total)
+            self._base = self._base_ptr()
+            rc = self._lib.shm_init(self._base, self._shm.size, max_objects)
+            if rc != _lib.OK:
+                raise RuntimeError(f"shm_init failed: {rc}")
+        else:
+            self._shm = shared_memory.SharedMemory(name=name)
+            # Attachers must not unlink the segment at exit; only the
+            # creating node owns its lifetime.
+            try:
+                from multiprocessing import resource_tracker
+                resource_tracker.unregister(self._shm._name, "shared_memory")
+            except Exception:
+                pass
+            self._base = self._base_ptr()
+            rc = self._lib.shm_attach(self._base)
+            if rc != _lib.OK:
+                raise RuntimeError(f"shm_attach failed: {rc}")
+        self._owner = create
+
+    def _base_ptr(self) -> int:
+        return ctypes.addressof(ctypes.c_char.from_buffer(self._shm.buf))
+
+    # -- raw object ops -------------------------------------------------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        cfg = get_config()
+        off = ctypes.c_uint64()
+        for attempt in range(cfg.object_store_full_max_retries):
+            rc = self._lib.shm_create(self._base, object_id.binary(), size,
+                                      ctypes.byref(off))
+            if rc == _lib.OK:
+                return self._shm.buf[off.value : off.value + size]
+            if rc == _lib.EXISTS:
+                raise FileExistsError(object_id)
+            if rc == _lib.FULL:
+                self._lib.shm_evict(self._base, size)
+                time.sleep(cfg.object_store_full_retry_s)
+                continue
+            raise RuntimeError(f"shm_create failed: {rc}")
+        raise ObjectStoreFullError(
+            f"object store full: need {size} bytes, "
+            f"{self.total_bytes() - self.used_bytes()} free"
+        )
+
+    def seal(self, object_id: ObjectID) -> None:
+        rc = self._lib.shm_seal(self._base, object_id.binary())
+        if rc != _lib.OK:
+            raise RuntimeError(f"shm_seal failed: {rc}")
+
+    def get_buffer(self, object_id: ObjectID,
+                   timeout_s: float = 0.0) -> Optional[memoryview]:
+        """Pin + return the payload view; None if absent within timeout."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.shm_get(self._base, object_id.binary(), timeout_s,
+                               ctypes.byref(off), ctypes.byref(size))
+        if rc == _lib.OK:
+            return self._shm.buf[off.value : off.value + size.value]
+        if rc in (_lib.NOT_FOUND, _lib.TIMEOUT, _lib.BAD_STATE):
+            return None
+        raise RuntimeError(f"shm_get failed: {rc}")
+
+    def release(self, object_id: ObjectID) -> None:
+        self._lib.shm_release(self._base, object_id.binary())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.shm_contains(self._base, object_id.binary()))
+
+    def delete(self, object_id: ObjectID) -> None:
+        self._lib.shm_delete(self._base, object_id.binary())
+
+    def used_bytes(self) -> int:
+        return self._lib.shm_used_bytes(self._base)
+
+    def total_bytes(self) -> int:
+        return self._lib.shm_total_bytes(self._base)
+
+    def num_objects(self) -> int:
+        return self._lib.shm_num_objects(self._base)
+
+    # -- value ops ------------------------------------------------------
+    def put_value(self, object_id: ObjectID, value: Any) -> int:
+        """Serialize ``value`` straight into the arena. Returns byte size."""
+        data, buffers = serialization.serialize(value)
+        sizes = [b.nbytes for b in buffers]
+        total = serialization.packed_size(data, sizes)
+        dest = self.create(object_id, total)
+        try:
+            serialization.pack_into(dest, data, buffers, sizes)
+        finally:
+            del dest  # release buffer view before seal (shm.buf exports)
+        self.seal(object_id)
+        return total
+
+    def put_parts(self, object_id: ObjectID, data: bytes,
+                  buffers, sizes) -> int:
+        """Write pre-serialized parts (one serialize pass upstream)."""
+        total = serialization.packed_size(data, sizes)
+        dest = self.create(object_id, total)
+        try:
+            serialization.pack_into(dest, data, buffers, sizes)
+        finally:
+            del dest
+        self.seal(object_id)
+        return total
+
+    def put_packed(self, object_id: ObjectID, packed: bytes) -> int:
+        dest = self.create(object_id, len(packed))
+        try:
+            dest[:] = packed
+        finally:
+            del dest
+        self.seal(object_id)
+        return len(packed)
+
+    def get_value(self, object_id: ObjectID, timeout_s: float = 0.0):
+        """Returns (found, value). Zero-copy for large numpy payloads while
+        the arena mapping lives (process lifetime)."""
+        buf = self.get_buffer(object_id, timeout_s)
+        if buf is None:
+            return False, None
+        try:
+            value = serialization.unpack(buf)
+        finally:
+            # NOTE: the deserialized value may hold views into `buf`; the
+            # pin taken by get_buffer is dropped here, which makes the
+            # object evictable-after-delete while views exist. The owner's
+            # reference count keeps the object alive for the ref lifetime,
+            # which also covers the views (they share the ObjectRef).
+            del buf
+            self.release(object_id)
+        return True, value
+
+    def close(self):
+        # Drop the ctypes export before closing the mapping.
+        self._base = None
+        try:
+            self._shm.close()
+        except BufferError:
+            pass  # outstanding zero-copy views; mapping stays until GC
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class MemoryStore:
+    """In-process store for small objects and pending futures."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[ObjectID, Any] = {}
+        self._events: Dict[ObjectID, threading.Event] = {}
+
+    def put(self, object_id: ObjectID, value: Any) -> None:
+        with self._lock:
+            self._objects[object_id] = value
+            ev = self._events.pop(object_id, None)
+        if ev is not None:
+            ev.set()
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    def get(self, object_id: ObjectID, timeout_s: Optional[float] = None):
+        """Returns (found, value); blocks up to timeout_s for pending puts."""
+        with self._lock:
+            if object_id in self._objects:
+                return True, self._objects[object_id]
+            if timeout_s == 0:
+                return False, None
+            ev = self._events.setdefault(object_id, threading.Event())
+        if not ev.wait(timeout_s):
+            return False, None
+        with self._lock:
+            if object_id in self._objects:
+                return True, self._objects[object_id]
+        return False, None
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+            self._events.pop(object_id, None)

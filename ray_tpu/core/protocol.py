@@ -1,0 +1,84 @@
+"""Wire protocol between node manager and worker processes.
+
+Capability parity with the reference's worker<->raylet IPC
+(reference: src/ray/raylet_ipc_client/client_connection.cc) — a unix
+domain socket carrying length-prefixed pickled messages. The node manager
+is the hub: task dispatch, task completion, nested submission, object
+resolution, and control-plane (GCS) calls all flow over the worker's one
+socket. Unlike the reference there is no worker-to-worker data path yet;
+on one TPU host the shared-memory arena already gives every worker
+zero-copy access to every large object, so the hub only moves control
+messages and small inline values.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+
+_LEN = struct.Struct("<I")
+
+
+def send_msg(sock: socket.socket, msg: dict) -> None:
+    data = serialization.dumps(msg)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    data = _recv_exact(sock, length)
+    if data is None:
+        return None
+    return serialization.loads(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    remaining = n
+    while remaining:
+        try:
+            chunk = sock.recv(remaining)
+        except (ConnectionResetError, OSError):
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+class MessageConnection:
+    """Thread-safe framed-message connection."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self._send_lock = threading.Lock()
+
+    def send(self, msg: dict) -> None:
+        with self._send_lock:
+            send_msg(self.sock, msg)
+
+    def recv(self) -> Optional[dict]:
+        return recv_msg(self.sock)
+
+    def close(self) -> None:
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.sock.close()
+
+
+# --- message kinds (node manager <-> worker) ---------------------------
+# worker -> node: REGISTER, TASK_DONE, SUBMIT, GET_OBJECT, PUT_META,
+#                 GCS_REQUEST, WAIT, ACTOR_STATE
+# node -> worker: EXECUTE, EXECUTE_ACTOR_TASK, CREATE_ACTOR, OBJECT_VALUE,
+#                 GCS_REPLY, KILL, SHUTDOWN

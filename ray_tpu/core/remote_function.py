@@ -1,0 +1,128 @@
+"""@remote functions — task submission frontend.
+
+Capability parity with the reference's RemoteFunction
+(reference: python/ray/remote_function.py:313 _remote — serialize args,
+register the function in the GCS function store once, build a TaskSpec,
+submit via the core worker; options() for per-call overrides).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.task_spec import Arg, SchedulingStrategy, TaskSpec
+
+
+def resources_from_options(options: Dict[str, Any],
+                           default_cpu: float = 1.0) -> Dict[str, float]:
+    resources = dict(options.get("resources") or {})
+    num_cpus = options.get("num_cpus")
+    resources["CPU"] = float(default_cpu if num_cpus is None else num_cpus)
+    if resources["CPU"] == 0:
+        resources.pop("CPU")
+    num_tpus = options.get("num_tpus")
+    if num_tpus:
+        resources["TPU"] = float(num_tpus)
+    memory = options.get("memory")
+    if memory:
+        resources["memory"] = float(memory)
+    return resources
+
+
+def strategy_from_options(options: Dict[str, Any]) -> SchedulingStrategy:
+    strategy = options.get("scheduling_strategy")
+    if strategy is None:
+        return SchedulingStrategy()
+    if isinstance(strategy, str):
+        return SchedulingStrategy(kind=strategy)
+    return strategy  # already a SchedulingStrategy (or PG strategy adapter)
+
+
+def value_to_arg(value: Any, runtime) -> Arg:
+    """Convert one call argument into a TaskSpec Arg.
+
+    ObjectRefs become dependency edges; small values inline into the spec;
+    large values are put into the object store and passed by reference
+    (reference: task_submission/dependency_resolver.h:35 inlining rules).
+    """
+    if isinstance(value, ObjectRef):
+        return Arg(object_id=value.id)
+    data, buffers = serialization.serialize(value)
+    if not buffers and len(data) <= get_config().max_inline_object_size:
+        return Arg(value_bytes=serialization.pack_parts(data, buffers))
+    ref = runtime.put_serialized(data, buffers)
+    arg = Arg(object_id=ref.id)
+    arg._keepalive = ref  # pin until the spec (and thus the arg) is dropped
+    return arg
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(options or {})
+        self._lock = threading.Lock()
+        self._blob: Optional[bytes] = None
+        self._function_id: Optional[str] = None
+        self._registered_with: Optional[int] = None
+
+    @property
+    def options_dict(self):
+        return self._options
+
+    def _ensure_registered(self, runtime) -> str:
+        with self._lock:
+            if self._blob is None:
+                self._blob = serialization.dumps(self._fn)
+                name = getattr(self._fn, "__qualname__", "fn")
+                digest = hashlib.sha1(self._blob).hexdigest()[:24]
+                self._function_id = f"fn:{name}:{digest}"
+            if self._registered_with != id(runtime):
+                runtime.put_function(self._function_id, self._blob)
+                self._registered_with = id(runtime)
+            return self._function_id
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(overrides)
+        clone = RemoteFunction(self._fn, merged)
+        clone._blob = self._blob
+        clone._function_id = self._function_id
+        return clone
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime()
+        function_id = self._ensure_registered(rt)
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=rt.next_task_id(),
+            function_id=function_id,
+            args=[value_to_arg(a, rt) for a in args],
+            kwargs={k: value_to_arg(v, rt) for k, v in kwargs.items()},
+            num_returns=num_returns,
+            resources=resources_from_options(opts),
+            strategy=strategy_from_options(opts),
+            max_retries=opts.get("max_retries", get_config().task_max_retries),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            name=opts.get("name") or getattr(self._fn, "__qualname__", ""),
+        )
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        rt.submit_spec(spec)
+        return refs[0] if num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            "remote functions cannot be called directly; use .remote()")
+
+    def __reduce__(self):
+        # Remote functions close over locks/caches; reconstruct from the
+        # wrapped function + options so they serialize into closures
+        # (e.g. a task that submits further tasks).
+        return (RemoteFunction, (self._fn, self._options))

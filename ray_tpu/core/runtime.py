@@ -1,0 +1,730 @@
+"""Driver/head runtime: submission, scheduling loop, ownership, actors.
+
+Capability parity with the reference's core-worker driver role plus the
+GCS-side managers (reference: src/ray/core_worker/core_worker.h:170
+SubmitTask/Get/Put/Wait; gcs_actor_manager.h:93 actor lifecycle +
+restarts; task retry in task_manager.h:175). The head process is the
+single owner and scheduler authority: workers reach it over their node
+socket, nodes are in-process objects (multi-node simulated clusters run
+many Nodes in this one process — reference: python/ray/cluster_utils.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config, reset_config
+from ray_tpu.core.gcs import ActorRecord, Gcs, JobRecord, NodeRecord
+from ray_tpu.core.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.node import Node
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import MemoryStore
+from ray_tpu.core.scheduler import ClusterScheduler
+from ray_tpu.core.task_manager import ObjectLocation, ReferenceCounter, TaskManager
+from ray_tpu.core.task_spec import TaskEvent, TaskSpec
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+_runtime_lock = threading.Lock()
+_runtime = None
+
+
+def get_runtime():
+    if _runtime is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _runtime
+
+
+def get_runtime_or_none():
+    return _runtime
+
+
+def set_runtime(rt) -> None:
+    global _runtime
+    with _runtime_lock:
+        _runtime = rt
+
+
+class ActorInfo:
+    def __init__(self, creation_spec: TaskSpec):
+        self.creation_spec = creation_spec
+        self.node_id: Optional[NodeID] = None
+        self.worker_id: Optional[WorkerID] = None
+        self.buffered: deque = deque()
+        self.lock = threading.Lock()
+        # Node whose resources the creation task acquired; released exactly
+        # once per incarnation at actor death.
+        self.resources_node: Optional[NodeID] = None
+
+
+class DriverRuntime:
+    is_driver = True
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_memory: Optional[int] = None,
+                 system_config: Optional[dict] = None,
+                 namespace: str = ""):
+        reset_config(system_config)
+        self.gcs = Gcs()
+        self.scheduler = ClusterScheduler(self.gcs)
+        self.task_manager = TaskManager()
+        self.reference_counter = ReferenceCounter()
+        self.reference_counter.set_deleter(self._maybe_delete_object)
+        self.memory_store = MemoryStore()
+        self.namespace = namespace
+        self.job_id = JobID.from_random()
+        self.gcs.register_job(JobRecord(self.job_id))
+        self.nodes: Dict[NodeID, Node] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+        self._driver_task_id = TaskID.from_random()
+        self._stopped = threading.Event()
+        # Scheduling queue
+        self._sched_cond = threading.Condition()
+        self._schedulable: deque = deque()
+        self._infeasible: List[TaskSpec] = []
+        self._sched_thread = threading.Thread(
+            target=self._scheduling_loop, name="scheduler", daemon=True)
+        self.head_node_id = self.add_node(
+            resources if resources is not None else None, labels,
+            object_store_memory)
+        self._sched_thread.start()
+
+    # --- cluster membership --------------------------------------------
+    def add_node(self, resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 object_store_memory: Optional[int] = None) -> NodeID:
+        import multiprocessing
+        if resources is None:
+            resources = {}
+        resources = dict(resources)
+        resources.setdefault("CPU", float(multiprocessing.cpu_count()))
+        node_id = NodeID.from_random()
+        node = Node(self, node_id, resources, labels,
+                    object_store_memory=object_store_memory)
+        self.nodes[node_id] = node
+        self.scheduler.add_node(node_id, resources, labels)
+        self.gcs.register_node(NodeRecord(
+            node_id=node_id, address=node.socket_path,
+            resources_total=resources, labels=dict(labels or {}),
+            node_manager=node))
+        # New capacity: re-check infeasible + queued work.
+        with self._sched_cond:
+            self._schedulable.extend(self._infeasible)
+            self._infeasible.clear()
+            self._sched_cond.notify_all()
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        """Simulate node failure (chaos testing). In-flight work is
+        retried or failed exactly as if each worker crashed
+        (reference: node death notifications, node_manager.proto)."""
+        node = self.nodes.pop(node_id, None)
+        if node is None:
+            return
+        self.scheduler.remove_node(node_id)
+        self.gcs.mark_node_dead(node_id)
+        from ray_tpu.core.node import ACTOR as ACTOR_STATE
+        with node._lock:
+            casualties = [
+                (w, list(w.running.values()),
+                 w.actor_id if w.state == ACTOR_STATE else None)
+                for w in node._workers.values()
+            ]
+            queued = [s for q in node._dispatch_queue.values() for s in q]
+        node.stop()
+        for worker, running, actor_id in casualties:
+            if running or actor_id is not None:
+                self.on_worker_crashed(node, worker, running, actor_id)
+        # Tasks queued but never started are rescheduled without consuming
+        # a retry (the lease was never granted).
+        for spec in queued:
+            self.scheduler.release(node_id, self._spec_resources(spec))
+            self._enqueue(spec)
+
+    # --- submission ----------------------------------------------------
+    def submit_spec(self, spec: TaskSpec) -> None:
+        if spec.is_actor_creation and spec.actor_id not in self.actors:
+            # Actor created from inside a worker: register here (the head
+            # owns actor lifecycle, reference: gcs_actor_manager.h:93).
+            self.create_actor(spec)
+            return
+        self.task_manager.add_pending(spec)
+        self._record_event(spec, "PENDING")
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            self._route_actor_task(spec)
+            return
+        deps = [d for d in spec.dependencies()
+                if not self.task_manager.is_ready(d)]
+        if not deps:
+            self._enqueue(spec)
+            return
+        remaining = [len(deps)]
+        lock = threading.Lock()
+
+        def on_dep_ready():
+            with lock:
+                remaining[0] -= 1
+                if remaining[0] != 0:
+                    return
+            self._enqueue(spec)
+
+        for dep in deps:
+            self.task_manager.on_ready(dep, on_dep_ready)
+
+    def _enqueue(self, spec: TaskSpec) -> None:
+        with self._sched_cond:
+            self._schedulable.append(spec)
+            self._sched_cond.notify_all()
+
+    def _scheduling_loop(self) -> None:
+        backlog: deque = deque()
+        while not self._stopped.is_set():
+            with self._sched_cond:
+                while not self._schedulable and not backlog and not self._stopped.is_set():
+                    self._sched_cond.wait(timeout=0.2)
+                if self._stopped.is_set():
+                    return
+                work = list(self._schedulable)
+                self._schedulable.clear()
+            backlog.extend(work)
+            made_progress = False
+            for _ in range(len(backlog)):
+                spec = backlog.popleft()
+                task = self.task_manager.get_pending(spec.task_id)
+                if task is None:
+                    continue  # cancelled/failed meanwhile
+                try:
+                    node_id = self.scheduler.pick_node(
+                        spec, preferred=self.head_node_id)
+                except ValueError:
+                    with self._sched_cond:  # add_node drains this list
+                        self._infeasible.append(spec)
+                    continue
+                if node_id is None or not self.scheduler.try_acquire(
+                        node_id, self._spec_resources(spec)):
+                    backlog.append(spec)
+                    continue
+                if spec.is_actor_creation:
+                    info = self.actors.get(spec.actor_id)
+                    if info is not None:
+                        info.resources_node = node_id
+                self.task_manager.mark_dispatched(spec.task_id, node_id)
+                self._record_event(spec, "SCHEDULED", node_id=node_id)
+                self.nodes[node_id].dispatch(spec)
+                made_progress = True
+            if backlog and not made_progress:
+                # All blocked on capacity; wait for a release/completion.
+                with self._sched_cond:
+                    self._sched_cond.wait(timeout=0.05)
+
+    def _spec_resources(self, spec: TaskSpec) -> Dict[str, float]:
+        from ray_tpu.core.scheduler import _pg_resources
+        if (spec.strategy.kind == "PLACEMENT_GROUP"
+                and spec.strategy.placement_group_id is not None):
+            return _pg_resources(spec.resources,
+                                 spec.strategy.placement_group_id,
+                                 spec.strategy.bundle_index)
+        return spec.resources
+
+    # --- actor routing -------------------------------------------------
+    def create_actor(self, spec: TaskSpec, name: Optional[str] = None) -> None:
+        record = ActorRecord(
+            actor_id=spec.actor_id, name=name, namespace=self.namespace,
+            state="PENDING", spec=spec, max_restarts=spec.max_restarts)
+        self.gcs.register_actor(record)
+        self.actors[spec.actor_id] = ActorInfo(spec)
+        self.submit_spec(spec)
+
+    def _route_actor_task(self, spec: TaskSpec) -> None:
+        info = self.actors.get(spec.actor_id)
+        record = self.gcs.get_actor(spec.actor_id)
+        if info is None or record is None:
+            self.task_manager.fail(spec.task_id,
+                                   ActorDiedError(spec.actor_id, "unknown actor"))
+            return
+        with info.lock:
+            if record.state == "DEAD":
+                self.task_manager.fail(
+                    spec.task_id,
+                    ActorDiedError(spec.actor_id,
+                                   f"actor is dead: {record.death_cause}"))
+                return
+            if record.state != "ALIVE" or info.worker_id is None:
+                info.buffered.append(spec)
+                return
+            node = self.nodes.get(info.node_id)
+        ok = node is not None and node.dispatch_to_actor(info.worker_id, spec)
+        if not ok:
+            with info.lock:
+                info.buffered.append(spec)
+
+    def _flush_actor_buffer(self, actor_id: ActorID) -> None:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return
+        with info.lock:
+            buffered = list(info.buffered)
+            info.buffered.clear()
+        for spec in buffered:
+            self._route_actor_task(spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        info = self.actors.get(actor_id)
+        record = self.gcs.get_actor(actor_id)
+        if info is None or record is None:
+            return
+        if no_restart:
+            self.gcs.update_actor_state(actor_id, "DEAD",
+                                        death_cause="killed via kill()")
+        node = self.nodes.get(info.node_id)
+        if node is not None and info.worker_id is not None:
+            node.kill_worker(info.worker_id)
+
+    # --- completion callbacks (called from node reader threads) ---------
+    def on_task_done(self, node: Node, worker, spec: TaskSpec, msg: dict) -> None:
+        error_blob = msg.get("error")
+        if error_blob is not None:
+            err = serialization.loads(error_blob)
+            if spec.retry_exceptions:
+                retry = self.task_manager.consume_retry(spec.task_id)
+                if retry is not None:
+                    self._release_task_resources(spec, node.node_id)
+                    self._resubmit(retry)
+                    return
+            if spec.is_actor_creation:
+                self.gcs.update_actor_state(spec.actor_id, "DEAD",
+                                            death_cause=str(err))
+                info = self.actors.get(spec.actor_id)
+                if info is not None:
+                    self._release_actor_resources(info)
+                self._fail_actor_buffer(spec.actor_id, err)
+            self._record_event(spec, "FAILED", node_id=node.node_id,
+                              error=msg.get("error_str"))
+            self.task_manager.fail(spec.task_id, err)
+            self._release_task_resources(spec, node.node_id)
+            self._signal_scheduler()
+            return
+        for oid_bytes, kind, data in msg.get("results", ()):
+            oid = ObjectID(oid_bytes)
+            if kind == "inline":
+                self.memory_store.put(oid, ("packed", bytes(data)))
+                self.task_manager.set_location(oid, ObjectLocation("memory"))
+            else:
+                self.task_manager.set_location(
+                    oid, ObjectLocation("shm", node.node_id))
+            self.task_manager.mark_object_ready(oid)
+        if spec.is_actor_creation:
+            info = self.actors.get(spec.actor_id)
+            if info is not None:
+                with info.lock:
+                    info.node_id = node.node_id
+                    info.worker_id = worker.worker_id
+                self.gcs.update_actor_state(spec.actor_id, "ALIVE",
+                                            node_id=node.node_id)
+                self._flush_actor_buffer(spec.actor_id)
+            self.task_manager.complete(spec.task_id)
+            # Creation resources stay held for the actor's lifetime.
+        else:
+            self.task_manager.complete(spec.task_id)
+            self._release_task_resources(spec, node.node_id)
+        self._record_event(spec, "FINISHED", node_id=node.node_id)
+        self._signal_scheduler()
+
+    def _release_task_resources(self, spec: TaskSpec, node_id: NodeID) -> None:
+        if spec.actor_id is not None:
+            # Method tasks hold no scheduler resources; creation resources
+            # are owned by the actor lifecycle (_release_actor_resources).
+            return
+        self.scheduler.release(node_id, self._spec_resources(spec))
+
+    def _signal_scheduler(self) -> None:
+        with self._sched_cond:
+            self._sched_cond.notify_all()
+
+    def _resubmit(self, spec: TaskSpec) -> None:
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            self._route_actor_task(spec)
+        else:
+            deps = [d for d in spec.dependencies()
+                    if not self.task_manager.is_ready(d)]
+            if deps:
+                remaining = [len(deps)]
+                lock = threading.Lock()
+
+                def on_dep_ready():
+                    with lock:
+                        remaining[0] -= 1
+                        if remaining[0]:
+                            return
+                    self._enqueue(spec)
+
+                for dep in deps:
+                    self.task_manager.on_ready(dep, on_dep_ready)
+            else:
+                self._enqueue(spec)
+
+    def on_worker_crashed(self, node: Node, worker, running: List[TaskSpec],
+                          actor_id: Optional[ActorID]) -> None:
+        cfg = get_config()
+        for spec in running:
+            if not spec.is_actor_creation and spec.actor_id is None:
+                self.scheduler.release(node.node_id, self._spec_resources(spec))
+            retry = self.task_manager.consume_retry(spec.task_id)
+            if retry is not None and not spec.is_actor_creation:
+                self._resubmit(retry)
+            elif spec.is_actor_creation:
+                pass  # handled by actor restart below
+            else:
+                err: Exception = WorkerCrashedError(
+                    f"worker {worker.worker_id.hex()[:8]} died while running "
+                    f"{spec.name or spec.function_id}")
+                if spec.actor_id is not None:
+                    err = ActorUnavailableError(spec.actor_id, str(err))
+                self._record_event(spec, "FAILED", node_id=node.node_id,
+                                  error=str(err))
+                self.task_manager.fail(spec.task_id, err)
+        if actor_id is not None or any(s.is_actor_creation for s in running):
+            aid = actor_id or next(
+                s.actor_id for s in running if s.is_actor_creation)
+            self._handle_actor_death(aid, node)
+        self._signal_scheduler()
+
+    def _release_actor_resources(self, info: ActorInfo) -> None:
+        """Release the creation-task resources exactly once per incarnation
+        (covers kill(), crash during __init__, and death while ALIVE)."""
+        node_id = info.resources_node
+        if node_id is None:
+            return
+        info.resources_node = None
+        self.scheduler.release(node_id,
+                               self._spec_resources(info.creation_spec))
+
+    def _handle_actor_death(self, actor_id: ActorID, node: Node) -> None:
+        record = self.gcs.get_actor(actor_id)
+        info = self.actors.get(actor_id)
+        if record is None or info is None:
+            return
+        self._release_actor_resources(info)
+        if record.state == "DEAD":
+            self._fail_actor_buffer(actor_id,
+                                    ActorDiedError(actor_id, "actor killed"))
+            return
+        can_restart = (record.max_restarts == -1
+                       or record.num_restarts < record.max_restarts)
+        if can_restart:
+            record.num_restarts += 1
+            with info.lock:
+                info.node_id = None
+                info.worker_id = None
+            new_spec = TaskSpec(
+                task_id=TaskID.from_random(),
+                function_id=info.creation_spec.function_id,
+                args=info.creation_spec.args,
+                kwargs=info.creation_spec.kwargs,
+                num_returns=1,
+                resources=info.creation_spec.resources,
+                strategy=info.creation_spec.strategy,
+                max_retries=0,
+                name=info.creation_spec.name,
+                actor_id=actor_id,
+                is_actor_creation=True,
+                max_restarts=info.creation_spec.max_restarts,
+                max_concurrency=info.creation_spec.max_concurrency,
+            )
+            info.creation_spec = new_spec
+            self.gcs.update_actor_state(actor_id, "RESTARTING")
+            self.task_manager.add_pending(new_spec)
+            self._enqueue(new_spec)
+        else:
+            self.gcs.update_actor_state(actor_id, "DEAD",
+                                        death_cause="worker died")
+            self._fail_actor_buffer(
+                actor_id, ActorDiedError(actor_id, "actor worker died"))
+
+    def _fail_actor_buffer(self, actor_id: ActorID, err: Exception) -> None:
+        info = self.actors.get(actor_id)
+        if info is None:
+            return
+        with info.lock:
+            buffered = list(info.buffered)
+            info.buffered.clear()
+        for spec in buffered:
+            self.task_manager.fail(spec.task_id, err)
+
+    # --- object plane ---------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        data, buffers = serialization.serialize(value)
+        return self.put_serialized(data, buffers)
+
+    def put_serialized(self, data: bytes, buffers) -> ObjectRef:
+        """Store already-serialized parts (single serialize pass)."""
+        with self._put_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        oid = ObjectID.for_put(self._driver_task_id, idx)
+        cfg = get_config()
+        if not buffers and len(data) < cfg.max_inline_object_size:
+            packed = serialization.pack_parts(data, buffers)
+            self.memory_store.put(oid, ("packed", packed))
+            self.task_manager.set_location(oid, ObjectLocation("memory"))
+        else:
+            head = self.nodes[self.head_node_id]
+            head.store.put_parts(oid, data, buffers,
+                                 [b.nbytes for b in buffers])
+            self.task_manager.set_location(
+                oid, ObjectLocation("shm", self.head_node_id))
+        self.task_manager.mark_object_ready(oid)
+        return ObjectRef(oid)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            out.append(self._get_one(ref.id, remaining))
+        return out[0] if single else out
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float]):
+        if not self.task_manager.wait_ready(oid, timeout):
+            raise GetTimeoutError(f"get() timed out waiting for {oid}")
+        err = self.task_manager.get_error(oid)
+        if err is not None:
+            raise err
+        found, stored = self.memory_store.get(oid, timeout_s=0)
+        if found:
+            kind, payload = stored
+            return serialization.unpack(payload) if kind == "packed" else payload
+        loc = self.task_manager.get_location(oid)
+        if loc is not None and loc.kind == "shm":
+            node = self.nodes.get(loc.node_id)
+            if node is not None:
+                found, value = node.store.get_value(oid, timeout_s=5.0)
+                if found:
+                    return value
+        raise ObjectLostError(oid)
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        event = threading.Event()
+        for ref in refs:
+            self.task_manager.on_ready(ref.id, event.set)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready = [r for r in refs if self.task_manager.is_ready(r.id)]
+            if len(ready) >= num_returns:
+                break
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                break
+            event.clear()
+            event.wait(remaining if remaining is not None else 0.2)
+        ready_set = {r.id for r in ready}
+        done = ready[:num_returns]
+        done_set = {r.id for r in done}
+        rest = [r for r in refs if r.id not in done_set]
+        return done, rest
+
+    def _maybe_delete_object(self, oid: ObjectID) -> None:
+        """Called when the local reference count drops to zero
+        (reference: reference_counter.h — delete at refcount 0)."""
+        if not self.task_manager.is_ready(oid):
+            return  # producing task still running; keep bookkeeping
+        self.memory_store.delete(oid)
+        loc = self.task_manager.get_location(oid)
+        if loc is not None and loc.kind == "shm":
+            node = self.nodes.get(loc.node_id)
+            if node is not None:
+                node.store.delete(oid)
+        self.task_manager.forget_object(oid)
+
+    # --- worker message handlers ----------------------------------------
+    def on_worker_put(self, node: Node, msg: dict) -> None:
+        oid = ObjectID(msg["object_id"])
+        self.task_manager.set_location(oid, ObjectLocation("shm", node.node_id))
+        self.task_manager.mark_object_ready(oid)
+
+    def handle_get_object(self, node: Node, worker, msg: dict) -> None:
+        oid = ObjectID(msg["object_id"])
+        req_id = msg.get("req_id")
+
+        def reply():
+            out = {"kind": "OBJECT_VALUE", "req_id": req_id}
+            err = self.task_manager.get_error(oid)
+            if err is not None:
+                out.update(status="error", error=serialization.dumps(err))
+                worker.send(out)
+                return
+            found, stored = self.memory_store.get(oid, timeout_s=0)
+            if found:
+                kind, payload = stored
+                out.update(status="inline", data=payload)
+                worker.send(out)
+                return
+            loc = self.task_manager.get_location(oid)
+            if loc is not None and loc.kind == "shm":
+                if loc.node_id == node.node_id:
+                    out.update(status="shm_local")
+                else:
+                    src = self.nodes.get(loc.node_id)
+                    buf = src.store.get_buffer(oid, timeout_s=5.0) if src else None
+                    if buf is None:
+                        out.update(status="error", error=serialization.dumps(
+                            ObjectLostError(oid)))
+                    else:
+                        # Inter-node object transfer (simulated C5 path).
+                        out.update(status="inline", data=bytes(buf))
+                        del buf
+                        src.store.release(oid)
+                worker.send(out)
+                return
+            out.update(status="error",
+                       error=serialization.dumps(ObjectLostError(oid)))
+            worker.send(out)
+
+        self.task_manager.on_ready(oid, reply)
+
+    def handle_check_ready(self, worker, msg: dict) -> None:
+        ready = [b for b in msg["object_ids"]
+                 if self.task_manager.is_ready(ObjectID(b))]
+        worker.send({"kind": "READY_REPLY", "req_id": msg.get("req_id"),
+                     "ready": ready})
+
+    def handle_gcs_request(self, worker, msg: dict) -> None:
+        method = msg["method"]
+        args = serialization.loads(msg["args"])
+        out = {"kind": "GCS_REPLY", "req_id": msg.get("req_id"), "error": None}
+        try:
+            result = self._gcs_dispatch(method, args)
+            out["result"] = serialization.dumps(result)
+        except Exception as e:  # noqa: BLE001
+            out["error"] = serialization.dumps(e)
+            out["result"] = None
+        worker.send(out)
+
+    def _gcs_dispatch(self, method: str, args: tuple) -> Any:
+        gcs = self.gcs
+        if method == "get_function":
+            return gcs.get_function(args[0])
+        if method == "put_function":
+            gcs.put_function(args[0], args[1])
+            return True
+        if method == "node_labels":
+            rec = gcs.nodes.get(NodeID(args[0]))
+            return dict(rec.labels) if rec else {}
+        if method == "kv_put":
+            gcs.kv.put(args[0], args[1], namespace=args[2])
+            return True
+        if method == "kv_get":
+            return gcs.kv.get(args[0], namespace=args[1])
+        if method == "kv_del":
+            return gcs.kv.delete(args[0], namespace=args[1])
+        if method == "kv_keys":
+            return gcs.kv.keys(args[0], namespace=args[1])
+        if method == "kv_exists":
+            return gcs.kv.exists(args[0], namespace=args[1])
+        if method == "actor_state":
+            rec = gcs.get_actor(ActorID(args[0]))
+            return rec.state if rec else None
+        if method == "get_named_actor_handle":
+            return gcs.kv.get(args[0].encode(), namespace="actor_handles")
+        if method == "cluster_resources":
+            return self.cluster_resources()
+        if method == "available_resources":
+            return self.available_resources()
+        raise ValueError(f"unknown GCS method {method}")
+
+    # --- misc api --------------------------------------------------------
+    def gcs_call(self, method: str, *args) -> Any:
+        return self._gcs_dispatch(method, args)
+
+    def cancel(self, object_id: ObjectID, force: bool = False) -> None:
+        task_id = self.task_manager.producing_task(object_id)
+        if task_id is None:
+            return
+        with self._sched_cond:
+            for spec in list(self._schedulable):
+                if spec.task_id == task_id:
+                    self._schedulable.remove(spec)
+                    self.task_manager.fail(task_id, TaskCancelledError(task_id))
+                    return
+        if force:
+            task = self.task_manager.get_pending(task_id)
+            if task is not None and task.node_id is not None:
+                node = self.nodes.get(task.node_id)
+                if node is not None:
+                    with node._lock:
+                        for w in node._workers.values():
+                            if task_id in w.running:
+                                node.kill_worker(w.worker_id)
+                                break
+
+    def cluster_resources(self) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        for view in self.scheduler.snapshot().values():
+            for k, v in view.total.items():
+                totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+    def available_resources(self) -> Dict[str, float]:
+        totals: Dict[str, float] = {}
+        for view in self.scheduler.snapshot().values():
+            for k, v in view.available.items():
+                totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    def put_function(self, function_id: str, blob: bytes) -> None:
+        self.gcs.put_function(function_id, blob)
+
+    def get_function(self, function_id: str):
+        blob = self.gcs.get_function(function_id)
+        return serialization.loads(blob) if blob else None
+
+    def as_future(self, ref: ObjectRef):
+        from concurrent.futures import Future
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def _record_event(self, spec: TaskSpec, state: str,
+                      node_id: Optional[NodeID] = None,
+                      error: Optional[str] = None) -> None:
+        self.gcs.add_task_event(TaskEvent(
+            task_id=spec.task_id, name=spec.name or spec.function_id,
+            state=state, node_id=node_id, error=error))
+
+    def shutdown(self) -> None:
+        self._stopped.set()
+        self._signal_scheduler()
+        for node in list(self.nodes.values()):
+            node.stop()
+        self.nodes.clear()
+        set_runtime(None)

@@ -1,0 +1,292 @@
+"""Cluster resource scheduler.
+
+Capability parity with the reference's two-level scheduler
+(reference: src/ray/raylet/scheduling/cluster_lease_manager.cc:196,
+cluster_resource_scheduler.h:45, policy/hybrid_scheduling_policy.h:50,
+policy/bundle_scheduling_policy.h): a cluster-wide resource view, a
+hybrid pack-then-spread default policy, SPREAD / node-affinity /
+node-label strategies, and atomic all-or-nothing placement-group bundle
+reservation (reference: 2PC in gcs_placement_group_scheduler.h:281 —
+here a single lock suffices because the scheduler is centralized in the
+head process).
+
+Resource demand that cannot be satisfied is queued; the per-node local
+schedulers (ray_tpu/core/node.py) pull granted leases and dispatch to
+workers. Demand summaries are exported for the autoscaler
+(reference: gcs_autoscaler_state_manager.h:41).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu.core.config import get_config
+from ray_tpu.core.gcs import Bundle, Gcs, NodeRecord, PlacementGroupRecord
+from ray_tpu.core.ids import NodeID, PlacementGroupID
+from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
+from ray_tpu.exceptions import PlacementGroupUnschedulableError
+
+
+def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+
+def _feasible(total: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(total.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+
+@dataclass
+class NodeResources:
+    total: Dict[str, float]
+    available: Dict[str, float]
+    labels: Dict[str, str] = field(default_factory=dict)
+    queue_depth: int = 0  # leases granted but not yet finished
+
+
+class ClusterScheduler:
+    def __init__(self, gcs: Gcs):
+        self._gcs = gcs
+        self._lock = threading.RLock()
+        self._nodes: Dict[NodeID, NodeResources] = {}
+        self._rr_counter = 0
+
+    # --- node membership ----------------------------------------------
+    def add_node(self, node_id: NodeID, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._nodes[node_id] = NodeResources(
+                total=dict(resources), available=dict(resources),
+                labels=dict(labels or {}))
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def add_node_resources(self, node_id: NodeID, resources: Dict[str, float]) -> None:
+        """Dynamically extend a node's totals (e.g. placement-group bundle
+        resources materialize as `CPU_group_{pgid}` custom resources)."""
+        with self._lock:
+            view = self._nodes[node_id]
+            for k, v in resources.items():
+                view.total[k] = view.total.get(k, 0.0) + v
+                view.available[k] = view.available.get(k, 0.0) + v
+
+    def strip_node_resources(self, node_id: NodeID, keys: List[str]) -> None:
+        with self._lock:
+            view = self._nodes.get(node_id)
+            if view is None:
+                return
+            for k in keys:
+                view.total.pop(k, None)
+                view.available.pop(k, None)
+
+    # --- accounting ----------------------------------------------------
+    def try_acquire(self, node_id: NodeID, need: Dict[str, float]) -> bool:
+        with self._lock:
+            view = self._nodes.get(node_id)
+            if view is None or not _fits(view.available, need):
+                return False
+            for k, v in need.items():
+                view.available[k] = view.available.get(k, 0.0) - v
+            view.queue_depth += 1
+            return True
+
+    def release(self, node_id: NodeID, need: Dict[str, float]) -> None:
+        with self._lock:
+            view = self._nodes.get(node_id)
+            if view is None:
+                return
+            for k, v in need.items():
+                view.available[k] = min(view.total.get(k, 0.0),
+                                        view.available.get(k, 0.0) + v)
+            view.queue_depth = max(0, view.queue_depth - 1)
+
+    def available(self, node_id: NodeID) -> Dict[str, float]:
+        with self._lock:
+            view = self._nodes.get(node_id)
+            return dict(view.available) if view else {}
+
+    def snapshot(self) -> Dict[NodeID, NodeResources]:
+        with self._lock:
+            return {
+                nid: NodeResources(dict(v.total), dict(v.available),
+                                   dict(v.labels), v.queue_depth)
+                for nid, v in self._nodes.items()
+            }
+
+    # --- placement policy ----------------------------------------------
+    def pick_node(self, spec: TaskSpec,
+                  preferred: Optional[NodeID] = None) -> Optional[NodeID]:
+        """Choose a node with resources available now; None if none can.
+
+        Raises ValueError if no node is even *feasible* (infeasible task).
+        """
+        need = dict(spec.resources)
+        strategy = spec.strategy
+        if strategy.kind == "PLACEMENT_GROUP" and strategy.placement_group_id:
+            need = _pg_resources(need, strategy.placement_group_id,
+                                 strategy.bundle_index)
+        with self._lock:
+            candidates = list(self._nodes.items())
+            if strategy.kind == "NODE_AFFINITY" and strategy.node_id is not None:
+                view = self._nodes.get(strategy.node_id)
+                if view is not None and _fits(view.available, need):
+                    return strategy.node_id
+                if not strategy.soft:
+                    return None
+            if strategy.kind == "NODE_LABEL" and strategy.labels:
+                candidates = [
+                    (nid, v) for nid, v in candidates
+                    if all(v.labels.get(k) == val
+                           for k, val in strategy.labels.items())
+                ]
+            feasible = [(nid, v) for nid, v in candidates if _feasible(v.total, need)]
+            if not feasible:
+                raise ValueError(
+                    f"no feasible node for resources {need} "
+                    f"(strategy {strategy.kind})")
+            fitting = [(nid, v) for nid, v in feasible if _fits(v.available, need)]
+            if not fitting:
+                return None
+            if strategy.kind == "SPREAD":
+                self._rr_counter += 1
+                fitting.sort(key=lambda kv: (kv[1].queue_depth, kv[0].hex()))
+                return fitting[self._rr_counter % len(fitting)][0]
+            # Hybrid default: pack onto the preferred (local) node until its
+            # queue depth crosses the spread threshold, then least-loaded
+            # (reference: hybrid_scheduling_policy.h:50).
+            threshold = get_config().scheduler_spread_threshold
+            if preferred is not None:
+                for nid, v in fitting:
+                    if nid == preferred and v.queue_depth <= max(
+                            1, threshold * sum(v.total.get("CPU", 1) for _ in (0,))):
+                        return nid
+            fitting.sort(key=lambda kv: (kv[1].queue_depth, kv[0].hex()))
+            return fitting[0][0]
+
+    # --- placement groups ----------------------------------------------
+    def reserve_placement_group(self, pg: PlacementGroupRecord) -> None:
+        """Atomically reserve all bundles or raise (all-or-nothing).
+
+        On success each bundle's resources are converted into
+        pg-scoped custom resources (`{res}_group_{i}_{pgid}` and
+        `{res}_group_{pgid}`) on the chosen node, mirroring the
+        reference's bundle resource formatting
+        (reference: src/ray/common/placement_group.h FormatPlacementGroupResource).
+        """
+        with self._lock:
+            assignment = self._solve_bundles(pg)
+            if assignment is None:
+                raise PlacementGroupUnschedulableError(
+                    f"cannot place bundles {[b.resources for b in pg.bundles]} "
+                    f"with strategy {pg.strategy}")
+            pgid = pg.pg_id.hex()
+            for bundle, node_id in zip(pg.bundles, assignment):
+                view = self._nodes[node_id]
+                for k, v in bundle.resources.items():
+                    view.available[k] -= v
+                    view.total[k] -= v
+                bundle.node_id = node_id
+                wildcard = {f"{k}_group_{pgid}": v for k, v in bundle.resources.items()}
+                indexed = {f"{k}_group_{bundle.index}_{pgid}": v
+                           for k, v in bundle.resources.items()}
+                self.add_node_resources(node_id, {**wildcard, **indexed})
+            pg.state = "CREATED"
+
+    def return_placement_group(self, pg: PlacementGroupRecord) -> None:
+        with self._lock:
+            pgid = pg.pg_id.hex()
+            for bundle in pg.bundles:
+                if bundle.node_id is None:
+                    continue
+                keys = [f"{k}_group_{pgid}" for k in bundle.resources]
+                keys += [f"{k}_group_{bundle.index}_{pgid}" for k in bundle.resources]
+                self.strip_node_resources(bundle.node_id, keys)
+                view = self._nodes.get(bundle.node_id)
+                if view is not None:
+                    for k, v in bundle.resources.items():
+                        view.total[k] = view.total.get(k, 0.0) + v
+                        view.available[k] = view.available.get(k, 0.0) + v
+                bundle.node_id = None
+            pg.state = "REMOVED"
+
+    def _solve_bundles(self, pg: PlacementGroupRecord) -> Optional[List[NodeID]]:
+        """Greedy bundle placement honoring PACK/SPREAD/STRICT_* semantics
+        (reference: policy/bundle_scheduling_policy.h:29,73,89)."""
+        avail = {nid: dict(v.available) for nid, v in self._nodes.items()}
+        nodes = list(avail.keys())
+        result: List[NodeID] = []
+
+        def take(nid: NodeID, res: Dict[str, float]) -> bool:
+            if not _fits(avail[nid], res):
+                return False
+            for k, v in res.items():
+                avail[nid][k] = avail[nid].get(k, 0.0) - v
+            return True
+
+        if pg.strategy == "STRICT_PACK":
+            for nid in nodes:
+                trial = {k: dict(v) for k, v in avail.items()}
+                ok = True
+                for b in pg.bundles:
+                    if not _fits(trial[nid], b.resources):
+                        ok = False
+                        break
+                    for k, v in b.resources.items():
+                        trial[nid][k] = trial[nid].get(k, 0.0) - v
+                if ok:
+                    return [nid] * len(pg.bundles)
+            return None
+        if pg.strategy == "STRICT_SPREAD":
+            used: set = set()
+            for b in pg.bundles:
+                placed = False
+                for nid in nodes:
+                    if nid in used:
+                        continue
+                    if take(nid, b.resources):
+                        result.append(nid)
+                        used.add(nid)
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            return result
+        # PACK (soft-pack) and SPREAD (soft-spread)
+        prefer_spread = pg.strategy == "SPREAD"
+        for b in pg.bundles:
+            order = sorted(
+                nodes,
+                key=lambda nid: (
+                    (result.count(nid) if prefer_spread else -result.count(nid)),
+                    nid.hex(),
+                ),
+            )
+            placed = False
+            for nid in order:
+                if take(nid, b.resources):
+                    result.append(nid)
+                    placed = True
+                    break
+            if not placed:
+                return None
+        return result
+
+    # --- autoscaler demand export --------------------------------------
+    def resource_demand(self, queued: List[TaskSpec]) -> List[Dict[str, float]]:
+        return [dict(t.resources) for t in queued]
+
+
+def _pg_resources(need: Dict[str, float], pg_id: PlacementGroupID,
+                  bundle_index: int) -> Dict[str, float]:
+    """Rewrite a resource request to target pg-scoped resources."""
+    pgid = pg_id.hex()
+    out = {}
+    for k, v in need.items():
+        if bundle_index >= 0:
+            out[f"{k}_group_{bundle_index}_{pgid}"] = v
+        else:
+            out[f"{k}_group_{pgid}"] = v
+    return out

@@ -1,0 +1,207 @@
+"""Owner-side task + object bookkeeping.
+
+Capability parity with the reference's ownership layer
+(reference: src/ray/core_worker/task_manager.h:175 — pending task table,
+retries, completion; reference_counter.h:43 — pinning objects while
+references exist; object location bookkeeping in
+ownership_object_directory.cc).
+
+Divergence from the reference: ownership is centralized in the head
+process rather than distributed per-worker. On a single TPU host (and a
+head-coordinated pod) this removes the distributed-GC protocol while
+keeping the same API semantics; the seam (`owner` field on TaskSpec)
+is where per-worker ownership would slot back in.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ray_tpu.core.ids import NodeID, ObjectID, TaskID
+from ray_tpu.core.task_spec import TaskSpec
+
+
+@dataclass
+class PendingTask:
+    spec: TaskSpec
+    retries_left: int
+    node_id: Optional[NodeID] = None
+    submitted_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class ObjectLocation:
+    kind: str                   # "memory" (head memory store) | "shm"
+    node_id: Optional[NodeID] = None
+
+
+class ReferenceCounter:
+    """Counts local references per object; fires a deleter at zero.
+
+    reference: src/ray/core_worker/reference_counter.h:43. Deletion is
+    deferred while the producing task is still pending (lineage keeps the
+    spec anyway, but the object may be produced after the last ref dies).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[ObjectID, int] = {}
+        self._deleter: Optional[Callable[[ObjectID], None]] = None
+
+    def set_deleter(self, fn: Callable[[ObjectID], None]) -> None:
+        self._deleter = fn
+
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        deleter = None
+        with self._lock:
+            count = self._counts.get(object_id)
+            if count is None:
+                return
+            if count <= 1:
+                del self._counts[object_id]
+                deleter = self._deleter
+            else:
+                self._counts[object_id] = count - 1
+        if deleter is not None:
+            try:
+                deleter(object_id)
+            except Exception:
+                pass
+
+    def count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(object_id, 0)
+
+    def tracked(self) -> int:
+        with self._lock:
+            return len(self._counts)
+
+
+class TaskManager:
+    """Tracks in-flight tasks, their return objects, and completion waiters."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pending: Dict[TaskID, PendingTask] = {}
+        self._object_to_task: Dict[ObjectID, TaskID] = {}
+        self._locations: Dict[ObjectID, ObjectLocation] = {}
+        self._object_ready: Dict[ObjectID, threading.Event] = {}
+        self._ready_callbacks: Dict[ObjectID, List[Callable[[], None]]] = {}
+        # Failed objects: get() raises the stored error.
+        self._errors: Dict[ObjectID, Exception] = {}
+        self.num_finished = 0
+        self.num_failed = 0
+
+    # --- pending tasks -------------------------------------------------
+    def add_pending(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._pending[spec.task_id] = PendingTask(spec, spec.max_retries)
+            for oid in spec.return_ids():
+                self._object_to_task[oid] = spec.task_id
+
+    def mark_dispatched(self, task_id: TaskID, node_id: NodeID) -> None:
+        with self._lock:
+            task = self._pending.get(task_id)
+            if task:
+                task.node_id = node_id
+
+    def get_pending(self, task_id: TaskID) -> Optional[PendingTask]:
+        with self._lock:
+            return self._pending.get(task_id)
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def consume_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
+        """Returns the spec to resubmit if retries remain, else None."""
+        with self._lock:
+            task = self._pending.get(task_id)
+            if task is None or task.retries_left <= 0:
+                return None
+            task.retries_left -= 1
+            return task.spec
+
+    # --- completion ----------------------------------------------------
+    def complete(self, task_id: TaskID) -> None:
+        with self._lock:
+            self._pending.pop(task_id, None)
+            self.num_finished += 1
+
+    def fail(self, task_id: TaskID, error: Exception) -> None:
+        with self._lock:
+            task = self._pending.pop(task_id, None)
+            self.num_failed += 1
+            if task is not None:
+                for oid in task.spec.return_ids():
+                    self._errors[oid] = error
+        if task is not None:
+            for oid in task.spec.return_ids():
+                self.mark_object_ready(oid)
+
+    # --- object readiness & location ----------------------------------
+    def set_location(self, object_id: ObjectID, location: ObjectLocation) -> None:
+        with self._lock:
+            self._locations[object_id] = location
+
+    def get_location(self, object_id: ObjectID) -> Optional[ObjectLocation]:
+        with self._lock:
+            return self._locations.get(object_id)
+
+    def get_error(self, object_id: ObjectID) -> Optional[Exception]:
+        with self._lock:
+            return self._errors.get(object_id)
+
+    def producing_task(self, object_id: ObjectID) -> Optional[TaskID]:
+        with self._lock:
+            return self._object_to_task.get(object_id)
+
+    def mark_object_ready(self, object_id: ObjectID) -> None:
+        with self._lock:
+            ev = self._object_ready.get(object_id)
+            callbacks = self._ready_callbacks.pop(object_id, [])
+            if ev is None:
+                ev = threading.Event()
+                self._object_ready[object_id] = ev
+        ev.set()
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def is_ready(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            ev = self._object_ready.get(object_id)
+            return ev is not None and ev.is_set()
+
+    def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
+        with self._lock:
+            ev = self._object_ready.setdefault(object_id, threading.Event())
+        return ev.wait(timeout)
+
+    def on_ready(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
+        """Invoke callback when object becomes ready (immediately if it is)."""
+        fire = False
+        with self._lock:
+            ev = self._object_ready.get(object_id)
+            if ev is not None and ev.is_set():
+                fire = True
+            else:
+                self._ready_callbacks.setdefault(object_id, []).append(callback)
+        if fire:
+            callback()
+
+    def forget_object(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._locations.pop(object_id, None)
+            self._object_ready.pop(object_id, None)
+            self._errors.pop(object_id, None)
+            self._object_to_task.pop(object_id, None)

@@ -1,0 +1,85 @@
+"""Task and actor specifications.
+
+Capability parity with the reference's TaskSpec protobuf
+(reference: src/ray/protobuf/common.proto TaskSpec; src/ray/common/lease/)
+— the unit handed from submitter to scheduler to worker. Arguments are
+either inline serialized values or ObjectRefs to be resolved before
+dispatch (reference: task_submission/dependency_resolver.h:35).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+
+
+@dataclass
+class Arg:
+    """One task argument: exactly one of value_bytes / object_id is set."""
+    value_bytes: Optional[bytes] = None  # serialization.pack'd inline value
+    object_id: Optional[ObjectID] = None
+
+
+@dataclass
+class SchedulingStrategy:
+    """Where a task/actor may run.
+
+    reference: python/ray/util/scheduling_strategies.py —
+    DEFAULT (hybrid pack/spread), SPREAD, node affinity, node labels,
+    placement-group bundles.
+    """
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | NODE_LABEL | PLACEMENT_GROUP
+    node_id: Optional[NodeID] = None
+    soft: bool = False
+    # label selector: {key: value} exact-match requirements
+    labels: Dict[str, str] = field(default_factory=dict)
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    function_id: str                    # key into the GCS function store
+    args: List[Arg]
+    kwargs: Dict[str, Arg] = field(default_factory=dict)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    name: str = ""
+    owner: str = "driver"               # routing key for completion delivery
+    # actor task fields
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    seq_no: int = 0                     # per-caller actor-task ordering
+    # actor creation fields
+    is_actor_creation: bool = False
+    max_restarts: int = 0
+    max_concurrency: int = 1
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def dependencies(self) -> List[ObjectID]:
+        deps = [a.object_id for a in self.args if a.object_id is not None]
+        deps += [a.object_id for a in self.kwargs.values() if a.object_id is not None]
+        return deps
+
+
+@dataclass
+class TaskEvent:
+    """Observability record for one task state transition
+    (reference: src/ray/core_worker/task_event_buffer.h:297)."""
+    task_id: TaskID
+    name: str
+    state: str    # PENDING | SCHEDULED | RUNNING | FINISHED | FAILED
+    timestamp: float = field(default_factory=time.time)
+    node_id: Optional[NodeID] = None
+    worker_id: Optional[WorkerID] = None
+    error: Optional[str] = None

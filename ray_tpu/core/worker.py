@@ -1,0 +1,344 @@
+"""Worker process: executes tasks and hosts actors.
+
+Capability parity with the reference's worker side
+(reference: python/ray/_private/workers/default_worker.py main loop →
+CoreWorkerProcess::RunTaskExecutionLoop, core_worker_process.cc:119;
+task execution via TaskReceiver, task_execution/task_receiver.h:44, with
+concurrency groups running on a thread pool,
+task_execution/concurrency_group_manager.h).
+
+One process per worker; connects to its node manager over a unix socket;
+executes plain tasks FIFO on a single thread (ordering guarantee) and
+actor tasks on a pool of ``max_concurrency`` threads. Inside task code
+the global runtime is a WorkerRuntime, so ``remote``/``get``/``put``
+compose (nested tasks, actor handles in args).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import queue
+import socket
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.protocol import MessageConnection
+from ray_tpu.core.task_manager import ReferenceCounter
+from ray_tpu.core.task_spec import Arg, TaskSpec
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError, TaskError
+
+
+class WorkerRuntime:
+    """The runtime visible to user code executing inside this worker."""
+
+    def __init__(self, conn: MessageConnection, store: SharedMemoryStore,
+                 node_id: NodeID, worker_id: WorkerID):
+        self.conn = conn
+        self.store = store
+        self.node_id = node_id
+        self.worker_id = worker_id
+        self.reference_counter = ReferenceCounter()  # no-op holder for refs
+        self.is_driver = False
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
+        self._replies: Dict[int, Tuple[threading.Event, list]] = {}
+        self._fn_cache: Dict[str, Any] = {}
+        self._put_counter = 0
+        self._current_task_id: threading.local = threading.local()
+        self.actor_instance = None
+        self.actor_id: Optional[ActorID] = None
+
+    # --- request/reply with the node manager ---------------------------
+    def _next_req(self) -> Tuple[int, threading.Event, list]:
+        with self._req_lock:
+            self._req_counter += 1
+            rid = self._req_counter
+            ev = threading.Event()
+            slot: list = [None]
+            self._replies[rid] = (ev, slot)
+        return rid, ev, slot
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        rid, ev, slot = self._next_req()
+        msg["req_id"] = rid
+        self.conn.send(msg)
+        if not ev.wait(timeout):
+            with self._req_lock:
+                self._replies.pop(rid, None)
+            raise GetTimeoutError(f"request {msg.get('kind')} timed out")
+        with self._req_lock:
+            self._replies.pop(rid, None)
+        return slot[0]
+
+    def deliver_reply(self, msg: dict) -> None:
+        rid = msg.get("req_id")
+        with self._req_lock:
+            entry = self._replies.get(rid)
+        if entry is not None:
+            ev, slot = entry
+            slot[0] = msg
+            ev.set()
+
+    # --- object plane ---------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        data, buffers = serialization.serialize(value)
+        return self.put_serialized(data, buffers)
+
+    def put_serialized(self, data: bytes, buffers) -> ObjectRef:
+        with self._req_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        task_id = getattr(self._current_task_id, "value", None) or TaskID.from_random()
+        oid = ObjectID.for_put(task_id, idx)
+        self.store.put_parts(oid, data, buffers, [b.nbytes for b in buffers])
+        self.conn.send({"kind": "PUT_META", "object_id": oid.binary()})
+        return ObjectRef(oid)
+
+    def put_result(self, oid: ObjectID, value: Any) -> Tuple[str, Any]:
+        """Store a task return; small values go inline in the reply."""
+        data, buffers = serialization.serialize(value)
+        from ray_tpu.core.config import get_config
+        if not buffers and len(data) < get_config().max_inline_object_size:
+            return ("inline", serialization.pack_parts(data, buffers))
+        sizes = [b.nbytes for b in buffers]
+        packed_len = serialization.packed_size(data, sizes)
+        dest = self.store.create(oid, packed_len)
+        try:
+            serialization.pack_into(dest, data, buffers, sizes)
+        finally:
+            del dest
+        self.store.seal(oid)
+        return ("shm", None)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = []
+        for ref in refs:
+            out.append(self._get_one(ref.id, timeout))
+        return out[0] if single else out
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float]):
+        found, value = self.store.get_value(oid, timeout_s=0.0)
+        if found:
+            return value
+        reply = self.request(
+            {"kind": "GET_OBJECT", "object_id": oid.binary()},
+            timeout=timeout if timeout is not None else None,
+        )
+        status = reply["status"]
+        if status == "inline":
+            return serialization.unpack(reply["data"])
+        if status == "shm_local":
+            found, value = self.store.get_value(oid, timeout_s=5.0)
+            if found:
+                return value
+            raise ObjectLostError(oid)
+        if status == "error":
+            raise serialization.loads(reply["error"])
+        raise ObjectLostError(oid)
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            ids = [r.id.binary() for r in pending]
+            reply = self.request({"kind": "CHECK_READY", "object_ids": ids},
+                                 timeout=30.0)
+            ready_set = set(reply["ready"])
+            newly = [r for r in pending if r.id.binary() in ready_set]
+            pending = [r for r in pending if r.id.binary() not in ready_set]
+            ready.extend(newly)
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            _time.sleep(0.005)
+        done = ready[:num_returns]
+        rest = ready[num_returns:] + pending
+        return done, rest
+
+    # --- task/actor submission (nested) ---------------------------------
+    def submit_spec(self, spec: TaskSpec) -> None:
+        self.conn.send({"kind": "SUBMIT", "spec": serialization.dumps(spec)})
+
+    def create_actor(self, spec: TaskSpec, name: Optional[str] = None) -> None:
+        self.submit_spec(spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self.conn.send({"kind": "KILL_ACTOR", "actor_id": actor_id.binary(),
+                        "no_restart": no_restart})
+
+    def cancel_task(self, object_id: ObjectID, force: bool = False) -> None:
+        self.conn.send({"kind": "CANCEL", "object_id": object_id.binary(),
+                        "force": force})
+
+    # --- control plane --------------------------------------------------
+    def gcs_call(self, method: str, *args) -> Any:
+        reply = self.request({"kind": "GCS_REQUEST", "method": method,
+                              "args": serialization.dumps(args)}, timeout=30.0)
+        if reply.get("error"):
+            raise serialization.loads(reply["error"])
+        return serialization.loads(reply["result"])
+
+    def get_function(self, function_id: str):
+        fn = self._fn_cache.get(function_id)
+        if fn is None:
+            blob = self.gcs_call("get_function", function_id)
+            if blob is None:
+                raise RuntimeError(f"function {function_id} not found in GCS")
+            fn = serialization.loads(blob)
+            self._fn_cache[function_id] = fn
+        return fn
+
+    def put_function(self, function_id: str, blob: bytes) -> None:
+        self.gcs_call("put_function", function_id, blob)
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    def node_labels(self) -> Dict[str, str]:
+        return self.gcs_call("node_labels", self.node_id.binary())
+
+    def as_future(self, ref: ObjectRef):
+        from concurrent.futures import Future
+        fut: Future = Future()
+        def run():
+            try:
+                fut.set_result(self.get(ref))
+            except Exception as e:
+                fut.set_exception(e)
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+
+def _resolve_arg(rt: WorkerRuntime, arg: Arg) -> Any:
+    if arg.value_bytes is not None:
+        return serialization.unpack(arg.value_bytes)
+    return rt._get_one(arg.object_id, timeout=None)
+
+
+def _resolve_args(rt: WorkerRuntime, spec: TaskSpec):
+    args = [_resolve_arg(rt, a) for a in spec.args]
+    kwargs = {k: _resolve_arg(rt, a) for k, a in spec.kwargs.items()}
+    return args, kwargs
+
+
+def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
+    """Run one task/actor-task; returns the TASK_DONE message."""
+    rt._current_task_id.value = spec.task_id
+    reply: dict = {"kind": "TASK_DONE", "task_id": spec.task_id.binary(),
+                   "spec_is_actor_creation": spec.is_actor_creation}
+    try:
+        args, kwargs = _resolve_args(rt, spec)
+        if spec.is_actor_creation:
+            cls = rt.get_function(spec.function_id)
+            rt.actor_instance = cls(*args, **kwargs)
+            rt.actor_id = spec.actor_id
+            result_values = [None]
+        elif spec.actor_id is not None:
+            method = getattr(rt.actor_instance, spec.method_name)
+            result = method(*args, **kwargs)
+            result_values = _split_returns(result, spec.num_returns)
+        else:
+            fn = rt.get_function(spec.function_id)
+            result = fn(*args, **kwargs)
+            result_values = _split_returns(result, spec.num_returns)
+        results = []
+        for oid, value in zip(spec.return_ids(), result_values):
+            kind, data = rt.put_result(oid, value)
+            results.append((oid.binary(), kind, data))
+        reply["results"] = results
+        reply["error"] = None
+    except Exception as e:  # noqa: BLE001 — user code may raise anything
+        tb = traceback.format_exc()
+        err = TaskError(spec.name or spec.function_id, tb, None)
+        reply["results"] = []
+        reply["error"] = serialization.dumps(err)
+        reply["error_str"] = tb
+    finally:
+        rt._current_task_id.value = None
+    return reply
+
+
+def _split_returns(result: Any, num_returns: int) -> List[Any]:
+    if num_returns == 1:
+        return [result]
+    result = list(result)
+    if len(result) != num_returns:
+        raise ValueError(
+            f"task declared num_returns={num_returns} but returned "
+            f"{len(result)} values")
+    return result
+
+
+def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
+                store_name: str) -> None:
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps stacks
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(socket_path)
+    conn = MessageConnection(sock)
+    store = SharedMemoryStore(store_name)
+    node_id = NodeID.from_hex(node_id_hex)
+    worker_id = WorkerID.from_hex(worker_id_hex)
+    rt = WorkerRuntime(conn, store, node_id, worker_id)
+
+    from ray_tpu.core import runtime as runtime_mod
+    runtime_mod.set_runtime(rt)
+
+    conn.send({"kind": "REGISTER", "worker_id": worker_id.binary(),
+               "pid": os.getpid()})
+
+    exec_pool = ThreadPoolExecutor(max_workers=1)
+    pool_lock = threading.Lock()
+
+    def run_task(spec: TaskSpec):
+        reply = _execute(rt, spec)
+        conn.send(reply)
+
+    while True:
+        msg = conn.recv()
+        if msg is None:
+            break
+        kind = msg["kind"]
+        if kind in ("EXECUTE", "CREATE_ACTOR", "EXECUTE_ACTOR_TASK"):
+            spec: TaskSpec = serialization.loads(msg["spec"])
+            if spec.is_actor_creation and spec.max_concurrency > 1:
+                with pool_lock:
+                    exec_pool = ThreadPoolExecutor(max_workers=spec.max_concurrency)
+            exec_pool.submit(run_task, spec)
+        elif kind in ("OBJECT_VALUE", "GCS_REPLY", "READY_REPLY"):
+            rt.deliver_reply(msg)
+        elif kind == "SHUTDOWN":
+            break
+        elif kind == "KILL":
+            os._exit(1)
+    os._exit(0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--socket", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--store-name", required=True)
+    args = parser.parse_args()
+    worker_main(args.socket, args.node_id, args.worker_id, args.store_name)
+
+
+if __name__ == "__main__":
+    main()

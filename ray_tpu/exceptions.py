@@ -1,0 +1,92 @@
+"""User-facing exception hierarchy.
+
+Capability parity with the reference's exceptions
+(reference: python/ray/exceptions.py): task errors wrap the remote
+traceback, actor errors carry restart context, object loss names the
+object, and all of them are serializable across process boundaries.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception; raised from ``get()``.
+
+    Carries the remote traceback text so the driver sees the real failure
+    site (reference: python/ray/exceptions.py RayTaskError).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        return (TaskError, (self.function_name, self.traceback_str, None))
+
+
+class ActorError(RayTpuError):
+    """An actor task cannot complete because the actor is dead or dying."""
+
+    def __init__(self, actor_id=None, message: str = "actor died"):
+        self.actor_id = actor_id
+        self.message = message
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (type(self), (self.actor_id, self.message))
+
+
+class ActorDiedError(ActorError):
+    pass
+
+
+class ActorUnavailableError(ActorError):
+    """Actor temporarily unreachable (restarting); call may be retried."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ObjectLostError(RayTpuError):
+    def __init__(self, object_id, message: str | None = None):
+        self.object_id = object_id
+        super().__init__(message or f"object {object_id} was lost and could not be reconstructed")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_id, None))
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(f"task {task_id} was cancelled")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id,))
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class PlacementGroupUnschedulableError(RayTpuError):
+    """No feasible node assignment exists for the requested bundles."""

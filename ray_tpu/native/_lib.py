@@ -1,0 +1,56 @@
+"""ctypes bindings for the native library."""
+
+from __future__ import annotations
+
+import ctypes
+
+from ray_tpu.native.build import ensure_built
+
+_lib = None
+
+
+def load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(ensure_built())
+        u64 = ctypes.c_uint64
+        i64 = ctypes.c_int64
+        p = ctypes.c_void_p
+        idp = ctypes.c_char_p
+        lib.shm_required_overhead.restype = i64
+        lib.shm_required_overhead.argtypes = [u64]
+        lib.shm_init.restype = i64
+        lib.shm_init.argtypes = [p, u64, u64]
+        lib.shm_attach.restype = i64
+        lib.shm_attach.argtypes = [p]
+        lib.shm_create.restype = i64
+        lib.shm_create.argtypes = [p, idp, u64, ctypes.POINTER(u64)]
+        lib.shm_seal.restype = i64
+        lib.shm_seal.argtypes = [p, idp]
+        lib.shm_get.restype = i64
+        lib.shm_get.argtypes = [p, idp, ctypes.c_double, ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        lib.shm_contains.restype = i64
+        lib.shm_contains.argtypes = [p, idp]
+        lib.shm_release.restype = i64
+        lib.shm_release.argtypes = [p, idp]
+        lib.shm_delete.restype = i64
+        lib.shm_delete.argtypes = [p, idp]
+        lib.shm_evict.restype = i64
+        lib.shm_evict.argtypes = [p, u64]
+        lib.shm_used_bytes.restype = i64
+        lib.shm_used_bytes.argtypes = [p]
+        lib.shm_num_objects.restype = i64
+        lib.shm_num_objects.argtypes = [p]
+        lib.shm_total_bytes.restype = i64
+        lib.shm_total_bytes.argtypes = [p]
+        _lib = lib
+    return _lib
+
+
+OK = 0
+NOT_FOUND = -1
+EXISTS = -2
+FULL = -3
+TIMEOUT = -4
+CORRUPT = -5
+BAD_STATE = -6

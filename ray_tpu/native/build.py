@@ -1,0 +1,44 @@
+"""Build the native library on demand.
+
+The .so is compiled once per machine into ray_tpu/native/_build/ and
+reused; rebuilt automatically when any source file is newer than the
+binary. Keeps the repo pip-install-free (no pybind11; plain ctypes ABI).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(_DIR, "src")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libray_tpu_native.so")
+_lock = threading.Lock()
+
+
+def _sources():
+    return sorted(
+        os.path.join(_SRC_DIR, f)
+        for f in os.listdir(_SRC_DIR)
+        if f.endswith(".cc")
+    )
+
+
+def ensure_built() -> str:
+    with _lock:
+        srcs = _sources()
+        if os.path.exists(_LIB_PATH):
+            lib_mtime = os.path.getmtime(_LIB_PATH)
+            if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
+                return _LIB_PATH
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = [
+            "g++", "-O2", "-g", "-fPIC", "-shared", "-std=c++17",
+            "-Wall", "-pthread",
+            "-o", _LIB_PATH + ".tmp", *srcs,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+        return _LIB_PATH

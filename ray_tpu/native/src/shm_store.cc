@@ -1,0 +1,378 @@
+// Node-local shared-memory object store.
+//
+// Capability parity with the reference's plasma store
+// (reference: src/ray/object_manager/plasma/store.h:55,
+// plasma_allocator.cc, obj_lifecycle_mgr.cc): create/seal/get/release/
+// delete of immutable binary objects in a shared-memory arena mapped by
+// every worker process on the node, with blocking get (waits for seal),
+// reference counts pinning objects, and LRU eviction of unreferenced
+// sealed objects under memory pressure (reference: eviction_policy.cc).
+//
+// Unlike plasma there is no store daemon or unix-socket protocol: the
+// arena itself carries a process-shared robust mutex + condvar, and every
+// process operates on the shared state directly through this library.
+// That removes a context switch + fd-passing round trip from the object
+// hot path (reference: protocol.cc, fling.cc) — on a TPU host the store
+// is purely a staging area between Python workers, the data loader, and
+// device transfer, so the daemonless design is both simpler and faster.
+//
+// Layout (all offsets relative to arena base; data 64-byte aligned):
+//   [Header | ObjectEntry x max_objects | data region (blocks)]
+
+#include <errno.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <time.h>
+
+#include <cstdio>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x7470755f73746f72ULL;  // "tpu_stor"
+constexpr uint64_t kAlign = 64;
+constexpr uint64_t kBlockHeader = 64;  // keeps payloads 64B-aligned
+
+enum State : uint8_t {
+  kEmpty = 0,
+  kCreated = 1,
+  kSealed = 2,
+};
+
+enum Err : int64_t {
+  kOk = 0,
+  kNotFound = -1,
+  kExists = -2,
+  kFull = -3,
+  kTimeout = -4,
+  kCorrupt = -5,
+  kBadState = -6,
+};
+
+struct ObjectEntry {
+  uint8_t id[16];
+  uint8_t state;
+  uint8_t pad[7];
+  uint64_t offset;  // payload offset from arena base
+  uint64_t size;    // payload size
+  int64_t refcount;
+  uint64_t lru;
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t total_size;
+  uint64_t max_objects;
+  uint64_t entries_offset;
+  uint64_t data_offset;
+  uint64_t free_head;  // offset of first free block, 0 = none
+  uint64_t lru_tick;
+  uint64_t used_bytes;
+  uint64_t num_objects;
+  pthread_mutex_t mutex;
+  pthread_cond_t cond;
+};
+
+// A block in the data region. When free, `next` links the sorted-by-offset
+// free list; when allocated, the payload starts at offset + kBlockHeader.
+struct Block {
+  uint64_t size;  // total block size including header
+  uint64_t next;  // next free block offset (0 = end)
+};
+
+inline Header* H(void* base) { return reinterpret_cast<Header*>(base); }
+inline Block* B(void* base, uint64_t off) {
+  return reinterpret_cast<Block*>(static_cast<char*>(base) + off);
+}
+inline ObjectEntry* entries(void* base) {
+  return reinterpret_cast<ObjectEntry*>(static_cast<char*>(base) +
+                                        H(base)->entries_offset);
+}
+
+inline uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+// Robust lock: recover consistency if a holder died mid-critical-section.
+int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    rc = 0;
+  }
+  return rc;
+}
+
+ObjectEntry* find(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  ObjectEntry* es = entries(base);
+  for (uint64_t i = 0; i < h->max_objects; ++i) {
+    if (es[i].state != kEmpty && memcmp(es[i].id, id, 16) == 0) return &es[i];
+  }
+  return nullptr;
+}
+
+ObjectEntry* find_slot(void* base) {
+  Header* h = H(base);
+  ObjectEntry* es = entries(base);
+  for (uint64_t i = 0; i < h->max_objects; ++i) {
+    if (es[i].state == kEmpty) return &es[i];
+  }
+  return nullptr;
+}
+
+// First-fit allocation from the sorted free list; splits blocks.
+uint64_t alloc_block(void* base, uint64_t payload) {
+  Header* h = H(base);
+  uint64_t need = align_up(payload) + kBlockHeader;
+  uint64_t prev = 0;
+  uint64_t cur = h->free_head;
+  while (cur) {
+    Block* b = B(base, cur);
+    if (b->size >= need) {
+      uint64_t remainder = b->size - need;
+      if (remainder >= kBlockHeader + kAlign) {
+        // Split: tail remains free.
+        uint64_t tail = cur + need;
+        Block* t = B(base, tail);
+        t->size = remainder;
+        t->next = b->next;
+        b->size = need;
+        if (prev) B(base, prev)->next = tail; else h->free_head = tail;
+      } else {
+        if (prev) B(base, prev)->next = b->next; else h->free_head = b->next;
+      }
+      h->used_bytes += b->size;
+      return cur;
+    }
+    prev = cur;
+    cur = b->next;
+  }
+  return 0;
+}
+
+// Free with coalescing of adjacent blocks (free list kept sorted by offset).
+void free_block(void* base, uint64_t off) {
+  Header* h = H(base);
+  Block* b = B(base, off);
+  h->used_bytes -= b->size;
+  uint64_t prev = 0, cur = h->free_head;
+  while (cur && cur < off) {
+    prev = cur;
+    cur = B(base, cur)->next;
+  }
+  b->next = cur;
+  if (prev) B(base, prev)->next = off; else h->free_head = off;
+  // Coalesce with next.
+  if (cur && off + b->size == cur) {
+    b->size += B(base, cur)->size;
+    b->next = B(base, cur)->next;
+  }
+  // Coalesce with prev.
+  if (prev && prev + B(base, prev)->size == off) {
+    Block* p = B(base, prev);
+    p->size += b->size;
+    p->next = b->next;
+  }
+}
+
+// Evict sealed, unreferenced objects in LRU order until `bytes` are free
+// or nothing evictable remains. Returns bytes freed. Caller holds lock.
+uint64_t evict_locked(void* base, uint64_t bytes) {
+  Header* h = H(base);
+  ObjectEntry* es = entries(base);
+  uint64_t freed = 0;
+  while (freed < bytes) {
+    ObjectEntry* victim = nullptr;
+    for (uint64_t i = 0; i < h->max_objects; ++i) {
+      ObjectEntry* e = &es[i];
+      if (e->state == kSealed && e->refcount == 0 &&
+          (!victim || e->lru < victim->lru)) {
+        victim = e;
+      }
+    }
+    if (!victim) break;
+    freed += align_up(victim->size) + kBlockHeader;
+    free_block(base, victim->offset - kBlockHeader);
+    victim->state = kEmpty;
+    h->num_objects--;
+  }
+  return freed;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t shm_required_overhead(uint64_t max_objects) {
+  return align_up(sizeof(Header)) + align_up(max_objects * sizeof(ObjectEntry));
+}
+
+int64_t shm_init(void* base, uint64_t total_size, uint64_t max_objects) {
+  memset(base, 0, shm_required_overhead(max_objects));
+  Header* h = H(base);
+  h->total_size = total_size;
+  h->max_objects = max_objects;
+  h->entries_offset = align_up(sizeof(Header));
+  h->data_offset = align_up(h->entries_offset + max_objects * sizeof(ObjectEntry));
+  if (h->data_offset + kBlockHeader + kAlign > total_size) return kFull;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_condattr_setclock(&ca, CLOCK_MONOTONIC);
+  pthread_cond_init(&h->cond, &ca);
+
+  Block* first = B(base, h->data_offset);
+  first->size = total_size - h->data_offset;
+  first->next = 0;
+  h->free_head = h->data_offset;
+  h->magic = kMagic;
+  return kOk;
+}
+
+int64_t shm_attach(void* base) {
+  return H(base)->magic == kMagic ? kOk : kCorrupt;
+}
+
+// Create an unsealed object and return the payload offset; the caller
+// writes the payload then calls shm_seal. Evicts LRU objects if needed.
+int64_t shm_create(void* base, const uint8_t* id, uint64_t size,
+                   uint64_t* offset_out) {
+  Header* h = H(base);
+  lock(h);
+  if (find(base, id)) {
+    pthread_mutex_unlock(&h->mutex);
+    return kExists;
+  }
+  ObjectEntry* slot = find_slot(base);
+  if (!slot) {
+    pthread_mutex_unlock(&h->mutex);
+    return kFull;
+  }
+  uint64_t block = alloc_block(base, size);
+  if (!block) {
+    evict_locked(base, align_up(size) + kBlockHeader);
+    block = alloc_block(base, size);
+  }
+  if (!block) {
+    pthread_mutex_unlock(&h->mutex);
+    return kFull;
+  }
+  memcpy(slot->id, id, 16);
+  slot->state = kCreated;
+  slot->offset = block + kBlockHeader;
+  slot->size = size;
+  slot->refcount = 1;  // creator holds a reference until seal+release
+  slot->lru = ++h->lru_tick;
+  h->num_objects++;
+  *offset_out = slot->offset;
+  pthread_mutex_unlock(&h->mutex);
+  return kOk;
+}
+
+int64_t shm_seal(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  ObjectEntry* e = find(base, id);
+  if (!e) { pthread_mutex_unlock(&h->mutex); return kNotFound; }
+  if (e->state != kCreated) { pthread_mutex_unlock(&h->mutex); return kBadState; }
+  e->state = kSealed;
+  e->refcount--;  // drop creator reference
+  pthread_cond_broadcast(&h->cond);
+  pthread_mutex_unlock(&h->mutex);
+  return kOk;
+}
+
+// Blocking get: waits until the object is sealed (or timeout), pins it
+// with a reference, and returns its payload offset + size.
+int64_t shm_get(void* base, const uint8_t* id, double timeout_s,
+                uint64_t* offset_out, uint64_t* size_out) {
+  Header* h = H(base);
+  struct timespec deadline;
+  clock_gettime(CLOCK_MONOTONIC, &deadline);
+  deadline.tv_sec += (time_t)timeout_s;
+  deadline.tv_nsec += (long)((timeout_s - (time_t)timeout_s) * 1e9);
+  if (deadline.tv_nsec >= 1000000000L) {
+    deadline.tv_sec += 1;
+    deadline.tv_nsec -= 1000000000L;
+  }
+  lock(h);
+  for (;;) {
+    ObjectEntry* e = find(base, id);
+    if (e && e->state == kSealed) {
+      e->refcount++;
+      e->lru = ++h->lru_tick;
+      *offset_out = e->offset;
+      *size_out = e->size;
+      pthread_mutex_unlock(&h->mutex);
+      return kOk;
+    }
+    if (timeout_s <= 0) {
+      pthread_mutex_unlock(&h->mutex);
+      return e ? kBadState : kNotFound;
+    }
+    int rc = pthread_cond_timedwait(&h->cond, &h->mutex, &deadline);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mutex);
+      return kTimeout;
+    }
+  }
+}
+
+int64_t shm_contains(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  ObjectEntry* e = find(base, id);
+  int64_t r = (e && e->state == kSealed) ? 1 : 0;
+  pthread_mutex_unlock(&h->mutex);
+  return r;
+}
+
+int64_t shm_release(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  ObjectEntry* e = find(base, id);
+  if (!e) { pthread_mutex_unlock(&h->mutex); return kNotFound; }
+  if (e->refcount > 0) e->refcount--;
+  pthread_mutex_unlock(&h->mutex);
+  return kOk;
+}
+
+// Delete an object outright (distributed refcount hit zero). If still
+// pinned by readers, it is marked unreferenced and left to eviction.
+int64_t shm_delete(void* base, const uint8_t* id) {
+  Header* h = H(base);
+  lock(h);
+  ObjectEntry* e = find(base, id);
+  if (!e) { pthread_mutex_unlock(&h->mutex); return kNotFound; }
+  if (e->refcount <= 0) {
+    free_block(base, e->offset - kBlockHeader);
+    e->state = kEmpty;
+    h->num_objects--;
+  } else {
+    // Readers hold pins; make it evictable as soon as they release.
+    e->lru = 0;
+  }
+  pthread_mutex_unlock(&h->mutex);
+  return kOk;
+}
+
+int64_t shm_evict(void* base, uint64_t bytes) {
+  Header* h = H(base);
+  lock(h);
+  uint64_t freed = evict_locked(base, bytes);
+  pthread_mutex_unlock(&h->mutex);
+  return (int64_t)freed;
+}
+
+int64_t shm_used_bytes(void* base) { return (int64_t)H(base)->used_bytes; }
+int64_t shm_num_objects(void* base) { return (int64_t)H(base)->num_objects; }
+int64_t shm_total_bytes(void* base) {
+  return (int64_t)(H(base)->total_size - H(base)->data_offset);
+}
+
+}  // extern "C"

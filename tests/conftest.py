@@ -1,0 +1,56 @@
+"""Test fixtures.
+
+Mirrors the reference's test infrastructure (reference:
+python/ray/tests/conftest.py:590 ray_start_regular; :680 ray_start_cluster)
+and forces JAX onto a virtual 8-device CPU mesh so every sharding test
+runs without TPU hardware (SURVEY.md §7 "Testing without TPUs").
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """A fresh single-node runtime per test."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=4, system_config={"task_max_retries": 0})
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """One runtime shared by a whole test module (faster)."""
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """A multi-node simulated cluster; tests add nodes declaratively."""
+    from ray_tpu.core.cluster_utils import Cluster
+    cluster = Cluster(head_node_args={"resources": {"CPU": 2}})
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    """An 8-device CPU mesh for sharding tests."""
+    import jax
+    devices = jax.devices("cpu")
+    assert len(devices) >= 8, (
+        "conftest must set xla_force_host_platform_device_count=8 before "
+        "jax import")
+    yield devices[:8]

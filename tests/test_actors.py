@@ -1,0 +1,176 @@
+"""Actor API tests (reference model: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, ActorError, TaskError
+
+
+def test_basic_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get([c.inc.remote() for _ in range(3)]) == [1, 2, 3]
+
+
+def test_actor_constructor_args(ray_start_regular):
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, base, scale=1):
+            self.base = base
+            self.scale = scale
+
+        def apply(self, x):
+            return (self.base + x) * self.scale
+
+    a = Adder.remote(10, scale=2)
+    assert ray_tpu.get(a.apply.remote(5)) == 30
+
+
+def test_actor_method_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Log:
+        def __init__(self):
+            self.items = []
+
+        def append(self, x):
+            self.items.append(x)
+
+        def get(self):
+            return list(self.items)
+
+    log = Log.remote()
+    for i in range(20):
+        log.append.remote(i)
+    assert ray_tpu.get(log.get.remote()) == list(range(20))
+
+
+def test_actor_handle_passing(ray_start_regular):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(store, value):
+        ray_tpu.get(store.set.remote(value))
+        return True
+
+    s = Store.remote()
+    assert ray_tpu.get(writer.remote(s, "hello"))
+    assert ray_tpu.get(s.get.remote()) == "hello"
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="the_registry").remote()
+    handle = ray_tpu.get_actor("the_registry")
+    assert ray_tpu.get(handle.ping.remote()) == "pong"
+
+
+def test_actor_error_in_method(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise ValueError("method error")
+
+        def ok(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(TaskError, match="method error"):
+        ray_tpu.get(b.fail.remote())
+    # Actor survives a method exception.
+    assert ray_tpu.get(b.ok.remote()) == 1
+
+
+def test_actor_constructor_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("cannot construct")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises((ActorError, TaskError)):
+        ray_tpu.get(b.m.remote(), timeout=10)
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.5)
+    with pytest.raises((ActorError, TaskError)):
+        ray_tpu.get(v.ping.remote(), timeout=10)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.count = 0
+
+        def suicide(self):
+            import os
+            os._exit(1)
+
+        def ping(self):
+            self.count += 1
+            return self.count
+
+    p = Phoenix.remote()
+    assert ray_tpu.get(p.ping.remote()) == 1
+    p.suicide.remote()
+    time.sleep(1.0)
+    # After restart, state is fresh (restart re-runs the constructor).
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            assert ray_tpu.get(p.ping.remote(), timeout=10) == 1
+            break
+        except (ActorError, TaskError):
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Parallel:
+        def block(self, t):
+            time.sleep(t)
+            return t
+
+    p = Parallel.remote()
+    t0 = time.time()
+    ray_tpu.get([p.block.remote(0.5) for _ in range(4)])
+    elapsed = time.time() - t0
+    # 4 concurrent 0.5s sleeps should take ~0.5s, not 2s.
+    assert elapsed < 1.6

@@ -1,0 +1,159 @@
+"""Multi-node simulation, scheduling policies, placement groups, chaos.
+
+Reference models: python/ray/tests/test_scheduling.py,
+test_placement_group.py, test_chaos.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.exceptions import (
+    PlacementGroupUnschedulableError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.util.placement_group import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+def test_custom_resources_route_tasks(ray_start_cluster):
+    cluster = ray_start_cluster
+    tpu_node = cluster.add_node(num_cpus=2, resources={"TPU": 4},
+                                labels={"tpu-pod-type": "v5p-8"})
+
+    @ray_tpu.remote(num_tpus=1)
+    def where():
+        import ray_tpu as rt
+        return rt.get_runtime_context().get_node_id()
+
+    node_id = ray_tpu.get(where.remote())
+    assert node_id == tpu_node.hex()
+
+
+def test_node_label_strategy(ray_start_cluster):
+    cluster = ray_start_cluster
+    labeled = cluster.add_node(num_cpus=2, labels={"zone": "us-central2-b"})
+    cluster.add_node(num_cpus=2, labels={"zone": "us-east1-d"})
+
+    @ray_tpu.remote(scheduling_strategy=SchedulingStrategy(
+        kind="NODE_LABEL", labels={"zone": "us-central2-b"}))
+    def where():
+        import ray_tpu as rt
+        return rt.get_runtime_context().get_node_id()
+
+    assert ray_tpu.get(where.remote()) == labeled.hex()
+
+
+def test_spread_strategy(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def where():
+        import time
+        import ray_tpu as rt
+        time.sleep(0.2)
+        return rt.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(8)]))
+    assert len(nodes) >= 2
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    node_ids = [cluster.add_node(num_cpus=1, resources={"TPU": 4})
+                for _ in range(4)]
+    pg = placement_group([{"TPU": 4}] * 4, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=5)
+    placed = set(n.hex() for n in pg.bundle_node_ids())
+    assert placed == {n.hex() for n in node_ids}
+    remove_placement_group(pg)
+
+
+def test_placement_group_infeasible(ray_start_cluster):
+    with pytest.raises(PlacementGroupUnschedulableError):
+        placement_group([{"TPU": 128}], strategy="STRICT_PACK")
+
+
+def test_placement_group_task_targeting(ray_start_cluster):
+    cluster = ray_start_cluster
+    tpu_node = cluster.add_node(num_cpus=4, resources={"TPU": 4})
+    pg = placement_group([{"TPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=5)
+
+    @ray_tpu.remote(num_cpus=0, num_tpus=1,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=0))
+    def where():
+        import ray_tpu as rt
+        return rt.get_runtime_context().get_node_id()
+
+    assert ray_tpu.get(where.remote()) == tpu_node.hex()
+
+
+def test_worker_crash_retry(ray_start_regular):
+    @ray_tpu.remote(max_retries=2)
+    def die_once(key):
+        import os
+        from ray_tpu.core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime()
+        n = int(rt.gcs_call("kv_get", key.encode(), "") or 0) + 1
+        rt.gcs_call("kv_put", key.encode(), str(n).encode(), "")
+        if n == 1:
+            os._exit(1)  # simulate hard crash
+        return n
+
+    assert ray_tpu.get(die_once.remote("crash_count"), timeout=60) == 2
+
+
+def test_worker_crash_no_retries_fails(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        import os
+        os._exit(1)
+
+    with pytest.raises(WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_node_removal_chaos(ray_start_cluster):
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"DOOMED": 1})
+
+    @ray_tpu.remote(resources={"DOOMED": 0.1}, max_retries=0)
+    def trapped():
+        import time
+        time.sleep(30)
+        return 1
+
+    ref = trapped.remote()
+    time.sleep(0.8)  # let it get scheduled onto the doomed node
+    cluster.remove_node(doomed)
+    with pytest.raises((WorkerCrashedError, TaskError)):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_object_transfer_between_nodes(ray_start_cluster):
+    """An object produced on node A is readable by a task on node B
+    (simulated inter-node transfer path)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"A": 1})
+    cluster.add_node(num_cpus=2, resources={"B": 1})
+    import numpy as np
+
+    @ray_tpu.remote(resources={"A": 0.1})
+    def produce():
+        return np.ones(200_000, dtype=np.float32)
+
+    @ray_tpu.remote(resources={"B": 0.1})
+    def consume(arr):
+        return float(arr.sum())
+
+    assert ray_tpu.get(consume.remote(produce.remote()), timeout=60) == 200_000.0

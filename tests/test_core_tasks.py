@@ -1,0 +1,173 @@
+"""Task API tests (reference model: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    refs = [square.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_dependency_chain(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(5):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 6
+
+
+def test_nested_task_submission(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_large_array_roundtrip(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return np.arange(300_000, dtype=np.float32)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    ref = make.remote()
+    arr = ray_tpu.get(ref)
+    assert arr.shape == (300_000,)
+    assert ray_tpu.get(total.remote(ref)) == pytest.approx(arr.sum())
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("first failure")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    # The dependent task fails because its dependency errored.
+    with pytest.raises(TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(3)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    done, rest = ray_tpu.wait([f, s], num_returns=1, timeout=2.0)
+    assert done == [f]
+    assert rest == [s]
+
+
+def test_retry_exceptions(ray_start_regular):
+    @ray_tpu.remote(retry_exceptions=True, max_retries=5)
+    def flaky(key):
+        # Use the KV store to count attempts across retries.
+        rt = __import__("ray_tpu.core.runtime", fromlist=["runtime"]).get_runtime()
+        n = rt.gcs_call("kv_get", key.encode(), "")
+        n = int(n or 0) + 1
+        rt.gcs_call("kv_put", key.encode(), str(n).encode(), "")
+        if n < 3:
+            raise RuntimeError(f"attempt {n} fails")
+        return n
+
+    assert ray_tpu.get(flaky.remote("flaky_counter")) == 3
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    obj = {"a": [1, 2, 3], "b": "text", "c": np.ones(10)}
+    ref = ray_tpu.put(obj)
+    out = ray_tpu.get(ref)
+    assert out["a"] == [1, 2, 3]
+    assert out["b"] == "text"
+    np.testing.assert_array_equal(out["c"], np.ones(10))
+
+
+def test_object_ref_in_collection_passthrough(ray_start_regular):
+    # Refs nested in containers are passed through (not auto-resolved),
+    # matching the reference's semantics.
+    @ray_tpu.remote
+    def identity(x):
+        return x
+
+    inner_ref = ray_tpu.put(42)
+    out = ray_tpu.get(identity.remote([inner_ref]))
+    assert isinstance(out[0], ray_tpu.ObjectRef)
+    assert ray_tpu.get(out[0]) == 42
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0
