@@ -1,0 +1,107 @@
+"""Headline benchmark: Llama train-step throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+
+vs_baseline = achieved MFU / 0.35 (BASELINE.json north star: Llama-2-7B
+fine-tune at >=35% MFU; on the single-chip CI device we run the largest
+Llama-architecture model that trains comfortably in HBM and report MFU
+against the same bar).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+# Peak bf16 FLOP/s per chip by TPU generation (public numbers).
+PEAK_FLOPS = {
+    "v5 lite": 394e12 / 2,   # v5e: 197 bf16 TFLOP/s
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6": 918e12,
+    "cpu": 1e12,  # nominal, keeps the script runnable off-TPU
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def main():
+    devices = jax.devices()
+    dev = devices[0]
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+
+    if on_tpu:
+        # ~440M-param Llama: big enough that the MXU dominates, small
+        # enough for one 16 GB chip with fp32 Adam moments.
+        cfg = LlamaConfig(
+            vocab_size=32000, dim=1536, n_layers=12, n_heads=12,
+            n_kv_heads=12, hidden_dim=4096, max_seq_len=2048,
+            dtype=jnp.bfloat16, attention="flash", remat=True)
+        batch, seq, steps = 16, 2048, 5
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 4, 64, 3
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
+                                 cfg.vocab_size)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: llama_loss(p, tokens, targets, cfg))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # Compile + warmup. NOTE: float(loss) is the sync barrier — it
+    # transfers the scalar, which forces the full dependency chain
+    # (block_until_ready alone does not flush on the axon tunnel).
+    params, opt_state, loss = train_step(params, opt_state, tokens, targets)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens,
+                                             targets)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    n_chips = len(devices)
+    tokens_per_sec_per_chip = tokens_per_sec / n_chips
+    flops_per_token = cfg.flops_per_token()
+    mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "mfu": round(mfu, 4),
+        "model_params": cfg.num_params(),
+        "batch": batch, "seq": seq,
+        "device": str(getattr(dev, "device_kind", dev)),
+        "final_loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

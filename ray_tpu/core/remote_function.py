@@ -52,7 +52,9 @@ def value_to_arg(value: Any, runtime) -> Arg:
     (reference: task_submission/dependency_resolver.h:35 inlining rules).
     """
     if isinstance(value, ObjectRef):
-        return Arg(object_id=value.id)
+        arg = Arg(object_id=value.id)
+        arg._keepalive = value  # pin: the spec holds the ref until done
+        return arg
     data, buffers = serialization.serialize(value)
     if not buffers and len(data) <= get_config().max_inline_object_size:
         return Arg(value_bytes=serialization.pack_parts(data, buffers))

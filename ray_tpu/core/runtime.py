@@ -63,6 +63,9 @@ class ActorInfo:
         self.worker_id: Optional[WorkerID] = None
         self.buffered: deque = deque()
         self.lock = threading.Lock()
+        # True only after creation completed AND the buffer was flushed —
+        # direct dispatch before that would overtake buffered tasks.
+        self.ready_for_dispatch = False
         # Node whose resources the creation task acquired; released exactly
         # once per incarnation at actor death.
         self.resources_node: Optional[NodeID] = None
@@ -263,7 +266,7 @@ class DriverRuntime:
                     ActorDiedError(spec.actor_id,
                                    f"actor is dead: {record.death_cause}"))
                 return
-            if record.state != "ALIVE" or info.worker_id is None:
+            if not info.ready_for_dispatch or info.worker_id is None:
                 info.buffered.append(spec)
                 return
             node = self.nodes.get(info.node_id)
@@ -273,14 +276,26 @@ class DriverRuntime:
                 info.buffered.append(spec)
 
     def _flush_actor_buffer(self, actor_id: ActorID) -> None:
+        """Drain buffered tasks in order, then open direct dispatch.
+        New submissions keep landing in the buffer until the flush
+        completes, preserving submission order."""
         info = self.actors.get(actor_id)
         if info is None:
             return
-        with info.lock:
-            buffered = list(info.buffered)
-            info.buffered.clear()
-        for spec in buffered:
-            self._route_actor_task(spec)
+        while True:
+            with info.lock:
+                if not info.buffered:
+                    info.ready_for_dispatch = True
+                    return
+                spec = info.buffered.popleft()
+                node = self.nodes.get(info.node_id)
+                worker_id = info.worker_id
+            ok = (node is not None and worker_id is not None
+                  and node.dispatch_to_actor(worker_id, spec))
+            if not ok:
+                with info.lock:
+                    info.buffered.appendleft(spec)
+                return  # actor died mid-flush; death path re-handles
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         info = self.actors.get(actor_id)
@@ -329,7 +344,17 @@ class DriverRuntime:
             self.task_manager.mark_object_ready(oid)
         if spec.is_actor_creation:
             info = self.actors.get(spec.actor_id)
-            if info is not None:
+            record = self.gcs.get_actor(spec.actor_id)
+            if record is not None and record.state == "DEAD":
+                # kill() raced the construction: honor the kill instead of
+                # reviving (reference: GCS actor manager kill-on-pending).
+                node.kill_worker(worker.worker_id)
+                if info is not None:
+                    self._release_actor_resources(info)
+                    self._fail_actor_buffer(
+                        spec.actor_id,
+                        ActorDiedError(spec.actor_id, "actor killed"))
+            elif info is not None:
                 with info.lock:
                     info.node_id = node.node_id
                     info.worker_id = worker.worker_id
@@ -430,6 +455,7 @@ class DriverRuntime:
             with info.lock:
                 info.node_id = None
                 info.worker_id = None
+                info.ready_for_dispatch = False
             new_spec = TaskSpec(
                 task_id=TaskID.from_random(),
                 function_id=info.creation_spec.function_id,
@@ -472,10 +498,7 @@ class DriverRuntime:
 
     def put_serialized(self, data: bytes, buffers) -> ObjectRef:
         """Store already-serialized parts (single serialize pass)."""
-        with self._put_lock:
-            self._put_counter += 1
-            idx = self._put_counter
-        oid = ObjectID.for_put(self._driver_task_id, idx)
+        oid = ObjectID.from_random()
         cfg = get_config()
         if not buffers and len(data) < cfg.max_inline_object_size:
             packed = serialization.pack_parts(data, buffers)
@@ -523,6 +546,10 @@ class DriverRuntime:
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):
+        if num_returns > len(refs):
+            raise ValueError(
+                f"num_returns ({num_returns}) exceeds the number of refs "
+                f"({len(refs)})")
         event = threading.Event()
         for ref in refs:
             self.task_manager.on_ready(ref.id, event.set)
@@ -537,7 +564,6 @@ class DriverRuntime:
                 break
             event.clear()
             event.wait(remaining if remaining is not None else 0.2)
-        ready_set = {r.id for r in ready}
         done = ready[:num_returns]
         done_set = {r.id for r in done}
         rest = [r for r in refs if r.id not in done_set]
@@ -657,25 +683,32 @@ class DriverRuntime:
         return self._gcs_dispatch(method, args)
 
     def cancel(self, object_id: ObjectID, force: bool = False) -> None:
+        """Cancel the producing task: tasks not yet dispatched (queued,
+        dep-waiting, or in the scheduler's backlog) fail with
+        TaskCancelledError immediately — the scheduling loop drops specs
+        whose pending entry is gone. Running tasks are only interrupted
+        with force=True (worker kill), matching the reference's
+        semantics for non-async tasks."""
         task_id = self.task_manager.producing_task(object_id)
         if task_id is None:
             return
-        with self._sched_cond:
-            for spec in list(self._schedulable):
-                if spec.task_id == task_id:
-                    self._schedulable.remove(spec)
-                    self.task_manager.fail(task_id, TaskCancelledError(task_id))
-                    return
+        task = self.task_manager.get_pending(task_id)
+        if task is None:
+            return  # already finished/failed
+        if task.node_id is None:
+            # Not dispatched anywhere yet; fail it and let the queues
+            # drop it when they encounter the dead pending entry.
+            self.task_manager.fail(task_id, TaskCancelledError(task_id))
+            self._signal_scheduler()
+            return
         if force:
-            task = self.task_manager.get_pending(task_id)
-            if task is not None and task.node_id is not None:
-                node = self.nodes.get(task.node_id)
-                if node is not None:
-                    with node._lock:
-                        for w in node._workers.values():
-                            if task_id in w.running:
-                                node.kill_worker(w.worker_id)
-                                break
+            node = self.nodes.get(task.node_id)
+            if node is not None:
+                with node._lock:
+                    for w in node._workers.values():
+                        if task_id in w.running:
+                            node.kill_worker(w.worker_id)
+                            break
 
     def cluster_resources(self) -> Dict[str, float]:
         totals: Dict[str, float] = {}

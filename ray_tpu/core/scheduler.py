@@ -132,10 +132,20 @@ class ClusterScheduler:
             candidates = list(self._nodes.items())
             if strategy.kind == "NODE_AFFINITY" and strategy.node_id is not None:
                 view = self._nodes.get(strategy.node_id)
-                if view is not None and _fits(view.available, need):
-                    return strategy.node_id
-                if not strategy.soft:
-                    return None
+                if view is None or not _feasible(view.total, need):
+                    if strategy.soft:
+                        view = None  # fall through to the general policy
+                    else:
+                        # Target node is gone or can never fit the task.
+                        raise ValueError(
+                            f"hard NODE_AFFINITY target "
+                            f"{strategy.node_id.hex()[:8]} is dead or "
+                            f"infeasible for {need}")
+                if view is not None:
+                    if _fits(view.available, need):
+                        return strategy.node_id
+                    if not strategy.soft:
+                        return None  # feasible but busy: wait for capacity
             if strategy.kind == "NODE_LABEL" and strategy.labels:
                 candidates = [
                     (nid, v) for nid, v in candidates

@@ -93,11 +93,10 @@ class WorkerRuntime:
         return self.put_serialized(data, buffers)
 
     def put_serialized(self, data: bytes, buffers) -> ObjectRef:
-        with self._req_lock:
-            self._put_counter += 1
-            idx = self._put_counter
-        task_id = getattr(self._current_task_id, "value", None) or TaskID.from_random()
-        oid = ObjectID.for_put(task_id, idx)
+        # Random IDs: a retried task attempt must not collide with the
+        # puts of its previous attempt (the ID travels in the returned
+        # ref + PUT_META, so determinism buys nothing).
+        oid = ObjectID.from_random()
         self.store.put_parts(oid, data, buffers, [b.nbytes for b in buffers])
         self.conn.send({"kind": "PUT_META", "object_id": oid.binary()})
         return ObjectRef(oid)
@@ -149,6 +148,10 @@ class WorkerRuntime:
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):
+        if num_returns > len(refs):
+            raise ValueError(
+                f"num_returns ({num_returns}) exceeds the number of refs "
+                f"({len(refs)})")
         import time as _time
         deadline = None if timeout is None else _time.monotonic() + timeout
         pending = list(refs)

@@ -1,0 +1,10 @@
+"""Model zoo: functional JAX models designed for GSPMD sharding."""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+    llama_sharding_rules,
+)
+from ray_tpu.models.mlp import MLPConfig, mlp_forward, mlp_init

@@ -1,0 +1,236 @@
+"""Llama-family decoder (Llama-2/3 architecture), functional JAX.
+
+The flagship model for the Train path (BASELINE.md north star:
+Llama-2-7B fine-tune ≥35% MFU on v5p). Design choices for TPU:
+
+- Layers are *stacked* (leading n_layers axis) and iterated with
+  `lax.scan`: one compiled block regardless of depth, fast compiles,
+  and `jax.checkpoint` per block gives layer-granular rematerialization.
+- All matmuls stay [tokens, features] × [features, out] — large, MXU-
+  shaped, bfloat16 by default with float32 accumulation.
+- Attention pluggable: "flash" (Pallas kernel, ray_tpu/ops/attention.py),
+  "reference" (jnp), or "ring"/"ulysses" (sequence-parallel,
+  ray_tpu/parallel/ring_attention.py) — selected by the sharding config,
+  not the model code.
+- Sharding is external: `llama_sharding_rules(mode)` returns rules for
+  this parameter tree (ddp/fsdp/tp/fsdp_tp), applied via
+  ray_tpu.parallel.sharding. The model itself is sharding-agnostic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops.attention import flash_attention
+from ray_tpu.ops.rmsnorm import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    hidden_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # flash | reference | ring | ulysses
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    # --- presets -------------------------------------------------------
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                           n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                           max_seq_len=8192, rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-scale config that runs on the 8-device CPU mesh."""
+        defaults = dict(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, hidden_dim=128, max_seq_len=128,
+                        dtype=jnp.float32, attention="reference",
+                        remat=False)
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    @staticmethod
+    def small_1b(**kw) -> "LlamaConfig":
+        defaults = dict(vocab_size=32000, dim=2048, n_layers=16,
+                        n_heads=16, n_kv_heads=16, hidden_dim=5504,
+                        max_seq_len=2048)
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    def num_params(self) -> int:
+        hd = self.head_dim
+        per_layer = (
+            self.dim * self.n_heads * hd          # wq
+            + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
+            + self.n_heads * hd * self.dim         # wo
+            + 3 * self.dim * self.hidden_dim       # w1, w2, w3 (w2 transposed)
+            + 2 * self.dim                         # norms
+        )
+        return (self.vocab_size * self.dim * 2     # embedding + lm_head
+                + self.n_layers * per_layer + self.dim)
+
+    def flops_per_token(self) -> float:
+        """Approx training FLOPs/token (6 * params, attention extra)."""
+        return 6.0 * self.num_params()
+
+
+def llama_init(rng, config: LlamaConfig) -> Dict[str, Any]:
+    """Initialize the parameter pytree (layers stacked on axis 0)."""
+    c = config
+    hd = c.head_dim
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(c.dtype)
+
+    keys = jax.random.split(k_layers, 7)
+
+    def stack(key, shape, fan_in):
+        return dense(key, (c.n_layers, *shape), fan_in)
+
+    params = {
+        "embedding": dense(k_embed, (c.vocab_size, c.dim), c.dim),
+        "layers": {
+            "attn_norm": jnp.ones((c.n_layers, c.dim), dtype=c.dtype),
+            "wq": stack(keys[0], (c.dim, c.n_heads * hd), c.dim),
+            "wk": stack(keys[1], (c.dim, c.n_kv_heads * hd), c.dim),
+            "wv": stack(keys[2], (c.dim, c.n_kv_heads * hd), c.dim),
+            "wo": stack(keys[3], (c.n_heads * hd, c.dim), c.n_heads * hd),
+            "mlp_norm": jnp.ones((c.n_layers, c.dim), dtype=c.dtype),
+            "w1": stack(keys[4], (c.dim, c.hidden_dim), c.dim),
+            "w3": stack(keys[5], (c.dim, c.hidden_dim), c.dim),
+            "w2": stack(keys[6], (c.hidden_dim, c.dim), c.hidden_dim),
+        },
+        "final_norm": jnp.ones((c.dim,), dtype=c.dtype),
+        "lm_head": dense(k_head, (c.dim, c.vocab_size), c.dim),
+    }
+    return params
+
+
+def _attention(q, k, v, config: LlamaConfig, mesh):
+    """Dispatch to the configured attention implementation."""
+    n_rep = config.n_heads // config.n_kv_heads
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+        v = jnp.repeat(v, n_rep, axis=2)
+    if config.attention == "ring":
+        from ray_tpu.parallel.ring_attention import ring_attention
+        return ring_attention(q, k, v, mesh, causal=True)
+    if config.attention == "ulysses":
+        from ray_tpu.parallel.ring_attention import ulysses_attention
+        return ulysses_attention(q, k, v, mesh, causal=True)
+    if config.attention == "flash":
+        return flash_attention(q, k, v, True)
+    from ray_tpu.ops.attention import _attention_reference
+    return _attention_reference(q, k, v, True)
+
+
+def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh):
+    c = config
+    b, s, _ = x.shape
+    hd = c.head_dim
+    h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
+    q = (h @ layer_params["wq"]).reshape(b, s, c.n_heads, hd)
+    k = (h @ layer_params["wk"]).reshape(b, s, c.n_kv_heads, hd)
+    v = (h @ layer_params["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = _attention(q, k, v, c, mesh)
+    x = x + attn.reshape(b, s, c.n_heads * hd) @ layer_params["wo"]
+    h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
+    gate = jax.nn.silu(h @ layer_params["w1"])
+    up = h @ layer_params["w3"]
+    x = x + (gate * up) @ layer_params["w2"]
+    return x
+
+
+def llama_forward(params, tokens, config: LlamaConfig, mesh=None):
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (float32)."""
+    c = config
+    x = params["embedding"][tokens].astype(c.dtype)
+    cos, sin = rope_frequencies(c.head_dim, tokens.shape[1], c.rope_theta)
+
+    block = functools.partial(_block, config=c, mesh=mesh)
+    if c.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer_params):
+        return block(layer_params, x, cos, sin), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    return (x @ params["lm_head"]).astype(jnp.float32)
+
+
+def llama_loss(params, tokens, targets, config: LlamaConfig, mesh=None,
+               mask=None):
+    """Next-token cross-entropy. targets: [B, S]; mask: [B, S] float."""
+    logits = llama_forward(params, tokens, config, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return -jnp.mean(ll)
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def llama_sharding_rules(mode: str = "fsdp_tp") -> ShardingRules:
+    """Sharding rules for this parameter tree (leading axis = layers).
+
+    Modes: ddp | fsdp | tp | fsdp_tp — the JaxTrainer's DDP/FSDP/TP
+    settings lower to these (reference analog:
+    train/torch/train_loop_utils.py prepare_model wrapping DDP/FSDP;
+    here it's a declarative mapping instead of a wrapper).
+    """
+    if mode == "ddp":
+        return ShardingRules(rules=[(r".*", P())])
+    if mode == "fsdp":
+        return ShardingRules(rules=[
+            (r"embedding", P("fsdp", None)),
+            (r"lm_head", P(None, "fsdp")),
+            (r"layers/(wq|wk|wv|w1|w3)", P(None, "fsdp", None)),
+            (r"layers/(wo|w2)", P(None, None, "fsdp")),
+            (r".*", P()),
+        ])
+    if mode == "tp":
+        return ShardingRules(rules=[
+            (r"embedding", P(None, "model")),
+            (r"lm_head", P(None, "model")),
+            (r"layers/(wq|wk|wv|w1|w3)", P(None, None, "model")),
+            (r"layers/(wo|w2)", P(None, "model", None)),
+            (r".*", P()),
+        ])
+    if mode == "fsdp_tp":
+        return ShardingRules(rules=[
+            (r"embedding", P("fsdp", "model")),
+            (r"lm_head", P(None, ("fsdp", "model"))),
+            (r"layers/(wq|wk|wv|w1|w3)", P(None, "fsdp", "model")),
+            (r"layers/(wo|w2)", P(None, "model", "fsdp")),
+            (r".*", P()),
+        ])
+    raise ValueError(f"unknown sharding mode {mode}")

@@ -1,0 +1,47 @@
+"""Small MLP classifier — the MNIST-class model for trainer tests
+(reference analog: the torch MNIST recipes in release tests,
+release_tests.yaml:197)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: tuple = (128, 128)
+    out_dim: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_init(rng, config: MLPConfig):
+    dims = [config.in_dim, *config.hidden, config.out_dim]
+    keys = jax.random.split(rng, len(dims) - 1)
+    layers = []
+    for key, d_in, d_out in zip(keys, dims[:-1], dims[1:]):
+        layers.append({
+            "w": (jax.random.normal(key, (d_in, d_out)) * (d_in ** -0.5)
+                  ).astype(config.dtype),
+            "b": jnp.zeros((d_out,), dtype=config.dtype),
+        })
+    return {"layers": layers}
+
+
+def mlp_forward(params, x):
+    n = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def mlp_loss(params, x, y):
+    logits = mlp_forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))

@@ -281,7 +281,11 @@ int64_t shm_seal(void* base, const uint8_t* id) {
   if (!e) { pthread_mutex_unlock(&h->mutex); return kNotFound; }
   if (e->state != kCreated) { pthread_mutex_unlock(&h->mutex); return kBadState; }
   e->state = kSealed;
-  e->refcount--;  // drop creator reference
+  // The creator reference is kept: it represents the owner's
+  // (distributed) reference count and is dropped by shm_delete, so LRU
+  // eviction can never reclaim an object whose ObjectRefs are alive
+  // (plasma parity: referenced objects are pinned; only deleted /
+  // released ones are eviction fodder).
   pthread_cond_broadcast(&h->cond);
   pthread_mutex_unlock(&h->mutex);
   return kOk;
@@ -342,19 +346,20 @@ int64_t shm_release(void* base, const uint8_t* id) {
   return kOk;
 }
 
-// Delete an object outright (distributed refcount hit zero). If still
-// pinned by readers, it is marked unreferenced and left to eviction.
+// Delete an object (the owner's distributed refcount hit zero): drops
+// the creator pin. Frees immediately unless readers still pin it, in
+// which case it becomes prime eviction fodder once they release.
 int64_t shm_delete(void* base, const uint8_t* id) {
   Header* h = H(base);
   lock(h);
   ObjectEntry* e = find(base, id);
   if (!e) { pthread_mutex_unlock(&h->mutex); return kNotFound; }
+  if (e->refcount > 0) e->refcount--;  // creator pin
   if (e->refcount <= 0) {
     free_block(base, e->offset - kBlockHeader);
     e->state = kEmpty;
     h->num_objects--;
   } else {
-    // Readers hold pins; make it evictable as soon as they release.
     e->lru = 0;
   }
   pthread_mutex_unlock(&h->mutex);

@@ -1,0 +1,138 @@
+"""Fused causal attention.
+
+A Pallas TPU kernel that computes attention per (batch, head, q-block)
+entirely in VMEM — the [S, S] score matrix never materializes in HBM,
+which is the memory win that matters on TPU (HBM bandwidth is the
+bottleneck; VMEM blocks feed the MXU directly). Falls back to a jnp
+reference off-TPU and for shapes the kernel doesn't cover.
+
+Backward runs the reference VJP on recomputed activations (flash-style
+fused backward kernel is future work; `jax.checkpoint` around the call
+already keeps residuals small).
+
+Layout: [batch, seq, heads, head_dim] (GQA supported by repeating K/V
+heads upstream in the model).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+# Max K/V bytes held in VMEM per (batch, head) program before falling
+# back (v5 VMEM ~16 MB/core; leave room for q/out/scores).
+_VMEM_KV_BUDGET = 8 * 1024 * 1024
+_BLOCK_Q = 256
+
+
+def _attention_reference(q, k, v, causal: bool):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / (d ** 0.5)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), sk - sq)
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                  block_q: int, seq_k: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)           # [block_q, d]
+    k = k_ref[0, 0, :, :].astype(jnp.float32)           # [seq_k, d]
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    d = q.shape[-1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * (1.0 / (d ** 0.5))
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, seq_k), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, seq_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) / l
+    o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = min(_BLOCK_Q, sq)
+    grid = (b, h, sq // block_q)
+    kernel = functools.partial(_flash_kernel, causal=causal,
+                               block_q=block_q, seq_k=sk)
+    # Kernel layout is [B, H, S, D] so the tiled (second-to-last, last)
+    # dims are (seq, head_dim) — the MXU-friendly orientation. XLA fuses
+    # the transposes into the surrounding projections.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _kernel_supported(q, k) -> bool:
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    if d % 128 or sq % 128 or sk % 128:
+        return False
+    kv_bytes = 2 * sk * d * 4
+    return kv_bytes <= _VMEM_KV_BUDGET
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, causal: bool = True):
+    """Fused causal attention: [B, S, H, D] x3 -> [B, S, H, D].
+
+    K/V head count must equal Q head count (expand GQA groups first)."""
+    if _kernel_supported(q, k):
+        return _flash_forward(q, k, v, causal)
+    return _attention_reference(q, k, v, causal)
+
+
+def _fwd(q, k, v, causal):
+    return flash_attention(q, k, v, causal), (q, k, v)
+
+
+def _bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,15 @@
+"""Parallelism primitives: meshes, sharding configs, collectives,
+sequence/context parallelism, and pipeline parallelism.
+
+Unlike the reference — which outsources TP/PP/SP/ring-attention to
+external engines (SURVEY.md §5.7) — these are first-class library
+components lowering to GSPMD mesh shardings, shard_map, and Pallas
+kernels (SURVEY.md §2.3 X1–X7)."""
+
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.parallel.sharding import (
+    ShardingConfig,
+    ShardingRules,
+    infer_sharding,
+    shard_pytree,
+)

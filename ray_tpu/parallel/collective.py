@@ -1,0 +1,226 @@
+"""Out-of-band collectives between actors/tasks.
+
+Capability parity with the reference's ray.util.collective
+(reference: python/ray/util/collective/collective.py —
+init_collective_group:180, allreduce:325, barrier:365, broadcast:440,
+allgather:490, reducescatter:539, send:598/recv:661; NCCL rendezvous via
+named actor + GCS KV, collective_group/nccl_collective_group.py:29).
+
+TPU-native stance (SURVEY.md §5.8): in-graph SPMD math should use
+`jax.lax.psum`/`all_gather` over a mesh — XLA emits ICI collective DMA
+and no framework code runs per step. This module covers the *out-of-band*
+cases the reference uses NCCL for: host tensors moving between actors
+(weight broadcast to env-runners, parameter servers, metric reduction).
+The backend rendezvouses through the GCS KV store and moves payloads
+through the shared-memory object plane — no NCCL, no CUDA, and on a
+TPU host no extra copies (the store is the staging buffer the device
+transfer reads from anyway).
+
+Ops must be called in the same order by every rank of a group (the
+standard collective contract).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.core import runtime as runtime_mod
+from ray_tpu.core import serialization
+from ray_tpu.exceptions import GetTimeoutError
+
+_DEFAULT_TIMEOUT = 60.0
+_POLL_S = 0.002
+
+
+def _kv_put(key: str, value: bytes) -> None:
+    rt = runtime_mod.get_runtime()
+    if rt.is_driver:
+        rt.gcs.kv.put(key.encode(), value, namespace="collective")
+    else:
+        rt.gcs_call("kv_put", key.encode(), value, "collective")
+
+
+def _kv_get(key: str) -> Optional[bytes]:
+    rt = runtime_mod.get_runtime()
+    if rt.is_driver:
+        return rt.gcs.kv.get(key.encode(), namespace="collective")
+    return rt.gcs_call("kv_get", key.encode(), "collective")
+
+
+def _kv_del(key: str) -> None:
+    rt = runtime_mod.get_runtime()
+    if rt.is_driver:
+        rt.gcs.kv.delete(key.encode(), namespace="collective")
+    else:
+        rt.gcs_call("kv_del", key.encode(), "collective")
+
+
+def _kv_wait(key: str, timeout: float) -> bytes:
+    deadline = time.monotonic() + timeout
+    while True:
+        value = _kv_get(key)
+        if value is not None:
+            return value
+        if time.monotonic() >= deadline:
+            raise GetTimeoutError(f"collective rendezvous timed out on {key}")
+        time.sleep(_POLL_S)
+
+
+@dataclass
+class GroupInfo:
+    world_size: int
+    rank: int
+    name: str
+    seq: int = 0
+
+
+_groups: Dict[str, GroupInfo] = {}
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> None:
+    """Join a collective group (each rank calls once)."""
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world {world_size}")
+    _groups[group_name] = GroupInfo(world_size, rank, group_name)
+    _kv_put(f"grp/{group_name}/{rank}", str(world_size).encode())
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups.pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _groups[group_name].rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _groups[group_name].world_size
+
+
+def _group(group_name: str) -> GroupInfo:
+    group = _groups.get(group_name)
+    if group is None:
+        raise ValueError(
+            f"collective group {group_name!r} not initialized in this "
+            f"process; call init_collective_group first")
+    return group
+
+
+def _exchange(group: GroupInfo, tensor: Optional[np.ndarray],
+              timeout: float) -> List[Optional[np.ndarray]]:
+    """All ranks deposit, all ranks read everyone's payload."""
+    seq = group.seq
+    group.seq += 1
+    prefix = f"col/{group.name}/{seq}"
+    _kv_put(f"{prefix}/{group.rank}",
+            serialization.pack(tensor) if tensor is not None else b"")
+    out: List[Optional[np.ndarray]] = []
+    for rank in range(group.world_size):
+        blob = _kv_wait(f"{prefix}/{rank}", timeout)
+        out.append(serialization.unpack(blob) if blob else None)
+    # Everyone acks; the last rank out cleans the round's keys.
+    _kv_put(f"{prefix}/ack/{group.rank}", b"1")
+    if all(_kv_get(f"{prefix}/ack/{r}") is not None
+           for r in range(group.world_size)):
+        for rank in range(group.world_size):
+            _kv_del(f"{prefix}/{rank}")
+    return out
+
+
+_REDUCE_OPS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "prod": lambda xs: np.prod(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+    "min": lambda xs: np.min(xs, axis=0),
+    "mean": lambda xs: np.mean(xs, axis=0),
+}
+
+
+def allreduce(tensor, op: str = "sum", group_name: str = "default",
+              timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
+    group = _group(group_name)
+    parts = _exchange(group, np.asarray(tensor), timeout)
+    return _REDUCE_OPS[op](np.stack([np.asarray(p) for p in parts]))
+
+
+def allgather(tensor, group_name: str = "default",
+              timeout: float = _DEFAULT_TIMEOUT) -> List[np.ndarray]:
+    group = _group(group_name)
+    return [np.asarray(p) for p in _exchange(group, np.asarray(tensor), timeout)]
+
+
+def reducescatter(tensor, op: str = "sum", group_name: str = "default",
+                  timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
+    """Reduce across ranks, then each rank keeps its 1/world shard along
+    axis 0."""
+    group = _group(group_name)
+    reduced = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
+    shards = np.array_split(reduced, group.world_size, axis=0)
+    return shards[group.rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
+    group = _group(group_name)
+    payload = np.asarray(tensor) if group.rank == src_rank else None
+    parts = _exchange(group, payload, timeout)
+    return np.asarray(parts[src_rank])
+
+
+def barrier(group_name: str = "default",
+            timeout: float = _DEFAULT_TIMEOUT) -> None:
+    group = _group(group_name)
+    _exchange(group, np.zeros((), dtype=np.int8), timeout)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    group = _group(group_name)
+    key = f"p2p/{group.name}/{group.rank}->{dst_rank}/{tag}"
+    _kv_put(key, serialization.pack(np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0,
+         timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
+    group = _group(group_name)
+    key = f"p2p/{group.name}/{src_rank}->{group.rank}/{tag}"
+    blob = _kv_wait(key, timeout)
+    _kv_del(key)
+    return serialization.unpack(blob)
+
+
+# --- in-graph SPMD collectives (the TPU hot path) -----------------------
+# These are thin names over jax.lax; inside a jitted/shard_mapped fn they
+# compile to ICI collective DMA. Use these for all per-step math — the
+# KV backend above is control-plane only.
+
+def psum(x, axis_name: str):
+    import jax
+    return jax.lax.psum(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    import jax
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    import jax
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute(x, axis_name: str, perm):
+    import jax
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
+    import jax
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=True)

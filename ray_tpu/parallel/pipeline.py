@@ -1,0 +1,93 @@
+"""Pipeline parallelism over the mesh's `pipe` axis.
+
+The reference treats pipeline parallelism as configuration passed to
+external engines (SURVEY.md §2.3 X4 — vLLM TP/PP passthrough,
+vllm_models.py:214); here it is an in-tree transform. The schedule is
+the classic GPipe rotation expressed as a `lax.scan` of
+`lax.ppermute` steps inside `shard_map` (MPMD-over-SPMD, cf. arXiv
+2412.14374): device i holds stage i's parameters; microbatches enter at
+stage 0, activations hop to the ICI neighbor each tick, and outputs
+drain from the last stage. Total ticks = n_micro + n_stages - 1, bubble
+fraction (n_stages-1)/(n_micro+n_stages-1).
+
+For a stage function f(stage_params, x) -> y with x and y of identical
+shape (the transformer-block contract), `pipeline()` computes the
+composition stage_{n-1} ∘ ... ∘ stage_0 over every microbatch.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def _pipeline_local(params, x, *, fn, axis_name: str):
+    """Per-device pipeline loop. params: stage-local pytree (leading
+    stage axis of size 1); x: [n_micro, mb, ...] full microbatch stack
+    (replicated — only stage 0 reads it)."""
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: jnp.squeeze(p, axis=0), params)
+    n_micro = x.shape[0]
+    steps = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (clamped; extra ticks feed dummies
+        # whose outputs are never recorded).
+        inject = x[jnp.minimum(t, n_micro - 1)]
+        inp = jnp.where(stage == 0, inject, state)
+        out = fn(params, inp)
+        # Last stage drains microbatch t-(n_stages-1).
+        mb_idx = t - (n_stages - 1)
+        record = jnp.logical_and(stage == n_stages - 1, mb_idx >= 0)
+        idx = jnp.maximum(mb_idx, 0)
+        outputs = jnp.where(
+            record,
+            lax.dynamic_update_index_in_dim(outputs, out, idx, axis=0),
+            outputs)
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    state0 = jnp.zeros_like(x[0])
+    out0 = jnp.zeros_like(x)
+    (_, outputs), _ = lax.scan(tick, (state0, out0), jnp.arange(steps))
+    # Only the last stage holds real outputs; broadcast them to all
+    # stages so the result is replicated over `pipe`.
+    outputs = lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs
+
+
+def pipeline(fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
+             x: jax.Array, mesh: Mesh, *, num_microbatches: int,
+             axis_name: str = "pipe") -> jax.Array:
+    """Run ``x`` through all pipeline stages.
+
+    stage_params: pytree whose leaves have a leading ``n_stages`` axis
+    (sharded over ``pipe``); x: [batch, ...] — split internally into
+    ``num_microbatches``.
+    """
+    if x.shape[0] % num_microbatches:
+        raise ValueError(
+            f"batch {x.shape[0]} not divisible by num_microbatches "
+            f"{num_microbatches}")
+    mb = x.shape[0] // num_microbatches
+    x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
+    param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    local = functools.partial(_pipeline_local, fn=fn, axis_name=axis_name)
+    out = shard_map(
+        local, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stage_params, x_mb)
+    return out.reshape(x.shape[0], *out.shape[2:])

@@ -1,0 +1,142 @@
+"""Sharding rules: how parameter/activation pytrees map onto the mesh.
+
+The TPU-native replacement for the reference's DDP/FSDP wrapper classes
+(reference: train/torch/train_loop_utils.py:458 DistributedDataParallel
+wrap, :473 FullyShardedDataParallel): instead of wrapping the model,
+declare rules mapping parameter-path regexes to PartitionSpecs; pjit
+lowers them to GSPMD shardings and XLA inserts the gradient psum
+(DDP-equivalent) or per-layer all-gather/reduce-scatter
+(FSDP/ZeRO-equivalent — arXiv 2004.13336) over ICI.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# A rule: (path regex, PartitionSpec). First match wins.
+Rule = Tuple[str, P]
+
+
+@dataclass
+class ShardingRules:
+    rules: List[Rule] = field(default_factory=list)
+    default: P = P()
+
+    def spec_for(self, path: str, ndim: int) -> P:
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                if len(spec) > ndim:
+                    # Drop trailing axes that don't exist on this param.
+                    spec = P(*spec[:ndim])
+                return spec
+        return self.default
+
+
+def _tree_paths(tree: Any) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for keypath, leaf in flat:
+        path = "/".join(_key_str(k) for k in keypath)
+        out.append((path, leaf))
+    return out
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    if hasattr(k, "name"):
+        return str(k.name)
+    return str(k)
+
+
+def infer_sharding(tree: Any, mesh: Mesh, rules: ShardingRules):
+    """Map every leaf to a NamedSharding via the first matching rule."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    shardings = []
+    for keypath, leaf in flat:
+        path = "/".join(_key_str(k) for k in keypath)
+        ndim = getattr(leaf, "ndim", 0)
+        spec = rules.spec_for(path, ndim)
+        shardings.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def shard_pytree(tree: Any, mesh: Mesh, rules: ShardingRules):
+    """Device-put a pytree according to the rules (used at init/restore)."""
+    shardings = infer_sharding(tree, mesh, rules)
+    return jax.device_put(tree, shardings)
+
+
+@dataclass
+class ShardingConfig:
+    """High-level parallelism mode, lowered to rules.
+
+    Modes (reference analog in parentheses):
+      ddp   — replicate params, shard batch on `data` (X2 DDP)
+      fsdp  — shard params' largest dim on `fsdp`, batch on data+fsdp (X3)
+      tp    — tensor-parallel transformer rules on `model` (X4 TP)
+      fsdp_tp — 2D: fsdp × model (the standard 7B+ recipe)
+    """
+    mode: str = "ddp"
+    # extra user rules consulted before the mode's built-ins
+    extra_rules: List[Rule] = field(default_factory=list)
+
+    def batch_spec(self) -> P:
+        if self.mode == "ddp":
+            return P(("data",))
+        return P(("data", "fsdp"))
+
+    def rules(self) -> ShardingRules:
+        built_in: List[Rule]
+        if self.mode == "ddp":
+            built_in = []          # replicate everything
+        elif self.mode == "fsdp":
+            built_in = [
+                # Shard the contraction/hidden dimension of every ≥2D
+                # param across fsdp; 1D (norms, biases) replicated.
+                (r"(embedding|lm_head)", P("fsdp", None)),
+                (r"(wq|wk|wv|q_proj|k_proj|v_proj|gate|up|w1|w3)",
+                 P("fsdp", None)),
+                (r"(wo|o_proj|down|w2)", P(None, "fsdp")),
+                (r".*", P()),
+            ]
+        elif self.mode == "tp":
+            built_in = _TP_RULES
+        elif self.mode == "fsdp_tp":
+            built_in = [
+                (r"(embedding|lm_head)", P("fsdp", "model")),
+                (r"(wq|wk|wv|q_proj|k_proj|v_proj)", P("fsdp", "model")),
+                (r"(wo|o_proj)", P("model", "fsdp")),
+                (r"(gate|up|w1|w3)", P("fsdp", "model")),
+                (r"(down|w2)", P("model", "fsdp")),
+                (r".*", P()),
+            ]
+        else:
+            raise ValueError(f"unknown sharding mode: {self.mode}")
+        return ShardingRules(rules=list(self.extra_rules) + built_in)
+
+
+# Megatron-style tensor parallelism: column-parallel in-projections,
+# row-parallel out-projections; XLA inserts the psum after wo/w2.
+_TP_RULES: List[Rule] = [
+    (r"(embedding|lm_head)", P(None, "model")),
+    (r"(wq|wk|wv|q_proj|k_proj|v_proj)", P(None, "model")),
+    (r"(wo|o_proj)", P("model", None)),
+    (r"(gate|up|w1|w3)", P(None, "model")),
+    (r"(down|w2)", P("model", None)),
+    (r".*", P()),
+]
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint helper usable inside jitted fns."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

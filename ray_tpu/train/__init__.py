@@ -1,0 +1,28 @@
+"""Distributed training (Train-v2 equivalent).
+
+reference: python/ray/train/v2 — DataParallelTrainer
+(api/data_parallel_trainer.py:152), TrainController state machine
+(_internal/execution/controller/controller.py:100), JAX backend
+(v2/jax/jax_trainer.py:19), report/get_checkpoint train-fn utils
+(api/train_fn_utils.py)."""
+
+from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer
+
+# DataParallelTrainer is the generic name in the reference; JaxTrainer is
+# the (only) backend here — alias for API familiarity.
+DataParallelTrainer = JaxTrainer

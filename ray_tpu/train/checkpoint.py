@@ -1,0 +1,138 @@
+"""Checkpoints: directories of files, with sharded-pytree save/restore.
+
+reference: python/ray/train/_checkpoint.py (Checkpoint = directory on an
+fsspec filesystem) + SURVEY.md §5.4 — the TPU equivalent of torch
+checkpointing is orbax-style sharded array checkpointing; restore placing
+shards directly on their target devices (no host round-trip of the full
+tree).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    """A directory of checkpoint data."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @staticmethod
+    def from_directory(path: str) -> "Checkpoint":
+        return Checkpoint(path)
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        if dest is None:
+            dest = tempfile.mkdtemp(prefix="rtpu_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+def save_pytree(tree: Any, directory: str, name: str = "state") -> None:
+    """Save a (possibly sharded) jax pytree via orbax; host arrays fall
+    back to pickle. Multi-host: every process participates (orbax
+    coordinates)."""
+    os.makedirs(directory, exist_ok=True)
+    try:
+        import jax
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        target = os.path.join(directory, name)
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        ckptr.save(target, tree)
+    except Exception:
+        with open(os.path.join(directory, name + ".pkl"), "wb") as f:
+            pickle.dump(tree, f)
+
+
+def load_pytree(directory: str, name: str = "state",
+                target: Any = None) -> Any:
+    """Restore a pytree; with ``target`` (a pytree of ShapeDtypeStruct or
+    arrays with shardings) orbax restores shards onto devices directly."""
+    pkl = os.path.join(directory, name + ".pkl")
+    if os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            return pickle.load(f)
+    import orbax.checkpoint as ocp
+    ckptr = ocp.PyTreeCheckpointer()
+    path = os.path.join(directory, name)
+    if target is not None:
+        try:
+            return ckptr.restore(path, item=target)
+        except TypeError:
+            return ckptr.restore(path)
+    return ckptr.restore(path)
+
+
+class CheckpointManager:
+    """Tracks latest/best checkpoints under the run's storage path.
+
+    reference: train/v2/_internal/execution/checkpoint/checkpoint_manager.py
+    """
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self._index = 0
+        self._checkpoints: list = []  # (path, metrics)
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, source_dir: str, metrics: Dict[str, Any]) -> Checkpoint:
+        self._index += 1
+        dest = os.path.join(self.storage_path,
+                            f"checkpoint_{self._index:06d}")
+        shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+        with open(os.path.join(dest, ".metrics.json"), "w") as f:
+            json.dump(_jsonable(metrics), f)
+        self._checkpoints.append((dest, metrics))
+        if self.num_to_keep and len(self._checkpoints) > self.num_to_keep:
+            old, _ = self._checkpoints.pop(0)
+            shutil.rmtree(old, ignore_errors=True)
+        return Checkpoint(dest)
+
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._checkpoints:
+            return self._find_on_disk()
+        return Checkpoint(self._checkpoints[-1][0])
+
+    def _find_on_disk(self) -> Optional[Checkpoint]:
+        """Resume discovery after a controller restart."""
+        if not os.path.isdir(self.storage_path):
+            return None
+        found = sorted(
+            d for d in os.listdir(self.storage_path)
+            if d.startswith("checkpoint_"))
+        if not found:
+            return None
+        return Checkpoint(os.path.join(self.storage_path, found[-1]))
+
+
+def _jsonable(metrics: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for key, value in metrics.items():
+        try:
+            json.dumps(value)
+            out[key] = value
+        except (TypeError, ValueError):
+            out[key] = float(value) if hasattr(value, "__float__") else str(value)
+    return out

@@ -1,0 +1,50 @@
+"""Control-plane collectives for train workers.
+
+reference: python/ray/train/collective/collectives.py:16,32 (barrier,
+broadcast_from_rank_zero via SynchronizationActor) — here implemented
+over the GCS-KV collective backend (ray_tpu/parallel/collective.py),
+scoped to the run's pre-initialized group.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.core import serialization
+from ray_tpu.parallel import collective
+from ray_tpu.train.context import get_context
+
+
+def barrier() -> None:
+    ctx = get_context()
+    collective.barrier(group_name=ctx.group_name)
+
+
+def broadcast_from_rank_zero(data: Any) -> Any:
+    """Broadcast an arbitrary picklable value from rank 0 to all ranks."""
+    ctx = get_context()
+    if ctx.world_rank == 0:
+        payload = np.frombuffer(serialization.pack(data), dtype=np.uint8)
+    else:
+        payload = None
+    out = collective.broadcast(
+        payload if payload is not None else np.zeros(0, dtype=np.uint8),
+        src_rank=0, group_name=ctx.group_name)
+    return serialization.unpack(out.tobytes())
+
+
+def allreduce_gradients(grads, op: str = "mean"):
+    """Host-side gradient allreduce for DDP loops whose math runs on a
+    single local device per worker (the multi-process CPU/dev path).
+    On a pod, shard over the mesh instead — XLA's psum rides ICI."""
+    ctx = get_context()
+    import jax
+    flat, treedef = jax.tree_util.tree_flatten(grads)
+    reduced = [
+        collective.allreduce(np.asarray(leaf), op=op,
+                             group_name=ctx.group_name)
+        for leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, reduced)

@@ -1,0 +1,69 @@
+"""Train configuration objects.
+
+reference: python/ray/train/v2/api/config.py (ScalingConfig, RunConfig,
+FailureConfig, CheckpointConfig) and python/ray/air/config.py.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    # chips each worker should see (sets the TPU resource request;
+    # reference: resources={"TPU": chips_per_host} per worker,
+    # jax_trainer.py + tpu.py:283 visible-chips plumbing)
+    tpu_chips_per_worker: int = 1
+    resources_per_worker: Dict[str, float] = field(default_factory=dict)
+    # topology label for slice gang scheduling, e.g. "v5p-32"
+    topology: Optional[str] = None
+    placement_strategy: str = "SPREAD"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu:
+            res.setdefault("TPU", float(self.tpu_chips_per_worker))
+        res.setdefault("CPU", 1.0)
+        return res
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0  # worker-group rebuilds before giving up
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        name = self.name or "train_run"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+
+@dataclass
+class Result:
+    """reference: python/ray/air/result.py"""
+    metrics: Dict[str, Any]
+    checkpoint: Optional[Any]
+    path: str
+    error: Optional[Exception] = None
+    metrics_history: list = field(default_factory=list)

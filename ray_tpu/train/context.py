@@ -1,0 +1,90 @@
+"""In-train-loop API: report(), get_context(), get_checkpoint().
+
+reference: python/ray/train/v2/api/train_fn_utils.py (report,
+get_checkpoint, get_dataset_shard) and train/v2/api/context.py.
+The context is process-global inside a train worker; report() buffers
+metrics for the controller and persists checkpoints rank-coordinated
+(rank 0 registers; others just sync).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, world_size: int, world_rank: int,
+                 storage_path: str, resume_checkpoint: Optional[Checkpoint],
+                 datasets: Optional[Dict[str, Any]] = None,
+                 group_name: str = "train"):
+        self.world_size = world_size
+        self.world_rank = world_rank
+        self.storage_path = storage_path
+        self.resume_checkpoint = resume_checkpoint
+        self.datasets = datasets or {}
+        self.group_name = group_name
+        self.reported: list = []
+        self.pending_checkpoint_dirs: list = []
+        self._lock = threading.Lock()
+
+    # reference API surface
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.world_rank  # one worker per host in this runtime
+
+    def get_experiment_name(self) -> str:
+        return self.storage_path.rsplit("/", 1)[-1]
+
+
+_context: Optional[TrainContext] = None
+
+
+def set_context(ctx: Optional[TrainContext]) -> None:
+    global _context
+    _context = ctx
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError(
+            "not inside a train loop (get_context/report are only valid "
+            "inside train_loop_per_worker)")
+    return _context
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ optional checkpoint dir) from the train loop.
+
+    All ranks should call report with the same cadence; only rank 0's
+    checkpoint is registered with the manager
+    (reference: ray.train.report + sync_actor rank coordination).
+    """
+    ctx = get_context()
+    with ctx._lock:
+        ctx.reported.append((dict(metrics),
+                             checkpoint.path if checkpoint else None))
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().resume_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer
+    (reference: streaming_split per-worker iterators, data/dataset.py:1853)."""
+    ctx = get_context()
+    ds = ctx.datasets.get(name)
+    if ds is None:
+        return None
+    if hasattr(ds, "streaming_split"):
+        return ds.streaming_split(ctx.world_size)[ctx.world_rank]
+    return ds

@@ -1,0 +1,193 @@
+"""JaxTrainer: controller + worker group + failure policy.
+
+reference: python/ray/train/v2 — the controller state machine
+(_internal/execution/controller/controller.py:100, state.py:89-154:
+Initializing→Scheduling→Running→Restarting→Finished/Errored), the
+worker group (execution/worker_group/worker_group.py), the JAX backend
+(v2/jax/jax_trainer.py:19, config.py:29 jax.distributed bootstrap), and
+TPU slice reservation (TPUReservationCallback + reserve_tpu_slice,
+_private/accelerators/tpu.py:145).
+
+Workers are actors on the core runtime ("tpu" worker profile when
+use_tpu — they see the chips; the controller and plain tasks don't).
+Inside each worker the user's train_loop_per_worker runs with the
+TrainContext set, so report()/get_checkpoint()/get_dataset_shard() work,
+and a collective group "<run>/train" is pre-initialized for host-side
+allreduce/barrier (in-graph math should use the mesh instead).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.exceptions import ActorError, RayTpuError, TaskError, WorkerCrashedError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import Result, RunConfig, ScalingConfig
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+
+class _TrainWorker:
+    """Actor hosting one rank of the gang (runs in a 'tpu'-profile
+    worker process when TPU resources are requested)."""
+
+    def __init__(self, rank: int, world_size: int, storage_path: str,
+                 group_name: str, jax_env: Optional[dict] = None):
+        self.rank = rank
+        self.world_size = world_size
+        self.storage_path = storage_path
+        self.group_name = group_name
+        if jax_env:
+            # Multi-host bootstrap (reference: _setup_jax_tpu_environment)
+            from ray_tpu.parallel.mesh import initialize_distributed
+            initialize_distributed(**jax_env)
+        from ray_tpu.parallel import collective
+        collective.init_collective_group(world_size, rank, group_name)
+
+    def run(self, loop_blob: bytes, loop_config: Optional[dict],
+            resume_path: Optional[str], datasets_blob: Optional[bytes]):
+        from ray_tpu.train import context as ctx_mod
+        loop = serialization.loads(loop_blob)
+        datasets = serialization.loads(datasets_blob) if datasets_blob else {}
+        ctx = ctx_mod.TrainContext(
+            world_size=self.world_size, world_rank=self.rank,
+            storage_path=self.storage_path,
+            resume_checkpoint=Checkpoint(resume_path) if resume_path else None,
+            datasets=datasets, group_name=self.group_name)
+        ctx_mod.set_context(ctx)
+        try:
+            if loop_config is not None:
+                loop(loop_config)
+            else:
+                try:
+                    loop()
+                except TypeError:
+                    loop({})
+        finally:
+            ctx_mod.set_context(None)
+        return ctx.reported
+
+    def ping(self):
+        return self.rank
+
+
+class JaxTrainer:
+    """Gang-scheduled SPMD training driver.
+
+    The DDP/FSDP/TP modes are not wrapper classes: the train loop builds
+    a mesh (`ray_tpu.parallel.mesh`) and shards params with
+    `llama_sharding_rules`/`ShardingConfig`; XLA inserts the gradient
+    collectives (SURVEY.md §2.3 X2/X3).
+    """
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.state_history: List[str] = ["INITIALIZING"]
+
+    def _transition(self, state: str) -> None:
+        self.state_history.append(state)
+
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        storage = self.run_config.resolved_storage_path()
+        manager = CheckpointManager(
+            storage, self.run_config.checkpoint_config.num_to_keep)
+        max_failures = self.run_config.failure_config.max_failures
+        loop_blob = serialization.dumps(self.train_loop)
+        datasets_blob = (serialization.dumps(self.datasets)
+                        if self.datasets else None)
+        last_error: Optional[Exception] = None
+
+        for attempt in range(max_failures + 1):
+            self._transition("SCHEDULING" if attempt == 0 else "RESTARTING")
+            workers, pg = self._create_worker_group(storage)
+            resume = manager.latest()
+            try:
+                self._transition("RUNNING")
+                refs = [
+                    w.run.remote(loop_blob, self.train_loop_config,
+                                 resume.path if resume else None,
+                                 datasets_blob)
+                    for w in workers
+                ]
+                all_reports = ray_tpu.get(refs)
+                self._transition("FINISHED")
+                return self._build_result(all_reports, manager, storage)
+            except (ActorError, WorkerCrashedError, TaskError,
+                    RayTpuError) as e:
+                last_error = e
+            finally:
+                for w in workers:
+                    try:
+                        ray_tpu.kill(w)
+                    except Exception:
+                        pass
+                if pg is not None:
+                    remove_placement_group(pg)
+        self._transition("ERRORED")
+        final = manager.latest()
+        return Result(metrics={}, checkpoint=final, path=storage,
+                      error=last_error)
+
+    def _create_worker_group(self, storage: str):
+        scaling = self.scaling_config
+        res = scaling.worker_resources()
+        # Gang reservation: one bundle per worker (reference:
+        # reserve_tpu_slice + STRICT_SPREAD onto slice hosts). PACK
+        # fallback keeps single-node dev boxes working.
+        pg = None
+        try:
+            pg = placement_group([dict(res)] * scaling.num_workers,
+                                 strategy=scaling.placement_strategy
+                                 if scaling.num_workers > 1 else "PACK")
+        except Exception:
+            pg = None
+        group_name = f"train/{os.path.basename(storage)}/{time.time_ns()}"
+        WorkerActor = ray_tpu.remote(_TrainWorker)
+        jax_env = None
+        if scaling.num_workers > 1 and scaling.use_tpu:
+            # Multi-host JAX over DCN: rank 0's host is the coordinator
+            # (reference: jax_trainer coordinator wiring).
+            jax_env_base = {"num_processes": scaling.num_workers}
+        workers = []
+        for rank in range(scaling.num_workers):
+            opts = {"num_cpus": res.get("CPU", 1)}
+            if "TPU" in res:
+                opts["num_tpus"] = res["TPU"]
+            env = None
+            if scaling.num_workers > 1 and scaling.use_tpu:
+                env = {"num_processes": scaling.num_workers,
+                       "process_id": rank}
+            workers.append(
+                WorkerActor.options(**opts).remote(
+                    rank, scaling.num_workers, storage, group_name,
+                    jax_env=env))
+        # Fail fast if any worker can't construct.
+        ray_tpu.get([w.ping.remote() for w in workers])
+        return workers, pg
+
+    def _build_result(self, all_reports, manager: CheckpointManager,
+                      storage: str) -> Result:
+        rank0 = all_reports[0] if all_reports else []
+        checkpoint = None
+        history = []
+        for metrics, ckpt_path in rank0:
+            history.append(metrics)
+            if ckpt_path:
+                checkpoint = manager.register(ckpt_path, metrics)
+        final_metrics = history[-1] if history else {}
+        return Result(metrics=final_metrics, checkpoint=checkpoint,
+                      path=storage, metrics_history=history)

@@ -8,12 +8,19 @@ runs without TPU hardware (SURVEY.md §7 "Testing without TPUs").
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes a backend. The TPU-image
+# sitecustomize imports jax at interpreter start (before pytest), so the
+# env vars alone are too late — update the jax config directly; backends
+# are still uninitialized at conftest time.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

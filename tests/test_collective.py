@@ -1,0 +1,80 @@
+"""Out-of-band collectives between actors (X1 parity tests;
+reference model: python/ray/util/collective tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def _make_workers(n):
+    @ray_tpu.remote
+    class ColWorker:
+        def __init__(self, rank, world):
+            from ray_tpu.parallel import collective
+            collective.init_collective_group(world, rank, "testgrp")
+            self.rank = rank
+
+        def do_allreduce(self):
+            from ray_tpu.parallel import collective
+            return collective.allreduce(
+                np.full(4, self.rank + 1.0), op="sum", group_name="testgrp")
+
+        def do_allgather(self):
+            from ray_tpu.parallel import collective
+            return collective.allgather(
+                np.array([self.rank]), group_name="testgrp")
+
+        def do_broadcast(self):
+            from ray_tpu.parallel import collective
+            return collective.broadcast(
+                np.arange(3) if self.rank == 0 else np.zeros(3),
+                src_rank=0, group_name="testgrp")
+
+        def do_reducescatter(self):
+            from ray_tpu.parallel import collective
+            return collective.reducescatter(
+                np.ones((4, 2)), group_name="testgrp")
+
+        def do_barrier(self):
+            from ray_tpu.parallel import collective
+            collective.barrier(group_name="testgrp")
+            return True
+
+        def do_sendrecv(self):
+            from ray_tpu.parallel import collective
+            if self.rank == 0:
+                collective.send(np.array([42.0]), dst_rank=1,
+                                group_name="testgrp")
+                return None
+            return collective.recv(src_rank=0, group_name="testgrp")
+
+    return [ColWorker.remote(i, n) for i in range(n)]
+
+
+def test_allreduce_and_friends(ray_start_regular):
+    workers = _make_workers(2)
+    out = ray_tpu.get([w.do_allreduce.remote() for w in workers], timeout=90)
+    for arr in out:
+        np.testing.assert_array_equal(arr, np.full(4, 3.0))
+
+    gathered = ray_tpu.get([w.do_allgather.remote() for w in workers],
+                           timeout=90)
+    for parts in gathered:
+        assert [int(p[0]) for p in parts] == [0, 1]
+
+    bcast = ray_tpu.get([w.do_broadcast.remote() for w in workers],
+                        timeout=90)
+    for arr in bcast:
+        np.testing.assert_array_equal(arr, np.arange(3))
+
+    rs = ray_tpu.get([w.do_reducescatter.remote() for w in workers],
+                     timeout=90)
+    for shard in rs:
+        np.testing.assert_array_equal(shard, np.full((2, 2), 2.0))
+
+    assert all(ray_tpu.get([w.do_barrier.remote() for w in workers],
+                           timeout=90))
+
+    sr = ray_tpu.get([w.do_sendrecv.remote() for w in workers], timeout=90)
+    np.testing.assert_array_equal(sr[1], np.array([42.0]))

@@ -1,0 +1,83 @@
+"""Llama model tests: forward/loss/grad, sharded-vs-unsharded parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    llama_forward,
+    llama_init,
+    llama_loss,
+    llama_sharding_rules,
+)
+from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+from ray_tpu.parallel.sharding import shard_pytree
+
+
+def _data(cfg, batch=4, seq=32):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
+                                 cfg.vocab_size)
+    return tokens, targets
+
+
+def test_forward_shapes():
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens, _ = _data(cfg)
+    logits = llama_forward(params, tokens, cfg)
+    assert logits.shape == (4, 32, cfg.vocab_size)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_gqa_head_counts():
+    cfg = LlamaConfig.tiny(n_heads=4, n_kv_heads=1)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens, targets = _data(cfg)
+    loss = llama_loss(params, tokens, targets, cfg)
+    assert bool(jnp.isfinite(loss))
+
+
+def test_sharded_matches_unsharded():
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens, targets = _data(cfg, batch=8)
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, model=2))
+    sharded = shard_pytree(params, mesh, llama_sharding_rules("fsdp_tp"))
+    batch_sh = NamedSharding(mesh, P(("data", "fsdp")))
+    t_s = jax.device_put(tokens, batch_sh)
+    y_s = jax.device_put(targets, batch_sh)
+    loss_sharded = jax.jit(
+        lambda p, t, y: llama_loss(p, t, y, cfg))(sharded, t_s, y_s)
+    loss_ref = llama_loss(params, tokens, targets, cfg)
+    np.testing.assert_allclose(float(loss_sharded), float(loss_ref),
+                               rtol=1e-4)
+
+
+def test_grad_step_improves_loss():
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens, targets = _data(cfg)
+
+    @jax.jit
+    def step(p):
+        loss, grads = jax.value_and_grad(
+            lambda p_: llama_loss(p_, tokens, targets, cfg))(p)
+        p = jax.tree.map(lambda a, g: a - 0.1 * g, p, grads)
+        return p, loss
+
+    losses = []
+    for _ in range(5):
+        params, loss = step(params)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_param_count_formula():
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
