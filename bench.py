@@ -14,6 +14,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
@@ -54,6 +55,11 @@ def main():
         cfg = LlamaConfig.tiny()
         batch, seq, steps = 4, 64, 3
 
+    # Scale batch to the chip count and shard it over a data-axis mesh,
+    # so dividing throughput by n_chips below is honest on multi-chip
+    # hosts (an unsharded step would run on device 0 only).
+    n_chips = len(devices)
+    batch = batch * n_chips
     params = llama_init(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(3e-4, weight_decay=0.01)
     opt_state = opt.init(params)
@@ -61,6 +67,15 @@ def main():
                                 cfg.vocab_size)
     targets = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
                                  cfg.vocab_size)
+    if n_chips > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.asarray(devices), ("data",))
+        data_sharding = NamedSharding(mesh, P("data"))
+        repl = NamedSharding(mesh, P())
+        tokens = jax.device_put(tokens, data_sharding)
+        targets = jax.device_put(targets, data_sharding)
+        params = jax.device_put(params, repl)
+        opt_state = jax.device_put(opt_state, repl)
 
     @jax.jit
     def train_step(params, opt_state, tokens, targets):
@@ -85,7 +100,6 @@ def main():
 
     tokens_per_step = batch * seq
     tokens_per_sec = tokens_per_step * steps / dt
-    n_chips = len(devices)
     tokens_per_sec_per_chip = tokens_per_sec / n_chips
     flops_per_token = cfg.flops_per_token()
     mfu = tokens_per_sec_per_chip * flops_per_token / peak_flops(dev)

@@ -695,14 +695,22 @@ class DriverRuntime:
         task = self.task_manager.get_pending(task_id)
         if task is None:
             return  # already finished/failed
-        if task.node_id is None:
-            # Not dispatched anywhere yet; fail it and let the queues
-            # drop it when they encounter the dead pending entry.
+        if task.node_id is None and task.actor_id is None:
+            # Plain task not dispatched anywhere yet; fail it and let the
+            # queues drop it when they encounter the dead pending entry.
+            # Actor tasks are excluded: they are routed to the actor
+            # without mark_dispatched, so node_id is None even while the
+            # method runs — cancelling them here would fail the ref while
+            # the method still executes (only force=True interrupts).
             self.task_manager.fail(task_id, TaskCancelledError(task_id))
             self._signal_scheduler()
             return
         if force:
-            node = self.nodes.get(task.node_id)
+            node_id = task.node_id
+            if node_id is None and task.actor_id is not None:
+                info = self.actors.get(task.actor_id)
+                node_id = info.node_id if info else None
+            node = self.nodes.get(node_id)
             if node is not None:
                 with node._lock:
                     for w in node._workers.values():

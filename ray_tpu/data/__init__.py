@@ -1,0 +1,40 @@
+"""ray_tpu.data — streaming, lazy, distributed datasets over the task
+runtime, with Arrow blocks and a TPU device-feed path.
+
+reference: python/ray/data/__init__.py public surface.
+"""
+
+from ray_tpu.data.aggregate import (
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Quantile,
+    Std,
+    Sum,
+)
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "AggregateFn", "Block", "BlockAccessor", "BlockMetadata", "Count",
+    "DataContext", "DataIterator", "Dataset", "GroupedData", "Max",
+    "MaterializedDataset", "Mean", "Min", "Quantile", "Std", "Sum",
+    "from_arrow", "from_blocks", "from_items", "from_numpy", "from_pandas",
+    "range", "range_tensor", "read_csv", "read_json", "read_parquet",
+]

@@ -1,0 +1,115 @@
+"""Aggregation functions for groupby/global aggregation.
+
+reference: python/ray/data/aggregate.py (AggregateFn, Count, Sum, Min,
+Max, Mean, Std, Quantile) — here computed per reduce partition with
+pyarrow groupby under the hood.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+@dataclass
+class AggregateFn:
+    """One aggregation over a column (or rows, for Count)."""
+
+    name: str          # output column name
+    kind: str          # count | sum | min | max | mean | std | quantile
+    on: Optional[str] = None
+    q: float = 0.5     # quantile only
+
+
+def Count():
+    return AggregateFn(name="count()", kind="count")
+
+
+def Sum(on: str):
+    return AggregateFn(name=f"sum({on})", kind="sum", on=on)
+
+
+def Min(on: str):
+    return AggregateFn(name=f"min({on})", kind="min", on=on)
+
+
+def Max(on: str):
+    return AggregateFn(name=f"max({on})", kind="max", on=on)
+
+
+def Mean(on: str):
+    return AggregateFn(name=f"mean({on})", kind="mean", on=on)
+
+
+def Std(on: str, ddof: int = 1):
+    return AggregateFn(name=f"std({on})", kind="std", on=on, q=float(ddof))
+
+
+def Quantile(on: str, q: float = 0.5):
+    return AggregateFn(name=f"quantile({on})", kind="quantile", on=on, q=q)
+
+
+def _agg_values(values: np.ndarray, agg: AggregateFn):
+    if agg.kind == "count":
+        return int(len(values))
+    if len(values) == 0:
+        return None
+    if agg.kind == "sum":
+        return values.sum()
+    if agg.kind == "min":
+        return values.min()
+    if agg.kind == "max":
+        return values.max()
+    if agg.kind == "mean":
+        return float(values.mean())
+    if agg.kind == "std":
+        ddof = int(agg.q)
+        return float(values.std(ddof=ddof)) if len(values) > ddof else 0.0
+    if agg.kind == "quantile":
+        return float(np.quantile(values, agg.q))
+    raise ValueError(f"unknown aggregate kind {agg.kind!r}")
+
+
+def aggregate_block(block: Block, keys: List[str],
+                    aggs: List[AggregateFn]) -> Block:
+    """Aggregate one (hash-partitioned) block; rows grouped by `keys`."""
+    acc = BlockAccessor(block)
+    if not keys:
+        cols = {}
+        for agg in aggs:
+            vals = (acc.to_numpy([agg.on])[agg.on]
+                    if agg.on else np.empty(acc.num_rows()))
+            if agg.on is None and agg.kind == "count":
+                vals = np.empty(acc.num_rows())
+            cols[agg.name] = [_agg_values(vals, agg)]
+        return pa.table({k: pa.array(v) for k, v in cols.items()})
+
+    if acc.num_rows() == 0:
+        return pa.table({})
+
+    key_cols = [block.column(k).to_pylist() for k in keys]
+    key_tuples = list(zip(*key_cols))
+    groups = {}
+    for i, kt in enumerate(key_tuples):
+        groups.setdefault(kt, []).append(i)
+    sorted_keys = sorted(groups.keys())
+    out = {k: [] for k in keys}
+    for agg in aggs:
+        out[agg.name] = []
+    col_cache = {}
+    for agg in aggs:
+        if agg.on and agg.on not in col_cache:
+            col_cache[agg.on] = acc.to_numpy([agg.on])[agg.on]
+    for kt in sorted_keys:
+        idx = np.asarray(groups[kt], dtype=np.int64)
+        for j, k in enumerate(keys):
+            out[k].append(kt[j])
+        for agg in aggs:
+            vals = col_cache[agg.on][idx] if agg.on else np.empty(len(idx))
+            out[agg.name].append(_agg_values(vals, agg))
+    return pa.table({k: pa.array(v) for k, v in out.items()})

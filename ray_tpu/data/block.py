@@ -1,0 +1,172 @@
+"""Block layer: the unit of distributed data.
+
+A Block is a pyarrow.Table; BlockAccessor wraps one with the operations
+the planner and executor need (slice/concat/convert/size accounting).
+Capability parity with the reference's block model
+(reference: python/ray/data/block.py, _internal/arrow_block.py,
+_internal/pandas_block.py) with Arrow as the single canonical format —
+pandas/numpy are converted at the edges, which keeps zero-copy numpy
+views available for device feeding (tobatches -> jnp.asarray).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+Batch = Union[pa.Table, "pandas.DataFrame", Dict[str, np.ndarray]]
+
+
+@dataclass
+class BlockMetadata:
+    """Sidecar stats for a block (reference: data/block.py BlockMetadata)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[pa.Schema] = None
+    input_files: List[str] = field(default_factory=list)
+    exec_stats: Optional[dict] = None
+
+
+def _normalize_rows(rows: Iterable[Any]) -> List[Dict[str, Any]]:
+    out = []
+    for r in rows:
+        if isinstance(r, dict):
+            out.append(r)
+        else:
+            out.append({"item": r})
+    return out
+
+
+class BlockAccessor:
+    """Operations over one Arrow-table block."""
+
+    def __init__(self, block: Block):
+        if not isinstance(block, pa.Table):
+            raise TypeError(f"Block must be a pyarrow.Table, got {type(block)}")
+        self._table = block
+
+    # -- construction -------------------------------------------------
+    @staticmethod
+    def from_rows(rows: Iterable[Any]) -> Block:
+        rows = _normalize_rows(rows)
+        if not rows:
+            return pa.table({})
+        cols: Dict[str, list] = {k: [] for k in rows[0]}
+        for r in rows:
+            for k in cols:
+                cols[k].append(r.get(k))
+        return pa.table({k: pa.array(v) for k, v in cols.items()})
+
+    @staticmethod
+    def from_batch(batch: Batch) -> Block:
+        if isinstance(batch, pa.Table):
+            return batch
+        if isinstance(batch, dict):
+            return pa.table({
+                k: (pa.array(np.asarray(v).tolist())
+                    if np.asarray(v).ndim > 1 else pa.array(np.asarray(v)))
+                for k, v in batch.items()})
+        # pandas
+        return pa.Table.from_pandas(batch, preserve_index=False)
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+        if not blocks:
+            return pa.table({})
+        if len(blocks) == 1:
+            return blocks[0]
+        return pa.concat_tables(blocks, promote_options="default")
+
+    # -- accessors ----------------------------------------------------
+    @property
+    def table(self) -> pa.Table:
+        return self._table
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def metadata(self, **kw) -> BlockMetadata:
+        return BlockMetadata(num_rows=self.num_rows(),
+                             size_bytes=self.size_bytes(),
+                             schema=self.schema(), **kw)
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def take_rows(self, indices: np.ndarray) -> Block:
+        return self._table.take(pa.array(indices))
+
+    # -- conversion ---------------------------------------------------
+    def to_arrow(self) -> pa.Table:
+        return self._table
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        cols = columns or self._table.column_names
+        out = {}
+        for name in cols:
+            col = self._table.column(name)
+            try:
+                arr = col.to_numpy(zero_copy_only=False)
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                arr = np.asarray(col.to_pylist(), dtype=object)
+            if arr.dtype == object and arr.size and isinstance(arr[0], np.ndarray):
+                try:
+                    arr = np.stack(arr)
+                except ValueError:
+                    pass
+            out[name] = arr
+        return out
+
+    def to_batch(self, batch_format: str):
+        if batch_format in ("pyarrow", "arrow"):
+            return self._table
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("numpy", "default", None):
+            return self.to_numpy()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def iter_rows(self):
+        for i in range(self._table.num_rows):
+            yield {name: self._table.column(name)[i].as_py()
+                   for name in self._table.column_names}
+
+    def select(self, columns: List[str]) -> Block:
+        return self._table.select(columns)
+
+    def drop(self, columns: List[str]) -> Block:
+        keep = [c for c in self._table.column_names if c not in columns]
+        return self._table.select(keep)
+
+    def rename(self, mapping: Dict[str, str]) -> Block:
+        names = [mapping.get(c, c) for c in self._table.column_names]
+        return self._table.rename_columns(names)
+
+    def sort(self, key: Union[str, List[str]], descending: bool = False) -> Block:
+        keys = [key] if isinstance(key, str) else list(key)
+        order = "descending" if descending else "ascending"
+        return self._table.sort_by([(k, order) for k in keys])
+
+    def random_shuffle(self, seed: Optional[int]) -> Block:
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._table.num_rows)
+        return self._table.take(pa.array(perm))
+
+
+def batch_to_block(batch: Batch) -> Block:
+    return BlockAccessor.from_batch(batch)

@@ -1,0 +1,52 @@
+"""DataContext: per-session execution knobs.
+
+reference: python/ray/data/context.py DataContext (thread-local current
+context, copied into each Dataset at creation and shipped with tasks).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class DataContext:
+    # Block sizing (reference: data/context.py target_max_block_size).
+    target_max_block_size: int = 128 * 1024 * 1024
+    target_min_block_size: int = 1 * 1024 * 1024
+    # Streaming executor limits.
+    op_resource_budget_fraction: float = 1.0
+    max_tasks_in_flight_per_op: int = 8
+    max_blocks_in_op_output_queue: int = 32
+    # Defaults for map_batches.
+    default_batch_format: str = "numpy"
+    # Read parallelism when not specified.
+    min_parallelism: int = 8
+    # Whether the optimizer fuses adjacent map operators.
+    enable_operator_fusion: bool = True
+    # Fail or warn on exceptions inside UDFs.
+    raise_on_udf_error: bool = True
+    # Extra resources to attach to every data task.
+    task_resources: Dict[str, float] = field(default_factory=dict)
+    # Verbose progress (stdout) from the streaming executor.
+    verbose_progress: bool = False
+
+    _current = threading.local()
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        ctx = getattr(DataContext._current, "ctx", None)
+        if ctx is None:
+            ctx = DataContext()
+            DataContext._current.ctx = ctx
+        return ctx
+
+    @staticmethod
+    def _set_current(ctx: "DataContext") -> None:
+        DataContext._current.ctx = ctx
+
+    def copy(self) -> "DataContext":
+        return copy.deepcopy(self)

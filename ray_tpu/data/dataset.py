@@ -1,0 +1,368 @@
+"""The public Dataset: a lazy, distributed collection of Arrow blocks.
+
+reference: python/ray/data/dataset.py — transformations append logical
+operators (lazy); consumption plans + streams execution
+(iter_batches:5162, streaming_split:1853, materialize, take, count).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.execution import RefBundle, StreamingExecutor
+from ray_tpu.data.iterator import (
+    DataIterator,
+    _ExecutionIterator,
+    iter_batches_from_blocks,
+    make_streaming_split,
+)
+from ray_tpu.data.planner import Planner
+
+
+class Dataset:
+    def __init__(self, plan: L.LogicalPlan,
+                 context: Optional[DataContext] = None):
+        self._plan = plan
+        self._context = context or DataContext.get_current().copy()
+
+    # -- plan construction helpers -----------------------------------
+    def _with_op(self, op: L.LogicalOp) -> "Dataset":
+        return Dataset(L.LogicalPlan(op), self._context)
+
+    @property
+    def context(self) -> DataContext:
+        return self._context
+
+    # -- transformations (lazy) --------------------------------------
+    def map(self, fn: Callable[[dict], dict], **opts) -> "Dataset":
+        return self._with_op(self._map_op("map_rows", fn, **opts))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: Optional[str] = None,
+                    compute: Optional[str] = None,
+                    concurrency: Optional[int] = None,
+                    fn_args=(), fn_kwargs=None,
+                    num_cpus: Optional[float] = None,
+                    resources: Optional[Dict[str, float]] = None,
+                    **_ignored) -> "Dataset":
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = num_cpus
+        # Callable classes run in long-lived actors (reference:
+        # dataset.py map_batches compute=ActorPoolStrategy).
+        if compute is None:
+            compute = "actors" if isinstance(fn, type) else "tasks"
+        if isinstance(fn, type):
+            fn = _CallableClassWrapper(fn, fn_args, fn_kwargs or {})
+            fn_args, fn_kwargs = (), {}
+        op = L.AbstractMap(
+            "map_batches", fn, self._plan.dag, fn_args=tuple(fn_args),
+            fn_kwargs=fn_kwargs or {}, batch_size=batch_size,
+            batch_format=batch_format, compute=compute,
+            concurrency=concurrency, resources=res)
+        return self._with_op(op)
+
+    def _map_op(self, kind: str, fn, **opts) -> L.AbstractMap:
+        return L.AbstractMap(kind, fn, self._plan.dag, **opts)
+
+    def filter(self, fn: Callable[[dict], bool], **opts) -> "Dataset":
+        return self._with_op(self._map_op("filter", fn, **opts))
+
+    def flat_map(self, fn: Callable[[dict], Iterable[dict]], **opts) -> "Dataset":
+        return self._with_op(self._map_op("flat_map", fn, **opts))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(self._map_op("select", list(cols)))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(self._map_op("drop", list(cols)))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self._with_op(self._map_op("rename", dict(mapping)))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        return self._with_op(self._map_op("add_column", (name, fn)))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with_op(L.AbstractAllToAll(
+            "repartition", self._plan.dag, num_outputs=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        return self._with_op(L.AbstractAllToAll(
+            "random_shuffle", self._plan.dag, seed=seed,
+            num_outputs=num_blocks))
+
+    def sort(self, key: Union[str, List[str]],
+             descending: bool = False) -> "Dataset":
+        return self._with_op(L.AbstractAllToAll(
+            "sort", self._plan.dag, key=key, descending=descending))
+
+    def groupby(self, key: Union[str, List[str]]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(L.Limit(self._plan.dag, n))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with_op(L.Union(
+            [self._plan.dag] + [o._plan.dag for o in others]))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with_op(L.Zip(self._plan.dag, other._plan.dag))
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        rng_seed = seed if seed is not None else 0x5EED
+
+        def sample(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+            n = len(next(iter(batch.values()))) if batch else 0
+            rng = np.random.default_rng(rng_seed + n)
+            keep = rng.random(n) < fraction
+            return {k: np.asarray(v)[keep] for k, v in batch.items()}
+
+        return self.map_batches(sample, batch_format="numpy")
+
+    # -- execution ----------------------------------------------------
+    def _execute_stream(self):
+        DataContext._set_current(self._context)
+        physical = Planner(self._context).plan(self._plan)
+        executor = StreamingExecutor(physical, self._context)
+        return executor.execute()
+
+    def iter_internal_ref_bundles(self):
+        return self._execute_stream()
+
+    def materialize(self) -> "MaterializedDataset":
+        bundles = list(self._execute_stream())
+        refs = [b.block_ref for b in bundles]
+        metas = [b.metadata for b in bundles]
+        plan = L.LogicalPlan(L.InputData(refs, metas))
+        return MaterializedDataset(plan, self._context, refs, metas)
+
+    # -- consumption ---------------------------------------------------
+    def iterator(self) -> DataIterator:
+        return _ExecutionIterator(self)
+
+    def iter_rows(self):
+        return self.iterator().iter_rows()
+
+    def iter_batches(self, **kw):
+        return self.iterator().iter_batches(**kw)
+
+    def iter_torch_batches(self, **kw):
+        return self.iterator().iter_torch_batches(**kw)
+
+    def iter_device_batches(self, **kw):
+        return self.iterator().iter_device_batches(**kw)
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[DataIterator]:
+        return make_streaming_split(self, n, equal)
+
+    def split(self, n: int) -> List["MaterializedDataset"]:
+        mat = self.materialize()
+        out = []
+        for i in range(n):
+            refs = mat._refs[i::n]
+            metas = mat._metas[i::n]
+            plan = L.LogicalPlan(L.InputData(refs, metas))
+            out.append(MaterializedDataset(plan, self._context, refs, metas))
+        return out
+
+    def take(self, n: int = 20) -> List[dict]:
+        out: List[dict] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[dict]:
+        return list(self.iter_rows())
+
+    def take_batch(self, n: int = 20, batch_format: str = "numpy"):
+        for batch in self.limit(n).iter_batches(batch_size=n,
+                                                batch_format=batch_format):
+            return batch
+        return {}
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def count(self) -> int:
+        total = 0
+        for bundle in self._execute_stream():
+            total += bundle.metadata.num_rows
+        return total
+
+    def schema(self) -> Optional[pa.Schema]:
+        for bundle in self.limit(1)._execute_stream():
+            block = ray_tpu.get(bundle.block_ref)
+            return block.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self._execute_stream())
+
+    def size_bytes(self) -> int:
+        return sum(b.metadata.size_bytes for b in self._execute_stream())
+
+    # -- aggregations --------------------------------------------------
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        ds = self._with_op(L.AbstractAllToAll(
+            "aggregate", self._plan.dag, key=None, aggs=list(aggs)))
+        rows = ds.take_all()
+        return rows[0] if rows else {}
+
+    def sum(self, on: str):
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: str):
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: str):
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: str):
+        return self.aggregate(Std(on)).get(f"std({on})")
+
+    def unique(self, column: str) -> List[Any]:
+        seen = set()
+        for row in self.select_columns([column]).iter_rows():
+            seen.add(row[column])
+        return sorted(seen)
+
+    # -- output --------------------------------------------------------
+    def to_pandas(self, limit: Optional[int] = None):
+        ds = self.limit(limit) if limit else self
+        blocks = [ray_tpu.get(b.block_ref) for b in ds._execute_stream()]
+        if not blocks:
+            return pa.table({}).to_pandas()
+        return BlockAccessor.concat(blocks).to_pandas()
+
+    def to_arrow_refs(self) -> List[Any]:
+        return [b.block_ref for b in self._execute_stream()]
+
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> None:
+        from ray_tpu.data.datasource import _FileWrite
+        ds = self._with_op(L.Write(self._plan.dag, _FileWrite(path, fmt),
+                                   name=f"Write[{fmt}]"))
+        for _ in ds._execute_stream():
+            pass
+
+    def stats(self) -> str:
+        return self._plan.explain()
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan.dag!r})"
+
+
+class MaterializedDataset(Dataset):
+    """A Dataset whose blocks are already in the object store
+    (reference: data/dataset.py MaterializedDataset)."""
+
+    def __init__(self, plan, context, refs, metas):
+        super().__init__(plan, context)
+        self._refs = refs
+        self._metas = metas
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+    def count(self) -> int:
+        return sum(m.num_rows for m in self._metas)
+
+
+class _CallableClassWrapper:
+    """Instantiates a callable class once per worker process
+    (reference: map actors construct the UDF class in the actor)."""
+
+    def __init__(self, cls, args, kwargs):
+        self.cls, self.args, self.kwargs = cls, args, kwargs
+        self._instance = None
+
+    def __call__(self, batch, *a, **kw):
+        if self._instance is None:
+            self._instance = self.cls(*self.args, **self.kwargs)
+        return self._instance(batch, *a, **kw)
+
+
+class GroupedData:
+    """reference: python/ray/data/grouped_data.py"""
+
+    def __init__(self, dataset: Dataset, key):
+        self._dataset = dataset
+        self._key = key
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        return self._dataset._with_op(L.AbstractAllToAll(
+            "aggregate", self._dataset._plan.dag, key=self._key,
+            aggs=list(aggs)))
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str) -> Dataset:
+        return self.aggregate(Std(on))
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        key = self._key
+        keys = [key] if isinstance(key, str) else list(key)
+
+        def apply_groups(batch: Dict[str, np.ndarray]):
+            if not batch:
+                return batch
+            import pandas as pd
+            df = pa.table({k: pa.array(np.asarray(v))
+                           for k, v in batch.items()}).to_pandas()
+            outs = []
+            for _, group in df.groupby(keys, sort=True):
+                res = fn({c: group[c].to_numpy() for c in group.columns})
+                outs.append(res)
+            merged: Dict[str, list] = {}
+            for o in outs:
+                for k, v in o.items():
+                    merged.setdefault(k, []).extend(np.asarray(v).tolist())
+            return {k: np.asarray(v) for k, v in merged.items()}
+
+        # Repartition by key first so each group lands in one block.
+        ds = self._dataset.sort(keys)
+        return ds.map_batches(apply_groups, batch_format="numpy")

@@ -1,0 +1,123 @@
+"""Datasources and sinks: range/items/files (parquet, csv, json).
+
+reference: python/ray/data/read_api.py and datasource/ — reads become a
+list of zero-arg read tasks, one per output block, executed as tasks by
+the streaming executor (reference: datasource/datasource.py ReadTask).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.context import DataContext
+
+
+class _RangeRead:
+    def __init__(self, start: int, end: int, tensor_shape=None):
+        self.start, self.end, self.tensor_shape = start, end, tensor_shape
+
+    def __call__(self):
+        ids = np.arange(self.start, self.end, dtype=np.int64)
+        if self.tensor_shape is None:
+            return pa.table({"id": pa.array(ids)})
+        data = [np.full(self.tensor_shape, i, dtype=np.int64) for i in ids]
+        return pa.table({"data": pa.array([d.tolist() for d in data])})
+
+
+def make_range_read_tasks(n: int, parallelism: int,
+                          tensor_shape=None) -> List[Callable]:
+    parallelism = max(1, min(parallelism, n) if n else 1)
+    per = n // parallelism
+    rem = n % parallelism
+    tasks, start = [], 0
+    for i in range(parallelism):
+        size = per + (1 if i < rem else 0)
+        tasks.append(_RangeRead(start, start + size, tensor_shape))
+        start += size
+    return tasks
+
+
+class _FileRead:
+    def __init__(self, path: str, fmt: str, columns=None):
+        self.path, self.fmt, self.columns = path, fmt, columns
+
+    def __call__(self):
+        if self.fmt == "parquet":
+            import pyarrow.parquet as pq
+            return pq.read_table(self.path, columns=self.columns)
+        if self.fmt == "csv":
+            import pyarrow.csv as pacsv
+            t = pacsv.read_csv(self.path)
+            return t.select(self.columns) if self.columns else t
+        if self.fmt == "json":
+            import pyarrow.json as pajson
+            t = pajson.read_json(self.path)
+            return t.select(self.columns) if self.columns else t
+        raise ValueError(f"unknown format {self.fmt!r}")
+
+
+def expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def make_file_read_tasks(paths, fmt: str, columns=None) -> List[Callable]:
+    return [_FileRead(p, fmt, columns) for p in expand_paths(paths)]
+
+
+class _FileWrite:
+    """Writes one block to `<dir>/<uuid>-<i>.<ext>` (reference:
+    datasource/parquet_datasink.py naming)."""
+
+    def __init__(self, path: str, fmt: str):
+        self.path, self.fmt = path, fmt
+
+    def __call__(self, block: pa.Table) -> str:
+        import uuid
+        os.makedirs(self.path, exist_ok=True)
+        name = f"{uuid.uuid4().hex[:12]}.{self.fmt}"
+        full = os.path.join(self.path, name)
+        if self.fmt == "parquet":
+            import pyarrow.parquet as pq
+            pq.write_table(block, full)
+        elif self.fmt == "csv":
+            import pyarrow.csv as pacsv
+            pacsv.write_csv(block, full)
+        elif self.fmt == "json":
+            with open(full, "w") as f:
+                import json
+                for row in BlockAccessor(block).iter_rows():
+                    f.write(json.dumps(_jsonable(row)) + "\n")
+        else:
+            raise ValueError(f"unknown format {self.fmt!r}")
+        return full
+
+
+def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        elif isinstance(v, np.ndarray):
+            v = v.tolist()
+        out[k] = v
+    return out

@@ -1,0 +1,700 @@
+"""Physical operators + the streaming executor.
+
+reference: python/ray/data/_internal/execution/streaming_executor.py:64
+(execute:152, _scheduling_loop_step:451) and
+streaming_executor_state.py:739 (select_operator_to_run); operators under
+data/_internal/execution/operators/. Here the executor is a pull-based
+generator: blocks flow as ObjectRefs between operators, each map operator
+keeps a bounded task pool (backpressure = bounded in-flight tasks plus a
+bounded output queue), and all-to-all ops are barriers that orchestrate
+shuffle stages with num_returns=N tasks.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.transforms import MapTransform, apply_transform_chain
+
+# ---------------------------------------------------------------------------
+# Remote task bodies (module-level so they pickle by value once).
+# ---------------------------------------------------------------------------
+
+
+def _meta(block: Block) -> BlockMetadata:
+    return BlockAccessor(block).metadata()
+
+
+def _map_task(transforms: List[MapTransform], block: Block):
+    out = apply_transform_chain(block, transforms)
+    return out, _meta(out)
+
+
+def _read_task(read_fn: Callable[[], Any]):
+    result = read_fn()
+    blocks: List[Block] = []
+    if isinstance(result, pa.Table):
+        blocks = [result]
+    else:
+        blocks = [b if isinstance(b, pa.Table) else BlockAccessor.from_batch(b)
+                  for b in result]
+    out = BlockAccessor.concat(blocks) if blocks else pa.table({})
+    return out, _meta(out)
+
+
+def _slice_task(block: Block, start: int, end: int):
+    out = BlockAccessor(block).slice(start, end)
+    return out, _meta(out)
+
+
+def _concat_task(*blocks: Block):
+    out = BlockAccessor.concat(list(blocks))
+    return out, _meta(out)
+
+
+def _shuffle_map_task(block: Block, n: int, seed):
+    if n == 1:
+        return block
+    rng = np.random.default_rng(seed)
+    assign = rng.integers(0, n, size=block.num_rows)
+    return tuple(BlockAccessor(block).take_rows(np.nonzero(assign == i)[0])
+                 for i in range(n))
+
+
+def _shuffle_reduce_task(seed, *shards: Block):
+    out = BlockAccessor.concat(list(shards))
+    out = BlockAccessor(out).random_shuffle(seed)
+    return out, _meta(out)
+
+
+def _sort_sample_task(block: Block, keys: List[str]):
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return []
+    idx = np.linspace(0, n - 1, num=min(n, 64)).astype(np.int64)
+    sampled = acc.take_rows(idx)
+    cols = [sampled.column(k).to_pylist() for k in keys]
+    return list(zip(*cols))
+
+
+def _sort_partition_task(block: Block, keys: List[str], boundaries,
+                         descending: bool, n: int):
+    acc = BlockAccessor(block)
+    sorted_block = acc.sort(keys, descending)
+    if n == 1:
+        return sorted_block
+    cols = [sorted_block.column(k).to_pylist() for k in keys]
+    key_tuples = list(zip(*cols))
+    import bisect
+    # Partition assignment is always on the ascending boundaries; a
+    # descending sort just emits partitions in reverse order.
+    parts: List[List[int]] = [[] for _ in range(n)]
+    for i, kt in enumerate(key_tuples):
+        j = bisect.bisect_right(boundaries, kt)
+        parts[min(j, n - 1)].append(i)
+    sacc = BlockAccessor(sorted_block)
+    return tuple(sacc.take_rows(np.asarray(p, dtype=np.int64))
+                 for p in parts)
+
+
+def _merge_sorted_task(keys: List[str], descending: bool, *parts: Block):
+    out = BlockAccessor.concat(list(parts))
+    if out.num_rows:
+        out = BlockAccessor(out).sort(keys, descending)
+    return out, _meta(out)
+
+
+def _groupby_map_task(block: Block, keys: List[str], n: int):
+    if n == 1:
+        return block
+    if not keys:  # global aggregation: everything to partition 0
+        return (block,) + tuple(block.schema.empty_table()
+                                for _ in range(n - 1))
+    import zlib
+    cols = [block.column(k).to_pylist() for k in keys]
+    # Stable cross-process hash: Python's hash() is salted per process,
+    # which would scatter one key over several partitions.
+    hashes = np.asarray([zlib.crc32(repr(t).encode()) % n
+                         for t in zip(*cols)], dtype=np.int64)
+    acc = BlockAccessor(block)
+    return tuple(acc.take_rows(np.nonzero(hashes == i)[0])
+                 for i in range(n))
+
+
+def _groupby_reduce_task(keys: List[str], aggs, *parts: Block):
+    from ray_tpu.data.aggregate import aggregate_block
+    merged = BlockAccessor.concat(list(parts))
+    out = aggregate_block(merged, keys, aggs)
+    return out, _meta(out)
+
+
+def _zip_task(left: Block, right: Block):
+    cols = {name: left.column(name) for name in left.column_names}
+    for name in right.column_names:
+        out_name = name if name not in cols else name + "_1"
+        cols[out_name] = right.column(name)
+    out = pa.table(cols)
+    return out, _meta(out)
+
+
+def _write_task(write_fn: Callable[[Block], Any], block: Block):
+    result = write_fn(block)
+    out = pa.table({"write_result": pa.array([result], type=pa.string())
+                    if isinstance(result, str) else pa.array([1])})
+    return out, _meta(out)
+
+
+# ---------------------------------------------------------------------------
+# Bundles and operator state
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RefBundle:
+    """One block ref + its metadata (reference:
+    data/_internal/execution/interfaces/ref_bundle.py).
+
+    `order` is the bundle's position in its producing op's output
+    sequence; maps preserve it, barriers sort by it, and the sink yields
+    in order — deterministic output without sacrificing out-of-order
+    task completion."""
+
+    block_ref: Any
+    metadata: BlockMetadata
+    order: int = 0
+
+
+class PhysicalOp:
+    def __init__(self, name: str, inputs: List["PhysicalOp"]):
+        self.name = name
+        self.inputs = inputs
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class InputDataOp(PhysicalOp):
+    def __init__(self, bundles: List[RefBundle]):
+        super().__init__("InputData", [])
+        self.bundles = bundles
+
+
+class ReadPhysicalOp(PhysicalOp):
+    def __init__(self, read_tasks: List[Callable], name: str = "Read"):
+        super().__init__(name, [])
+        self.read_tasks = read_tasks
+
+
+class MapPhysicalOp(PhysicalOp):
+    def __init__(self, transforms: List[MapTransform], input_op: PhysicalOp,
+                 *, compute: str = "tasks", concurrency: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 name: str = "Map"):
+        super().__init__(name, [input_op])
+        self.transforms = transforms
+        self.compute = compute
+        self.concurrency = concurrency
+        self.resources = resources or {}
+
+
+class AllToAllPhysicalOp(PhysicalOp):
+    def __init__(self, kind: str, input_op: PhysicalOp, *,
+                 num_outputs: Optional[int] = None, key=None,
+                 descending: bool = False, seed=None, aggs=None,
+                 name: Optional[str] = None):
+        super().__init__(name or kind, [input_op])
+        self.kind = kind
+        self.num_outputs = num_outputs
+        self.key = key
+        self.descending = descending
+        self.seed = seed
+        self.aggs = aggs or []
+
+
+class LimitPhysicalOp(PhysicalOp):
+    def __init__(self, input_op: PhysicalOp, limit: int):
+        super().__init__(f"Limit[{limit}]", [input_op])
+        self.limit = limit
+
+
+class UnionPhysicalOp(PhysicalOp):
+    def __init__(self, inputs: List[PhysicalOp]):
+        super().__init__("Union", inputs)
+
+
+class ZipPhysicalOp(PhysicalOp):
+    def __init__(self, left: PhysicalOp, right: PhysicalOp):
+        super().__init__("Zip", [left, right])
+
+
+class WritePhysicalOp(PhysicalOp):
+    def __init__(self, write_fn: Callable, input_op: PhysicalOp,
+                 name: str = "Write"):
+        super().__init__(name, [input_op])
+        self.write_fn = write_fn
+
+
+# ---------------------------------------------------------------------------
+# Actor pool for compute="actors" map operators
+# ---------------------------------------------------------------------------
+
+
+class _MapWorker:
+    """Long-lived worker for actor-based map_batches
+    (reference: data/_internal/execution/operators/actor_pool_map_operator.py).
+    """
+
+    def ready(self):
+        return "ok"
+
+    def map(self, transforms, block):
+        return _map_task(transforms, block)
+
+
+class _ActorPool:
+    def __init__(self, size: int, resources: Dict[str, float]):
+        actor_cls = ray_tpu.remote(
+            num_cpus=resources.get("CPU", 1), resources={
+                k: v for k, v in resources.items() if k != "CPU"} or None,
+        )(_MapWorker)
+        self.actors = [actor_cls.remote() for _ in range(size)]
+        self.load = {i: 0 for i in range(size)}
+
+    def pick(self) -> Tuple[int, Any]:
+        i = min(self.load, key=lambda k: self.load[k])
+        self.load[i] += 1
+        return i, self.actors[i]
+
+    def release(self, i: int):
+        self.load[i] -= 1
+
+    def shutdown(self):
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+class _OpState:
+    def __init__(self, op: PhysicalOp, ctx: DataContext):
+        self.op = op
+        self.inqueues: List[deque] = [deque() for _ in op.inputs]
+        self.outqueue: deque = deque()
+        self.inputs_done: List[bool] = [False] * len(op.inputs)
+        self.started = False
+        self.finished = False
+        self.in_flight = 0
+        self.rows_emitted = 0  # for Limit
+        self.pending_reads: deque = deque()
+        self.actor_pool: Optional[_ActorPool] = None
+        self.ctx = ctx
+        self.emit_counter = 0  # fresh order indices (Union)
+        if isinstance(op, ReadPhysicalOp):
+            self.pending_reads.extend(enumerate(op.read_tasks))
+
+    def all_inputs_done(self) -> bool:
+        return all(self.inputs_done) and all(not q for q in self.inqueues)
+
+    def has_input(self) -> bool:
+        if isinstance(self.op, ReadPhysicalOp):
+            return bool(self.pending_reads)
+        return any(q for q in self.inqueues)
+
+    def under_limits(self) -> bool:
+        return (self.in_flight < self.ctx.max_tasks_in_flight_per_op
+                and len(self.outqueue) < self.ctx.max_blocks_in_op_output_queue)
+
+
+class StreamingExecutor:
+    """Executes a physical DAG, yielding output RefBundles as they become
+    available. Pull-based: work only advances while the consumer iterates,
+    and bounded queues give memory backpressure."""
+
+    def __init__(self, dag: PhysicalOp, ctx: Optional[DataContext] = None):
+        self.dag = dag
+        self.ctx = ctx or DataContext.get_current()
+        self.states: Dict[int, _OpState] = {}
+        self.topo: List[PhysicalOp] = []
+        self._build(dag)
+        # pending task ref -> completion callback info
+        self.pending: Dict[Any, Tuple] = {}
+
+    def _build(self, op: PhysicalOp):
+        if id(op) in self.states:
+            return
+        for inp in op.inputs:
+            self._build(inp)
+        self.states[id(op)] = _OpState(op, self.ctx)
+        self.topo.append(op)
+
+    # -- dispatch helpers --------------------------------------------
+    def _remote_map(self, op: MapPhysicalOp):
+        opts = {"num_returns": 2}
+        if op.resources:
+            cpus = op.resources.get("CPU")
+            if cpus is not None:
+                opts["num_cpus"] = cpus
+            rest = {k: v for k, v in op.resources.items() if k != "CPU"}
+            if rest:
+                opts["resources"] = rest
+        return ray_tpu.remote(**opts)(_map_task)
+
+    def _dispatch(self, op: PhysicalOp, st: _OpState):
+        if isinstance(op, ReadPhysicalOp):
+            order, read_fn = st.pending_reads.popleft()
+            b_ref, m_ref = ray_tpu.remote(num_returns=2)(_read_task).remote(read_fn)
+            self.pending[m_ref] = (op, b_ref, None, order)
+            st.in_flight += 1
+        elif isinstance(op, MapPhysicalOp):
+            bundle: RefBundle = st.inqueues[0].popleft()
+            if op.compute == "actors":
+                if st.actor_pool is None:
+                    size = op.concurrency or 2
+                    st.actor_pool = _ActorPool(size, op.resources)
+                idx, actor = st.actor_pool.pick()
+                b_ref, m_ref = actor.map.options(num_returns=2).remote(
+                    op.transforms, bundle.block_ref)
+                self.pending[m_ref] = (op, b_ref, idx, bundle.order)
+            else:
+                b_ref, m_ref = self._remote_map(op).remote(
+                    op.transforms, bundle.block_ref)
+                self.pending[m_ref] = (op, b_ref, None, bundle.order)
+            st.in_flight += 1
+        elif isinstance(op, WritePhysicalOp):
+            bundle = st.inqueues[0].popleft()
+            b_ref, m_ref = ray_tpu.remote(num_returns=2)(_write_task).remote(
+                op.write_fn, bundle.block_ref)
+            self.pending[m_ref] = (op, b_ref, None, bundle.order)
+            st.in_flight += 1
+
+    def _forward(self, op: PhysicalOp, bundle: RefBundle):
+        """Push an output bundle to every consumer's inqueue."""
+        for consumer in self.topo:
+            for i, inp in enumerate(consumer.inputs):
+                if inp is op:
+                    self.states[id(consumer)].inqueues[i].append(bundle)
+
+    def _mark_finished(self, op: PhysicalOp):
+        st = self.states[id(op)]
+        if st.finished:
+            return
+        st.finished = True
+        if st.actor_pool is not None:
+            st.actor_pool.shutdown()
+            st.actor_pool = None
+        for consumer in self.topo:
+            for i, inp in enumerate(consumer.inputs):
+                if inp is op:
+                    self.states[id(consumer)].inputs_done[i] = True
+
+    # -- barrier (all-to-all) execution ------------------------------
+    def _run_all_to_all(self, op: AllToAllPhysicalOp, st: _OpState):
+        bundles = sorted(st.inqueues[0], key=lambda b: b.order)
+        st.inqueues[0].clear()
+        refs = [b.block_ref for b in bundles]
+        metas = [b.metadata for b in bundles]
+        n_in = len(refs)
+        n_out = op.num_outputs or max(n_in, 1)
+        out: List[Tuple[Any, Any]] = []
+
+        if n_in == 0:
+            return
+
+        if op.kind == "repartition":
+            total_rows = sum(m.num_rows for m in metas)
+            rows_per = [total_rows // n_out + (1 if i < total_rows % n_out else 0)
+                        for i in range(n_out)]
+            # global row ranges -> (block, start, end) slices per output
+            slices: List[List[Any]] = [[] for _ in range(n_out)]
+            block_starts = np.cumsum([0] + [m.num_rows for m in metas])
+            out_starts = np.cumsum([0] + rows_per)
+            for i in range(n_out):
+                lo, hi = int(out_starts[i]), int(out_starts[i + 1])
+                for j in range(n_in):
+                    blo, bhi = int(block_starts[j]), int(block_starts[j + 1])
+                    s, e = max(lo, blo), min(hi, bhi)
+                    if s < e:
+                        slices[i].append(
+                            ray_tpu.remote(num_returns=2)(_slice_task).remote(
+                                refs[j], s - blo, e - blo)[0])
+            for i in range(n_out):
+                b, m = ray_tpu.remote(num_returns=2)(_concat_task).remote(
+                    *slices[i])
+                out.append((b, m))
+        elif op.kind == "random_shuffle":
+            shard_refs = []
+            for j, r in enumerate(refs):
+                seed_j = None if op.seed is None else op.seed + j
+                shards = ray_tpu.remote(num_returns=n_out)(
+                    _shuffle_map_task).remote(r, n_out, seed_j)
+                if n_out == 1:
+                    shards = [shards]
+                shard_refs.append(shards)
+            for i in range(n_out):
+                seed_i = None if op.seed is None else op.seed + 7919 * (i + 1)
+                b, m = ray_tpu.remote(num_returns=2)(
+                    _shuffle_reduce_task).remote(
+                        seed_i, *[shard_refs[j][i] for j in range(n_in)])
+                out.append((b, m))
+        elif op.kind == "sort":
+            keys = [op.key] if isinstance(op.key, str) else list(op.key)
+            samples = ray_tpu.get([
+                ray_tpu.remote()(_sort_sample_task).remote(r, keys)
+                for r in refs])
+            flat = sorted([s for part in samples for s in part])
+            if flat and n_out > 1:
+                idx = np.linspace(0, len(flat) - 1, num=n_out + 1)[1:-1]
+                boundaries = [flat[int(i)] for i in idx]
+            else:
+                boundaries = []
+            n_parts = max(len(boundaries) + 1, 1)
+            part_refs = []
+            for r in refs:
+                parts = ray_tpu.remote(num_returns=n_parts)(
+                    _sort_partition_task).remote(
+                        r, keys, boundaries, op.descending, n_parts)
+                if n_parts == 1:
+                    parts = [parts]
+                part_refs.append(parts)
+            order = range(n_parts - 1, -1, -1) if op.descending else range(n_parts)
+            for i in order:
+                b, m = ray_tpu.remote(num_returns=2)(_merge_sorted_task).remote(
+                    keys, op.descending, *[part_refs[j][i] for j in range(n_in)])
+                out.append((b, m))
+        elif op.kind == "aggregate":
+            keys = ([op.key] if isinstance(op.key, str)
+                    else list(op.key) if op.key else [])
+            n_parts = min(n_out, max(n_in, 1)) if keys else 1
+            part_refs = []
+            for r in refs:
+                parts = ray_tpu.remote(num_returns=n_parts)(
+                    _groupby_map_task).remote(r, keys, n_parts)
+                if n_parts == 1:
+                    parts = [parts]
+                part_refs.append(parts)
+            for i in range(n_parts):
+                b, m = ray_tpu.remote(num_returns=2)(
+                    _groupby_reduce_task).remote(
+                        keys, op.aggs, *[part_refs[j][i] for j in range(n_in)])
+                out.append((b, m))
+        else:
+            raise ValueError(f"unknown all-to-all kind {op.kind!r}")
+
+        for i, (b_ref, m_ref) in enumerate(out):
+            meta = ray_tpu.get(m_ref)
+            st.outqueue.append(RefBundle(b_ref, meta, order=i))
+
+    def _run_zip(self, op: ZipPhysicalOp, st: _OpState):
+        left = sorted(st.inqueues[0], key=lambda b: b.order)
+        st.inqueues[0].clear()
+        right = sorted(st.inqueues[1], key=lambda b: b.order)
+        st.inqueues[1].clear()
+        lrows = [b.metadata.num_rows for b in left]
+        # Repartition right to match left's row layout, then zip blockwise.
+        right_refs = [b.block_ref for b in right]
+        rstarts = np.cumsum([0] + [b.metadata.num_rows for b in right])
+        lstarts = np.cumsum([0] + lrows)
+        for i in range(len(left)):
+            lo, hi = int(lstarts[i]), int(lstarts[i + 1])
+            parts = []
+            for j in range(len(right)):
+                blo, bhi = int(rstarts[j]), int(rstarts[j + 1])
+                s, e = max(lo, blo), min(hi, bhi)
+                if s < e:
+                    parts.append(ray_tpu.remote(num_returns=2)(
+                        _slice_task).remote(right_refs[j], s - blo, e - blo)[0])
+            rblock = ray_tpu.remote(num_returns=2)(_concat_task).remote(*parts)[0]
+            b, m = ray_tpu.remote(num_returns=2)(_zip_task).remote(
+                left[i].block_ref, rblock)
+            st.outqueue.append(RefBundle(b, ray_tpu.get(m), order=i))
+
+    # -- main loop ----------------------------------------------------
+    def execute(self):
+        """Generator of output RefBundles from the DAG's sink op."""
+        sink = self.dag
+        sink_state = self.states[id(sink)]
+        # Seed InputData ops.
+        for op in self.topo:
+            st = self.states[id(op)]
+            if isinstance(op, InputDataOp):
+                for i, b in enumerate(op.bundles):
+                    b = RefBundle(b.block_ref, b.metadata, order=i)
+                    if op is sink:
+                        st.outqueue.append(b)
+                    else:
+                        self._forward(op, b)
+                self._mark_finished(op)
+
+        # In-order yield: hold back bundles until their predecessor
+        # (by order index) has been emitted; flush sorted on finish.
+        hold: Dict[int, RefBundle] = {}
+        next_expected = 0
+
+        def drain_sink():
+            nonlocal next_expected
+            while sink_state.outqueue:
+                b = sink_state.outqueue.popleft()
+                hold[b.order] = b
+            while next_expected in hold:
+                yield hold.pop(next_expected)
+                next_expected += 1
+
+        while True:
+            yield from drain_sink()
+            if sink_state.finished and not sink_state.outqueue \
+                    and sink_state.in_flight == 0:
+                for k in sorted(hold):
+                    yield hold.pop(k)
+                return
+
+            progressed = self._step()
+            if not progressed and not self.pending:
+                # Nothing in flight and nothing dispatched: check finish.
+                if sink_state.outqueue:
+                    continue
+                if sink_state.finished:
+                    return
+                # All upstream finished but sink not marked: finish ops
+                # whose inputs are exhausted.
+                stuck = True
+                for op in self.topo:
+                    st = self.states[id(op)]
+                    if (not st.finished and st.all_inputs_done()
+                            and st.in_flight == 0 and not st.has_input()):
+                        self._mark_finished(op)
+                        stuck = False
+                if stuck:
+                    raise RuntimeError(
+                        "streaming executor deadlock: no progress possible")
+
+    def _step(self) -> bool:
+        progressed = False
+        # 1. Completions.
+        if self.pending:
+            ready, _ = ray_tpu.wait(list(self.pending.keys()),
+                                    num_returns=1, timeout=0.02)
+            for m_ref in ready:
+                op, b_ref, actor_idx, order = self.pending.pop(m_ref)
+                st = self.states[id(op)]
+                st.in_flight -= 1
+                if actor_idx is not None and st.actor_pool is not None:
+                    st.actor_pool.release(actor_idx)
+                meta = ray_tpu.get(m_ref)
+                bundle = RefBundle(b_ref, meta, order=order)
+                if op is self.dag:
+                    st.outqueue.append(bundle)
+                else:
+                    self._forward(op, bundle)
+                progressed = True
+
+        # 2. Finish ops with exhausted inputs (and no in-flight work).
+        for op in self.topo:
+            st = self.states[id(op)]
+            if st.finished:
+                continue
+            if isinstance(op, ReadPhysicalOp):
+                if not st.pending_reads and st.in_flight == 0:
+                    self._mark_finished(op)
+                    progressed = True
+            elif isinstance(op, AllToAllPhysicalOp):
+                if st.all_inputs_done() and st.in_flight == 0 \
+                        and not st.outqueue and not st.finished:
+                    pass  # handled below (barrier needs the inqueue intact)
+            elif st.all_inputs_done() and st.in_flight == 0:
+                self._mark_finished(op)
+                progressed = True
+
+        # 3. Barrier ops whose inputs are complete.
+        for op in self.topo:
+            st = self.states[id(op)]
+            if st.finished:
+                continue
+            if isinstance(op, AllToAllPhysicalOp) and st.inputs_done[0] \
+                    and st.in_flight == 0:
+                self._run_all_to_all(op, st)
+                for b in list(st.outqueue) if op is not self.dag else []:
+                    self._forward(op, b)
+                if op is not self.dag:
+                    st.outqueue.clear()
+                self._mark_finished(op)
+                progressed = True
+            elif isinstance(op, ZipPhysicalOp) and all(st.inputs_done) \
+                    and st.in_flight == 0:
+                self._run_zip(op, st)
+                if op is not self.dag:
+                    for b in list(st.outqueue):
+                        self._forward(op, b)
+                    st.outqueue.clear()
+                self._mark_finished(op)
+                progressed = True
+
+        # 4. Streaming passthrough ops (Limit, Union).
+        for op in self.topo:
+            st = self.states[id(op)]
+            if st.finished:
+                continue
+            if isinstance(op, LimitPhysicalOp):
+                while st.inqueues[0] and st.rows_emitted < op.limit:
+                    # Consume strictly in order so the limit is
+                    # deterministic under out-of-order completion.
+                    want = st.emit_counter
+                    match = next((b for b in st.inqueues[0]
+                                  if b.order == want), None)
+                    if match is None:
+                        break
+                    st.inqueues[0].remove(match)
+                    st.emit_counter += 1
+                    bundle = match
+                    remaining = op.limit - st.rows_emitted
+                    if bundle.metadata.num_rows > remaining:
+                        b, m = ray_tpu.remote(num_returns=2)(
+                            _slice_task).remote(bundle.block_ref, 0, remaining)
+                        bundle = RefBundle(b, ray_tpu.get(m),
+                                           order=bundle.order)
+                    st.rows_emitted += bundle.metadata.num_rows
+                    if op is self.dag:
+                        st.outqueue.append(bundle)
+                    else:
+                        self._forward(op, bundle)
+                    progressed = True
+                if st.rows_emitted >= op.limit or st.all_inputs_done():
+                    self._mark_finished(op)
+                    progressed = True
+            elif isinstance(op, UnionPhysicalOp):
+                for q in st.inqueues:
+                    while q:
+                        bundle = q.popleft()
+                        bundle = RefBundle(bundle.block_ref, bundle.metadata,
+                                           order=st.emit_counter)
+                        st.emit_counter += 1
+                        if op is self.dag:
+                            st.outqueue.append(bundle)
+                        else:
+                            self._forward(op, bundle)
+                        progressed = True
+                if st.all_inputs_done():
+                    self._mark_finished(op)
+                    progressed = True
+
+        # 5. Dispatch new tasks, downstream ops first (drain memory).
+        for op in reversed(self.topo):
+            st = self.states[id(op)]
+            if st.finished or isinstance(
+                    op, (AllToAllPhysicalOp, ZipPhysicalOp, LimitPhysicalOp,
+                         UnionPhysicalOp, InputDataOp)):
+                continue
+            while st.has_input() and st.under_limits():
+                self._dispatch(op, st)
+                progressed = True
+        return progressed

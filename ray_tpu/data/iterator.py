@@ -1,0 +1,256 @@
+"""DataIterator: batch iteration and streaming_split.
+
+reference: python/ray/data/iterator.py:106 (iter_batches with
+batch_size/format/local shuffle, iter_torch_batches) and
+dataset.py:1853 streaming_split — n consumers fed from one execution via
+a coordinator actor (reference: _internal/execution/streaming_split
+output_splitter.py). Device feeding for TPU: `iter_device_batches`
+yields jax arrays staged host->HBM with double buffering.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+def _slice_concat(blocks: deque, batch_size: int) -> Optional[Block]:
+    """Pop exactly batch_size rows off the front of `blocks` (concat as
+    needed); returns None if fewer rows are buffered."""
+    have = sum(b.num_rows for b in blocks)
+    if have < batch_size:
+        return None
+    parts, need = [], batch_size
+    while need > 0:
+        b = blocks.popleft()
+        if b.num_rows <= need:
+            parts.append(b)
+            need -= b.num_rows
+        else:
+            parts.append(BlockAccessor(b).slice(0, need))
+            blocks.appendleft(BlockAccessor(b).slice(need, b.num_rows))
+            need = 0
+    return BlockAccessor.concat(parts)
+
+
+def iter_batches_from_blocks(block_iter, *, batch_size: Optional[int],
+                             batch_format: str = "numpy",
+                             drop_last: bool = False,
+                             local_shuffle_buffer_size: Optional[int] = None,
+                             local_shuffle_seed: Optional[int] = None):
+    """Core batching loop over an iterator of Blocks."""
+    buf: deque = deque()
+    shuffle_rows: List[Block] = []
+    rng = np.random.default_rng(local_shuffle_seed)
+
+    def emit(block: Block):
+        return BlockAccessor(block).to_batch(batch_format)
+
+    for block in block_iter:
+        if block.num_rows == 0:
+            continue
+        if local_shuffle_buffer_size:
+            shuffle_rows.append(block)
+            have = sum(b.num_rows for b in shuffle_rows)
+            if have >= local_shuffle_buffer_size:
+                merged = BlockAccessor.concat(shuffle_rows)
+                merged = BlockAccessor(merged).random_shuffle(
+                    int(rng.integers(0, 2**31)))
+                shuffle_rows = []
+                buf.append(merged)
+        else:
+            buf.append(block)
+        while True:
+            size = batch_size or (buf[0].num_rows if buf else 0)
+            if size == 0:
+                break
+            batch = _slice_concat(buf, size)
+            if batch is None:
+                break
+            yield emit(batch)
+
+    if shuffle_rows:
+        merged = BlockAccessor.concat(shuffle_rows)
+        merged = BlockAccessor(merged).random_shuffle(
+            int(rng.integers(0, 2**31)))
+        buf.append(merged)
+    # Tail.
+    while buf:
+        remaining = sum(b.num_rows for b in buf)
+        if remaining == 0:
+            break
+        size = batch_size or remaining
+        if remaining >= size:
+            yield emit(_slice_concat(buf, size))
+        else:
+            if not drop_last:
+                yield emit(_slice_concat(buf, remaining))
+            break
+
+
+class DataIterator:
+    """One consumer's view of a dataset (reference: data/iterator.py)."""
+
+    def _block_iter(self) -> Iterator[Block]:
+        raise NotImplementedError
+
+    def iter_rows(self):
+        for block in self._block_iter():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None,
+                     prefetch_batches: int = 1):
+        return iter_batches_from_blocks(
+            self._block_iter(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu", **kw):
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(np.ascontiguousarray(v))
+                if dtypes is not None:
+                    t = t.to(dtypes[k] if isinstance(dtypes, dict) else dtypes)
+                out[k] = t.to(device)
+            yield out
+
+    def iter_device_batches(self, *, batch_size: Optional[int] = 256,
+                            sharding=None, dtypes=None, drop_last: bool = True,
+                            prefetch: int = 2, **kw):
+        """Yield batches as jax.Arrays on device, with a small host-side
+        prefetch queue so host->HBM transfer overlaps compute
+        (TPU-native equivalent of iter_torch_batches+pin_memory)."""
+        import jax
+        import jax.numpy as jnp
+
+        def to_device(batch: Dict[str, np.ndarray]):
+            out = {}
+            for k, v in batch.items():
+                arr = jnp.asarray(v, dtype=dtypes.get(k) if isinstance(
+                    dtypes, dict) else dtypes)
+                if sharding is not None:
+                    arr = jax.device_put(arr, sharding)
+                out[k] = arr
+            return out
+
+        queue: deque = deque()
+        it = self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                               drop_last=drop_last, **kw)
+        for batch in it:
+            queue.append(to_device(batch))  # async dispatch
+            if len(queue) > prefetch:
+                yield queue.popleft()
+        while queue:
+            yield queue.popleft()
+
+    def materialize_blocks(self) -> List[Block]:
+        return list(self._block_iter())
+
+
+class _ExecutionIterator(DataIterator):
+    """Iterates a dataset by (re-)executing its plan each epoch."""
+
+    def __init__(self, dataset):
+        self._dataset = dataset
+
+    def _block_iter(self):
+        for bundle in self._dataset._execute_stream():
+            yield ray_tpu.get(bundle.block_ref)
+
+
+class _SplitCoordinator:
+    """Actor distributing one execution's blocks to n consumers.
+
+    reference: data/_internal/execution/operators/output_splitter.py via
+    Dataset.streaming_split: each output split pulls the next block for
+    its index; `equal=True` balances rows by splitting blocks.
+    """
+
+    def __init__(self, plan_blob: bytes, n: int, equal: bool):
+        import cloudpickle
+        self._make_stream = cloudpickle.loads(plan_blob)
+        self.n = n
+        self.equal = equal
+        self.lock = threading.Lock()
+        self.queues: List[deque] = [deque() for _ in range(n)]
+        self.stream = None
+        self.done = False
+        self.epoch = -1
+        self.rr = 0  # round-robin cursor
+
+    def start_epoch(self, epoch: int):
+        with self.lock:
+            if epoch > self.epoch:
+                self.epoch = epoch
+                self.stream = self._make_stream()
+                self.done = False
+                self.queues = [deque() for _ in range(self.n)]
+                self.rr = 0
+        return self.epoch
+
+    def _pump(self):
+        """Pull one bundle from the stream into the emptiest queue."""
+        try:
+            bundle = next(self.stream)
+        except StopIteration:
+            self.done = True
+            return False
+        i = self.rr % self.n
+        self.rr += 1
+        self.queues[i].append(bundle.block_ref)
+        return True
+
+    def get_next(self, split_idx: int):
+        """Returns a block ref, or None when the epoch is exhausted."""
+        with self.lock:
+            while not self.queues[split_idx] and not self.done:
+                self._pump()
+            if self.queues[split_idx]:
+                return self.queues[split_idx].popleft()
+            return None
+
+
+class _SplitIterator(DataIterator):
+    def __init__(self, coordinator, split_idx: int, n: int):
+        self._coord = coordinator
+        self._idx = split_idx
+        self._n = n
+        self._epoch = 0
+
+    def _block_iter(self):
+        ray_tpu.get(self._coord.start_epoch.remote(self._epoch))
+        self._epoch += 1
+        while True:
+            ref = ray_tpu.get(self._coord.get_next.remote(self._idx))
+            if ref is None:
+                return
+            yield ray_tpu.get(ref)
+
+
+def make_streaming_split(dataset, n: int, equal: bool) -> List[DataIterator]:
+    import cloudpickle
+
+    ds = dataset
+
+    def make_stream():
+        return ds._execute_stream()
+
+    blob = cloudpickle.dumps(make_stream)
+    coord_cls = ray_tpu.remote(num_cpus=0.5)(_SplitCoordinator)
+    coord = coord_cls.remote(blob, n, equal)
+    return [_SplitIterator(coord, i, n) for i in range(n)]

@@ -1,0 +1,136 @@
+"""Logical plan: a DAG of declarative operators built by the Dataset API.
+
+reference: python/ray/data/_internal/logical/operators/*.py and
+logical/interfaces.py — each Dataset op appends a LogicalOperator; the
+planner lowers the DAG to physical operators at execution time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class LogicalOp:
+    """One node of the logical DAG (single-input chain plus n-ary ops)."""
+
+    def __init__(self, name: str, inputs: List["LogicalOp"]):
+        self.name = name
+        self.inputs = inputs
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(i.name for i in self.inputs)})"
+
+
+class Read(LogicalOp):
+    """Leaf: produces blocks from a datasource's read tasks."""
+
+    def __init__(self, read_tasks: List[Callable[[], Any]],
+                 name: str = "Read"):
+        super().__init__(name, [])
+        self.read_tasks = read_tasks
+
+
+class InputData(LogicalOp):
+    """Leaf: blocks already in the object store (from_items / from_pandas)."""
+
+    def __init__(self, block_refs: List[Any], metadata: List[Any]):
+        super().__init__("InputData", [])
+        self.block_refs = block_refs
+        self.metadata = metadata
+
+
+class AbstractMap(LogicalOp):
+    """Row/batch transform; fusable with adjacent maps.
+
+    kind: one of "map_rows", "map_batches", "filter", "flat_map",
+    "select", "drop", "rename", "add_column".
+    """
+
+    def __init__(self, kind: str, fn: Any, input_op: LogicalOp, *,
+                 fn_args: Tuple = (), fn_kwargs: Optional[Dict] = None,
+                 batch_size: Optional[int] = None,
+                 batch_format: Optional[str] = None,
+                 compute: str = "tasks", concurrency: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name or kind, [input_op])
+        self.kind = kind
+        self.fn = fn
+        self.fn_args = fn_args
+        self.fn_kwargs = fn_kwargs or {}
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+        self.compute = compute
+        self.concurrency = concurrency
+        self.resources = resources or {}
+
+
+class AbstractAllToAll(LogicalOp):
+    """Barrier op over the whole stream (shuffle/sort/repartition/groupby)."""
+
+    def __init__(self, kind: str, input_op: LogicalOp, *,
+                 num_outputs: Optional[int] = None,
+                 key: Any = None, descending: bool = False,
+                 seed: Optional[int] = None,
+                 aggs: Optional[List[Any]] = None,
+                 name: Optional[str] = None):
+        super().__init__(name or kind, [input_op])
+        self.kind = kind  # repartition | random_shuffle | sort | aggregate
+        self.num_outputs = num_outputs
+        self.key = key
+        self.descending = descending
+        self.seed = seed
+        self.aggs = aggs or []
+
+
+class Limit(LogicalOp):
+    def __init__(self, input_op: LogicalOp, limit: int):
+        super().__init__(f"Limit[{limit}]", [input_op])
+        self.limit = limit
+
+
+class Union(LogicalOp):
+    def __init__(self, inputs: List[LogicalOp]):
+        super().__init__("Union", inputs)
+
+
+class Zip(LogicalOp):
+    def __init__(self, left: LogicalOp, right: LogicalOp):
+        super().__init__("Zip", [left, right])
+
+
+class Write(LogicalOp):
+    def __init__(self, input_op: LogicalOp, write_fn: Callable,
+                 name: str = "Write"):
+        super().__init__(name, [input_op])
+        self.write_fn = write_fn
+
+
+@dataclass
+class LogicalPlan:
+    dag: LogicalOp
+
+    def with_op(self, op: LogicalOp) -> "LogicalPlan":
+        return LogicalPlan(dag=op)
+
+    def ops_topological(self) -> List[LogicalOp]:
+        seen: Dict[int, LogicalOp] = {}
+        order: List[LogicalOp] = []
+
+        def visit(op: LogicalOp):
+            if id(op) in seen:
+                return
+            seen[id(op)] = op
+            for inp in op.inputs:
+                visit(inp)
+            order.append(op)
+
+        visit(self.dag)
+        return order
+
+    def explain(self) -> str:
+        lines = []
+        for i, op in enumerate(self.ops_topological()):
+            lines.append(f"{i}: {op!r}")
+        return "\n".join(lines)

@@ -1,0 +1,99 @@
+"""Planner: logical DAG -> physical DAG, with map-operator fusion.
+
+reference: python/ray/data/_internal/planner/planner.py plus the fusion
+rule in _internal/logical/rules/operator_fusion.py — adjacent map-family
+operators collapse into one MapPhysicalOp applying a fused transform
+chain in a single task.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.data import logical as L
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.execution import (
+    AllToAllPhysicalOp,
+    InputDataOp,
+    LimitPhysicalOp,
+    MapPhysicalOp,
+    PhysicalOp,
+    ReadPhysicalOp,
+    RefBundle,
+    UnionPhysicalOp,
+    WritePhysicalOp,
+    ZipPhysicalOp,
+)
+from ray_tpu.data.transforms import MapTransform
+
+
+def _to_transform(op: L.AbstractMap, ctx: DataContext) -> MapTransform:
+    return MapTransform(
+        kind=op.kind, fn=op.fn, fn_args=op.fn_args, fn_kwargs=op.fn_kwargs,
+        batch_size=op.batch_size,
+        batch_format=op.batch_format or ctx.default_batch_format)
+
+
+def _fusable(a: L.AbstractMap, b: L.AbstractMap) -> bool:
+    # Actor-compute ops only fuse with identical compute/concurrency;
+    # differing resource requests block fusion.
+    return (a.compute == b.compute and a.concurrency == b.concurrency
+            and a.resources == b.resources)
+
+
+class Planner:
+    def __init__(self, ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+
+    def plan(self, plan: L.LogicalPlan) -> PhysicalOp:
+        return self._lower(plan.dag, {})
+
+    def _lower(self, op: L.LogicalOp, memo: Dict[int, PhysicalOp]) -> PhysicalOp:
+        if id(op) in memo:
+            return memo[id(op)]
+        result = self._lower_one(op, memo)
+        memo[id(op)] = result
+        return result
+
+    def _lower_one(self, op: L.LogicalOp, memo) -> PhysicalOp:
+        if isinstance(op, L.Read):
+            return ReadPhysicalOp(op.read_tasks, name=op.name)
+        if isinstance(op, L.InputData):
+            bundles = [RefBundle(r, m)
+                       for r, m in zip(op.block_refs, op.metadata)]
+            return InputDataOp(bundles)
+        if isinstance(op, L.AbstractMap):
+            # Collect the maximal fusable chain ending at `op`.
+            chain: List[L.AbstractMap] = [op]
+            cur = op
+            while (self.ctx.enable_operator_fusion
+                   and isinstance(cur.inputs[0], L.AbstractMap)
+                   and _fusable(cur.inputs[0], cur)
+                   and id(cur.inputs[0]) not in memo):
+                cur = cur.inputs[0]
+                chain.append(cur)
+            chain.reverse()
+            upstream = self._lower(chain[0].inputs[0], memo)
+            transforms = [_to_transform(c, self.ctx) for c in chain]
+            name = "->".join(c.name for c in chain)
+            return MapPhysicalOp(
+                transforms, upstream, compute=op.compute,
+                concurrency=op.concurrency, resources=op.resources, name=name)
+        if isinstance(op, L.AbstractAllToAll):
+            upstream = self._lower(op.inputs[0], memo)
+            return AllToAllPhysicalOp(
+                op.kind, upstream, num_outputs=op.num_outputs, key=op.key,
+                descending=op.descending, seed=op.seed, aggs=op.aggs,
+                name=op.name)
+        if isinstance(op, L.Limit):
+            return LimitPhysicalOp(self._lower(op.inputs[0], memo), op.limit)
+        if isinstance(op, L.Union):
+            return UnionPhysicalOp([self._lower(i, memo) for i in op.inputs])
+        if isinstance(op, L.Zip):
+            return ZipPhysicalOp(self._lower(op.inputs[0], memo),
+                                 self._lower(op.inputs[1], memo))
+        if isinstance(op, L.Write):
+            return WritePhysicalOp(op.write_fn,
+                                   self._lower(op.inputs[0], memo),
+                                   name=op.name)
+        raise TypeError(f"cannot lower logical op {op!r}")

@@ -1,0 +1,98 @@
+"""Dataset creation API: range/from_*/read_* constructors.
+
+reference: python/ray/data/read_api.py (range:?, from_items, from_pandas,
+from_numpy, from_arrow, read_parquet, read_csv, read_json).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import make_file_read_tasks, make_range_read_tasks
+
+
+def _ds(op: L.LogicalOp) -> Dataset:
+    return Dataset(L.LogicalPlan(op))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    ctx = DataContext.get_current()
+    par = parallelism if parallelism > 0 else min(ctx.min_parallelism, max(n, 1))
+    return _ds(L.Read(make_range_read_tasks(n, par), name=f"Range[{n}]"))
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    ctx = DataContext.get_current()
+    par = parallelism if parallelism > 0 else min(ctx.min_parallelism, max(n, 1))
+    return _ds(L.Read(make_range_read_tasks(n, par, tensor_shape=tuple(shape)),
+                      name=f"RangeTensor[{n}]"))
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    ctx = DataContext.get_current()
+    par = parallelism if parallelism > 0 else min(
+        ctx.min_parallelism, max(len(items), 1))
+    par = max(1, min(par, len(items) or 1))
+    chunks = np.array_split(np.arange(len(items)), par)
+    refs, metas = [], []
+    for chunk in chunks:
+        if len(chunk) == 0:
+            continue
+        block = BlockAccessor.from_rows([items[i] for i in chunk])
+        refs.append(ray_tpu.put(block))
+        metas.append(BlockAccessor(block).metadata())
+    return _ds(L.InputData(refs, metas))
+
+
+def from_blocks(blocks: List[pa.Table]) -> Dataset:
+    refs = [ray_tpu.put(b) for b in blocks]
+    metas = [BlockAccessor(b).metadata() for b in blocks]
+    return _ds(L.InputData(refs, metas))
+
+
+def from_arrow(tables) -> Dataset:
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    return from_blocks(list(tables))
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return from_blocks([pa.Table.from_pandas(df, preserve_index=False)
+                        for df in dfs])
+
+
+def from_numpy(arrays) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    blocks = []
+    for arr in arrays:
+        if arr.ndim == 1:
+            blocks.append(pa.table({"data": pa.array(arr)}))
+        else:
+            blocks.append(pa.table({"data": pa.array(arr.tolist())}))
+    return from_blocks(blocks)
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return _ds(L.Read(make_file_read_tasks(paths, "parquet", columns),
+                      name="ReadParquet"))
+
+
+def read_csv(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return _ds(L.Read(make_file_read_tasks(paths, "csv", columns),
+                      name="ReadCSV"))
+
+
+def read_json(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    return _ds(L.Read(make_file_read_tasks(paths, "json", columns),
+                      name="ReadJSON"))

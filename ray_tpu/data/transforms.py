@@ -1,0 +1,91 @@
+"""Fused per-block transform chains, applied inside data tasks.
+
+reference: python/ray/data/_internal/planner/plan_udf_map_op.py — the
+planner fuses adjacent row/batch transforms into one chain that a single
+task applies to a block, avoiding a task hop (and an object-store round
+trip) per logical operator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockAccessor, batch_to_block
+
+
+@dataclass
+class MapTransform:
+    kind: str  # map_rows | map_batches | filter | flat_map | select | drop | rename | add_column
+    fn: Any = None
+    fn_args: Tuple = ()
+    fn_kwargs: Dict = field(default_factory=dict)
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+
+
+def _apply_batches(block: Block, t: MapTransform) -> Block:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    size = t.batch_size or max(n, 1)
+    out_blocks: List[Block] = []
+    for start in range(0, max(n, 1), size):
+        piece = acc.slice(start, min(start + size, n)) if n else block
+        batch = BlockAccessor(piece).to_batch(t.batch_format)
+        result = t.fn(batch, *t.fn_args, **t.fn_kwargs)
+        if result is None:
+            continue
+        if hasattr(result, "__next__") or (
+                hasattr(result, "__iter__")
+                and not isinstance(result, (dict, pa.Table, list))
+                and not hasattr(result, "columns")):
+            for r in result:
+                out_blocks.append(batch_to_block(r))
+        else:
+            out_blocks.append(batch_to_block(result))
+        if n == 0:
+            break
+    if not out_blocks:
+        return pa.table({})
+    return BlockAccessor.concat(out_blocks)
+
+
+def _apply_rows(block: Block, t: MapTransform) -> Block:
+    acc = BlockAccessor(block)
+    out_rows: List[dict] = []
+    for row in acc.iter_rows():
+        if t.kind == "map_rows":
+            out_rows.append(t.fn(row, *t.fn_args, **t.fn_kwargs))
+        elif t.kind == "filter":
+            if t.fn(row, *t.fn_args, **t.fn_kwargs):
+                out_rows.append(row)
+        elif t.kind == "flat_map":
+            out_rows.extend(t.fn(row, *t.fn_args, **t.fn_kwargs))
+    if not out_rows and acc.num_rows():
+        return block.schema.empty_table()
+    return BlockAccessor.from_rows(out_rows)
+
+
+def apply_transform_chain(block: Block, transforms: List[MapTransform]) -> Block:
+    for t in transforms:
+        if t.kind == "map_batches":
+            block = _apply_batches(block, t)
+        elif t.kind in ("map_rows", "filter", "flat_map"):
+            block = _apply_rows(block, t)
+        elif t.kind == "select":
+            block = BlockAccessor(block).select(t.fn)
+        elif t.kind == "drop":
+            block = BlockAccessor(block).drop(t.fn)
+        elif t.kind == "rename":
+            block = BlockAccessor(block).rename(t.fn)
+        elif t.kind == "add_column":
+            name, fn = t.fn
+            batch = BlockAccessor(block).to_numpy()
+            col = fn(batch)
+            block = block.append_column(name, pa.array(np.asarray(col)))
+        else:
+            raise ValueError(f"unknown transform kind {t.kind!r}")
+    return block

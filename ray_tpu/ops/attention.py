@@ -108,7 +108,10 @@ def _kernel_supported(q, k) -> bool:
     sk = k.shape[1]
     if jax.default_backend() not in ("tpu", "axon"):
         return False
-    if d % 128 or sq % 128 or sk % 128:
+    # sq must tile exactly by the q block actually used (min(_BLOCK_Q,
+    # sq)) — the grid floor-divides, so a 128-aligned-but-not-block-
+    # aligned tail would be left unwritten.
+    if d % 128 or sq % 128 or sk % 128 or sq % min(_BLOCK_Q, sq):
         return False
     kv_bytes = 2 * sk * d * 4
     return kv_bytes <= _VMEM_KV_BUDGET

@@ -127,8 +127,11 @@ def _exchange(group: GroupInfo, tensor: Optional[np.ndarray],
     _kv_put(f"{prefix}/ack/{group.rank}", b"1")
     if all(_kv_get(f"{prefix}/ack/{r}") is not None
            for r in range(group.world_size)):
+        # Last rank out cleans payload AND ack keys — without this the
+        # head KV leaks world_size entries per collective call.
         for rank in range(group.world_size):
             _kv_del(f"{prefix}/{rank}")
+            _kv_del(f"{prefix}/ack/{rank}")
     return out
 
 

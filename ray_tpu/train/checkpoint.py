@@ -98,6 +98,14 @@ class CheckpointManager:
         os.makedirs(storage_path, exist_ok=True)
 
     def register(self, source_dir: str, metrics: Dict[str, Any]) -> Checkpoint:
+        if os.path.abspath(source_dir).startswith(
+                os.path.abspath(self.storage_path)):
+            # Already persisted at report() time — record, don't re-copy.
+            self._checkpoints.append((os.path.abspath(source_dir), metrics))
+            if self.num_to_keep and len(self._checkpoints) > self.num_to_keep:
+                old, _ = self._checkpoints.pop(0)
+                shutil.rmtree(old, ignore_errors=True)
+            return Checkpoint(source_dir)
         self._index += 1
         dest = os.path.join(self.storage_path,
                             f"checkpoint_{self._index:06d}")

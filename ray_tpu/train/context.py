@@ -65,13 +65,30 @@ def report(metrics: Dict[str, Any],
     """Report metrics (+ optional checkpoint dir) from the train loop.
 
     All ranks should call report with the same cadence; only rank 0's
-    checkpoint is registered with the manager
+    checkpoint is persisted — and it is persisted HERE, at report time,
+    so a later crash still leaves every reported checkpoint on storage
+    for the failure-policy restart to resume from
     (reference: ray.train.report + sync_actor rank coordination).
     """
+    import json
+    import os
+    import shutil
+    import time
+
     ctx = get_context()
+    persisted = None
+    if checkpoint is not None and ctx.world_rank == 0:
+        persisted = os.path.join(ctx.storage_path,
+                                 f"checkpoint_{time.time_ns():019d}")
+        shutil.copytree(checkpoint.path, persisted, dirs_exist_ok=True)
+        try:
+            with open(os.path.join(persisted, ".metrics.json"), "w") as f:
+                json.dump({k: v for k, v in metrics.items()
+                           if isinstance(v, (int, float, str, bool))}, f)
+        except OSError:
+            pass
     with ctx._lock:
-        ctx.reported.append((dict(metrics),
-                             checkpoint.path if checkpoint else None))
+        ctx.reported.append((dict(metrics), persisted))
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

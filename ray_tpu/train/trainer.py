@@ -157,11 +157,6 @@ class JaxTrainer:
             pg = None
         group_name = f"train/{os.path.basename(storage)}/{time.time_ns()}"
         WorkerActor = ray_tpu.remote(_TrainWorker)
-        jax_env = None
-        if scaling.num_workers > 1 and scaling.use_tpu:
-            # Multi-host JAX over DCN: rank 0's host is the coordinator
-            # (reference: jax_trainer coordinator wiring).
-            jax_env_base = {"num_processes": scaling.num_workers}
         workers = []
         for rank in range(scaling.num_workers):
             opts = {"num_cpus": res.get("CPU", 1)}
