@@ -115,8 +115,50 @@ def unpack(src) -> Any:
     return pickle.loads(data, buffers=buffers)
 
 
+def _maybe_register_by_value(value: Any) -> None:
+    """Ship user-module code by value.
+
+    Workers can import installed packages but not the driver's ad-hoc
+    modules (a pytest file, a script next to the driver). The reference
+    ships such code via runtime_env working_dir (reference:
+    python/ray/_private/runtime_env/working_dir.py); the single-machine
+    equivalent is pickling user-module classes/functions by value.
+    """
+    import sys
+    import sysconfig
+
+    target = value if isinstance(value, type) or callable(value) else type(value)
+    mod_name = getattr(target, "__module__", None)
+    if not mod_name or mod_name == "__main__":
+        return  # __main__ is already by-value in cloudpickle
+    if mod_name.split(".")[0] in ("ray_tpu", "builtins"):
+        return
+    mod = sys.modules.get(mod_name)
+    mod_file = getattr(mod, "__file__", None) if mod else None
+    if not mod_file:
+        return
+    stdlib = sysconfig.get_paths()["stdlib"]
+    if (mod_file.startswith(sys.prefix) or mod_file.startswith(stdlib)
+            or "site-packages" in mod_file):
+        return
+    # Modules workers CAN import (resolvable from cwd, where workers
+    # start) stay by-reference so class identity survives the round
+    # trip; only truly driver-local modules (e.g. a pytest file on a
+    # pytest-inserted path) go by value.
+    import os
+    parts = mod_name.split(".")
+    root = os.path.join(os.getcwd(), parts[0])
+    if os.path.exists(root) or os.path.exists(root + ".py"):
+        return
+    try:
+        cloudpickle.register_pickle_by_value(mod)
+    except Exception:
+        pass
+
+
 def dumps(value: Any) -> bytes:
     """Plain cloudpickle dump (control-plane messages, function defs)."""
+    _maybe_register_by_value(value)
     return cloudpickle.dumps(value)
 
 

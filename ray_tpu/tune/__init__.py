@@ -1,0 +1,40 @@
+"""ray_tpu.tune — trial-based hyperparameter optimization.
+
+Capability parity with Ray Tune (reference: python/ray/tune/ — Tuner,
+search spaces, searchers, trial schedulers, experiment checkpointing)
+running on ray_tpu actors.
+"""
+
+from ray_tpu.tune.experiment import Trial
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import (
+    Trainable,
+    get_checkpoint,
+    report,
+    wrap_function,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
+
+__all__ = [
+    "ASHAScheduler", "BasicVariantGenerator", "FIFOScheduler",
+    "PopulationBasedTraining", "ResultGrid", "Searcher", "TPESearcher",
+    "Trainable", "TrialScheduler", "TuneConfig", "Tuner", "choice",
+    "get_checkpoint", "grid_search", "loguniform", "randint", "report",
+    "run", "sample_from", "uniform", "wrap_function",
+]

@@ -1,0 +1,98 @@
+"""Trials and experiment state.
+
+Capability parity with the reference's experiment layer (reference:
+python/ray/tune/experiment/trial.py Trial states + metadata;
+tune/execution/experiment_state.py periodic experiment checkpointing so
+``Tuner.restore`` resumes interrupted runs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Optional[Dict[str, Any]] = None
+    metrics_history: List[Dict[str, Any]] = field(default_factory=list)
+    checkpoint_path: Optional[str] = None
+    error_msg: Optional[str] = None
+    num_failures: int = 0
+    local_dir: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "trial_id": self.trial_id,
+            "config": _jsonable(self.config),
+            "status": self.status,
+            "last_result": _jsonable(self.last_result),
+            "checkpoint_path": self.checkpoint_path,
+            "error_msg": self.error_msg,
+            "num_failures": self.num_failures,
+            "local_dir": self.local_dir,
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "Trial":
+        return Trial(trial_id=d["trial_id"], config=d["config"],
+                     status=d["status"], last_result=d["last_result"],
+                     checkpoint_path=d.get("checkpoint_path"),
+                     error_msg=d.get("error_msg"),
+                     num_failures=d.get("num_failures", 0),
+                     local_dir=d.get("local_dir", ""))
+
+
+def _jsonable(obj: Any) -> Any:
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {k: _jsonable(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_jsonable(v) for v in obj]
+        return repr(obj)
+
+
+class ExperimentState:
+    """Periodic JSON snapshot of all trials for resume."""
+
+    FILENAME = "experiment_state.json"
+
+    def __init__(self, experiment_dir: str):
+        self.experiment_dir = experiment_dir
+        os.makedirs(experiment_dir, exist_ok=True)
+
+    def save(self, trials: List[Trial]) -> None:
+        payload = {"saved_at": time.time(),
+                   "trials": [t.to_json() for t in trials]}
+        tmp = os.path.join(self.experiment_dir, self.FILENAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(self.experiment_dir, self.FILENAME))
+
+    def load(self) -> Optional[List[Trial]]:
+        path = os.path.join(self.experiment_dir, self.FILENAME)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            payload = json.load(f)
+        return [Trial.from_json(d) for d in payload["trials"]]
+
+    @staticmethod
+    def exists(experiment_dir: str) -> bool:
+        return os.path.exists(os.path.join(experiment_dir,
+                                           ExperimentState.FILENAME))

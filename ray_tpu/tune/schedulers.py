@@ -1,0 +1,151 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Capability parity with the reference's scheduler layer
+(reference: python/ray/tune/schedulers/ — trial_scheduler.py decision
+protocol, async_hyperband.py ASHAScheduler rung/cutoff logic,
+pbt.py PopulationBasedTraining exploit/explore).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    PAUSE = "PAUSE"
+    STOP = "STOP"
+
+    def set_search_properties(self, metric: str, mode: str) -> None:
+        self.metric, self.mode = metric, mode
+
+    def on_trial_result(self, controller, trial, result: Dict[str, Any]) -> str:
+        return self.CONTINUE
+
+    def on_trial_complete(self, controller, trial,
+                          result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion (reference: trial_scheduler.py)."""
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving
+    (reference: python/ray/tune/schedulers/async_hyperband.py).
+
+    Rungs at grace_period * reduction_factor^k iterations; when a trial
+    reaches a rung, it continues only if its metric is within the top
+    1/reduction_factor of completed results at that rung.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100):
+        self.time_attr = time_attr
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[float, List[float]] = {}
+        self._trial_rung: Dict[str, int] = {}  # index of next rung per trial
+        milestones = []
+        t = float(grace_period)
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self._milestones = milestones
+
+    def on_trial_result(self, controller, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return self.STOP
+        metric = result.get(self.metric)
+        if metric is None:
+            return self.CONTINUE
+        value = float(metric) if self.mode == "max" else -float(metric)
+        # Record each rung once per trial, the first time t crosses it.
+        next_rung = self._trial_rung.get(trial.trial_id, 0)
+        while next_rung < len(self._milestones) \
+                and t >= self._milestones[next_rung]:
+            milestone = self._milestones[next_rung]
+            next_rung += 1
+            self._trial_rung[trial.trial_id] = next_rung
+            recorded = self._rungs.setdefault(milestone, [])
+            recorded.append(value)
+            k = max(1, int(len(recorded) / self.rf))
+            cutoff = sorted(recorded, reverse=True)[k - 1]
+            if value < cutoff:
+                return self.STOP
+        return self.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: python/ray/tune/schedulers/pbt.py).
+
+    Every perturbation_interval iterations, a trial in the bottom
+    quantile exploits (checkpoint-copies) a top-quantile trial and
+    explores by perturbing the mutated hyperparameters.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_probability = resample_probability
+        self.rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+
+    def _score(self, trial) -> Optional[float]:
+        r = trial.last_result or {}
+        if self.metric not in r:
+            return None
+        v = float(r[self.metric])
+        return v if self.mode == "max" else -v
+
+    def on_trial_result(self, controller, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, 0)
+        if t - self._last_perturb.get(trial.trial_id, 0) < self.interval:
+            return self.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        population = [tr for tr in controller.trials
+                      if self._score(tr) is not None]
+        if len(population) < 2:
+            return self.CONTINUE
+        ranked = sorted(population, key=self._score, reverse=True)
+        n_q = max(1, int(math.ceil(len(ranked) * self.quantile)))
+        top, bottom = ranked[:n_q], ranked[-n_q:]
+        if trial not in bottom or trial in top:
+            return self.CONTINUE
+        donor = self.rng.choice(top)
+        if donor is trial:
+            return self.CONTINUE
+        new_config = self._explore(dict(donor.config))
+        controller.exploit(trial, donor, new_config)
+        return self.CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+        for key, spec in self.mutations.items():
+            if key not in config:
+                continue
+            if isinstance(spec, Domain):
+                if self.rng.random() < self.resample_probability:
+                    config[key] = spec.sample(self.rng)
+                else:
+                    factor = self.rng.choice([0.8, 1.2])
+                    if isinstance(config[key], (int, float)):
+                        config[key] = type(config[key])(config[key] * factor)
+            elif isinstance(spec, list):
+                config[key] = self.rng.choice(spec)
+            elif callable(spec):
+                config[key] = spec()
+        return config

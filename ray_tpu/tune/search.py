@@ -1,0 +1,296 @@
+"""Search spaces and search algorithms.
+
+Capability parity with the reference's Tune search layer
+(reference: python/ray/tune/search/ — sample.py distributions,
+basic_variant.py BasicVariantGenerator grid/random expansion,
+searcher.py Searcher ABC). Model-based searchers in the reference
+(hyperopt/optuna/bayesopt) are external-library adapters; here a
+dependency-free TPE-style searcher (`TPESearcher`) fills that slot.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    # Seam for model-based searchers: map to/from the unit interval.
+    def to_unit(self, value: Any) -> float:
+        raise NotImplementedError
+
+    def from_unit(self, u: float) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log = float(lower), float(upper), log
+
+    def sample(self, rng: random.Random) -> float:
+        return self.from_unit(rng.random())
+
+    def to_unit(self, value: Any) -> float:
+        if self.log:
+            return (math.log(value) - math.log(self.lower)) / (
+                math.log(self.upper) - math.log(self.lower))
+        return (value - self.lower) / (self.upper - self.lower)
+
+    def from_unit(self, u: float) -> float:
+        u = min(max(u, 0.0), 1.0)
+        if self.log:
+            return math.exp(math.log(self.lower)
+                            + u * (math.log(self.upper) - math.log(self.lower)))
+        return self.lower + u * (self.upper - self.lower)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = int(lower), int(upper)  # [lower, upper)
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randrange(self.lower, self.upper)
+
+    def to_unit(self, value: Any) -> float:
+        span = max(self.upper - 1 - self.lower, 1)
+        return (value - self.lower) / span
+
+    def from_unit(self, u: float) -> int:
+        u = min(max(u, 0.0), 1.0)
+        return min(self.upper - 1,
+                   self.lower + int(u * (self.upper - self.lower)))
+
+
+class Categorical(Domain):
+    def __init__(self, categories: List[Any]):
+        if not categories:
+            raise ValueError("choice() needs at least one option")
+        self.categories = list(categories)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.categories)
+
+    def to_unit(self, value: Any) -> float:
+        idx = self.categories.index(value)
+        return (idx + 0.5) / len(self.categories)
+
+    def from_unit(self, u: float) -> Any:
+        idx = min(len(self.categories) - 1,
+                  int(min(max(u, 0.0), 1.0) * len(self.categories)))
+        return self.categories[idx]
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable[[dict], Any]):
+        self.fn = fn
+
+    def sample(self, rng: random.Random) -> Any:  # resolved late, with config
+        raise NotImplementedError("SampleFrom is resolved against the config")
+
+
+# -- public space constructors (reference: ray.tune.{uniform,choice,...}) --
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def choice(categories: List[Any]) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable[[dict], Any]) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(spec: Any) -> bool:
+    return isinstance(spec, dict) and set(spec.keys()) == {"grid_search"}
+
+
+def resolve_config(param_space: Dict[str, Any], rng: random.Random,
+                   grid_assignment: Optional[Dict[str, Any]] = None,
+                   ) -> Dict[str, Any]:
+    """Resolve one concrete config from a (possibly nested) param space."""
+    grid_assignment = grid_assignment or {}
+
+    def _resolve(space: Dict[str, Any], prefix: str) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        deferred: List[Tuple[str, SampleFrom]] = []
+        for key, spec in space.items():
+            path = f"{prefix}{key}"
+            if _is_grid(spec):
+                out[key] = grid_assignment[path]
+            elif isinstance(spec, SampleFrom):
+                deferred.append((key, spec))
+            elif isinstance(spec, Domain):
+                out[key] = spec.sample(rng)
+            elif isinstance(spec, dict):
+                out[key] = _resolve(spec, path + "/")
+            else:
+                out[key] = spec
+        for key, spec in deferred:  # after siblings, so fn sees them
+            out[key] = spec.fn(out)
+        return out
+
+    return _resolve(param_space, "")
+
+
+def grid_axes(param_space: Dict[str, Any], prefix: str = "",
+              ) -> List[Tuple[str, List[Any]]]:
+    axes: List[Tuple[str, List[Any]]] = []
+    for key, spec in param_space.items():
+        path = f"{prefix}{key}"
+        if _is_grid(spec):
+            axes.append((path, spec["grid_search"]))
+        elif isinstance(spec, dict) and not _is_grid(spec):
+            axes.extend(grid_axes(spec, path + "/"))
+    return axes
+
+
+class Searcher:
+    """ABC (reference: python/ray/tune/search/searcher.py).
+
+    suggest() returns a concrete config (or None = exhausted);
+    on_trial_complete feeds the final score back.
+    """
+
+    def set_search_properties(self, metric: str, mode: str,
+                              param_space: Dict[str, Any]) -> None:
+        self.metric, self.mode, self.param_space = metric, mode, param_space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product x num_samples random sampling
+    (reference: python/ray/tune/search/basic_variant.py)."""
+
+    def __init__(self, num_samples: int = 1, seed: Optional[int] = None):
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._queue: Optional[List[Dict[str, Any]]] = None
+
+    def _build_queue(self) -> List[Dict[str, Any]]:
+        axes = grid_axes(self.param_space)
+        combos: List[Dict[str, Any]] = [{}]
+        if axes:
+            names = [n for n, _ in axes]
+            combos = [dict(zip(names, vals)) for vals in
+                      itertools.product(*[vs for _, vs in axes])]
+        configs = []
+        for _ in range(self.num_samples):
+            for assignment in combos:
+                configs.append(resolve_config(self.param_space, self.rng,
+                                              assignment))
+        return configs
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._queue is None:
+            self._queue = self._build_queue()
+        return self._queue.pop(0) if self._queue else None
+
+    def total_trials(self) -> int:
+        if self._queue is None:
+            self._queue = self._build_queue()
+        return len(self._queue)
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator over flat Domain spaces — the
+    in-tree stand-in for the reference's external model-based searchers
+    (reference: python/ray/tune/search/{hyperopt,optuna}/). Nested dicts
+    and grid_search entries fall back to random sampling.
+
+    Candidates are scored by the density ratio l(x)/g(x) of Gaussian
+    kernel estimates fit to the good / bad halves of observed trials,
+    per-dimension in unit space.
+    """
+
+    def __init__(self, num_samples: int = 32, n_startup: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: Optional[int] = None):
+        self.num_samples = num_samples
+        self.n_startup = n_startup
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.rng = random.Random(seed)
+        self._suggested = 0
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._observed: List[Tuple[Dict[str, Any], float]] = []
+
+    def _flat_domains(self) -> Dict[str, Domain]:
+        return {k: v for k, v in self.param_space.items()
+                if isinstance(v, Domain) and not isinstance(v, SampleFrom)}
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        domains = self._flat_domains()
+        if len(self._observed) < self.n_startup or not domains:
+            cfg = resolve_config(self.param_space, self.rng,
+                                 self._random_grid_assignment())
+            self._pending[trial_id] = cfg
+            return cfg
+        ranked = sorted(self._observed, key=lambda o: o[1],
+                        reverse=(self.mode == "max"))
+        n_good = max(1, int(self.gamma * len(ranked)))
+        good, bad = ranked[:n_good], ranked[n_good:] or ranked[:1]
+
+        def density(us: List[float], u: float) -> float:
+            bw = max(0.1, 1.0 / max(len(us), 1) ** 0.5)
+            return sum(math.exp(-0.5 * ((u - x) / bw) ** 2)
+                       for x in us) / (len(us) * bw) + 1e-12
+
+        cfg = resolve_config(self.param_space, self.rng,
+                             self._random_grid_assignment())
+        for key, dom in domains.items():
+            good_us = [dom.to_unit(c[key]) for c, _ in good if key in c]
+            bad_us = [dom.to_unit(c[key]) for c, _ in bad if key in c]
+            best_u, best_score = None, -math.inf
+            for _ in range(self.n_candidates):
+                base = self.rng.choice(good_us) if good_us else self.rng.random()
+                u = min(max(base + self.rng.gauss(0, 0.15), 0.0), 1.0)
+                score = math.log(density(good_us, u)) - math.log(
+                    density(bad_us, u))
+                if score > best_score:
+                    best_u, best_score = u, score
+            cfg[key] = dom.from_unit(best_u)
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def _random_grid_assignment(self) -> Dict[str, Any]:
+        return {path: self.rng.choice(vals)
+                for path, vals in grid_axes(self.param_space)}
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or result is None or self.metric not in result:
+            return
+        self._observed.append((cfg, float(result[self.metric])))

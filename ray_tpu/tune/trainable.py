@@ -1,0 +1,183 @@
+"""Trainable API: class trainables and function trainables.
+
+Capability parity with the reference's trainable layer (reference:
+python/ray/tune/trainable/trainable.py Trainable setup/step/save/restore;
+function_trainable.py — function API running in a thread, reporting
+through a session). ``tune.report`` inside a function trainable hands
+metrics (and optionally a checkpoint) to the controller one iteration at
+a time.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class Trainable:
+    """Class API: subclass and override setup/step/save/load."""
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        self.config = dict(config or {})
+        self.iteration = 0
+        self.setup(self.config)
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[str]:
+        return None
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def reset_config(self, new_config: Dict[str, Any]) -> bool:
+        """Return True if the trainable reconfigured in place (enables
+        actor reuse for PBT; reference: trainable.py reset_config)."""
+        return False
+
+    def stop(self) -> None:
+        pass
+
+    # -- controller-facing driver methods --
+
+    def train(self) -> Dict[str, Any]:
+        result = self.step() or {}
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        return result
+
+    def save(self, checkpoint_root: str) -> Optional[str]:
+        path = os.path.join(checkpoint_root,
+                            f"checkpoint_{self.iteration:06d}")
+        os.makedirs(path, exist_ok=True)
+        self.save_checkpoint(path)
+        with open(os.path.join(path, ".tune_metadata"), "w") as f:
+            f.write(str(self.iteration))
+        return path
+
+    def restore(self, checkpoint_path: str) -> None:
+        meta = os.path.join(checkpoint_path, ".tune_metadata")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                self.iteration = int(f.read())
+        self.load_checkpoint(checkpoint_path)
+
+    def reset(self, new_config: Dict[str, Any]) -> bool:
+        if self.reset_config(new_config):
+            self.config = dict(new_config)
+            return True
+        return False
+
+
+class _FnSession:
+    """Per-process session a running trainable function reports into."""
+
+    def __init__(self, resume_checkpoint: Optional[Checkpoint]):
+        self.results: "queue.Queue" = queue.Queue()
+        self.resume_checkpoint = resume_checkpoint
+
+
+_session: Optional[_FnSession] = None
+_session_lock = threading.Lock()
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report one iteration from a function trainable
+    (reference: ray.tune.report / train_fn_utils.report)."""
+    if _session is None:
+        # Fall back to the train-loop context so one user function works
+        # under both JaxTrainer and the Tuner (reference: unified
+        # ray.train/ray.tune reporting).
+        from ray_tpu.train import context as train_ctx
+        train_ctx.report(metrics, checkpoint=checkpoint)
+        return
+    persisted = None
+    if checkpoint is not None:
+        persisted = tempfile.mkdtemp(prefix="rtpu_tune_ckpt_")
+        shutil.copytree(checkpoint.path, persisted, dirs_exist_ok=True)
+    _session.results.put(("result", dict(metrics), persisted))
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    if _session is None:
+        from ray_tpu.train import context as train_ctx
+        return train_ctx.get_checkpoint()
+    return _session.resume_checkpoint
+
+
+class FunctionTrainable(Trainable):
+    """Wraps ``def trainable(config): ... tune.report(...)`` into the
+    class API. The function runs in a daemon thread; each ``train()``
+    call hands back the next reported result."""
+
+    _fn: Callable = None  # set by wrap_function subclass
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        self._thread: Optional[threading.Thread] = None
+        self._last_checkpoint_dir: Optional[str] = None
+        self._resume: Optional[Checkpoint] = None
+        self._last_metrics: Dict[str, Any] = {}
+
+    def _start(self) -> None:
+        global _session
+        self._session = _FnSession(self._resume)
+
+        def runner():
+            global _session
+            with _session_lock:
+                _session = self._session
+            try:
+                self._fn(self.config)
+                self._session.results.put(("done", None, None))
+            except BaseException:
+                self._session.results.put(
+                    ("error", traceback.format_exc(), None))
+
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+
+    def step(self) -> Dict[str, Any]:
+        if self._thread is None:
+            self._start()
+        kind, payload, ckpt_dir = self._session.results.get()
+        if kind == "error":
+            raise RuntimeError(f"trainable function failed:\n{payload}")
+        if kind == "done":
+            return dict(self._last_metrics, done=True)
+        if ckpt_dir:
+            # Only the most recent reported checkpoint is ever consumed;
+            # drop the previous temp copy so long runs don't fill /tmp.
+            if self._last_checkpoint_dir:
+                shutil.rmtree(self._last_checkpoint_dir, ignore_errors=True)
+            self._last_checkpoint_dir = ckpt_dir
+        result = dict(payload)
+        self._last_metrics = dict(payload)
+        result.setdefault("done", False)
+        return result
+
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[str]:
+        if self._last_checkpoint_dir:
+            shutil.copytree(self._last_checkpoint_dir, checkpoint_dir,
+                            dirs_exist_ok=True)
+            return checkpoint_dir
+        return None
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        self._resume = Checkpoint(checkpoint_dir)
+
+
+def wrap_function(fn: Callable) -> type:
+    return type(f"fn_{getattr(fn, '__name__', 'trainable')}",
+                (FunctionTrainable,), {"_fn": staticmethod(fn)})

@@ -1,0 +1,252 @@
+"""The Tune controller: drives trial actors to completion.
+
+Capability parity with the reference's execution layer (reference:
+python/ray/tune/execution/tune_controller.py TuneController — trial
+lifecycle, searcher/scheduler hooks, failure retry with
+checkpoint-restore, periodic experiment snapshots). Trials are actors on
+the core runtime; each `train()` is one actor call, so many trials step
+concurrently and the controller multiplexes with `wait()`.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.tune import experiment as exp_mod
+from ray_tpu.tune.experiment import ExperimentState, Trial
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import Searcher
+
+
+class _TrialRunner:
+    """Actor hosting one trial's Trainable."""
+
+    def __init__(self, trainable_blob: bytes, config: Dict[str, Any]):
+        cls = serialization.loads(trainable_blob)
+        self.trainable = cls(config)
+
+    def train(self) -> Dict[str, Any]:
+        return self.trainable.train()
+
+    def save(self, checkpoint_root: str) -> Optional[str]:
+        return self.trainable.save(checkpoint_root)
+
+    def restore(self, path: str) -> None:
+        self.trainable.restore(path)
+
+    def reset(self, config: Dict[str, Any]) -> bool:
+        return self.trainable.reset(config)
+
+    def stop(self) -> None:
+        self.trainable.stop()
+
+
+class TuneController:
+    def __init__(self, trainable_cls: type, *,
+                 searcher: Searcher,
+                 scheduler: Optional[TrialScheduler],
+                 metric: str, mode: str,
+                 experiment_dir: str,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 max_concurrent: Optional[int] = None,
+                 stop: Union[None, Dict[str, Any], Callable] = None,
+                 max_failures: int = 0,
+                 checkpoint_freq: int = 0,
+                 restored_trials: Optional[List[Trial]] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.trainable_blob = serialization.dumps(trainable_cls)
+        self.searcher = searcher
+        self.scheduler = scheduler or FIFOScheduler()
+        self.scheduler.set_search_properties(metric, mode)
+        self.metric, self.mode = metric, mode
+        self.experiment_dir = experiment_dir
+        self.resources = dict(resources_per_trial or {"CPU": 1})
+        self.stop_criteria = stop
+        self.max_failures = max_failures
+        self.checkpoint_freq = checkpoint_freq
+        self.trials: List[Trial] = list(restored_trials or [])
+        self.state = ExperimentState(experiment_dir)
+        self._actors: Dict[str, Any] = {}
+        self._inflight: Dict[Any, Trial] = {}  # train() ref -> trial
+        if max_concurrent is None:
+            cpus = ray_tpu.cluster_resources().get("CPU", 1.0)
+            per = self.resources.get("CPU", 1.0) or 1.0
+            max_concurrent = max(1, int(cpus // per))
+        self.max_concurrent = max_concurrent
+        # A restored experiment resumes its existing trials; the searcher
+        # is not re-run (reference: Tuner.restore resumes, param_space
+        # changes require a new experiment).
+        self._exhausted = restored_trials is not None
+
+    # -- trial lifecycle --
+
+    def _next_trial(self) -> Optional[Trial]:
+        runnable = [t for t in self.trials
+                    if t.status in (exp_mod.PENDING, exp_mod.PAUSED)]
+        if runnable:
+            return runnable[0]
+        if self._exhausted:
+            return None
+        trial_id = f"trial_{len(self.trials):05d}_{uuid.uuid4().hex[:6]}"
+        config = self.searcher.suggest(trial_id)
+        if config is None:
+            self._exhausted = True
+            return None
+        trial = Trial(trial_id=trial_id, config=config,
+                      local_dir=os.path.join(self.experiment_dir, trial_id))
+        os.makedirs(trial.local_dir, exist_ok=True)
+        self.trials.append(trial)
+        return trial
+
+    def _make_actor(self, config: Dict[str, Any]):
+        Runner = ray_tpu.remote(_TrialRunner)
+        opts: Dict[str, Any] = {}
+        if "CPU" in self.resources:
+            opts["num_cpus"] = self.resources["CPU"]
+        if "TPU" in self.resources:
+            opts["num_tpus"] = self.resources["TPU"]
+        return Runner.options(**opts).remote(self.trainable_blob, config)
+
+    def _start_trial(self, trial: Trial) -> None:
+        actor = self._make_actor(trial.config)
+        if trial.checkpoint_path:
+            ray_tpu.get(actor.restore.remote(trial.checkpoint_path))
+        self._actors[trial.trial_id] = actor
+        trial.status = exp_mod.RUNNING
+        self._submit_train(trial)
+
+    def _submit_train(self, trial: Trial) -> None:
+        ref = self._actors[trial.trial_id].train.remote()
+        self._inflight[ref] = trial
+
+    def _terminate_trial(self, trial: Trial, status: str,
+                         error: Optional[str] = None) -> None:
+        trial.status = status
+        trial.error_msg = error
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                actor.stop.remote()
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+        self.scheduler.on_trial_complete(self, trial, trial.last_result)
+
+    def _should_stop(self, trial: Trial, result: Dict[str, Any]) -> bool:
+        if result.get("done"):
+            return True
+        stop = self.stop_criteria
+        if stop is None:
+            return False
+        if callable(stop):
+            return bool(stop(trial.trial_id, result))
+        return any(k in result and result[k] >= v for k, v in stop.items())
+
+    def _checkpoint_trial(self, trial: Trial) -> None:
+        path = ray_tpu.get(
+            self._actors[trial.trial_id].save.remote(trial.local_dir))
+        if path:
+            trial.checkpoint_path = path
+
+    # -- PBT hook (reference: pbt.py _exploit) --
+
+    def exploit(self, trial: Trial, donor: Trial,
+                new_config: Dict[str, Any]) -> None:
+        donor_actor = self._actors.get(donor.trial_id)
+        if donor_actor is None:
+            return
+        donor_path = ray_tpu.get(donor_actor.save.remote(donor.local_dir))
+        if donor_path:
+            donor.checkpoint_path = donor_path
+        trial.config = dict(new_config)
+        actor = self._actors[trial.trial_id]
+        reset_ok = ray_tpu.get(actor.reset.remote(new_config))
+        if not reset_ok:
+            # Replace the actor (trainable can't reconfigure in place).
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+            actor = self._make_actor(new_config)
+            self._actors[trial.trial_id] = actor
+        if donor_path:
+            ray_tpu.get(actor.restore.remote(donor_path))
+            trial.checkpoint_path = donor_path
+
+    # -- main loop --
+
+    def run(self) -> List[Trial]:
+        step = 0
+        while True:
+            self._fill()
+            if not self._inflight:
+                break
+            ready, _ = ray_tpu.wait(list(self._inflight.keys()),
+                                    num_returns=1, timeout=60.0)
+            for ref in ready:
+                trial = self._inflight.pop(ref)
+                self._process(trial, ref)
+            step += 1
+            if step % 10 == 0:
+                self.state.save(self.trials)
+        self.state.save(self.trials)
+        return self.trials
+
+    def _fill(self) -> None:
+        while len(self._inflight) < self.max_concurrent:
+            trial = self._next_trial()
+            if trial is None:
+                break
+            try:
+                self._start_trial(trial)
+            except RayTpuError as e:
+                self._terminate_trial(trial, exp_mod.ERROR, str(e))
+
+    def _process(self, trial: Trial, ref) -> None:
+        try:
+            result = ray_tpu.get(ref)
+        except RayTpuError as e:
+            trial.num_failures += 1
+            # The actor may still be alive (app-level exception) and
+            # holding its resource reservation — always kill it.
+            actor = self._actors.pop(trial.trial_id, None)
+            if actor is not None:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+            if trial.num_failures <= self.max_failures:
+                trial.status = exp_mod.PENDING  # restart from checkpoint
+                return
+            self._terminate_trial(trial, exp_mod.ERROR, str(e))
+            return
+        trial.last_result = result
+        trial.metrics_history.append(result)
+        if (self.checkpoint_freq
+                and result.get("training_iteration", 0)
+                % self.checkpoint_freq == 0):
+            self._checkpoint_trial(trial)
+        if self._should_stop(trial, result):
+            self._terminate_trial(trial, exp_mod.TERMINATED)
+            return
+        decision = self.scheduler.on_trial_result(self, trial, result)
+        if decision == TrialScheduler.STOP:
+            self._terminate_trial(trial, exp_mod.TERMINATED)
+        elif decision == TrialScheduler.PAUSE:
+            self._checkpoint_trial(trial)
+            actor = self._actors.pop(trial.trial_id, None)
+            if actor is not None:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:
+                    pass
+            trial.status = exp_mod.PAUSED
+        else:
+            self._submit_train(trial)

@@ -1,0 +1,196 @@
+"""Tuner: the public HPO entry point.
+
+Capability parity with the reference's Tuner API (reference:
+python/ray/tune/tuner.py:312 Tuner.fit; tune/tune.py run;
+tune/result_grid.py ResultGrid). Accepts class trainables, function
+trainables, and JaxTrainer instances (trainer-as-trainable, the
+reference's Tuner(trainer) pattern).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import Result, RunConfig
+from ray_tpu.tune import experiment as exp_mod
+from ray_tpu.tune.experiment import ExperimentState, Trial
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclass
+class TuneConfig:
+    """reference: python/ray/tune/tune_config.py"""
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+
+@dataclass
+class ResultGrid:
+    """reference: python/ray/tune/result_grid.py"""
+    results: List[Result] = field(default_factory=list)
+    trials: List[Trial] = field(default_factory=list)
+    experiment_path: str = ""
+
+    def __len__(self) -> int:
+        return len(self.results)
+
+    def __getitem__(self, i: int) -> Result:
+        return self.results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error_msg for t in self.trials if t.error_msg]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self.results if metric in (r.metrics or {})]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        return (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+        return pd.DataFrame([dict(r.metrics, trial_id=t.trial_id)
+                             for r, t in zip(self.results, self.trials)])
+
+
+def _as_trainable_cls(trainable: Any) -> type:
+    from ray_tpu.train.trainer import JaxTrainer
+    if isinstance(trainable, JaxTrainer):
+        return _trainer_trainable(trainable)
+    if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+        return trainable
+    if callable(trainable):
+        return wrap_function(trainable)
+    raise TypeError(f"not a trainable: {trainable!r}")
+
+
+def _trainer_trainable(trainer) -> type:
+    """Tuner(JaxTrainer) support: each trial runs trainer.fit() with the
+    trial config merged into train_loop_config (reference:
+    tuner_internal.py converting trainers to trainables)."""
+
+    def run_trainer(config: Dict[str, Any]) -> None:
+        import copy
+        from ray_tpu.tune.trainable import report
+        t = copy.copy(trainer)
+        merged = dict(trainer.train_loop_config or {})
+        merged.update(config)
+        t.train_loop_config = merged
+        result = t.fit()
+        if result.error is not None:
+            raise result.error
+        for metrics in (result.metrics_history or [result.metrics]):
+            report(metrics)
+
+    return wrap_function(run_trainer)
+
+
+class Tuner:
+    def __init__(self, trainable: Union[type, Callable, Any],
+                 *, param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 stop: Union[None, Dict[str, Any], Callable] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 max_failures: int = 0,
+                 checkpoint_freq: int = 1,
+                 _restored_trials: Optional[List[Trial]] = None):
+        self.trainable_cls = _as_trainable_cls(trainable)
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig(name="tune_run")
+        self.stop = stop
+        self.resources_per_trial = resources_per_trial
+        self.max_failures = max_failures
+        self.checkpoint_freq = checkpoint_freq
+        self._restored_trials = _restored_trials
+
+    def _experiment_dir(self) -> str:
+        base = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        name = self.run_config.name or "tune_run"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cfg = self.tune_config
+        searcher = cfg.search_alg or BasicVariantGenerator(
+            num_samples=cfg.num_samples, seed=cfg.seed)
+        searcher.set_search_properties(cfg.metric, cfg.mode,
+                                       self.param_space)
+        exp_dir = self._experiment_dir()
+        controller = TuneController(
+            self.trainable_cls, searcher=searcher, scheduler=cfg.scheduler,
+            metric=cfg.metric, mode=cfg.mode, experiment_dir=exp_dir,
+            resources_per_trial=self.resources_per_trial,
+            max_concurrent=cfg.max_concurrent_trials, stop=self.stop,
+            max_failures=self.max_failures,
+            checkpoint_freq=self.checkpoint_freq,
+            restored_trials=self._restored_trials)
+        trials = controller.run()
+        results = [
+            Result(metrics=t.last_result or {},
+                   checkpoint=(Checkpoint(t.checkpoint_path)
+                               if t.checkpoint_path else None),
+                   path=t.local_dir,
+                   error=(RuntimeError(t.error_msg) if t.error_msg else None),
+                   metrics_history=t.metrics_history)
+            for t in trials
+        ]
+        grid = ResultGrid(results=results, trials=trials,
+                          experiment_path=exp_dir)
+        grid._metric, grid._mode = cfg.metric, cfg.mode
+        return grid
+
+    @classmethod
+    def restore(cls, path: str, trainable: Union[type, Callable, Any],
+                **kwargs) -> "Tuner":
+        """Resume an interrupted experiment from its state snapshot
+        (reference: tuner.py Tuner.restore). Unfinished trials restart
+        (from their last checkpoint when one exists)."""
+        trials = ExperimentState(path).load()
+        if trials is None:
+            raise FileNotFoundError(f"no experiment state under {path}")
+        for t in trials:
+            if t.status in (exp_mod.RUNNING, exp_mod.PAUSED):
+                t.status = exp_mod.PENDING
+        run_config = kwargs.pop("run_config", None) or RunConfig(
+            name=os.path.basename(path), storage_path=os.path.dirname(path))
+        return cls(trainable, run_config=run_config,
+                   _restored_trials=trials, **kwargs)
+
+
+def run(trainable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: str = "loss", mode: str = "min",
+        stop=None, search_alg=None, scheduler=None,
+        resources_per_trial=None, **kwargs) -> ResultGrid:
+    """Functional entry point (reference: ray.tune.run)."""
+    tuner = Tuner(trainable, param_space=config or {},
+                  tune_config=TuneConfig(metric=metric, mode=mode,
+                                         num_samples=num_samples,
+                                         search_alg=search_alg,
+                                         scheduler=scheduler),
+                  stop=stop, resources_per_trial=resources_per_trial,
+                  **kwargs)
+    return tuner.fit()

@@ -7,6 +7,7 @@ runs without TPU hardware (SURVEY.md §7 "Testing without TPUs").
 """
 
 import os
+import threading
 
 # Must be set before jax initializes a backend. The TPU-image
 # sitecustomize imports jax at interpreter start (before pytest), so the
@@ -50,6 +51,33 @@ def ray_start_cluster():
     cluster = Cluster(head_node_args={"resources": {"CPU": 2}})
     yield cluster
     cluster.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _per_test_watchdog():
+    """Per-test timeout (pytest-timeout isn't in the image): SIGALRM in
+    the main thread interrupts Python-level waits, so a flaky hang in a
+    get()/wait() fails the one test instead of stalling the whole run
+    (reference: pytest.ini's 180 s default timeout)."""
+    import signal
+
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _on_alarm(signum, frame):
+        import faulthandler
+        import sys
+        faulthandler.dump_traceback(file=sys.stderr)
+        raise TimeoutError("test exceeded 150 s watchdog")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(150)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture
