@@ -209,6 +209,16 @@ class Gcs:
                 rec.node_id = node_id
             if death_cause is not None:
                 rec.death_cause = death_cause
+            if state == "DEAD" and rec.name:
+                # Release the name so it can be re-created (reference:
+                # gcs_actor_manager removes named-actor entries on death).
+                # Guarded by actor_id so a late duplicate DEAD transition
+                # can't wipe a live successor that reused the name.
+                key = (rec.namespace, rec.name)
+                if self.named_actors.get(key) == actor_id:
+                    del self.named_actors[key]
+                    self.kv.delete(rec.name.encode(),
+                                   namespace="actor_handles")
         self.pubsub.publish("actor", (state, actor_id))
 
     def get_actor(self, actor_id: ActorID) -> Optional[ActorRecord]:

@@ -1,0 +1,41 @@
+"""Serve configuration objects.
+
+Capability parity with the reference's serve config (reference:
+python/ray/serve/config.py AutoscalingConfig/HTTPOptions;
+serve/_private/config.py DeploymentConfig/ReplicaConfig).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """reference: python/ray/serve/config.py AutoscalingConfig +
+    serve/autoscaling_policy.py target-ongoing-requests policy."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 2.0
+    # smoothed over this window of replica metric reports
+    look_back_period_s: float = 2.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    user_config: Optional[Dict[str, Any]] = None
+    health_check_period_s: float = 1.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+
+@dataclass
+class HTTPOptions:
+    host: str = "127.0.0.1"
+    port: int = 8000

@@ -1,0 +1,282 @@
+"""Serve controller: declarative target-state reconciliation.
+
+Capability parity with the reference's control plane (reference:
+python/ray/serve/_private/controller.py:102 ServeController actor;
+deployment_state.py:1713,2957 DeploymentState(Manager) reconciler;
+autoscaling_state.py + serve/autoscaling_policy.py target-ongoing-
+requests autoscaling; long_poll.py:228 LongPollHost config push).
+
+Runs as an actor with a background reconcile thread; routers learn of
+replica-set changes through versioned polls (the long-poll equivalent).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _DeploymentState:
+    def __init__(self, name: str, app_name: str, callable_blob: bytes,
+                 init_args_blob: bytes, config: DeploymentConfig,
+                 route_prefix: Optional[str]):
+        self.name = name
+        self.app_name = app_name
+        self.callable_blob = callable_blob
+        self.init_args_blob = init_args_blob
+        self.config = config
+        self.route_prefix = route_prefix
+        self.replicas: Dict[str, Any] = {}  # replica_id -> actor handle
+        self.target = (config.autoscaling_config.min_replicas
+                       if config.autoscaling_config
+                       else config.num_replicas)
+        self.next_replica_no = 0
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+        self.status = "UPDATING"
+
+
+class ServeController:
+    """The singleton controller actor (named CONTROLLER_NAME)."""
+
+    def __init__(self, reconcile_interval_s: float = 0.2):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._version = 0
+        self._version_cv = threading.Condition(self._lock)
+        self._stopped = False
+        self._interval = reconcile_interval_s
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- API (called by serve.run / handles / proxy) --
+
+    def deploy_application(self, app_name: str,
+                           deployments: List[dict]) -> None:
+        """deployments: [{name, callable_blob, init_args_blob, config,
+        route_prefix}] — full target state for the app (reference:
+        application_state.py apply_deployment_args)."""
+        with self._lock:
+            keep = set()
+            for d in deployments:
+                name = d["name"]
+                keep.add(name)
+                existing = self._deployments.get(name)
+                if existing is not None:
+                    existing.callable_blob = d["callable_blob"]
+                    existing.init_args_blob = d["init_args_blob"]
+                    old_config = existing.config
+                    existing.config = d["config"]
+                    existing.route_prefix = d.get("route_prefix")
+                    if not existing.config.autoscaling_config:
+                        existing.target = d["config"].num_replicas
+                    if (d["config"].user_config is not None
+                            and d["config"].user_config
+                            != old_config.user_config):
+                        for h in existing.replicas.values():
+                            h.reconfigure.remote(d["config"].user_config)
+                    existing.status = "UPDATING"
+                else:
+                    self._deployments[name] = _DeploymentState(
+                        name, app_name, d["callable_blob"],
+                        d["init_args_blob"], d["config"],
+                        d.get("route_prefix"))
+            # drop deployments of this app that were removed
+            for name, st in list(self._deployments.items()):
+                if st.app_name == app_name and name not in keep:
+                    self._remove_deployment_locked(name)
+
+    def delete_application(self, app_name: str) -> None:
+        with self._lock:
+            for name, st in list(self._deployments.items()):
+                if st.app_name == app_name:
+                    self._remove_deployment_locked(name)
+
+    def _remove_deployment_locked(self, name: str) -> None:
+        st = self._deployments.pop(name)
+        for h in st.replicas.values():
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+        self._bump_locked()
+
+    def get_replicas(self, deployment_name: str) -> tuple:
+        """(version, [(replica_id, handle), ...]) for routers."""
+        with self._lock:
+            st = self._deployments.get(deployment_name)
+            if st is None:
+                return self._version, []
+            return self._version, list(st.replicas.items())
+
+    def poll_replicas(self, deployment_name: str, known_version: int,
+                      timeout_s: float = 2.0) -> tuple:
+        """Long-poll: return when the replica set changes past
+        known_version or timeout (reference: long_poll.py:228)."""
+        deadline = time.monotonic() + timeout_s
+        with self._version_cv:
+            while (self._version <= known_version and not self._stopped
+                   and time.monotonic() < deadline):
+                self._version_cv.wait(timeout=max(
+                    0.0, deadline - time.monotonic()))
+        return self.get_replicas(deployment_name)
+
+    def get_status(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "app": st.app_name,
+                    "status": st.status,
+                    "target_replicas": st.target,
+                    "running_replicas": len(st.replicas),
+                    "route_prefix": st.route_prefix,
+                }
+                for name, st in self._deployments.items()
+            }
+
+    def list_routes(self) -> Dict[str, str]:
+        """route_prefix -> ingress deployment name (for the proxy)."""
+        with self._lock:
+            return {st.route_prefix: name
+                    for name, st in self._deployments.items()
+                    if st.route_prefix}
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for name in list(self._deployments):
+                self._remove_deployment_locked(name)
+            self._stopped = True
+            self._version_cv.notify_all()
+
+    def ping(self) -> str:
+        return "pong"
+
+    # -- reconcile --
+
+    def _bump_locked(self) -> None:
+        self._version += 1
+        self._version_cv.notify_all()
+
+    def _reconcile_loop(self) -> None:
+        while not self._stopped:
+            try:
+                self._reconcile_once()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            time.sleep(self._interval)
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            states = list(self._deployments.values())
+        for st in states:
+            self._autoscale(st)
+            self._health_check(st)
+            self._scale_to_target(st)
+
+    def _autoscale(self, st: _DeploymentState) -> None:
+        cfg: Optional[AutoscalingConfig] = st.config.autoscaling_config
+        if cfg is None or not st.replicas:
+            return
+        totals = []
+        for rid, h in list(st.replicas.items()):
+            try:
+                m = ray_tpu.get(
+                    h.get_metrics.remote(cfg.look_back_period_s),
+                    timeout=1.0)
+                totals.append(m["avg_ongoing"])
+            except Exception:
+                pass  # health check will deal with it
+        if not totals:
+            return
+        desired = max(cfg.min_replicas,
+                      min(cfg.max_replicas,
+                          int(-(-sum(totals) // cfg.target_ongoing_requests))
+                          or cfg.min_replicas))
+        now = time.monotonic()
+        with self._lock:
+            if desired > st.target:
+                if now - st.last_scale_up >= cfg.upscale_delay_s:
+                    st.target = desired
+                    st.last_scale_up = now
+            elif desired < st.target:
+                if now - st.last_scale_down >= cfg.downscale_delay_s:
+                    st.target = desired
+                    st.last_scale_down = now
+
+    def _health_check(self, st: _DeploymentState) -> None:
+        dead = []
+        for rid, h in list(st.replicas.items()):
+            try:
+                ray_tpu.get(h.check_health.remote(), timeout=5.0)
+            except Exception:
+                dead.append(rid)
+        if dead:
+            with self._lock:
+                for rid in dead:
+                    h = st.replicas.pop(rid, None)
+                    if h is not None:
+                        try:
+                            ray_tpu.kill(h)
+                        except Exception:
+                            pass
+                self._bump_locked()
+
+    def _scale_to_target(self, st: _DeploymentState) -> None:
+        from ray_tpu.serve.replica import Replica
+        with self._lock:
+            delta = st.target - len(st.replicas)
+        if delta > 0:
+            ReplicaActor = ray_tpu.remote(Replica)
+            new = {}
+            for _ in range(delta):
+                with self._lock:
+                    rid = f"{st.name}#{st.next_replica_no}"
+                    st.next_replica_no += 1
+                opts = dict(st.config.ray_actor_options)
+                opts.setdefault("max_concurrency",
+                                max(4, min(st.config.max_ongoing_requests,
+                                           32)))
+                handle = ReplicaActor.options(**opts).remote(
+                    st.name, rid, st.callable_blob, st.init_args_blob,
+                    st.config.max_ongoing_requests,
+                    st.config.user_config)
+                new[rid] = handle
+            # wait for constructors so routers never see half-born replicas
+            for rid, h in new.items():
+                try:
+                    ray_tpu.get(h.check_health.remote(), timeout=60.0)
+                except Exception:
+                    try:
+                        ray_tpu.kill(h)
+                    except Exception:
+                        pass
+                    new.pop(rid, None)
+            with self._lock:
+                st.replicas.update(new)
+                st.status = ("HEALTHY" if len(st.replicas) >= st.target
+                             else "UPDATING")
+                self._bump_locked()
+        elif delta < 0:
+            with self._lock:
+                victims = list(st.replicas)[delta:]
+                doomed = [st.replicas.pop(rid) for rid in victims]
+                st.status = "HEALTHY"
+                self._bump_locked()
+            for h in doomed:
+                try:
+                    h.prepare_for_shutdown.remote()
+                    ray_tpu.kill(h)
+                except Exception:
+                    pass
+        else:
+            with self._lock:
+                if st.status != "HEALTHY" and len(st.replicas) >= st.target:
+                    st.status = "HEALTHY"

@@ -1,0 +1,89 @@
+"""DeploymentHandle: call deployments from Python (model composition).
+
+Capability parity with the reference's handle API (reference:
+python/ray/serve/handle.py DeploymentHandle/DeploymentResponse —
+``handle.remote()`` returns a response future; ``.options(method_name=)``
+targets methods; handles serialize so replicas can call downstream
+deployments).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.core import serialization
+from ray_tpu.serve.replica import Rejected
+from ray_tpu.serve.router import Router
+
+_routers: Dict[str, Router] = {}
+_routers_lock = threading.Lock()
+
+
+def _get_router(deployment_name: str, controller) -> Router:
+    with _routers_lock:
+        router = _routers.get(deployment_name)
+        if router is None:
+            router = Router(deployment_name, controller)
+            _routers[deployment_name] = router
+        return router
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference:
+    serve/handle.py DeploymentResponse)."""
+
+    def __init__(self, router: Router, method_name: str, args_blob: bytes,
+                 replica_id: str, ref):
+        self._router = router
+        self._method_name = method_name
+        self._args_blob = args_blob
+        self._replica_id = replica_id
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        try:
+            value = ray_tpu.get(self._ref, timeout=timeout_s)
+        except ray_tpu.exceptions.ActorError:
+            return self._router.fetch(self._method_name, self._args_blob,
+                                      timeout_s)
+        if isinstance(value, Rejected):
+            # Chosen replica was saturated — re-route with backoff.
+            return self._router.fetch(self._method_name, self._args_blob,
+                                      timeout_s)
+        return value
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self.method_name = method_name
+
+    def _controller(self):
+        return ray_tpu.get_actor(
+            __import__("ray_tpu.serve.controller",
+                       fromlist=["CONTROLLER_NAME"]).CONTROLLER_NAME)
+
+    def options(self, *, method_name: Optional[str] = None,
+                **_ignored) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                method_name or self.method_name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = _get_router(self.deployment_name, self._controller())
+        blob = serialization.dumps((args, kwargs))
+        rid, ref = router.submit(self.method_name, blob)
+        return DeploymentResponse(router, self.method_name, blob, rid, ref)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment_name, self.app_name,
+                                method_name=name)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self.app_name, self.method_name))

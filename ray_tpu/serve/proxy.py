@@ -1,0 +1,116 @@
+"""HTTP proxy: routes requests to ingress deployments.
+
+Capability parity with the reference's proxy (reference:
+python/ray/serve/_private/proxy.py:115,530,706 HTTP proxy — longest-
+prefix route matching, JSON bodies, per-request routing through the
+router). The reference runs uvicorn/ASGI proxy actors on every ingress
+node; here a threaded stdlib HTTP server runs in the driver (or any
+host) process — dependency-free and sufficient for single-host serving;
+multi-host ingress fans out by starting one proxy per node.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve.handle import DeploymentHandle
+
+
+class _ProxyState:
+    def __init__(self, controller):
+        self.controller = controller
+        self._routes: Dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def refresh(self) -> None:
+        routes = ray_tpu.get(self.controller.list_routes.remote())
+        with self._lock:
+            self._routes = dict(routes)
+
+    def match(self, path: str) -> Optional[Tuple[str, str]]:
+        """Longest-prefix match → (deployment_name, remaining_path)."""
+        with self._lock:
+            routes = dict(self._routes)
+        best = None
+        for prefix, dep in routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm + ("" if norm == "/" else "/")) or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, dep)
+        if best is None:
+            return None
+        prefix, dep = best
+        rest = path[len(prefix):] if prefix != "/" else path
+        return dep, rest or "/"
+
+
+def _make_handler(state: _ProxyState):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _respond(self, code: int, payload: Any) -> None:
+            body = (payload if isinstance(payload, (bytes, bytearray))
+                    else json.dumps(payload).encode())
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _handle(self, body: Optional[dict]) -> None:
+            parsed = urllib.parse.urlparse(self.path)
+            match = state.match(parsed.path)
+            if match is None:
+                state.refresh()
+                match = state.match(parsed.path)
+            if match is None:
+                self._respond(404, {"error": f"no route for {parsed.path}"})
+                return
+            dep, _rest = match
+            request: Dict[str, Any] = dict(
+                urllib.parse.parse_qsl(parsed.query))
+            if body:
+                request.update(body)
+            try:
+                handle = DeploymentHandle(dep)
+                result = handle.remote(request).result(timeout_s=60.0)
+                self._respond(200, result)
+            except Exception as e:  # noqa: BLE001 — surface as 500
+                self._respond(500, {"error": str(e)})
+
+        def do_GET(self):  # noqa: N802
+            self._handle(None)
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            try:
+                body = json.loads(raw) if raw else None
+            except json.JSONDecodeError:
+                body = {"body": raw.decode("utf-8", "replace")}
+            self._handle(body)
+
+    return Handler
+
+
+class HttpProxy:
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self.state = _ProxyState(controller)
+        self.server = ThreadingHTTPServer((host, port),
+                                          _make_handler(self.state))
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

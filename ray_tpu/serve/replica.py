@@ -1,0 +1,124 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Capability parity with the reference's replica (reference:
+python/ray/serve/_private/replica.py:492,1138 ReplicaActor,
+handle_request_with_rejection:831 — backpressure via
+max_ongoing_requests; queue-length probes for the router; request
+metrics for autoscaling; reconfigure(user_config); multiplexed model
+LRU).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.core import serialization
+
+
+class Rejected:
+    """Sentinel returned (not raised — task errors are wrapped in
+    TaskError on the wire) when a replica is at max_ongoing_requests;
+    the router retries on another replica."""
+
+    def __reduce__(self):
+        return (Rejected, ())
+
+
+class Replica:
+    def __init__(self, deployment_name: str, replica_id: str,
+                 callable_blob: bytes, init_args_blob: bytes,
+                 max_ongoing_requests: int,
+                 user_config: Optional[dict] = None,
+                 multiplex_max_models: int = 3):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        cls_or_fn = serialization.loads(callable_blob)
+        init_args, init_kwargs = serialization.loads(init_args_blob)
+        if isinstance(cls_or_fn, type):
+            self.callable = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self.callable = cls_or_fn
+        self.max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._total = 0
+        self._lock = threading.Lock()
+        # sliding window of (t, ongoing) samples for autoscaling
+        self._metric_samples = []
+        self._multiplexed: "dict[str, Any]" = {}  # model_id -> model (LRU)
+        self._multiplex_max = multiplex_max_models
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # -- request path --
+
+    def handle_request(self, method_name: str, args_blob: bytes) -> Any:
+        with self._lock:
+            if self._ongoing >= self.max_ongoing:
+                return Rejected()
+            self._ongoing += 1
+            self._total += 1
+        try:
+            args, kwargs = serialization.loads(args_blob)
+            fn = getattr(self.callable, method_name, self.callable)
+            result = fn(*args, **kwargs)
+            import inspect
+            if inspect.iscoroutine(result):
+                import asyncio
+                result = asyncio.run(result)
+            return result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+                self._metric_samples.append((time.monotonic(), self._ongoing))
+                if len(self._metric_samples) > 1000:
+                    self._metric_samples = self._metric_samples[-500:]
+
+    # -- router/controller probes --
+
+    def get_queue_len(self) -> int:
+        return self._ongoing
+
+    def get_metrics(self, window_s: float = 2.0) -> Dict[str, float]:
+        now = time.monotonic()
+        with self._lock:
+            recent = [v for t, v in self._metric_samples
+                      if now - t <= window_s]
+            ongoing = self._ongoing
+        avg = (sum(recent) / len(recent)) if recent else float(ongoing)
+        return {"ongoing": float(ongoing), "avg_ongoing": avg,
+                "total": float(self._total)}
+
+    def check_health(self) -> bool:
+        checker = getattr(self.callable, "check_health", None)
+        if checker is not None:
+            checker()
+        return True
+
+    def reconfigure(self, user_config: dict) -> None:
+        fn = getattr(self.callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    # -- multiplexing (reference: serve/multiplex.py model LRU) --
+
+    def load_multiplexed(self, model_id: str, loader_blob: bytes) -> None:
+        if model_id in self._multiplexed:
+            self._multiplexed[model_id] = self._multiplexed.pop(model_id)
+            return
+        loader = serialization.loads(loader_blob)
+        if len(self._multiplexed) >= self._multiplex_max:
+            evict = next(iter(self._multiplexed))
+            del self._multiplexed[evict]
+        self._multiplexed[model_id] = loader(model_id)
+
+    def get_multiplexed_model_ids(self) -> list:
+        return list(self._multiplexed)
+
+    def get_multiplexed_model(self, model_id: str):
+        return self._multiplexed.get(model_id)
+
+    def prepare_for_shutdown(self) -> None:
+        stopper = getattr(self.callable, "__del__", None)
+        _ = stopper

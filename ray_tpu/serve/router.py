@@ -1,0 +1,106 @@
+"""Request router: power-of-two-choices replica selection.
+
+Capability parity with the reference's router (reference:
+python/ray/serve/_private/router.py:496 AsyncioRouter;
+request_router/pow_2_router.py:27 PowerOfTwoChoicesRequestRouter —
+queue-length probes, retry on rejection, replica-set refresh through the
+controller's long-poll).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve.replica import Rejected
+
+_PROBE_CACHE_S = 0.1
+
+
+class Router:
+    def __init__(self, deployment_name: str, controller):
+        self.deployment_name = deployment_name
+        self.controller = controller
+        self._version = -1
+        self._replicas: List[Tuple[str, Any]] = []
+        self._qlen_cache: Dict[str, Tuple[float, int]] = {}
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+
+    def _refresh(self, block: bool) -> None:
+        if block:
+            version, replicas = ray_tpu.get(
+                self.controller.poll_replicas.remote(
+                    self.deployment_name, self._version, 2.0))
+        else:
+            version, replicas = ray_tpu.get(
+                self.controller.get_replicas.remote(self.deployment_name))
+        with self._lock:
+            self._version = version
+            self._replicas = replicas
+
+    def _queue_len(self, rid: str, handle) -> int:
+        now = time.monotonic()
+        cached = self._qlen_cache.get(rid)
+        if cached and now - cached[0] < _PROBE_CACHE_S:
+            return cached[1]
+        try:
+            qlen = ray_tpu.get(handle.get_queue_len.remote(), timeout=1.0)
+        except Exception:
+            qlen = 1 << 30  # unprobeable replica loses the comparison
+        self._qlen_cache[rid] = (now, qlen)
+        return qlen
+
+    def choose(self) -> Tuple[str, Any]:
+        """Pick a replica: two random candidates, shorter queue wins."""
+        deadline = time.monotonic() + 30.0
+        block = False
+        while True:
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                if len(replicas) == 1:
+                    return replicas[0]
+                a, b = self._rng.sample(replicas, 2)
+                return a if (self._queue_len(*a) <= self._queue_len(*b)) \
+                    else b
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment "
+                    f"{self.deployment_name!r} after 30 s")
+            self._refresh(block)
+            block = True
+
+    def submit(self, method_name: str, args_blob: bytes):
+        """Route once and return (replica_id, ObjectRef); rejection is
+        surfaced at get() time and retried by DeploymentResponse."""
+        rid, handle = self.choose()
+        return rid, handle.handle_request.remote(method_name, args_blob)
+
+    def fetch(self, method_name: str, args_blob: bytes,
+              timeout: Optional[float]) -> Any:
+        """Route + get with rejection retries (the blocking path)."""
+        attempts = 0
+        deadline = (time.monotonic() + timeout) if timeout else None
+        while True:
+            rid, handle = self.choose()
+            ref = handle.handle_request.remote(method_name, args_blob)
+            try:
+                remaining = (max(0.001, deadline - time.monotonic())
+                             if deadline else None)
+                result = ray_tpu.get(ref, timeout=remaining)
+            except ray_tpu.exceptions.ActorError:
+                self._refresh(block=False)  # replica died; new set
+                continue
+            if not isinstance(result, Rejected):
+                return result
+            attempts += 1
+            self._qlen_cache.pop(rid, None)
+            if deadline and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"request to {self.deployment_name} timed out "
+                    f"after {attempts} rejected attempts")
+            time.sleep(min(0.05 * attempts, 0.5))

@@ -39,6 +39,7 @@ class Trial:
             "config": _jsonable(self.config),
             "status": self.status,
             "last_result": _jsonable(self.last_result),
+            "metrics_history": _jsonable(self.metrics_history),
             "checkpoint_path": self.checkpoint_path,
             "error_msg": self.error_msg,
             "num_failures": self.num_failures,
@@ -49,6 +50,7 @@ class Trial:
     def from_json(d: dict) -> "Trial":
         return Trial(trial_id=d["trial_id"], config=d["config"],
                      status=d["status"], last_result=d["last_result"],
+                     metrics_history=d.get("metrics_history") or [],
                      checkpoint_path=d.get("checkpoint_path"),
                      error_msg=d.get("error_msg"),
                      num_failures=d.get("num_failures", 0),

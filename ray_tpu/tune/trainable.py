@@ -62,6 +62,11 @@ class Trainable:
                             f"checkpoint_{self.iteration:06d}")
         os.makedirs(path, exist_ok=True)
         self.save_checkpoint(path)
+        if not os.listdir(path):
+            # Nothing to save (e.g. a function trainable that never
+            # reported a checkpoint) — no phantom checkpoint dirs.
+            os.rmdir(path)
+            return None
         with open(os.path.join(path, ".tune_metadata"), "w") as f:
             f.write(str(self.iteration))
         return path
@@ -81,10 +86,16 @@ class Trainable:
 
 
 class _FnSession:
-    """Per-process session a running trainable function reports into."""
+    """Per-process session a running trainable function reports into.
+
+    The queue is bounded so report() applies backpressure: the function
+    thread cannot race iterations ahead of the controller, which would
+    waste compute past an early-stop decision and leak checkpoint copies
+    (reference: function trainables block in session.report until the
+    result is consumed)."""
 
     def __init__(self, resume_checkpoint: Optional[Checkpoint]):
-        self.results: "queue.Queue" = queue.Queue()
+        self.results: "queue.Queue" = queue.Queue(maxsize=2)
         self.resume_checkpoint = resume_checkpoint
 
 
@@ -179,5 +190,9 @@ class FunctionTrainable(Trainable):
 
 
 def wrap_function(fn: Callable) -> type:
+    # The wrapper class's module is ray_tpu.*, which would defeat the
+    # by-value shipping of fn's driver-local module — register fn itself.
+    from ray_tpu.core.serialization import _maybe_register_by_value
+    _maybe_register_by_value(fn)
     return type(f"fn_{getattr(fn, '__name__', 'trainable')}",
                 (FunctionTrainable,), {"_fn": staticmethod(fn)})

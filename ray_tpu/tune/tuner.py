@@ -38,6 +38,30 @@ class TuneConfig:
     seed: Optional[int] = None
 
 
+class _BudgetedSearcher(Searcher):
+    """Caps a user-supplied searcher at TuneConfig.num_samples trials."""
+
+    def __init__(self, inner: Searcher, num_samples: int):
+        self.inner = inner
+        self.num_samples = num_samples
+        self._suggested = 0
+
+    def set_search_properties(self, metric, mode, param_space):
+        super().set_search_properties(metric, mode, param_space)
+        self.inner.set_search_properties(metric, mode, param_space)
+
+    def suggest(self, trial_id):
+        if self._suggested >= self.num_samples:
+            return None
+        cfg = self.inner.suggest(trial_id)
+        if cfg is not None:
+            self._suggested += 1
+        return cfg
+
+    def on_trial_complete(self, trial_id, result):
+        self.inner.on_trial_complete(trial_id, result)
+
+
 @dataclass
 class ResultGrid:
     """reference: python/ray/tune/result_grid.py"""
@@ -135,8 +159,13 @@ class Tuner:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         cfg = self.tune_config
-        searcher = cfg.search_alg or BasicVariantGenerator(
-            num_samples=cfg.num_samples, seed=cfg.seed)
+        if cfg.search_alg is not None:
+            # num_samples caps searcher-driven runs too (reference:
+            # tune_config.num_samples governs every search_alg).
+            searcher = _BudgetedSearcher(cfg.search_alg, cfg.num_samples)
+        else:
+            searcher = BasicVariantGenerator(
+                num_samples=cfg.num_samples, seed=cfg.seed)
         searcher.set_search_properties(cfg.metric, cfg.mode,
                                        self.param_space)
         exp_dir = self._experiment_dir()
