@@ -1,0 +1,26 @@
+"""ray_tpu.rl — reinforcement learning (reference: rllib/).
+
+Algorithm/EnvRunner/Learner stack re-shaped for TPU: rollouts over
+JAX functional envs compile to one `lax.scan` program, learners are
+pure-JAX with GSPMD data parallelism in-mesh and a host-collective
+gradient allreduce across learner actors.
+"""
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rl.env import (
+    CartPole, CartPoleJax, Env, JaxEnv, Pendulum, make_env, register_env)
+from ray_tpu.rl.env_runner import JaxEnvRunner, SingleAgentEnvRunner
+from ray_tpu.rl.learner import Learner, LearnerGroup, compute_gae
+from ray_tpu.rl.rl_module import RLModuleSpec
+from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
+from ray_tpu.rl import spaces
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "CartPole", "CartPoleJax", "DQN",
+    "DQNConfig", "Env", "JaxEnv", "JaxEnvRunner", "Learner",
+    "LearnerGroup", "PPO", "PPOConfig", "Pendulum", "RLModuleSpec",
+    "SampleBatch", "SingleAgentEnvRunner", "compute_gae",
+    "concat_samples", "make_env", "register_env", "spaces",
+]

@@ -1,0 +1,203 @@
+"""Algorithm / AlgorithmConfig: the RL driver loop.
+
+Reference: rllib/algorithms/algorithm.py:1190 (step = sample +
+training_step + metrics) and algorithm_config.py:109 (fluent builder:
+.environment().training().env_runners().learners()). The Trainable
+surface (train/save/restore) matches what ray_tpu.tune drives.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.env import Env, JaxEnv, make_env, make_jax_env
+from ray_tpu.rl.rl_module import RLModuleSpec
+from ray_tpu.rl.sample_batch import SampleBatch
+
+
+class AlgorithmConfig:
+    """Fluent config; subclass per algorithm for defaults."""
+
+    algo_class = None  # set by subclasses
+
+    def __init__(self):
+        # environment
+        self.env: Any = None
+        self.env_creator: Optional[Callable[[], Env]] = None
+        # env runners
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 8
+        self.rollout_fragment_length: int = 128
+        self.prefer_jax_env: bool = True
+        # training
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 1024
+        self.grad_clip: Optional[float] = None
+        # learners
+        self.num_learners: int = 0
+        # module
+        self.hidden: Tuple[int, ...] = (64, 64)
+        # misc
+        self.seed: int = 0
+
+    # -- fluent sections (reference: algorithm_config.py builder) -------
+    def environment(self, env=None, *, env_creator=None) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_creator is not None:
+            self.env_creator = env_creator
+        return self
+
+    def env_runners(self, *, num_env_runners=None,
+                    num_envs_per_env_runner=None,
+                    rollout_fragment_length=None,
+                    prefer_jax_env=None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if prefer_jax_env is not None:
+            self.prefer_jax_env = prefer_jax_env
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown training option {key!r}")
+            setattr(self, key, value)
+        return self
+
+    def learners(self, *, num_learners=None) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def rl_module(self, *, hidden=None) -> "AlgorithmConfig":
+        if hidden is not None:
+            self.hidden = tuple(hidden)
+        return self
+
+    def debugging(self, *, seed=None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # -- env construction ----------------------------------------------
+    def make_python_env(self) -> Env:
+        if self.env_creator is not None:
+            return self.env_creator()
+        if isinstance(self.env, str):
+            return make_env(self.env)
+        if isinstance(self.env, type) and issubclass(self.env, Env):
+            return self.env()
+        raise ValueError(f"cannot build env from {self.env!r}")
+
+    def make_jax_env(self) -> Optional[JaxEnv]:
+        if not self.prefer_jax_env:
+            return None
+        if isinstance(self.env, str):
+            return make_jax_env(self.env)
+        if isinstance(self.env, type) and issubclass(self.env, JaxEnv):
+            return self.env()
+        if isinstance(self.env, JaxEnv):
+            return self.env
+        return None
+
+    def module_spec(self) -> RLModuleSpec:
+        env = self.make_jax_env() or self.make_python_env()
+        return RLModuleSpec(obs_space=env.observation_space,
+                            action_space=env.action_space,
+                            hidden=self.hidden)
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build_algo(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use a subclass "
+                             "like PPOConfig")
+        return self.algo_class(self.copy())
+
+    # legacy alias (reference keeps .build() working)
+    build = build_algo
+
+
+class Algorithm:
+    """Iteration-driven trainer; also a Tune trainable surface."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._env_steps_lifetime = 0
+        self._episode_returns: List[float] = []
+        self.setup(config)
+
+    # -- subclass hooks --------------------------------------------------
+    def setup(self, config: AlgorithmConfig) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        """One unit of sampling + learning; returns metrics."""
+        raise NotImplementedError
+
+    # -- public loop -----------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        start = time.perf_counter()
+        steps_before = self._env_steps_lifetime
+        metrics = self.training_step()
+        self.iteration += 1
+        elapsed = time.perf_counter() - start
+        sampled = self._env_steps_lifetime - steps_before
+        recent = self._episode_returns[-100:]
+        result = {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled": sampled,
+            "num_env_steps_sampled_lifetime": self._env_steps_lifetime,
+            "env_steps_per_sec": sampled / max(elapsed, 1e-9),
+            "time_this_iter_s": elapsed,
+            "episode_return_mean": (float(np.mean(recent)) if recent
+                                    else float("nan")),
+            "episodes_total": len(self._episode_returns),
+        }
+        result.update(metrics)
+        return result
+
+    def record_episodes(self, returns: List[float]) -> None:
+        self._episode_returns.extend(returns)
+
+    # -- checkpointing (reference: rllib/utils/checkpoints.py
+    #    Checkpointable.save_to_path / restore_from_path) ----------------
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "iteration": self.iteration,
+            "env_steps_lifetime": self._env_steps_lifetime,
+            "episode_returns": self._episode_returns[-1000:],
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.iteration = state["iteration"]
+        self._env_steps_lifetime = state["env_steps_lifetime"]
+        self._episode_returns = list(state["episode_returns"])
+
+    def save_to_path(self, path: str) -> str:
+        from ray_tpu.core import serialization
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            f.write(serialization.dumps(self.get_state()))
+        return path
+
+    def restore_from_path(self, path: str) -> None:
+        from ray_tpu.core import serialization
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            self.set_state(serialization.loads(f.read()))
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,4 @@
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
+
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig"]

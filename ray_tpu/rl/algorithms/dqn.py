@@ -1,0 +1,206 @@
+"""DQN (reference: rllib/algorithms/dqn/dqn.py — replay buffer +
+target network; loss in dqn_rainbow_torch_learner.py). Double-DQN
+target, epsilon-greedy collection, numpy circular replay buffer."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.rl_module import _dense_forward, _dense_init
+from ray_tpu.rl.spaces import Discrete
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.buffer_size = 50_000
+        self.learning_starts = 500
+        self.target_update_freq = 500
+        self.train_batch_size = 64
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 5_000
+        self.num_gradient_steps = 32
+        self.num_envs_per_env_runner = 4
+        self.rollout_fragment_length = 64
+
+
+class ReplayBuffer:
+    """Circular uniform replay (reference:
+    rllib/utils/replay_buffers/replay_buffer.py)."""
+
+    def __init__(self, capacity: int, obs_shape, obs_dtype=np.float32):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, *obs_shape), dtype=obs_dtype)
+        self.next_obs = np.zeros_like(self.obs)
+        self.actions = np.zeros(capacity, dtype=np.int32)
+        self.rewards = np.zeros(capacity, dtype=np.float32)
+        self.dones = np.zeros(capacity, dtype=np.float32)
+        self.pos = 0
+        self.size = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones):
+        for i in range(len(obs)):
+            p = self.pos
+            self.obs[p] = obs[i]
+            self.actions[p] = actions[i]
+            self.rewards[p] = rewards[i]
+            self.next_obs[p] = next_obs[i]
+            self.dones[p] = dones[i]
+            self.pos = (p + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch_size: int, rng: np.random.Generator):
+        idx = rng.integers(self.size, size=batch_size)
+        return {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "next_obs": self.next_obs[idx],
+            "dones": self.dones[idx],
+        }
+
+
+class DQN(Algorithm):
+    def setup(self, config: DQNConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        env0 = config.make_python_env()
+        if not isinstance(env0.action_space, Discrete):
+            raise ValueError("DQN needs a Discrete action space")
+        self.envs = [config.make_python_env()
+                     for _ in range(config.num_envs_per_env_runner)]
+        from ray_tpu.rl.spaces import flat_dim
+        self.n_actions = env0.action_space.n
+        obs_shape = env0.observation_space.shape
+        obs_dim = flat_dim(env0.observation_space)
+        self._rng = np.random.default_rng(config.seed)
+        self.buffer = ReplayBuffer(config.buffer_size, obs_shape)
+
+        key = jax.random.PRNGKey(config.seed)
+        dims = [obs_dim, *config.hidden, self.n_actions]
+        self.params = _dense_init(key, dims)
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.optimizer = optax.adam(config.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self._grad_updates = 0
+
+        def q_values(params, obs):
+            return _dense_forward(params, obs)
+
+        def train_step(params, target_params, opt_state, batch):
+            gamma = config.gamma
+
+            def loss_fn(p):
+                q = q_values(p, batch["obs"])
+                q_taken = jnp.take_along_axis(
+                    q, batch["actions"][:, None].astype(jnp.int32),
+                    axis=-1).squeeze(-1)
+                # double DQN: online net picks, target net evaluates
+                next_online = q_values(p, batch["next_obs"])
+                next_act = jnp.argmax(next_online, axis=-1)
+                next_target = q_values(target_params, batch["next_obs"])
+                next_q = jnp.take_along_axis(
+                    next_target, next_act[:, None], axis=-1).squeeze(-1)
+                target = (batch["rewards"]
+                          + gamma * (1.0 - batch["dones"])
+                          * jax.lax.stop_gradient(next_q))
+                return optax.huber_loss(q_taken, target).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._q_values = jax.jit(q_values)
+        self._train_step = jax.jit(train_step)
+        self._obs = np.stack(
+            [env.reset(seed=config.seed + i)[0]
+             for i, env in enumerate(self.envs)])
+        self._ep_return = np.zeros(len(self.envs))
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps_lifetime
+                   / max(1, cfg.epsilon_decay_steps))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end
+                                           - cfg.epsilon_start)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        N = len(self.envs)
+        for _ in range(cfg.rollout_fragment_length):
+            eps = self._epsilon()
+            q = np.asarray(self._q_values(self.params, self._obs))
+            actions = np.argmax(q, axis=-1)
+            explore = self._rng.random(N) < eps
+            actions[explore] = self._rng.integers(self.n_actions,
+                                                  size=explore.sum())
+            next_obs = np.empty_like(self._obs)
+            rewards = np.zeros(N, dtype=np.float32)
+            dones = np.zeros(N, dtype=np.float32)
+            step_obs = np.empty_like(self._obs)
+            for i, env in enumerate(self.envs):
+                obs, rew, term, trunc, _ = env.step(int(actions[i]))
+                rewards[i] = rew
+                next_obs[i] = obs  # true next obs, pre-reset
+                self._ep_return[i] += rew
+                # terminated cuts the bootstrap; truncation does not
+                dones[i] = float(term)
+                if term or trunc:
+                    self.record_episodes([float(self._ep_return[i])])
+                    self._ep_return[i] = 0.0
+                    obs, _ = env.reset()
+                step_obs[i] = obs
+            self.buffer.add_batch(self._obs, actions, rewards, next_obs,
+                                  dones)
+            self._obs = step_obs
+            self._env_steps_lifetime += N
+
+        losses = []
+        if self.buffer.size >= cfg.learning_starts:
+            import jax
+            import jax.numpy as jnp
+            for _ in range(cfg.num_gradient_steps):
+                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                self.params, self.opt_state, loss = self._train_step(
+                    self.params, self.target_params, self.opt_state, batch)
+                self._grad_updates += 1
+                losses.append(float(loss))
+                if self._grad_updates % cfg.target_update_freq == 0:
+                    self.target_params = jax.tree.map(jnp.copy, self.params)
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "epsilon": self._epsilon(),
+            "buffer_size": self.buffer.size,
+        }
+
+
+    def get_state(self) -> Dict[str, Any]:
+        import jax
+        state = super().get_state()
+        state["params"] = jax.tree.map(np.asarray, self.params)
+        state["target_params"] = jax.tree.map(np.asarray,
+                                              self.target_params)
+        state["opt_state"] = jax.tree.map(np.asarray, self.opt_state)
+        state["grad_updates"] = self._grad_updates
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+        import jax
+        super().set_state(state)
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        self.params = as_jnp(state["params"])
+        self.target_params = as_jnp(state["target_params"])
+        self.opt_state = as_jnp(state["opt_state"])
+        self._grad_updates = state["grad_updates"]
+
+
+DQNConfig.algo_class = DQN

@@ -1,0 +1,240 @@
+"""PPO (reference: rllib/algorithms/ppo/ppo.py — config defaults in
+PPOConfig.__init__, surrogate loss in ppo_torch_learner.py
+compute_loss_for_module).
+
+Two sampling paths, selected automatically:
+- `JaxEnv` available (e.g. "CartPole-v1") and no remote runners: the
+  collect→GAE→epoch pipeline is device-resident end to end; the only
+  host traffic is episode-return bookkeeping.
+- Otherwise: local or remote `SingleAgentEnvRunner` actors sample
+  Python envs in parallel; the learner group (possibly N actors with
+  gradient allreduce) consumes the concatenated batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env_runner import JaxEnvRunner, SingleAgentEnvRunner
+from ray_tpu.rl.learner import Learner, LearnerGroup, compute_gae
+from ray_tpu.rl.sample_batch import (
+    ACTIONS, ADVANTAGES, DONES, FINAL_OBS, LOGP, OBS, REWARDS,
+    TRUNCATEDS, VALUE_TARGETS, VF_PREDS, SampleBatch)
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.num_epochs = 4
+        self.minibatch_size = 256
+        self.grad_clip = 0.5
+
+
+class PPOLearner(Learner):
+    def __init__(self, module_spec, *, clip_param=0.2, vf_clip_param=10.0,
+                 vf_loss_coeff=0.5, entropy_coeff=0.01, **kwargs):
+        self.clip_param = clip_param
+        self.vf_clip_param = vf_clip_param
+        self.vf_loss_coeff = vf_loss_coeff
+        self.entropy_coeff = entropy_coeff
+        super().__init__(module_spec, **kwargs)
+
+    def loss(self, params, batch):
+        import jax.numpy as jnp
+
+        dist, values = self.spec.forward(params, batch[OBS])
+        logp = dist.log_prob(batch[ACTIONS])
+        ratio = jnp.exp(logp - batch[LOGP])
+        adv = batch[ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surrogate = jnp.minimum(
+            adv * ratio,
+            adv * jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param))
+        policy_loss = -surrogate.mean()
+
+        vf_err = (values - batch[VALUE_TARGETS]) ** 2
+        vf_clipped = batch[VF_PREDS] + jnp.clip(
+            values - batch[VF_PREDS], -self.vf_clip_param,
+            self.vf_clip_param)
+        vf_err_clipped = (vf_clipped - batch[VALUE_TARGETS]) ** 2
+        vf_loss = 0.5 * jnp.maximum(vf_err, vf_err_clipped).mean()
+
+        entropy = dist.entropy().mean()
+        total = (policy_loss + self.vf_loss_coeff * vf_loss
+                 - self.entropy_coeff * entropy)
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": (batch[LOGP] - logp).mean(),
+        }
+
+
+class _EnvRunnerActor:
+    """Remote wrapper for SingleAgentEnvRunner (reference:
+    env_runner_group.py actor pool)."""
+
+    def __init__(self, blob: bytes):
+        from ray_tpu.core import serialization
+        kwargs = serialization.loads(blob)
+        self.runner = SingleAgentEnvRunner(**kwargs)
+
+    def sample(self) -> bytes:
+        from ray_tpu.core import serialization
+        batch = self.runner.sample()
+        return serialization.dumps((dict(batch), self.runner.pop_metrics()))
+
+    def set_weights(self, weights) -> None:
+        self.runner.set_weights(weights)
+
+    def ping(self):
+        return True
+
+
+class PPO(Algorithm):
+    def setup(self, config: PPOConfig) -> None:
+        self.spec = config.module_spec()
+        learner_kwargs = dict(
+            module_spec=self.spec, lr=config.lr,
+            grad_clip=config.grad_clip, seed=config.seed,
+            clip_param=config.clip_param,
+            vf_clip_param=config.vf_clip_param,
+            vf_loss_coeff=config.vf_loss_coeff,
+            entropy_coeff=config.entropy_coeff)
+        self.learner_group = LearnerGroup(
+            PPOLearner, num_learners=config.num_learners, **learner_kwargs)
+        self._rng = np.random.default_rng(config.seed)
+
+        jax_env = config.make_jax_env()
+        if (jax_env is not None and config.num_env_runners == 0
+                and config.num_learners <= 1):
+            self.jax_runner = JaxEnvRunner(
+                jax_env, self.spec,
+                num_envs=config.num_envs_per_env_runner,
+                rollout_len=config.rollout_fragment_length,
+                seed=config.seed)
+            self.runners = None
+            return
+        self.jax_runner = None
+        runner_kwargs = dict(
+            env_creator=(config.env_creator
+                         or (lambda cfg=config: cfg.make_python_env())),
+            module_spec=self.spec,
+            num_envs=config.num_envs_per_env_runner,
+            rollout_len=config.rollout_fragment_length)
+        if config.num_env_runners == 0:
+            self.runners = [SingleAgentEnvRunner(seed=config.seed,
+                                                 **runner_kwargs)]
+            self._remote = False
+        else:
+            import ray_tpu
+            from ray_tpu.core import serialization
+            actor_cls = ray_tpu.remote(_EnvRunnerActor)
+            self.runners = [
+                actor_cls.remote(serialization.dumps(
+                    dict(seed=config.seed + i, **runner_kwargs)))
+                for i in range(config.num_env_runners)]
+            ray_tpu.get([r.ping.remote() for r in self.runners])
+            self._remote = True
+
+    # ------------------------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        if self.jax_runner is not None:
+            return self._training_step_jax()
+        return self._training_step_python()
+
+    def _postprocess(self, cols, params) -> SampleBatch:
+        """[T, N] columns -> flat [T*N] batch with GAE columns.
+
+        Truncated episodes (time limits) must not be treated as true
+        terminations: the value of the real next obs is folded into the
+        reward at the boundary (reference:
+        rllib/evaluation/postprocessing.py — bootstrap at truncation),
+        then GAE cuts the trace at every episode end.
+        """
+        import jax.numpy as jnp
+        v_final = self.spec.compute_values(params, cols[FINAL_OBS])
+        rewards = (jnp.asarray(cols[REWARDS])
+                   + self.config.gamma * v_final
+                   * jnp.asarray(cols[TRUNCATEDS], jnp.float32))
+        adv, targets = compute_gae(
+            rewards, cols[VF_PREDS], cols[DONES],
+            cols["bootstrap_value"], gamma=self.config.gamma,
+            lambda_=self.config.lambda_)
+        flat = {}
+        for key in (OBS, ACTIONS, LOGP, VF_PREDS, REWARDS, DONES):
+            arr = cols[key]
+            flat[key] = np.asarray(arr).reshape((-1,) + arr.shape[2:])
+        flat[ADVANTAGES] = np.asarray(adv).reshape(-1)
+        flat[VALUE_TARGETS] = np.asarray(targets).reshape(-1)
+        return SampleBatch(flat)
+
+    def _sgd_epochs(self, batch: SampleBatch) -> Dict[str, Any]:
+        cfg = self.config
+        mb = min(cfg.minibatch_size, len(batch))
+        all_metrics: List[Dict] = []
+        for _ in range(cfg.num_epochs):
+            for minibatch in batch.minibatches(mb, self._rng):
+                all_metrics.append(self.learner_group.update(minibatch))
+        import jax
+        host = [{k: float(np.asarray(v)) for k, v in m.items()}
+                for m in all_metrics]
+        return {k: float(np.mean([m[k] for m in host])) for k in host[0]}
+
+    def _training_step_jax(self) -> Dict[str, Any]:
+        learner = self.learner_group.local_learner
+        cols = self.jax_runner.sample_device(learner.params)
+        self._env_steps_lifetime += (self.jax_runner.rollout_len
+                                     * self.jax_runner.num_envs)
+        self.record_episodes(self.jax_runner.pop_metrics()
+                             ["episode_returns"])
+        batch = self._postprocess(cols, learner.params)
+        return self._sgd_epochs(batch)
+
+    def _training_step_python(self) -> Dict[str, Any]:
+        from ray_tpu.rl.sample_batch import concat_samples
+        weights = self.learner_group.get_weights()
+        batches = []
+        if self._remote:
+            import ray_tpu
+            from ray_tpu.core import serialization
+            ray_tpu.get([r.set_weights.remote(weights)
+                         for r in self.runners])
+            for blob in ray_tpu.get([r.sample.remote()
+                                     for r in self.runners]):
+                cols, metrics = serialization.loads(blob)
+                batches.append(self._postprocess(cols, weights))
+                self.record_episodes(metrics["episode_returns"])
+        else:
+            for runner in self.runners:
+                runner.set_weights(weights)
+                cols = runner.sample()
+                batches.append(self._postprocess(cols, weights))
+                self.record_episodes(runner.pop_metrics()
+                                     ["episode_returns"])
+        batch = concat_samples(batches)
+        self._env_steps_lifetime += len(batch)
+        return self._sgd_epochs(batch)
+
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["learner"] = self.learner_group.get_state()
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        self.learner_group.set_state(state["learner"])
+
+
+PPOConfig.algo_class = PPO

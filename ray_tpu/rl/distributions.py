@@ -1,0 +1,52 @@
+"""Action distributions (reference: rllib/models/distributions.py and
+the torch Categorical/DiagGaussian wrappers in
+rllib/models/torch/torch_distributions.py) as pure JAX."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    def __init__(self, logits):
+        self.logits = logits - jax.scipy.special.logsumexp(
+            logits, axis=-1, keepdims=True)
+
+    def sample(self, key):
+        return jax.random.categorical(key, self.logits)
+
+    def log_prob(self, actions):
+        return jnp.take_along_axis(
+            self.logits, actions[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return -jnp.sum(p * self.logits, axis=-1)
+
+    def mode(self):
+        return jnp.argmax(self.logits, axis=-1)
+
+
+class DiagGaussian:
+    def __init__(self, mean, log_std):
+        self.mean = mean
+        self.log_std = jnp.broadcast_to(log_std, mean.shape)
+
+    def sample(self, key):
+        return self.mean + jnp.exp(self.log_std) * jax.random.normal(
+            key, self.mean.shape)
+
+    def log_prob(self, actions):
+        var = jnp.exp(2 * self.log_std)
+        ll = -0.5 * ((actions - self.mean) ** 2 / var
+                     + 2 * self.log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    def entropy(self):
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e),
+                       axis=-1)
+
+    def mode(self):
+        return self.mean

@@ -1,0 +1,222 @@
+"""Environments: a gymnasium-style Python API plus a JAX functional API.
+
+The reference samples gymnasium envs in EnvRunner actors
+(reference: rllib/env/single_agent_env_runner.py:68). gymnasium is not
+in this image, so the classic-control envs the RLlib smoke tests lean on
+are implemented natively. TPU-first addition: `JaxEnv`, a pure-function
+env protocol whose reset/step jit and vmap, so whole rollouts run as one
+compiled program (`lax.scan`) — on-device sampling the reference has no
+analog for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.spaces import Box, Discrete, Space
+
+
+class Env:
+    """Single-agent env, gymnasium calling convention."""
+
+    observation_space: Space
+    action_space: Space
+    max_episode_steps: int = 10_000
+
+    def reset(self, *, seed: Optional[int] = None) -> Tuple[Any, Dict]:
+        raise NotImplementedError
+
+    def step(self, action) -> Tuple[Any, float, bool, bool, Dict]:
+        """Returns (obs, reward, terminated, truncated, info)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Classic control, numpy
+# ---------------------------------------------------------------------------
+
+_CARTPOLE_HIGH = np.array([4.8, np.inf, 0.418, np.inf], dtype=np.float32)
+
+
+class CartPole(Env):
+    """CartPole-v1 dynamics (pole balancing; +1 per step, 500-step cap)."""
+
+    observation_space = Box(-_CARTPOLE_HIGH, _CARTPOLE_HIGH)
+    action_space = Discrete(2)
+    max_episode_steps = 500
+
+    def __init__(self):
+        self._rng = np.random.default_rng()
+        self._state = None
+        self._t = 0
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self._t = 0
+        return self._state.copy(), {}
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self._state
+        force = 10.0 if action == 1 else -10.0
+        costh, sinth = np.cos(theta), np.sin(theta)
+        # gravity 9.8, cart 1.0, pole 0.1 mass, pole half-length 0.5, dt 0.02
+        temp = (force + 0.05 * theta_dot**2 * sinth) / 1.1
+        theta_acc = (9.8 * sinth - costh * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costh**2 / 1.1))
+        x_acc = temp - 0.05 * theta_acc * costh / 1.1
+        x = x + 0.02 * x_dot
+        x_dot = x_dot + 0.02 * x_acc
+        theta = theta + 0.02 * theta_dot
+        theta_dot = theta_dot + 0.02 * theta_acc
+        self._state = np.array([x, x_dot, theta, theta_dot], dtype=np.float32)
+        self._t += 1
+        terminated = bool(abs(x) > 2.4 or abs(theta) > 0.2095)
+        truncated = self._t >= self.max_episode_steps
+        return self._state.copy(), 1.0, terminated, truncated, {}
+
+
+class Pendulum(Env):
+    """Pendulum-v1 swing-up: continuous torque in [-2, 2]."""
+
+    observation_space = Box(np.array([-1.0, -1.0, -8.0], np.float32),
+                            np.array([1.0, 1.0, 8.0], np.float32))
+    action_space = Box(np.array([-2.0], np.float32),
+                       np.array([2.0], np.float32))
+    max_episode_steps = 200
+
+    def __init__(self):
+        self._rng = np.random.default_rng()
+        self._th = 0.0
+        self._thdot = 0.0
+        self._t = 0
+
+    def _obs(self):
+        return np.array([np.cos(self._th), np.sin(self._th), self._thdot],
+                        dtype=np.float32)
+
+    def reset(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._th = self._rng.uniform(-np.pi, np.pi)
+        self._thdot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0], -2.0, 2.0))
+        th, thdot = self._th, self._thdot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th**2 + 0.1 * thdot**2 + 0.001 * u**2
+        # g 10.0, m 1.0, l 1.0, dt 0.05
+        thdot = thdot + (3 * 10.0 / 2 * np.sin(th) + 3.0 * u) * 0.05
+        thdot = float(np.clip(thdot, -8.0, 8.0))
+        th = th + thdot * 0.05
+        self._th, self._thdot = th, thdot
+        self._t += 1
+        return self._obs(), -cost, False, self._t >= self.max_episode_steps, {}
+
+
+# ---------------------------------------------------------------------------
+# JAX functional envs — jit/vmap-able; rollouts compile to one XLA program
+# ---------------------------------------------------------------------------
+
+class JaxEnv:
+    """Pure-function env: state is a pytree, reset/step are traceable.
+
+    `step` auto-resets on episode end, the standard shape for vectorized
+    `lax.scan` rollouts. It returns a dict with:
+      obs        — next obs (post-reset where the episode ended)
+      final_obs  — the true next obs (pre-reset), for truncation
+                   bootstrapping in GAE
+      reward, terminated, truncated — scalars; done = term | trunc
+    """
+
+    observation_space: Space
+    action_space: Space
+    max_episode_steps: int
+
+    def reset(self, key):
+        """key -> (state, obs)"""
+        raise NotImplementedError
+
+    def step(self, state, action, key):
+        """(state, action, key) -> (state, out_dict) — see class doc."""
+        raise NotImplementedError
+
+
+class CartPoleJax(JaxEnv):
+    """CartPole-v1 as pure JAX — same dynamics as `CartPole`."""
+
+    observation_space = CartPole.observation_space
+    action_space = CartPole.action_space
+    max_episode_steps = 500
+
+    def reset(self, key):
+        import jax
+        s = jax.random.uniform(key, (4,), minval=-0.05, maxval=0.05)
+        return {"s": s, "t": 0}, s
+
+    def step(self, state, action, key):
+        import jax.numpy as jnp
+        s = state["s"]
+        x, x_dot, theta, theta_dot = s[0], s[1], s[2], s[3]
+        force = jnp.where(action == 1, 10.0, -10.0)
+        costh, sinth = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + 0.05 * theta_dot**2 * sinth) / 1.1
+        theta_acc = (9.8 * sinth - costh * temp) / (
+            0.5 * (4.0 / 3.0 - 0.1 * costh**2 / 1.1))
+        x_acc = temp - 0.05 * theta_acc * costh / 1.1
+        s2 = jnp.stack([
+            x + 0.02 * x_dot,
+            x_dot + 0.02 * x_acc,
+            theta + 0.02 * theta_dot,
+            theta_dot + 0.02 * theta_acc,
+        ])
+        t2 = state["t"] + 1
+        terminated = (jnp.abs(s2[0]) > 2.4) | (jnp.abs(s2[2]) > 0.2095)
+        truncated = ~terminated & (t2 >= self.max_episode_steps)
+        done = terminated | truncated
+        # auto-reset: fresh state where done
+        reset_state, _ = self.reset(key)
+        new_s = jnp.where(done, reset_state["s"], s2)
+        new_t = jnp.where(done, 0, t2)
+        return {"s": new_s, "t": new_t}, {
+            "obs": new_s, "final_obs": s2, "reward": 1.0,
+            "terminated": terminated, "truncated": truncated}
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Callable[[], Env]] = {
+    "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
+}
+_JAX_REGISTRY: Dict[str, Callable[[], JaxEnv]] = {
+    "CartPole-v1": CartPoleJax,
+}
+
+
+def register_env(name: str, creator: Callable[[], Env]) -> None:
+    """Reference analog: ray.tune.register_env used by RLlib configs."""
+    _REGISTRY[name] = creator
+
+
+def make_env(name: str) -> Env:
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown env {name!r}; registered: "
+                         f"{sorted(_REGISTRY)}")
+    return _REGISTRY[name]()
+
+
+def make_jax_env(name: str) -> Optional[JaxEnv]:
+    creator = _JAX_REGISTRY.get(name)
+    return creator() if creator else None

@@ -1,0 +1,205 @@
+"""EnvRunners: vectorized experience collection.
+
+Reference: rllib/env/single_agent_env_runner.py:68 (sample() over
+gymnasium vector envs, weights synced from the learner group) and
+env_runner_group.py:69 (the actor pool). Two implementations:
+
+- `SingleAgentEnvRunner`: arbitrary Python `Env`s, numpy stepping with a
+  jitted policy forward. Runs in-process or as an actor on CPU nodes.
+- `JaxEnvRunner`: `JaxEnv`s only — the whole rollout (policy forward,
+  env.step, auto-reset) is ONE jitted `lax.scan`, vmapped over
+  `num_envs`. On TPU the sampling loop never leaves the device; there
+  is no per-step host round-trip at all.
+
+Both return time-major columns shaped [T, N, ...] plus a bootstrap
+value, so the learner's GAE treats them identically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.env import Env, JaxEnv
+from ray_tpu.rl.rl_module import RLModuleSpec
+from ray_tpu.rl.sample_batch import (
+    ACTIONS, DONES, FINAL_OBS, LOGP, OBS, REWARDS, TRUNCATEDS, VF_PREDS,
+    SampleBatch)
+
+
+class SingleAgentEnvRunner:
+    """Steps `num_envs` Python envs with the current policy."""
+
+    def __init__(self, env_creator: Callable[[], Env],
+                 module_spec: RLModuleSpec, *, num_envs: int = 1,
+                 rollout_len: int = 128, seed: int = 0,
+                 explore: bool = True):
+        import jax
+        self.envs = [env_creator() for _ in range(num_envs)]
+        self.spec = module_spec
+        self.rollout_len = rollout_len
+        self.explore = explore
+        self._key = jax.random.PRNGKey(seed)
+        self.params = jax.tree.map(np.asarray,
+                                   module_spec.init(jax.random.PRNGKey(seed)))
+        self._obs = np.stack(
+            [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)])
+        self._ep_return = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, dtype=np.int64)
+        self._completed: List[float] = []
+        self._completed_lens: List[int] = []
+
+        def _act(params, obs, key):
+            dist, value = module_spec.forward(params, obs)
+            if explore:
+                action = dist.sample(key)
+            else:
+                action = dist.mode()
+            return action, dist.log_prob(action), value
+
+        self._act = jax.jit(_act)
+
+    def set_weights(self, params) -> None:
+        import jax
+        self.params = jax.tree.map(np.asarray, params)
+
+    def get_weights(self):
+        return self.params
+
+    def sample(self) -> SampleBatch:
+        """One fragment: [T, N] columns + bootstrap_value [N]."""
+        import jax
+        T, N = self.rollout_len, len(self.envs)
+        cols: Dict[str, list] = {k: [] for k in
+                                 (OBS, ACTIONS, LOGP, VF_PREDS, REWARDS,
+                                  DONES, TRUNCATEDS, FINAL_OBS)}
+        for _ in range(T):
+            self._key, sub = jax.random.split(self._key)
+            action, logp, value = self._act(self.params, self._obs, sub)
+            action = np.asarray(action)
+            cols[OBS].append(self._obs.copy())
+            cols[ACTIONS].append(action)
+            cols[LOGP].append(np.asarray(logp))
+            cols[VF_PREDS].append(np.asarray(value))
+            rewards = np.zeros(N, dtype=np.float32)
+            dones = np.zeros(N, dtype=bool)
+            truncateds = np.zeros(N, dtype=bool)
+            final_obs = np.empty_like(self._obs)
+            for i, env in enumerate(self.envs):
+                obs, rew, term, trunc, _ = env.step(action[i])
+                rewards[i] = rew
+                final_obs[i] = obs  # the true next obs, pre-reset
+                self._ep_return[i] += rew
+                self._ep_len[i] += 1
+                if term or trunc:
+                    dones[i] = True
+                    truncateds[i] = trunc and not term
+                    self._completed.append(float(self._ep_return[i]))
+                    self._completed_lens.append(int(self._ep_len[i]))
+                    self._ep_return[i] = 0.0
+                    self._ep_len[i] = 0
+                    obs, _ = env.reset()
+                self._obs[i] = obs
+            cols[REWARDS].append(rewards)
+            cols[DONES].append(dones)
+            cols[TRUNCATEDS].append(truncateds)
+            cols[FINAL_OBS].append(final_obs)
+        bootstrap = np.asarray(
+            self.spec.compute_values(self.params, self._obs))
+        batch = SampleBatch({k: np.stack(v) for k, v in cols.items()})
+        batch["bootstrap_value"] = bootstrap
+        return batch
+
+    def pop_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_returns": self._completed,
+            "episode_lens": self._completed_lens,
+        }
+        self._completed = []
+        self._completed_lens = []
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+class JaxEnvRunner:
+    """Fully-jitted rollouts over a `JaxEnv` (PureJaxRL-style scan)."""
+
+    def __init__(self, env: JaxEnv, module_spec: RLModuleSpec, *,
+                 num_envs: int = 8, rollout_len: int = 128, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        self.env = env
+        self.spec = module_spec
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self._key = jax.random.PRNGKey(seed)
+        self._key, init_key = jax.random.split(self._key)
+        keys = jax.random.split(init_key, num_envs)
+        self._env_state, self._obs = jax.vmap(env.reset)(keys)
+        self._ep_return = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, dtype=np.int64)
+        self._completed: List[float] = []
+        self._completed_lens: List[int] = []
+
+        def rollout(params, env_state, obs, key):
+            def step_fn(carry, _):
+                env_state, obs, key = carry
+                key, k_act, k_env = jax.random.split(key, 3)
+                dist, value = module_spec.forward(params, obs)
+                action = dist.sample(k_act)
+                logp = dist.log_prob(action)
+                env_keys = jax.random.split(k_env, num_envs)
+                env_state, step_out = jax.vmap(env.step)(
+                    env_state, action, env_keys)
+                next_obs = step_out["obs"]
+                done = step_out["terminated"] | step_out["truncated"]
+                out = {OBS: obs, ACTIONS: action, LOGP: logp,
+                       VF_PREDS: value,
+                       REWARDS: jnp.asarray(step_out["reward"],
+                                            jnp.float32),
+                       DONES: done,
+                       TRUNCATEDS: step_out["truncated"],
+                       FINAL_OBS: step_out["final_obs"]}
+                return (env_state, next_obs, key), out
+
+            (env_state, obs, key), cols = jax.lax.scan(
+                step_fn, (env_state, obs, key), None, length=rollout_len)
+            bootstrap = module_spec.compute_values(params, obs)
+            cols["bootstrap_value"] = bootstrap
+            return env_state, obs, cols
+
+        self._rollout = jax.jit(rollout)
+
+    def sample_device(self, params):
+        """Rollout with columns left on device ([T, N] jax arrays)."""
+        import jax
+        self._key, sub = jax.random.split(self._key)
+        self._env_state, self._obs, cols = self._rollout(
+            params, self._env_state, self._obs, sub)
+        self._track_episodes(np.asarray(cols[REWARDS]),
+                             np.asarray(cols[DONES]))
+        return cols
+
+    def _track_episodes(self, rewards: np.ndarray, dones: np.ndarray):
+        T, N = rewards.shape
+        for t in range(T):
+            self._ep_return += rewards[t]
+            self._ep_len += 1
+            for i in np.nonzero(dones[t])[0]:
+                self._completed.append(float(self._ep_return[i]))
+                self._completed_lens.append(int(self._ep_len[i]))
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+
+    def pop_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_returns": self._completed,
+            "episode_lens": self._completed_lens,
+        }
+        self._completed = []
+        self._completed_lens = []
+        return out

@@ -1,0 +1,93 @@
+"""RLModule: the model abstraction (reference:
+rllib/core/rl_module/rl_module.py:256 — forward_exploration /
+forward_inference / forward_train over a spaces pair).
+
+TPU-first shape: a module is a frozen spec + pure functions
+(init/forward), so the same module runs inside a jitted rollout
+(`lax.scan` on device), inside the learner's pjit-sharded loss, and on a
+CPU env-runner actor — no framework object crosses the jit boundary,
+only the params pytree.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+from ray_tpu.rl.distributions import Categorical, DiagGaussian
+from ray_tpu.rl.spaces import Box, Discrete, Space
+
+
+def _dense_init(key, dims, final_gain: float = 1.0):
+    """Orthogonal init (the PPO-standard choice): gain sqrt(2) for
+    hidden layers, `final_gain` for the output layer."""
+    import jax
+    keys = jax.random.split(key, len(dims) - 1)
+    layers = []
+    last = len(dims) - 2
+    for i, (k, d_in, d_out) in enumerate(zip(keys, dims[:-1], dims[1:])):
+        gain = final_gain if i == last else np.sqrt(2.0)
+        w = jax.nn.initializers.orthogonal(gain)(k, (d_in, d_out))
+        layers.append({"w": w, "b": jax.numpy.zeros((d_out,))})
+    return layers
+
+
+def _dense_forward(layers, x, activate_last=False):
+    import jax.numpy as jnp
+    n = len(layers)
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < n - 1 or activate_last:
+            x = jnp.tanh(x)
+    return x
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Actor-critic MLP spec for a (obs_space, action_space) pair."""
+
+    obs_space: Space = None
+    action_space: Space = None
+    hidden: Tuple[int, ...] = (64, 64)
+
+    @property
+    def obs_dim(self) -> int:
+        return int(np.prod(self.obs_space.shape)) or 1
+
+    @property
+    def is_continuous(self) -> bool:
+        return isinstance(self.action_space, Box)
+
+    @property
+    def act_dim(self) -> int:
+        if self.is_continuous:
+            return int(np.prod(self.action_space.shape))
+        return self.action_space.n
+
+    def init(self, key):
+        import jax
+        kp, kv = jax.random.split(key)
+        params = {
+            "pi": _dense_init(kp, [self.obs_dim, *self.hidden, self.act_dim],
+                              final_gain=0.01),
+            "vf": _dense_init(kv, [self.obs_dim, *self.hidden, 1],
+                              final_gain=1.0),
+        }
+        if self.is_continuous:
+            params["log_std"] = jax.numpy.zeros((self.act_dim,))
+        return params
+
+    def forward(self, params, obs):
+        """obs [..., obs_dim] -> (action distribution, value [...])."""
+        dist_in = _dense_forward(params["pi"], obs)
+        value = _dense_forward(params["vf"], obs).squeeze(-1)
+        if self.is_continuous:
+            dist = DiagGaussian(dist_in, params["log_std"])
+        else:
+            dist = Categorical(dist_in)
+        return dist, value
+
+    def compute_values(self, params, obs):
+        return _dense_forward(params["vf"], obs).squeeze(-1)

@@ -1,0 +1,59 @@
+"""SampleBatch: columnar rollout storage (reference:
+rllib/policy/sample_batch.py — SampleBatch with OBS/ACTIONS/REWARDS
+columns, concat_samples).  Host-side representation is numpy; learners
+move columns to device as one transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Sequence
+
+import numpy as np
+
+OBS = "obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+DONES = "dones"
+TRUNCATEDS = "truncateds"
+FINAL_OBS = "final_obs"
+LOGP = "action_logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+
+
+class SampleBatch(dict):
+    """dict[str, np.ndarray] with a consistent leading (time/batch) dim."""
+
+    def __len__(self) -> int:
+        for v in self.values():
+            return len(v)
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    def rows(self) -> int:
+        return len(self)
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        perm = rng.permutation(len(self))
+        return SampleBatch({k: v[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int,
+                    rng: np.random.Generator = None) -> Iterator["SampleBatch"]:
+        batch = self.shuffle(rng) if rng is not None else self
+        for start in range(0, len(batch) - size + 1, size):
+            yield batch.slice(start, start + size)
+
+
+def concat_samples(batches: Sequence[SampleBatch]) -> SampleBatch:
+    if not batches:
+        return SampleBatch()
+    keys = batches[0].keys()
+    return SampleBatch(
+        {k: np.concatenate([np.asarray(b[k]) for b in batches]) for k in keys})

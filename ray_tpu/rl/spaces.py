@@ -1,0 +1,91 @@
+"""Observation/action spaces (gymnasium-compatible surface).
+
+The reference consumes gymnasium spaces throughout RLlib
+(reference: rllib/core/rl_module/rl_module.py:256 takes
+observation_space/action_space). gymnasium is not a dependency here;
+these two cover the single-agent algorithms in-tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+class Space:
+    shape: Tuple[int, ...]
+    dtype: Any
+
+    def sample(self, rng: Optional[np.random.Generator] = None):
+        raise NotImplementedError
+
+    def contains(self, x) -> bool:
+        raise NotImplementedError
+
+
+class Discrete(Space):
+    """{0, 1, ..., n-1}."""
+
+    def __init__(self, n: int):
+        if n <= 0:
+            raise ValueError(f"Discrete space needs n > 0, got {n}")
+        self.n = int(n)
+        self.shape = ()
+        self.dtype = np.int32
+
+    def sample(self, rng=None):
+        rng = rng or np.random.default_rng()
+        return int(rng.integers(self.n))
+
+    def contains(self, x) -> bool:
+        try:
+            i = int(x)
+        except (TypeError, ValueError):
+            return False
+        return 0 <= i < self.n
+
+    def __repr__(self):
+        return f"Discrete({self.n})"
+
+    def __eq__(self, other):
+        return isinstance(other, Discrete) and other.n == self.n
+
+
+class Box(Space):
+    """Bounded (possibly unbounded) box in R^shape."""
+
+    def __init__(self, low, high, shape: Optional[Tuple[int, ...]] = None,
+                 dtype=np.float32):
+        if shape is None:
+            shape = np.broadcast(np.asarray(low), np.asarray(high)).shape
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.low = np.broadcast_to(np.asarray(low, dtype), self.shape).copy()
+        self.high = np.broadcast_to(np.asarray(high, dtype), self.shape).copy()
+
+    def sample(self, rng=None):
+        rng = rng or np.random.default_rng()
+        low = np.where(np.isfinite(self.low), self.low, -1.0)
+        high = np.where(np.isfinite(self.high), self.high, 1.0)
+        return rng.uniform(low, high, self.shape).astype(self.dtype)
+
+    def contains(self, x) -> bool:
+        x = np.asarray(x)
+        return (x.shape == self.shape and np.all(x >= self.low - 1e-6)
+                and np.all(x <= self.high + 1e-6))
+
+    def __repr__(self):
+        return f"Box(shape={self.shape}, dtype={self.dtype})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Box) and other.shape == self.shape
+                and np.allclose(other.low, self.low)
+                and np.allclose(other.high, self.high))
+
+
+def flat_dim(space: Space) -> int:
+    """Input width of a dense network reading this space."""
+    if isinstance(space, Discrete):
+        return space.n
+    return int(np.prod(space.shape)) if space.shape else 1
