@@ -44,6 +44,12 @@ class ActorMethod:
             max_task_retries=overrides.get("max_task_retries",
                                            self._max_task_retries))
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (reference:
+        python/ray/dag — ClassMethodNode via .bind)."""
+        from ray_tpu.dag.node import ClassMethodNode
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from ray_tpu.core import runtime as runtime_mod
         rt = runtime_mod.get_runtime()
@@ -83,6 +89,9 @@ class ActorHandle:
             return self._seq
 
     def __getattr__(self, name: str):
+        if name == "__ray_call__":
+            # escape hatch: run fn(instance, *args) on the actor
+            return ActorMethod(self, "__ray_call__")
         if name.startswith("_"):
             raise AttributeError(name)
         if name not in self._method_names:

@@ -97,6 +97,12 @@ class RemoteFunction:
         clone._function_id = self._function_id
         return clone
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (reference:
+        python/ray/dag — FunctionNode via .bind)."""
+        from ray_tpu.dag.node import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         from ray_tpu.core import runtime as runtime_mod
         rt = runtime_mod.get_runtime()

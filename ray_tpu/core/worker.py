@@ -252,8 +252,15 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
             rt.actor_id = spec.actor_id
             result_values = [None]
         elif spec.actor_id is not None:
-            method = getattr(rt.actor_instance, spec.method_name)
-            result = method(*args, **kwargs)
+            if spec.method_name == "__ray_call__":
+                # run an arbitrary function against the actor instance
+                # (reference: ActorHandle.__ray_call__ convention used by
+                # compiled graphs to install execution loops)
+                fn = args[0]
+                result = fn(rt.actor_instance, *args[1:], **kwargs)
+            else:
+                method = getattr(rt.actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
             result_values = _split_returns(result, spec.num_returns)
         else:
             fn = rt.get_function(spec.function_id)

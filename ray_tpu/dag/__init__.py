@@ -1,0 +1,12 @@
+"""ray_tpu.dag — static DAGs over actors, optionally compiled onto
+pre-established shared-memory channels (reference: python/ray/dag/)."""
+
+from ray_tpu.dag.compiled import (
+    CompiledDAG, CompiledDAGRef, DAGExecutionError)
+from ray_tpu.dag.node import (
+    ClassMethodNode, DAGNode, FunctionNode, InputNode, MultiOutputNode)
+
+__all__ = [
+    "ClassMethodNode", "CompiledDAG", "CompiledDAGRef", "DAGExecutionError",
+    "DAGNode", "FunctionNode", "InputNode", "MultiOutputNode",
+]

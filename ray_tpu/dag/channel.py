@@ -1,0 +1,108 @@
+"""Shared-memory channels for compiled graphs.
+
+Reference: python/ray/experimental/channel/shared_memory_channel.py:151
+(Channel over mutable plasma objects, reader-acked, bounded buffering).
+Here a channel is a sliding window of `capacity` sealed objects in the
+node's shm arena, addressed by (channel_id, seq): the writer seals
+`seq`, each reader polls the arena directly (no control-plane RPC on
+the data path) and deposits a tiny ack object; the writer reclaims slot
+`seq - capacity` only after every reader acked it, which is also the
+backpressure bound on in-flight executions.
+
+Same-store only: writer and all readers must share one shm arena (the
+same node). Values read out may be zero-copy views into the arena; they
+stay valid for at least `capacity - 1` further writes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.exceptions import GetTimeoutError
+
+_POLL_S = 0.0002
+
+
+class ChannelTimeoutError(GetTimeoutError):
+    pass
+
+
+@dataclass(frozen=True)
+class ChannelSpec:
+    """Picklable channel identity; bind to a store on each side."""
+
+    channel_id: bytes  # 8 random bytes
+    num_readers: int
+    capacity: int = 4
+
+    def data_oid(self, seq: int) -> ObjectID:
+        h = hashlib.sha1(b"chan:" + self.channel_id
+                         + seq.to_bytes(8, "little")).digest()
+        return ObjectID(h[: ObjectID.SIZE])
+
+    def ack_oid(self, seq: int, reader: int) -> ObjectID:
+        h = hashlib.sha1(b"chack:" + self.channel_id
+                         + seq.to_bytes(8, "little")
+                         + reader.to_bytes(2, "little")).digest()
+        return ObjectID(h[: ObjectID.SIZE])
+
+
+def _local_store():
+    """The shm arena of the node this process lives on."""
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime()
+    if getattr(rt, "is_driver", False):
+        return rt.nodes[rt.head_node_id].store
+    return rt.store
+
+
+class ChannelWriter:
+    def __init__(self, spec: ChannelSpec, store=None):
+        self.spec = spec
+        self.store = store or _local_store()
+
+    def write(self, value: Any, seq: int,
+              timeout: Optional[float] = 60.0) -> None:
+        spec = self.spec
+        if seq >= spec.capacity:
+            old = seq - spec.capacity
+            deadline = None if timeout is None else (
+                time.monotonic() + timeout)
+            for reader in range(spec.num_readers):
+                ack = spec.ack_oid(old, reader)
+                while not self.store.contains(ack):
+                    if deadline is not None and time.monotonic() > deadline:
+                        raise ChannelTimeoutError(
+                            f"channel writer blocked: seq {old} not "
+                            f"acked by reader {reader}")
+                    time.sleep(_POLL_S)
+                self.store.delete(ack)
+            self.store.delete(spec.data_oid(old))
+        self.store.put_value(spec.data_oid(seq), value)
+
+
+class ChannelReader:
+    def __init__(self, spec: ChannelSpec, reader_idx: int, store=None):
+        self.spec = spec
+        self.reader_idx = reader_idx
+        self.store = store or _local_store()
+
+    def read(self, seq: int, timeout: Optional[float] = 60.0) -> Any:
+        found, value = self.store.get_value(
+            self.spec.data_oid(seq),
+            timeout_s=1e9 if timeout is None else timeout)
+        if not found:
+            raise ChannelTimeoutError(
+                f"channel read timed out at seq {seq}")
+        return value
+
+    def ack(self, seq: int) -> None:
+        oid = self.spec.ack_oid(seq, self.reader_idx)
+        if self.store.contains(oid):
+            return  # idempotent: a retried get() may re-ack
+        self.store.create(oid, 1)
+        self.store.seal(oid)

@@ -1,0 +1,346 @@
+"""Compiled graphs: a bound DAG pinned onto its actors with
+pre-established shared-memory channels.
+
+Reference: python/ray/dag/compiled_dag_node.py:805 (CompiledDAG) —
+compile once, then each `execute()` moves data through pre-allocated
+channels with NO per-call task submission, scheduling, or control-plane
+RPC. Each participating actor runs a resident execution loop (installed
+via the `__ray_call__` escape hatch) that polls its input channels,
+runs its nodes in topo order, and writes output channels; the driver
+only touches the shm arena.
+
+Error/teardown semantics match the reference: application exceptions
+flow through the channels as error tokens (the DAG stays alive);
+`teardown()` injects a stop token that propagates through every
+channel and unwinds the loops.
+
+Same-node only in this round: channels need writer and readers on one
+shm arena (the head node). Cross-slice DAGs ride DCN in the reference
+via NCCL channels; the TPU equivalent (jax transfer-server channels)
+is future work.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.dag.channel import ChannelReader, ChannelSpec, ChannelWriter
+from ray_tpu.dag.node import (
+    ClassMethodNode, DAGNode, FunctionNode, InputAttributeNode, InputNode,
+    MultiOutputNode)
+
+
+class _Stop:
+    """Teardown token."""
+
+
+class _ErrorToken:
+    def __init__(self, error: BaseException, node_name: str):
+        self.error = error
+        self.node_name = node_name
+
+
+_STOP = _Stop()
+
+
+class DAGExecutionError(RuntimeError):
+    pass
+
+
+def _compiled_dag_loop(instance, schedule):
+    """Resident per-actor loop. Reads lazily (just before the first
+    node that needs a channel) so actor-level cycles like
+    A.n1 -> B.n2 -> A.n3 can't deadlock."""
+    readers = {key: ChannelReader(spec, idx)
+               for key, (spec, idx) in schedule["reads"].items()}
+    writers = {uid: ChannelWriter(spec)
+               for uid, spec in schedule["writes"].items()}
+    seq = 0
+    while True:
+        cache: Dict[str, Any] = {}
+        stop = False
+
+        def read(key):
+            nonlocal stop
+            if key not in cache:
+                cache[key] = readers[key].read(seq, timeout=None)
+            value = cache[key]
+            if isinstance(value, _Stop):
+                stop = True
+            return value
+
+        local: Dict[int, Any] = {}
+        for node in schedule["nodes"]:
+            error: Optional[_ErrorToken] = None
+
+            def resolve(aspec):
+                nonlocal error
+                kind = aspec[0]
+                if kind == "const":
+                    return aspec[1]
+                if kind == "local":
+                    value = local[aspec[1]]
+                else:  # ("chan", key, selector)
+                    value = read(aspec[1])
+                    if stop:
+                        return None
+                    if aspec[1] == "__input__" and \
+                            not isinstance(value, _ErrorToken):
+                        in_args, in_kwargs = value
+                        value = InputNode.extract(aspec[2], in_args,
+                                                  in_kwargs)
+                if isinstance(value, _ErrorToken):
+                    error = value
+                return value
+
+            if node.get("sync_input"):
+                read("__input__")
+            if stop:
+                break
+            args = [resolve(a) for a in node["args"]]
+            kwargs = {k: resolve(v) for k, v in node["kwargs"].items()}
+            if stop:
+                break
+            uid = node["uid"]
+            if error is not None:
+                local[uid] = error
+            else:
+                try:
+                    method = getattr(instance, node["method"])
+                    local[uid] = method(*args, **kwargs)
+                except Exception as e:  # noqa: BLE001 — user code
+                    local[uid] = _ErrorToken(e, node["method"])
+            if uid in writers:
+                # block on backpressure indefinitely: a slow driver must
+                # stall the pipeline, not kill it
+                writers[uid].write(local[uid], seq, timeout=None)
+
+        if not stop:
+            for key in readers:
+                read(key)  # drain channels untouched this round
+        if stop:
+            for writer in writers.values():
+                writer.write(_STOP, seq, timeout=None)
+            for key in cache:
+                readers[key].ack(seq)
+            return seq
+        for key in readers:
+            readers[key].ack(seq)
+        seq += 1
+
+
+class CompiledDAGRef:
+    """Future for one `execute()`; `get()` reads the output channels."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value: Any = None
+        self._fetched = False
+
+    def get(self, timeout: Optional[float] = 60.0):
+        if not self._fetched:
+            self._value = self._dag._read_output(self._seq, timeout)
+            self._fetched = True
+        if isinstance(self._value, _ErrorToken):
+            raise DAGExecutionError(
+                f"node {self._value.node_name!r} failed: "
+                f"{self._value.error!r}") from self._value.error
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, *, buffer_capacity: int = 4):
+        self._capacity = buffer_capacity
+        nodes = root.topo_sort()
+        if any(isinstance(n, FunctionNode) for n in nodes):
+            raise ValueError(
+                "compiled graphs support actor methods only; wrap "
+                "stateless functions in an actor (reference behavior)")
+        inputs = [n for n in nodes if isinstance(n, InputNode)]
+        if len(inputs) > 1:
+            raise ValueError("a DAG has at most one InputNode")
+        self._outputs = (root._outputs if isinstance(root, MultiOutputNode)
+                         else [root])
+        self._multi = isinstance(root, MultiOutputNode)
+        compute = [n for n in nodes if isinstance(n, ClassMethodNode)]
+        if not compute:
+            raise ValueError("DAG has no actor-method nodes")
+        for out in self._outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor-method nodes")
+
+        # consumers of each produced value, and of the input
+        by_uid = {n._node_uid: n for n in nodes}
+        actor_of = {n._node_uid: n._handle._actor_id for n in compute}
+        consumers: Dict[int, set] = {n._node_uid: set() for n in compute}
+        input_consumers: set = set()
+        for n in compute:
+            for arg in n._all_args():
+                if isinstance(arg, ClassMethodNode) and \
+                        actor_of[arg._node_uid] != actor_of[n._node_uid]:
+                    consumers[arg._node_uid].add(actor_of[n._node_uid])
+                elif isinstance(arg, (InputNode, InputAttributeNode)):
+                    input_consumers.add(actor_of[n._node_uid])
+            # source nodes sync on the input channel for stop/backpressure
+            if not any(isinstance(a, DAGNode) for a in n._all_args()):
+                input_consumers.add(actor_of[n._node_uid])
+
+        out_uids = {o._node_uid for o in self._outputs}
+
+        def make_spec(uid: Optional[int], reader_actors: set,
+                      driver_reads: bool) -> ChannelSpec:
+            return ChannelSpec(
+                channel_id=os.urandom(8),
+                num_readers=len(reader_actors) + (1 if driver_reads else 0),
+                capacity=buffer_capacity)
+
+        # channel per cross-actor-consumed or terminal node, + input
+        self._chan_specs: Dict[int, ChannelSpec] = {}
+        reader_order: Dict[int, List] = {}
+        for n in compute:
+            uid = n._node_uid
+            drv = uid in out_uids
+            if consumers[uid] or drv:
+                self._chan_specs[uid] = make_spec(uid, consumers[uid], drv)
+                reader_order[uid] = sorted(consumers[uid],
+                                           key=lambda a: a.hex())
+        self._input_spec = make_spec(None, input_consumers, False)
+        input_reader_order = sorted(input_consumers, key=lambda a: a.hex())
+
+        def reader_idx(uid: Optional[int], actor_id) -> int:
+            order = (input_reader_order if uid is None
+                     else reader_order[uid])
+            return order.index(actor_id)
+
+        # per-actor schedules
+        handles: Dict[Any, Any] = {}
+        schedules: Dict[Any, dict] = {}
+        for n in compute:
+            aid = actor_of[n._node_uid]
+            handles[aid] = n._handle
+            schedules.setdefault(aid, {"reads": {}, "writes": {},
+                                       "nodes": []})
+        for n in compute:
+            aid = actor_of[n._node_uid]
+            sched = schedules[aid]
+
+            def argspec(arg):
+                if isinstance(arg, InputNode):
+                    sched["reads"]["__input__"] = (
+                        self._input_spec, reader_idx(None, aid))
+                    return ("chan", "__input__", None)
+                if isinstance(arg, InputAttributeNode):
+                    sched["reads"]["__input__"] = (
+                        self._input_spec, reader_idx(None, aid))
+                    return ("chan", "__input__", arg._selector)
+                if isinstance(arg, ClassMethodNode):
+                    uid = arg._node_uid
+                    if actor_of[uid] == aid:
+                        return ("local", uid)
+                    key = f"n{uid}"
+                    sched["reads"][key] = (self._chan_specs[uid],
+                                           reader_idx(uid, aid))
+                    return ("chan", key, None)
+                if isinstance(arg, DAGNode):
+                    raise ValueError(f"unsupported node type {type(arg)}")
+                return ("const", arg)
+
+            entry = {
+                "uid": n._node_uid,
+                "method": n._method_name,
+                "args": [argspec(a) for a in n._bound_args],
+                "kwargs": {k: argspec(v)
+                           for k, v in n._bound_kwargs.items()},
+                "sync_input": not any(isinstance(a, DAGNode)
+                                      for a in n._all_args()),
+            }
+            if entry["sync_input"]:
+                sched["reads"]["__input__"] = (
+                    self._input_spec, reader_idx(None, aid))
+            if n._node_uid in self._chan_specs:
+                sched["writes"][n._node_uid] = self._chan_specs[n._node_uid]
+            sched["nodes"].append(entry)
+
+        # channels are same-arena: every participating actor must sit on
+        # the head node (where the driver's endpoints live)
+        import time as _time
+        from ray_tpu.core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime()
+        if getattr(rt, "is_driver", False):
+            deadline = _time.monotonic() + 10.0
+            for aid in handles:
+                while True:
+                    info = rt.actors.get(aid)
+                    if info is not None and info.node_id is not None:
+                        break
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"actor {aid} not placed within 10s; cannot "
+                            "compile DAG")
+                    _time.sleep(0.01)
+                if info.node_id != rt.head_node_id:
+                    raise ValueError(
+                        f"compiled graphs require all actors on the head "
+                        f"node (shared shm arena); actor {aid} is on "
+                        f"node {info.node_id}")
+
+        # driver-side endpoints
+        self._input_writer = ChannelWriter(self._input_spec)
+        self._output_readers = [
+            ChannelReader(self._chan_specs[o._node_uid],
+                          # driver is always the last reader index
+                          self._chan_specs[o._node_uid].num_readers - 1)
+            for o in self._outputs]
+        self._next_seq = 0
+        self._torn_down = False
+
+        # install the loops
+        self._loop_refs = [
+            handles[aid].__ray_call__.remote(_compiled_dag_loop, sched)
+            for aid, sched in schedules.items()]
+
+    # ------------------------------------------------------------------
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("DAG was torn down")
+        seq = self._next_seq
+        self._next_seq += 1
+        self._input_writer.write((args, kwargs), seq)
+        return CompiledDAGRef(self, seq)
+
+    def _read_output(self, seq: int, timeout: Optional[float]):
+        import copy
+
+        # read everything before acking anything, so a timeout on one
+        # output leaves the whole seq re-readable
+        raw = [reader.read(seq, timeout)
+               for reader in self._output_readers]
+        # deep-copy: read values may be zero-copy views into channel
+        # slots the writer will reuse after `capacity` more executions
+        values = [v if isinstance(v, _ErrorToken) else copy.deepcopy(v)
+                  for v in raw]
+        for reader in self._output_readers:
+            reader.ack(seq)
+        errors = [v for v in values if isinstance(v, _ErrorToken)]
+        if errors:
+            return errors[0]
+        return values if self._multi else values[0]
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        import ray_tpu
+        self._input_writer.write(_STOP, self._next_seq)
+        try:
+            ray_tpu.get(self._loop_refs, timeout=30.0)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:  # noqa: BLE001 — interpreter shutdown
+            pass

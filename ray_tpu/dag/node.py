@@ -1,0 +1,182 @@
+"""DAG node types (reference: python/ray/dag/dag_node.py,
+input_node.py, class_node.py, function_node.py — nodes built with
+`.bind()`, executed lazily or compiled).
+
+Uncompiled execution (`node.execute(...)`) walks the graph and submits
+one task per node through the normal runtime. Compiling
+(`experimental_compile`) replaces per-call submission with
+pre-established shared-memory channels — see ray_tpu/dag/compiled.py.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    def __init__(self):
+        self._node_uid = next(_node_counter)
+
+    # -- graph walking ---------------------------------------------------
+    def upstream(self) -> List["DAGNode"]:
+        return [a for a in self._all_args() if isinstance(a, DAGNode)]
+
+    def _all_args(self) -> List[Any]:
+        return []
+
+    def topo_sort(self) -> List["DAGNode"]:
+        """All ancestors + self, dependencies first, deterministic."""
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(node: DAGNode):
+            if node._node_uid in seen:
+                return
+            seen.add(node._node_uid)
+            for up in node.upstream():
+                visit(up)
+            order.append(node)
+
+        visit(self)
+        return order
+
+    # -- uncompiled execution -------------------------------------------
+    def execute(self, *args, **kwargs):
+        """Submit the whole DAG through the normal task path; returns an
+        ObjectRef (or list of refs for MultiOutputNode)."""
+        memo: Dict[int, Any] = {}
+        return self._eval(memo, args, kwargs)
+
+    def _eval(self, memo, in_args, in_kwargs):
+        raise NotImplementedError
+
+    def experimental_compile(self, **kwargs):
+        from ray_tpu.dag.compiled import CompiledDAG
+        return CompiledDAG(self, **kwargs)
+
+
+def _resolve(arg, memo, in_args, in_kwargs):
+    if isinstance(arg, DAGNode):
+        return arg._eval(memo, in_args, in_kwargs)
+    return arg
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder; supports `with InputNode() as inp`.
+
+    `inp` is the single positional arg (or the tuple of them);
+    `inp[i]` / `inp.key` select positional / keyword args.
+    """
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getitem__(self, index) -> "InputAttributeNode":
+        return InputAttributeNode(self, ("idx", index))
+
+    def __getattr__(self, name: str) -> "InputAttributeNode":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return InputAttributeNode(self, ("key", name))
+
+    @staticmethod
+    def extract(selector: Optional[Tuple[str, Any]], in_args, in_kwargs):
+        if selector is None:
+            if in_kwargs:
+                raise ValueError(
+                    "DAG input has keyword args; consume them via "
+                    "inp.<name>, not the bare InputNode")
+            if len(in_args) != 1:
+                return tuple(in_args)
+            return in_args[0]
+        kind, sel = selector
+        return in_args[sel] if kind == "idx" else in_kwargs[sel]
+
+    def _eval(self, memo, in_args, in_kwargs):
+        return self.extract(None, in_args, in_kwargs)
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, selector: Tuple[str, Any]):
+        super().__init__()
+        self._parent = parent
+        self._selector = selector
+
+    def _all_args(self):
+        return [self._parent]
+
+    def _eval(self, memo, in_args, in_kwargs):
+        return InputNode.extract(self._selector, in_args, in_kwargs)
+
+
+class ClassMethodNode(DAGNode):
+    """actor.method.bind(...) — an actor-method call in the DAG."""
+
+    def __init__(self, handle, method_name: str, args: tuple, kwargs: dict):
+        super().__init__()
+        self._handle = handle
+        self._method_name = method_name
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _all_args(self):
+        return list(self._bound_args) + list(self._bound_kwargs.values())
+
+    def _eval(self, memo, in_args, in_kwargs):
+        if self._node_uid in memo:
+            return memo[self._node_uid]
+        args = [_resolve(a, memo, in_args, in_kwargs)
+                for a in self._bound_args]
+        kwargs = {k: _resolve(v, memo, in_args, in_kwargs)
+                  for k, v in self._bound_kwargs.items()}
+        from ray_tpu.core.actor import ActorMethod
+        ref = ActorMethod(self._handle, self._method_name).remote(
+            *args, **kwargs)
+        memo[self._node_uid] = ref
+        return ref
+
+
+class FunctionNode(DAGNode):
+    """fn.bind(...) — a task call in the DAG (uncompiled mode only)."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        super().__init__()
+        self._remote_fn = remote_fn
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    def _all_args(self):
+        return list(self._bound_args) + list(self._bound_kwargs.values())
+
+    def _eval(self, memo, in_args, in_kwargs):
+        if self._node_uid in memo:
+            return memo[self._node_uid]
+        args = [_resolve(a, memo, in_args, in_kwargs)
+                for a in self._bound_args]
+        kwargs = {k: _resolve(v, memo, in_args, in_kwargs)
+                  for k, v in self._bound_kwargs.items()}
+        ref = self._remote_fn.remote(*args, **kwargs)
+        memo[self._node_uid] = ref
+        return ref
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal node returning several leaves (reference:
+    python/ray/dag/output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__()
+        self._outputs = list(outputs)
+
+    def _all_args(self):
+        return list(self._outputs)
+
+    def _eval(self, memo, in_args, in_kwargs):
+        return [_resolve(o, memo, in_args, in_kwargs)
+                for o in self._outputs]

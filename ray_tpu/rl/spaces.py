@@ -51,6 +51,9 @@ class Discrete(Space):
     def __eq__(self, other):
         return isinstance(other, Discrete) and other.n == self.n
 
+    def __hash__(self):
+        return hash(("Discrete", self.n))
+
 
 class Box(Space):
     """Bounded (possibly unbounded) box in R^shape."""
@@ -82,6 +85,10 @@ class Box(Space):
         return (isinstance(other, Box) and other.shape == self.shape
                 and np.allclose(other.low, self.low)
                 and np.allclose(other.high, self.high))
+
+    def __hash__(self):
+        return hash(("Box", self.shape, self.low.tobytes(),
+                     self.high.tobytes()))
 
 
 def flat_dim(space: Space) -> int:

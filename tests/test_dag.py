@@ -1,0 +1,168 @@
+"""Compiled-graph tests (reference: python/ray/dag/tests/experimental/
+test_accelerated_dag.py — execute/get roundtrips, chains, fan-out,
+app-error propagation, teardown)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import DAGExecutionError, InputNode, MultiOutputNode
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, inc=1):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def add2(self, x, y):
+        return x + y
+
+    def boom(self, x):
+        raise ValueError(f"bad input {x}")
+
+    def num_calls(self):
+        return self.calls
+
+
+def test_uncompiled_dag_execute(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    ref = dag.execute(5)
+    assert ray_tpu.get(ref) == 16
+
+
+def test_uncompiled_function_node(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    with InputNode() as inp:
+        dag = double.bind(double.bind(inp))
+    assert ray_tpu.get(dag.execute(3)) == 12
+
+
+def test_compiled_chain(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(10):
+            assert compiled.execute(i).get() == i + 11
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_same_actor_local_passthrough(ray_start_regular):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(0).get() == 2
+        # both nodes ran on the one actor
+        assert compiled.execute(0).get() == 2
+    finally:
+        compiled.teardown()
+    assert ray_tpu.get(a.num_calls.remote()) == 4
+
+
+def test_compiled_fanout_multi_output(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(100)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(7).get() == [8, 107]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_join_two_inputs(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    c = Adder.remote(0)
+    with InputNode() as inp:
+        dag = c.add2.bind(a.add.bind(inp[0]), b.add.bind(inp[1]))
+    compiled = dag.experimental_compile()
+    try:
+        # (3+1) + (4+2)
+        assert compiled.execute(3, 4).get() == 10
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_numpy_payload(ray_start_regular):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        x = np.arange(131072, dtype=np.float32)
+        out = compiled.execute(x).get()
+        np.testing.assert_allclose(np.asarray(out), x + 1)
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_app_error_keeps_dag_alive(ray_start_regular):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.boom.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(DAGExecutionError, match="bad input 1"):
+            compiled.execute(1).get()
+        # DAG still works after an application error
+        with pytest.raises(DAGExecutionError, match="bad input 2"):
+            compiled.execute(2).get()
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_error_propagates_downstream(ray_start_regular):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.boom.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(DAGExecutionError, match="boom"):
+            compiled.execute(1).get()
+    finally:
+        compiled.teardown()
+
+
+def test_teardown_frees_actor(ray_start_regular):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled.execute(1).get() == 2
+    compiled.teardown()
+    # actor serves normal calls again after teardown
+    assert ray_tpu.get(a.add.remote(5)) == 6
+    with pytest.raises(RuntimeError):
+        compiled.execute(1)
+
+
+def test_compiled_pipelined_executes(ray_start_regular):
+    """Several in-flight executions within the channel window."""
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(3)]
+        assert [r.get() for r in refs] == [1, 2, 3]
+    finally:
+        compiled.teardown()
