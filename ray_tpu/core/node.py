@@ -25,7 +25,7 @@ from typing import Any, Deque, Dict, List, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config
-from ray_tpu.core.ids import ActorID, NodeID, TaskID, WorkerID
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.core.protocol import MessageConnection
 from ray_tpu.core.task_spec import TaskSpec
@@ -49,6 +49,8 @@ class WorkerHandle:
         self.actor_id: Optional[ActorID] = None
         self.running: Dict[TaskID, TaskSpec] = {}
         self.registered = threading.Event()
+        # objects this worker holds borrowed refs to (pinned at owner)
+        self.held_refs: set = set()
 
     def send(self, msg: dict) -> bool:
         conn = self.conn
@@ -198,8 +200,17 @@ class Node:
             elif kind == "KILL_ACTOR":
                 self.runtime.kill_actor(ActorID(msg["actor_id"]),
                                         no_restart=msg.get("no_restart", True))
+            elif kind == "REF_ADD":
+                oid = ObjectID(msg["object_id"])
+                if handle is not None:
+                    handle.held_refs.add(oid)
+                self.runtime.reference_counter.add_local_reference(oid)
+            elif kind == "REF_DROP":
+                oid = ObjectID(msg["object_id"])
+                if handle is not None:
+                    handle.held_refs.discard(oid)
+                self.runtime.deferred_remove_reference(oid)
             elif kind == "CANCEL":
-                from ray_tpu.core.ids import ObjectID
                 self.runtime.cancel(ObjectID(msg["object_id"]),
                                     force=msg.get("force", False))
             return handle
@@ -280,11 +291,15 @@ class Node:
             worker.state = DEAD
             running = list(worker.running.values())
             worker.running.clear()
+            held = list(worker.held_refs)
+            worker.held_refs.clear()
             try:
                 self._idle[worker.profile].remove(worker)
             except ValueError:
                 pass
             self._workers.pop(worker.worker_id, None)
+        for oid in held:  # release this worker's borrowed pins
+            self.runtime.reference_counter.remove_local_reference(oid)
         if self._stopped.is_set():
             return
         self.runtime.on_worker_crashed(self, worker, running,

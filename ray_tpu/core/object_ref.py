@@ -54,7 +54,10 @@ class ObjectRef:
         return f"ObjectRef({self._id.hex()})"
 
     def __reduce__(self):
-        # Serialized refs re-register on deserialization (borrowing).
+        # Serialized refs re-register on deserialization (borrowing);
+        # values being stored report contained refs for nested pinning.
+        from ray_tpu.core import serialization
+        serialization.note_ref(self._id)
         return (ObjectRef, (self._id, self._owner))
 
     def __del__(self):

@@ -85,6 +85,17 @@ class DriverRuntime:
         self.task_manager = TaskManager()
         self.reference_counter = ReferenceCounter()
         self.reference_counter.set_deleter(self._maybe_delete_object)
+        self._ref_grace_s = 2.0
+        # objects pinned because they are contained in a stored value
+        # (task return / put): container oid -> contained oids
+        self._contained_refs: Dict[ObjectID, List[ObjectID]] = {}
+        self._contained_lock = threading.Lock()
+        # single expiry thread for deferred ref drops (no Timer churn)
+        self._expiry_items: List[tuple] = []
+        self._expiry_cv = threading.Condition()
+        self._expiry_thread = threading.Thread(
+            target=self._expiry_loop, name="ref-expiry", daemon=True)
+        self._expiry_thread.start()
         self.memory_store = MemoryStore()
         self.namespace = namespace
         self.job_id = JobID.from_random()
@@ -333,8 +344,11 @@ class DriverRuntime:
             self._release_task_resources(spec, node.node_id)
             self._signal_scheduler()
             return
-        for oid_bytes, kind, data in msg.get("results", ()):
+        for result in msg.get("results", ()):
+            oid_bytes, kind, data = result[:3]
+            contained = result[3] if len(result) > 3 else ()
             oid = ObjectID(oid_bytes)
+            self._pin_contained(oid, contained)
             if kind == "inline":
                 self.memory_store.put(oid, ("packed", bytes(data)))
                 self.task_manager.set_location(oid, ObjectLocation("memory"))
@@ -493,8 +507,11 @@ class DriverRuntime:
 
     # --- object plane ---------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
-        data, buffers = serialization.serialize(value)
-        return self.put_serialized(data, buffers)
+        with serialization.collect_contained_refs() as contained:
+            data, buffers = serialization.serialize(value)
+        ref = self.put_serialized(data, buffers)
+        self._pin_contained(ref.id, contained)
+        return ref
 
     def put_serialized(self, data: bytes, buffers) -> ObjectRef:
         """Store already-serialized parts (single serialize pass)."""
@@ -569,6 +586,19 @@ class DriverRuntime:
         rest = [r for r in refs if r.id not in done_set]
         return done, rest
 
+    def _pin_contained(self, container: ObjectID, contained) -> None:
+        """Objects referenced inside a stored value stay alive as long as
+        the container does (reference: reference_counter.h nested-ref
+        tracking). `contained` is a list of ObjectID binaries."""
+        if not contained:
+            return
+        oids = [b if isinstance(b, ObjectID) else ObjectID(b)
+                for b in contained]
+        for oid in oids:
+            self.reference_counter.add_local_reference(oid)
+        with self._contained_lock:
+            self._contained_refs.setdefault(container, []).extend(oids)
+
     def _maybe_delete_object(self, oid: ObjectID) -> None:
         """Called when the local reference count drops to zero
         (reference: reference_counter.h — delete at refcount 0)."""
@@ -581,10 +611,51 @@ class DriverRuntime:
             if node is not None:
                 node.store.delete(oid)
         self.task_manager.forget_object(oid)
+        with self._contained_lock:
+            nested = self._contained_refs.pop(oid, None)
+        if nested:
+            for inner in nested:  # may recurse through nested containers
+                self.reference_counter.remove_local_reference(inner)
+
+    def _expiry_loop(self) -> None:
+        import heapq
+        while True:
+            with self._expiry_cv:
+                while not self._expiry_items:
+                    self._expiry_cv.wait()
+                deadline, _, fn = self._expiry_items[0]
+                now = time.monotonic()
+                if deadline > now:
+                    self._expiry_cv.wait(deadline - now)
+                    continue
+                heapq.heappop(self._expiry_items)
+            try:
+                fn()
+            except Exception:
+                pass
+
+    def _schedule_expiry(self, delay: float, fn) -> None:
+        import heapq
+        with self._expiry_cv:
+            heapq.heappush(
+                self._expiry_items,
+                (time.monotonic() + delay, id(fn), fn))
+            self._expiry_cv.notify()
+
+    def deferred_remove_reference(self, oid: ObjectID) -> None:
+        """Remove a worker-reported borrow; a zero count only fires the
+        deleter after a grace window (and only if still zero), masking
+        the gap between a worker dropping a returned ref and the caller
+        registering its borrow. Containment pinning (task returns / puts
+        that embed refs) covers the durable cases; the grace window only
+        guards transient hand-offs."""
+        self.reference_counter.remove_local_reference(
+            oid, defer=(self._ref_grace_s, self._schedule_expiry))
 
     # --- worker message handlers ----------------------------------------
     def on_worker_put(self, node: Node, msg: dict) -> None:
         oid = ObjectID(msg["object_id"])
+        self._pin_contained(oid, msg.get("contained", ()))
         self.task_manager.set_location(oid, ObjectLocation("shm", node.node_id))
         self.task_manager.mark_object_ready(oid)
 

@@ -17,9 +17,37 @@ from __future__ import annotations
 
 import io
 import pickle
+import threading
 from typing import Any, List, Tuple
 
 import cloudpickle
+
+# --- nested-ref collection -------------------------------------------------
+# While a collector is active on this thread, every ObjectRef pickled
+# reports its id here. Used to pin objects *contained in* stored values
+# (task returns, puts) until the containing object dies — the reference's
+# nested-reference counting (reference: reference_counter.h "contained in
+# owned object" tracking).
+_ref_collector = threading.local()
+
+
+class collect_contained_refs:
+    """Context manager yielding the list of ObjectIDs pickled within."""
+
+    def __enter__(self):
+        self._prev = getattr(_ref_collector, "refs", None)
+        _ref_collector.refs = []
+        return _ref_collector.refs
+
+    def __exit__(self, *exc):
+        _ref_collector.refs = self._prev
+        return False
+
+
+def note_ref(object_id) -> None:
+    refs = getattr(_ref_collector, "refs", None)
+    if refs is not None:
+        refs.append(object_id)
 
 ALIGNMENT = 64
 # Buffers below this size are serialized in-band; pickle5 callbacks only

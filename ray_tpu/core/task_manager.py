@@ -47,29 +47,63 @@ class ReferenceCounter:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock: the deleter may recursively remove refs pinned by the
+        # deleted object (nested references)
+        self._lock = threading.RLock()
         self._counts: Dict[ObjectID, int] = {}
         self._deleter: Optional[Callable[[ObjectID], None]] = None
+        self._on_first: Optional[Callable[[ObjectID], None]] = None
 
     def set_deleter(self, fn: Callable[[ObjectID], None]) -> None:
         self._deleter = fn
 
-    def add_local_reference(self, object_id: ObjectID) -> None:
-        with self._lock:
-            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+    def set_on_first(self, fn: Callable[[ObjectID], None]) -> None:
+        """Hook fired when an object's local count goes 0 -> 1 (workers
+        use it to report borrowed refs to the owner, reference:
+        reference_counter.h borrowing protocol)."""
+        self._on_first = fn
 
-    def remove_local_reference(self, object_id: ObjectID) -> None:
-        deleter = None
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        # hooks fire under the lock so ADD/DROP notifications are emitted
+        # in count-transition order even across threads
+        with self._lock:
+            count = self._counts.get(object_id, 0)
+            self._counts[object_id] = count + 1
+            if count == 0 and self._on_first is not None:
+                try:
+                    self._on_first(object_id)
+                except Exception:
+                    pass
+
+    def remove_local_reference(self, object_id: ObjectID,
+                               defer: Optional[tuple] = None) -> None:
+        """Drop one reference. `defer=(delay_s, schedule_fn)` delays the
+        zero-count deleter by `delay_s` via `schedule_fn(delay, fn)`,
+        firing only if the count is still zero then (grace window for
+        in-flight borrows)."""
         with self._lock:
             count = self._counts.get(object_id)
             if count is None:
                 return
-            if count <= 1:
-                del self._counts[object_id]
-                deleter = self._deleter
-            else:
+            if count > 1:
                 self._counts[object_id] = count - 1
-        if deleter is not None:
+                return
+            del self._counts[object_id]
+            deleter = self._deleter
+            if deleter is not None and defer is None:
+                try:
+                    deleter(object_id)
+                except Exception:
+                    pass
+        if deleter is not None and defer is not None:
+            delay, schedule = defer
+            schedule(delay,
+                     lambda: self._delete_if_still_zero(object_id, deleter))
+
+    def _delete_if_still_zero(self, object_id: ObjectID, deleter) -> None:
+        with self._lock:
+            if self._counts.get(object_id, 0) > 0:
+                return  # re-borrowed during the grace window
             try:
                 deleter(object_id)
             except Exception:

@@ -45,7 +45,16 @@ class WorkerRuntime:
         self.store = store
         self.node_id = node_id
         self.worker_id = worker_id
-        self.reference_counter = ReferenceCounter()  # no-op holder for refs
+        # Borrowed-ref reporting: the first local ref to an object pins
+        # it at the owner (REF_ADD); the last drop releases it
+        # (REF_DROP). reference: reference_counter.h:43 borrowing.
+        self.reference_counter = ReferenceCounter()
+        self.reference_counter.set_on_first(
+            lambda oid: self.conn.send(
+                {"kind": "REF_ADD", "object_id": oid.binary()}))
+        self.reference_counter.set_deleter(
+            lambda oid: self.conn.send(
+                {"kind": "REF_DROP", "object_id": oid.binary()}))
         self.is_driver = False
         self._req_lock = threading.Lock()
         self._req_counter = 0
@@ -89,24 +98,31 @@ class WorkerRuntime:
 
     # --- object plane ---------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
-        data, buffers = serialization.serialize(value)
-        return self.put_serialized(data, buffers)
+        with serialization.collect_contained_refs() as contained:
+            data, buffers = serialization.serialize(value)
+        return self.put_serialized(
+            data, buffers, contained=[o.binary() for o in contained])
 
-    def put_serialized(self, data: bytes, buffers) -> ObjectRef:
+    def put_serialized(self, data: bytes, buffers, contained=()) -> ObjectRef:
         # Random IDs: a retried task attempt must not collide with the
         # puts of its previous attempt (the ID travels in the returned
         # ref + PUT_META, so determinism buys nothing).
         oid = ObjectID.from_random()
         self.store.put_parts(oid, data, buffers, [b.nbytes for b in buffers])
-        self.conn.send({"kind": "PUT_META", "object_id": oid.binary()})
+        self.conn.send({"kind": "PUT_META", "object_id": oid.binary(),
+                        "contained": list(contained)})
         return ObjectRef(oid)
 
-    def put_result(self, oid: ObjectID, value: Any) -> Tuple[str, Any]:
-        """Store a task return; small values go inline in the reply."""
-        data, buffers = serialization.serialize(value)
+    def put_result(self, oid: ObjectID, value: Any) -> Tuple[str, Any, list]:
+        """Store a task return; small values go inline in the reply.
+        Returns (kind, payload, contained_ref_binaries)."""
+        with serialization.collect_contained_refs() as contained:
+            data, buffers = serialization.serialize(value)
+        contained_bin = [o.binary() for o in contained]
         from ray_tpu.core.config import get_config
         if not buffers and len(data) < get_config().max_inline_object_size:
-            return ("inline", serialization.pack_parts(data, buffers))
+            return ("inline", serialization.pack_parts(data, buffers),
+                    contained_bin)
         sizes = [b.nbytes for b in buffers]
         packed_len = serialization.packed_size(data, sizes)
         dest = self.store.create(oid, packed_len)
@@ -115,7 +131,7 @@ class WorkerRuntime:
         finally:
             del dest
         self.store.seal(oid)
-        return ("shm", None)
+        return ("shm", None, contained_bin)
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -268,8 +284,8 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
             result_values = _split_returns(result, spec.num_returns)
         results = []
         for oid, value in zip(spec.return_ids(), result_values):
-            kind, data = rt.put_result(oid, value)
-            results.append((oid.binary(), kind, data))
+            kind, data, contained = rt.put_result(oid, value)
+            results.append((oid.binary(), kind, data, contained))
         reply["results"] = results
         reply["error"] = None
     except Exception as e:  # noqa: BLE001 — user code may raise anything

@@ -1,0 +1,1 @@
+"""Experimental subsystems (reference: python/ray/experimental/)."""

@@ -1,0 +1,147 @@
+"""Device object store: ObjectRefs whose payload stays on-device (HBM).
+
+Reference: python/ray/experimental/gpu_object_manager/
+(gpu_object_manager.py:84, gpu_object_store.py) — "RDT" refs whose
+tensor payload never moves through plasma; only metadata does, and
+transfer happens out-of-band between the owning and consuming actors.
+
+TPU-native stance (SURVEY.md §2.3 X6): there is no CUDA-IPC analog for
+HBM across host processes, and ICI collectives only exist inside jitted
+programs. So a device ref's payload lives in the *owner process's* JAX
+client; the object plane carries a small metadata record. Consumers on
+the same process get the live `jax.Array` (zero transfer); consumers
+elsewhere trigger one owner-side device→host copy, a shared-memory hop
+(zero-copy numpy both ways), and a `device_put` — the staging pattern
+the object plane is the right transport for on a TPU host. Same-mesh
+SPMD math should never use this path: keep arrays inside one jitted
+program and let XLA move bytes over ICI.
+
+Usage:
+    ref = device_objects.put(array)          # inside any actor/driver
+    arr = device_objects.get(ref)            # anywhere; device_put as needed
+    device_objects.free(ref)
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.core.actor import ActorHandle
+from ray_tpu.core.object_ref import ObjectRef
+
+# per-process payload registry: uid -> jax.Array
+_registry: Dict[bytes, Any] = {}
+
+
+@dataclass
+class DeviceObjectMeta:
+    """What actually travels through the object plane."""
+
+    uid: bytes
+    shape: Tuple[int, ...]
+    dtype: str
+    owner: Optional[ActorHandle]  # None => owned by the driver
+    # driver-owned objects inline a host copy (the driver serves no RPCs)
+    inline_host: Optional[np.ndarray] = field(default=None, repr=False)
+
+
+def _own_handle() -> Optional[ActorHandle]:
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime()
+    actor_id = getattr(rt, "actor_id", None)
+    if actor_id is None:
+        return None
+    return ActorHandle(actor_id, "<device-object-owner>", [])
+
+
+def put(array) -> ObjectRef:
+    """Register a device array; returns a ref to its metadata record."""
+    import ray_tpu
+
+    from ray_tpu.core import runtime as runtime_mod
+
+    uid = os.urandom(16)
+    owner = _own_handle()
+    inline = None
+    if owner is None:
+        # Driver or plain (non-actor) task: consumers can't call back in,
+        # so ship a host copy with the metadata. Only the driver keeps a
+        # registry entry (its process persists); a transient task worker
+        # must not pin HBM it can never be asked to free.
+        inline = np.asarray(array)
+        if getattr(runtime_mod.get_runtime(), "is_driver", False):
+            _registry[uid] = array
+    else:
+        _registry[uid] = array
+    meta = DeviceObjectMeta(
+        uid=uid, shape=tuple(array.shape), dtype=str(array.dtype),
+        owner=owner, inline_host=inline)
+    return ray_tpu.put(meta)
+
+
+def _export(instance, uid: bytes) -> np.ndarray:
+    """Owner-side fetch handler (runs via __ray_call__)."""
+    array = _registry.get(uid)
+    if array is None:
+        raise KeyError(f"device object {uid.hex()} was freed or never "
+                       "existed on this owner")
+    return np.asarray(array)  # device -> host
+
+
+def _drop(instance, uid: bytes) -> bool:
+    return _registry.pop(uid, None) is not None
+
+
+def _resolve_meta(ref, timeout) -> DeviceObjectMeta:
+    # task args holding the ref arrive pre-resolved as the meta record
+    if isinstance(ref, DeviceObjectMeta):
+        return ref
+    import ray_tpu
+    meta = ray_tpu.get(ref, timeout=timeout)
+    if not isinstance(meta, DeviceObjectMeta):
+        raise TypeError(f"{ref} is not a device object ref")
+    return meta
+
+
+def get(ref, *, device=None, sharding=None,
+        timeout: Optional[float] = 60.0):
+    """Resolve a device ref (or its meta record) to a jax.Array here.
+
+    Same-process: returns the live array. Remote: one owner device→host
+    copy + shm hop, then `device_put` onto `device`/`sharding` (default:
+    JAX's default device).
+    """
+    import jax
+    import ray_tpu
+
+    meta = _resolve_meta(ref, timeout)
+    local = _registry.get(meta.uid)
+    if local is not None:
+        if device is None and sharding is None:
+            return local
+        host = np.asarray(local)
+    elif meta.owner is None:
+        host = meta.inline_host
+    else:
+        fetch = meta.owner.__ray_call__.remote(_export, meta.uid)
+        host = ray_tpu.get(fetch, timeout=timeout)
+    placement = sharding or device
+    if placement is None:
+        return jax.numpy.asarray(host)
+    return jax.device_put(host, placement)
+
+
+def free(ref, timeout: Optional[float] = 30.0) -> None:
+    """Drop the device payload (metadata record stays until GC'd)."""
+    import ray_tpu
+
+    meta = _resolve_meta(ref, timeout)
+    if _registry.pop(meta.uid, None) is not None:
+        return
+    if meta.owner is not None:
+        ray_tpu.get(meta.owner.__ray_call__.remote(_drop, meta.uid),
+                    timeout=timeout)

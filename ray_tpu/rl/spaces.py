@@ -82,13 +82,15 @@ class Box(Space):
         return f"Box(shape={self.shape}, dtype={self.dtype})"
 
     def __eq__(self, other):
+        # exact comparison, consistent with __hash__
         return (isinstance(other, Box) and other.shape == self.shape
-                and np.allclose(other.low, self.low)
-                and np.allclose(other.high, self.high))
+                and other.dtype == self.dtype
+                and np.array_equal(other.low, self.low)
+                and np.array_equal(other.high, self.high))
 
     def __hash__(self):
-        return hash(("Box", self.shape, self.low.tobytes(),
-                     self.high.tobytes()))
+        return hash(("Box", self.shape, str(self.dtype),
+                     self.low.tobytes(), self.high.tobytes()))
 
 
 def flat_dim(space: Space) -> int:
