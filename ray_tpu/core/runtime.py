@@ -348,7 +348,11 @@ class DriverRuntime:
             oid_bytes, kind, data = result[:3]
             contained = result[3] if len(result) > 3 else ()
             oid = ObjectID(oid_bytes)
-            self._pin_contained(oid, contained)
+            # only pin nested refs while someone still holds the result;
+            # a fire-and-forget caller that dropped the ref must not leak
+            result_live = self.reference_counter.count(oid) > 0
+            if result_live:
+                self._pin_contained(oid, contained)
             if kind == "inline":
                 self.memory_store.put(oid, ("packed", bytes(data)))
                 self.task_manager.set_location(oid, ObjectLocation("memory"))
@@ -356,6 +360,8 @@ class DriverRuntime:
                 self.task_manager.set_location(
                     oid, ObjectLocation("shm", node.node_id))
             self.task_manager.mark_object_ready(oid)
+            if not result_live:
+                self._maybe_delete_object(oid)
         if spec.is_actor_creation:
             info = self.actors.get(spec.actor_id)
             record = self.gcs.get_actor(spec.actor_id)

@@ -1,0 +1,12 @@
+"""ray_tpu.llm — LLM serving and batch inference (reference:
+python/ray/llm). The engine is in-tree and TPU-native (static-shape KV
+caches, jitted whole-batch decode) instead of wrapping vLLM."""
+
+from ray_tpu.llm.engine import (
+    ContinuousBatchingEngine, EngineConfig, GenerationRequest)
+from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
+
+__all__ = [
+    "ByteTokenizer", "ContinuousBatchingEngine", "EngineConfig",
+    "GenerationRequest", "get_tokenizer",
+]

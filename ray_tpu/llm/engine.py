@@ -1,0 +1,244 @@
+"""Continuous-batching inference engine.
+
+Reference: the reference serves LLMs by wrapping vLLM
+(python/ray/llm/_internal/serve/engines/vllm/vllm_engine.py —
+continuous batching, paged KV). TPU-native redesign (JetStream-style):
+
+- The KV cache is ONE static-shape array pair [L, B, S, KVH, HD] in
+  HBM: XLA-friendly, no paging indirection — slot b of the batch
+  dimension is the "page table", assigned to one request at a time.
+- Decode is a single jitted step for the WHOLE batch every iteration;
+  requests join (prefill into a free slot) and leave (EOS/length)
+  between steps without recompiling — that is the continuous batching.
+- Prefill pads prompts into power-of-two buckets so only O(log S)
+  prefill programs ever compile.
+
+Sampling (temperature / top-k / greedy) is host-side numpy on [B, V]
+logits — tiny relative to the decode matmuls and trivially flexible.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.models.llama import (
+    LlamaConfig, llama_decode_step, llama_init, llama_init_cache,
+    llama_prefill)
+
+
+@dataclass
+class EngineConfig:
+    # default vocab covers the ByteTokenizer's 258 ids (256 bytes + BOS/EOS)
+    model: LlamaConfig = field(
+        default_factory=lambda: LlamaConfig.tiny(vocab_size=258))
+    max_batch: int = 8
+    max_seq: int = 512
+    tokenizer: Optional[str] = None  # None/"byte" or an HF id
+    seed: int = 0
+
+
+@dataclass
+class GenerationRequest:
+    prompt_ids: List[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    stop_ids: tuple = ()
+    request_id: int = field(default_factory=itertools.count().__next__)
+    # filled by the engine
+    output_ids: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+
+class _Slot:
+    def __init__(self, index: int):
+        self.index = index
+        self.request: Optional[GenerationRequest] = None
+        self.pos = 0            # position of the NEXT token to decode
+        self.next_token = 0
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, config: EngineConfig, params=None):
+        import jax
+        import jax.numpy as jnp
+
+        self.config = config
+        c = config.model
+        if params is None:
+            # random weights — real checkpoints load via orbax/train
+            params = llama_init(jax.random.PRNGKey(config.seed), c)
+        self.params = params
+        self._rng = np.random.default_rng(config.seed)
+        self.cache_k, self.cache_v = llama_init_cache(
+            c, config.max_batch, config.max_seq)
+        self.slots = [_Slot(i) for i in range(config.max_batch)]
+        self.waiting: List[GenerationRequest] = []
+        self._lock = threading.Lock()
+        self.total_generated = 0
+
+        def decode(params, cache_k, cache_v, tokens, pos):
+            return llama_decode_step(params, tokens, cache_k, cache_v,
+                                     pos, c)
+
+        def prefill(params, tokens):
+            logits, ks, vs = llama_prefill(params, tokens, c)
+            return logits, ks, vs
+
+        def insert(cache_k, cache_v, ks, vs, slot):
+            # in-place (donated) slot write — no whole-cache copy.
+            # ks/vs: [L, 1, bucket, KVH, HD] from a batch-1 prefill.
+            ck = jax.lax.dynamic_update_slice(
+                cache_k, ks, (0, slot, 0, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cache_v, vs, (0, slot, 0, 0, 0))
+            return ck, cv
+
+        self._decode = jax.jit(decode, donate_argnums=(1, 2))
+        self._prefill = jax.jit(prefill)
+        self._insert = jax.jit(insert, donate_argnums=(0, 1))
+        self._jnp = jnp
+
+    # ------------------------------------------------------------------
+    def add_request(self, request: GenerationRequest) -> GenerationRequest:
+        limit = self.config.max_seq - 1
+        if len(request.prompt_ids) > limit:
+            request.prompt_ids = request.prompt_ids[-limit:]
+        with self._lock:
+            self.waiting.append(request)
+        return request
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self.waiting) or any(
+                s.request is not None for s in self.slots)
+
+    def _free_slots(self) -> List[_Slot]:
+        return [s for s in self.slots if s.request is None]
+
+    def _admit(self) -> None:
+        """Prefill waiting requests into free slots."""
+        jnp = self._jnp
+        while True:
+            with self._lock:
+                if not self.waiting:
+                    return
+                free = self._free_slots()
+                if not free:
+                    return
+                request = self.waiting.pop(0)
+                slot = free[0]
+                slot.request = request
+            ids = request.prompt_ids
+            bucket = 1
+            while bucket < len(ids):
+                bucket *= 2
+            bucket = min(bucket, self.config.max_seq)
+            padded = np.zeros((1, bucket), dtype=np.int32)
+            padded[0, : len(ids)] = ids
+            logits, ks, vs = self._prefill(self.params, jnp.asarray(padded))
+            self.cache_k, self.cache_v = self._insert(
+                self.cache_k, self.cache_v, ks, vs, slot.index)
+            last = np.asarray(logits[0, len(ids) - 1])
+            slot.next_token = self._sample(last, request)
+            slot.pos = len(ids)
+            self._emit(slot, slot.next_token)
+
+    def _sample(self, logits: np.ndarray, request: GenerationRequest) -> int:
+        if request.temperature <= 0.0:
+            return int(np.argmax(logits))
+        logits = logits / request.temperature
+        if request.top_k > 0:
+            kth = np.partition(logits, -request.top_k)[-request.top_k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        logits = logits - logits.max()
+        probs = np.exp(logits)
+        probs /= probs.sum()
+        return int(self._rng.choice(len(probs), p=probs))
+
+    def _emit(self, slot: _Slot, token: int) -> None:
+        request = slot.request
+        request.output_ids.append(token)
+        self.total_generated += 1
+        if token in request.stop_ids:
+            request.finish_reason = "stop"
+        elif len(request.output_ids) >= request.max_tokens:
+            request.finish_reason = "length"
+        elif slot.pos >= self.config.max_seq - 1:
+            request.finish_reason = "length"
+        if request.done:
+            slot.request = None
+
+    def step(self) -> int:
+        """Admit + one whole-batch decode step. Returns #active slots."""
+        self._admit()
+        active = [s for s in self.slots if s.request is not None]
+        if not active:
+            return 0
+        jnp = self._jnp
+        tokens = np.zeros(self.config.max_batch, dtype=np.int32)
+        pos = np.zeros(self.config.max_batch, dtype=np.int32)
+        for slot in active:
+            tokens[slot.index] = slot.next_token
+            pos[slot.index] = slot.pos
+        logits, self.cache_k, self.cache_v = self._decode(
+            self.params, self.cache_k, self.cache_v,
+            jnp.asarray(tokens), jnp.asarray(pos))
+        logits = np.asarray(logits)
+        for slot in active:
+            slot.pos += 1
+            slot.next_token = self._sample(logits[slot.index], slot.request)
+            self._emit(slot, slot.next_token)
+        return len(active)
+
+    # ------------------------------------------------------------------
+    def generate(self, prompts_ids: List[List[int]], *,
+                 max_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, stop_ids: tuple = ()) -> List[List[int]]:
+        """Synchronous batch API: token ids in, token ids out."""
+        requests = [
+            self.add_request(GenerationRequest(
+                prompt_ids=ids, max_tokens=max_tokens,
+                temperature=temperature, top_k=top_k, stop_ids=stop_ids))
+            for ids in prompts_ids]
+        while any(not r.done for r in requests):
+            if self.step() == 0 and any(not r.done for r in requests):
+                # nothing active yet (all waiting on slots) — admit again
+                time.sleep(0)
+        return [r.output_ids for r in requests]
+
+    def fail_all(self, message: str) -> None:
+        """Abort every waiting and active request with an error (used by
+        serving loops when a step raises — requests must not hang)."""
+        with self._lock:
+            pending = list(self.waiting)
+            self.waiting.clear()
+        for request in pending:
+            request.error = message
+            request.finish_reason = "error"
+        for slot in self.slots:
+            if slot.request is not None:
+                slot.request.error = message
+                slot.request.finish_reason = "error"
+                slot.request = None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "waiting": len(self.waiting),
+                "active": sum(1 for s in self.slots
+                              if s.request is not None),
+                "max_batch": self.config.max_batch,
+                "total_generated": self.total_generated,
+            }

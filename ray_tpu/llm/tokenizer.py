@@ -1,0 +1,48 @@
+"""Tokenizers for the LLM stack.
+
+ByteTokenizer is the hermetic default (UTF-8 bytes + specials) so tests
+and benches never need weight/tokenizer downloads; HF tokenizers load
+through `transformers` when a model id is given (reference: the
+reference's serving stack resolves HF tokenizers the same way).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class ByteTokenizer:
+    """ids 0..255 = bytes; 256 = BOS; 257 = EOS."""
+
+    bos_id = 256
+    eos_id = 257
+    vocab_size = 258
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", "replace")
+
+
+class HFTokenizer:
+    def __init__(self, name: str):
+        from transformers import AutoTokenizer
+        self._tok = AutoTokenizer.from_pretrained(name)
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
+        self.vocab_size = self._tok.vocab_size
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: List[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def get_tokenizer(name: Optional[str] = None):
+    if name is None or name == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(name)

@@ -234,3 +234,102 @@ def llama_sharding_rules(mode: str = "fsdp_tp") -> ShardingRules:
             (r".*", P()),
         ])
     raise ValueError(f"unknown sharding mode {mode}")
+
+
+# ---------------------------------------------------------------------------
+# Inference: KV-cache prefill + single-token decode
+# (reference analog: the vLLM engine the reference wraps for serving,
+# python/ray/llm/_internal/serve/engines/vllm/ — here the engine is
+# in-tree and TPU-native: static-shape caches, jitted decode over the
+# whole batch, continuous batching handled by ray_tpu.llm.engine)
+# ---------------------------------------------------------------------------
+
+def llama_init_cache(config: LlamaConfig, batch: int, max_seq: int):
+    """KV cache pair, each [L, B, S, KVH, HD] in the model dtype."""
+    c = config
+    shape = (c.n_layers, batch, max_seq, c.n_kv_heads, c.head_dim)
+    return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
+
+
+def llama_prefill(params, tokens, config: LlamaConfig):
+    """Forward over a padded prompt, keeping per-layer K/V.
+
+    tokens: [B, S] int32 -> (logits [B, S, vocab] f32,
+    k [L, B, S, KVH, HD], v [L, B, S, KVH, HD]). Positions are arange;
+    junk K/V at padding positions is never attended later because decode
+    masks by true position.
+    """
+    c = config
+    b, s = tokens.shape
+    hd = c.head_dim
+    x = params["embedding"][tokens].astype(c.dtype)
+    cos, sin = rope_frequencies(hd, s, c.rope_theta)
+
+    def body(x, layer_params):
+        h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
+        q = (h @ layer_params["wq"]).reshape(b, s, c.n_heads, hd)
+        k = (h @ layer_params["wk"]).reshape(b, s, c.n_kv_heads, hd)
+        v = (h @ layer_params["wv"]).reshape(b, s, c.n_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = _attention(q, k, v, c, None)
+        x = x + attn.reshape(b, s, c.n_heads * hd) @ layer_params["wo"]
+        h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
+        x = x + (jax.nn.silu(h @ layer_params["w1"])
+                 * (h @ layer_params["w3"])) @ layer_params["w2"]
+        return x, (k, v)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def llama_decode_step(params, token, cache_k, cache_v, pos,
+                      config: LlamaConfig):
+    """One token for every sequence in the batch.
+
+    token: [B] int32 (the token at position `pos`); pos: [B] int32;
+    cache_k/v: [L, B, S, KVH, HD]. Returns (logits [B, vocab] f32,
+    cache_k, cache_v) with the new K/V written at `pos`.
+    """
+    c = config
+    n_layers, b, s, kvh, hd = cache_k.shape
+    n_rep = c.n_heads // c.n_kv_heads
+    x = params["embedding"][token][:, None, :].astype(c.dtype)  # [B,1,D]
+    cos, sin = rope_frequencies(hd, s, c.rope_theta)
+    pos_2d = pos[:, None]                                       # [B,1]
+    # causal visibility: this token may attend to cache slots <= pos
+    visible = jnp.arange(s)[None, :] <= pos_2d                  # [B,S]
+
+    def body(x, layer):
+        layer_params, ck, cv = layer                            # ck [B,S,KVH,HD]
+        h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
+        q = (h @ layer_params["wq"]).reshape(b, 1, c.n_heads, hd)
+        k = (h @ layer_params["wk"]).reshape(b, 1, kvh, hd)
+        v = (h @ layer_params["wv"]).reshape(b, 1, kvh, hd)
+        q = apply_rope(q, cos, sin, positions=pos_2d)
+        k = apply_rope(k, cos, sin, positions=pos_2d)
+        write = jax.vmap(
+            lambda cache, new, p: jax.lax.dynamic_update_slice(
+                cache, new, (p, 0, 0)))
+        ck = write(ck, k, pos)
+        cv = write(cv, v, pos)
+        kk = jnp.repeat(ck, n_rep, axis=2) if n_rep > 1 else ck
+        vv = jnp.repeat(cv, n_rep, axis=2) if n_rep > 1 else cv
+        scores = jnp.einsum("bqhd,bshd->bhqs", q, kk).astype(jnp.float32)
+        scores = scores * (hd ** -0.5)
+        scores = jnp.where(visible[:, None, None, :], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bhqs,bshd->bqhd", weights, vv)
+        x = x + attn.reshape(b, 1, c.n_heads * hd) @ layer_params["wo"]
+        h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
+        x = x + (jax.nn.silu(h @ layer_params["w1"])
+                 * (h @ layer_params["w3"])) @ layer_params["w2"]
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_k, new_v

@@ -250,7 +250,7 @@ class ServeController:
                     st.config.user_config)
                 new[rid] = handle
             # wait for constructors so routers never see half-born replicas
-            for rid, h in new.items():
+            for rid, h in list(new.items()):  # failures pop from `new`
                 try:
                     ray_tpu.get(h.check_health.remote(), timeout=60.0)
                 except Exception:

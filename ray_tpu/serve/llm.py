@@ -1,0 +1,194 @@
+"""Serve LLM layer: LLMConfig, LLMServer, build_openai_app.
+
+Reference: python/ray/serve/llm/__init__.py:33,75,178 (LLMConfig,
+LLMServer, build_openai_app over a vLLM engine). Here the engine is the
+in-tree TPU-native continuous-batching engine (ray_tpu.llm.engine); the
+OpenAI-compatible surface exposes /v1/completions and
+/v1/chat/completions through the serve HTTP proxy.
+
+A replica owns one engine plus a background stepper thread; concurrent
+requests land in the engine's waiting queue and share decode batches —
+the continuous-batching path the reference gets from vLLM.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import serve
+from ray_tpu.llm.engine import (
+    ContinuousBatchingEngine, EngineConfig, GenerationRequest)
+from ray_tpu.llm.tokenizer import get_tokenizer
+
+
+@dataclass
+class LLMConfig:
+    """Reference analog: serve/llm LLMConfig (model_loading_config +
+    engine_kwargs + deployment_config)."""
+
+    model_id: str = "llama-tiny"
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    num_replicas: int = 1
+    max_ongoing_requests: int = 16
+    # generation defaults
+    max_tokens: int = 64
+    temperature: float = 0.0
+
+
+class LLMServer:
+    """Deployment class hosting one engine per replica."""
+
+    def __init__(self, config: LLMConfig, params_blob: Optional[bytes] = None):
+        params = None
+        if params_blob is not None:
+            from ray_tpu.core import serialization
+            params = serialization.loads(params_blob)
+        self.config = config
+        self.engine = ContinuousBatchingEngine(config.engine, params)
+        self.tokenizer = get_tokenizer(config.engine.tokenizer)
+        if self.tokenizer.vocab_size > config.engine.model.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab ({self.tokenizer.vocab_size}) exceeds "
+                f"model vocab ({config.engine.model.vocab_size}); token "
+                "embedding lookups would silently clamp")
+        self._wake = threading.Event()
+        self._stepper = threading.Thread(target=self._step_loop,
+                                         daemon=True)
+        self._stepper.start()
+
+    def _step_loop(self) -> None:
+        while True:
+            try:
+                if self.engine.has_work():
+                    self.engine.step()
+                else:
+                    self._wake.wait(0.002)
+                    self._wake.clear()
+            except Exception as e:  # noqa: BLE001 — keep serving
+                # fail in-flight requests instead of hanging them; the
+                # engine stays up for subsequent requests
+                self.engine.fail_all(f"engine step failed: {e!r}")
+
+    def _generate(self, prompt: str, *, max_tokens: Optional[int] = None,
+                  temperature: Optional[float] = None,
+                  top_k: int = 0) -> Dict[str, Any]:
+        ids = self.tokenizer.encode(prompt)
+        request = GenerationRequest(
+            prompt_ids=ids,
+            max_tokens=max_tokens or self.config.max_tokens,
+            temperature=(self.config.temperature if temperature is None
+                         else temperature),
+            top_k=top_k,
+            stop_ids=(self.tokenizer.eos_id,)
+            if self.tokenizer.eos_id is not None else ())
+        self.engine.add_request(request)
+        self._wake.set()
+        while not request.done:
+            time.sleep(0.001)
+        if request.error is not None:
+            raise RuntimeError(request.error)
+        out_ids = [i for i in request.output_ids
+                   if i not in request.stop_ids]
+        return {
+            "text": self.tokenizer.decode(out_ids),
+            "prompt_tokens": len(ids),
+            "completion_tokens": len(request.output_ids),
+            "finish_reason": request.finish_reason,
+        }
+
+    # -- OpenAI-compatible surface (routed by path) --------------------
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        path = request.get("__path__", "")
+        if path.endswith("/chat/completions"):
+            return self.chat_completions(request)
+        if path.endswith("/completions"):
+            return self.completions(request)
+        if path.endswith("/models"):
+            return {"object": "list",
+                    "data": [{"id": self.config.model_id,
+                              "object": "model"}]}
+        if path.endswith("/stats"):
+            return self.engine.stats()
+        return {"error": f"unknown route {path!r}"}
+
+    def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        prompt = body.get("prompt", "")
+        result = self._generate(
+            prompt,
+            max_tokens=body.get("max_tokens"),
+            temperature=body.get("temperature"),
+            top_k=body.get("top_k", 0))
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "model": body.get("model", self.config.model_id),
+            "choices": [{
+                "index": 0,
+                "text": result["text"],
+                "finish_reason": result["finish_reason"],
+            }],
+            "usage": {
+                "prompt_tokens": result["prompt_tokens"],
+                "completion_tokens": result["completion_tokens"],
+                "total_tokens": (result["prompt_tokens"]
+                                 + result["completion_tokens"]),
+            },
+        }
+
+    def chat_completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        messages = body.get("messages", [])
+        prompt = "".join(
+            f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
+            for m in messages) + "<|assistant|>"
+        result = self._generate(
+            prompt,
+            max_tokens=body.get("max_tokens"),
+            temperature=body.get("temperature"))
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "model": body.get("model", self.config.model_id),
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": result["text"]},
+                "finish_reason": result["finish_reason"],
+            }],
+            "usage": {
+                "prompt_tokens": result["prompt_tokens"],
+                "completion_tokens": result["completion_tokens"],
+                "total_tokens": (result["prompt_tokens"]
+                                 + result["completion_tokens"]),
+            },
+        }
+
+
+def build_llm_deployment(config: LLMConfig, params=None,
+                         name: Optional[str] = None):
+    """An Application serving `config` (reference:
+    serve/llm build_llm_deployment)."""
+    params_blob = None
+    if params is not None:
+        from ray_tpu.core import serialization
+        params_blob = serialization.dumps(params)
+    dep = serve.deployment(
+        LLMServer,
+        name=name or config.model_id,
+        num_replicas=config.num_replicas,
+        max_ongoing_requests=config.max_ongoing_requests)
+    return dep.bind(config, params_blob)
+
+
+def build_openai_app(llm_configs: List[LLMConfig] = None, *,
+                     config: LLMConfig = None, params=None):
+    """OpenAI-compatible app (reference: serve/llm build_openai_app).
+    Single-model per app in this round; multi-model routing via model
+    multiplexing is future work."""
+    if config is None:
+        configs = llm_configs or [LLMConfig()]
+        config = configs[0]
+    return build_llm_deployment(config, params=params)

@@ -73,11 +73,17 @@ def _make_handler(state: _ProxyState):
             if match is None:
                 self._respond(404, {"error": f"no route for {parsed.path}"})
                 return
-            dep, _rest = match
+            dep, rest = match
             request: Dict[str, Any] = dict(
                 urllib.parse.parse_qsl(parsed.query))
             if body:
                 request.update(body)
+            # Sub-path routing (e.g. the OpenAI /v1/* surface): expose
+            # the remainder under the reserved "__path__" key. Root
+            # requests keep a pristine payload, so plain deployments
+            # never see routing metadata.
+            if rest != "/":
+                request["__path__"] = rest
             try:
                 handle = DeploymentHandle(dep)
                 result = handle.remote(request).result(timeout_s=60.0)
