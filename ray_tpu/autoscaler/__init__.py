@@ -1,0 +1,13 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling (reference:
+python/ray/autoscaler — StandardAutoscaler v1 loop + ResourceDemandScheduler
+bin-packing + pluggable NodeProviders, and the v2 instance manager)."""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.config import AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import (
+    FakeMultiNodeProvider, NodeProvider)
+
+__all__ = [
+    "AutoscalerConfig", "FakeMultiNodeProvider", "NodeProvider",
+    "NodeTypeConfig", "StandardAutoscaler",
+]

@@ -1,0 +1,162 @@
+"""StandardAutoscaler: the demand → bin-pack → launch/terminate loop.
+
+Reference: autoscaler/_private/autoscaler.py:172 (StandardAutoscaler,
+update at :367) and resource_demand_scheduler.py:100 (bin-packing unmet
+demand onto hypothetical nodes of each type). One update round:
+
+1. read unmet demand from the runtime (backlog + infeasible tasks);
+2. subtract capacity already free on live nodes;
+3. first-fit-decreasing pack the remainder onto copies of each node
+   type (respecting max_workers) → launch list;
+4. enforce min_workers;
+5. terminate nodes idle longer than idle_timeout_s (never the head).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.config import AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in need.items() if v > 0)
+
+
+def _take(avail: Dict[str, float], need: Dict[str, float]) -> None:
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class StandardAutoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
+                 runtime=None):
+        from ray_tpu.core import runtime as runtime_mod
+        self.config = config
+        self.provider = provider
+        self.runtime = runtime or runtime_mod.get_runtime()
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- the core round -------------------------------------------------
+    def update(self) -> Dict[str, int]:
+        """One reconciliation round; returns {type: launched_count}."""
+        launched: Dict[str, int] = {}
+        live = self.provider.non_terminated_nodes()
+        counts: Dict[str, int] = {}
+        for type_name in live.values():
+            counts[type_name] = counts.get(type_name, 0) + 1
+
+        # 1-2. unmet demand minus free capacity on live nodes
+        demand = self.runtime.resource_demand()
+        free = [dict(r.available)
+                for r in self.runtime.scheduler.snapshot().values()]
+        unmet: List[Dict[str, float]] = []
+        for need in sorted(demand, key=lambda d: -sum(d.values())):
+            for avail in free:
+                if _fits(avail, need):
+                    _take(avail, need)
+                    break
+            else:
+                unmet.append(need)
+
+        # 3. pack the remainder onto new nodes, type by type
+        to_launch: List[NodeTypeConfig] = []
+        if unmet:
+            virtual: List[tuple] = []  # (avail dict, node_type)
+            for need in unmet:
+                placed = False
+                for avail, _ in virtual:
+                    if _fits(avail, need):
+                        _take(avail, need)
+                        placed = True
+                        break
+                if placed:
+                    continue
+                for nt in self.config.node_types:
+                    planned = (counts.get(nt.name, 0)
+                               + sum(1 for _, t in virtual
+                                     if t.name == nt.name))
+                    if planned >= nt.max_workers:
+                        continue
+                    if _fits(dict(nt.resources), need):
+                        avail = dict(nt.resources)
+                        _take(avail, need)
+                        virtual.append((avail, nt))
+                        placed = True
+                        break
+                # unplaceable on any type: permanently infeasible, skip
+            to_launch = [nt for _, nt in virtual]
+
+        # cap burst size by upscaling_speed
+        max_new = max(1, int(len(live) * self.config.upscaling_speed)) \
+            if live else len(to_launch) or 1
+        for nt in to_launch[:max_new]:
+            self.provider.create_node(nt)
+            launched[nt.name] = launched.get(nt.name, 0) + 1
+            counts[nt.name] = counts.get(nt.name, 0) + 1
+
+        # 4. min_workers floor
+        for nt in self.config.node_types:
+            while counts.get(nt.name, 0) < nt.min_workers:
+                self.provider.create_node(nt)
+                launched[nt.name] = launched.get(nt.name, 0) + 1
+                counts[nt.name] = counts.get(nt.name, 0) + 1
+
+        # 5. idle termination
+        self._terminate_idle(counts)
+        return launched
+
+    def _terminate_idle(self, counts: Dict[str, int]) -> None:
+        now = time.monotonic()
+        snapshot = self.runtime.scheduler.snapshot()
+        live = self.provider.non_terminated_nodes()
+        for pid, type_name in list(live.items()):
+            node_id = getattr(self.provider, "runtime_node_id",
+                              lambda p: None)(pid)
+            if node_id is None or node_id == self.runtime.head_node_id:
+                continue
+            res = snapshot.get(node_id)
+            if res is None:
+                continue
+            busy = any(res.available.get(k, 0.0) < v - 1e-9
+                       for k, v in res.total.items())
+            if busy:
+                self._idle_since.pop(pid, None)
+                continue
+            first_idle = self._idle_since.setdefault(pid, now)
+            nt = self.config.node_type(type_name)
+            floor = nt.min_workers if nt else 0
+            if (now - first_idle >= self.config.idle_timeout_s
+                    and counts.get(type_name, 0) > floor):
+                self.provider.terminate_node(pid)
+                self._idle_since.pop(pid, None)
+                counts[type_name] = counts.get(type_name, 0) - 1
+
+    # -- background loop ------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.update()
+                except Exception:  # noqa: BLE001 — keep scaling
+                    pass
+                self._stop.wait(self.config.update_interval_s)
+
+        self._thread = threading.Thread(target=loop, name="autoscaler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,33 @@
+"""Autoscaler configuration (reference: the cluster-YAML schema,
+autoscaler/ray-schema.json — available_node_types with resources,
+min_workers, max_workers; TPU note: a node type maps to a pod-slice
+granularity, e.g. one v5p host with {"TPU": 4} + slice labels)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: List[NodeTypeConfig] = field(default_factory=list)
+    idle_timeout_s: float = 60.0
+    # max fraction of current cluster size to add per update round
+    upscaling_speed: float = 1.0
+    update_interval_s: float = 1.0
+
+    def node_type(self, name: str) -> Optional[NodeTypeConfig]:
+        for nt in self.node_types:
+            if nt.name == name:
+                return nt
+        return None

@@ -1,0 +1,65 @@
+"""Node providers (reference: autoscaler/node_provider.py ABC with
+aws/gcp/... implementations; FakeMultiNodeProvider at
+autoscaler/_private/fake_multi_node/node_provider.py:237 simulates node
+launches for tests — the pattern adopted here)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.config import NodeTypeConfig
+
+
+class NodeProvider:
+    """Launch/terminate nodes of declared types."""
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        """Returns an opaque provider node id."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        """provider_node_id -> node_type name."""
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Adds/removes simulated nodes on the live runtime — a dev-box
+    stand-in for a cloud API, so autoscaling tests run hermetically
+    (e.g. node types claiming {"TPU": 4} simulate v5p hosts)."""
+
+    def __init__(self, runtime=None):
+        from ray_tpu.core import runtime as runtime_mod
+        self.runtime = runtime or runtime_mod.get_runtime()
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, tuple] = {}  # pid -> (node_id, type name)
+        self._counter = 0
+
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        node_id = self.runtime.add_node(
+            resources=dict(node_type.resources),
+            labels={"ray_tpu.io/node-type": node_type.name,
+                    **node_type.labels})
+        with self._lock:
+            self._counter += 1
+            pid = f"fake-{node_type.name}-{self._counter}"
+            self._nodes[pid] = (node_id, node_type.name)
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            entry = self._nodes.pop(provider_node_id, None)
+        if entry is not None:
+            self.runtime.remove_node(entry[0])
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        with self._lock:
+            return {pid: t for pid, (_, t) in self._nodes.items()}
+
+    def runtime_node_id(self, provider_node_id: str):
+        with self._lock:
+            entry = self._nodes.get(provider_node_id)
+        return entry[0] if entry else None

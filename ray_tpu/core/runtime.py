@@ -110,6 +110,10 @@ class DriverRuntime:
         self._sched_cond = threading.Condition()
         self._schedulable: deque = deque()
         self._infeasible: List[TaskSpec] = []
+        # snapshot of the scheduling backlog, refreshed each loop pass;
+        # read by the autoscaler's demand export (reference:
+        # gcs_autoscaler_state_manager.h pending-demand reporting)
+        self._backlog_view: List[TaskSpec] = []
         self._sched_thread = threading.Thread(
             target=self._scheduling_loop, name="scheduler", daemon=True)
         self.head_node_id = self.add_node(
@@ -240,10 +244,21 @@ class DriverRuntime:
                 self._record_event(spec, "SCHEDULED", node_id=node_id)
                 self.nodes[node_id].dispatch(spec)
                 made_progress = True
+            self._backlog_view = list(backlog)
             if backlog and not made_progress:
                 # All blocked on capacity; wait for a release/completion.
                 with self._sched_cond:
                     self._sched_cond.wait(timeout=0.05)
+
+    def resource_demand(self) -> List[Dict[str, float]]:
+        """Unmet resource requests: backlog (feasible but waiting on
+        capacity) + infeasible tasks. The autoscaler's input (reference:
+        gcs_autoscaler_state_manager.h:41 demand export)."""
+        with self._sched_cond:
+            infeasible = list(self._infeasible)
+        specs = self._backlog_view + infeasible
+        return [dict(self._spec_resources(s)) for s in specs
+                if s.resources]
 
     def _spec_resources(self, spec: TaskSpec) -> Dict[str, float]:
         from ray_tpu.core.scheduler import _pg_resources
@@ -348,11 +363,7 @@ class DriverRuntime:
             oid_bytes, kind, data = result[:3]
             contained = result[3] if len(result) > 3 else ()
             oid = ObjectID(oid_bytes)
-            # only pin nested refs while someone still holds the result;
-            # a fire-and-forget caller that dropped the ref must not leak
-            result_live = self.reference_counter.count(oid) > 0
-            if result_live:
-                self._pin_contained(oid, contained)
+            self._pin_contained(oid, contained)
             if kind == "inline":
                 self.memory_store.put(oid, ("packed", bytes(data)))
                 self.task_manager.set_location(oid, ObjectLocation("memory"))
@@ -360,8 +371,12 @@ class DriverRuntime:
                 self.task_manager.set_location(
                     oid, ObjectLocation("shm", node.node_id))
             self.task_manager.mark_object_ready(oid)
-            if not result_live:
-                self._maybe_delete_object(oid)
+            # fire-and-forget caller may have dropped the result ref
+            # already; reclaim after the borrow grace window (checked
+            # under the counter lock — races with REF_ADD are safe).
+            # Reclaiming the container also unpins its contained refs.
+            self.reference_counter.delete_if_unreferenced(
+                oid, defer=(self._ref_grace_s, self._schedule_expiry))
         if spec.is_actor_creation:
             info = self.actors.get(spec.actor_id)
             record = self.gcs.get_actor(spec.actor_id)

@@ -284,10 +284,6 @@ class ClusterScheduler:
                 return None
         return result
 
-    # --- autoscaler demand export --------------------------------------
-    def resource_demand(self, queued: List[TaskSpec]) -> List[Dict[str, float]]:
-        return [dict(t.resources) for t in queued]
-
 
 def _pg_resources(need: Dict[str, float], pg_id: PlacementGroupID,
                   bundle_index: int) -> Dict[str, float]:

@@ -100,6 +100,21 @@ class ReferenceCounter:
             schedule(delay,
                      lambda: self._delete_if_still_zero(object_id, deleter))
 
+    def delete_if_unreferenced(self, object_id: ObjectID,
+                               defer: Optional[tuple] = None) -> None:
+        """Fire the deleter iff no refs exist (checked under the lock at
+        fire time). With `defer=(delay, schedule)` the check happens
+        after the grace window, so in-flight borrows can land first."""
+        deleter = self._deleter
+        if deleter is None:
+            return
+        if defer is None:
+            self._delete_if_still_zero(object_id, deleter)
+            return
+        delay, schedule = defer
+        schedule(delay,
+                 lambda: self._delete_if_still_zero(object_id, deleter))
+
     def _delete_if_still_zero(self, object_id: ObjectID, deleter) -> None:
         with self._lock:
             if self._counts.get(object_id, 0) > 0:

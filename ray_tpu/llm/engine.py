@@ -219,8 +219,12 @@ class ContinuousBatchingEngine:
         return [r.output_ids for r in requests]
 
     def fail_all(self, message: str) -> None:
-        """Abort every waiting and active request with an error (used by
-        serving loops when a step raises — requests must not hang)."""
+        """Abort every waiting and active request with an error and
+        reset the KV caches (used by serving loops when a step raises —
+        requests must not hang). The cache reset matters: a failed
+        decode/insert may have consumed its donated buffers, leaving
+        self.cache_k/v deleted; without fresh caches every later step
+        would fail too."""
         with self._lock:
             pending = list(self.waiting)
             self.waiting.clear()
@@ -231,7 +235,11 @@ class ContinuousBatchingEngine:
             if slot.request is not None:
                 slot.request.error = message
                 slot.request.finish_reason = "error"
-                slot.request = None
+            slot.request = None
+            slot.pos = 0
+            slot.next_token = 0
+        self.cache_k, self.cache_v = llama_init_cache(
+            self.config.model, self.config.max_batch, self.config.max_seq)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -33,7 +33,9 @@ class HFTokenizer:
         self._tok = AutoTokenizer.from_pretrained(name)
         self.bos_id = self._tok.bos_token_id
         self.eos_id = self._tok.eos_token_id
-        self.vocab_size = self._tok.vocab_size
+        # len() includes added special tokens; .vocab_size does not, and
+        # added ids sit beyond it — the embedding bound must cover them
+        self.vocab_size = len(self._tok)
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         return self._tok.encode(text, add_special_tokens=add_bos)

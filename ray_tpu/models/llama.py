@@ -152,6 +152,8 @@ def _attention(q, k, v, config: LlamaConfig, mesh):
 
 
 def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh):
+    """One transformer block. Returns (x, (k, v)) — K/V are post-rope,
+    the layout the KV cache stores; training callers discard them."""
     c = config
     b, s, _ = x.shape
     hd = c.head_dim
@@ -167,7 +169,7 @@ def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh):
     gate = jax.nn.silu(h @ layer_params["w1"])
     up = h @ layer_params["w3"]
     x = x + (gate * up) @ layer_params["w2"]
-    return x
+    return x, (k, v)
 
 
 def llama_forward(params, tokens, config: LlamaConfig, mesh=None):
@@ -181,7 +183,8 @@ def llama_forward(params, tokens, config: LlamaConfig, mesh=None):
         block = jax.checkpoint(block)
 
     def scan_body(x, layer_params):
-        return block(layer_params, x, cos, sin), None
+        x, _kv = block(layer_params, x, cos, sin)
+        return x, None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
@@ -260,24 +263,12 @@ def llama_prefill(params, tokens, config: LlamaConfig):
     masks by true position.
     """
     c = config
-    b, s = tokens.shape
     hd = c.head_dim
     x = params["embedding"][tokens].astype(c.dtype)
-    cos, sin = rope_frequencies(hd, s, c.rope_theta)
+    cos, sin = rope_frequencies(hd, tokens.shape[1], c.rope_theta)
 
     def body(x, layer_params):
-        h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
-        q = (h @ layer_params["wq"]).reshape(b, s, c.n_heads, hd)
-        k = (h @ layer_params["wk"]).reshape(b, s, c.n_kv_heads, hd)
-        v = (h @ layer_params["wv"]).reshape(b, s, c.n_kv_heads, hd)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        attn = _attention(q, k, v, c, None)
-        x = x + attn.reshape(b, s, c.n_heads * hd) @ layer_params["wo"]
-        h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
-        x = x + (jax.nn.silu(h @ layer_params["w1"])
-                 * (h @ layer_params["w3"])) @ layer_params["w2"]
-        return x, (k, v)
+        return _block(layer_params, x, cos, sin, c, None)
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)

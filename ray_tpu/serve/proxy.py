@@ -79,9 +79,11 @@ def _make_handler(state: _ProxyState):
             if body:
                 request.update(body)
             # Sub-path routing (e.g. the OpenAI /v1/* surface): expose
-            # the remainder under the reserved "__path__" key. Root
-            # requests keep a pristine payload, so plain deployments
-            # never see routing metadata.
+            # the remainder under the reserved "__path__" key. Always
+            # strip any client-supplied value first — routing metadata
+            # must come from the proxy, never the payload. Root requests
+            # keep a pristine payload.
+            request.pop("__path__", None)
             if rest != "/":
                 request["__path__"] = rest
             try:

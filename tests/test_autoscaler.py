@@ -1,0 +1,148 @@
+"""Autoscaler tests (reference: autoscaler unit tests driving
+StandardAutoscaler.update with a fake provider,
+python/ray/tests/test_autoscaler.py + FakeMultiNodeProvider)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    AutoscalerConfig, FakeMultiNodeProvider, NodeTypeConfig,
+    StandardAutoscaler)
+
+
+@pytest.fixture
+def small_head():
+    rt = ray_tpu.init(num_cpus=1)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _autoscaler(rt, **cfg_kw):
+    config = AutoscalerConfig(**cfg_kw)
+    provider = FakeMultiNodeProvider(rt)
+    return StandardAutoscaler(config, provider, rt), provider
+
+
+def _wait_demand(rt, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if rt.resource_demand():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_scale_up_on_backlog(small_head):
+    rt = small_head
+    autoscaler, provider = _autoscaler(
+        rt, node_types=[NodeTypeConfig("cpu2", {"CPU": 2.0},
+                                       max_workers=4)])
+
+    @ray_tpu.remote(num_cpus=2)
+    def work(x):
+        time.sleep(0.2)
+        return x + 1
+
+    refs = [work.remote(i) for i in range(3)]
+    assert _wait_demand(rt)
+    autoscaler.update()
+    assert len(provider.non_terminated_nodes()) >= 1
+    # more rounds may be needed while tasks queue
+    for _ in range(5):
+        autoscaler.update()
+        time.sleep(0.05)
+    assert ray_tpu.get(refs, timeout=60) == [1, 2, 3]
+
+
+def test_infeasible_tpu_demand_launches_tpu_node(small_head):
+    rt = small_head
+    autoscaler, provider = _autoscaler(
+        rt, node_types=[
+            NodeTypeConfig("cpu2", {"CPU": 2.0}, max_workers=2),
+            NodeTypeConfig("v5p-host", {"CPU": 8.0, "TPU": 4.0},
+                           max_workers=2,
+                           labels={"tpu-pod-type": "v5p-8"}),
+        ])
+
+    @ray_tpu.remote(resources={"TPU": 4})
+    def on_tpu():
+        return "ok"
+
+    ref = on_tpu.remote()
+    assert _wait_demand(rt)
+    launched = autoscaler.update()
+    assert launched.get("v5p-host") == 1
+    assert ray_tpu.get(ref, timeout=60) == "ok"
+
+
+def test_min_workers_floor(small_head):
+    rt = small_head
+    autoscaler, provider = _autoscaler(
+        rt, node_types=[NodeTypeConfig("cpu1", {"CPU": 1.0},
+                                       min_workers=2, max_workers=4)])
+    autoscaler.update()
+    nodes = provider.non_terminated_nodes()
+    assert sum(1 for t in nodes.values() if t == "cpu1") == 2
+
+
+def test_max_workers_cap(small_head):
+    rt = small_head
+    autoscaler, provider = _autoscaler(
+        rt, node_types=[NodeTypeConfig("cpu2", {"CPU": 2.0},
+                                       max_workers=2)])
+
+    @ray_tpu.remote(num_cpus=2)
+    def work():
+        time.sleep(0.5)
+
+    refs = [work.remote() for _ in range(8)]
+    assert _wait_demand(rt)
+    for _ in range(4):
+        autoscaler.update()
+    nodes = provider.non_terminated_nodes()
+    assert sum(1 for t in nodes.values() if t == "cpu2") <= 2
+    ray_tpu.get(refs, timeout=60)
+
+
+def test_idle_nodes_terminated(small_head):
+    rt = small_head
+    autoscaler, provider = _autoscaler(
+        rt,
+        node_types=[NodeTypeConfig("cpu2", {"CPU": 2.0}, max_workers=2)],
+        idle_timeout_s=0.1)
+
+    @ray_tpu.remote(num_cpus=2)
+    def work():
+        return 1
+
+    ref = work.remote()
+    assert _wait_demand(rt)
+    autoscaler.update()
+    assert len(provider.non_terminated_nodes()) == 1
+    assert ray_tpu.get(ref, timeout=60) == 1
+    time.sleep(0.3)
+    autoscaler.update()  # marks idle
+    time.sleep(0.3)
+    autoscaler.update()  # past idle_timeout -> terminate
+    assert len(provider.non_terminated_nodes()) == 0
+
+
+def test_background_loop(small_head):
+    rt = small_head
+    autoscaler, provider = _autoscaler(
+        rt,
+        node_types=[NodeTypeConfig("cpu1", {"CPU": 1.0}, min_workers=1,
+                                   max_workers=2)],
+        update_interval_s=0.05)
+    autoscaler.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if provider.non_terminated_nodes():
+                break
+            time.sleep(0.02)
+        assert provider.non_terminated_nodes()
+    finally:
+        autoscaler.stop()
