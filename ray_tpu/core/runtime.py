@@ -11,6 +11,7 @@ many Nodes in this one process — reference: python/ray/cluster_utils.py).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -96,6 +97,11 @@ class DriverRuntime:
         self._expiry_thread = threading.Thread(
             target=self._expiry_loop, name="ref-expiry", daemon=True)
         self._expiry_thread.start()
+        # periodic state snapshot for the out-of-process CLI
+        # (reference: the dashboard state aggregator; here a JSON file)
+        self._state_dump_thread = threading.Thread(
+            target=self._state_dump_loop, name="state-dump", daemon=True)
+        self._state_dump_thread.start()
         self.memory_store = MemoryStore()
         self.namespace = namespace
         self.job_id = JobID.from_random()
@@ -623,6 +629,9 @@ class DriverRuntime:
     def _maybe_delete_object(self, oid: ObjectID) -> None:
         """Called when the local reference count drops to zero
         (reference: reference_counter.h — delete at refcount 0)."""
+        stopped = getattr(self, "_stopped", None)
+        if stopped is not None and stopped.is_set():
+            return  # shutdown: shm arenas may already be unmapped
         if not self.task_manager.is_ready(oid):
             return  # producing task still running; keep bookkeeping
         self.memory_store.delete(oid)
@@ -640,19 +649,51 @@ class DriverRuntime:
 
     def _expiry_loop(self) -> None:
         import heapq
-        while True:
+        while getattr(self, "_stopped", None) is None:
+            time.sleep(0.05)  # started early in __init__
+        while not self._stopped.is_set():
             with self._expiry_cv:
                 while not self._expiry_items:
-                    self._expiry_cv.wait()
+                    self._expiry_cv.wait(0.5)
+                    if self._stopped.is_set():
+                        return
                 deadline, _, fn = self._expiry_items[0]
                 now = time.monotonic()
                 if deadline > now:
-                    self._expiry_cv.wait(deadline - now)
+                    self._expiry_cv.wait(min(deadline - now, 0.5))
                     continue
                 heapq.heappop(self._expiry_items)
             try:
                 fn()
             except Exception:
+                pass
+
+    def _state_dump_loop(self) -> None:
+        import json
+        import tempfile
+        pointer = os.path.join(tempfile.gettempdir(),
+                               "ray_tpu_last_session.json")
+        # this thread starts early in __init__, before _stopped exists
+        while getattr(self, "_stopped", None) is None:
+            time.sleep(0.05)
+        while not self._stopped.wait(2.0):
+            try:
+                from ray_tpu.util import state as state_mod
+                head = self.nodes.get(self.head_node_id)
+                if head is None:
+                    continue
+                path = os.path.join(head.session_dir, "state.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(state_mod.state_snapshot(), f)
+                os.replace(tmp, path)
+                pointer_tmp = f"{pointer}.{os.getpid()}.tmp"
+                with open(pointer_tmp, "w") as f:
+                    json.dump({"state_path": path,
+                               "session_dir": head.session_dir,
+                               "pid": os.getpid()}, f)
+                os.replace(pointer_tmp, pointer)
+            except Exception:  # noqa: BLE001 — observability best-effort
                 pass
 
     def _schedule_expiry(self, delay: float, fn) -> None:
@@ -768,6 +809,12 @@ class DriverRuntime:
             return self.cluster_resources()
         if method == "available_resources":
             return self.available_resources()
+        if method == "metrics_apply":
+            from ray_tpu.util.metrics import _registry
+            kind, name, tag_items, value, boundaries = args
+            _registry.apply(kind, name, tuple(tag_items), value,
+                            boundaries)
+            return True
         raise ValueError(f"unknown GCS method {method}")
 
     # --- misc api --------------------------------------------------------

@@ -1,0 +1,184 @@
+"""Job submission (reference: python/ray/dashboard/modules/job/ — the
+JobSubmissionClient SDK + job manager that runs entrypoint commands,
+tracks status, and serves logs).
+
+Jobs are driver programs: each entrypoint runs as a subprocess with its
+own runtime (the reference runs them on the head node the same way).
+Status and metadata live in the GCS KV under the "jobs" namespace; logs
+stream to a per-job file in the session dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class _JobEntry:
+    def __init__(self, submission_id: str, entrypoint: str, log_path: str,
+                 metadata: Optional[Dict[str, str]]):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.log_path = log_path
+        self.metadata = metadata or {}
+        self.status = JobStatus.PENDING
+        self.start_time = time.time()
+        self.end_time: Optional[float] = None
+        self.proc: Optional[subprocess.Popen] = None
+        self.message = ""
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "submission_id": self.submission_id,
+            "entrypoint": self.entrypoint,
+            "status": self.status,
+            "message": self.message,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "metadata": self.metadata,
+        }
+
+
+class JobSubmissionClient:
+    """In-process manager + SDK (reference:
+    python/ray/dashboard/modules/job/sdk.py JobSubmissionClient)."""
+
+    _singleton: Optional["JobSubmissionClient"] = None
+    _singleton_lock = threading.Lock()
+
+    def __init__(self, address: Optional[str] = None):
+        from ray_tpu.core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime()
+        if rt is None or not getattr(rt, "is_driver", False):
+            raise RuntimeError("JobSubmissionClient needs an initialized "
+                               "driver (ray_tpu.init)")
+        self._rt = rt
+        head = rt.nodes[rt.head_node_id]
+        self._log_dir = os.path.join(head.session_dir, "jobs")
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._jobs: Dict[str, _JobEntry] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def shared(cls) -> "JobSubmissionClient":
+        with cls._singleton_lock:
+            if cls._singleton is None:
+                cls._singleton = cls()
+            return cls._singleton
+
+    # ------------------------------------------------------------------
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        log_path = os.path.join(self._log_dir, f"{submission_id}.log")
+        entry = _JobEntry(submission_id, entrypoint, log_path, metadata)
+        with self._lock:
+            if submission_id in self._jobs:
+                raise ValueError(f"job {submission_id!r} already exists")
+            self._jobs[submission_id] = entry
+        self._publish(entry)
+
+        env = dict(os.environ)
+        for key, value in (runtime_env or {}).get("env_vars", {}).items():
+            env[key] = str(value)
+        if runtime_env and "working_dir" in runtime_env:
+            cwd = runtime_env["working_dir"]
+        else:
+            cwd = None
+
+        def run():
+            with open(log_path, "wb") as log:
+                try:
+                    entry.proc = subprocess.Popen(
+                        entrypoint, shell=True, stdout=log,
+                        stderr=subprocess.STDOUT, env=env, cwd=cwd)
+                    entry.status = JobStatus.RUNNING
+                    self._publish(entry)
+                    code = entry.proc.wait()
+                    if entry.status == JobStatus.STOPPED:
+                        pass
+                    elif code == 0:
+                        entry.status = JobStatus.SUCCEEDED
+                    else:
+                        entry.status = JobStatus.FAILED
+                        entry.message = f"exit code {code}"
+                except Exception as e:  # noqa: BLE001
+                    entry.status = JobStatus.FAILED
+                    entry.message = repr(e)
+            entry.end_time = time.time()
+            self._publish(entry)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"job-{submission_id}").start()
+        return submission_id
+
+    def _publish(self, entry: _JobEntry) -> None:
+        self._rt.gcs.kv.put(entry.submission_id.encode(),
+                            json.dumps(entry.info()).encode(),
+                            namespace="jobs")
+
+    def _entry(self, submission_id: str) -> _JobEntry:
+        with self._lock:
+            entry = self._jobs.get(submission_id)
+        if entry is None:
+            raise ValueError(f"no job {submission_id!r}")
+        return entry
+
+    def get_job_status(self, submission_id: str) -> str:
+        return self._entry(submission_id).status
+
+    def get_job_info(self, submission_id: str) -> Dict[str, Any]:
+        return self._entry(submission_id).info()
+
+    def get_job_logs(self, submission_id: str) -> str:
+        entry = self._entry(submission_id)
+        try:
+            with open(entry.log_path, "rb") as f:
+                return f.read().decode("utf-8", "replace")
+        except FileNotFoundError:
+            return ""
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [e.info() for e in self._jobs.values()]
+
+    def stop_job(self, submission_id: str) -> bool:
+        entry = self._entry(submission_id)
+        if entry.proc is not None and entry.proc.poll() is None:
+            entry.status = JobStatus.STOPPED
+            entry.proc.terminate()
+            try:
+                entry.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                entry.proc.kill()
+            self._publish(entry)
+            return True
+        return False
+
+    def wait_until_finish(self, submission_id: str,
+                          timeout: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout
+        terminal = {JobStatus.SUCCEEDED, JobStatus.FAILED,
+                    JobStatus.STOPPED}
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in terminal:
+                return status
+            time.sleep(0.05)
+        raise TimeoutError(f"job {submission_id} still "
+                           f"{self.get_job_status(submission_id)}")

@@ -1,0 +1,146 @@
+"""`ray-tpu` CLI (reference: python/ray/scripts/scripts.py `ray
+status/list/...` and python/ray/util/state/state_cli.py).
+
+The control plane lives inside driver processes, so cluster commands
+read the session state snapshot the driver dumps every ~2s
+(<session>/state.json, pointer at $TMPDIR/ray_tpu_last_session.json).
+
+    python -m ray_tpu.scripts.cli status
+    python -m ray_tpu.scripts.cli list tasks|actors|nodes|jobs|pgs
+    python -m ray_tpu.scripts.cli summary
+    python -m ray_tpu.scripts.cli timeline -o trace.json
+    python -m ray_tpu.scripts.cli submit -- python my_driver.py
+    python -m ray_tpu.scripts.cli version
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+
+def _load_state() -> Optional[Dict[str, Any]]:
+    pointer = os.path.join(tempfile.gettempdir(),
+                           "ray_tpu_last_session.json")
+    try:
+        with open(pointer) as f:
+            meta = json.load(f)
+        with open(meta["state_path"]) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, KeyError):
+        return None
+
+
+def _require_state() -> Dict[str, Any]:
+    state = _load_state()
+    if state is None:
+        print("no live session found (is a driver running ray_tpu.init "
+              "on this machine?)", file=sys.stderr)
+        sys.exit(1)
+    age = time.time() - state.get("timestamp", 0)
+    if age > 30:
+        print(f"warning: state snapshot is {age:.0f}s old (driver may "
+              "have exited)", file=sys.stderr)
+    return state
+
+
+def _fmt_resources(res: Dict[str, float]) -> str:
+    return ", ".join(f"{k}: {v:g}" for k, v in sorted(res.items()))
+
+
+def cmd_status(args) -> None:
+    state = _require_state()
+    total = state["resources_total"]
+    avail = state["resources_available"]
+    print(f"======== Cluster status "
+          f"(as of {time.ctime(state['timestamp'])}) ========")
+    print(f"Nodes: {len(state['nodes'])}")
+    for node in state["nodes"]:
+        role = "head" if node["is_head"] else "worker"
+        print(f"  {node['node_id'][:12]} [{role}] "
+              f"{_fmt_resources(node['resources_total'])}")
+    used = {k: total.get(k, 0) - avail.get(k, 0) for k in total}
+    print("Usage:")
+    for key in sorted(total):
+        print(f"  {used.get(key, 0):g}/{total[key]:g} {key}")
+    summary = state.get("task_summary", {})
+    if summary:
+        print("Tasks:", ", ".join(f"{k}: {v}"
+                                  for k, v in sorted(summary.items())))
+
+
+def cmd_list(args) -> None:
+    state = _require_state()
+    key = {"tasks": "tasks", "actors": "actors", "nodes": "nodes",
+           "jobs": "jobs", "pgs": "placement_groups"}[args.kind]
+    rows = state.get(key, [])
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def cmd_summary(args) -> None:
+    state = _require_state()
+    print(json.dumps(state.get("task_summary", {}), indent=2))
+
+
+def cmd_timeline(args) -> None:
+    state = _require_state()
+    # the snapshot carries recent tasks only; a live driver can export
+    # the full trace via ray_tpu.util.state.timeline()
+    trace = []
+    for task in state.get("tasks", []):
+        if task["state"] not in ("FINISHED", "FAILED"):
+            continue
+        trace.append({
+            "name": task["name"], "cat": "task", "ph": "i",
+            "ts": task["timestamp"] * 1e6, "pid": task["node_id"] or "?",
+            "tid": task["task_id"][:8], "s": "t",
+        })
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {args.output}")
+
+
+def cmd_submit(args) -> None:
+    entry = " ".join(args.entrypoint)
+    if not entry:
+        print("usage: ray-tpu submit -- <command ...>", file=sys.stderr)
+        sys.exit(2)
+    proc = subprocess.run(entry, shell=True)
+    sys.exit(proc.returncode)
+
+
+def cmd_version(args) -> None:
+    from ray_tpu._version import __version__
+    print(__version__)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status").set_defaults(fn=cmd_status)
+    p = sub.add_parser("list")
+    p.add_argument("kind",
+                   choices=["tasks", "actors", "nodes", "jobs", "pgs"])
+    p.set_defaults(fn=cmd_list)
+    sub.add_parser("summary").set_defaults(fn=cmd_summary)
+    p = sub.add_parser("timeline")
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+    p = sub.add_parser("submit")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""ray_tpu.util — orchestration + observability utilities
+(placement groups, state API, user metrics)."""
+
+from ray_tpu.util import metrics, state
+from ray_tpu.util.placement_group import (
+    placement_group, remove_placement_group)
+
+__all__ = ["metrics", "placement_group", "remove_placement_group",
+           "state"]

@@ -1,0 +1,148 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py:173,318,240 — metrics defined in
+any task/actor/driver, aggregated centrally, exported in Prometheus
+text format (the reference scrapes via the dashboard agent's
+/metrics endpoint; here `prometheus_text()` renders the same exposition
+format and the dashboard module serves it).
+
+Workers report through the control-plane KV channel (one message per
+update — fine for control-path metrics; hot-loop counters should
+aggregate locally and flush periodically).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                       2.5, 5.0, 10.0]
+
+
+class _Registry:
+    """Process-global metric state (driver holds the authoritative
+    copy; workers forward updates to it)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (name, tag_items) -> value
+        self.counters: Dict[Tuple, float] = {}
+        self.gauges: Dict[Tuple, float] = {}
+        # (name, tag_items) -> (boundaries, bucket counts, sum, count)
+        self.histograms: Dict[Tuple, list] = {}
+        self.descriptions: Dict[str, str] = {}
+
+    def apply(self, kind: str, name: str, tags: Tuple, value: float,
+              boundaries: Optional[Sequence[float]] = None) -> None:
+        with self.lock:
+            key = (name, tags)
+            if kind == "counter":
+                self.counters[key] = self.counters.get(key, 0.0) + value
+            elif kind == "gauge":
+                self.gauges[key] = value
+            elif kind == "histogram":
+                entry = self.histograms.get(key)
+                if entry is None:
+                    bounds = list(boundaries or _DEFAULT_BOUNDARIES)
+                    entry = [bounds, [0] * (len(bounds) + 1), 0.0, 0]
+                    self.histograms[key] = entry
+                bounds, buckets, _, _ = entry
+                buckets[bisect.bisect_left(bounds, value)] += 1
+                entry[2] += value
+                entry[3] += 1
+
+
+_registry = _Registry()
+
+
+def _record(kind: str, name: str, tags: Dict[str, str], value: float,
+            boundaries=None) -> None:
+    tag_items = tuple(sorted((tags or {}).items()))
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime_or_none()
+    if rt is not None and not getattr(rt, "is_driver", False):
+        # worker: forward to the driver-held registry via the GCS channel
+        rt.gcs_call("metrics_apply", kind, name, tag_items, value,
+                    list(boundaries) if boundaries else None)
+        return
+    _registry.apply(kind, name, tag_items, value, boundaries)
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self._name = name
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        _registry.descriptions[name] = description
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[Dict[str, str]]) -> Dict[str, str]:
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        return out
+
+
+class Counter(Metric):
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        _record("counter", self._name, self._tags(tags), value)
+
+
+class Gauge(Metric):
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        _record("gauge", self._name, self._tags(tags), value)
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = list(boundaries or _DEFAULT_BOUNDARIES)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        _record("histogram", self._name, self._tags(tags), value,
+                self._boundaries)
+
+
+def _fmt_tags(tags: Tuple, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in tags]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition-format dump of every metric."""
+    reg = _registry
+    lines: List[str] = []
+    with reg.lock:
+        for (name, tags), value in sorted(reg.counters.items()):
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name}{_fmt_tags(tags)} {value}")
+        for (name, tags), value in sorted(reg.gauges.items()):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name}{_fmt_tags(tags)} {value}")
+        for (name, tags), (bounds, buckets, total, count) in sorted(
+                reg.histograms.items()):
+            lines.append(f"# TYPE {name} histogram")
+            cumulative = 0
+            for bound, n in zip(bounds, buckets):
+                cumulative += n
+                lines.append(f"{name}_bucket"
+                             f"{_fmt_tags(tags, f'le=\"{bound}\"')} "
+                             f"{cumulative}")
+            cumulative += buckets[-1]
+            lines.append(f"{name}_bucket"
+                         f"{_fmt_tags(tags, 'le=\"+Inf\"')} {cumulative}")
+            lines.append(f"{name}_sum{_fmt_tags(tags)} {total}")
+            lines.append(f"{name}_count{_fmt_tags(tags)} {count}")
+    return "\n".join(lines) + "\n"

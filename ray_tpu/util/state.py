@@ -1,0 +1,190 @@
+"""State API: programmatic cluster introspection.
+
+Reference: python/ray/util/state/api.py (list_tasks/list_actors/
+list_nodes/list_objects/list_placement_groups, summarize_tasks) backed
+by the GCS task-event store (gcs_task_manager.h:97) and the dashboard
+state aggregator. Here the control plane lives in the driver process,
+so the API reads the live runtime directly; `ray_tpu.scripts.cli`
+serves the same data out-of-process from the session state dump.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _runtime():
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime()
+    if rt is None or not getattr(rt, "is_driver", False):
+        raise RuntimeError("state API requires an initialized driver "
+                           "(call ray_tpu.init first)")
+    return rt
+
+
+def list_tasks(limit: int = 1000,
+               filters: Optional[Dict[str, Any]] = None) -> List[dict]:
+    """Latest known state per task, newest first."""
+    rt = _runtime()
+    latest: Dict[str, dict] = {}
+    for ev in rt.gcs.list_task_events(limit=100_000):
+        latest[ev.task_id.hex()] = {
+            "task_id": ev.task_id.hex(),
+            "name": ev.name,
+            "state": ev.state,
+            "node_id": ev.node_id.hex() if ev.node_id else None,
+            "error": ev.error,
+            "timestamp": ev.timestamp,
+        }
+    rows = sorted(latest.values(), key=lambda r: -r["timestamp"])
+    if filters:
+        rows = [r for r in rows
+                if all(r.get(k) == v for k, v in filters.items())]
+    return rows[:limit]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for row in list_tasks(limit=10**9):
+        counts[row["state"]] = counts.get(row["state"], 0) + 1
+    return counts
+
+
+def list_actors(limit: int = 1000) -> List[dict]:
+    rt = _runtime()
+    with rt.gcs.lock:
+        records = list(rt.gcs.actors.values())
+    return [{
+        "actor_id": r.actor_id.hex(),
+        "class_name": ((r.spec.name if r.spec else "") or "").split(".")[0],
+        "state": r.state,
+        "name": r.name,
+        "restarts": r.num_restarts,
+    } for r in records[:limit]]
+
+
+def list_nodes() -> List[dict]:
+    rt = _runtime()
+    snap = rt.scheduler.snapshot()
+    out = []
+    for record in rt.gcs.alive_nodes():
+        res = snap.get(record.node_id)
+        out.append({
+            "node_id": record.node_id.hex(),
+            "alive": record.alive,
+            "resources_total": dict(record.resources_total),
+            "resources_available": dict(res.available) if res else {},
+            "labels": dict(record.labels),
+            "is_head": record.node_id == rt.head_node_id,
+        })
+    return out
+
+
+def list_objects(limit: int = 1000) -> List[dict]:
+    rt = _runtime()
+    with rt.reference_counter._lock:
+        counts = dict(rt.reference_counter._counts)
+    out = []
+    for oid, count in list(counts.items())[:limit]:
+        loc = rt.task_manager.get_location(oid)
+        out.append({
+            "object_id": oid.hex(),
+            "reference_count": count,
+            "location": (loc.kind if loc else None),
+            "node_id": (loc.node_id.hex()
+                        if loc and loc.node_id else None),
+        })
+    return out
+
+
+def list_placement_groups() -> List[dict]:
+    rt = _runtime()
+    with rt.gcs.lock:
+        records = list(rt.gcs.placement_groups.values())
+    return [{
+        "placement_group_id": r.pg_id.hex(),
+        "name": r.name,
+        "state": r.state,
+        "strategy": r.strategy,
+        "bundles": [{"index": b.index, "resources": dict(b.resources),
+                     "node_id": b.node_id.hex() if b.node_id else None}
+                    for b in r.bundles],
+    } for r in records]
+
+
+def list_jobs() -> List[dict]:
+    rt = _runtime()
+    with rt.gcs.lock:
+        records = list(rt.gcs.jobs.values())
+    return [{
+        "job_id": r.job_id.hex(),
+        "state": r.state,
+        "start_time": r.start_time,
+        "end_time": r.end_time,
+    } for r in records]
+
+
+# ---------------------------------------------------------------------------
+# Timeline (reference: `ray timeline` → Chrome trace from task events)
+# ---------------------------------------------------------------------------
+
+def timeline(filename: Optional[str] = None) -> List[dict]:
+    """Chrome-trace events from the task-event store; optionally write
+    to `filename` (load in chrome://tracing or Perfetto)."""
+    rt = _runtime()
+    by_task: Dict[str, List] = {}
+    for ev in rt.gcs.list_task_events(limit=1_000_000):
+        by_task.setdefault(ev.task_id.hex(), []).append(ev)
+    trace: List[dict] = []
+    for tid, events in by_task.items():
+        events.sort(key=lambda e: e.timestamp)
+        start = next((e for e in events
+                      if e.state in ("SCHEDULED", "RUNNING")), events[0])
+        end = next((e for e in reversed(events)
+                    if e.state in ("FINISHED", "FAILED")), None)
+        node = next((e.node_id.hex()[:8] for e in events if e.node_id),
+                    "pending")
+        if end is None:
+            continue
+        trace.append({
+            "name": events[0].name,
+            "cat": "task",
+            "ph": "X",
+            "ts": start.timestamp * 1e6,
+            "dur": max((end.timestamp - start.timestamp) * 1e6, 1.0),
+            "pid": node,
+            "tid": tid[:8],
+            "args": {"state": end.state, "task_id": tid},
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+# ---------------------------------------------------------------------------
+# Session state dump — feeds the out-of-process CLI
+# ---------------------------------------------------------------------------
+
+def state_snapshot() -> dict:
+    return {
+        "timestamp": time.time(),
+        "nodes": list_nodes(),
+        "actors": list_actors(),
+        "tasks": list_tasks(limit=200),
+        "task_summary": summarize_tasks(),
+        "placement_groups": list_placement_groups(),
+        "jobs": list_jobs(),
+        "resources_total": _totals("resources_total"),
+        "resources_available": _totals("resources_available"),
+    }
+
+
+def _totals(key: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for node in list_nodes():
+        for k, v in node[key].items():
+            out[k] = out.get(k, 0.0) + v
+    return out

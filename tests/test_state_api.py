@@ -1,0 +1,158 @@
+"""State API / metrics / jobs / CLI tests (reference:
+python/ray/tests/test_state_api.py shape — run work, then introspect)."""
+
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics, state
+
+
+def test_list_tasks_and_summary(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    ray_tpu.get([f.remote(i) for i in range(3)])
+
+    tasks = state.list_tasks()
+    assert len(tasks) == 3
+    assert all(t["state"] == "FINISHED" for t in tasks)
+    assert state.summarize_tasks() == {"FINISHED": 3}
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    summary = state.summarize_tasks()
+    assert summary.get("FAILED") == 1
+    failed = state.list_tasks(filters={"state": "FAILED"})
+    assert len(failed) == 1 and failed[0]["error"]
+
+
+def test_list_actors_nodes_objects(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    actors = state.list_actors()
+    assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["is_head"]
+    assert nodes[0]["resources_total"]["CPU"] == 4
+
+    ref = ray_tpu.put(list(range(100)))
+    objects = state.list_objects()
+    assert any(o["object_id"] == ref.id.hex() for o in objects)
+
+
+def test_timeline_export(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def f():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(4)])
+    out = tmp_path / "trace.json"
+    events = state.timeline(str(out))
+    assert len(events) == 4
+    data = json.loads(out.read_text())
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in data)
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    c = metrics.Counter("reqs_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    g = metrics.Gauge("queue_depth")
+    g.set(7.0)
+    h = metrics.Histogram("latency_s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = metrics.prometheus_text()
+    assert 'reqs_total{route="/a"} 3.0' in text
+    assert "queue_depth 7.0" in text
+    assert 'latency_s_bucket{le="0.1"} 1' in text
+    assert 'latency_s_bucket{le="+Inf"} 3' in text
+    assert "latency_s_count 3" in text
+
+
+def test_metrics_from_worker(ray_start_regular):
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util import metrics as m
+        m.Counter("worker_side").inc(5.0)
+        return True
+
+    assert ray_tpu.get(work.remote())
+    assert "worker_side 5.0" in metrics.prometheus_text()
+
+
+def test_job_submission(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('job says hi')\"",
+        runtime_env={"env_vars": {"MARKER": "42"}})
+    assert client.wait_until_finish(job_id, timeout=60) == \
+        JobStatus.SUCCEEDED
+    assert "job says hi" in client.get_job_logs(job_id)
+    infos = client.list_jobs()
+    assert len(infos) == 1 and infos[0]["submission_id"] == job_id
+
+
+def test_job_failure_and_stop(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert client.wait_until_finish(bad, timeout=60) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(bad)["message"]
+
+    slow = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
+    deadline = time.monotonic() + 30
+    while (client.get_job_status(slow) == JobStatus.PENDING
+           and time.monotonic() < deadline):
+        time.sleep(0.02)
+    assert client.stop_job(slow)
+    assert client.wait_until_finish(slow, timeout=30) == JobStatus.STOPPED
+
+
+def test_cli_status_reads_snapshot(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    # wait for the dumper's 2s tick
+    from ray_tpu.scripts.cli import _load_state
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        snap = _load_state()
+        if snap and snap.get("task_summary", {}).get("FINISHED"):
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("state snapshot never appeared")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "status"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "Cluster status" in proc.stdout
+    assert "CPU" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "list", "nodes"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)[0]["is_head"]
