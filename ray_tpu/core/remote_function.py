@@ -55,12 +55,23 @@ def value_to_arg(value: Any, runtime) -> Arg:
         arg = Arg(object_id=value.id)
         arg._keepalive = value  # pin: the spec holds the ref until done
         return arg
-    data, buffers = serialization.serialize(value)
+    # Serialize under a ref collector so ObjectRefs *embedded* in the
+    # argument are containment-pinned for the life of the spec — without
+    # this, a caller dropping its handle while the task is queued deletes
+    # the inner object before execution (reference: reference_counter.h
+    # nested "contained in" tracking).
+    with serialization.collect_contained_refs() as contained:
+        data, buffers = serialization.serialize(value)
+    pins = [ObjectRef(oid) for oid in contained]
     if not buffers and len(data) <= get_config().max_inline_object_size:
-        return Arg(value_bytes=serialization.pack_parts(data, buffers))
+        arg = Arg(value_bytes=serialization.pack_parts(data, buffers))
+        if pins:
+            arg._keepalive = pins
+        return arg
     ref = runtime.put_serialized(data, buffers)
     arg = Arg(object_id=ref.id)
-    arg._keepalive = ref  # pin until the spec (and thus the arg) is dropped
+    # pin until the spec (and thus the arg) is dropped
+    arg._keepalive = (ref, pins) if pins else ref
     return arg
 
 

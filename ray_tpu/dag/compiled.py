@@ -22,6 +22,7 @@ is future work.
 
 from __future__ import annotations
 
+import copy
 import os
 from typing import Any, Dict, List, Optional
 
@@ -56,6 +57,7 @@ def _compiled_dag_loop(instance, schedule):
                for key, (spec, idx) in schedule["reads"].items()}
     writers = {uid: ChannelWriter(spec)
                for uid, spec in schedule["writes"].items()}
+    zero_copy = schedule.get("zero_copy", False)
     seq = 0
     while True:
         cache: Dict[str, Any] = {}
@@ -64,7 +66,18 @@ def _compiled_dag_loop(instance, schedule):
         def read(key):
             nonlocal stop
             if key not in cache:
-                cache[key] = readers[key].read(seq, timeout=None)
+                value = readers[key].read(seq, timeout=None)
+                # Channel reads are zero-copy views into slots the writer
+                # reuses after `capacity` executions; hand user methods an
+                # owned copy so a stateful actor retaining its input never
+                # sees the slot rewritten underneath it. Opt out via
+                # experimental_compile(zero_copy_reads=True) when no
+                # method retains its inputs (saves an O(payload) copy per
+                # hop).
+                if not zero_copy and not isinstance(
+                        value, (_Stop, _ErrorToken)):
+                    value = copy.deepcopy(value)
+                cache[key] = value
             value = cache[key]
             if isinstance(value, _Stop):
                 stop = True
@@ -151,8 +164,10 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, *, buffer_capacity: int = 4):
+    def __init__(self, root: DAGNode, *, buffer_capacity: int = 4,
+                 zero_copy_reads: bool = False):
         self._capacity = buffer_capacity
+        self._zero_copy_reads = zero_copy_reads
         nodes = root.topo_sort()
         if any(isinstance(n, FunctionNode) for n in nodes):
             raise ValueError(
@@ -221,7 +236,8 @@ class CompiledDAG:
             aid = actor_of[n._node_uid]
             handles[aid] = n._handle
             schedules.setdefault(aid, {"reads": {}, "writes": {},
-                                       "nodes": []})
+                                       "nodes": [],
+                                       "zero_copy": zero_copy_reads})
         for n in compute:
             aid = actor_of[n._node_uid]
             sched = schedules[aid]
@@ -311,8 +327,6 @@ class CompiledDAG:
         return CompiledDAGRef(self, seq)
 
     def _read_output(self, seq: int, timeout: Optional[float]):
-        import copy
-
         # read everything before acking anything, so a timeout on one
         # output leaves the whole seq re-readable
         raw = [reader.read(seq, timeout)

@@ -49,7 +49,19 @@ class _JobEntry:
             "start_time": self.start_time,
             "end_time": self.end_time,
             "metadata": self.metadata,
+            "log_path": self.log_path,
         }
+
+
+def list_job_infos(gcs) -> List[Dict[str, Any]]:
+    """All submitted-job records from the GCS "jobs" KV namespace — the
+    shared table every client and the state API read."""
+    out = []
+    for key in gcs.kv.keys(namespace="jobs"):
+        blob = gcs.kv.get(key, namespace="jobs")
+        if blob is not None:  # deleted between keys() and get()
+            out.append(json.loads(blob.decode()))
+    return out
 
 
 class JobSubmissionClient:
@@ -69,8 +81,18 @@ class JobSubmissionClient:
         head = rt.nodes[rt.head_node_id]
         self._log_dir = os.path.join(head.session_dir, "jobs")
         os.makedirs(self._log_dir, exist_ok=True)
-        self._jobs: Dict[str, _JobEntry] = {}
-        self._lock = threading.Lock()
+        # Process-handle table shared by every client of the same runtime
+        # (lives on the runtime so its lifetime tracks the runtime's), so
+        # a second JobSubmissionClient() can stop jobs the first submitted.
+        # The authoritative *status* table is the GCS "jobs" KV namespace.
+        with JobSubmissionClient._singleton_lock:
+            if not hasattr(rt, "_submitted_jobs"):
+                rt._submitted_jobs = {}
+                rt._submitted_jobs_lock = threading.Lock()
+            self._jobs: Dict[str, _JobEntry] = rt._submitted_jobs
+            # shared with every client of this runtime so check-and-insert
+            # in submit_job is atomic across clients
+            self._lock = rt._submitted_jobs_lock
 
     @classmethod
     def shared(cls) -> "JobSubmissionClient":
@@ -132,33 +154,41 @@ class JobSubmissionClient:
                             json.dumps(entry.info()).encode(),
                             namespace="jobs")
 
-    def _entry(self, submission_id: str) -> _JobEntry:
+    def _entry(self, submission_id: str) -> Optional[_JobEntry]:
         with self._lock:
-            entry = self._jobs.get(submission_id)
-        if entry is None:
+            return self._jobs.get(submission_id)
+
+    def _kv_info(self, submission_id: str) -> Dict[str, Any]:
+        """The shared job table is the GCS "jobs" KV namespace — every
+        client (and the state API/CLI) reads the same records, whichever
+        client instance submitted the job."""
+        blob = self._rt.gcs.kv.get(submission_id.encode(), namespace="jobs")
+        if blob is None:
             raise ValueError(f"no job {submission_id!r}")
-        return entry
+        return json.loads(blob.decode())
 
     def get_job_status(self, submission_id: str) -> str:
-        return self._entry(submission_id).status
+        return self._kv_info(submission_id)["status"]
 
     def get_job_info(self, submission_id: str) -> Dict[str, Any]:
-        return self._entry(submission_id).info()
+        return self._kv_info(submission_id)
 
     def get_job_logs(self, submission_id: str) -> str:
-        entry = self._entry(submission_id)
+        log_path = self._kv_info(submission_id).get("log_path", "")
         try:
-            with open(entry.log_path, "rb") as f:
+            with open(log_path, "rb") as f:
                 return f.read().decode("utf-8", "replace")
-        except FileNotFoundError:
+        except (FileNotFoundError, IsADirectoryError):
             return ""
 
     def list_jobs(self) -> List[Dict[str, Any]]:
-        with self._lock:
-            return [e.info() for e in self._jobs.values()]
+        return list_job_infos(self._rt.gcs)
 
     def stop_job(self, submission_id: str) -> bool:
         entry = self._entry(submission_id)
+        if entry is None:
+            self._kv_info(submission_id)  # raises if the job is unknown
+            return False
         if entry.proc is not None and entry.proc.poll() is None:
             entry.status = JobStatus.STOPPED
             entry.proc.terminate()

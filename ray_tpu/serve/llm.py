@@ -73,6 +73,61 @@ class LLMServer:
                 # engine stays up for subsequent requests
                 self.engine.fail_all(f"engine step failed: {e!r}")
 
+    def _validate_sampling(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Validate/clamp client sampling params before they reach the
+        shared stepper thread — a bad value raising inside step() would
+        fail every in-flight request on the replica, not just this one.
+        """
+        import math
+
+        out: Dict[str, Any] = {}
+        max_tokens = body.get("max_tokens")
+        if max_tokens is not None:
+            if (isinstance(max_tokens, bool)
+                    or not isinstance(max_tokens, int) or max_tokens < 1):
+                raise ValueError("max_tokens must be a positive integer")
+            out["max_tokens"] = min(max_tokens,
+                                    self.config.engine.model.max_seq_len)
+        temperature = body.get("temperature")
+        if temperature is not None:
+            if (isinstance(temperature, bool)
+                    or not isinstance(temperature, (int, float))
+                    or math.isnan(float(temperature))
+                    or not 0.0 <= float(temperature) <= 100.0):
+                raise ValueError("temperature must be a number in [0, 100]")
+            # sub-epsilon temperatures overflow the float32 logit divide
+            # to inf/NaN inside the stepper; they mean "greedy" anyway
+            out["temperature"] = (0.0 if float(temperature) < 1e-3
+                                  else float(temperature))
+        top_k = body.get("top_k", 0)
+        if isinstance(top_k, bool) or not isinstance(top_k, int) or top_k < 0:
+            raise ValueError("top_k must be a non-negative integer")
+        # top_k > vocab makes np.partition raise inside the stepper
+        out["top_k"] = min(top_k, self.config.engine.model.vocab_size)
+        return out
+
+    @staticmethod
+    def _flatten_content(content: Any) -> str:
+        """OpenAI message content is a string or a list of typed parts;
+        flatten text parts rather than interpolating a Python repr."""
+        if isinstance(content, str):
+            return content
+        if isinstance(content, list):
+            texts = []
+            for part in content:
+                if not isinstance(part, dict) or part.get("type") != "text":
+                    raise ValueError(
+                        "only text content parts are supported")
+                texts.append(str(part.get("text", "")))
+            return "".join(texts)
+        raise ValueError("message content must be a string or a list of "
+                         "content parts")
+
+    @staticmethod
+    def _invalid_request(err: ValueError) -> Dict[str, Any]:
+        return {"error": {"message": str(err),
+                          "type": "invalid_request_error"}}
+
     def _generate(self, prompt: str, *, max_tokens: Optional[int] = None,
                   temperature: Optional[float] = None,
                   top_k: int = 0) -> Dict[str, Any]:
@@ -117,11 +172,17 @@ class LLMServer:
 
     def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         prompt = body.get("prompt", "")
+        if not isinstance(prompt, str):
+            return self._invalid_request(ValueError("prompt must be a string"))
+        try:
+            sampling = self._validate_sampling(body)
+        except ValueError as e:
+            return self._invalid_request(e)
         result = self._generate(
             prompt,
-            max_tokens=body.get("max_tokens"),
-            temperature=body.get("temperature"),
-            top_k=body.get("top_k", 0))
+            max_tokens=sampling.get("max_tokens"),
+            temperature=sampling.get("temperature"),
+            top_k=sampling["top_k"])
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -141,13 +202,24 @@ class LLMServer:
 
     def chat_completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         messages = body.get("messages", [])
+        if not isinstance(messages, list) or any(
+                not isinstance(m, dict) for m in messages):
+            return self._invalid_request(
+                ValueError("messages must be a list of objects"))
+        try:
+            sampling = self._validate_sampling(body)
+            contents = [self._flatten_content(m.get("content", ""))
+                        for m in messages]
+        except ValueError as e:
+            return self._invalid_request(e)
         prompt = "".join(
-            f"<|{m.get('role', 'user')}|>{m.get('content', '')}"
-            for m in messages) + "<|assistant|>"
+            f"<|{m.get('role', 'user')}|>{content}"
+            for m, content in zip(messages, contents)) + "<|assistant|>"
         result = self._generate(
             prompt,
-            max_tokens=body.get("max_tokens"),
-            temperature=body.get("temperature"))
+            max_tokens=sampling.get("max_tokens"),
+            temperature=sampling.get("temperature"),
+            top_k=sampling["top_k"])
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",

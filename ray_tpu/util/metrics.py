@@ -113,8 +113,15 @@ class Histogram(Metric):
                 self._boundaries)
 
 
+def _esc_label(value) -> str:
+    # Prometheus text-format label escaping: backslash, double-quote, and
+    # newline must be escaped or scrapers reject the exposition.
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
 def _fmt_tags(tags: Tuple, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in tags]
+    parts = [f'{k}="{_esc_label(v)}"' for k, v in tags]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""

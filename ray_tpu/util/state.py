@@ -118,12 +118,26 @@ def list_jobs() -> List[dict]:
     rt = _runtime()
     with rt.gcs.lock:
         records = list(rt.gcs.jobs.values())
-    return [{
+    out = [{
         "job_id": r.job_id.hex(),
+        "type": "driver",
         "state": r.state,
         "start_time": r.start_time,
         "end_time": r.end_time,
     } for r in records]
+    # Submitted jobs (JobSubmissionClient) live in the GCS "jobs" KV
+    # namespace — the same records every submission client sees.
+    from ray_tpu.job_submission import list_job_infos
+    for info in list_job_infos(rt.gcs):
+        out.append({
+            "job_id": info.get("submission_id"),
+            "type": "submission",
+            "state": info.get("status"),
+            "start_time": info.get("start_time"),
+            "end_time": info.get("end_time"),
+            "entrypoint": info.get("entrypoint"),
+        })
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -171,3 +171,35 @@ def test_object_ref_in_collection_passthrough(ray_start_regular):
 def test_cluster_resources(ray_start_regular):
     res = ray_tpu.cluster_resources()
     assert res["CPU"] == 4.0
+
+
+def test_arg_embedded_ref_pinned(ray_start_regular):
+    # An ObjectRef embedded inside a serialized argument is containment-
+    # pinned by the task spec: the caller dropping its handle while the
+    # task is queued must not delete the inner object.
+    import gc
+
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.core.remote_function import value_to_arg
+
+    rt = runtime_mod.get_runtime()
+    inner = ray_tpu.put(np.arange(100_000))  # large -> shm store
+    oid = inner.id
+    arg = value_to_arg({"payload": inner}, rt)
+    del inner
+    gc.collect()
+    assert rt.reference_counter.count(oid) > 0, (
+        "embedded ref dropped while the arg still pins it")
+    del arg
+    gc.collect()
+
+    # End-to-end: inner ref's only handle dies right after submission.
+    @ray_tpu.remote
+    def read_inner(box):
+        return ray_tpu.get(box["ref"]) + 1
+
+    inner2 = ray_tpu.put(41)
+    fut = read_inner.remote({"ref": inner2})
+    del inner2
+    gc.collect()
+    assert ray_tpu.get(fut) == 42

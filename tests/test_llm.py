@@ -132,3 +132,30 @@ def test_openai_app_http(ray_start_shared):
         assert payload["data"][0]["id"] == "llama-test"
     finally:
         serve.shutdown()
+
+
+def test_sampling_param_validation():
+    # Bad client params must be rejected per-request, not reach the
+    # shared stepper thread (where they would fail every in-flight
+    # request on the replica).
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+
+    config = LLMConfig(
+        engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=2, max_seq=64),
+        max_tokens=4)
+    server = LLMServer(config)
+    out = server.completions({"prompt": "hi", "top_k": 10**9})
+    # top_k is clamped to vocab, so this must succeed, not error
+    assert "error" not in out
+    out = server.completions({"prompt": "hi", "temperature": "hot"})
+    assert out["error"]["type"] == "invalid_request_error"
+    out = server.completions({"prompt": "hi", "max_tokens": -3})
+    assert out["error"]["type"] == "invalid_request_error"
+    out = server.chat_completions({"messages": "nope"})
+    assert out["error"]["type"] == "invalid_request_error"
+    # engine still healthy after the rejects
+    out = server.completions({"prompt": "hi", "max_tokens": 2})
+    assert out["usage"]["completion_tokens"] == 2

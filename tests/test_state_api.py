@@ -156,3 +156,27 @@ def test_cli_status_reads_snapshot(ray_start_regular):
         capture_output=True, text=True, timeout=60)
     assert proc.returncode == 0
     assert json.loads(proc.stdout)[0]["is_head"]
+
+
+def test_prometheus_label_escaping(ray_start_regular):
+    c = metrics.Counter("esc_total", tag_keys=("path",))
+    c.inc(tags={"path": 'a"b\\c\nd'})
+    text = metrics.prometheus_text()
+    assert 'esc_total{path="a\\"b\\\\c\\nd"} 1.0' in text
+
+
+def test_job_table_shared_between_clients(ray_start_regular):
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+    first = JobSubmissionClient()
+    job_id = first.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('shared')\"")
+    second = JobSubmissionClient()
+    assert second.wait_until_finish(job_id, timeout=60) == \
+        JobStatus.SUCCEEDED
+    assert any(j["submission_id"] == job_id for j in second.list_jobs())
+    assert "shared" in second.get_job_logs(job_id)
+    # state API sees submission jobs alongside driver jobs
+    from ray_tpu.util import state
+    jobs = state.list_jobs()
+    assert any(j.get("job_id") == job_id and j["type"] == "submission"
+               for j in jobs)
