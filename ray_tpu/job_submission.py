@@ -70,6 +70,9 @@ class JobSubmissionClient:
 
     _singleton: Optional["JobSubmissionClient"] = None
     _singleton_lock = threading.Lock()
+    # separate from _singleton_lock: shared() holds that lock while
+    # calling __init__, so reusing it here would deadlock
+    _table_lock = threading.Lock()
 
     def __init__(self, address: Optional[str] = None):
         from ray_tpu.core import runtime as runtime_mod
@@ -85,7 +88,7 @@ class JobSubmissionClient:
         # (lives on the runtime so its lifetime tracks the runtime's), so
         # a second JobSubmissionClient() can stop jobs the first submitted.
         # The authoritative *status* table is the GCS "jobs" KV namespace.
-        with JobSubmissionClient._singleton_lock:
+        with JobSubmissionClient._table_lock:
             if not hasattr(rt, "_submitted_jobs"):
                 rt._submitted_jobs = {}
                 rt._submitted_jobs_lock = threading.Lock()

@@ -1,14 +1,25 @@
-"""Fused causal attention.
+"""Fused causal flash attention — streaming Pallas TPU kernels.
 
-A Pallas TPU kernel that computes attention per (batch, head, q-block)
-entirely in VMEM — the [S, S] score matrix never materializes in HBM,
-which is the memory win that matters on TPU (HBM bandwidth is the
-bottleneck; VMEM blocks feed the MXU directly). Falls back to a jnp
-reference off-TPU and for shapes the kernel doesn't cover.
+Forward: online-softmax accumulation over K/V tiles (FlashAttention
+algorithm) with a (batch, head, q-block, k-block) grid — VMEM stays
+bounded at any sequence length, the [S, S] score matrix never touches
+HBM, and causally-masked K blocks are skipped (their compute is
+predicated off and their DMAs elided by clamping the block index map to
+the last valid block, so Mosaic's pipeline sees a repeated index and
+re-uses the buffer).
 
-Backward runs the reference VJP on recomputed activations (flash-style
-fused backward kernel is future work; `jax.checkpoint` around the call
-already keeps residuals small).
+Backward: fused dq and dk/dv kernels using the saved logsumexp and the
+precomputed delta = rowsum(dO * O) — no score-matrix materialization in
+the backward either, which is where the naive VJP loses (a
+[B, H, S, S] f32 tensor per layer is HBM-bandwidth death at seq 2048+).
+
+All matmuls run with bf16 inputs and f32 accumulation
+(preferred_element_type) — the MXU's native mode; softmax statistics
+stay f32.
+
+Reference analog: the reference has no in-tree attention kernels (it
+delegates to vLLM/torch, SURVEY.md §5.7); this is the TPU-native
+equivalent the blueprint commits to.
 
 Layout: [batch, seq, heads, head_dim] (GQA supported by repeating K/V
 heads upstream in the model).
@@ -24,10 +35,22 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
-# Max K/V bytes held in VMEM per (batch, head) program before falling
-# back (v5 VMEM ~16 MB/core; leave room for q/out/scores).
-_VMEM_KV_BUDGET = 8 * 1024 * 1024
+# Default tile sizes; shrunk to fit when seq is smaller. 128-multiples
+# keep every matmul MXU-aligned. 256x512 measured ~4x faster than
+# 512x512 on v5e (the [bq, bk] f32 score tile plus double-buffered
+# operands stays within VMEM without spilling).
 _BLOCK_Q = 256
+_BLOCK_K = 512
+# Run kernels in interpreter mode (CPU testing); toggled by tests.
+_INTERPRET = False
+
+
+def _block_size(pref: int, dim: int) -> Optional[int]:
+    """Largest 128-multiple block <= pref that tiles `dim` exactly."""
+    for cand in (pref, 256, 128):
+        if cand <= dim and dim % cand == 0:
+            return cand
+    return None
 
 
 def _attention_reference(q, k, v, causal: bool):
@@ -43,78 +66,329 @@ def _attention_reference(q, k, v, causal: bool):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
-                  block_q: int, seq_k: int):
+
+# --- shared causal-geometry helpers (keep forward/backward in sync) ----
+
+def _causal_live(qi, ki, block_q: int, block_k: int, offset: int):
+    """Whether the (qi, ki) tile touches the causal lower triangle."""
+    return (qi + 1) * block_q - 1 + offset >= ki * block_k
+
+
+def _causal_mask(s, qi, ki, block_q: int, block_k: int, offset: int):
+    """NEG_INF-mask score tile entries above the causal diagonal."""
+    q_pos = qi * block_q + offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _clamped_kv_index(causal: bool, block_q: int, block_k: int,
+                      offset: int, nk: int):
+    """KV block index map: past-diagonal fetches clamp to the last live
+    block, so Mosaic sees a repeated index and elides the DMA."""
+    def index(bi, hi, qi, ki):
+        if causal:
+            last = jnp.minimum(
+                ((qi + 1) * block_q - 1 + offset) // block_k, nk - 1)
+            ki = jnp.minimum(ki, last)
+        return (bi, hi, ki, 0)
+    return index
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, causal: bool, sm_scale: float, block_q: int,
+                block_k: int, offset: int):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
-    q = q_ref[0, 0, :, :].astype(jnp.float32)           # [block_q, d]
-    k = k_ref[0, 0, :, :].astype(jnp.float32)           # [seq_k, d]
-    v = v_ref[0, 0, :, :].astype(jnp.float32)
-    d = q.shape[-1]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * (1.0 / (d ** 0.5))
-    if causal:
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, seq_k), 0)
-        k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, seq_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) / l
-    o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    run = (_causal_live(qi, ki, block_q, block_k, offset) if causal
+           else ki >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]                                   # [bq, d] bf16
+        k = k_ref[0, 0]                                   # [bk, d] bf16
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        m_prev = m_scr[...]                               # [bq, 128]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)                # broadcast
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])     # [bq, 1]
+        p = jnp.exp(s - m_new[:, :1])                     # [bq, bk] f32
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc_scr[...] * alpha
+        acc += jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new[:, :1], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[...] = acc
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)  # fully-masked row guard
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l)          # [bq, 1]
 
 
-def _flash_forward(q, k, v, causal: bool):
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int):
+    """q,k,v: [B, H, S, D] -> (o [B, H, Sq, D], lse [B, H, Sq, 1] f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
-    block_q = min(_BLOCK_Q, sq)
-    grid = (b, h, sq // block_q)
-    kernel = functools.partial(_flash_kernel, causal=causal,
-                               block_q=block_q, seq_k=sk)
-    # Kernel layout is [B, H, S, D] so the tiled (second-to-last, last)
-    # dims are (seq, head_dim) — the MXU-friendly orientation. XLA fuses
-    # the transposes into the surrounding projections.
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    out = pl.pallas_call(
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    offset = sk - sq
+    nq, nk = sq // block_q, sk // block_k
+    grid = (b, h, nq, nk)
+
+    kv_index = _clamped_kv_index(causal, block_q, block_k, offset, nk)
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, sm_scale=d ** -0.5,
+        block_q=block_q, block_k=block_k, offset=offset)
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
+            pl.BlockSpec((1, 1, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            # trailing dim of 1 satisfies the (8, 128) tile rule via
+            # the block-equals-array-dim escape hatch, without the 128x
+            # lane padding the official kernel pays for its lse output
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accum
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_INTERPRET,
+    )(q, k, v)
+    return out, lse
 
 
-def _kernel_supported(q, k) -> bool:
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, causal: bool, sm_scale: float, block_q: int,
+               block_k: int, offset: int):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = (_causal_live(qi, ki, block_q, block_k, offset) if causal
+           else ki >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        p = jnp.exp(s - lse_ref[0, 0])                     # [bq, bk]
+        do = do_ref[0, 0]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0]) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                sm_scale: float, block_q: int, block_k: int, offset: int):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = (_causal_live(qi, ki, block_q, block_k, offset) if causal
+           else qi >= 0)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0, 0]                                    # [bq, d]
+        k = k_ref[0, 0]                                    # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        p = jnp.exp(s - lse_ref[0, 0])                     # [bq, bk]
+        do = do_ref[0, 0]                                  # [bq, d]
+        # dv += p^T @ do
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0]) * sm_scale
+        # dk += ds^T @ q
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
+                    block_k: int):
+    """All tensors [B, H, S, D] (lse/delta [B, H, S]); returns dq/dk/dv."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    offset = sk - sq
+    nq, nk = sq // block_q, sk // block_k
+    sm_scale = d ** -0.5
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)                   # [B,H,Sq,1]
+
+    q_idx = lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+
+    kv_idx = _clamped_kv_index(causal, block_q, block_k, offset, nk)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, offset=offset),
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_idx),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx),
+            pl.BlockSpec((1, 1, block_q, d), q_idx),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_idx),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_INTERPRET,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: iterate q blocks innermost for each k block. For causal,
+    # early (fully-masked) q blocks clamp forward to the first live one.
+    def q_idx_b(bi, hi, ki, qi):
+        if causal:
+            first = jnp.maximum((ki * block_k - offset) // block_q, 0)
+            qi = jnp.maximum(qi, first)
+        return (bi, hi, qi, 0)
+
+    kv_idx_b = lambda bi, hi, ki, qi: (bi, hi, ki, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, sm_scale=sm_scale,
+                          block_q=block_q, block_k=block_k, offset=offset),
+        grid=(b, h, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), q_idx_b),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx_b),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx_b),
+            pl.BlockSpec((1, 1, block_q, d), q_idx_b),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx_b),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx_b),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), kv_idx_b),
+            pl.BlockSpec((1, 1, block_k, d), kv_idx_b),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_INTERPRET,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+# ---------------------------------------------------------------------------
+
+def _kernel_plan(q, k):
+    """(block_q, block_k) if the kernels cover these shapes, else None."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if jax.default_backend() not in ("tpu", "axon"):
-        return False
-    # sq must tile exactly by the q block actually used (min(_BLOCK_Q,
-    # sq)) — the grid floor-divides, so a 128-aligned-but-not-block-
-    # aligned tail would be left unwritten.
-    if d % 128 or sq % 128 or sk % 128 or sq % min(_BLOCK_Q, sq):
-        return False
-    kv_bytes = 2 * sk * d * 4
-    return kv_bytes <= _VMEM_KV_BUDGET
+    if not (_INTERPRET or jax.default_backend() in ("tpu", "axon")):
+        return None
+    if d % 128 or sq % 128 or sk % 128:
+        return None
+    bq = _block_size(_BLOCK_Q, sq)
+    bk = _block_size(_BLOCK_K, sk)
+    if bq is None or bk is None:
+        return None
+    return bq, bk
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -122,20 +396,44 @@ def flash_attention(q, k, v, causal: bool = True):
     """Fused causal attention: [B, S, H, D] x3 -> [B, S, H, D].
 
     K/V head count must equal Q head count (expand GQA groups first)."""
-    if _kernel_supported(q, k):
-        return _flash_forward(q, k, v, causal)
-    return _attention_reference(q, k, v, causal)
+    plan = _kernel_plan(q, k)
+    if plan is None:
+        return _attention_reference(q, k, v, causal)
+    # Kernel layout is [B, H, S, D] so the tiled (second-to-last, last)
+    # dims are (seq, head_dim) — the MXU-friendly orientation. XLA fuses
+    # the transposes into the surrounding projections.
+    out, _ = _flash_forward(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal, *plan)
+    return out.transpose(0, 2, 1, 3)
 
 
 def _fwd(q, k, v, causal):
-    return flash_attention(q, k, v, causal), (q, k, v)
+    plan = _kernel_plan(q, k)
+    if plan is None:
+        return flash_attention(q, k, v, causal), (q, k, v, None, None)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, lse = _flash_forward(qt, kt, vt, causal, *plan)
+    return out.transpose(0, 2, 1, 3), (q, k, v, out, lse)
 
 
 def _bwd(causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    plan = _kernel_plan(q, k)
+    if plan is None or out is None:
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _attention_reference(q_, k_, v_, causal),
+            q, k, v)
+        return vjp(g)
+    # `out` was saved in kernel layout [B, H, S, D] by _fwd.
+    dq, dk, dv = _flash_backward(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), out, lse,
+        g.transpose(0, 2, 1, 3), causal, *plan)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
 
 
 flash_attention.defvjp(_fwd, _bwd)
