@@ -25,13 +25,22 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          object_store_memory: Optional[int] = None,
          namespace: str = "",
          system_config: Optional[dict] = None,
+         head_port: Optional[int] = None,
          ignore_reinit_error: bool = False) -> DriverRuntime:
-    """Start the single-node runtime (head + worker pool + object store)."""
+    """Start the head runtime (worker pool + object store + scheduler).
+
+    ``head_port`` >= 0 additionally opens the multi-host control plane:
+    a TCP listener node daemons join via ``ray-tpu start --address``
+    (0 picks a free port; see ``runtime.head_address``).
+    """
     existing = runtime_mod.get_runtime_or_none()
     if existing is not None:
         if ignore_reinit_error:
             return existing
         raise RuntimeError("ray_tpu is already initialized; call shutdown() first")
+    if head_port is not None:
+        system_config = dict(system_config or {})
+        system_config.setdefault("head_port", head_port)
     res = dict(resources or {})
     if num_cpus is not None:
         res["CPU"] = float(num_cpus)

@@ -42,6 +42,55 @@ class Cluster:
             res["CPU"] = float(num_cpus)
         return self.runtime.add_node(res or None, labels, object_store_memory)
 
+    def add_remote_node(self, num_cpus: Optional[float] = None,
+                        resources: Optional[Dict[str, float]] = None,
+                        labels: Optional[Dict[str, str]] = None,
+                        object_store_memory: Optional[int] = None,
+                        timeout: float = 30.0):
+        """Start a node daemon as a SEPARATE OS PROCESS that joins this
+        head over TCP — the real multi-host path (reference: raylet
+        processes joining the GCS, src/ray/raylet/main.cc:180). Requires
+        the head to have been created with ``head_port >= 0``. Returns
+        (NodeID, subprocess.Popen); kill the process to simulate host
+        failure."""
+        import json
+        import subprocess
+        import sys
+        import time
+
+        if self.runtime.head_address is None:
+            raise RuntimeError(
+                "head has no TCP listener; pass head_port=0 via "
+                "system_config/head_node_args")
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        before = set(self.runtime.nodes)
+        cmd = [sys.executable, "-m", "ray_tpu.core.node_daemon",
+               "--address", self.runtime.head_address,
+               "--resources", json.dumps(res),
+               "--labels", json.dumps(labels or {})]
+        if object_store_memory:
+            cmd += ["--object-store-memory", str(object_store_memory)]
+        import os
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, env=env)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            new = set(self.runtime.nodes) - before
+            if new:
+                return new.pop(), proc
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node daemon exited rc={proc.returncode} before "
+                    "registering")
+            time.sleep(0.05)
+        proc.kill()
+        raise TimeoutError("node daemon did not register in time")
+
     def remove_node(self, node_id: NodeID) -> None:
         """Kill a node (its workers die; chaos path)."""
         self.runtime.remove_node(node_id)

@@ -69,6 +69,23 @@ class Config:
     # Grace period before a dead worker's in-flight tasks are failed.
     worker_death_grace_s: float = 0.5
 
+    # --- multi-host control plane ---
+    # TCP port for the head's node-daemon listener: -1 disables the
+    # listener (single-host mode), 0 picks a free port
+    # (reference: gcs_server port + raylet node_manager_port).
+    head_port: int = -1
+    head_host: str = "127.0.0.1"
+    # Remote-node heartbeat cadence and declared-dead threshold
+    # (reference: gcs_health_check_manager.h:45).
+    heartbeat_interval_s: float = 0.5
+    heartbeat_timeout_s: float = 5.0
+    # Chunk size for node-to-node object transfer (reference: chunked
+    # push/pull, object_manager.proto:63-66).
+    object_chunk_size: int = 1024 * 1024
+    # Max concurrent inbound pulls an object server admits
+    # (reference: pull_manager.h:50 admission control).
+    object_pull_concurrency: int = 8
+
     # --- logging / events ---
     task_events_enabled: bool = True
     task_events_buffer_size: int = 100_000

@@ -1,0 +1,292 @@
+"""Node daemon: runs one Node (worker pool + shm store) on another host.
+
+Capability parity with the reference's per-node raylet process
+(reference: src/ray/raylet/main.cc:180 — a raylet per node registering
+with the GCS over the network, heartbeating, and executing leased work).
+``python -m ray_tpu.core.node_daemon --address HEAD_HOST:PORT`` (or the
+``ray-tpu start`` CLI) connects to the head's HeadServer
+(ray_tpu/core/remote_node.py), registers the node's resources, and then
+serves dispatches. The local ``Node`` is exactly the in-process Node the
+head uses — only its ``runtime`` is a ``HeadProxy`` that forwards every
+runtime call over the TCP control connection instead of calling the
+DriverRuntime directly.
+
+Object data does not transit the control connection: each daemon runs an
+ObjectServer (object_transfer.py) and pulls objects it needs directly
+from the holder node in bounded chunks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import threading
+from typing import Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config, reset_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_transfer import ObjectServer, pull_object
+from ray_tpu.core.protocol import (
+    MessageConnection,
+    connect_tcp,
+    parse_address,
+)
+from ray_tpu.exceptions import ObjectLostError
+
+
+class _RefForwarder:
+    """Forwards borrowed-ref transitions to the head's ReferenceCounter."""
+
+    def __init__(self, proxy: "HeadProxy"):
+        self._proxy = proxy
+
+    def add_local_reference(self, object_id: ObjectID) -> None:
+        self._proxy.send({"kind": "REF_ADD",
+                          "object_id": object_id.binary()})
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        self._proxy.send({"kind": "REF_DROP",
+                          "object_id": object_id.binary(), "defer": False})
+
+
+class HeadProxy:
+    """The runtime interface a Node invokes, forwarded to the head."""
+
+    is_driver = False
+
+    def __init__(self, conn: MessageConnection):
+        self.conn = conn
+        self.dead = threading.Event()
+        self.reference_counter = _RefForwarder(self)
+
+    def send(self, msg: dict) -> bool:
+        if self.dead.is_set():
+            return False
+        try:
+            self.conn.send(msg)
+            return True
+        except OSError:
+            self.dead.set()
+            return False
+
+    # --- runtime interface used by Node --------------------------------
+    def submit_spec(self, spec) -> None:
+        self.send({"kind": "SUBMIT", "spec": serialization.dumps(spec)})
+
+    def on_worker_put(self, node, msg: dict) -> None:
+        self.send({"kind": "PUT_META", "object_id": msg["object_id"],
+                   "contained": list(msg.get("contained", ()))})
+
+    def handle_get_object(self, node, handle, msg: dict) -> None:
+        self.send({"kind": "GET_OBJECT",
+                   "worker_id": handle.worker_id.binary(),
+                   "object_id": msg["object_id"],
+                   "req_id": msg.get("req_id")})
+
+    def handle_check_ready(self, handle, msg: dict) -> None:
+        self.send({"kind": "CHECK_READY",
+                   "worker_id": handle.worker_id.binary(),
+                   "object_ids": msg["object_ids"],
+                   "req_id": msg.get("req_id")})
+
+    def handle_gcs_request(self, handle, msg: dict) -> None:
+        self.send({"kind": "GCS_REQUEST",
+                   "worker_id": handle.worker_id.binary(),
+                   "method": msg["method"], "args": msg["args"],
+                   "req_id": msg.get("req_id")})
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self.send({"kind": "KILL_ACTOR", "actor_id": actor_id.binary(),
+                   "no_restart": no_restart})
+
+    def cancel(self, object_id: ObjectID, force: bool = False) -> None:
+        self.send({"kind": "CANCEL", "object_id": object_id.binary(),
+                   "force": force})
+
+    def deferred_remove_reference(self, object_id: ObjectID) -> None:
+        self.send({"kind": "REF_DROP", "object_id": object_id.binary(),
+                   "defer": True})
+
+    def on_task_done(self, node, worker, spec, msg: dict) -> None:
+        self.send({"kind": "TASK_DONE_FWD",
+                   "worker_id": worker.worker_id.binary(),
+                   "spec": serialization.dumps(spec), "msg": msg})
+
+    def on_worker_crashed(self, node, worker, running, actor_id) -> None:
+        self.send({"kind": "WORKER_CRASHED_FWD",
+                   "worker_id": worker.worker_id.binary(),
+                   "running": [serialization.dumps(s) for s in running],
+                   "actor_id": actor_id.binary() if actor_id else None})
+
+
+class NodeDaemon:
+    def __init__(self, head_address: str,
+                 resources: Optional[dict] = None,
+                 labels: Optional[dict] = None,
+                 object_store_memory: Optional[int] = None,
+                 session_dir: Optional[str] = None,
+                 advertise_host: Optional[str] = None):
+        from ray_tpu.core.node import Node  # late: spawns worker procs
+
+        host, port = parse_address(head_address)
+        self.conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
+        self.proxy = HeadProxy(self.conn)
+        self.node_id = NodeID.from_random()
+        if resources is None:
+            resources = {}
+        resources = dict(resources)
+        if "CPU" not in resources:
+            import multiprocessing
+            resources["CPU"] = float(multiprocessing.cpu_count())
+        self.node = Node(self.proxy, self.node_id, resources, labels,
+                         object_store_memory=object_store_memory,
+                         session_dir=session_dir)
+        self._advertise = advertise_host or get_config().head_host
+        self.object_server = ObjectServer(self._resolve_store,
+                                          host=self._advertise)
+        self.conn.send({
+            "kind": "NODE_REGISTER",
+            "node_id": self.node_id.binary(),
+            "resources": resources,
+            "labels": dict(labels or {}),
+            "object_addr": [self._advertise, self.object_server.address[1]],
+            "address": f"{socket.gethostname()}:{os.getpid()}",
+        })
+        reply = self.conn.recv()
+        if reply is None or reply.get("kind") != "REGISTERED":
+            raise RuntimeError("head rejected node registration")
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, name="heartbeat", daemon=True)
+        self._heartbeat_thread.start()
+
+    def _resolve_store(self, oid: ObjectID):
+        return self.node.store if self.node.store.contains(oid) else None
+
+    def _heartbeat_loop(self) -> None:
+        cfg = get_config()
+        while not self.proxy.dead.wait(cfg.heartbeat_interval_s):
+            self.proxy.send({"kind": "HEARTBEAT",
+                             "idle": self.node.idle_worker_count(),
+                             "store_used": self.node.store.used_bytes()})
+
+    # --- main loop ------------------------------------------------------
+    def serve_forever(self) -> None:
+        try:
+            while True:
+                msg = self.conn.recv()
+                if msg is None:
+                    break
+                try:
+                    if not self._handle(msg):
+                        break
+                except Exception:  # noqa: BLE001 — keep serving
+                    import traceback
+                    traceback.print_exc()
+        finally:
+            self.proxy.dead.set()
+            self.shutdown()
+
+    def _handle(self, msg: dict) -> bool:
+        kind = msg["kind"]
+        if kind == "DISPATCH":
+            self.node.dispatch(serialization.loads(msg["spec"]))
+        elif kind == "DISPATCH_ACTOR":
+            spec = serialization.loads(msg["spec"])
+            if not self.node.dispatch_to_actor(WorkerID(msg["worker_id"]),
+                                               spec):
+                self.proxy.send({"kind": "ACTOR_DISPATCH_FAILED",
+                                 "spec": serialization.dumps(spec)})
+        elif kind == "TO_WORKER":
+            self._route_to_worker(WorkerID(msg["worker_id"]), msg["payload"])
+        elif kind == "KILL_WORKER":
+            self.node.kill_worker(WorkerID(msg["worker_id"]))
+        elif kind == "PRESTART":
+            self.node.prestart_workers(msg.get("count", 1),
+                                       msg.get("profile", "cpu"))
+        elif kind == "DELETE_OBJECT":
+            self.node.store.delete(ObjectID(msg["object_id"]))
+        elif kind == "CANCEL_TASK":
+            self._cancel_task(TaskID(msg["task_id"]))
+        elif kind == "STOP":
+            return False
+        return True
+
+    def _route_to_worker(self, worker_id: WorkerID, payload: dict) -> None:
+        if payload.get("status") == "pull":
+            # The head pointed us at the holder node; pull the object
+            # into the local arena (chunked, node-to-node), then tell the
+            # worker it is local (reference: PullManager-driven transfer,
+            # pull_manager.h:50).
+            threading.Thread(
+                target=self._pull_and_reply,
+                args=(worker_id, payload), daemon=True).start()
+            return
+        self._send_to_worker(worker_id, payload)
+
+    def _pull_and_reply(self, worker_id: WorkerID, payload: dict) -> None:
+        oid = ObjectID(payload["object_id"])
+        addr = tuple(payload["addr"])
+        out = {"kind": "OBJECT_VALUE", "req_id": payload.get("req_id")}
+        if pull_object(addr, oid, self.node.store):
+            self.proxy.send({"kind": "REPLICA", "object_id": oid.binary()})
+            out["status"] = "shm_local"
+        else:
+            out["status"] = "error"
+            out["error"] = serialization.dumps(ObjectLostError(oid))
+        self._send_to_worker(worker_id, out)
+
+    def _send_to_worker(self, worker_id: WorkerID, payload: dict) -> None:
+        with self.node._lock:
+            worker = self.node._workers.get(worker_id)
+        if worker is not None:
+            worker.send(payload)
+
+    def _cancel_task(self, task_id: TaskID) -> None:
+        with self.node._lock:
+            target = None
+            for worker in self.node._workers.values():
+                if task_id in worker.running:
+                    target = worker.worker_id
+                    break
+        if target is not None:
+            self.node.kill_worker(target)
+
+    def shutdown(self) -> None:
+        self.object_server.stop()
+        self.node.stop()
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="ray_tpu node daemon (joins a head over TCP)")
+    parser.add_argument("--address", required=True,
+                        help="head address, host:port")
+    parser.add_argument("--resources", default="{}",
+                        help="JSON resource dict, e.g. '{\"CPU\": 4}'")
+    parser.add_argument("--labels", default="{}",
+                        help="JSON node labels")
+    parser.add_argument("--object-store-memory", type=int, default=None)
+    parser.add_argument("--system-config", default=None,
+                        help="JSON system config matching the head's")
+    parser.add_argument("--session-dir", default=None)
+    args = parser.parse_args(argv)
+    if args.system_config:
+        reset_config(json.loads(args.system_config))
+    daemon = NodeDaemon(
+        args.address,
+        resources=json.loads(args.resources) or None,
+        labels=json.loads(args.labels) or None,
+        object_store_memory=args.object_store_memory,
+        session_dir=args.session_dir)
+    daemon.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,30 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
+def listen_tcp(host: str, port: int) -> socket.socket:
+    """Bind + listen a TCP socket for cross-host control traffic
+    (reference: grpc_server.h:81 — here length-framed messages over a
+    plain stream; host defaults to loopback, pods pass the DCN address)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    sock.bind((host, port))
+    sock.listen(128)
+    return sock
+
+
+def connect_tcp(host: str, port: int,
+                timeout: Optional[float] = None) -> socket.socket:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    return sock
+
+
+def parse_address(address: str) -> Tuple[str, int]:
+    host, _, port = address.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
 class MessageConnection:
     """Thread-safe framed-message connection."""
 

@@ -1,0 +1,282 @@
+"""Head-side proxy for node daemons on other hosts.
+
+Capability parity with the reference's head-of-cluster view of remote
+raylets (reference: src/ray/gcs/gcs_node_manager.h:47 node table +
+gcs_health_check_manager.h:45 liveness; node_manager gRPC client in
+src/ray/raylet_rpc_client/). A ``RemoteNode`` presents the same surface
+the scheduler and runtime use on in-process ``Node`` objects
+(``dispatch``, ``dispatch_to_actor``, ``kill_worker``, ``store.delete``)
+but forwards each call over the daemon's TCP control connection
+(``ray_tpu/core/node_daemon.py`` is the other end). Large objects never
+transit this connection: they move node-to-node through the chunked
+object servers (object_transfer.py).
+
+``HeadServer`` is the head's TCP listener: it accepts daemon
+connections, registers them with the runtime, and runs one reader
+thread per daemon that translates forwarded worker traffic into the
+same runtime handler calls an in-process node would make.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.protocol import MessageConnection, listen_tcp
+from ray_tpu.core.task_spec import TaskSpec
+
+
+class RemoteWorkerStub:
+    """Stands in for a WorkerHandle living in a daemon process: ``send``
+    routes the payload through the daemon, which forwards it to the
+    worker's local socket."""
+
+    def __init__(self, node: "RemoteNode", worker_id: WorkerID):
+        self.node = node
+        self.worker_id = worker_id
+
+    def send(self, msg: dict) -> bool:
+        return self.node.send({"kind": "TO_WORKER",
+                               "worker_id": self.worker_id.binary(),
+                               "payload": msg})
+
+
+class RemoteStoreProxy:
+    """The slice of the store interface the head invokes on other nodes.
+    Reads go through the object servers, never through this proxy."""
+
+    def __init__(self, node: "RemoteNode"):
+        self._node = node
+
+    def delete(self, object_id: ObjectID) -> None:
+        self._node.send({"kind": "DELETE_OBJECT",
+                         "object_id": object_id.binary()})
+
+
+class RemoteNode:
+    is_remote = True
+
+    def __init__(self, runtime, conn: MessageConnection, node_id: NodeID,
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 object_addr: Tuple[str, int], address: str):
+        self.runtime = runtime
+        self.conn = conn
+        self.node_id = node_id
+        self.resources = dict(resources)
+        self.labels = dict(labels)
+        self.object_addr = tuple(object_addr)
+        self.address = address
+        self.store = RemoteStoreProxy(self)
+        self.session_dir = None
+        self.last_heartbeat = time.time()
+        self.idle_workers = 0
+        self.store_used = 0
+        self._alive = True
+        self._dead_lock = threading.Lock()
+        # Tasks dispatched to this node and not yet completed; on node
+        # death these are retried/failed exactly like worker crashes
+        # (the daemon can no longer report them).
+        self._inflight_lock = threading.Lock()
+        self._inflight: Dict[TaskID, TaskSpec] = {}
+
+    # --- liveness ------------------------------------------------------
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    def mark_dead(self) -> bool:
+        """Test-and-set: returns True for exactly one caller (the one
+        that must run the death cleanup — EOF reader and heartbeat
+        monitor can race here)."""
+        with self._dead_lock:
+            was = self._alive
+            self._alive = False
+            return was
+
+    def send(self, msg: dict) -> bool:
+        if not self._alive:
+            return False
+        try:
+            self.conn.send(msg)
+            return True
+        except OSError:
+            return False
+
+    # --- inflight bookkeeping -----------------------------------------
+    def track(self, spec: TaskSpec) -> None:
+        with self._inflight_lock:
+            self._inflight[spec.task_id] = spec
+
+    def untrack(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._inflight_lock:
+            return self._inflight.pop(task_id, None)
+
+    def take_inflight(self) -> List[TaskSpec]:
+        with self._inflight_lock:
+            specs = list(self._inflight.values())
+            self._inflight.clear()
+            return specs
+
+    # --- Node interface used by the runtime/scheduler ------------------
+    def dispatch(self, spec: TaskSpec) -> None:
+        self.track(spec)
+        if not self.send({"kind": "DISPATCH",
+                          "spec": serialization.dumps(spec)}):
+            # Leave the spec tracked: the death sweep (take_inflight)
+            # is what retries it.
+            self.runtime.on_remote_node_death(self.node_id)
+
+    def dispatch_to_actor(self, worker_id: WorkerID, spec: TaskSpec) -> bool:
+        self.track(spec)
+        ok = self.send({"kind": "DISPATCH_ACTOR",
+                        "worker_id": worker_id.binary(),
+                        "spec": serialization.dumps(spec)})
+        if not ok:
+            self.untrack(spec.task_id)
+        return ok
+
+    def kill_worker(self, worker_id: WorkerID) -> None:
+        self.send({"kind": "KILL_WORKER", "worker_id": worker_id.binary()})
+
+    def prestart_workers(self, count: int, profile: str = "cpu") -> None:
+        self.send({"kind": "PRESTART", "count": count, "profile": profile})
+
+    def cancel_task(self, task_id: TaskID) -> None:
+        self.send({"kind": "CANCEL_TASK", "task_id": task_id.binary()})
+
+    def idle_worker_count(self) -> int:
+        return self.idle_workers
+
+    def stop(self) -> None:
+        self.send({"kind": "STOP"})
+        self.close()
+
+    def close(self) -> None:
+        self.mark_dead()
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class HeadServer:
+    """The head's TCP listener for node daemons."""
+
+    def __init__(self, runtime, host: str, port: int):
+        self.runtime = runtime
+        self._listener = listen_tcp(host, port)
+        self.address: Tuple[str, int] = self._listener.getsockname()
+        self._stopped = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="head-accept", daemon=True)
+        self._accept_thread.start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="head-monitor", daemon=True)
+        self._monitor_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._reader_loop,
+                             args=(MessageConnection(sock),),
+                             daemon=True).start()
+
+    def _monitor_loop(self) -> None:
+        """Declare remote nodes dead when heartbeats stop
+        (reference: gcs_health_check_manager.h:45)."""
+        cfg = get_config()
+        while not self._stopped.wait(cfg.heartbeat_interval_s):
+            now = time.time()
+            for node in list(self.runtime.nodes.values()):
+                if (isinstance(node, RemoteNode) and node.alive
+                        and now - node.last_heartbeat
+                        > cfg.heartbeat_timeout_s):
+                    self.runtime.on_remote_node_death(node.node_id)
+
+    def _reader_loop(self, conn: MessageConnection) -> None:
+        node: Optional[RemoteNode] = None
+        while True:
+            msg = conn.recv()
+            if msg is None:
+                break
+            try:
+                if node is None:
+                    if msg.get("kind") != "NODE_REGISTER":
+                        break
+                    node = self.runtime.register_remote_node(conn, msg)
+                    conn.send({"kind": "REGISTERED"})
+                else:
+                    self._handle(node, msg)
+            except Exception:  # noqa: BLE001 — keep the daemon link alive
+                import traceback
+                traceback.print_exc()
+        if node is not None:
+            self.runtime.on_remote_node_death(node.node_id)
+
+    def _handle(self, node: RemoteNode, msg: dict) -> None:
+        rt = self.runtime
+        kind = msg["kind"]
+        if kind == "HEARTBEAT":
+            node.last_heartbeat = time.time()
+            node.idle_workers = msg.get("idle", 0)
+            node.store_used = msg.get("store_used", 0)
+        elif kind == "TASK_DONE_FWD":
+            spec: TaskSpec = serialization.loads(msg["spec"])
+            node.untrack(spec.task_id)
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.on_task_done(node, worker, spec, msg["msg"])
+        elif kind == "WORKER_CRASHED_FWD":
+            running = [serialization.loads(s) for s in msg["running"]]
+            for spec in running:
+                node.untrack(spec.task_id)
+            actor_id = (ActorID(msg["actor_id"])
+                        if msg.get("actor_id") else None)
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.on_worker_crashed(node, worker, running, actor_id)
+        elif kind == "ACTOR_DISPATCH_FAILED":
+            spec = serialization.loads(msg["spec"])
+            node.untrack(spec.task_id)
+            rt._route_actor_task(spec)
+        elif kind == "SUBMIT":
+            rt.submit_spec(serialization.loads(msg["spec"]))
+        elif kind == "PUT_META":
+            rt.on_worker_put(node, msg)
+        elif kind == "REPLICA":
+            rt.add_object_replica(ObjectID(msg["object_id"]), node.node_id)
+        elif kind == "GET_OBJECT":
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.handle_get_object(node, worker, msg)
+        elif kind == "CHECK_READY":
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.handle_check_ready(worker, msg)
+        elif kind == "GCS_REQUEST":
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.handle_gcs_request(worker, msg)
+        elif kind == "KILL_ACTOR":
+            rt.kill_actor(ActorID(msg["actor_id"]),
+                          no_restart=msg.get("no_restart", True))
+        elif kind == "REF_ADD":
+            rt.reference_counter.add_local_reference(ObjectID(msg["object_id"]))
+        elif kind == "REF_DROP":
+            oid = ObjectID(msg["object_id"])
+            if msg.get("defer", True):
+                rt.deferred_remove_reference(oid)
+            else:
+                rt.reference_counter.remove_local_reference(oid)
+        elif kind == "CANCEL":
+            rt.cancel(ObjectID(msg["object_id"]),
+                      force=msg.get("force", False))
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass

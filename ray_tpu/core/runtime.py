@@ -122,9 +122,29 @@ class DriverRuntime:
         self._backlog_view: List[TaskSpec] = []
         self._sched_thread = threading.Thread(
             target=self._scheduling_loop, name="scheduler", daemon=True)
+        # objects replicated beyond their primary location by node-to-node
+        # transfer: oid -> set of NodeIDs holding a sealed copy
+        self._replica_lock = threading.Lock()
+        self._object_replicas: Dict[ObjectID, set] = {}
         self.head_node_id = self.add_node(
             resources if resources is not None else None, labels,
             object_store_memory)
+        # Multi-host control plane: a TCP listener node daemons register
+        # with (reference: gcs_server accepting raylet registrations) and
+        # an object server for chunked node-to-node transfer out of the
+        # in-process stores. Disabled unless head_port >= 0.
+        self.head_server = None
+        self.object_server = None
+        self.head_address: Optional[str] = None
+        cfg = get_config()
+        if cfg.head_port >= 0:
+            from ray_tpu.core.object_transfer import ObjectServer
+            from ray_tpu.core.remote_node import HeadServer
+            self.object_server = ObjectServer(self._resolve_local_store,
+                                              host=cfg.head_host)
+            self.head_server = HeadServer(self, cfg.head_host, cfg.head_port)
+            self.head_address = (f"{self.head_server.address[0]}:"
+                                 f"{self.head_server.address[1]}")
         self._sched_thread.start()
 
     # --- cluster membership --------------------------------------------
@@ -152,10 +172,116 @@ class DriverRuntime:
             self._sched_cond.notify_all()
         return node_id
 
+    def register_remote_node(self, conn, msg: dict):
+        """A node daemon registered over TCP (reference: raylet
+        registration with the GCS, gcs_node_manager.h:47)."""
+        from ray_tpu.core.remote_node import RemoteNode
+        node_id = NodeID(msg["node_id"])
+        resources = dict(msg["resources"])
+        labels = dict(msg.get("labels") or {})
+        node = RemoteNode(self, conn, node_id, resources, labels,
+                          tuple(msg["object_addr"]),
+                          msg.get("address", ""))
+        self.nodes[node_id] = node
+        self.scheduler.add_node(node_id, resources, labels)
+        self.gcs.register_node(NodeRecord(
+            node_id=node_id, address=node.address,
+            resources_total=resources, labels=labels, node_manager=node))
+        with self._sched_cond:
+            self._schedulable.extend(self._infeasible)
+            self._infeasible.clear()
+            self._sched_cond.notify_all()
+        return node
+
+    def on_remote_node_death(self, node_id: NodeID) -> None:
+        """A remote node's daemon stopped heartbeating or its connection
+        dropped. Retry/fail its in-flight work exactly as worker crashes
+        would, and promote object replicas where copies survive
+        (reference: node death notifications in node_manager.proto +
+        gcs_health_check_manager.h:45)."""
+        if self._stopped.is_set():
+            return
+        node = self.nodes.get(node_id)
+        if node is None or not getattr(node, "is_remote", False):
+            return
+        if not node.mark_dead():
+            return  # another thread (EOF reader vs monitor) won the race
+        self.nodes.pop(node_id, None)
+        self.scheduler.remove_node(node_id)
+        self.gcs.mark_node_dead(node_id)
+        node.close()
+        # Replica bookkeeping: drop copies on the dead node; objects whose
+        # primary lived there survive if any replica exists.
+        promote: List[Tuple[ObjectID, NodeID]] = []
+        with self._replica_lock:
+            for oid, reps in self._object_replicas.items():
+                reps.discard(node_id)
+                loc = self.task_manager.get_location(oid)
+                if (reps and loc is not None and loc.kind == "shm"
+                        and loc.node_id == node_id):
+                    promote.append((oid, next(iter(reps))))
+        for oid, new_primary in promote:
+            self.task_manager.set_location(
+                oid, ObjectLocation("shm", new_primary))
+        # In-flight tasks the daemon can no longer report on.
+        specs = node.take_inflight()
+        actor_ids = {aid for aid, info in self.actors.items()
+                     if info.node_id == node_id}
+        for spec in specs:
+            if spec.is_actor_creation:
+                actor_ids.add(spec.actor_id)
+                continue
+            retry = self.task_manager.consume_retry(spec.task_id)
+            if retry is not None:
+                self._resubmit(retry)
+                continue
+            err: Exception = WorkerCrashedError(
+                f"node {node_id.hex()[:8]} died while running "
+                f"{spec.name or spec.function_id}")
+            if spec.actor_id is not None:
+                err = ActorUnavailableError(spec.actor_id, str(err))
+            self._record_event(spec, "FAILED", node_id=node_id,
+                               error=str(err))
+            self.task_manager.fail(spec.task_id, err)
+        for aid in actor_ids:
+            self._handle_actor_death(aid, node)
+        self._signal_scheduler()
+
+    def add_object_replica(self, oid: ObjectID, node_id: NodeID) -> None:
+        with self._replica_lock:
+            self._object_replicas.setdefault(oid, set()).add(node_id)
+
+    def object_holders(self, oid: ObjectID) -> List[NodeID]:
+        """Nodes holding a sealed copy (primary first, then replicas)."""
+        holders: List[NodeID] = []
+        loc = self.task_manager.get_location(oid)
+        if loc is not None and loc.kind == "shm" and loc.node_id is not None:
+            holders.append(loc.node_id)
+        with self._replica_lock:
+            for nid in self._object_replicas.get(oid, ()):
+                if nid not in holders:
+                    holders.append(nid)
+        return [nid for nid in holders if nid in self.nodes]
+
+    def _resolve_local_store(self, oid: ObjectID):
+        """ObjectServer callback: find an in-process store holding oid
+        (the head serves all its simulated nodes from one server)."""
+        for nid in self.object_holders(oid):
+            node = self.nodes.get(nid)
+            if (node is not None and not getattr(node, "is_remote", False)
+                    and node.store.contains(oid)):
+                return node.store
+        return None
+
     def remove_node(self, node_id: NodeID) -> None:
         """Simulate node failure (chaos testing). In-flight work is
         retried or failed exactly as if each worker crashed
         (reference: node death notifications, node_manager.proto)."""
+        existing = self.nodes.get(node_id)
+        if existing is not None and getattr(existing, "is_remote", False):
+            existing.send({"kind": "STOP"})
+            self.on_remote_node_death(node_id)
+            return
         node = self.nodes.pop(node_id, None)
         if node is None:
             return
@@ -246,9 +372,15 @@ class DriverRuntime:
                     info = self.actors.get(spec.actor_id)
                     if info is not None:
                         info.resources_node = node_id
+                node = self.nodes.get(node_id)
+                if node is None:
+                    # Node died between pick and dispatch (remote-node
+                    # heartbeat monitor removes nodes concurrently).
+                    backlog.append(spec)
+                    continue
                 self.task_manager.mark_dispatched(spec.task_id, node_id)
                 self._record_event(spec, "SCHEDULED", node_id=node_id)
-                self.nodes[node_id].dispatch(spec)
+                node.dispatch(spec)
                 made_progress = True
             self._backlog_view = list(backlog)
             if backlog and not made_progress:
@@ -579,13 +711,29 @@ class DriverRuntime:
         if found:
             kind, payload = stored
             return serialization.unpack(payload) if kind == "packed" else payload
-        loc = self.task_manager.get_location(oid)
-        if loc is not None and loc.kind == "shm":
-            node = self.nodes.get(loc.node_id)
-            if node is not None:
-                found, value = node.store.get_value(oid, timeout_s=5.0)
-                if found:
-                    return value
+        holders = self.object_holders(oid)
+        # Prefer a copy in an in-process store (zero-copy read).
+        for nid in holders:
+            node = self.nodes.get(nid)
+            if node is None or getattr(node, "is_remote", False):
+                continue
+            found, value = node.store.get_value(oid, timeout_s=5.0)
+            if found:
+                return value
+        # Remote holders only: pull chunked into the head store
+        # (reference: PullManager-driven transfer, pull_manager.h:50).
+        head = self.nodes.get(self.head_node_id)
+        if head is not None:
+            from ray_tpu.core.object_transfer import pull_object
+            for nid in holders:
+                node = self.nodes.get(nid)
+                if node is None or not getattr(node, "is_remote", False):
+                    continue
+                if pull_object(node.object_addr, oid, head.store):
+                    self.add_object_replica(oid, self.head_node_id)
+                    found, value = head.store.get_value(oid, timeout_s=5.0)
+                    if found:
+                        return value
         raise ObjectLostError(oid)
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
@@ -636,8 +784,13 @@ class DriverRuntime:
             return  # producing task still running; keep bookkeeping
         self.memory_store.delete(oid)
         loc = self.task_manager.get_location(oid)
-        if loc is not None and loc.kind == "shm":
-            node = self.nodes.get(loc.node_id)
+        targets = set()
+        if loc is not None and loc.kind == "shm" and loc.node_id is not None:
+            targets.add(loc.node_id)
+        with self._replica_lock:
+            targets.update(self._object_replicas.pop(oid, ()))
+        for nid in targets:
+            node = self.nodes.get(nid)
             if node is not None:
                 node.store.delete(oid)
         self.task_manager.forget_object(oid)
@@ -740,26 +893,96 @@ class DriverRuntime:
                 return
             loc = self.task_manager.get_location(oid)
             if loc is not None and loc.kind == "shm":
-                if loc.node_id == node.node_id:
+                holders = self.object_holders(oid)
+                if node.node_id in holders:
                     out.update(status="shm_local")
-                else:
-                    src = self.nodes.get(loc.node_id)
-                    buf = src.store.get_buffer(oid, timeout_s=5.0) if src else None
-                    if buf is None:
-                        out.update(status="error", error=serialization.dumps(
-                            ObjectLostError(oid)))
+                    worker.send(out)
+                    return
+                if getattr(node, "is_remote", False):
+                    # Point the daemon at a holder; it pulls chunked
+                    # node-to-node (reference: object_manager.proto:63
+                    # chunked Push/Pull).
+                    addr = self._holder_object_addr(holders)
+                    if addr is None:
+                        out.update(status="error",
+                                   error=serialization.dumps(
+                                       ObjectLostError(oid)))
                     else:
-                        # Inter-node object transfer (simulated C5 path).
-                        out.update(status="inline", data=bytes(buf))
-                        del buf
-                        src.store.release(oid)
-                worker.send(out)
+                        out.update(status="pull", addr=list(addr),
+                                   object_id=oid.binary())
+                    worker.send(out)
+                    return
+                # In-process requester: replicate into its store off the
+                # callback thread, then report it local.
+                threading.Thread(
+                    target=self._replicate_and_reply,
+                    args=(oid, node, worker, out), daemon=True).start()
                 return
             out.update(status="error",
                        error=serialization.dumps(ObjectLostError(oid)))
             worker.send(out)
 
         self.task_manager.on_ready(oid, reply)
+
+    def _holder_object_addr(self, holders: List[NodeID]):
+        """Object-server address of some node holding the object."""
+        for nid in holders:
+            node = self.nodes.get(nid)
+            if node is None:
+                continue
+            if getattr(node, "is_remote", False):
+                return node.object_addr
+            if self.object_server is not None:
+                return self.object_server.address
+        return None
+
+    def _replicate_and_reply(self, oid: ObjectID, dst_node: Node,
+                             worker, out: dict) -> None:
+        if self._replicate_to_node(oid, dst_node):
+            self.add_object_replica(oid, dst_node.node_id)
+            out.update(status="shm_local")
+        else:
+            out.update(status="error",
+                       error=serialization.dumps(ObjectLostError(oid)))
+        worker.send(out)
+
+    def _replicate_to_node(self, oid: ObjectID, dst_node: Node) -> bool:
+        """Copy a sealed object into ``dst_node``'s store from any holder
+        (in-process: direct memcpy between arenas; remote: chunked pull)."""
+        if dst_node.store.contains(oid):
+            return True
+        for nid in self.object_holders(oid):
+            src = self.nodes.get(nid)
+            if src is None or nid == dst_node.node_id:
+                continue
+            if getattr(src, "is_remote", False):
+                from ray_tpu.core.object_transfer import pull_object
+                if pull_object(src.object_addr, oid, dst_node.store):
+                    return True
+                continue
+            buf = src.store.get_buffer(oid, timeout_s=2.0)
+            if buf is None:
+                continue
+            try:
+                try:
+                    dest = dst_node.store.create(oid, len(buf))
+                except FileExistsError:
+                    probe = dst_node.store.get_buffer(oid, timeout_s=10.0)
+                    if probe is None:
+                        continue
+                    del probe
+                    dst_node.store.release(oid)
+                    return True
+                try:
+                    dest[:] = buf
+                finally:
+                    del dest
+                dst_node.store.seal(oid)
+                return True
+            finally:
+                del buf
+                src.store.release(oid)
+        return False
 
     def handle_check_ready(self, worker, msg: dict) -> None:
         ready = [b for b in msg["object_ids"]
@@ -850,12 +1073,16 @@ class DriverRuntime:
                 info = self.actors.get(task.actor_id)
                 node_id = info.node_id if info else None
             node = self.nodes.get(node_id)
-            if node is not None:
-                with node._lock:
-                    for w in node._workers.values():
-                        if task_id in w.running:
-                            node.kill_worker(w.worker_id)
-                            break
+            if node is None:
+                return
+            if getattr(node, "is_remote", False):
+                node.cancel_task(task_id)
+                return
+            with node._lock:
+                for w in node._workers.values():
+                    if task_id in w.running:
+                        node.kill_worker(w.worker_id)
+                        break
 
     def cluster_resources(self) -> Dict[str, float]:
         totals: Dict[str, float] = {}
@@ -904,6 +1131,10 @@ class DriverRuntime:
     def shutdown(self) -> None:
         self._stopped.set()
         self._signal_scheduler()
+        if self.head_server is not None:
+            self.head_server.stop()
+        if self.object_server is not None:
+            self.object_server.stop()
         for node in list(self.nodes.values()):
             node.stop()
         self.nodes.clear()

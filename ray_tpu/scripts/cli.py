@@ -120,6 +120,17 @@ def cmd_version(args) -> None:
     print(__version__)
 
 
+def cmd_start(args) -> None:
+    from ray_tpu.core import node_daemon
+    argv = ["--address", args.address, "--resources", args.resources,
+            "--labels", args.labels]
+    if args.object_store_memory:
+        argv += ["--object-store-memory", str(args.object_store_memory)]
+    if args.system_config:
+        argv += ["--system-config", args.system_config]
+    node_daemon.main(argv)
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(prog="ray-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -137,6 +148,15 @@ def main(argv=None) -> None:
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_submit)
     sub.add_parser("version").set_defaults(fn=cmd_version)
+    p = sub.add_parser(
+        "start", help="start a node daemon joining a head over TCP "
+        "(reference: `ray start --address`)")
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--labels", default="{}")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.add_argument("--system-config", default=None)
+    p.set_defaults(fn=cmd_start)
 
     args = parser.parse_args(argv)
     args.fn(args)
