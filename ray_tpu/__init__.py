@@ -28,6 +28,7 @@ from ray_tpu.api import (
     shutdown,
     wait,
 )
+from ray_tpu.core.generator import ObjectRefGenerator
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu import exceptions
 
@@ -45,6 +46,7 @@ __all__ = [
     "kill",
     "nodes",
     "ObjectRef",
+    "ObjectRefGenerator",
     "put",
     "remote",
     "shutdown",

@@ -53,12 +53,16 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         from ray_tpu.core import runtime as runtime_mod
         rt = runtime_mod.get_runtime()
+        num_returns = self._num_returns
+        if num_returns == "streaming":
+            # incremental yields (reference: _raylet.pyx:299)
+            num_returns = -1
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id="",
             args=[value_to_arg(a, rt) for a in args],
             kwargs={k: value_to_arg(v, rt) for k, v in kwargs.items()},
-            num_returns=self._num_returns,
+            num_returns=num_returns,
             resources={},
             max_retries=self._max_task_retries,
             name=f"{self._handle._class_name}.{self._method_name}",
@@ -68,7 +72,10 @@ class ActorMethod:
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)
-        return refs[0] if self._num_returns == 1 else refs
+        if num_returns == -1:
+            from ray_tpu.core.generator import ObjectRefGenerator
+            return ObjectRefGenerator(spec.task_id)
+        return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):
         raise TypeError("actor methods cannot be called directly; use .remote()")

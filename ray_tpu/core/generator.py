@@ -1,0 +1,87 @@
+"""ObjectRefGenerator — incremental results from streaming tasks.
+
+Capability parity with the reference's streaming generators
+(reference: python/ray/_raylet.pyx:299 ObjectRefGenerator;
+src/ray/core_worker/task_execution/generator_waiter.cc). A task or
+actor method declared with ``num_returns="streaming"`` returns one of
+these instead of an ObjectRef: each ``next()`` blocks until the worker
+has yielded (and stored) the next value, so the consumer overlaps with
+the producer — the basis for token streaming in Serve/LLM and per-block
+Data returns.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core.ids import ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef
+
+
+class ObjectRefGenerator:
+    """Iterates ObjectRefs of a streaming task's yields, in yield order.
+
+    Picklable: passing a generator to another task hands over
+    consumption (indices are tracked per-instance, so exactly one
+    consumer should iterate a given instance).
+    """
+
+    def __init__(self, task_id: TaskID, start_index: int = 0):
+        self._task_id = task_id
+        self._index = start_index
+        self._exhausted = False
+
+    @property
+    def task_id(self) -> TaskID:
+        return self._task_id
+
+    def __iter__(self) -> "ObjectRefGenerator":
+        return self
+
+    def __next__(self) -> ObjectRef:
+        return self._next_internal(timeout=None)
+
+    def next_ready(self, timeout: Optional[float] = None) -> ObjectRef:
+        """Like next() but with a timeout (raises GetTimeoutError)."""
+        return self._next_internal(timeout=timeout)
+
+    def _next_internal(self, timeout: Optional[float]) -> ObjectRef:
+        if self._exhausted:
+            raise StopIteration
+        from ray_tpu.core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime()
+        status, payload = rt.stream_next(self._task_id, self._index, timeout)
+        if status == "item":
+            self._index += 1
+            return ObjectRef(payload if isinstance(payload, ObjectID)
+                             else ObjectID(payload))
+        self._exhausted = True
+        if status == "done":
+            raise StopIteration
+        raise payload  # the task's error
+
+    def completed(self) -> bool:
+        return self._exhausted
+
+    def __reduce__(self):
+        # Serialization hands consumption to the receiver: the local
+        # copy must no longer reclaim the stream on GC (ownership
+        # transfer, reference: generator refs passed between workers).
+        self._handed_off = True
+        return (ObjectRefGenerator, (self._task_id, self._index))
+
+    def __del__(self):
+        # Reclaim owner-side state: unconsumed items (no ObjectRef was
+        # ever constructed for them) and the StreamState record itself.
+        if getattr(self, "_handed_off", False):
+            return
+        try:
+            from ray_tpu.core import runtime as runtime_mod
+        except ImportError:
+            return
+        rt = runtime_mod.get_runtime_or_none()
+        if rt is not None and getattr(rt, "is_driver", False):
+            try:
+                rt.release_stream(self._task_id, self._index)
+            except Exception:  # noqa: BLE001 — best-effort GC
+                pass

@@ -191,6 +191,10 @@ class Node:
                 self.runtime.submit_spec(spec)
             elif kind == "PUT_META":
                 self.runtime.on_worker_put(self, msg)
+            elif kind == "STREAM_ITEM":
+                self.runtime.on_stream_item(self, msg)
+            elif kind == "STREAM_NEXT":
+                self.runtime.handle_stream_next(handle, msg)
             elif kind == "GET_OBJECT":
                 self.runtime.handle_get_object(self, handle, msg)
             elif kind == "CHECK_READY":

@@ -80,6 +80,18 @@ class HeadProxy:
         self.send({"kind": "PUT_META", "object_id": msg["object_id"],
                    "contained": list(msg.get("contained", ()))})
 
+    def on_stream_item(self, node, msg: dict) -> None:
+        self.send({"kind": "STREAM_ITEM", "task_id": msg["task_id"],
+                   "object_id": msg["object_id"], "index": msg["index"],
+                   "item_kind": msg["item_kind"], "data": msg["data"],
+                   "contained": list(msg.get("contained", ()))})
+
+    def handle_stream_next(self, handle, msg: dict) -> None:
+        self.send({"kind": "STREAM_NEXT",
+                   "worker_id": handle.worker_id.binary(),
+                   "task_id": msg["task_id"], "index": msg["index"],
+                   "req_id": msg.get("req_id")})
+
     def handle_get_object(self, node, handle, msg: dict) -> None:
         self.send({"kind": "GET_OBJECT",
                    "worker_id": handle.worker_id.binary(),

@@ -151,21 +151,31 @@ class SharedMemoryStore:
         return len(packed)
 
     def get_value(self, object_id: ObjectID, timeout_s: float = 0.0):
-        """Returns (found, value). Zero-copy for large numpy payloads while
-        the arena mapping lives (process lifetime)."""
+        """Returns (found, value). Zero-copy for large numpy payloads: the
+        reader pin taken by get_buffer is released only when the
+        deserialized value itself is garbage-collected, so views into the
+        arena stay valid even after the ObjectRef is dropped (the store
+        defers freeing deleted-but-pinned objects; reference: plasma
+        buffers pinning the object for the value's lifetime)."""
         buf = self.get_buffer(object_id, timeout_s)
         if buf is None:
             return False, None
+        released = []
+
+        def on_release():
+            if not released:
+                released.append(True)
+                try:
+                    self.release(object_id)
+                except Exception:  # noqa: BLE001 — GC/shutdown context
+                    pass
+
         try:
-            value = serialization.unpack(buf)
-        finally:
-            # NOTE: the deserialized value may hold views into `buf`; the
-            # pin taken by get_buffer is dropped here, which makes the
-            # object evictable-after-delete while views exist. The owner's
-            # reference count keeps the object alive for the ref lifetime,
-            # which also covers the views (they share the ObjectRef).
+            value = serialization.unpack_pinned(buf, on_release)
+        except BaseException:
             del buf
-            self.release(object_id)
+            on_release()
+            raise
         return True, value
 
     def close(self):

@@ -120,6 +120,10 @@ class RemoteFunction:
         function_id = self._ensure_registered(rt)
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        # "streaming": incremental yields via ObjectRefGenerator
+        # (reference: num_returns="streaming", _raylet.pyx:299).
+        if num_returns == "streaming":
+            num_returns = -1
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id=function_id,
@@ -134,6 +138,9 @@ class RemoteFunction:
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)
+        if num_returns == -1:
+            from ray_tpu.core.generator import ObjectRefGenerator
+            return ObjectRefGenerator(spec.task_id)
         return refs[0] if num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

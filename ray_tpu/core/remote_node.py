@@ -248,6 +248,11 @@ class HeadServer:
             rt.submit_spec(serialization.loads(msg["spec"]))
         elif kind == "PUT_META":
             rt.on_worker_put(node, msg)
+        elif kind == "STREAM_ITEM":
+            rt.on_stream_item(node, msg)
+        elif kind == "STREAM_NEXT":
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.handle_stream_next(worker, msg)
         elif kind == "REPLICA":
             rt.add_object_replica(ObjectID(msg["object_id"]), node.node_id)
         elif kind == "GET_OBJECT":

@@ -57,6 +57,22 @@ def set_runtime(rt) -> None:
         _runtime = rt
 
 
+class StreamState:
+    """Owner-side record of a streaming task's yields (reference:
+    task_manager.h streaming-generator return bookkeeping)."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.items: List[ObjectID] = []
+        self.done = False
+        self.error: Optional[Exception] = None
+        # (index, fire(status, payload)) waiters from worker STREAM_NEXT
+        self.waiters: List[Tuple[int, Callable]] = []
+        # consumer dropped its generator; late items are reclaimed and
+        # the state is popped at stream completion
+        self.abandoned = False
+
+
 class ActorInfo:
     def __init__(self, creation_spec: TaskSpec):
         self.creation_spec = creation_spec
@@ -91,6 +107,9 @@ class DriverRuntime:
         # (task return / put): container oid -> contained oids
         self._contained_refs: Dict[ObjectID, List[ObjectID]] = {}
         self._contained_lock = threading.Lock()
+        # streaming-task yields (reference: _raylet.pyx:299)
+        self._streams: Dict[TaskID, StreamState] = {}
+        self._streams_lock = threading.Lock()
         # single expiry thread for deferred ref drops (no Timer churn)
         self._expiry_items: List[tuple] = []
         self._expiry_cv = threading.Condition()
@@ -231,7 +250,8 @@ class DriverRuntime:
             if spec.is_actor_creation:
                 actor_ids.add(spec.actor_id)
                 continue
-            retry = self.task_manager.consume_retry(spec.task_id)
+            retry = (None if spec.num_returns == -1
+                     else self.task_manager.consume_retry(spec.task_id))
             if retry is not None:
                 self._resubmit(retry)
                 continue
@@ -243,6 +263,8 @@ class DriverRuntime:
             self._record_event(spec, "FAILED", node_id=node_id,
                                error=str(err))
             self.task_manager.fail(spec.task_id, err)
+            if spec.num_returns == -1:
+                self._finish_stream(spec.task_id, err)
         for aid in actor_ids:
             self._handle_actor_death(aid, node)
         self._signal_scheduler()
@@ -304,6 +326,149 @@ class DriverRuntime:
         for spec in queued:
             self.scheduler.release(node_id, self._spec_resources(spec))
             self._enqueue(spec)
+
+    # --- streaming generators -------------------------------------------
+    # reference: _raylet.pyx:299 ObjectRefGenerator owner-side protocol.
+    def _stream(self, task_id: TaskID) -> StreamState:
+        with self._streams_lock:
+            state = self._streams.get(task_id)
+            if state is None:
+                state = self._streams[task_id] = StreamState()
+            return state
+
+    def on_stream_item(self, node, msg: dict) -> None:
+        """A worker yielded one item of a streaming task."""
+        oid = ObjectID(msg["object_id"])
+        self._pin_contained(oid, msg.get("contained", ()))
+        if msg["item_kind"] == "inline":
+            self.memory_store.put(oid, ("packed", bytes(msg["data"])))
+            self.task_manager.set_location(oid, ObjectLocation("memory"))
+        else:
+            self.task_manager.set_location(
+                oid, ObjectLocation("shm", node.node_id))
+        self.task_manager.mark_object_ready(oid)
+        state = self._stream(TaskID(msg["task_id"]))
+        with state.cond:
+            abandoned = state.abandoned
+            state.items.append(oid)
+            fired = [w for w in state.waiters if w[0] < len(state.items)]
+            state.waiters = [w for w in state.waiters
+                             if w[0] >= len(state.items)]
+            state.cond.notify_all()
+        if abandoned:
+            # nobody will consume this item; reclaim after grace
+            self.reference_counter.delete_if_unreferenced(
+                oid, defer=(self._ref_grace_s, self._schedule_expiry))
+            return
+        for index, fire in fired:
+            fire("item", state.items[index].binary())
+
+    def _finish_stream(self, task_id: TaskID,
+                       error: Optional[Exception]) -> None:
+        with self._streams_lock:
+            state = self._streams.get(task_id)
+        if state is None:
+            return
+        with state.cond:
+            state.done = True
+            state.error = error
+            waiters = state.waiters
+            state.waiters = []
+            abandoned = state.abandoned
+            state.cond.notify_all()
+        if abandoned:
+            with self._streams_lock:
+                self._streams.pop(task_id, None)
+        for index, fire in waiters:
+            if index < len(state.items):
+                fire("item", state.items[index].binary())
+            elif error is not None:
+                fire("error", serialization.dumps(error))
+            else:
+                fire("done", None)
+
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: Optional[float]):
+        """Blocking owner-side wait for stream item ``index``.
+        Returns ("item", ObjectID) | ("done", None) | ("error", exc)."""
+        state = self._stream(task_id)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with state.cond:
+            while True:
+                if index < len(state.items):
+                    return "item", state.items[index]
+                if state.done:
+                    if state.error is not None:
+                        return "error", state.error
+                    return "done", None
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"stream item {index} of task {task_id} timed out")
+                state.cond.wait(remaining if remaining is not None else 0.5)
+
+    def handle_stream_next(self, worker, msg: dict) -> None:
+        """STREAM_NEXT from a worker: reply when the item exists
+        (asynchronously if it doesn't yet)."""
+        task_id = TaskID(msg["task_id"])
+        index = msg["index"]
+        req_id = msg.get("req_id")
+
+        def fire(status: str, payload) -> None:
+            out = {"kind": "STREAM_REPLY", "req_id": req_id,
+                   "status": status}
+            if status == "item":
+                out["object_id"] = payload
+            elif status == "error":
+                out["error"] = payload
+            worker.send(out)
+
+        state = self._stream(task_id)
+        with state.cond:
+            if index < len(state.items):
+                item = state.items[index].binary()
+            elif state.done:
+                if state.error is not None:
+                    fire("error", serialization.dumps(state.error))
+                else:
+                    fire("done", None)
+                # A worker consumer reached the end; its (handed-off)
+                # generator never calls release_stream, so reclaim the
+                # state here after a grace window.
+                self._schedule_expiry(
+                    self._ref_grace_s,
+                    lambda: self._pop_finished_stream(task_id))
+                return
+            else:
+                state.waiters.append((index, fire))
+                return
+        fire("item", item)
+
+    def _pop_finished_stream(self, task_id: TaskID) -> None:
+        with self._streams_lock:
+            state = self._streams.get(task_id)
+            if state is not None and state.done:
+                self._streams.pop(task_id, None)
+
+    def release_stream(self, task_id: TaskID, from_index: int) -> None:
+        """The consumer dropped its generator: reclaim unconsumed items
+        and the StreamState (immediately if the stream finished, else at
+        stream completion via the abandoned flag)."""
+        with self._streams_lock:
+            state = self._streams.get(task_id)
+        if state is None:
+            return
+        with state.cond:
+            tail = state.items[from_index:]
+            finished = state.done
+            state.abandoned = True
+        for oid in tail:
+            self.reference_counter.delete_if_unreferenced(
+                oid, defer=(self._ref_grace_s, self._schedule_expiry))
+        if finished:
+            with self._streams_lock:
+                self._streams.pop(task_id, None)
 
     # --- submission ----------------------------------------------------
     def submit_spec(self, spec: TaskSpec) -> None:
@@ -416,17 +581,22 @@ class DriverRuntime:
         self.actors[spec.actor_id] = ActorInfo(spec)
         self.submit_spec(spec)
 
+    def _fail_task(self, spec: TaskSpec, err: Exception) -> None:
+        self.task_manager.fail(spec.task_id, err)
+        if spec.num_returns == -1:
+            self._finish_stream(spec.task_id, err)
+
     def _route_actor_task(self, spec: TaskSpec) -> None:
         info = self.actors.get(spec.actor_id)
         record = self.gcs.get_actor(spec.actor_id)
         if info is None or record is None:
-            self.task_manager.fail(spec.task_id,
-                                   ActorDiedError(spec.actor_id, "unknown actor"))
+            self._fail_task(spec,
+                            ActorDiedError(spec.actor_id, "unknown actor"))
             return
         with info.lock:
             if record.state == "DEAD":
-                self.task_manager.fail(
-                    spec.task_id,
+                self._fail_task(
+                    spec,
                     ActorDiedError(spec.actor_id,
                                    f"actor is dead: {record.death_cause}"))
                 return
@@ -478,7 +648,7 @@ class DriverRuntime:
         error_blob = msg.get("error")
         if error_blob is not None:
             err = serialization.loads(error_blob)
-            if spec.retry_exceptions:
+            if spec.retry_exceptions and spec.num_returns != -1:
                 retry = self.task_manager.consume_retry(spec.task_id)
                 if retry is not None:
                     self._release_task_resources(spec, node.node_id)
@@ -494,6 +664,8 @@ class DriverRuntime:
             self._record_event(spec, "FAILED", node_id=node.node_id,
                               error=msg.get("error_str"))
             self.task_manager.fail(spec.task_id, err)
+            if spec.num_returns == -1:
+                self._finish_stream(spec.task_id, err)
             self._release_task_resources(spec, node.node_id)
             self._signal_scheduler()
             return
@@ -538,6 +710,8 @@ class DriverRuntime:
             # Creation resources stay held for the actor's lifetime.
         else:
             self.task_manager.complete(spec.task_id)
+            if spec.num_returns == -1:
+                self._finish_stream(spec.task_id, None)
             self._release_task_resources(spec, node.node_id)
         self._record_event(spec, "FINISHED", node_id=node.node_id)
         self._signal_scheduler()
@@ -581,7 +755,11 @@ class DriverRuntime:
         for spec in running:
             if not spec.is_actor_creation and spec.actor_id is None:
                 self.scheduler.release(node.node_id, self._spec_resources(spec))
-            retry = self.task_manager.consume_retry(spec.task_id)
+            # Streaming tasks never retry: already-consumed yields would
+            # replay (reference keeps generator retries behind a flag for
+            # the same reason).
+            retry = (None if spec.num_returns == -1
+                     else self.task_manager.consume_retry(spec.task_id))
             if retry is not None and not spec.is_actor_creation:
                 self._resubmit(retry)
             elif spec.is_actor_creation:
@@ -595,6 +773,8 @@ class DriverRuntime:
                 self._record_event(spec, "FAILED", node_id=node.node_id,
                                   error=str(err))
                 self.task_manager.fail(spec.task_id, err)
+                if spec.num_returns == -1:
+                    self._finish_stream(spec.task_id, err)
         if actor_id is not None or any(s.is_actor_creation for s in running):
             aid = actor_id or next(
                 s.actor_id for s in running if s.is_actor_creation)
@@ -662,7 +842,7 @@ class DriverRuntime:
             buffered = list(info.buffered)
             info.buffered.clear()
         for spec in buffered:
-            self.task_manager.fail(spec.task_id, err)
+            self._fail_task(spec, err)
 
     # --- object plane ---------------------------------------------------
     def put(self, value: Any) -> ObjectRef:

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import io
 import pickle
+import sys
 import threading
 from typing import Any, List, Tuple
 
@@ -141,6 +142,73 @@ def unpack(src) -> Any:
         buffers.append(src[offset : offset + size])
         offset += size
     return pickle.loads(data, buffers=buffers)
+
+
+def unpack_pinned(src, on_release) -> Any:
+    """Like unpack(), but ties ``on_release`` to the *value's* lifetime.
+
+    Zero-copy deserialization hands out numpy views into the shared
+    memory arena; the store pin must outlive those views, not the
+    ObjectRef (reference: plasma client buffers stay valid while the
+    deserialized value is referenced, store_provider/plasma_store_
+    provider.h:94). Each out-of-band buffer is wrapped in a PEP-688
+    buffer-provider the arrays keep alive; when the last wrapper is
+    collected, ``on_release`` fires. Values with no out-of-band buffers
+    are fully copied by pickle, so ``on_release`` fires immediately.
+    """
+    src = memoryview(src)
+    data_len = int.from_bytes(src[0:4], "little")
+    index_len = int.from_bytes(src[4:8], "little")
+    offset = 8
+    sizes = pickle.loads(src[offset : offset + index_len])
+    offset += index_len
+    data = src[offset : offset + data_len]
+    offset += data_len
+    if not sizes:
+        value = pickle.loads(data)
+        on_release()
+        return value
+    if sys.version_info < (3, 12):
+        # _PinnedBuffer needs PEP-688 (__buffer__ on Python classes,
+        # 3.12+). Fall back to plain views: the pin releases with the
+        # ObjectRef instead of the value (pre-round-2 semantics).
+        value = unpack(src)
+        on_release()
+        return value
+    remaining = [len(sizes)]
+
+    class _PinnedBuffer:
+        """Buffer provider (PEP 688) releasing the store pin at GC."""
+
+        __slots__ = ("_view",)
+
+        def __init__(self, view):
+            self._view = view
+
+        def __buffer__(self, flags):
+            return memoryview(self._view)
+
+        def __release_buffer__(self, view):
+            pass
+
+        def __del__(self):
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                try:
+                    on_release()
+                except Exception:  # noqa: BLE001 — GC context
+                    pass
+
+    buffers = []
+    for size in sizes:
+        offset = _align(offset)
+        buffers.append(_PinnedBuffer(src[offset : offset + size]))
+        offset += size
+    try:
+        return pickle.loads(data, buffers=buffers)
+    except BaseException:
+        del buffers  # fire on_release via the wrappers
+        raise
 
 
 def _maybe_register_by_value(value: Any) -> None:

@@ -204,6 +204,20 @@ class WorkerRuntime:
         self.conn.send({"kind": "CANCEL", "object_id": object_id.binary(),
                         "force": force})
 
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: Optional[float]):
+        """Consume item ``index`` of a streaming task owned by the head
+        (reference: ObjectRefGenerator protocol, _raylet.pyx:299)."""
+        reply = self.request({"kind": "STREAM_NEXT",
+                              "task_id": task_id.binary(), "index": index},
+                             timeout=timeout)
+        status = reply["status"]
+        if status == "item":
+            return "item", ObjectID(reply["object_id"])
+        if status == "done":
+            return "done", None
+        return "error", serialization.loads(reply["error"])
+
     # --- control plane --------------------------------------------------
     def gcs_call(self, method: str, *args) -> Any:
         reply = self.request({"kind": "GCS_REQUEST", "method": method,
@@ -255,6 +269,81 @@ def _resolve_args(rt: WorkerRuntime, spec: TaskSpec):
     return args, kwargs
 
 
+def _stream_item(rt: WorkerRuntime, spec: TaskSpec, index: int,
+                 value: Any) -> None:
+    """Store one yielded value and report it to the owner incrementally
+    (reference: streaming-generator intermediate returns,
+    generator_waiter.cc)."""
+    oid = ObjectID.from_random()
+    kind, data, contained = rt.put_result(oid, value)
+    rt.conn.send({"kind": "STREAM_ITEM", "task_id": spec.task_id.binary(),
+                  "object_id": oid.binary(), "index": index,
+                  "item_kind": kind, "data": data, "contained": contained})
+
+
+def _stream_out(rt: WorkerRuntime, spec: TaskSpec, result: Any) -> int:
+    """Drain a (a)sync generator, reporting each yield. Returns count."""
+    import inspect
+
+    if inspect.isasyncgen(result):
+        import asyncio
+
+        async def drain():
+            count = 0
+            async for value in result:
+                _stream_item(rt, spec, count, value)
+                count += 1
+            return count
+
+        return asyncio.run(drain())
+    count = 0
+    for value in result:
+        _stream_item(rt, spec, count, value)
+        count += 1
+    return count
+
+
+def _call_target(rt: WorkerRuntime, spec: TaskSpec, args, kwargs) -> Any:
+    if spec.actor_id is not None and not spec.is_actor_creation:
+        if spec.method_name == "__ray_call__":
+            # run an arbitrary function against the actor instance
+            # (reference: ActorHandle.__ray_call__ convention used by
+            # compiled graphs to install execution loops)
+            fn = args[0]
+            return fn(rt.actor_instance, *args[1:], **kwargs)
+        method = getattr(rt.actor_instance, spec.method_name)
+        return method(*args, **kwargs)
+    fn = rt.get_function(spec.function_id)
+    return fn(*args, **kwargs)
+
+
+def _pack_reply(rt: WorkerRuntime, spec: TaskSpec, reply: dict,
+                result_values: List[Any]) -> dict:
+    results = []
+    for oid, value in zip(spec.return_ids(), result_values):
+        kind, data, contained = rt.put_result(oid, value)
+        results.append((oid.binary(), kind, data, contained))
+    reply["results"] = results
+    reply["error"] = None
+    return reply
+
+
+def _pack_stream_reply(reply: dict, count: int) -> dict:
+    reply["stream_len"] = count
+    reply["results"] = []
+    reply["error"] = None
+    return reply
+
+
+def _pack_error(spec: TaskSpec, reply: dict) -> dict:
+    tb = traceback.format_exc()
+    err = TaskError(spec.name or spec.function_id, tb, None)
+    reply["results"] = []
+    reply["error"] = serialization.dumps(err)
+    reply["error_str"] = tb
+    return reply
+
+
 def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     """Run one task/actor-task; returns the TASK_DONE message."""
     rt._current_task_id.value = spec.task_id
@@ -267,36 +356,55 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
             rt.actor_instance = cls(*args, **kwargs)
             rt.actor_id = spec.actor_id
             result_values = [None]
-        elif spec.actor_id is not None:
-            if spec.method_name == "__ray_call__":
-                # run an arbitrary function against the actor instance
-                # (reference: ActorHandle.__ray_call__ convention used by
-                # compiled graphs to install execution loops)
-                fn = args[0]
-                result = fn(rt.actor_instance, *args[1:], **kwargs)
-            else:
-                method = getattr(rt.actor_instance, spec.method_name)
-                result = method(*args, **kwargs)
-            result_values = _split_returns(result, spec.num_returns)
         else:
-            fn = rt.get_function(spec.function_id)
-            result = fn(*args, **kwargs)
+            result = _call_target(rt, spec, args, kwargs)
+            if spec.num_returns == -1:
+                return _pack_stream_reply(
+                    reply, _stream_out(rt, spec, result))
             result_values = _split_returns(result, spec.num_returns)
-        results = []
-        for oid, value in zip(spec.return_ids(), result_values):
-            kind, data, contained = rt.put_result(oid, value)
-            results.append((oid.binary(), kind, data, contained))
-        reply["results"] = results
-        reply["error"] = None
-    except Exception as e:  # noqa: BLE001 — user code may raise anything
-        tb = traceback.format_exc()
-        err = TaskError(spec.name or spec.function_id, tb, None)
-        reply["results"] = []
-        reply["error"] = serialization.dumps(err)
-        reply["error_str"] = tb
+        return _pack_reply(rt, spec, reply, result_values)
+    except Exception:  # noqa: BLE001 — user code may raise anything
+        return _pack_error(spec, reply)
     finally:
         rt._current_task_id.value = None
-    return reply
+
+
+async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
+    """Async-actor execution: awaits coroutine methods and drains async
+    generators on the actor's event loop, so ``max_concurrency``
+    requests interleave at await points (reference: asyncio actors,
+    task_execution/concurrency_group_manager.h + fiber.h)."""
+    import asyncio
+    import inspect
+
+    rt._current_task_id.value = spec.task_id
+    reply: dict = {"kind": "TASK_DONE", "task_id": spec.task_id.binary(),
+                   "spec_is_actor_creation": False}
+    loop = asyncio.get_running_loop()
+    try:
+        # Argument resolution may block on object fetches; keep the loop
+        # free for other coroutines.
+        args, kwargs = await loop.run_in_executor(
+            None, _resolve_args, rt, spec)
+        result = _call_target(rt, spec, args, kwargs)
+        if inspect.iscoroutine(result):
+            result = await result
+        if spec.num_returns == -1:
+            if inspect.isasyncgen(result):
+                count = 0
+                async for value in result:
+                    _stream_item(rt, spec, count, value)
+                    count += 1
+            else:
+                count = await loop.run_in_executor(
+                    None, _stream_out, rt, spec, result)
+            return _pack_stream_reply(reply, count)
+        return _pack_reply(rt, spec, reply,
+                           _split_returns(result, spec.num_returns))
+    except Exception:  # noqa: BLE001 — user code may raise anything
+        return _pack_error(spec, reply)
+    finally:
+        rt._current_task_id.value = None
 
 
 def _split_returns(result: Any, num_returns: int) -> List[Any]:
@@ -331,10 +439,45 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
 
     exec_pool = ThreadPoolExecutor(max_workers=1)
     pool_lock = threading.Lock()
+    # Async-actor support (reference: asyncio actors — the reference runs
+    # coroutine methods on a dedicated event loop so max_concurrency
+    # requests interleave at awaits rather than occupying threads).
+    actor_state = {"loop": None, "sem": None, "max_concurrency": 1}
 
     def run_task(spec: TaskSpec):
         reply = _execute(rt, spec)
         conn.send(reply)
+
+    def ensure_actor_loop():
+        import asyncio
+        if actor_state["loop"] is None:
+            loop = asyncio.new_event_loop()
+            threading.Thread(target=loop.run_forever,
+                             name="actor-loop", daemon=True).start()
+            actor_state["loop"] = loop
+            actor_state["sem"] = asyncio.Semaphore(
+                actor_state["max_concurrency"])
+        return actor_state["loop"]
+
+    def run_async_task(spec: TaskSpec):
+        import asyncio
+
+        async def run():
+            async with actor_state["sem"]:
+                reply = await _execute_async(rt, spec)
+                conn.send(reply)
+
+        asyncio.run_coroutine_threadsafe(run(), ensure_actor_loop())
+
+    def is_async_method(spec: TaskSpec) -> bool:
+        import inspect
+        if rt.actor_instance is None or spec.method_name is None:
+            return False
+        if spec.method_name == "__ray_call__":
+            return False
+        method = getattr(rt.actor_instance, spec.method_name, None)
+        return (inspect.iscoroutinefunction(method)
+                or inspect.isasyncgenfunction(method))
 
     while True:
         msg = conn.recv()
@@ -346,8 +489,14 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
             if spec.is_actor_creation and spec.max_concurrency > 1:
                 with pool_lock:
                     exec_pool = ThreadPoolExecutor(max_workers=spec.max_concurrency)
-            exec_pool.submit(run_task, spec)
-        elif kind in ("OBJECT_VALUE", "GCS_REPLY", "READY_REPLY"):
+            if spec.is_actor_creation:
+                actor_state["max_concurrency"] = max(1, spec.max_concurrency)
+            if kind == "EXECUTE_ACTOR_TASK" and is_async_method(spec):
+                run_async_task(spec)
+            else:
+                exec_pool.submit(run_task, spec)
+        elif kind in ("OBJECT_VALUE", "GCS_REPLY", "READY_REPLY",
+                      "STREAM_REPLY"):
             rt.deliver_reply(msg)
         elif kind == "SHUTDOWN":
             break

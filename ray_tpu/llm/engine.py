@@ -51,6 +51,10 @@ class GenerationRequest:
     top_k: int = 0
     stop_ids: tuple = ()
     request_id: int = field(default_factory=itertools.count().__next__)
+    # Streaming: when set (queue.Queue), the stepper pushes each emitted
+    # token as it decodes; None terminates the stream (reference: vLLM's
+    # per-request output stream consumed by serve token streaming).
+    stream_queue: Optional[Any] = None
     # filled by the engine
     output_ids: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
@@ -59,6 +63,13 @@ class GenerationRequest:
     @property
     def done(self) -> bool:
         return self.finish_reason is not None
+
+    def push_stream(self, item) -> None:
+        if self.stream_queue is not None:
+            try:
+                self.stream_queue.put_nowait(item)
+            except Exception:  # noqa: BLE001 — consumer gone
+                pass
 
 
 class _Slot:
@@ -177,7 +188,9 @@ class ContinuousBatchingEngine:
             request.finish_reason = "length"
         elif slot.pos >= self.config.max_seq - 1:
             request.finish_reason = "length"
+        request.push_stream(token)
         if request.done:
+            request.push_stream(None)
             slot.request = None
 
     def step(self) -> int:
@@ -231,10 +244,12 @@ class ContinuousBatchingEngine:
         for request in pending:
             request.error = message
             request.finish_reason = "error"
+            request.push_stream(None)
         for slot in self.slots:
             if slot.request is not None:
                 slot.request.error = message
                 slot.request.finish_reason = "error"
+                slot.request.push_stream(None)
             slot.request = None
             slot.pos = 0
             slot.next_token = 0

@@ -55,12 +55,26 @@ class DeploymentResponse:
         return value
 
 
+class DeploymentResponseGenerator:
+    """Iterates a streaming deployment response's values (reference:
+    serve/handle.py DeploymentResponseGenerator). For a handler that
+    returned a plain value, yields that single value."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __iter__(self):
+        for _kind, value in self._inner:
+            yield value
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self.method_name = method_name
+        self.stream = stream
 
     def _controller(self):
         return ray_tpu.get_actor(
@@ -68,13 +82,18 @@ class DeploymentHandle:
                        fromlist=["CONTROLLER_NAME"]).CONTROLLER_NAME)
 
     def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
                 **_ignored) -> "DeploymentHandle":
         return DeploymentHandle(self.deployment_name, self.app_name,
-                                method_name or self.method_name)
+                                method_name or self.method_name,
+                                self.stream if stream is None else stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = _get_router(self.deployment_name, self._controller())
         blob = serialization.dumps((args, kwargs))
+        if self.stream:
+            return DeploymentResponseGenerator(
+                router.stream(self.method_name, blob))
         rid, ref = router.submit(self.method_name, blob)
         return DeploymentResponse(router, self.method_name, blob, rid, ref)
 
@@ -82,8 +101,9 @@ class DeploymentHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.deployment_name, self.app_name,
-                                method_name=name)
+                                method_name=name, stream=self.stream)
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self.app_name, self.method_name))
+                (self.deployment_name, self.app_name, self.method_name,
+                 self.stream))

@@ -155,6 +155,53 @@ class LLMServer:
             "finish_reason": request.finish_reason,
         }
 
+    def _generate_stream(self, prompt: str, *,
+                         max_tokens: Optional[int] = None,
+                         temperature: Optional[float] = None,
+                         top_k: int = 0):
+        """Yield decoded text per emitted token (reference: vLLM output
+        streams behind serve token streaming). The engine's stepper
+        pushes each token onto the request's queue as it decodes."""
+        import queue
+
+        ids = self.tokenizer.encode(prompt)
+        request = GenerationRequest(
+            prompt_ids=ids,
+            max_tokens=max_tokens or self.config.max_tokens,
+            temperature=(self.config.temperature if temperature is None
+                         else temperature),
+            top_k=top_k,
+            stop_ids=(self.tokenizer.eos_id,)
+            if self.tokenizer.eos_id is not None else (),
+            stream_queue=queue.Queue())
+        self.engine.add_request(request)
+        self._wake.set()
+        # Incremental detokenization: decode the full output each step
+        # and emit the text delta, holding back while the tail is an
+        # incomplete multi-byte/multi-piece character (U+FFFD) so
+        # streamed text matches the non-streamed decode exactly.
+        out_ids: List[int] = []
+        emitted = ""
+        while True:
+            token = request.stream_queue.get()
+            if token is None:
+                break
+            if token in request.stop_ids:
+                continue
+            out_ids.append(token)
+            text = self.tokenizer.decode(out_ids)
+            if text.endswith("�"):
+                continue
+            delta = text[len(emitted):]
+            if delta:
+                emitted = text
+                yield delta
+        if request.error is not None:
+            raise RuntimeError(request.error)
+        final = self.tokenizer.decode(out_ids)
+        if len(final) > len(emitted):
+            yield final[len(emitted):]
+
     # -- OpenAI-compatible surface (routed by path) --------------------
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         path = request.get("__path__", "")
@@ -178,6 +225,8 @@ class LLMServer:
             sampling = self._validate_sampling(body)
         except ValueError as e:
             return self._invalid_request(e)
+        if body.get("stream"):
+            return self._stream_completions(body, prompt, sampling)
         result = self._generate(
             prompt,
             max_tokens=sampling.get("max_tokens"),
@@ -200,6 +249,57 @@ class LLMServer:
             },
         }
 
+    def _stream_completions(self, body: Dict[str, Any], prompt: str,
+                            sampling: Dict[str, Any]):
+        """SSE generator for /v1/completions with stream=true
+        (reference: OpenAI SSE chunks, serve/llm streaming responses)."""
+        import json as _json
+
+        cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
+        model = body.get("model", self.config.model_id)
+        for text in self._generate_stream(
+                prompt, max_tokens=sampling.get("max_tokens"),
+                temperature=sampling.get("temperature"),
+                top_k=sampling["top_k"]):
+            chunk = {"id": cmpl_id, "object": "text_completion",
+                     "model": model,
+                     "choices": [{"index": 0, "text": text,
+                                  "finish_reason": None}]}
+            yield f"data: {_json.dumps(chunk)}\n\n"
+        final = {"id": cmpl_id, "object": "text_completion", "model": model,
+                 "choices": [{"index": 0, "text": "",
+                              "finish_reason": "stop"}]}
+        yield f"data: {_json.dumps(final)}\n\n"
+        yield "data: [DONE]\n\n"
+
+    def _stream_chat(self, body: Dict[str, Any], prompt: str,
+                     sampling: Dict[str, Any]):
+        import json as _json
+
+        chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        model = body.get("model", self.config.model_id)
+        head = {"id": chat_id, "object": "chat.completion.chunk",
+                "model": model,
+                "choices": [{"index": 0,
+                             "delta": {"role": "assistant"},
+                             "finish_reason": None}]}
+        yield f"data: {_json.dumps(head)}\n\n"
+        for text in self._generate_stream(
+                prompt, max_tokens=sampling.get("max_tokens"),
+                temperature=sampling.get("temperature"),
+                top_k=sampling["top_k"]):
+            chunk = {"id": chat_id, "object": "chat.completion.chunk",
+                     "model": model,
+                     "choices": [{"index": 0, "delta": {"content": text},
+                                  "finish_reason": None}]}
+            yield f"data: {_json.dumps(chunk)}\n\n"
+        final = {"id": chat_id, "object": "chat.completion.chunk",
+                 "model": model,
+                 "choices": [{"index": 0, "delta": {},
+                              "finish_reason": "stop"}]}
+        yield f"data: {_json.dumps(final)}\n\n"
+        yield "data: [DONE]\n\n"
+
     def chat_completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         messages = body.get("messages", [])
         if not isinstance(messages, list) or any(
@@ -215,6 +315,8 @@ class LLMServer:
         prompt = "".join(
             f"<|{m.get('role', 'user')}|>{content}"
             for m, content in zip(messages, contents)) + "<|assistant|>"
+        if body.get("stream"):
+            return self._stream_chat(body, prompt, sampling)
         result = self._generate(
             prompt,
             max_tokens=sampling.get("max_tokens"),

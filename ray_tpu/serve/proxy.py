@@ -18,7 +18,6 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
-from ray_tpu.serve.handle import DeploymentHandle
 
 
 class _ProxyState:
@@ -86,12 +85,52 @@ def _make_handler(state: _ProxyState):
             request.pop("__path__", None)
             if rest != "/":
                 request["__path__"] = rest
+            streaming_started = False
             try:
-                handle = DeploymentHandle(dep)
-                result = handle.remote(request).result(timeout_s=60.0)
-                self._respond(200, result)
+                # Streaming-first protocol: the replica's header item
+                # tells us whether the handler streamed (→ SSE/chunked
+                # response, reference: serve/_private/proxy.py:706
+                # streaming responses) or returned a value (→ JSON).
+                from ray_tpu.core import serialization
+                from ray_tpu.serve.handle import _get_router
+                router = _get_router(dep, state.controller)
+                blob = serialization.dumps(((request,), {}))
+                gen = router.stream("__call__", blob, item_timeout_s=60.0)
+                first = next(gen, None)
+                if first is None:
+                    self._respond(200, None)
+                    return
+                kind, value = first
+                if kind == "single":
+                    self._respond(200, value)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                streaming_started = True
+                self._write_chunk(value)
+                for _kind, chunk in gen:
+                    self._write_chunk(chunk)
             except Exception as e:  # noqa: BLE001 — surface as 500
-                self._respond(500, {"error": str(e)})
+                if streaming_started:
+                    return  # headers sent: a clean close, never a second
+                           # status line into the SSE body
+                try:
+                    self._respond(500, {"error": str(e)})
+                except (OSError, ValueError):
+                    pass
+
+        def _write_chunk(self, chunk: Any) -> None:
+            if isinstance(chunk, (bytes, bytearray)):
+                data = bytes(chunk)
+            elif isinstance(chunk, str):
+                data = chunk.encode()
+            else:
+                data = (json.dumps(chunk) + "\n").encode()
+            self.wfile.write(data)
+            self.wfile.flush()
 
         def do_GET(self):  # noqa: N802
             self._handle(None)

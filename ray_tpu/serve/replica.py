@@ -75,6 +75,63 @@ class Replica:
                 if len(self._metric_samples) > 1000:
                     self._metric_samples = self._metric_samples[-500:]
 
+    def handle_request_streaming(self, method_name: str, args_blob: bytes):
+        """Streaming request path (called with num_returns="streaming";
+        reference: replica.py:793 handle_request_streaming). Yields a
+        header item first:
+          {"type": "rejected"}               — at max_ongoing_requests
+          {"type": "single", "data": value}  — handler returned a value
+          {"type": "stream"}                 — handler is a generator;
+                                               chunks follow, one per item
+        Backpressure accounting covers the whole stream lifetime.
+        """
+        import inspect
+
+        with self._lock:
+            admitted = self._ongoing < self.max_ongoing
+            if admitted:
+                self._ongoing += 1
+                self._total += 1
+        if not admitted:
+            # yield OUTSIDE the lock: a generator suspension while
+            # holding it would block every other request thread.
+            yield {"type": "rejected"}
+            return
+        try:
+            args, kwargs = serialization.loads(args_blob)
+            fn = getattr(self.callable, method_name, self.callable)
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                import asyncio
+                result = asyncio.run(result)
+            if inspect.isgenerator(result):
+                yield {"type": "stream"}
+                for chunk in result:
+                    yield {"type": "chunk", "data": chunk}
+            elif inspect.isasyncgen(result):
+                import asyncio
+
+                yield {"type": "stream"}
+                loop = asyncio.new_event_loop()
+                try:
+                    while True:
+                        try:
+                            chunk = loop.run_until_complete(
+                                result.__anext__())
+                        except StopAsyncIteration:
+                            break
+                        yield {"type": "chunk", "data": chunk}
+                finally:
+                    loop.close()
+            else:
+                yield {"type": "single", "data": result}
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+                self._metric_samples.append((time.monotonic(), self._ongoing))
+                if len(self._metric_samples) > 1000:
+                    self._metric_samples = self._metric_samples[-500:]
+
     # -- router/controller probes --
 
     def get_queue_len(self) -> int:

@@ -80,6 +80,49 @@ class Router:
         rid, handle = self.choose()
         return rid, handle.handle_request.remote(method_name, args_blob)
 
+    def stream(self, method_name: str, args_blob: bytes,
+               item_timeout_s: Optional[float] = None):
+        """Route a streaming request (reference: router streaming path,
+        serve/_private/router.py handle streaming). Yields the replica's
+        items after the header: a single ("single", value) item, or
+        ("chunk", value) items as the handler produces them. Re-routes
+        on rejection/replica death before any chunk was consumed."""
+        attempts = 0
+        deadline = time.monotonic() + 60.0
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"streaming request to {self.deployment_name} not "
+                    f"admitted after {attempts} rejected attempts")
+            rid, handle = self.choose()
+            it = handle.handle_request_streaming.options(
+                num_returns="streaming").remote(method_name, args_blob)
+            try:
+                header = ray_tpu.get(it.next_ready(item_timeout_s),
+                                     timeout=item_timeout_s)
+            except StopIteration:
+                self._refresh(block=False)
+                continue
+            except ray_tpu.exceptions.ActorError:
+                self._refresh(block=False)
+                continue
+            kind = header.get("type")
+            if kind == "rejected":
+                attempts += 1
+                self._qlen_cache.pop(rid, None)
+                time.sleep(min(0.05 * attempts, 0.5))
+                continue
+            if kind == "single":
+                yield "single", header.get("data")
+                return
+            while True:
+                try:
+                    ref = it.next_ready(item_timeout_s)
+                except StopIteration:
+                    return
+                item = ray_tpu.get(ref, timeout=item_timeout_s)
+                yield "chunk", item.get("data")
+
     def fetch(self, method_name: str, args_blob: bytes,
               timeout: Optional[float]) -> Any:
         """Route + get with rejection retries (the blocking path)."""

@@ -1,0 +1,131 @@
+"""Serve streaming: SSE proxy responses, streaming handles, LLM tokens.
+
+Reference models: python/ray/serve/tests/test_streaming_response.py and
+the serve/llm OpenAI SSE surface.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance(ray_start_shared):
+    yield ray_start_shared
+    serve.shutdown()
+
+
+def test_streaming_handle(serve_instance):
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield {"i": i}
+
+    handle = serve.run(Streamer.bind(), name="stream_app")
+    out = list(handle.options(stream=True).remote(3))
+    assert out == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+
+def test_streaming_handle_single_value(serve_instance):
+    """Non-generator handlers still work through the streaming path."""
+    @serve.deployment
+    def plain(x):
+        return x * 2
+
+    handle = serve.run(plain.bind(), name="plain_stream_app")
+    assert list(handle.options(stream=True).remote(21)) == [42]
+
+
+def test_proxy_sse_response(serve_instance):
+    @serve.deployment
+    class SSE:
+        def __call__(self, request):
+            for i in range(3):
+                yield f"data: {json.dumps({'n': i})}\n\n"
+                time.sleep(0.05)
+
+    serve.start(proxy=True, http_options=serve.HTTPOptions(port=0))
+    from ray_tpu import serve as serve_mod
+    port = serve_mod._proxy.port
+    serve.run(SSE.bind(), name="sse_app", route_prefix="/sse")
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/sse", timeout=60) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        raw = resp.read().decode()
+    events = [json.loads(line[len("data: "):])
+              for line in raw.splitlines() if line.startswith("data: ")]
+    assert events == [{"n": 0}, {"n": 1}, {"n": 2}]
+
+
+def test_proxy_plain_json_still_works(serve_instance):
+    @serve.deployment
+    def echo(request):
+        return {"got": request.get("x")}
+
+    serve.start(proxy=True, http_options=serve.HTTPOptions(port=0))
+    from ray_tpu import serve as serve_mod
+    port = serve_mod._proxy.port
+    serve.run(echo.bind(), name="echo_app", route_prefix="/echo")
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/echo?x=1", timeout=60) as resp:
+        payload = json.loads(resp.read())
+    assert payload == {"got": "1"}
+
+
+def test_llm_sse_token_streaming(serve_instance):
+    """/v1/completions with stream=true emits per-token SSE chunks and a
+    [DONE] terminator (VERDICT round-1 item 4 done-criterion)."""
+    from ray_tpu.llm.engine import EngineConfig
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+
+    config = LLMConfig(
+        model_id="llama-stream-test",
+        engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=2, max_seq=64),
+        max_tokens=8)
+    serve.start(proxy=True, http_options=serve.HTTPOptions(port=0))
+    from ray_tpu import serve as serve_mod
+    port = serve_mod._proxy.port
+    serve.run(build_openai_app(config=config), name="llm_stream_app",
+              route_prefix="/v1")
+
+    body = json.dumps({"prompt": "hi", "max_tokens": 4,
+                       "stream": True}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        raw = resp.read().decode()
+    lines = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    chunks = [json.loads(ln[len("data: "):]) for ln in lines[:-1]]
+    # 4 token chunks + 1 finish chunk
+    assert len(chunks) == 5
+    assert all(c["object"] == "text_completion" for c in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+
+    # chat streaming too
+    body = json.dumps({"messages": [{"role": "user", "content": "hey"}],
+                       "max_tokens": 3, "stream": True}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        raw = resp.read().decode()
+    lines = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    chunks = [json.loads(ln[len("data: "):]) for ln in lines[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
