@@ -1,0 +1,18 @@
+"""Accelerator managers (reference: python/ray/_private/accelerators/).
+
+TPU is the first-class accelerator here; the manager handles chip
+detection, per-worker visibility partitioning, slice metadata, gang
+resources, and node labels.
+"""
+
+from ray_tpu.accelerators.tpu import (
+    TpuAcceleratorManager,
+    infer_tpu_pod_type_from_topology,
+    reserve_tpu_slice,
+)
+
+__all__ = [
+    "TpuAcceleratorManager",
+    "infer_tpu_pod_type_from_topology",
+    "reserve_tpu_slice",
+]

@@ -67,6 +67,9 @@ class Bundle:
     index: int
     resources: Dict[str, float]
     node_id: Optional[NodeID] = None
+    # per-bundle node-label requirements (reference: bundle_label_selector
+    # on placement groups, used by reserve_tpu_slice)
+    label_selector: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

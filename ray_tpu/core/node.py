@@ -43,7 +43,8 @@ class WorkerHandle:
                  profile: str = "cpu"):
         self.worker_id = worker_id
         self.proc = proc
-        self.profile = profile  # "cpu" | "tpu" — see _spawn_worker
+        self.profile = profile  # "cpu" | "tpu:<k>" — see _spawn_worker
+        self.chips: List[int] = []  # TPU chips this worker owns
         self.conn: Optional[MessageConnection] = None
         self.state = STARTING
         self.actor_id: Optional[ActorID] = None
@@ -85,14 +86,18 @@ class Node:
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         # Separate pools per worker profile: "cpu" workers start with the
         # accelerator runtime masked out (fast startup, no chip
-        # contention); "tpu" workers see the chips. This is the
-        # reference's per-worker accelerator-visibility plumbing
+        # contention); "tpu:<k>" workers own k specific chips from the
+        # node's chip pool, exported via TPU_VISIBLE_CHIPS + bounds env
+        # vars ("tpu:0" = fractional request, shares all chips). This is
+        # the reference's per-worker accelerator-visibility plumbing
         # (reference: _private/accelerators/tpu.py:283 TPU_VISIBLE_CHIPS)
         # applied at process-pool level.
-        self._idle: Dict[str, Deque[WorkerHandle]] = {
-            "cpu": deque(), "tpu": deque()}
-        self._dispatch_queue: Dict[str, Deque[TaskSpec]] = {
-            "cpu": deque(), "tpu": deque()}
+        from collections import defaultdict
+        self._idle: Dict[str, Deque[WorkerHandle]] = defaultdict(deque)
+        self._dispatch_queue: Dict[str, Deque[TaskSpec]] = defaultdict(deque)
+        self._free_chips: List[int] = list(
+            range(int(self.resources.get("TPU", 0))))
+        self._total_chips = len(self._free_chips)
         self._stopped = threading.Event()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
@@ -104,12 +109,24 @@ class Node:
         self.prestart_workers(get_config().min_idle_workers)
 
     # --- worker pool ---------------------------------------------------
-    def _spawn_worker(self, profile: str = "cpu") -> WorkerHandle:
+    def _allocate_chips(self, count: int) -> Optional[List[int]]:
+        """Take `count` chips from the pool (under self._lock); None if
+        the pool is short (the caller reclaims idle TPU workers)."""
+        if count <= 0:
+            return []
+        if len(self._free_chips) < count:
+            return None
+        taken, self._free_chips = (self._free_chips[:count],
+                                   self._free_chips[count:])
+        return taken
+
+    def _spawn_worker(self, profile: str = "cpu") -> Optional[WorkerHandle]:
         worker_id = WorkerID.from_random()
         pkg_parent = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        chips: List[int] = []
         if profile == "cpu":
             # Mask the accelerator: no TPU runtime import (which costs
             # seconds per process and can contend for chips), and any jax
@@ -117,6 +134,43 @@ class Node:
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)  # axon tunnel opt-out
             env["TPU_VISIBLE_CHIPS"] = ""
+        else:
+            # "tpu:<k>": the worker owns k chips, exported to the TPU
+            # runtime via TPU_VISIBLE_CHIPS + bounds vars (reference:
+            # tpu.py:283-323). k=0 (fractional TPU request) shares the
+            # full host.
+            need = int(profile.split(":", 1)[1]) if ":" in profile else 0
+            with self._lock:
+                allocated = self._allocate_chips(need)
+                victim = None
+                if allocated is None:
+                    # Reclaim chips hoarded by idle TPU workers (prefer
+                    # actual chip holders — killing a chipless tpu:0
+                    # worker frees nothing); retry happens when the
+                    # death returns chips to the pool.
+                    for p, idle in self._idle.items():
+                        if (p.startswith("tpu") and idle
+                                and idle[0].chips):
+                            victim = idle.popleft()
+                            break
+                    if victim is None:
+                        for p, idle in self._idle.items():
+                            if p.startswith("tpu") and idle:
+                                victim = idle.popleft()
+                                break
+            if allocated is None:
+                if victim is not None:
+                    self.kill_worker(victim.worker_id)
+                return None
+            chips = allocated
+            if chips:
+                from ray_tpu.accelerators.tpu import TpuAcceleratorManager
+                for key, value in TpuAcceleratorManager.visible_chip_env(
+                        chips, self._total_chips).items():
+                    if value is None:
+                        env.pop(key, None)
+                    else:
+                        env[key] = value
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker",
              "--socket", self.socket_path,
@@ -128,6 +182,7 @@ class Node:
             stderr=None if get_config().log_to_driver else subprocess.DEVNULL,
         )
         handle = WorkerHandle(worker_id, proc, profile)
+        handle.chips = chips
         with self._lock:
             self._workers[worker_id] = handle
         return handle
@@ -139,10 +194,16 @@ class Node:
 
     @staticmethod
     def _profile_for(spec: TaskSpec) -> str:
+        amount = 0.0
         for key, value in spec.resources.items():
             if value > 0 and (key == "TPU" or key.startswith("TPU_group")):
-                return "tpu"
-        return "cpu"
+                amount = max(amount, value)
+        if amount <= 0:
+            return "cpu"
+        if amount < 1:
+            return "tpu:0"  # fractional request: shares the full host
+        import math
+        return f"tpu:{int(math.ceil(amount))}"
 
     def _accept_loop(self) -> None:
         while not self._stopped.is_set():
@@ -260,8 +321,12 @@ class Node:
             self._spawn_worker(worker.profile)
 
     def _pump(self) -> None:
-        """Match queued specs with idle workers."""
-        for profile in ("cpu", "tpu"):
+        """Match queued specs with idle workers; spawn for starved TPU
+        queues (a finished worker may now be an idle chip holder the
+        spawn path can reclaim)."""
+        with self._lock:
+            profiles = list(self._dispatch_queue.keys())
+        for profile in profiles:
             while True:
                 with self._lock:
                     queue = self._dispatch_queue[profile]
@@ -271,6 +336,16 @@ class Node:
                     spec = queue.popleft()
                     worker = idle.popleft()
                     self._send_task(worker, spec)
+        for profile in profiles:
+            with self._lock:
+                starved = (profile.startswith("tpu")
+                           and self._dispatch_queue[profile]
+                           and not self._idle[profile]
+                           and not any(w.profile == profile
+                                       and w.state == STARTING
+                                       for w in self._workers.values()))
+            if starved:
+                self._spawn_worker(profile)
 
     def _on_task_done(self, worker: WorkerHandle, msg: dict) -> None:
         task_id = TaskID(msg["task_id"])
@@ -302,10 +377,23 @@ class Node:
             except ValueError:
                 pass
             self._workers.pop(worker.worker_id, None)
+            # Return this worker's chips; TPU specs may be queued
+            # waiting for exactly these.
+            if worker.chips:
+                self._free_chips.extend(worker.chips)
+                worker.chips = []
+            starved = [
+                p for p, q in self._dispatch_queue.items()
+                if q and p.startswith("tpu") and not self._idle[p]
+                and not any(w.profile == p and w.state == STARTING
+                            for w in self._workers.values())
+            ]
         for oid in held:  # release this worker's borrowed pins
             self.runtime.reference_counter.remove_local_reference(oid)
         if self._stopped.is_set():
             return
+        for profile in starved:
+            self._spawn_worker(profile)
         self.runtime.on_worker_crashed(self, worker, running,
                                        worker.actor_id if was_actor else None)
 

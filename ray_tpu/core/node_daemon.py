@@ -153,6 +153,9 @@ class NodeDaemon:
         if "CPU" not in resources:
             import multiprocessing
             resources["CPU"] = float(multiprocessing.cpu_count())
+        labels = dict(labels or {})
+        from ray_tpu.accelerators.tpu import TpuAcceleratorManager
+        TpuAcceleratorManager.augment_node(resources, labels)
         self.node = Node(self.proxy, self.node_id, resources, labels,
                          object_store_memory=object_store_memory,
                          session_dir=session_dir)

@@ -175,6 +175,12 @@ class DriverRuntime:
             resources = {}
         resources = dict(resources)
         resources.setdefault("CPU", float(multiprocessing.cpu_count()))
+        labels = dict(labels or {})
+        # TPU hosts self-describe: chip count, slice gang resources,
+        # topology labels (reference: accelerator manager hooks in node
+        # registration, _private/accelerators/tpu.py).
+        from ray_tpu.accelerators.tpu import TpuAcceleratorManager
+        TpuAcceleratorManager.augment_node(resources, labels)
         node_id = NodeID.from_random()
         node = Node(self, node_id, resources, labels,
                     object_store_memory=object_store_memory)

@@ -226,10 +226,21 @@ class ClusterScheduler:
         """Greedy bundle placement honoring PACK/SPREAD/STRICT_* semantics
         (reference: policy/bundle_scheduling_policy.h:29,73,89)."""
         avail = {nid: dict(v.available) for nid, v in self._nodes.items()}
+        node_labels = {nid: v.labels for nid, v in self._nodes.items()}
         nodes = list(avail.keys())
         result: List[NodeID] = []
 
-        def take(nid: NodeID, res: Dict[str, float]) -> bool:
+        def labels_ok(nid: NodeID, bundle) -> bool:
+            selector = getattr(bundle, "label_selector", None)
+            if not selector:
+                return True
+            labels = node_labels.get(nid, {})
+            return all(labels.get(k) == v for k, v in selector.items())
+
+        def take(nid: NodeID, bundle) -> bool:
+            if not labels_ok(nid, bundle):
+                return False
+            res = bundle.resources
             if not _fits(avail[nid], res):
                 return False
             for k, v in res.items():
@@ -241,7 +252,8 @@ class ClusterScheduler:
                 trial = {k: dict(v) for k, v in avail.items()}
                 ok = True
                 for b in pg.bundles:
-                    if not _fits(trial[nid], b.resources):
+                    if not labels_ok(nid, b) or not _fits(
+                            trial[nid], b.resources):
                         ok = False
                         break
                     for k, v in b.resources.items():
@@ -256,7 +268,7 @@ class ClusterScheduler:
                 for nid in nodes:
                     if nid in used:
                         continue
-                    if take(nid, b.resources):
+                    if take(nid, b):
                         result.append(nid)
                         used.add(nid)
                         placed = True
@@ -276,7 +288,7 @@ class ClusterScheduler:
             )
             placed = False
             for nid in order:
-                if take(nid, b.resources):
+                if take(nid, b):
                     result.append(nid)
                     placed = True
                     break

@@ -469,15 +469,27 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
 
         asyncio.run_coroutine_threadsafe(run(), ensure_actor_loop())
 
-    def is_async_method(spec: TaskSpec) -> bool:
+    def is_async_actor() -> bool:
+        """An actor with ANY coroutine/async-gen method runs ALL its
+        methods on the event loop (reference semantics: sync methods of
+        asyncio actors execute on the loop, serialized with the rest) —
+        per-method routing would let a sync and an async method of a
+        max_concurrency=1 actor run concurrently."""
+        cached = actor_state.get("is_async")
+        if cached is not None:
+            return cached
         import inspect
-        if rt.actor_instance is None or spec.method_name is None:
+        instance = rt.actor_instance
+        if instance is None:
             return False
-        if spec.method_name == "__ray_call__":
-            return False
-        method = getattr(rt.actor_instance, spec.method_name, None)
-        return (inspect.iscoroutinefunction(method)
-                or inspect.isasyncgenfunction(method))
+        result = any(
+            inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
+            for m in (getattr(instance, name, None)
+                      for name in dir(instance)
+                      if not name.startswith("__"))
+            if m is not None)
+        actor_state["is_async"] = result
+        return result
 
     while True:
         msg = conn.recv()
@@ -491,7 +503,7 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
                     exec_pool = ThreadPoolExecutor(max_workers=spec.max_concurrency)
             if spec.is_actor_creation:
                 actor_state["max_concurrency"] = max(1, spec.max_concurrency)
-            if kind == "EXECUTE_ACTOR_TASK" and is_async_method(spec):
+            if kind == "EXECUTE_ACTOR_TASK" and is_async_actor():
                 run_async_task(spec)
             else:
                 exec_pool.submit(run_task, spec)

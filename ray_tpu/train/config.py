@@ -21,8 +21,13 @@ class ScalingConfig:
     # jax_trainer.py + tpu.py:283 visible-chips plumbing)
     tpu_chips_per_worker: int = 1
     resources_per_worker: Dict[str, float] = field(default_factory=dict)
-    # topology label for slice gang scheduling, e.g. "v5p-32"
+    # chip topology for slice gang scheduling, e.g. "2x2x4" — with
+    # accelerator_type set, the trainer reserves a whole slice via its
+    # head resource and places one worker per slice host (reference:
+    # reserve_tpu_slice, _private/accelerators/tpu.py:145)
     topology: Optional[str] = None
+    # TPU generation, e.g. "TPU-V4" / "TPU-V5P"
+    accelerator_type: Optional[str] = None
     placement_strategy: str = "SPREAD"
 
     def worker_resources(self) -> Dict[str, float]:
@@ -67,3 +72,5 @@ class Result:
     path: str
     error: Optional[Exception] = None
     metrics_history: list = field(default_factory=list)
+    # per-rank report lists [(metrics, checkpoint_path), ...]
+    all_reports: list = field(default_factory=list)

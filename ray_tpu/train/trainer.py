@@ -42,11 +42,48 @@ class _TrainWorker:
         self.storage_path = storage_path
         self.group_name = group_name
         if jax_env:
-            # Multi-host bootstrap (reference: _setup_jax_tpu_environment)
+            # Multi-host bootstrap (reference: _setup_jax_tpu_environment).
+            # The coordinator must bind on RANK 0's host (on a pod that's
+            # a slice host the head can't predict), so rank 0 picks a
+            # local port and publishes it through the GCS KV; the rest
+            # of the gang polls for it.
+            if jax_env.get("coordinator_address") is None:
+                jax_env = dict(jax_env)
+                jax_env["coordinator_address"] = \
+                    self._rendezvous_coordinator(
+                        jax_env.get("process_id", 0))
             from ray_tpu.parallel.mesh import initialize_distributed
             initialize_distributed(**jax_env)
         from ray_tpu.parallel import collective
         collective.init_collective_group(world_size, rank, group_name)
+
+    def _rendezvous_coordinator(self, process_id: int) -> str:
+        import socket as _socket
+        import time as _time
+
+        from ray_tpu.core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime()
+        key = f"jaxcoord/{self.group_name}".encode()
+        if process_id == 0:
+            try:
+                host = _socket.gethostbyname(_socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+            probe = _socket.socket()
+            probe.bind((host, 0))
+            address = f"{host}:{probe.getsockname()[1]}"
+            probe.close()
+            rt.gcs_call("kv_put", key, address.encode(), "train")
+            return address
+        deadline = _time.monotonic() + 120.0
+        while _time.monotonic() < deadline:
+            value = rt.gcs_call("kv_get", key, "train")
+            if value:
+                return value.decode()
+            _time.sleep(0.05)
+        raise TimeoutError(
+            "rank 0 never published the jax.distributed coordinator "
+            f"address for group {self.group_name}")
 
     def run(self, loop_blob: bytes, loop_config: Optional[dict],
             resume_path: Optional[str], datasets_blob: Optional[bytes]):
@@ -113,7 +150,12 @@ class JaxTrainer:
 
         for attempt in range(max_failures + 1):
             self._transition("SCHEDULING" if attempt == 0 else "RESTARTING")
-            workers, pg = self._create_worker_group(storage)
+            try:
+                workers, pg, reservation = self._create_worker_group(storage)
+            except (ActorError, WorkerCrashedError, TaskError, RayTpuError,
+                    TimeoutError, RuntimeError) as e:
+                last_error = e
+                continue
             resume = manager.latest()
             try:
                 self._transition("RUNNING")
@@ -137,6 +179,8 @@ class JaxTrainer:
                         pass
                 if pg is not None:
                     remove_placement_group(pg)
+                if reservation is not None:
+                    reservation.release()
         self._transition("ERRORED")
         final = manager.latest()
         return Result(metrics={}, checkpoint=final, path=storage,
@@ -145,14 +189,30 @@ class JaxTrainer:
     def _create_worker_group(self, storage: str):
         scaling = self.scaling_config
         res = scaling.worker_resources()
-        # Gang reservation: one bundle per worker (reference:
-        # reserve_tpu_slice + STRICT_SPREAD onto slice hosts). PACK
-        # fallback keeps single-node dev boxes working.
+        # Multi-host slice gang: reserve a whole slice via its head
+        # resource, then pin every worker to that slice's hosts with the
+        # slice-name resource + STRICT_SPREAD (one worker per host) —
+        # the reference's JaxTrainer shape (reference: reserve_tpu_slice
+        # tpu.py:145 + TPUReservationCallback).
+        slice_name = None
+        slice_reservation = None
+        if (scaling.use_tpu and scaling.topology
+                and scaling.accelerator_type):
+            from ray_tpu.accelerators.tpu import reserve_tpu_slice
+            slice_reservation = reserve_tpu_slice(scaling.topology,
+                                                  scaling.accelerator_type)
+            if slice_reservation is not None:
+                slice_name = slice_reservation.name
+                res[slice_name] = 1.0
+        # Gang reservation: one bundle per worker. PACK fallback keeps
+        # single-node dev boxes working.
         pg = None
+        strategy = (("STRICT_SPREAD" if slice_name
+                     else scaling.placement_strategy)
+                    if scaling.num_workers > 1 else "PACK")
         try:
             pg = placement_group([dict(res)] * scaling.num_workers,
-                                 strategy=scaling.placement_strategy
-                                 if scaling.num_workers > 1 else "PACK")
+                                 strategy=strategy)
         except Exception:
             pg = None
         group_name = f"train/{os.path.basename(storage)}/{time.time_ns()}"
@@ -162,8 +222,24 @@ class JaxTrainer:
             opts = {"num_cpus": res.get("CPU", 1)}
             if "TPU" in res:
                 opts["num_tpus"] = res["TPU"]
+            if slice_name is not None:
+                opts["resources"] = {slice_name: 1.0}
+            if pg is not None:
+                # Place each worker INSIDE its reserved bundle rather
+                # than double-booking from the free pool (reference:
+                # PlacementGroupSchedulingStrategy per worker rank).
+                from ray_tpu.util.placement_group import (
+                    PlacementGroupSchedulingStrategy)
+                opts["scheduling_strategy"] = \
+                    PlacementGroupSchedulingStrategy(
+                        placement_group=pg,
+                        placement_group_bundle_index=rank)
             env = None
             if scaling.num_workers > 1 and scaling.use_tpu:
+                # coordinator_address resolves inside the gang: rank 0
+                # binds locally and publishes via the GCS KV (see
+                # _TrainWorker) — the head can't pick it, because on a
+                # real pod rank 0 lives on a slice host, not here.
                 env = {"num_processes": scaling.num_workers,
                        "process_id": rank}
             workers.append(
@@ -171,8 +247,13 @@ class JaxTrainer:
                     rank, scaling.num_workers, storage, group_name,
                     jax_env=env))
         # Fail fast if any worker can't construct.
-        ray_tpu.get([w.ping.remote() for w in workers])
-        return workers, pg
+        try:
+            ray_tpu.get([w.ping.remote() for w in workers])
+        except BaseException:
+            if slice_reservation is not None:
+                slice_reservation.release()
+            raise
+        return workers, pg, slice_reservation
 
     def _build_result(self, all_reports, manager: CheckpointManager,
                       storage: str) -> Result:
@@ -185,4 +266,5 @@ class JaxTrainer:
                 checkpoint = manager.register(ckpt_path, metrics)
         final_metrics = history[-1] if history else {}
         return Result(metrics=final_metrics, checkpoint=checkpoint,
-                      path=storage, metrics_history=history)
+                      path=storage, metrics_history=history,
+                      all_reports=list(all_reports))

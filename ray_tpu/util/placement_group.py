@@ -50,7 +50,9 @@ class PlacementGroup:
 
 def placement_group(bundles: List[Dict[str, float]],
                     strategy: str = "PACK",
-                    name: str = "") -> PlacementGroup:
+                    name: str = "",
+                    bundle_label_selector: Optional[List[Dict[str, str]]]
+                    = None) -> PlacementGroup:
     """Create and synchronously reserve a placement group.
 
     Raises PlacementGroupUnschedulableError if no feasible assignment
@@ -62,10 +64,16 @@ def placement_group(bundles: List[Dict[str, float]],
         raise ValueError(f"unknown placement strategy: {strategy}")
     rt = runtime_mod.get_runtime()
     pg_id = PlacementGroupID.from_random()
+    selectors = bundle_label_selector or [{}] * len(bundles)
+    if len(selectors) != len(bundles):
+        raise ValueError(
+            f"bundle_label_selector length ({len(selectors)}) must match "
+            f"bundles length ({len(bundles)})")
     record = PlacementGroupRecord(
         pg_id=pg_id, name=name, strategy=strategy,
-        bundles=[Bundle(index=i, resources=dict(b))
-                 for i, b in enumerate(bundles)])
+        bundles=[Bundle(index=i, resources=dict(b),
+                        label_selector=dict(sel))
+                 for i, (b, sel) in enumerate(zip(bundles, selectors))])
     rt.gcs.register_placement_group(record)
     rt.scheduler.reserve_placement_group(record)
     return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)

