@@ -121,6 +121,10 @@ class TpuAcceleratorManager:
         elif n == 2:
             env[TPU_CHIPS_PER_HOST_BOUNDS_ENV] = _2_CHIP_CONFIG
             env[TPU_HOST_BOUNDS_ENV] = _SINGLE_HOST_BOUNDS
+        # n == 4 on an 8-chip host: visible chips only, no bounds — the
+        # reference has no bounds config beyond 1/2 chips either
+        # (tpu.py:283-323); request validation limits counts to
+        # {1, 2, 4, 8} (remote_function.validate_tpu_quantity).
         return env
 
     # --- slice metadata (GKE env first, then GCE metadata) ---------------

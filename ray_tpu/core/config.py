@@ -86,6 +86,14 @@ class Config:
     # (reference: pull_manager.h:50 admission control).
     object_pull_concurrency: int = 8
 
+    # --- lineage / spilling ---
+    # Completed stateless task specs retained for object reconstruction
+    # (reference: max_lineage_bytes, task_manager.h:184). 0 disables.
+    lineage_max_entries: int = 10_000
+    # Spill referenced objects to disk when the shm arena is full
+    # (reference: local_object_manager.h:43 + external_storage.py).
+    object_spill_enabled: bool = True
+
     # --- logging / events ---
     task_events_enabled: bool = True
     task_events_buffer_size: int = 100_000

@@ -260,6 +260,8 @@ class Node:
                 self.runtime.handle_get_object(self, handle, msg)
             elif kind == "CHECK_READY":
                 self.runtime.handle_check_ready(handle, msg)
+            elif kind == "SPILL_REQUEST":
+                self.runtime.handle_spill_request(self, handle, msg)
             elif kind == "GCS_REQUEST":
                 self.runtime.handle_gcs_request(handle, msg)
             elif kind == "KILL_ACTOR":

@@ -104,6 +104,12 @@ class HeadProxy:
                    "object_ids": msg["object_ids"],
                    "req_id": msg.get("req_id")})
 
+    def handle_spill_request(self, node, handle, msg: dict) -> None:
+        self.send({"kind": "SPILL_REQUEST",
+                   "worker_id": handle.worker_id.binary(),
+                   "bytes": msg.get("bytes", 0),
+                   "req_id": msg.get("req_id")})
+
     def handle_gcs_request(self, handle, msg: dict) -> None:
         self.send({"kind": "GCS_REQUEST",
                    "worker_id": handle.worker_id.binary(),
@@ -178,7 +184,12 @@ class NodeDaemon:
         self._heartbeat_thread.start()
 
     def _resolve_store(self, oid: ObjectID):
-        return self.node.store if self.node.store.contains(oid) else None
+        if self.node.store.contains(oid):
+            return self.node.store
+        path = os.path.join(self._spill_dir(), oid.hex())
+        if os.path.exists(path):
+            return ("file", path)  # spilled: serve straight off disk
+        return None
 
     def _heartbeat_loop(self) -> None:
         cfg = get_config()
@@ -222,7 +233,16 @@ class NodeDaemon:
             self.node.prestart_workers(msg.get("count", 1),
                                        msg.get("profile", "cpu"))
         elif kind == "DELETE_OBJECT":
-            self.node.store.delete(ObjectID(msg["object_id"]))
+            oid = ObjectID(msg["object_id"])
+            self.node.store.delete(oid)
+            spill_path = os.path.join(self._spill_dir(), oid.hex())
+            if os.path.exists(spill_path):
+                try:
+                    os.unlink(spill_path)
+                except OSError:
+                    pass
+        elif kind == "SPILL_OBJECTS":
+            self._spill_objects(msg)
         elif kind == "CANCEL_TASK":
             self._cancel_task(TaskID(msg["task_id"]))
         elif kind == "STOP":
@@ -258,6 +278,27 @@ class NodeDaemon:
             worker = self.node._workers.get(worker_id)
         if worker is not None:
             worker.send(payload)
+
+    def _spill_dir(self) -> str:
+        path = os.path.join(self.node.session_dir, "spill")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def _spill_objects(self, msg: dict) -> None:
+        """Spill candidates from the local arena until `bytes` are freed
+        (reference: LocalObjectManager::SpillObjects). Reports results
+        so the head records locations and unblocks the worker."""
+        from ray_tpu.core.object_store import spill_objects
+        needed = int(msg.get("bytes", 0)) or 1
+        results = spill_objects(
+            self.node.store, self._spill_dir(),
+            [ObjectID(b) for b in msg.get("object_ids", ())], needed)
+        self.proxy.send({"kind": "SPILLED",
+                         "results": [(oid.binary(), path, size)
+                                     for oid, path, size in results],
+                         "freed": sum(size for _, _, size in results),
+                         "reply_worker": msg.get("reply_worker"),
+                         "req_id": msg.get("req_id")})
 
     def _cancel_task(self, task_id: TaskID) -> None:
         with self.node._lock:

@@ -98,12 +98,20 @@ class SharedMemoryStore:
         raise RuntimeError(f"shm_get failed: {rc}")
 
     def release(self, object_id: ObjectID) -> None:
+        # May fire from GC (value-lifetime pins) after close(): no-op
+        # rather than a native call on an unmapped arena.
+        if self._base is None:
+            return
         self._lib.shm_release(self._base, object_id.binary())
 
     def contains(self, object_id: ObjectID) -> bool:
+        if self._base is None:
+            return False
         return bool(self._lib.shm_contains(self._base, object_id.binary()))
 
     def delete(self, object_id: ObjectID) -> None:
+        if self._base is None:
+            return
         self._lib.shm_delete(self._base, object_id.binary())
 
     def used_bytes(self) -> int:
@@ -190,6 +198,37 @@ class SharedMemoryStore:
                 self._shm.unlink()
             except FileNotFoundError:
                 pass
+
+
+def spill_objects(store: SharedMemoryStore, spill_dir: str, object_ids,
+                  needed: int):
+    """Spill sealed objects from `store` to files until `needed` bytes
+    are freed (reference: LocalObjectManager::SpillObjects,
+    local_object_manager.h:43). Returns [(ObjectID, path, size)].
+    Shared by the head (in-process nodes) and node daemons."""
+    import os
+
+    os.makedirs(spill_dir, exist_ok=True)
+    results = []
+    freed = 0
+    for oid in object_ids:
+        if freed >= needed:
+            break
+        buf = store.get_buffer(oid, timeout_s=0)
+        if buf is None:
+            continue
+        path = os.path.join(spill_dir, oid.hex())
+        try:
+            size = len(buf)
+            with open(path, "wb") as f:
+                f.write(buf)
+        finally:
+            del buf
+            store.release(oid)
+        store.delete(oid)
+        results.append((oid, path, size))
+        freed += size
+    return results
 
 
 class MemoryStore:

@@ -73,9 +73,18 @@ class ObjectServer:
                 if msg is None or msg.get("kind") != "PULL":
                     return
                 oid = ObjectID(msg["object_id"])
-                store = self._resolve(oid)
-                buf = (store.get_buffer(oid, timeout_s=2.0)
-                       if store is not None else None)
+                source = self._resolve(oid)
+                if source is None:
+                    send_msg(sock, {"kind": "PULL_ERR",
+                                    "error": "object not found"})
+                    continue
+                if isinstance(source, tuple) and source[0] == "file":
+                    # spilled payload: stream straight off disk
+                    # (reference: serving spilled objects back out of
+                    # external storage)
+                    self._serve_file(sock, source[1], chunk_size)
+                    continue
+                buf = source.get_buffer(oid, timeout_s=2.0)
                 if buf is None:
                     send_msg(sock, {"kind": "PULL_ERR",
                                     "error": "object not found"})
@@ -92,7 +101,7 @@ class ObjectServer:
                             sock.sendall(part)
                     finally:
                         del buf
-                        store.release(oid)
+                        source.release(oid)
         except OSError:
             return
         finally:
@@ -100,6 +109,24 @@ class ObjectServer:
                 sock.close()
             except OSError:
                 pass
+
+    def _serve_file(self, sock: socket.socket, path: str,
+                    chunk_size: int) -> None:
+        import os
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            send_msg(sock, {"kind": "PULL_ERR", "error": "spill file gone"})
+            return
+        with self._sem:
+            send_msg(sock, {"kind": "PULL_META", "size": size})
+            with open(path, "rb") as f:
+                while True:
+                    part = f.read(chunk_size)
+                    if not part:
+                        break
+                    sock.sendall(_LEN.pack(len(part)))
+                    sock.sendall(part)
 
     def stop(self) -> None:
         self._stopped.set()

@@ -28,11 +28,28 @@ def resources_from_options(options: Dict[str, Any],
         resources.pop("CPU")
     num_tpus = options.get("num_tpus")
     if num_tpus:
+        validate_tpu_quantity(float(num_tpus))
         resources["TPU"] = float(num_tpus)
+    elif resources.get("TPU"):
+        validate_tpu_quantity(float(resources["TPU"]))
     memory = options.get("memory")
     if memory:
         resources["memory"] = float(memory)
     return resources
+
+
+def validate_tpu_quantity(quantity: float) -> None:
+    """Whole-chip TPU requests must be a supported partition size: the
+    visibility env plumbing only has bounds configs for 1, 2, 4, and 8
+    chips (reference: TPU_VALID_CHIP_OPTIONS + validate_resource_
+    request_quantity, _private/accelerators/tpu.py:270). Fractional
+    requests (<1) share a host and are always allowed."""
+    if quantity < 1:
+        return
+    if quantity not in (1.0, 2.0, 4.0, 8.0):
+        raise ValueError(
+            f"requested TPU={quantity} is not a supported chip "
+            "configuration; supported: fractional (<1), 1, 2, 4, 8")
 
 
 def strategy_from_options(options: Dict[str, Any]) -> SchedulingStrategy:

@@ -261,6 +261,11 @@ class HeadServer:
         elif kind == "CHECK_READY":
             worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
             rt.handle_check_ready(worker, msg)
+        elif kind == "SPILL_REQUEST":
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.handle_spill_request(node, worker, msg)
+        elif kind == "SPILLED":
+            rt.on_objects_spilled(node, msg)
         elif kind == "GCS_REQUEST":
             worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
             rt.handle_gcs_request(worker, msg)

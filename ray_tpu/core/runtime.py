@@ -40,6 +40,8 @@ from ray_tpu.exceptions import (
 _runtime_lock = threading.Lock()
 _runtime = None
 
+_SPILL_MISS = object()  # sentinel: spilled payload not readable here
+
 
 def get_runtime():
     if _runtime is None:
@@ -110,6 +112,17 @@ class DriverRuntime:
         # streaming-task yields (reference: _raylet.pyx:299)
         self._streams: Dict[TaskID, StreamState] = {}
         self._streams_lock = threading.Lock()
+        # Lineage: specs of completed stateless tasks, kept (bounded
+        # LRU) so lost objects can be reconstructed by re-execution
+        # (reference: task_manager.h:175 lineage + max_lineage_bytes;
+        # object_recovery_manager.h:41). Actor/streaming tasks are
+        # excluded — reconstruction is wrong for stateful work
+        # (SURVEY §7).
+        from collections import OrderedDict
+        self._lineage: "OrderedDict[TaskID, TaskSpec]" = OrderedDict()
+        self._lineage_by_object: Dict[ObjectID, TaskID] = {}
+        self._lineage_lock = threading.Lock()
+        self._reconstructing: set = set()
         # single expiry thread for deferred ref drops (no Timer churn)
         self._expiry_items: List[tuple] = []
         self._expiry_cv = threading.Condition()
@@ -268,9 +281,7 @@ class DriverRuntime:
                 err = ActorUnavailableError(spec.actor_id, str(err))
             self._record_event(spec, "FAILED", node_id=node_id,
                                error=str(err))
-            self.task_manager.fail(spec.task_id, err)
-            if spec.num_returns == -1:
-                self._finish_stream(spec.task_id, err)
+            self._fail_task(spec, err)
         for aid in actor_ids:
             self._handle_actor_death(aid, node)
         self._signal_scheduler()
@@ -292,13 +303,18 @@ class DriverRuntime:
         return [nid for nid in holders if nid in self.nodes]
 
     def _resolve_local_store(self, oid: ObjectID):
-        """ObjectServer callback: find an in-process store holding oid
-        (the head serves all its simulated nodes from one server)."""
+        """ObjectServer callback: find an in-process store (or local
+        spill file) holding oid — the head serves all its simulated
+        nodes from one server."""
         for nid in self.object_holders(oid):
             node = self.nodes.get(nid)
             if (node is not None and not getattr(node, "is_remote", False)
                     and node.store.contains(oid)):
                 return node.store
+        loc = self.task_manager.get_location(oid)
+        if (loc is not None and loc.kind == "spilled" and loc.path
+                and os.path.exists(loc.path)):
+            return ("file", loc.path)
         return None
 
     def remove_node(self, node_id: NodeID) -> None:
@@ -476,6 +492,105 @@ class DriverRuntime:
             with self._streams_lock:
                 self._streams.pop(task_id, None)
 
+    # --- lineage reconstruction -----------------------------------------
+    def _record_lineage(self, spec: TaskSpec) -> None:
+        if (spec.actor_id is not None or spec.is_actor_creation
+                or spec.num_returns == -1):
+            return
+        cfg = get_config()
+        if cfg.lineage_max_entries <= 0:
+            return
+        with self._lineage_lock:
+            self._lineage[spec.task_id] = spec
+            self._lineage.move_to_end(spec.task_id)
+            for oid in spec.return_ids():
+                self._lineage_by_object[oid] = spec.task_id
+            while len(self._lineage) > cfg.lineage_max_entries:
+                old_id, old_spec = self._lineage.popitem(last=False)
+                for oid in old_spec.return_ids():
+                    if self._lineage_by_object.get(oid) == old_id:
+                        del self._lineage_by_object[oid]
+
+    def _lineage_knows(self, oid: ObjectID) -> bool:
+        with self._lineage_lock:
+            task_id = self._lineage_by_object.get(oid)
+            return task_id is not None and task_id in self._lineage
+
+    def _reconstruct_after_infra_failure(self, oid: ObjectID,
+                                         err: Exception) -> bool:
+        """An object failed due to infrastructure loss (worker/node
+        death, not user code): if lineage knows the producer, clear the
+        error and re-execute — a reconstruction racing a dying node must
+        not poison the object permanently."""
+        if not isinstance(err, (WorkerCrashedError, ObjectLostError)):
+            return False
+        if not self._lineage_knows(oid):
+            return False
+        self.task_manager.mark_object_unready(oid)
+        return self.try_reconstruct(oid)
+
+    def _object_available(self, oid: ObjectID) -> bool:
+        if self.memory_store.contains(oid):
+            return True
+        if self.object_holders(oid):
+            return True
+        loc = self.task_manager.get_location(oid)
+        return loc is not None and loc.kind == "spilled"
+
+    def try_reconstruct(self, oid: ObjectID) -> bool:
+        """Re-execute the lost object's producing task (and transitively
+        any lost dependencies). Returns True if reconstruction is in
+        flight — the caller should wait on readiness again (reference:
+        ObjectRecoveryManager::RecoverObject)."""
+        with self._lineage_lock:
+            if oid in self._reconstructing:
+                return True
+            task_id = self._lineage_by_object.get(oid)
+            root = self._lineage.get(task_id) if task_id else None
+            if root is None:
+                return False
+            # Claim under the same lock as the membership check so a
+            # concurrent getter can't resubmit the same producer twice.
+            self._reconstructing.add(oid)
+        # Collect the transitive set of lost producers.
+        to_resubmit: List[TaskSpec] = []
+        stack = [root]
+        seen = {root.task_id}
+        while stack:
+            spec = stack.pop()
+            to_resubmit.append(spec)
+            for dep in spec.dependencies():
+                if self._object_available(dep):
+                    continue
+                with self._lineage_lock:
+                    dep_task = self._lineage_by_object.get(dep)
+                    dep_spec = (self._lineage.get(dep_task)
+                                if dep_task else None)
+                if dep_spec is None:
+                    self._reconstruction_done(oid)  # drop the claim
+                    return False  # an input is unreconstructible
+                if dep_spec.task_id not in seen:
+                    seen.add(dep_spec.task_id)
+                    stack.append(dep_spec)
+        # Mark every output unready first so dep-waiting across the
+        # resubmitted set blocks correctly, then resubmit.
+        with self._lineage_lock:
+            for spec in to_resubmit:
+                for out in spec.return_ids():
+                    self._reconstructing.add(out)
+        for spec in to_resubmit:
+            for out in spec.return_ids():
+                self.task_manager.mark_object_unready(out)
+        for spec in to_resubmit:
+            self.task_manager.add_pending(spec)
+            self._record_event(spec, "RECONSTRUCTING")
+            self._resubmit(spec)
+        return True
+
+    def _reconstruction_done(self, oid: ObjectID) -> None:
+        with self._lineage_lock:
+            self._reconstructing.discard(oid)
+
     # --- submission ----------------------------------------------------
     def submit_spec(self, spec: TaskSpec) -> None:
         if spec.is_actor_creation and spec.actor_id not in self.actors:
@@ -589,6 +704,10 @@ class DriverRuntime:
 
     def _fail_task(self, spec: TaskSpec, err: Exception) -> None:
         self.task_manager.fail(spec.task_id, err)
+        for oid in spec.return_ids():
+            # a failed reconstruction must drop its claims or later
+            # try_reconstruct calls would no-op forever
+            self._reconstruction_done(oid)
         if spec.num_returns == -1:
             self._finish_stream(spec.task_id, err)
 
@@ -669,9 +788,7 @@ class DriverRuntime:
                 self._fail_actor_buffer(spec.actor_id, err)
             self._record_event(spec, "FAILED", node_id=node.node_id,
                               error=msg.get("error_str"))
-            self.task_manager.fail(spec.task_id, err)
-            if spec.num_returns == -1:
-                self._finish_stream(spec.task_id, err)
+            self._fail_task(spec, err)
             self._release_task_resources(spec, node.node_id)
             self._signal_scheduler()
             return
@@ -679,6 +796,7 @@ class DriverRuntime:
             oid_bytes, kind, data = result[:3]
             contained = result[3] if len(result) > 3 else ()
             oid = ObjectID(oid_bytes)
+            self._reconstruction_done(oid)
             self._pin_contained(oid, contained)
             if kind == "inline":
                 self.memory_store.put(oid, ("packed", bytes(data)))
@@ -718,6 +836,7 @@ class DriverRuntime:
             self.task_manager.complete(spec.task_id)
             if spec.num_returns == -1:
                 self._finish_stream(spec.task_id, None)
+            self._record_lineage(spec)
             self._release_task_resources(spec, node.node_id)
         self._record_event(spec, "FINISHED", node_id=node.node_id)
         self._signal_scheduler()
@@ -778,9 +897,7 @@ class DriverRuntime:
                     err = ActorUnavailableError(spec.actor_id, str(err))
                 self._record_event(spec, "FAILED", node_id=node.node_id,
                                   error=str(err))
-                self.task_manager.fail(spec.task_id, err)
-                if spec.num_returns == -1:
-                    self._finish_stream(spec.task_id, err)
+                self._fail_task(spec, err)
         if actor_id is not None or any(s.is_actor_creation for s in running):
             aid = actor_id or next(
                 s.actor_id for s in running if s.is_actor_creation)
@@ -868,8 +985,15 @@ class DriverRuntime:
             self.task_manager.set_location(oid, ObjectLocation("memory"))
         else:
             head = self.nodes[self.head_node_id]
-            head.store.put_parts(oid, data, buffers,
-                                 [b.nbytes for b in buffers])
+            sizes = [b.nbytes for b in buffers]
+            from ray_tpu.exceptions import ObjectStoreFullError
+            try:
+                head.store.put_parts(oid, data, buffers, sizes)
+            except ObjectStoreFullError:
+                # spill referenced objects to disk, then retry
+                self.spill_on_node(
+                    head, serialization.packed_size(data, sizes))
+                head.store.put_parts(oid, data, buffers, sizes)
             self.task_manager.set_location(
                 oid, ObjectLocation("shm", self.head_node_id))
         self.task_manager.mark_object_ready(oid)
@@ -888,39 +1012,77 @@ class DriverRuntime:
         return out[0] if single else out
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float]):
-        if not self.task_manager.wait_ready(oid, timeout):
-            raise GetTimeoutError(f"get() timed out waiting for {oid}")
-        err = self.task_manager.get_error(oid)
-        if err is not None:
-            raise err
-        found, stored = self.memory_store.get(oid, timeout_s=0)
-        if found:
-            kind, payload = stored
-            return serialization.unpack(payload) if kind == "packed" else payload
-        holders = self.object_holders(oid)
-        # Prefer a copy in an in-process store (zero-copy read).
-        for nid in holders:
-            node = self.nodes.get(nid)
-            if node is None or getattr(node, "is_remote", False):
-                continue
-            found, value = node.store.get_value(oid, timeout_s=5.0)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for attempt in range(3):
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            if not self.task_manager.wait_ready(oid, remaining):
+                raise GetTimeoutError(f"get() timed out waiting for {oid}")
+            err = self.task_manager.get_error(oid)
+            if err is not None:
+                if (attempt < 2
+                        and self._reconstruct_after_infra_failure(oid, err)):
+                    continue
+                raise err
+            found, stored = self.memory_store.get(oid, timeout_s=0)
             if found:
-                return value
-        # Remote holders only: pull chunked into the head store
-        # (reference: PullManager-driven transfer, pull_manager.h:50).
-        head = self.nodes.get(self.head_node_id)
-        if head is not None:
-            from ray_tpu.core.object_transfer import pull_object
+                kind, payload = stored
+                return (serialization.unpack(payload)
+                        if kind == "packed" else payload)
+            loc = self.task_manager.get_location(oid)
+            if loc is not None and loc.kind == "spilled":
+                value = self._read_spilled(oid, loc)
+                if value is not _SPILL_MISS:
+                    return value
+            holders = self.object_holders(oid)
+            # Prefer a copy in an in-process store (zero-copy read).
             for nid in holders:
                 node = self.nodes.get(nid)
-                if node is None or not getattr(node, "is_remote", False):
+                if node is None or getattr(node, "is_remote", False):
                     continue
-                if pull_object(node.object_addr, oid, head.store):
-                    self.add_object_replica(oid, self.head_node_id)
-                    found, value = head.store.get_value(oid, timeout_s=5.0)
-                    if found:
-                        return value
+                found, value = node.store.get_value(oid, timeout_s=5.0)
+                if found:
+                    return value
+            # Remote holders only: pull chunked into the head store
+            # (reference: PullManager-driven transfer, pull_manager.h:50).
+            head = self.nodes.get(self.head_node_id)
+            if head is not None:
+                from ray_tpu.core.object_transfer import pull_object
+                for nid in holders:
+                    node = self.nodes.get(nid)
+                    if node is None or not getattr(node, "is_remote", False):
+                        continue
+                    if pull_object(node.object_addr, oid, head.store):
+                        self.add_object_replica(oid, self.head_node_id)
+                        found, value = head.store.get_value(oid,
+                                                            timeout_s=5.0)
+                        if found:
+                            return value
+            # Every copy is gone: lineage reconstruction re-executes the
+            # producer, then we wait for readiness again.
+            if not self.try_reconstruct(oid):
+                break
         raise ObjectLostError(oid)
+
+    def _read_spilled(self, oid: ObjectID, loc: ObjectLocation):
+        """Read a spilled payload. Local file: unpack directly. File on
+        a remote host: pull it chunked off the daemon's object server
+        (which serves spill files) into the head arena."""
+        import os as _os
+        if loc.path and _os.path.exists(loc.path):
+            with open(loc.path, "rb") as f:
+                return serialization.unpack(f.read())
+        node = self.nodes.get(loc.node_id)
+        head = self.nodes.get(self.head_node_id)
+        if (node is not None and getattr(node, "is_remote", False)
+                and head is not None):
+            from ray_tpu.core.object_transfer import pull_object
+            if pull_object(node.object_addr, oid, head.store):
+                self.add_object_replica(oid, self.head_node_id)
+                found, value = head.store.get_value(oid, timeout_s=5.0)
+                if found:
+                    return value
+        return _SPILL_MISS
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):
@@ -971,7 +1133,7 @@ class DriverRuntime:
         self.memory_store.delete(oid)
         loc = self.task_manager.get_location(oid)
         targets = set()
-        if loc is not None and loc.kind == "shm" and loc.node_id is not None:
+        if loc is not None and loc.node_id is not None:
             targets.add(loc.node_id)
         with self._replica_lock:
             targets.update(self._object_replicas.pop(oid, ()))
@@ -979,6 +1141,11 @@ class DriverRuntime:
             node = self.nodes.get(nid)
             if node is not None:
                 node.store.delete(oid)
+        if loc is not None and loc.kind == "spilled" and loc.path:
+            try:
+                os.unlink(loc.path)
+            except OSError:
+                pass  # remote file: the daemon's DELETE_OBJECT removes it
         self.task_manager.forget_object(oid)
         with self._contained_lock:
             nested = self._contained_refs.pop(oid, None)
@@ -1053,6 +1220,71 @@ class DriverRuntime:
         self.reference_counter.remove_local_reference(
             oid, defer=(self._ref_grace_s, self._schedule_expiry))
 
+    # --- object spilling --------------------------------------------------
+    # reference: raylet LocalObjectManager spilling under memory pressure
+    # (local_object_manager.h:43) + external_storage.py file layout.
+    @staticmethod
+    def _spill_dir_for(node) -> str:
+        base = node.session_dir
+        if not base:
+            import tempfile
+            base = tempfile.gettempdir()
+        path = os.path.join(base, "spill")
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def handle_spill_request(self, node, worker, msg: dict) -> None:
+        """A worker's create() hit a full arena: free space by spilling
+        referenced sealed objects to disk, then let it retry."""
+        needed = int(msg.get("bytes", 0)) or 1
+        if getattr(node, "is_remote", False):
+            candidates = [
+                oid.binary()
+                for oid in self.task_manager.objects_on_node(node.node_id)
+                if (loc := self.task_manager.get_location(oid)) is not None
+                and loc.kind == "shm" and self.task_manager.is_ready(oid)
+            ]
+            node.send({"kind": "SPILL_OBJECTS", "object_ids": candidates,
+                       "bytes": needed,
+                       "reply_worker": worker.worker_id.binary(),
+                       "req_id": msg.get("req_id")})
+            return
+        freed = self.spill_on_node(node, needed)
+        worker.send({"kind": "SPILL_REPLY", "req_id": msg.get("req_id"),
+                     "freed": freed})
+
+    def spill_on_node(self, node, needed: int) -> int:
+        """Spill ready shm objects from an in-process node's arena to
+        disk until `needed` bytes are freed. Returns bytes freed."""
+        if not get_config().object_spill_enabled:
+            return 0
+        from ray_tpu.core.object_store import spill_objects
+        candidates = [
+            oid for oid in self.task_manager.objects_on_node(node.node_id)
+            if (loc := self.task_manager.get_location(oid)) is not None
+            and loc.kind == "shm" and self.task_manager.is_ready(oid)
+        ]
+        results = spill_objects(node.store, self._spill_dir_for(node),
+                                candidates, needed)
+        for oid, path, _size in results:
+            self.task_manager.set_location(
+                oid, ObjectLocation("spilled", node.node_id, path))
+        return sum(size for _, _, size in results)
+
+    def on_objects_spilled(self, node, msg: dict) -> None:
+        """A daemon spilled objects on our request: record locations and
+        unblock the waiting worker."""
+        for oid_bytes, path, _size in msg.get("results", ()):
+            self.task_manager.set_location(
+                ObjectID(oid_bytes),
+                ObjectLocation("spilled", node.node_id, path))
+        reply_worker = msg.get("reply_worker")
+        if reply_worker is not None:
+            from ray_tpu.core.remote_node import RemoteWorkerStub
+            RemoteWorkerStub(node, WorkerID(reply_worker)).send(
+                {"kind": "SPILL_REPLY", "req_id": msg.get("req_id"),
+                 "freed": msg.get("freed", 0)})
+
     # --- worker message handlers ----------------------------------------
     def on_worker_put(self, node: Node, msg: dict) -> None:
         oid = ObjectID(msg["object_id"])
@@ -1063,11 +1295,17 @@ class DriverRuntime:
     def handle_get_object(self, node: Node, worker, msg: dict) -> None:
         oid = ObjectID(msg["object_id"])
         req_id = msg.get("req_id")
+        attempts = [0]
 
         def reply():
             out = {"kind": "OBJECT_VALUE", "req_id": req_id}
             err = self.task_manager.get_error(oid)
             if err is not None:
+                if (attempts[0] < 2
+                        and self._reconstruct_after_infra_failure(oid, err)):
+                    attempts[0] += 1
+                    self.task_manager.on_ready(oid, reply)
+                    return
                 out.update(status="error", error=serialization.dumps(err))
                 worker.send(out)
                 return
@@ -1078,8 +1316,52 @@ class DriverRuntime:
                 worker.send(out)
                 return
             loc = self.task_manager.get_location(oid)
+            if loc is not None and loc.kind == "spilled":
+                holder = self.nodes.get(loc.node_id)
+                holder_remote = getattr(holder, "is_remote", False)
+                requester_remote = getattr(node, "is_remote", False)
+                # File readable on the requester's host: its own spill,
+                # or (for in-process requesters, which share the head's
+                # host) any file spilled by an in-process node.
+                if loc.path and (loc.node_id == node.node_id
+                                 or (not requester_remote
+                                     and not holder_remote)):
+                    out.update(status="spilled_local", path=loc.path)
+                    worker.send(out)
+                    return
+                if requester_remote:
+                    # the holder's object server streams spill files
+                    addr = (holder.object_addr if holder_remote
+                            else (self.object_server.address
+                                  if self.object_server else None))
+                    if addr is not None:
+                        out.update(status="pull", addr=list(addr),
+                                   object_id=oid.binary())
+                    else:
+                        out.update(status="error",
+                                   error=serialization.dumps(
+                                       ObjectLostError(oid)))
+                    worker.send(out)
+                    return
+                # in-process requester, file on a remote host: pull it
+                # into the requester's arena off the reader thread
+                threading.Thread(
+                    target=self._replicate_and_reply,
+                    args=(oid, node, worker, out), daemon=True).start()
+                return
             if loc is not None and loc.kind == "shm":
                 holders = self.object_holders(oid)
+                if not holders:
+                    # every copy died with its node: reconstruct via
+                    # lineage, then re-arm this reply on readiness
+                    if attempts[0] < 2 and self.try_reconstruct(oid):
+                        attempts[0] += 1
+                        self.task_manager.on_ready(oid, reply)
+                        return
+                    out.update(status="error", error=serialization.dumps(
+                        ObjectLostError(oid)))
+                    worker.send(out)
+                    return
                 if node.node_id in holders:
                     out.update(status="shm_local")
                     worker.send(out)
@@ -1137,6 +1419,13 @@ class DriverRuntime:
         (in-process: direct memcpy between arenas; remote: chunked pull)."""
         if dst_node.store.contains(oid):
             return True
+        loc = self.task_manager.get_location(oid)
+        if loc is not None and loc.kind == "spilled":
+            src = self.nodes.get(loc.node_id)
+            if src is not None and getattr(src, "is_remote", False):
+                from ray_tpu.core.object_transfer import pull_object
+                return pull_object(src.object_addr, oid, dst_node.store)
+            return False  # local files are served via spilled_local
         for nid in self.object_holders(oid):
             src = self.nodes.get(nid)
             if src is None or nid == dst_node.node_id:

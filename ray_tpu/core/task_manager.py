@@ -34,8 +34,11 @@ class PendingTask:
 
 @dataclass
 class ObjectLocation:
-    kind: str                   # "memory" (head memory store) | "shm"
+    kind: str                   # "memory" | "shm" | "spilled"
     node_id: Optional[NodeID] = None
+    # filesystem path of the spilled payload (kind == "spilled";
+    # reference: spilled object URLs, local_object_manager.h:43)
+    path: Optional[str] = None
 
 
 class ReferenceCounter:
@@ -247,6 +250,22 @@ class TaskManager:
                 self._ready_callbacks.setdefault(object_id, []).append(callback)
         if fire:
             callback()
+
+    def objects_on_node(self, node_id: NodeID) -> List[ObjectID]:
+        """Objects whose primary copy lives on `node_id` (shm or
+        spilled-to-its-disk)."""
+        with self._lock:
+            return [oid for oid, loc in self._locations.items()
+                    if loc.node_id == node_id]
+
+    def mark_object_unready(self, object_id: ObjectID) -> None:
+        """Reset readiness for lineage reconstruction: subsequent
+        get()/dep-waits block until the re-executed producer completes
+        (reference: object_recovery_manager.h:41)."""
+        with self._lock:
+            self._object_ready.pop(object_id, None)
+            self._locations.pop(object_id, None)
+            self._errors.pop(object_id, None)
 
     def forget_object(self, object_id: ObjectID) -> None:
         with self._lock:

@@ -103,12 +103,39 @@ class WorkerRuntime:
         return self.put_serialized(
             data, buffers, contained=[o.binary() for o in contained])
 
+    def request_spill(self, nbytes: int) -> None:
+        """Ask the owner to spill objects from this node's arena to disk
+        (reference: raylet-triggered spilling under create pressure,
+        local_object_manager.h:43)."""
+        self.request({"kind": "SPILL_REQUEST", "bytes": nbytes},
+                     timeout=60.0)
+
+    def _store_with_spill(self, write_fn, nbytes: int):
+        """Run a store write; on a full arena, spill and retry. Several
+        rounds: a spilled victim's space frees only after in-flight
+        readers (e.g. an object-server stream) release their pins."""
+        import time as _time
+
+        from ray_tpu.exceptions import ObjectStoreFullError
+        attempts = 5
+        for attempt in range(attempts):
+            try:
+                return write_fn()
+            except ObjectStoreFullError:
+                if attempt == attempts - 1:
+                    raise
+                self.request_spill(nbytes)
+                _time.sleep(0.05 * (attempt + 1))
+
     def put_serialized(self, data: bytes, buffers, contained=()) -> ObjectRef:
         # Random IDs: a retried task attempt must not collide with the
         # puts of its previous attempt (the ID travels in the returned
         # ref + PUT_META, so determinism buys nothing).
         oid = ObjectID.from_random()
-        self.store.put_parts(oid, data, buffers, [b.nbytes for b in buffers])
+        sizes = [b.nbytes for b in buffers]
+        self._store_with_spill(
+            lambda: self.store.put_parts(oid, data, buffers, sizes),
+            serialization.packed_size(data, sizes))
         self.conn.send({"kind": "PUT_META", "object_id": oid.binary(),
                         "contained": list(contained)})
         return ObjectRef(oid)
@@ -125,12 +152,16 @@ class WorkerRuntime:
                     contained_bin)
         sizes = [b.nbytes for b in buffers]
         packed_len = serialization.packed_size(data, sizes)
-        dest = self.store.create(oid, packed_len)
-        try:
-            serialization.pack_into(dest, data, buffers, sizes)
-        finally:
-            del dest
-        self.store.seal(oid)
+
+        def write():
+            dest = self.store.create(oid, packed_len)
+            try:
+                serialization.pack_into(dest, data, buffers, sizes)
+            finally:
+                del dest
+            self.store.seal(oid)
+
+        self._store_with_spill(write, packed_len)
         return ("shm", None, contained_bin)
 
     def get(self, refs, timeout: Optional[float] = None):
@@ -158,6 +189,14 @@ class WorkerRuntime:
             if found:
                 return value
             raise ObjectLostError(oid)
+        if status == "spilled_local":
+            # payload was spilled to a file on this host (reference:
+            # reading back from external storage)
+            try:
+                with open(reply["path"], "rb") as f:
+                    return serialization.unpack(f.read())
+            except OSError:
+                raise ObjectLostError(oid)
         if status == "error":
             raise serialization.loads(reply["error"])
         raise ObjectLostError(oid)
@@ -482,12 +521,17 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
         instance = rt.actor_instance
         if instance is None:
             return False
-        result = any(
-            inspect.iscoroutinefunction(m) or inspect.isasyncgenfunction(m)
-            for m in (getattr(instance, name, None)
-                      for name in dir(instance)
-                      if not name.startswith("__"))
-            if m is not None)
+        # getattr_static: never trigger @property getters or other
+        # descriptors — a raising getter must not kill the worker.
+        result = False
+        for name in dir(type(instance)):
+            if name.startswith("__"):
+                continue
+            attr = inspect.getattr_static(type(instance), name, None)
+            if (inspect.iscoroutinefunction(attr)
+                    or inspect.isasyncgenfunction(attr)):
+                result = True
+                break
         actor_state["is_async"] = result
         return result
 
@@ -508,7 +552,7 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
             else:
                 exec_pool.submit(run_task, spec)
         elif kind in ("OBJECT_VALUE", "GCS_REPLY", "READY_REPLY",
-                      "STREAM_REPLY"):
+                      "STREAM_REPLY", "SPILL_REPLY"):
             rt.deliver_reply(msg)
         elif kind == "SHUTDOWN":
             break

@@ -1,0 +1,193 @@
+"""Lineage reconstruction + object spilling.
+
+Reference models: python/ray/tests/test_reconstruction.py
+(object_recovery_manager.h:41 re-execution of lost objects) and
+test_object_spilling.py (local_object_manager.h:43 spill-to-disk under
+arena pressure).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ObjectLostError
+
+
+@pytest.fixture
+def chaos_cluster():
+    from ray_tpu.core.cluster_utils import Cluster
+    cluster = Cluster(head_node_args={"resources": {"CPU": 2}},
+                      system_config={"task_max_retries": 0})
+    yield cluster
+    cluster.shutdown()
+
+
+def _pin_soft(node_id):
+    """Prefer a node but survive its death (soft affinity falls back),
+    so reconstruction stays feasible."""
+    from ray_tpu.core.task_spec import SchedulingStrategy
+    return SchedulingStrategy(kind="NODE_AFFINITY", node_id=node_id,
+                              soft=True)
+
+
+def test_lost_object_reconstructed_on_get(chaos_cluster):
+    cluster = chaos_cluster
+    node_b = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(100_000, dtype=np.float64)  # shm-sized
+
+    ref = produce.options(scheduling_strategy=_pin_soft(node_b)).remote()
+    ray_tpu.wait([ref], timeout=30)
+    cluster.remove_node(node_b)  # the only copy dies with the node
+    value = ray_tpu.get(ref, timeout=60)  # lineage re-executes produce()
+    assert float(value.sum()) == float(np.arange(100_000).sum())
+
+
+def test_transitive_chain_reconstruction(chaos_cluster):
+    cluster = chaos_cluster
+    node_b = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def base():
+        return np.ones(100_000, dtype=np.float64)
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2.0
+
+    pin = _pin_soft(node_b)
+    ref_a = base.options(scheduling_strategy=pin).remote()
+    ref_b = double.options(scheduling_strategy=pin).remote(ref_a)
+    ray_tpu.wait([ref_b], timeout=30)
+    cluster.remove_node(node_b)  # both copies lost
+    out = ray_tpu.get(ref_b, timeout=60)  # rebuilds base -> double
+    assert float(out[0]) == 2.0
+
+
+def test_dependent_task_triggers_reconstruction(chaos_cluster):
+    """A queued consumer whose arg was lost reconstructs it through the
+    worker GET_OBJECT path (the Dataset-mid-pipeline shape)."""
+    cluster = chaos_cluster
+    node_b = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def produce():
+        return np.full(100_000, 7.0)
+
+    ref = produce.options(scheduling_strategy=_pin_soft(node_b)).remote()
+    ray_tpu.wait([ref], timeout=30)
+    cluster.remove_node(node_b)
+
+    @ray_tpu.remote
+    def consume(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(consume.remote(ref), timeout=60) == 700_000.0
+
+
+def test_unreconstructible_object_raises(chaos_cluster):
+    """ray_tpu.put has no lineage: loss is permanent (the reference's
+    semantics for non-task objects)."""
+    cluster = chaos_cluster
+    node_b = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def produce_put():
+        import ray_tpu as rt
+        return rt.put(np.ones(100_000))  # inner object owned via put
+
+    inner = ray_tpu.get(
+        produce_put.options(scheduling_strategy=_pin_soft(node_b)).remote(),
+        timeout=30)
+    cluster.remove_node(node_b)
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(inner, timeout=30)
+
+
+def test_dataset_survives_node_death(chaos_cluster):
+    """VERDICT item 7 done-criterion: kill the node holding blocks
+    mid-pipeline; the Dataset job still completes via lineage."""
+    import ray_tpu.data as data
+
+    cluster = chaos_cluster
+    node_b = cluster.add_node(num_cpus=2, resources={"b": 1.0})
+
+    ds = data.range(200, parallelism=4).map_batches(
+        lambda batch: {"id": [v * 2 for v in batch["id"]]},
+        resources={"b": 0.1})
+    # Materialize blocks on node b, kill it, then bring up a
+    # replacement carrying the same resource (the autoscaler shape) so
+    # re-execution is feasible.
+    materialized = ds.materialize()
+    cluster.remove_node(node_b)
+    cluster.add_node(num_cpus=2, resources={"b": 1.0})
+    total = sum(row["id"] for row in materialized.take_all())
+    assert total == 2 * sum(range(200))
+
+
+def test_spill_on_arena_overflow(ray_start_regular):
+    """Referenced objects exceeding the arena spill to disk instead of
+    failing (VERDICT item 7 arena-overflow criterion)."""
+    import ray_tpu as rt
+
+    rt.shutdown()
+    rt.init(num_cpus=2, object_store_memory=4 * 1024 * 1024,
+            system_config={"object_store_full_max_retries": 2,
+                           "task_max_retries": 0})
+    # 8 x 1MB while holding every ref: 2x the 4MB arena.
+    blobs = [np.full(131_072, i, dtype=np.float64) for i in range(8)]
+    refs = [rt.put(b) for b in blobs]
+    for i, ref in enumerate(refs):
+        out = rt.get(ref, timeout=30)
+        assert float(out[0]) == float(i)
+    rt.shutdown()
+
+
+def test_worker_put_spills(ray_start_regular):
+    """Task returns overflowing the arena spill via the worker's
+    SPILL_REQUEST path."""
+    import ray_tpu as rt
+
+    rt.shutdown()
+    rt.init(num_cpus=2, object_store_memory=4 * 1024 * 1024,
+            system_config={"object_store_full_max_retries": 2,
+                           "task_max_retries": 0})
+
+    @rt.remote
+    def make(i):
+        return np.full(131_072, float(i))  # ~1MB each
+
+    refs = [make.remote(i) for i in range(8)]
+    for i, ref in enumerate(refs):
+        assert float(rt.get(ref, timeout=60)[0]) == float(i)
+    rt.shutdown()
+
+
+def test_spill_on_remote_node_and_restore():
+    """Objects spilled on a daemon's host restore through the daemon and
+    pull back to the driver."""
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster(
+        head_node_args={"resources": {"CPU": 2}},
+        system_config={"head_port": 0,
+                       "object_store_full_max_retries": 2,
+                       "task_max_retries": 0})
+    try:
+        node_id, proc = cluster.add_remote_node(
+            num_cpus=2, resources={"spot": 1.0},
+            object_store_memory=4 * 1024 * 1024)
+
+        @ray_tpu.remote(resources={"spot": 0.1})
+        def make(i):
+            return np.full(131_072, float(i))
+
+        refs = [make.remote(i) for i in range(8)]
+        for i, ref in enumerate(refs):
+            assert float(ray_tpu.get(ref, timeout=90)[0]) == float(i)
+        proc.kill()
+        proc.wait(timeout=10)
+    finally:
+        cluster.shutdown()
