@@ -122,7 +122,13 @@ _config: Config | None = None
 
 
 def get_config() -> Config:
+    # Lock-free fast path: config objects are immutable after
+    # reset_config; rebinding a module global is atomic under the GIL
+    # and this is called on every dispatch/completion.
     global _config
+    config = _config
+    if config is not None:
+        return config
     with _config_lock:
         if _config is None:
             _config = Config()

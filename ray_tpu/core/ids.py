@@ -17,7 +17,7 @@ from typing import ClassVar
 
 class BaseID:
     SIZE: ClassVar[int] = 16
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, id_bytes: bytes):
         if len(id_bytes) != self.SIZE:
@@ -25,6 +25,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(id_bytes)}"
             )
         self._bytes = id_bytes
+        self._hash = hash(id_bytes)
 
     @classmethod
     def from_random(cls):
@@ -51,7 +52,9 @@ class BaseID:
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __hash__(self) -> int:
-        return hash((type(self).__name__, self._bytes))
+        # cached: IDs key nearly every hot-path dict (tasks, objects,
+        # locations, refcounts)
+        return self._hash
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.hex()})"

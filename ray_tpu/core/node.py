@@ -98,14 +98,27 @@ class Node:
         self._free_chips: List[int] = list(
             range(int(self.resources.get("TPU", 0))))
         self._total_chips = len(self._free_chips)
+        # per-profile pool counters (avoid scanning _workers per dispatch)
+        self._n_starting: Dict[str, int] = {}
+        self._n_live: Dict[str, int] = {}
         self._stopped = threading.Event()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
         self._listener.listen(128)
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"node-accept-{node_id.hex()[:6]}",
+        self._listener.setblocking(False)
+        # ONE selector-driven IO thread handles every worker connection:
+        # thread-per-worker reader loops anti-scale under the GIL (the
+        # reference's raylet is similarly a single asio event loop,
+        # src/ray/common/asio/). Sends from other threads use the
+        # non-blocking-aware _send_all.
+        import selectors
+        self._selector = selectors.DefaultSelector()
+        self._selector.register(self._listener, selectors.EVENT_READ,
+                                ("accept", None))
+        self._io_thread = threading.Thread(
+            target=self._io_loop, name=f"node-io-{node_id.hex()[:6]}",
             daemon=True)
-        self._accept_thread.start()
+        self._io_thread.start()
         self.prestart_workers(get_config().min_idle_workers)
 
     # --- worker pool ---------------------------------------------------
@@ -185,6 +198,8 @@ class Node:
         handle.chips = chips
         with self._lock:
             self._workers[worker_id] = handle
+            self._n_starting[profile] = self._n_starting.get(profile, 0) + 1
+            self._n_live[profile] = self._n_live.get(profile, 0) + 1
         return handle
 
     def prestart_workers(self, count: int, profile: str = "cpu") -> None:
@@ -205,29 +220,60 @@ class Node:
         import math
         return f"tpu:{int(math.ceil(amount))}"
 
-    def _accept_loop(self) -> None:
+    def _io_loop(self) -> None:
+        from ray_tpu.core.protocol import FrameReader
+        import selectors
         while not self._stopped.is_set():
             try:
-                sock, _ = self._listener.accept()
+                events = self._selector.select(timeout=0.5)
             except OSError:
                 return
-            threading.Thread(target=self._reader_loop,
-                             args=(MessageConnection(sock),),
-                             daemon=True).start()
+            for key, _mask in events:
+                kind, state = key.data
+                if kind == "accept":
+                    try:
+                        sock, _ = self._listener.accept()
+                    except OSError:
+                        continue
+                    sock.setblocking(False)
+                    self._selector.register(
+                        sock, selectors.EVENT_READ,
+                        ("conn", [MessageConnection(sock), FrameReader(),
+                                  None]))
+                    continue
+                try:
+                    self._service_conn(key.fileobj, state)
+                except Exception:  # noqa: BLE001 — one bad connection
+                    # (or death-handler error) must not kill the node's
+                    # only IO thread
+                    import traceback
+                    traceback.print_exc()
 
-    def _reader_loop(self, conn: MessageConnection) -> None:
-        handle: Optional[WorkerHandle] = None
-        while True:
-            msg = conn.recv()
-            if msg is None:
-                break
+    def _service_conn(self, sock, state) -> None:
+        conn, reader, handle = state
+        try:
+            data = sock.recv(262144)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            data = b""
+        if not data:
             try:
-                handle = self._handle_worker_msg(conn, handle, msg)
+                self._selector.unregister(sock)
+            except (KeyError, OSError):
+                pass
+            conn.close()
+            if handle is not None:
+                self._on_worker_death(handle)
+            return
+        for frame in reader.feed(data):
+            try:
+                msg = serialization.loads(frame)
+                new_handle = self._handle_worker_msg(conn, state[2], msg)
+                state[2] = new_handle
             except Exception:  # noqa: BLE001 — keep the connection alive
                 import traceback
                 traceback.print_exc()
-        if handle is not None:
-            self._on_worker_death(handle)
 
     def _handle_worker_msg(self, conn: MessageConnection,
                            handle: Optional[WorkerHandle],
@@ -240,6 +286,11 @@ class Node:
                     if handle is None:  # externally started worker
                         handle = WorkerHandle(worker_id, None)
                         self._workers[worker_id] = handle
+                        self._n_live[handle.profile] = \
+                            self._n_live.get(handle.profile, 0) + 1
+                    else:
+                        self._n_starting[handle.profile] = max(
+                            0, self._n_starting.get(handle.profile, 0) - 1)
                     handle.conn = conn
                     handle.state = IDLE
                     self._idle[handle.profile].append(handle)
@@ -247,6 +298,8 @@ class Node:
                 self._pump()
             elif kind == "TASK_DONE":
                 self._on_task_done(handle, msg)
+            elif kind == "TASK_DONE_BATCH":
+                self._on_task_batch_done(handle, msg)
             elif kind == "SUBMIT":
                 spec = serialization.loads(msg["spec"])
                 self.runtime.submit_spec(spec)
@@ -283,6 +336,21 @@ class Node:
             return handle
 
     # --- dispatch ------------------------------------------------------
+    def _worker_cap(self, profile: str) -> int:
+        """Max live workers per profile (reference: worker_pool.h
+        maximum_startup_concurrency + num_cpus-bounded pool). Without
+        this, a deep dispatch queue would fork one process per task.
+        TPU pools are bounded by chips, not CPUs — a 1-CPU host with 2
+        chips must still run 2 single-chip workers concurrently."""
+        cfg = get_config()
+        if cfg.max_workers_per_node > 0:
+            return cfg.max_workers_per_node
+        if profile.startswith("tpu:"):
+            k = int(profile.split(":", 1)[1])
+            if k > 0 and self._total_chips:
+                return max(1, self._total_chips // k)
+        return max(1, int(self.resources.get("CPU", 1)))
+
     def dispatch(self, spec: TaskSpec) -> None:
         """Run a (non-actor-method) task on this node. Resources already
         acquired by the cluster scheduler."""
@@ -293,10 +361,32 @@ class Node:
             if worker is not None:
                 self._send_task(worker, spec)
                 return
+            # Pipeline: hand a busy-but-shallow worker a second spec so
+            # it never idles a round trip (reference: owner-side lease
+            # reuse); deeper backlogs park in the profile queue, from
+            # which completions refill workers in batches. The scan is
+            # restricted to the empty-queue case (light load) so a deep
+            # backlog never pays O(workers) per dispatch, and skips
+            # actor creations both as payload (they must own a worker)
+            # and as hosts (a creating worker is off-limits).
+            if (not spec.is_actor_creation
+                    and not self._dispatch_queue[profile]
+                    and self._n_live.get(profile, 0)
+                    >= self._worker_cap(profile)):
+                for candidate in self._workers.values():
+                    if (candidate.profile == profile
+                            and candidate.state == BUSY
+                            and len(candidate.running) < 2
+                            and not any(s.is_actor_creation
+                                        for s in
+                                        candidate.running.values())):
+                        self._send_task(candidate, spec)
+                        return
             self._dispatch_queue[profile].append(spec)
-            n_starting = sum(1 for w in self._workers.values()
-                             if w.state == STARTING and w.profile == profile)
-            if n_starting < len(self._dispatch_queue[profile]):
+            n_starting = self._n_starting.get(profile, 0)
+            n_live = self._n_live.get(profile, 0)
+            if (n_starting < len(self._dispatch_queue[profile])
+                    and n_live < self._worker_cap(profile)):
                 self._spawn_worker(profile)
 
     def dispatch_to_actor(self, worker_id: WorkerID, spec: TaskSpec) -> bool:
@@ -308,17 +398,22 @@ class Node:
                 return False
             worker.running[spec.task_id] = spec
             return worker.send({"kind": "EXECUTE_ACTOR_TASK",
-                                "spec": serialization.dumps(spec)})
+                                "spec": serialization.dumps_fast(spec)})
 
     def _send_task(self, worker: WorkerHandle, spec: TaskSpec) -> None:
         worker.state = BUSY
         worker.running[spec.task_id] = spec
         kind = "CREATE_ACTOR" if spec.is_actor_creation else "EXECUTE"
-        if not worker.send({"kind": kind, "spec": serialization.dumps(spec)}):
-            worker.state = DEAD
+        if not worker.send({"kind": kind, "spec": serialization.dumps_fast(spec)}):
+            # This spec never reached the worker: requeue without
+            # consuming a retry, then run the FULL death path so other
+            # in-flight (pipelined) specs on this worker are retried too
+            # — setting DEAD here would make the later EOF handler
+            # early-return and strand them.
             self._dispatch_queue[worker.profile].appendleft(spec)
             del worker.running[spec.task_id]
-            # The reader thread may not have noticed this death yet, so
+            self._on_worker_death(worker)
+            # The IO thread may not have noticed this death yet, so
             # make sure a replacement exists to drain the queue.
             self._spawn_worker(worker.profile)
 
@@ -343,14 +438,14 @@ class Node:
                 starved = (profile.startswith("tpu")
                            and self._dispatch_queue[profile]
                            and not self._idle[profile]
-                           and not any(w.profile == profile
-                                       and w.state == STARTING
-                                       for w in self._workers.values()))
+                           and self._n_starting.get(profile, 0) == 0)
             if starved:
                 self._spawn_worker(profile)
 
     def _on_task_done(self, worker: WorkerHandle, msg: dict) -> None:
         task_id = TaskID(msg["task_id"])
+        batch = None
+        spawn_profile = None
         with self._lock:
             spec = worker.running.pop(task_id, None)
             if spec is None:
@@ -358,17 +453,98 @@ class Node:
             if spec.is_actor_creation and msg.get("error") is None:
                 worker.state = ACTOR
                 worker.actor_id = spec.actor_id
+                # Actor workers leave the task pool: the pool cap must
+                # not count them or long-lived actors starve task
+                # dispatch (serve runs dozens of actors per node).
+                self._n_live[worker.profile] = max(
+                    0, self._n_live.get(worker.profile, 0) - 1)
+                # This worker's departure may leave queued specs with no
+                # pool worker to drain them.
+                if (self._dispatch_queue.get(worker.profile)
+                        and self._n_starting.get(worker.profile, 0) == 0
+                        and self._n_live.get(worker.profile, 0)
+                        < self._worker_cap(worker.profile)):
+                    spawn_profile = worker.profile
             elif worker.state == BUSY:
-                worker.state = IDLE
-                self._idle[worker.profile].append(worker)
+                # Fast path: keep the worker's pipeline topped up
+                # straight from its own profile's queue — a full _pump()
+                # scan per completion is the throughput bottleneck.
+                batch = self._refill_locked(worker)
+        if spawn_profile is not None:
+            self._spawn_worker(spawn_profile)
+        if batch:
+            self._send_batch(worker, batch)
         self.runtime.on_task_done(self, worker, spec, msg)
-        self._pump()
+
+    def _refill_locked(self, worker: WorkerHandle) -> Optional[List[TaskSpec]]:
+        """Top up a busy worker's pipeline from its profile queue
+        (called under self._lock). Returns the batch to send, or None.
+        Batching amortizes the head's per-message cost — the single
+        IO thread is the task-throughput ceiling."""
+        queue = self._dispatch_queue.get(worker.profile)
+        if queue and len(worker.running) < 32:
+            take = min(len(queue), 32 - len(worker.running), 16)
+            batch: List[TaskSpec] = []
+            while len(batch) < take and queue:
+                if queue[0].is_actor_creation:
+                    # An actor creation must own a fresh worker: send it
+                    # alone once this worker has fully drained.
+                    if not worker.running and not batch:
+                        batch.append(queue.popleft())
+                    break
+                batch.append(queue.popleft())
+            if batch:
+                for spec in batch:
+                    worker.running[spec.task_id] = spec
+                return batch
+        if not worker.running:
+            worker.state = IDLE
+            self._idle[worker.profile].append(worker)
+        return None
+
+    def _send_batch(self, worker: WorkerHandle,
+                    batch: List[TaskSpec]) -> None:
+        if len(batch) == 1:
+            with self._lock:
+                del worker.running[batch[0].task_id]
+                self._send_task(worker, batch[0])
+            return
+        if not worker.send({"kind": "EXECUTE_BATCH",
+                            "specs": serialization.dumps_fast(batch)}):
+            with self._lock:
+                for spec in batch:
+                    if worker.running.pop(spec.task_id, None) is not None:
+                        self._dispatch_queue[worker.profile].appendleft(spec)
+            # full death path: retries any remaining in-flight specs
+            self._on_worker_death(worker)
+            self._spawn_worker(worker.profile)
+
+    def _on_task_batch_done(self, worker: WorkerHandle, msg: dict) -> None:
+        done = []
+        batch = None
+        with self._lock:
+            for item in msg["items"]:
+                spec = worker.running.pop(TaskID(item["task_id"]), None)
+                if spec is not None:
+                    done.append((spec, item))
+            if worker.state == BUSY:
+                batch = self._refill_locked(worker)
+        if batch:
+            self._send_batch(worker, batch)
+        for spec, item in done:
+            self.runtime.on_task_done(self, worker, spec, item)
 
     def _on_worker_death(self, worker: WorkerHandle) -> None:
         with self._lock:
             if worker.state == DEAD:
                 return
             was_actor = worker.state == ACTOR
+            if worker.state == STARTING:
+                self._n_starting[worker.profile] = max(
+                    0, self._n_starting.get(worker.profile, 0) - 1)
+            if not was_actor:  # actor workers already left the pool count
+                self._n_live[worker.profile] = max(
+                    0, self._n_live.get(worker.profile, 0) - 1)
             worker.state = DEAD
             running = list(worker.running.values())
             worker.running.clear()
@@ -387,8 +563,7 @@ class Node:
             starved = [
                 p for p, q in self._dispatch_queue.items()
                 if q and p.startswith("tpu") and not self._idle[p]
-                and not any(w.profile == p and w.state == STARTING
-                            for w in self._workers.values())
+                and self._n_starting.get(p, 0) == 0
             ]
         for oid in held:  # release this worker's borrowed pins
             self.runtime.reference_counter.remove_local_reference(oid)
@@ -433,6 +608,10 @@ class Node:
         try:
             self._listener.close()
         except OSError:
+            pass
+        try:
+            self._selector.close()
+        except (OSError, RuntimeError):
             pass
         try:
             os.unlink(self.socket_path)

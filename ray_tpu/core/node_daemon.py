@@ -74,7 +74,7 @@ class HeadProxy:
 
     # --- runtime interface used by Node --------------------------------
     def submit_spec(self, spec) -> None:
-        self.send({"kind": "SUBMIT", "spec": serialization.dumps(spec)})
+        self.send({"kind": "SUBMIT", "spec": serialization.dumps_fast(spec)})
 
     def on_worker_put(self, node, msg: dict) -> None:
         self.send({"kind": "PUT_META", "object_id": msg["object_id"],
@@ -131,12 +131,12 @@ class HeadProxy:
     def on_task_done(self, node, worker, spec, msg: dict) -> None:
         self.send({"kind": "TASK_DONE_FWD",
                    "worker_id": worker.worker_id.binary(),
-                   "spec": serialization.dumps(spec), "msg": msg})
+                   "spec": serialization.dumps_fast(spec), "msg": msg})
 
     def on_worker_crashed(self, node, worker, running, actor_id) -> None:
         self.send({"kind": "WORKER_CRASHED_FWD",
                    "worker_id": worker.worker_id.binary(),
-                   "running": [serialization.dumps(s) for s in running],
+                   "running": [serialization.dumps_fast(s) for s in running],
                    "actor_id": actor_id.binary() if actor_id else None})
 
 
@@ -224,7 +224,7 @@ class NodeDaemon:
             if not self.node.dispatch_to_actor(WorkerID(msg["worker_id"]),
                                                spec):
                 self.proxy.send({"kind": "ACTOR_DISPATCH_FAILED",
-                                 "spec": serialization.dumps(spec)})
+                                 "spec": serialization.dumps_fast(spec)})
         elif kind == "TO_WORKER":
             self._route_to_worker(WorkerID(msg["worker_id"]), msg["payload"])
         elif kind == "KILL_WORKER":

@@ -24,9 +24,52 @@ from ray_tpu.core import serialization
 _LEN = struct.Struct("<I")
 
 
+def _send_all(sock: socket.socket, data: bytes) -> None:
+    """sendall that also works on non-blocking sockets (the node's
+    selector loop keeps worker connections non-blocking for reads;
+    writes from other threads spin on writability when the buffer
+    fills)."""
+    import select as _select
+    view = memoryview(data)
+    while view:
+        try:
+            sent = sock.send(view)
+        except (BlockingIOError, InterruptedError):
+            _select.select([], [sock], [], 1.0)
+            continue
+        view = view[sent:]
+
+
 def send_msg(sock: socket.socket, msg: dict) -> None:
-    data = serialization.dumps(msg)
-    sock.sendall(_LEN.pack(len(data)) + data)
+    # Messages carry only framework structures and pre-serialized bytes
+    # (user values are packed upstream), so the fast pickle path is safe.
+    data = serialization.dumps_fast(msg)
+    _send_all(sock, _LEN.pack(len(data)) + data)
+
+
+class FrameReader:
+    """Incremental parser for length-prefixed frames on a non-blocking
+    socket (reference: client_connection.cc async read path)."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def feed(self, data: bytes) -> List[bytes]:
+        self._buf += data
+        out: List[bytes] = []
+        buf = self._buf
+        while True:
+            if len(buf) < _LEN.size:
+                break
+            (length,) = _LEN.unpack_from(buf)
+            end = _LEN.size + length
+            if len(buf) < end:
+                break
+            out.append(bytes(buf[_LEN.size:end]))
+            del buf[:end]
+        return out
 
 
 def recv_msg(sock: socket.socket) -> Optional[dict]:
@@ -87,8 +130,10 @@ class MessageConnection:
         self._send_lock = threading.Lock()
 
     def send(self, msg: dict) -> None:
+        data = serialization.dumps_fast(msg)
+        framed = _LEN.pack(len(data)) + data
         with self._send_lock:
-            send_msg(self.sock, msg)
+            _send_all(self.sock, framed)
 
     def recv(self) -> Optional[dict]:
         return recv_msg(self.sock)

@@ -100,6 +100,12 @@ class RemoteFunction:
         self._blob: Optional[bytes] = None
         self._function_id: Optional[str] = None
         self._registered_with: Optional[int] = None
+        # Options are immutable per RemoteFunction (options() clones):
+        # precompute the per-call constants off the submit hot path.
+        self._resources = resources_from_options(self._options)
+        self._strategy = strategy_from_options(self._options)
+        self._name = (self._options.get("name")
+                      or getattr(fn, "__qualname__", ""))
 
     @property
     def options_dict(self):
@@ -147,11 +153,11 @@ class RemoteFunction:
             args=[value_to_arg(a, rt) for a in args],
             kwargs={k: value_to_arg(v, rt) for k, v in kwargs.items()},
             num_returns=num_returns,
-            resources=resources_from_options(opts),
-            strategy=strategy_from_options(opts),
+            resources=dict(self._resources),
+            strategy=self._strategy,
             max_retries=opts.get("max_retries", get_config().task_max_retries),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            name=opts.get("name") or getattr(self._fn, "__qualname__", ""),
+            name=self._name,
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)

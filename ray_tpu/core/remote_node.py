@@ -125,7 +125,7 @@ class RemoteNode:
     def dispatch(self, spec: TaskSpec) -> None:
         self.track(spec)
         if not self.send({"kind": "DISPATCH",
-                          "spec": serialization.dumps(spec)}):
+                          "spec": serialization.dumps_fast(spec)}):
             # Leave the spec tracked: the death sweep (take_inflight)
             # is what retries it.
             self.runtime.on_remote_node_death(self.node_id)
@@ -134,7 +134,7 @@ class RemoteNode:
         self.track(spec)
         ok = self.send({"kind": "DISPATCH_ACTOR",
                         "worker_id": worker_id.binary(),
-                        "spec": serialization.dumps(spec)})
+                        "spec": serialization.dumps_fast(spec)})
         if not ok:
             self.untrack(spec.task_id)
         return ok

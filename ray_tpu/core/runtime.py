@@ -606,6 +606,13 @@ class DriverRuntime:
         deps = [d for d in spec.dependencies()
                 if not self.task_manager.is_ready(d)]
         if not deps:
+            # Direct dispatch on the submitting thread when capacity is
+            # free (reference: owner-to-worker direct push with cached
+            # leases, normal_task_submitter.cc:499 — the scheduler
+            # thread only handles contention/backlog). Two thread hops
+            # fewer per task on the hot path.
+            if self._try_fast_dispatch(spec):
+                return
             self._enqueue(spec)
             return
         remaining = [len(deps)]
@@ -621,13 +628,42 @@ class DriverRuntime:
         for dep in deps:
             self.task_manager.on_ready(dep, on_dep_ready)
 
+    def _try_fast_dispatch(self, spec: TaskSpec) -> bool:
+        if self._schedulable or self._backlog_view:
+            return False  # don't jump ahead of parked work
+        try:
+            node_id = self.scheduler.pick_node(spec,
+                                               preferred=self.head_node_id)
+        except ValueError:
+            return False  # infeasible: let the slow path park it
+        if node_id is None or not self.scheduler.try_acquire(
+                node_id, self._spec_resources(spec)):
+            return False
+        node = self.nodes.get(node_id)
+        if node is None:
+            self.scheduler.release(node_id, self._spec_resources(spec))
+            return False
+        if spec.is_actor_creation:
+            info = self.actors.get(spec.actor_id)
+            if info is not None:
+                info.resources_node = node_id
+        self.task_manager.mark_dispatched(spec.task_id, node_id)
+        self._record_event(spec, "SCHEDULED", node_id=node_id)
+        node.dispatch(spec)
+        return True
+
     def _enqueue(self, spec: TaskSpec) -> None:
         with self._sched_cond:
+            was_empty = not self._schedulable
             self._schedulable.append(spec)
-            self._sched_cond.notify_all()
+            if was_empty:
+                # The scheduler drains the whole list per pass; notifying
+                # on every append would wake it once per task.
+                self._sched_cond.notify_all()
 
     def _scheduling_loop(self) -> None:
         backlog: deque = deque()
+        self._backlog_blocked = False
         while not self._stopped.is_set():
             with self._sched_cond:
                 while not self._schedulable and not backlog and not self._stopped.is_set():
@@ -670,9 +706,13 @@ class DriverRuntime:
                 made_progress = True
             self._backlog_view = list(backlog)
             if backlog and not made_progress:
-                # All blocked on capacity; wait for a release/completion.
+                # All blocked on capacity; wait for a release/completion
+                # (completions only notify while this flag is up, so the
+                # hot path pays no wakeup per task when nothing waits).
                 with self._sched_cond:
+                    self._backlog_blocked = True
                     self._sched_cond.wait(timeout=0.05)
+                    self._backlog_blocked = False
 
     def resource_demand(self) -> List[Dict[str, float]]:
         """Unmet resource requests: backlog (feasible but waiting on
@@ -796,7 +836,8 @@ class DriverRuntime:
             oid_bytes, kind, data = result[:3]
             contained = result[3] if len(result) > 3 else ()
             oid = ObjectID(oid_bytes)
-            self._reconstruction_done(oid)
+            if self._reconstructing:  # unlocked peek: usually empty
+                self._reconstruction_done(oid)
             self._pin_contained(oid, contained)
             if kind == "inline":
                 self.memory_store.put(oid, ("packed", bytes(data)))
@@ -849,6 +890,10 @@ class DriverRuntime:
         self.scheduler.release(node_id, self._spec_resources(spec))
 
     def _signal_scheduler(self) -> None:
+        # cheap unlocked read: only completions that may unblock a
+        # capacity-starved backlog pay the lock+notify+context switch
+        if not getattr(self, "_backlog_blocked", True):
+            return
         with self._sched_cond:
             self._sched_cond.notify_all()
 
@@ -1208,7 +1253,9 @@ class DriverRuntime:
             heapq.heappush(
                 self._expiry_items,
                 (time.monotonic() + delay, id(fn), fn))
-            self._expiry_cv.notify()
+            # No notify: the expiry thread polls at >=2 Hz and every
+            # deadline is >=grace seconds out, so a wakeup per scheduled
+            # item would only thrash the GIL on the task hot path.
 
     def deferred_remove_reference(self, oid: ObjectID) -> None:
         """Remove a worker-reported borrow; a zero count only fires the

@@ -111,6 +111,13 @@ class ReferenceCounter:
         deleter = self._deleter
         if deleter is None:
             return
+        with self._lock:
+            if self._counts.get(object_id, 0) > 0:
+                # The common case (caller still holds its ObjectRef):
+                # that ref's drop is what deletes; scheduling a deferred
+                # re-check per task result would only churn the expiry
+                # heap on the hot path.
+                return
         if defer is None:
             self._delete_if_still_zero(object_id, deleter)
             return

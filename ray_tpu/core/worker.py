@@ -230,7 +230,7 @@ class WorkerRuntime:
 
     # --- task/actor submission (nested) ---------------------------------
     def submit_spec(self, spec: TaskSpec) -> None:
-        self.conn.send({"kind": "SUBMIT", "spec": serialization.dumps(spec)})
+        self.conn.send({"kind": "SUBMIT", "spec": serialization.dumps_fast(spec)})
 
     def create_actor(self, spec: TaskSpec, name: Optional[str] = None) -> None:
         self.submit_spec(spec)
@@ -540,7 +540,18 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
         if msg is None:
             break
         kind = msg["kind"]
-        if kind in ("EXECUTE", "CREATE_ACTOR", "EXECUTE_ACTOR_TASK"):
+        if kind == "EXECUTE_BATCH":
+            # Batched dispatch: execute sequentially, reply once — the
+            # head's single IO thread amortizes its per-message cost
+            # across the batch.
+            specs: List[TaskSpec] = serialization.loads(msg["specs"])
+
+            def run_batch(specs=specs):
+                items = [_execute(rt, s) for s in specs]
+                conn.send({"kind": "TASK_DONE_BATCH", "items": items})
+
+            exec_pool.submit(run_batch)
+        elif kind in ("EXECUTE", "CREATE_ACTOR", "EXECUTE_ACTOR_TASK"):
             spec: TaskSpec = serialization.loads(msg["spec"])
             if spec.is_actor_creation and spec.max_concurrency > 1:
                 with pool_lock:

@@ -1,0 +1,156 @@
+"""Core-runtime microbenchmarks (reference: python/ray/_private/ray_perf.py:95).
+
+Measures the control/object plane, not TPU math: trivial-task
+throughput, actor call rates, and put/get rates. Run:
+
+    python -m ray_tpu.scripts.perf [--tasks N]
+
+Prints one JSON line per benchmark and a summary line; committed
+numbers live in PERF.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _rate(n: int, seconds: float) -> float:
+    return round(n / seconds, 1) if seconds > 0 else float("inf")
+
+
+def bench_trivial_tasks(rt, n: int) -> dict:
+    """Submit-then-drain n no-op tasks (reference: 'tasks sync' +
+    'tasks async' in ray_perf)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    # warmup: spin the worker pool up
+    ray_tpu.get([nop.remote() for _ in range(20)])
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    return {"bench": "trivial_tasks", "n": n, "seconds": round(dt, 3),
+            "per_second": _rate(n, dt)}
+
+
+def bench_task_sync_latency(rt, n: int) -> dict:
+    """Round-trip one task at a time (scheduling latency)."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(nop.remote())
+    dt = time.perf_counter() - t0
+    return {"bench": "task_sync_roundtrip", "n": n,
+            "seconds": round(dt, 3), "per_second": _rate(n, dt),
+            "latency_ms": round(1000 * dt / n, 3)}
+
+
+def bench_actor_calls(rt, n: int) -> dict:
+    """Pipelined calls on one actor (reference: 'actor calls async')."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    t0 = time.perf_counter()
+    refs = [a.ping.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    ray_tpu.kill(a)
+    return {"bench": "actor_calls_pipelined", "n": n,
+            "seconds": round(dt, 3), "per_second": _rate(n, dt)}
+
+
+def bench_actor_sync(rt, n: int) -> dict:
+    import ray_tpu
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.ping.remote())
+    dt = time.perf_counter() - t0
+    ray_tpu.kill(a)
+    return {"bench": "actor_calls_sync", "n": n, "seconds": round(dt, 3),
+            "per_second": _rate(n, dt),
+            "latency_ms": round(1000 * dt / n, 3)}
+
+
+def bench_put_get_small(rt, n: int) -> dict:
+    import ray_tpu
+
+    value = {"k": 1, "v": "x" * 100}
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(value))
+    dt = time.perf_counter() - t0
+    return {"bench": "put_get_small", "n": n, "seconds": round(dt, 3),
+            "per_second": _rate(n, dt)}
+
+
+def bench_put_get_1mb(rt, n: int) -> dict:
+    import numpy as np
+
+    import ray_tpu
+
+    value = np.zeros(131_072, dtype=np.float64)  # 1 MiB
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(ray_tpu.put(value))
+    dt = time.perf_counter() - t0
+    gbps = (n * value.nbytes) / dt / 1e9
+    return {"bench": "put_get_1mb", "n": n, "seconds": round(dt, 3),
+            "per_second": _rate(n, dt), "GB_per_s": round(gbps, 2)}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tasks", type=int, default=2000)
+    parser.add_argument("--sync-tasks", type=int, default=300)
+    parser.add_argument("--actor-calls", type=int, default=2000)
+    parser.add_argument("--puts", type=int, default=1000)
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+    rt = ray_tpu.init(num_cpus=8, ignore_reinit_error=True,
+                      system_config={"log_to_driver": False})
+    results = []
+    for fn, n in (
+        (bench_trivial_tasks, args.tasks),
+        (bench_task_sync_latency, args.sync_tasks),
+        (bench_actor_calls, args.actor_calls),
+        (bench_actor_sync, args.sync_tasks),
+        (bench_put_get_small, args.puts),
+        (bench_put_get_1mb, min(args.puts, 300)),
+    ):
+        out = fn(rt, n)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    summary = {r["bench"]: r["per_second"] for r in results}
+    print(json.dumps({"bench": "summary", **summary}))
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()
