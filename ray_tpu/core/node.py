@@ -52,11 +52,21 @@ class WorkerHandle:
         self.registered = threading.Event()
         # objects this worker holds borrowed refs to (pinned at owner)
         self.held_refs: set = set()
+        # outstanding blocking requests (get/wait/stream-next) — a
+        # blocked worker doesn't count toward the pool cap, or nested
+        # submission would deadlock (reference: workers blocked in
+        # ray.get release their CPU resource)
+        self.blocked_requests = 0
+        self.node: Optional["Node"] = None
 
     def send(self, msg: dict) -> bool:
         conn = self.conn
         if conn is None or self.state == DEAD:
             return False
+        if (self.node is not None and msg.get("kind") in
+                ("OBJECT_VALUE", "READY_REPLY", "STREAM_REPLY")):
+            # answering a blocking request: the worker re-enters the pool
+            self.node._mark_unblocked(self)
         try:
             conn.send(msg)
             return True
@@ -101,6 +111,7 @@ class Node:
         # per-profile pool counters (avoid scanning _workers per dispatch)
         self._n_starting: Dict[str, int] = {}
         self._n_live: Dict[str, int] = {}
+        self._n_blocked: Dict[str, int] = {}
         self._stopped = threading.Event()
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
@@ -196,6 +207,7 @@ class Node:
         )
         handle = WorkerHandle(worker_id, proc, profile)
         handle.chips = chips
+        handle.node = self
         with self._lock:
             self._workers[worker_id] = handle
             self._n_starting[profile] = self._n_starting.get(profile, 0) + 1
@@ -285,6 +297,7 @@ class Node:
                     handle = self._workers.get(worker_id)
                     if handle is None:  # externally started worker
                         handle = WorkerHandle(worker_id, None)
+                        handle.node = self
                         self._workers[worker_id] = handle
                         self._n_live[handle.profile] = \
                             self._n_live.get(handle.profile, 0) + 1
@@ -300,6 +313,22 @@ class Node:
                 self._on_task_done(handle, msg)
             elif kind == "TASK_DONE_BATCH":
                 self._on_task_batch_done(handle, msg)
+            elif kind == "RETURN_SPECS":
+                # the worker is blocking: it hands queued specs back for
+                # re-dispatch elsewhere
+                self._on_specs_returned(handle, msg)
+            elif kind in ("GET_OBJECT", "CHECK_READY", "STREAM_NEXT") \
+                    and handle is not None:
+                # The worker is (probably) about to block on this reply:
+                # take it out of the pool-cap accounting so queued work
+                # can still spawn replacements (nested submit+get).
+                self._mark_blocked(handle)
+                if kind == "GET_OBJECT":
+                    self.runtime.handle_get_object(self, handle, msg)
+                elif kind == "CHECK_READY":
+                    self.runtime.handle_check_ready(handle, msg)
+                else:
+                    self.runtime.handle_stream_next(handle, msg)
             elif kind == "SUBMIT":
                 spec = serialization.loads(msg["spec"])
                 self.runtime.submit_spec(spec)
@@ -307,12 +336,6 @@ class Node:
                 self.runtime.on_worker_put(self, msg)
             elif kind == "STREAM_ITEM":
                 self.runtime.on_stream_item(self, msg)
-            elif kind == "STREAM_NEXT":
-                self.runtime.handle_stream_next(handle, msg)
-            elif kind == "GET_OBJECT":
-                self.runtime.handle_get_object(self, handle, msg)
-            elif kind == "CHECK_READY":
-                self.runtime.handle_check_ready(handle, msg)
             elif kind == "SPILL_REQUEST":
                 self.runtime.handle_spill_request(self, handle, msg)
             elif kind == "GCS_REQUEST":
@@ -336,6 +359,35 @@ class Node:
             return handle
 
     # --- dispatch ------------------------------------------------------
+    def _mark_blocked(self, worker: WorkerHandle) -> None:
+        spawn = False
+        with self._lock:
+            worker.blocked_requests += 1
+            if worker.blocked_requests == 1:
+                self._n_blocked[worker.profile] = \
+                    self._n_blocked.get(worker.profile, 0) + 1
+                # escape hatch: queued work may now be spawnable
+                profile = worker.profile
+                spawn = (bool(self._dispatch_queue.get(profile))
+                         and self._n_starting.get(profile, 0) == 0
+                         and self._effective_live(profile)
+                         < self._worker_cap(profile))
+        if spawn:
+            self._spawn_worker(worker.profile)
+
+    def _mark_unblocked(self, worker: WorkerHandle) -> None:
+        with self._lock:
+            if worker.blocked_requests > 0:
+                worker.blocked_requests -= 1
+                if worker.blocked_requests == 0:
+                    self._n_blocked[worker.profile] = max(
+                        0, self._n_blocked.get(worker.profile, 0) - 1)
+
+    def _effective_live(self, profile: str) -> int:
+        """Pool workers counting toward the cap: live minus blocked."""
+        return (self._n_live.get(profile, 0)
+                - self._n_blocked.get(profile, 0))
+
     def _worker_cap(self, profile: str) -> int:
         """Max live workers per profile (reference: worker_pool.h
         maximum_startup_concurrency + num_cpus-bounded pool). Without
@@ -371,12 +423,13 @@ class Node:
             # and as hosts (a creating worker is off-limits).
             if (not spec.is_actor_creation
                     and not self._dispatch_queue[profile]
-                    and self._n_live.get(profile, 0)
+                    and self._effective_live(profile)
                     >= self._worker_cap(profile)):
                 for candidate in self._workers.values():
                     if (candidate.profile == profile
                             and candidate.state == BUSY
                             and len(candidate.running) < 2
+                            and candidate.blocked_requests == 0
                             and not any(s.is_actor_creation
                                         for s in
                                         candidate.running.values())):
@@ -384,9 +437,9 @@ class Node:
                         return
             self._dispatch_queue[profile].append(spec)
             n_starting = self._n_starting.get(profile, 0)
-            n_live = self._n_live.get(profile, 0)
             if (n_starting < len(self._dispatch_queue[profile])
-                    and n_live < self._worker_cap(profile)):
+                    and self._effective_live(profile)
+                    < self._worker_cap(profile)):
                 self._spawn_worker(profile)
 
     def dispatch_to_actor(self, worker_id: WorkerID, spec: TaskSpec) -> bool:
@@ -435,10 +488,13 @@ class Node:
                     self._send_task(worker, spec)
         for profile in profiles:
             with self._lock:
-                starved = (profile.startswith("tpu")
-                           and self._dispatch_queue[profile]
-                           and not self._idle[profile]
-                           and self._n_starting.get(profile, 0) == 0)
+                starved = (
+                    self._dispatch_queue[profile]
+                    and not self._idle[profile]
+                    and self._n_starting.get(profile, 0) == 0
+                    and (profile.startswith("tpu")  # chip reclaim path
+                         or self._effective_live(profile)
+                         < self._worker_cap(profile)))
             if starved:
                 self._spawn_worker(profile)
 
@@ -486,11 +542,16 @@ class Node:
             take = min(len(queue), 32 - len(worker.running), 16)
             batch: List[TaskSpec] = []
             while len(batch) < take and queue:
-                if queue[0].is_actor_creation:
+                head = queue[0]
+                if head.is_actor_creation:
                     # An actor creation must own a fresh worker: send it
                     # alone once this worker has fully drained.
                     if not worker.running and not batch:
                         batch.append(queue.popleft())
+                    break
+                if not self._batchable(head):
+                    if not batch:
+                        batch.append(queue.popleft())  # dispatch singly
                     break
                 batch.append(queue.popleft())
             if batch:
@@ -502,11 +563,28 @@ class Node:
             self._idle[worker.profile].append(worker)
         return None
 
+    @staticmethod
+    def _batchable(spec: TaskSpec) -> bool:
+        """Batch-mates execute sequentially in one worker slot, so a
+        spec whose inline args embed unresolved ObjectRefs (no
+        dependency edge — the head never waited for them) could block
+        on a batch-mate's output: head-of-line deadlock. Dispatch those
+        singly; direct object_id deps are safe (resolved before
+        dispatch). Streaming tasks stay single for reply ordering."""
+        if spec.num_returns == -1:
+            return False
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if (arg.value_bytes is not None
+                    and getattr(arg, "_keepalive", None)):
+                return False
+        return True
+
     def _send_batch(self, worker: WorkerHandle,
                     batch: List[TaskSpec]) -> None:
         if len(batch) == 1:
             with self._lock:
-                del worker.running[batch[0].task_id]
+                if worker.running.pop(batch[0].task_id, None) is None:
+                    return  # worker died; the crash path retried it
                 self._send_task(worker, batch[0])
             return
         if not worker.send({"kind": "EXECUTE_BATCH",
@@ -534,6 +612,14 @@ class Node:
         for spec, item in done:
             self.runtime.on_task_done(self, worker, spec, item)
 
+    def _on_specs_returned(self, worker: WorkerHandle, msg: dict) -> None:
+        with self._lock:
+            for tid_bytes in msg["task_ids"]:
+                spec = worker.running.pop(TaskID(tid_bytes), None)
+                if spec is not None:
+                    self._dispatch_queue[worker.profile].appendleft(spec)
+        self._pump()
+
     def _on_worker_death(self, worker: WorkerHandle) -> None:
         with self._lock:
             if worker.state == DEAD:
@@ -545,6 +631,10 @@ class Node:
             if not was_actor:  # actor workers already left the pool count
                 self._n_live[worker.profile] = max(
                     0, self._n_live.get(worker.profile, 0) - 1)
+            if worker.blocked_requests > 0:
+                worker.blocked_requests = 0
+                self._n_blocked[worker.profile] = max(
+                    0, self._n_blocked.get(worker.profile, 0) - 1)
             worker.state = DEAD
             running = list(worker.running.values())
             worker.running.clear()

@@ -56,6 +56,9 @@ class WorkerRuntime:
             lambda oid: self.conn.send(
                 {"kind": "REF_DROP", "object_id": oid.binary()}))
         self.is_driver = False
+        # set by worker_main: flushes queued specs back to the node
+        # before this worker blocks on an object
+        self.on_block = None
         self._req_lock = threading.Lock()
         self._req_counter = 0
         self._replies: Dict[int, Tuple[threading.Event, list]] = {}
@@ -177,10 +180,20 @@ class WorkerRuntime:
         found, value = self.store.get_value(oid, timeout_s=0.0)
         if found:
             return value
-        reply = self.request(
-            {"kind": "GET_OBJECT", "object_id": oid.binary()},
-            timeout=timeout if timeout is not None else None,
-        )
+        # About to block: hand queued (pipelined) specs back to the node
+        # so they can run elsewhere — one of them might be what this
+        # get() is waiting for (head-of-line deadlock otherwise). Specs
+        # arriving while blocked bounce straight back (enter/exit).
+        if self.on_block is not None:
+            self.on_block(True)
+        try:
+            reply = self.request(
+                {"kind": "GET_OBJECT", "object_id": oid.binary()},
+                timeout=timeout if timeout is not None else None,
+            )
+        finally:
+            if self.on_block is not None:
+                self.on_block(False)
         status = reply["status"]
         if status == "inline":
             return serialization.unpack(reply["data"])
@@ -247,9 +260,16 @@ class WorkerRuntime:
                     timeout: Optional[float]):
         """Consume item ``index`` of a streaming task owned by the head
         (reference: ObjectRefGenerator protocol, _raylet.pyx:299)."""
-        reply = self.request({"kind": "STREAM_NEXT",
-                              "task_id": task_id.binary(), "index": index},
-                             timeout=timeout)
+        if self.on_block is not None:
+            self.on_block(True)
+        try:
+            reply = self.request({"kind": "STREAM_NEXT",
+                                  "task_id": task_id.binary(),
+                                  "index": index},
+                                 timeout=timeout)
+        finally:
+            if self.on_block is not None:
+                self.on_block(False)
         status = reply["status"]
         if status == "item":
             return "item", ObjectID(reply["object_id"])
@@ -478,6 +498,84 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
 
     exec_pool = ThreadPoolExecutor(max_workers=1)
     pool_lock = threading.Lock()
+    # Plain tasks run off a local pending queue on one runner thread;
+    # when the current task blocks on an object, queued specs are handed
+    # BACK to the node (RETURN_SPECS) so they can run elsewhere — a
+    # pipelined batch-mate might be exactly what the task waits for.
+    from collections import deque as _deque
+    pending: "_deque" = _deque()  # (spec, collector | None)
+    pending_cv = threading.Condition()
+
+    class BatchCollector:
+        """Aggregates one EXECUTE_BATCH's replies into TASK_DONE_BATCH
+        (specs given back reduce the expected count)."""
+
+        def __init__(self, expected: int):
+            self.expected = expected
+            self.items: list = []
+
+        def add(self, item: dict) -> None:
+            with pending_cv:
+                self.items.append(item)
+                done = len(self.items) >= self.expected
+                items = list(self.items) if done else None
+            if done:
+                conn.send({"kind": "TASK_DONE_BATCH", "items": items})
+
+        def returned(self, count: int) -> None:
+            # called under pending_cv
+            self.expected -= count
+            if self.items and len(self.items) >= self.expected:
+                items = list(self.items)
+                conn.send({"kind": "TASK_DONE_BATCH", "items": items})
+
+    blocked_depth = [0]
+
+    def on_block(entering: bool) -> None:
+        with pending_cv:
+            blocked_depth[0] += 1 if entering else -1
+            if not entering:
+                return
+            taken = list(pending)
+            pending.clear()
+            ids = []
+            for spec, collector in taken:
+                ids.append(spec.task_id.binary())
+                if collector is not None:
+                    collector.returned(1)
+        if ids:
+            conn.send({"kind": "RETURN_SPECS", "task_ids": ids})
+
+    rt.on_block = on_block
+
+    def runner_loop() -> None:
+        while True:
+            with pending_cv:
+                while not pending:
+                    pending_cv.wait()
+                spec, collector = pending.popleft()
+            reply = _execute(rt, spec)
+            if collector is None:
+                conn.send(reply)
+            else:
+                collector.add(reply)
+
+    threading.Thread(target=runner_loop, name="task-runner",
+                     daemon=True).start()
+
+    def enqueue(spec: TaskSpec, collector=None) -> None:
+        with pending_cv:
+            if blocked_depth[0] > 0:
+                # runner is blocked on an object: bounce the spec back
+                # immediately rather than parking it behind the block
+                if collector is not None:
+                    collector.returned(1)
+                bounce = spec.task_id.binary()
+            else:
+                pending.append((spec, collector))
+                pending_cv.notify()
+                return
+        conn.send({"kind": "RETURN_SPECS", "task_ids": [bounce]})
     # Async-actor support (reference: asyncio actors — the reference runs
     # coroutine methods on a dedicated event loop so max_concurrency
     # requests interleave at awaits rather than occupying threads).
@@ -541,17 +639,16 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
             break
         kind = msg["kind"]
         if kind == "EXECUTE_BATCH":
-            # Batched dispatch: execute sequentially, reply once — the
-            # head's single IO thread amortizes its per-message cost
-            # across the batch.
+            # Batched dispatch: execute sequentially off the pending
+            # queue, reply once — the head's single IO thread amortizes
+            # its per-message cost across the batch.
             specs: List[TaskSpec] = serialization.loads(msg["specs"])
-
-            def run_batch(specs=specs):
-                items = [_execute(rt, s) for s in specs]
-                conn.send({"kind": "TASK_DONE_BATCH", "items": items})
-
-            exec_pool.submit(run_batch)
-        elif kind in ("EXECUTE", "CREATE_ACTOR", "EXECUTE_ACTOR_TASK"):
+            collector = BatchCollector(len(specs))
+            for s in specs:
+                enqueue(s, collector)
+        elif kind == "EXECUTE":
+            enqueue(serialization.loads(msg["spec"]))
+        elif kind in ("CREATE_ACTOR", "EXECUTE_ACTOR_TASK"):
             spec: TaskSpec = serialization.loads(msg["spec"])
             if spec.is_actor_creation and spec.max_concurrency > 1:
                 with pool_lock:

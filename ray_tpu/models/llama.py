@@ -48,6 +48,15 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "flash"  # flash | reference | ring | ulysses
     remat: bool = True
+    # Mixture-of-Experts: >0 replaces the dense FFN with moe_experts
+    # expert FFNs routed top-k, expert-parallel over the "expert" mesh
+    # axis (ray_tpu/parallel/moe.py; no reference analog — SURVEY §2.3
+    # X4 commits EP in-tree).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    # weight of the Switch-style load-balancing aux loss (per layer)
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -84,19 +93,33 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         hd = self.head_dim
+        ffn_copies = max(1, self.moe_experts)
         per_layer = (
             self.dim * self.n_heads * hd          # wq
             + 2 * self.dim * self.n_kv_heads * hd  # wk, wv
             + self.n_heads * hd * self.dim         # wo
-            + 3 * self.dim * self.hidden_dim       # w1, w2, w3 (w2 transposed)
+            + ffn_copies * 3 * self.dim * self.hidden_dim  # w1, w2, w3
+            + (self.dim * self.moe_experts if self.moe_experts else 0)
             + 2 * self.dim                         # norms
         )
         return (self.vocab_size * self.dim * 2     # embedding + lm_head
                 + self.n_layers * per_layer + self.dim)
 
+    def active_params_per_token(self) -> int:
+        """Parameters actually touched per token: for MoE, only top_k of
+        the moe_experts expert FFNs are active."""
+        total = self.num_params()
+        if self.moe_experts:
+            inactive = ((self.moe_experts - min(self.moe_top_k,
+                                                self.moe_experts))
+                        * 3 * self.dim * self.hidden_dim * self.n_layers)
+            total -= inactive
+        return total
+
     def flops_per_token(self) -> float:
-        """Approx training FLOPs/token (6 * params, attention extra)."""
-        return 6.0 * self.num_params()
+        """Approx training FLOPs/token (6 * active params; counting all
+        experts would overstate MoE MFU by E/top_k)."""
+        return 6.0 * self.active_params_per_token()
 
 
 def llama_init(rng, config: LlamaConfig) -> Dict[str, Any]:
@@ -114,19 +137,31 @@ def llama_init(rng, config: LlamaConfig) -> Dict[str, Any]:
     def stack(key, shape, fan_in):
         return dense(key, (c.n_layers, *shape), fan_in)
 
+    layers: Dict[str, Any] = {
+        "attn_norm": jnp.ones((c.n_layers, c.dim), dtype=c.dtype),
+        "wq": stack(keys[0], (c.dim, c.n_heads * hd), c.dim),
+        "wk": stack(keys[1], (c.dim, c.n_kv_heads * hd), c.dim),
+        "wv": stack(keys[2], (c.dim, c.n_kv_heads * hd), c.dim),
+        "wo": stack(keys[3], (c.n_heads * hd, c.dim), c.n_heads * hd),
+        "mlp_norm": jnp.ones((c.n_layers, c.dim), dtype=c.dtype),
+    }
+    if c.moe_experts:
+        # expert-stacked FFN weights [L, E, ...] + per-layer router
+        layers["router"] = stack(keys[6], (c.dim, c.moe_experts), c.dim)
+        layers["w1"] = stack(keys[4], (c.moe_experts, c.dim, c.hidden_dim),
+                             c.dim)
+        layers["w3"] = stack(keys[5], (c.moe_experts, c.dim, c.hidden_dim),
+                             c.dim)
+        layers["w2"] = stack(
+            jax.random.fold_in(keys[6], 1),
+            (c.moe_experts, c.hidden_dim, c.dim), c.hidden_dim)
+    else:
+        layers["w1"] = stack(keys[4], (c.dim, c.hidden_dim), c.dim)
+        layers["w3"] = stack(keys[5], (c.dim, c.hidden_dim), c.dim)
+        layers["w2"] = stack(keys[6], (c.hidden_dim, c.dim), c.hidden_dim)
     params = {
         "embedding": dense(k_embed, (c.vocab_size, c.dim), c.dim),
-        "layers": {
-            "attn_norm": jnp.ones((c.n_layers, c.dim), dtype=c.dtype),
-            "wq": stack(keys[0], (c.dim, c.n_heads * hd), c.dim),
-            "wk": stack(keys[1], (c.dim, c.n_kv_heads * hd), c.dim),
-            "wv": stack(keys[2], (c.dim, c.n_kv_heads * hd), c.dim),
-            "wo": stack(keys[3], (c.n_heads * hd, c.dim), c.n_heads * hd),
-            "mlp_norm": jnp.ones((c.n_layers, c.dim), dtype=c.dtype),
-            "w1": stack(keys[4], (c.dim, c.hidden_dim), c.dim),
-            "w3": stack(keys[5], (c.dim, c.hidden_dim), c.dim),
-            "w2": stack(keys[6], (c.hidden_dim, c.dim), c.hidden_dim),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((c.dim,), dtype=c.dtype),
         "lm_head": dense(k_head, (c.dim, c.vocab_size), c.dim),
     }
@@ -151,6 +186,21 @@ def _attention(q, k, v, config: LlamaConfig, mesh):
     return _attention_reference(q, k, v, True)
 
 
+def _ffn(layer_params, h, config: LlamaConfig):
+    """FFN output (pre-residual): dense SwiGLU or the MoE layer.
+    Returns (y, aux) — aux is the MoE load-balancing loss (0 if dense)."""
+    c = config
+    if c.moe_experts:
+        from ray_tpu.parallel.moe import moe_ffn
+        return moe_ffn(h, layer_params["router"], layer_params["w1"],
+                       layer_params["w3"], layer_params["w2"],
+                       top_k=c.moe_top_k,
+                       capacity_factor=c.moe_capacity_factor)
+    gate = jax.nn.silu(h @ layer_params["w1"])
+    up = h @ layer_params["w3"]
+    return (gate * up) @ layer_params["w2"], jnp.zeros((), jnp.float32)
+
+
 def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh):
     """One transformer block. Returns (x, (k, v)) — K/V are post-rope,
     the layout the KV cache stores; training callers discard them."""
@@ -166,14 +216,14 @@ def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh):
     attn = _attention(q, k, v, c, mesh)
     x = x + attn.reshape(b, s, c.n_heads * hd) @ layer_params["wo"]
     h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
-    gate = jax.nn.silu(h @ layer_params["w1"])
-    up = h @ layer_params["w3"]
-    x = x + (gate * up) @ layer_params["w2"]
-    return x, (k, v)
+    y, aux = _ffn(layer_params, h, c)
+    return x + y, (k, v), aux
 
 
-def llama_forward(params, tokens, config: LlamaConfig, mesh=None):
-    """tokens: [B, S] int32 -> logits [B, S, vocab] (float32)."""
+def llama_forward(params, tokens, config: LlamaConfig, mesh=None,
+                  return_aux: bool = False):
+    """tokens: [B, S] int32 -> logits [B, S, vocab] (float32).
+    With return_aux, also returns the summed MoE load-balancing loss."""
     c = config
     x = params["embedding"][tokens].astype(c.dtype)
     cos, sin = rope_frequencies(c.head_dim, tokens.shape[1], c.rope_theta)
@@ -182,58 +232,96 @@ def llama_forward(params, tokens, config: LlamaConfig, mesh=None):
     if c.remat:
         block = jax.checkpoint(block)
 
-    def scan_body(x, layer_params):
-        x, _kv = block(layer_params, x, cos, sin)
-        return x, None
+    def scan_body(carry, layer_params):
+        x, aux_sum = carry
+        x, _kv, aux = block(layer_params, x, cos, sin)
+        return (x, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    (x, aux_sum), _ = jax.lax.scan(
+        scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if return_aux:
+        return logits, aux_sum
+    return logits
 
 
 def llama_loss(params, tokens, targets, config: LlamaConfig, mesh=None,
                mask=None):
-    """Next-token cross-entropy. targets: [B, S]; mask: [B, S] float."""
-    logits = llama_forward(params, tokens, config, mesh)
+    """Next-token cross-entropy (+ MoE load-balancing aux when MoE)."""
+    logits, aux = llama_forward(params, tokens, config, mesh,
+                                return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if mask is None:
-        return -jnp.mean(ll)
-    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        loss = -jnp.mean(ll)
+    else:
+        loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    if config.moe_experts:
+        loss = loss + config.moe_aux_weight * aux / config.n_layers
+    return loss
 
 
-def llama_sharding_rules(mode: str = "fsdp_tp") -> ShardingRules:
+def llama_sharding_rules(mode: str = "fsdp_tp",
+                         moe: bool = False) -> ShardingRules:
     """Sharding rules for this parameter tree (leading axis = layers).
 
-    Modes: ddp | fsdp | tp | fsdp_tp — the JaxTrainer's DDP/FSDP/TP
+    Modes: ddp | fsdp | tp | fsdp_tp | ep — the JaxTrainer's DDP/FSDP/TP
     settings lower to these (reference analog:
     train/torch/train_loop_utils.py prepare_model wrapping DDP/FSDP;
-    here it's a declarative mapping instead of a wrapper).
+    here it's a declarative mapping instead of a wrapper). With
+    ``moe=True`` the FFN weights carry a leading expert axis [L,E,..],
+    so the fsdp/tp specs shift right one slot (sharding D/H, never E).
     """
+    # FFN weight specs: (w1/w3 pattern spec, wo/w2 pattern spec) with
+    # an extra None for the expert axis in MoE trees.
+    def ffn(spec_in: P, spec_out: P):
+        if moe:
+            spec_in = P(None, None, *spec_in[1:])
+            spec_out = P(None, None, *spec_out[1:])
+            return [
+                (r"layers/(w1|w3)", spec_in),
+                (r"layers/w2", spec_out),
+                (r"layers/wq|layers/wk|layers/wv",
+                 P(*spec_in[:1], *spec_in[2:])),
+                (r"layers/wo", P(*spec_out[:1], *spec_out[2:])),
+            ]
+        return [
+            (r"layers/(wq|wk|wv|w1|w3)", spec_in),
+            (r"layers/(wo|w2)", spec_out),
+        ]
+
     if mode == "ddp":
         return ShardingRules(rules=[(r".*", P())])
     if mode == "fsdp":
         return ShardingRules(rules=[
             (r"embedding", P("fsdp", None)),
             (r"lm_head", P(None, "fsdp")),
-            (r"layers/(wq|wk|wv|w1|w3)", P(None, "fsdp", None)),
-            (r"layers/(wo|w2)", P(None, None, "fsdp")),
+            *ffn(P(None, "fsdp", None), P(None, None, "fsdp")),
             (r".*", P()),
         ])
     if mode == "tp":
         return ShardingRules(rules=[
             (r"embedding", P(None, "model")),
             (r"lm_head", P(None, "model")),
-            (r"layers/(wq|wk|wv|w1|w3)", P(None, None, "model")),
-            (r"layers/(wo|w2)", P(None, "model", None)),
+            *ffn(P(None, None, "model"), P(None, "model", None)),
             (r".*", P()),
         ])
     if mode == "fsdp_tp":
         return ShardingRules(rules=[
             (r"embedding", P("fsdp", "model")),
             (r"lm_head", P(None, ("fsdp", "model"))),
-            (r"layers/(wq|wk|wv|w1|w3)", P(None, "fsdp", "model")),
-            (r"layers/(wo|w2)", P(None, "model", "fsdp")),
+            *ffn(P(None, "fsdp", "model"), P(None, "model", "fsdp")),
+            (r".*", P()),
+        ])
+    if mode == "ep":
+        # Expert parallelism: expert-stacked FFN weights [L, E, D, H]
+        # partitioned on the "expert" mesh axis; GSPMD turns the MoE
+        # dispatch/combine einsums into all-to-alls (parallel/moe.py).
+        # Attention/router/embeddings replicate (compose with data axis
+        # for the batch).
+        return ShardingRules(rules=[
+            (r"layers/(w1|w2|w3)", P(None, "expert", None, None)),
             (r".*", P()),
         ])
     raise ValueError(f"unknown sharding mode {mode}")
@@ -268,7 +356,8 @@ def llama_prefill(params, tokens, config: LlamaConfig):
     cos, sin = rope_frequencies(hd, tokens.shape[1], c.rope_theta)
 
     def body(x, layer_params):
-        return _block(layer_params, x, cos, sin, c, None)
+        x, kv, _aux = _block(layer_params, x, cos, sin, c, None)
+        return x, kv
 
     x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
@@ -315,9 +404,8 @@ def llama_decode_step(params, token, cache_k, cache_v, pos,
         attn = jnp.einsum("bhqs,bshd->bqhd", weights, vv)
         x = x + attn.reshape(b, 1, c.n_heads * hd) @ layer_params["wo"]
         h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
-        x = x + (jax.nn.silu(h @ layer_params["w1"])
-                 * (h @ layer_params["w3"])) @ layer_params["w2"]
-        return x, (ck, cv)
+        y, _aux = _ffn(layer_params, h, c)  # MoE-aware (decode too)
+        return x + y, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache_k, cache_v))

@@ -1,0 +1,119 @@
+"""Mixture-of-Experts with expert parallelism over the mesh.
+
+The reference has no in-tree MoE (SURVEY §2.3 X4: TP/PP/EP appear only
+as config passthrough to vLLM/DeepSpeed); here expert parallelism is a
+first-class library component, TPU-first: expert weights are sharded on
+the ``expert`` mesh axis and dispatch/combine are einsums over one-hot
+routing masks — under jit, GSPMD partitions the token and expert
+dimensions and inserts the all-to-all collectives over ICI (the
+Mesh-TensorFlow / Switch-Transformer formulation, which is how MoE is
+idiomatically expressed for XLA rather than hand-written sends).
+
+Components:
+- ``top_k_gating``: softmax router → top-k experts per token with
+  renormalized weights and a Switch-style load-balancing aux loss.
+- ``moe_dispatch``/``moe_combine``: capacity-bounded one-hot routing.
+- ``moe_ffn``: the full layer — gate → dispatch → per-expert SwiGLU
+  FFN (batched over the expert axis) → combine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_gating(x: jax.Array, router: jax.Array, k: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Route tokens: returns (gates [T,E], topk_idx [T,k], aux_loss).
+
+    ``x``: [T, D] tokens; ``router``: [D, E]. Gates are zero outside
+    the top-k and renormalized over the selected experts. The aux loss
+    is the Switch load-balancing term E * sum_e(frac_tokens_e *
+    mean_prob_e), minimized at uniform routing.
+    """
+    logits = x.astype(jnp.float32) @ router.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topk_vals, topk_idx = jax.lax.top_k(probs, k)                # [T, k]
+    topk_vals = topk_vals / jnp.maximum(
+        topk_vals.sum(axis=-1, keepdims=True), 1e-9)
+    num_experts = router.shape[-1]
+    gates = jnp.zeros_like(probs).at[
+        jnp.arange(x.shape[0])[:, None], topk_idx].set(topk_vals)
+    # load-balancing aux (Switch Transformer eq. 4-6)
+    top1 = jax.nn.one_hot(topk_idx[:, 0], num_experts)
+    frac_tokens = top1.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = num_experts * jnp.sum(frac_tokens * frac_probs)
+    return gates, topk_idx, aux
+
+
+def moe_dispatch(gates: jax.Array, topk_idx: jax.Array,
+                 num_experts: int, capacity: int
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Build routing masks: (dispatch [T,E,C] one-hot, combine [T,E,C]).
+
+    Each expert accepts at most ``capacity`` tokens; overflow tokens are
+    dropped for that expert (their residual path still carries them —
+    standard capacity-factor semantics).
+    """
+    num_tokens, k = topk_idx.shape
+    dispatch = jnp.zeros((num_tokens, num_experts, capacity),
+                         dtype=gates.dtype)
+    # fill k slots sequentially so earlier (higher-gate) choices claim
+    # capacity first
+    occupancy = jnp.zeros((num_experts,), dtype=jnp.int32)
+    for slot in range(k):
+        expert = topk_idx[:, slot]                           # [T]
+        onehot = jax.nn.one_hot(expert, num_experts,
+                                dtype=jnp.int32)             # [T, E]
+        # position of each token within its chosen expert's buffer
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1
+                         + occupancy[None, :])               # [T, E]
+        pos = jnp.take_along_axis(
+            pos_in_expert, expert[:, None], axis=1)[:, 0]    # [T]
+        keep = pos < capacity
+        pos_clamped = jnp.clip(pos, 0, capacity - 1)
+        pos_onehot = jax.nn.one_hot(pos_clamped, capacity,
+                                    dtype=gates.dtype)       # [T, C]
+        slot_dispatch = (onehot.astype(gates.dtype)[:, :, None]
+                         * pos_onehot[:, None, :]
+                         * keep.astype(gates.dtype)[:, None, None])
+        dispatch = dispatch + slot_dispatch
+        occupancy = occupancy + onehot.sum(axis=0)
+    combine = dispatch * gates[:, :, None]
+    return dispatch, combine
+
+
+def moe_ffn(x: jax.Array, router: jax.Array, w1: jax.Array,
+            w3: jax.Array, w2: jax.Array, *, top_k: int = 2,
+            capacity_factor: float = 2.0
+            ) -> Tuple[jax.Array, jax.Array]:
+    """Full MoE SwiGLU layer.
+
+    ``x``: [B, S, D]; ``router``: [D, E]; expert weights stacked on a
+    leading expert axis — ``w1``/``w3``: [E, D, H], ``w2``: [E, H, D].
+    Shard the expert axis (PartitionSpec("expert", ...)) and GSPMD
+    turns the dispatch/combine einsums into all-to-alls over ICI.
+    Returns (y [B, S, D], aux_loss).
+    """
+    b, s, d = x.shape
+    num_experts = router.shape[-1]
+    tokens = x.reshape(b * s, d)
+    gates, topk_idx, aux = top_k_gating(tokens, router, top_k)
+    capacity = max(1, int(capacity_factor * top_k * (b * s) / num_experts))
+    dispatch, combine = moe_dispatch(gates, topk_idx, num_experts,
+                                     capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    # [T,E,C] x [T,D] -> [E,C,D]: the all-to-all (tokens -> experts)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)
+    # per-expert SwiGLU, batched over the (sharded) expert axis
+    gate = jax.nn.silu(jnp.einsum("ecd,edh->ech", expert_in, w1))
+    up = jnp.einsum("ecd,edh->ech", expert_in, w3)
+    expert_out = jnp.einsum("ech,ehd->ecd", gate * up, w2)
+    # [T,E,C] x [E,C,D] -> [T,D]: the all-to-all back (experts -> tokens)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y.reshape(b, s, d), aux

@@ -203,3 +203,36 @@ def test_arg_embedded_ref_pinned(ray_start_regular):
     del inner2
     gc.collect()
     assert ray_tpu.get(fut) == 42
+
+
+def test_nested_submission_under_pool_cap():
+    """A parent task blocked in get(child) must not deadlock a node
+    whose worker pool is at its cap: blocked workers leave the cap
+    accounting so a replacement spawns (reference: workers blocked in
+    ray.get release their CPU)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=1,
+                      system_config={"task_max_retries": 0,
+                                     "max_workers_per_node": 1})
+
+    @ray_tpu.remote(num_cpus=0)
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote(num_cpus=0)
+    def parent():
+        import ray_tpu as r
+        return r.get(child.remote(41))
+
+    assert ray_tpu.get(parent.remote(), timeout=60) == 42
+
+    # two levels deep for good measure
+    @ray_tpu.remote(num_cpus=0)
+    def grandparent():
+        import ray_tpu as r
+        return r.get(parent.remote()) + 1
+
+    assert ray_tpu.get(grandparent.remote(), timeout=60) == 43
+    ray_tpu.shutdown()
