@@ -63,10 +63,6 @@ class WorkerHandle:
         conn = self.conn
         if conn is None or self.state == DEAD:
             return False
-        if (self.node is not None and msg.get("kind") in
-                ("OBJECT_VALUE", "READY_REPLY", "STREAM_REPLY")):
-            # answering a blocking request: the worker re-enters the pool
-            self.node._mark_unblocked(self)
         try:
             conn.send(msg)
             return True
@@ -317,18 +313,21 @@ class Node:
                 # the worker is blocking: it hands queued specs back for
                 # re-dispatch elsewhere
                 self._on_specs_returned(handle, msg)
-            elif kind in ("GET_OBJECT", "CHECK_READY", "STREAM_NEXT") \
-                    and handle is not None:
-                # The worker is (probably) about to block on this reply:
-                # take it out of the pool-cap accounting so queued work
-                # can still spawn replacements (nested submit+get).
-                self._mark_blocked(handle)
-                if kind == "GET_OBJECT":
-                    self.runtime.handle_get_object(self, handle, msg)
-                elif kind == "CHECK_READY":
-                    self.runtime.handle_check_ready(handle, msg)
-                else:
-                    self.runtime.handle_stream_next(handle, msg)
+            elif kind == "BLOCKED":
+                # the worker reports it is blocking on an object: take
+                # it out of the pool-cap accounting so queued work can
+                # still spawn replacements (nested submit+get)
+                if handle is not None:
+                    self._mark_blocked(handle)
+            elif kind == "UNBLOCKED":
+                if handle is not None:
+                    self._mark_unblocked(handle)
+            elif kind == "GET_OBJECT":
+                self.runtime.handle_get_object(self, handle, msg)
+            elif kind == "CHECK_READY":
+                self.runtime.handle_check_ready(handle, msg)
+            elif kind == "STREAM_NEXT":
+                self.runtime.handle_stream_next(handle, msg)
             elif kind == "SUBMIT":
                 spec = serialization.loads(msg["spec"])
                 self.runtime.submit_spec(spec)
@@ -362,6 +361,10 @@ class Node:
     def _mark_blocked(self, worker: WorkerHandle) -> None:
         spawn = False
         with self._lock:
+            if worker.state == ACTOR:
+                # actor workers already left the pool count at creation;
+                # counting their blocks would drive the cap negative
+                return
             worker.blocked_requests += 1
             if worker.blocked_requests == 1:
                 self._n_blocked[worker.profile] = \
@@ -514,6 +517,12 @@ class Node:
                 # dispatch (serve runs dozens of actors per node).
                 self._n_live[worker.profile] = max(
                     0, self._n_live.get(worker.profile, 0) - 1)
+                if worker.blocked_requests > 0:
+                    # it blocked during __init__: clear the pool-side
+                    # mark too, since actor blocks are no longer counted
+                    worker.blocked_requests = 0
+                    self._n_blocked[worker.profile] = max(
+                        0, self._n_blocked.get(worker.profile, 0) - 1)
                 # This worker's departure may leave queued specs with no
                 # pool worker to drain them.
                 if (self._dispatch_queue.get(worker.profile)
@@ -538,6 +547,9 @@ class Node:
         Batching amortizes the head's per-message cost — the single
         IO thread is the task-throughput ceiling."""
         queue = self._dispatch_queue.get(worker.profile)
+        if worker.blocked_requests > 0:
+            # the worker would only bounce refills while blocked
+            return None
         if queue and len(worker.running) < 32:
             take = min(len(queue), 32 - len(worker.running), 16)
             batch: List[TaskSpec] = []

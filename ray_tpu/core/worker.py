@@ -220,6 +220,16 @@ class WorkerRuntime:
             raise ValueError(
                 f"num_returns ({num_returns}) exceeds the number of refs "
                 f"({len(refs)})")
+        if self.on_block is not None:
+            self.on_block(True)
+            try:
+                return self._wait_inner(refs, num_returns, timeout)
+            finally:
+                self.on_block(False)
+        return self._wait_inner(refs, num_returns, timeout)
+
+    def _wait_inner(self, refs: List[ObjectRef], num_returns: int,
+                    timeout: Optional[float]):
         import time as _time
         deadline = None if timeout is None else _time.monotonic() + timeout
         pending = list(refs)
@@ -532,19 +542,27 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
     blocked_depth = [0]
 
     def on_block(entering: bool) -> None:
+        # Explicit blocked/unblocked reports keep the node's pool-cap
+        # accounting exact even when a get() times out locally (the
+        # node can't infer the unblock from a reply it never sent).
         with pending_cv:
             blocked_depth[0] += 1 if entering else -1
             if not entering:
-                return
-            taken = list(pending)
-            pending.clear()
-            ids = []
-            for spec, collector in taken:
-                ids.append(spec.task_id.binary())
-                if collector is not None:
-                    collector.returned(1)
-        if ids:
+                notify = blocked_depth[0] == 0
+                ids = []
+            else:
+                notify = blocked_depth[0] == 1
+                taken = list(pending)
+                pending.clear()
+                ids = []
+                for spec, collector in taken:
+                    ids.append(spec.task_id.binary())
+                    if collector is not None:
+                        collector.returned(1)
+        if entering and ids:
             conn.send({"kind": "RETURN_SPECS", "task_ids": ids})
+        if notify:
+            conn.send({"kind": "BLOCKED" if entering else "UNBLOCKED"})
 
     rt.on_block = on_block
 

@@ -262,31 +262,24 @@ def llama_loss(params, tokens, targets, config: LlamaConfig, mesh=None,
     return loss
 
 
-def llama_sharding_rules(mode: str = "fsdp_tp",
-                         moe: bool = False) -> ShardingRules:
+def llama_sharding_rules(mode: str = "fsdp_tp") -> ShardingRules:
     """Sharding rules for this parameter tree (leading axis = layers).
 
     Modes: ddp | fsdp | tp | fsdp_tp | ep — the JaxTrainer's DDP/FSDP/TP
     settings lower to these (reference analog:
     train/torch/train_loop_utils.py prepare_model wrapping DDP/FSDP;
-    here it's a declarative mapping instead of a wrapper). With
-    ``moe=True`` the FFN weights carry a leading expert axis [L,E,..],
-    so the fsdp/tp specs shift right one slot (sharding D/H, never E).
+    here it's a declarative mapping instead of a wrapper). MoE trees
+    need no flag: ndim-constrained rule variants shard the 4-D
+    expert-stacked FFN weights on D/H (never the expert axis).
     """
-    # FFN weight specs: (w1/w3 pattern spec, wo/w2 pattern spec) with
-    # an extra None for the expert axis in MoE trees.
     def ffn(spec_in: P, spec_out: P):
-        if moe:
-            spec_in = P(None, None, *spec_in[1:])
-            spec_out = P(None, None, *spec_out[1:])
-            return [
-                (r"layers/(w1|w3)", spec_in),
-                (r"layers/w2", spec_out),
-                (r"layers/wq|layers/wk|layers/wv",
-                 P(*spec_in[:1], *spec_in[2:])),
-                (r"layers/wo", P(*spec_out[:1], *spec_out[2:])),
-            ]
+        # 4-D variants for MoE expert-stacked weights [L, E, D, H]
+        # (matched by ndim, so dense 3-D weights fall through).
+        moe_in = P(None, None, *spec_in[1:])
+        moe_out = P(None, None, *spec_out[1:])
         return [
+            (r"layers/(w1|w3)", moe_in, 4),
+            (r"layers/w2", moe_out, 4),
             (r"layers/(wq|wk|wv|w1|w3)", spec_in),
             (r"layers/(wo|w2)", spec_out),
         ]

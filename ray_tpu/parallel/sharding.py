@@ -30,7 +30,13 @@ class ShardingRules:
     default: P = P()
 
     def spec_for(self, path: str, ndim: int) -> P:
-        for pattern, spec in self.rules:
+        for rule in self.rules:
+            if len(rule) == 3:
+                pattern, spec, want_ndim = rule
+                if want_ndim != ndim:
+                    continue  # ndim-constrained rule for another shape
+            else:
+                pattern, spec = rule
             if re.search(pattern, path):
                 if len(spec) > ndim:
                     # Drop trailing axes that don't exist on this param.

@@ -6,13 +6,22 @@ reference: python/ray/train/v2 — DataParallelTrainer
 (v2/jax/jax_trainer.py:19), report/get_checkpoint train-fn utils
 (api/train_fn_utils.py)."""
 
-from ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    load_pytree,
+    load_sharded_state,
+    reshard_states,
+    save_pytree,
+    save_sharded_state,
+)
 from ray_tpu.train.config import (
     CheckpointConfig,
+    ElasticScalingPolicy,
     FailureConfig,
     Result,
     RunConfig,
     ScalingConfig,
+    ScalingPolicy,
 )
 from ray_tpu.train.context import (
     TrainContext,

@@ -29,6 +29,21 @@ class ScalingConfig:
     # TPU generation, e.g. "TPU-V4" / "TPU-V5P"
     accelerator_type: Optional[str] = None
     placement_strategy: str = "SPREAD"
+    # Elastic training: with min_workers set, a worker-group failure
+    # rebuilds the gang at whatever size the cluster can still schedule
+    # (>= min_workers) instead of failing, resuming from the last
+    # checkpoint (reference: Resizing state + scaling policies,
+    # train/v2/_internal/execution/controller/state.py:125).
+    min_workers: Optional[int] = None
+    scaling_policy: Optional[Any] = None
+
+    def resolved_scaling_policy(self):
+        if self.scaling_policy is not None:
+            return self.scaling_policy
+        if self.min_workers is not None:
+            return ElasticScalingPolicy(self.min_workers,
+                                        self.worker_resources())
+        return ScalingPolicy()
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker)
@@ -36,6 +51,53 @@ class ScalingConfig:
             res.setdefault("TPU", float(self.tpu_chips_per_worker))
         res.setdefault("CPU", 1.0)
         return res
+
+
+class ScalingPolicy:
+    """Decides the gang size after a failure (reference:
+    train/v2/_internal/execution/scaling_policy/ + the Resizing
+    controller state, controller/state.py:125). Return None to stop
+    retrying at a new size (the failure policy's max_failures still
+    governs same-size retries)."""
+
+    def world_size_after_failure(self, current_world: int,
+                                 runtime) -> "int | None":
+        return current_world  # fixed-size: retry at the same size
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Shrink to what the cluster can currently schedule, bounded below
+    by ``min_workers`` — the elastic-training shape: lose a host, keep
+    training smaller from the last checkpoint."""
+
+    def __init__(self, min_workers: int, resources_per_worker=None):
+        self.min_workers = min_workers
+        self.resources_per_worker = dict(resources_per_worker or {})
+
+    def world_size_after_failure(self, current_world: int,
+                                 runtime) -> "int | None":
+        # The dead gang's resource releases land asynchronously (worker
+        # kills are observed by node IO threads); poll briefly and take
+        # the best feasible size seen instead of aborting on a
+        # transiently-empty cluster.
+        import time as _time
+
+        best = 0
+        deadline = _time.monotonic() + 3.0
+        while _time.monotonic() < deadline:
+            available = runtime.available_resources()
+            feasible = current_world
+            for key, need in self.resources_per_worker.items():
+                if need > 0:
+                    feasible = min(feasible,
+                                   int(available.get(key, 0.0) // need))
+            best = max(best, min(feasible, current_world))
+            if best >= current_world:
+                break
+            _time.sleep(0.1)
+        if best < self.min_workers:
+            return None
+        return best
 
 
 @dataclass

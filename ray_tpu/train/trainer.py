@@ -24,6 +24,7 @@ import traceback
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core import runtime as runtime_mod
 from ray_tpu.core import serialization
 from ray_tpu.exceptions import ActorError, RayTpuError, TaskError, WorkerCrashedError
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
@@ -148,13 +149,19 @@ class JaxTrainer:
                         if self.datasets else None)
         last_error: Optional[Exception] = None
 
+        policy = self.scaling_config.resolved_scaling_policy()
+        world = self.scaling_config.num_workers
         for attempt in range(max_failures + 1):
             self._transition("SCHEDULING" if attempt == 0 else "RESTARTING")
             try:
-                workers, pg, reservation = self._create_worker_group(storage)
+                workers, pg, reservation = self._create_worker_group(
+                    storage, world)
             except (ActorError, WorkerCrashedError, TaskError, RayTpuError,
                     TimeoutError, RuntimeError) as e:
                 last_error = e
+                world = self._resize_after_failure(policy, world)
+                if world is None:
+                    break
                 continue
             resume = manager.latest()
             try:
@@ -181,13 +188,34 @@ class JaxTrainer:
                     remove_placement_group(pg)
                 if reservation is not None:
                     reservation.release()
+            # Decide the next gang size only after the failed group's
+            # reservations are released — the policy reads available
+            # cluster resources.
+            world = self._resize_after_failure(policy, world)
+            if world is None:
+                break
         self._transition("ERRORED")
         final = manager.latest()
         return Result(metrics={}, checkpoint=final, path=storage,
                       error=last_error)
 
-    def _create_worker_group(self, storage: str):
+    def _resize_after_failure(self, policy, world: int):
+        """Scaling-policy hook: pick the next gang size (None = stop).
+        A shrink is the elastic Resizing transition; training resumes
+        from the last checkpoint at the new world size."""
+        new_world = policy.world_size_after_failure(
+            world, runtime_mod.get_runtime())
+        if new_world is None or new_world < 1:
+            return None
+        if new_world != world:
+            self._transition("RESIZING")
+        return new_world
+
+    def _create_worker_group(self, storage: str,
+                             num_workers: Optional[int] = None):
         scaling = self.scaling_config
+        if num_workers is None:
+            num_workers = scaling.num_workers
         res = scaling.worker_resources()
         # Multi-host slice gang: reserve a whole slice via its head
         # resource, then pin every worker to that slice's hosts with the
@@ -209,16 +237,16 @@ class JaxTrainer:
         pg = None
         strategy = (("STRICT_SPREAD" if slice_name
                      else scaling.placement_strategy)
-                    if scaling.num_workers > 1 else "PACK")
+                    if num_workers > 1 else "PACK")
         try:
-            pg = placement_group([dict(res)] * scaling.num_workers,
+            pg = placement_group([dict(res)] * num_workers,
                                  strategy=strategy)
         except Exception:
             pg = None
         group_name = f"train/{os.path.basename(storage)}/{time.time_ns()}"
         WorkerActor = ray_tpu.remote(_TrainWorker)
         workers = []
-        for rank in range(scaling.num_workers):
+        for rank in range(num_workers):
             opts = {"num_cpus": res.get("CPU", 1)}
             if "TPU" in res:
                 opts["num_tpus"] = res["TPU"]
@@ -235,21 +263,30 @@ class JaxTrainer:
                         placement_group=pg,
                         placement_group_bundle_index=rank)
             env = None
-            if scaling.num_workers > 1 and scaling.use_tpu:
+            if num_workers > 1 and scaling.use_tpu:
                 # coordinator_address resolves inside the gang: rank 0
                 # binds locally and publishes via the GCS KV (see
                 # _TrainWorker) — the head can't pick it, because on a
                 # real pod rank 0 lives on a slice host, not here.
-                env = {"num_processes": scaling.num_workers,
+                env = {"num_processes": num_workers,
                        "process_id": rank}
             workers.append(
                 WorkerActor.options(**opts).remote(
-                    rank, scaling.num_workers, storage, group_name,
+                    rank, num_workers, storage, group_name,
                     jax_env=env))
-        # Fail fast if any worker can't construct.
+        # Fail fast if any worker can't construct — and release every
+        # reservation on the way out, or the next (resized) attempt sees
+        # the failed gang still holding the cluster's resources.
         try:
             ray_tpu.get([w.ping.remote() for w in workers])
         except BaseException:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            if pg is not None:
+                remove_placement_group(pg)
             if slice_reservation is not None:
                 slice_reservation.release()
             raise

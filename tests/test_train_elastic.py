@@ -1,0 +1,142 @@
+"""Elastic training + sharded checkpoints.
+
+Reference models: train/v2 Resizing controller state + scaling policies
+(controller/state.py:116-125, execution/scaling_policy/) and orbax-style
+async sharded checkpointing (SURVEY §5.4).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    ElasticScalingPolicy,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    load_sharded_state,
+    reshard_states,
+    save_sharded_state,
+)
+
+
+def test_sharded_save_load_reshard(tmp_path):
+    """Per-rank shards round-trip and re-partition for a new world size."""
+    d = str(tmp_path / "ckpt")
+    full = np.arange(12, dtype=np.float64)
+    shards = np.array_split(full, 4)
+    threads = []
+    for rank in range(4):
+        t = save_sharded_state(d, rank, 4, {"w": shards[rank],
+                                            "step": rank},
+                               background=(rank % 2 == 0))
+        if t is not None:
+            threads.append(t)
+    for t in threads:
+        t.join()
+    states = load_sharded_state(d)
+    assert len(states) == 4
+    merged = np.concatenate([s["w"] for s in states])
+    np.testing.assert_array_equal(merged, full)
+    # reshard 4 -> 3 (arrays re-split on axis 0; non-arrays like 'step'
+    # are re-split too, so drop them first for the default policy)
+    arr_states = [{"w": s["w"]} for s in states]
+    new = reshard_states(arr_states, 3)
+    assert len(new) == 3
+    np.testing.assert_array_equal(
+        np.concatenate([s["w"] for s in new]), full)
+
+
+def test_elastic_resume_at_smaller_world(tmp_path):
+    """VERDICT item 8 done-criterion: kill one worker of 4 mid-run; the
+    controller resizes to world 3 and resumes from the last sharded
+    checkpoint."""
+    from ray_tpu.core.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"resources": {"CPU": 2}},
+                      system_config={"task_max_retries": 0})
+    nodes = []
+    for _ in range(4):
+        nodes.append(cluster.add_node(
+            num_cpus=2, resources={"trainslot": 1.0}))
+    storage = str(tmp_path / "run")
+
+    def train_loop(config):
+        import numpy as np
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        world = ctx.get_world_size()
+        rank = ctx.get_world_rank()
+        ckpt_dir = os.path.join(ctx.storage_path, "sharded")
+        start_step = 0
+        full_dim = 12
+        states = train.load_sharded_state(ckpt_dir, timeout=1.0)
+        if states is not None:
+            # reshard the previous gang's shards for THIS world size
+            # (all shards are from ONE complete step — per-step dirs)
+            start_step = states[0]["step"]
+            arrays = [{"w": s["w"]} for s in states]
+            mine = train.reshard_states(arrays, world)[rank]["w"]
+        else:
+            mine = np.array_split(
+                np.zeros(full_dim), world)[rank]
+        save_thread = None
+        for step in range(start_step, 10):
+            mine = mine + 1.0  # "training"
+            if rank == 0 and step == 4 and world == 4:
+                # crash the gang mid-run after a checkpoint exists
+                time.sleep(0.3)
+                os._exit(1)
+            if save_thread is not None:
+                save_thread.join()
+            save_thread = train.save_sharded_state(
+                ckpt_dir, rank, world, {"w": mine, "step": step + 1},
+                step=step + 1, background=True)
+            train.report({"step": step, "world": world, "rank": rank})
+            time.sleep(0.05)
+        if save_thread is not None:
+            save_thread.join()
+        train.report({"done": True, "world": world})
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(
+            num_workers=4, min_workers=2,
+            resources_per_worker={"trainslot": 1.0, "CPU": 1.0}),
+        run_config=RunConfig(name="elastic", storage_path=storage,
+                             failure_config=FailureConfig(max_failures=2)))
+
+    # Kill rank 0's NODE shortly after the run starts so the cluster can
+    # only schedule 3 workers afterwards (the elastic policy shrinks).
+    def chaos():
+        time.sleep(1.0)
+        # rank 0's worker crashed itself (os._exit); also remove one
+        # node so only 3 trainslots remain
+        cluster.remove_node(nodes[0])
+
+    killer = threading.Thread(target=chaos, daemon=True)
+    killer.start()
+    try:
+        result = trainer.fit()
+        assert result.error is None, result.error
+        finals = [reports[-1][0] for reports in result.all_reports]
+        # resumed gang ran at world 3
+        assert all(m["world"] == 3 for m in finals)
+        assert len(finals) == 3
+        assert "RESIZING" in trainer.state_history
+        # the checkpointed state survived: total "training" progress
+        # accumulated across the resize (10 steps of +1 over 12 elems,
+        # modulo the in-flight step lost at the crash)
+        states = load_sharded_state(os.path.join(result.path, "sharded"))
+        assert states is not None and len(states) == 3
+        merged = np.concatenate([s["w"] for s in states])
+        assert merged.shape == (12,)
+        assert float(merged.min()) >= 9.0  # every element trained
+    finally:
+        cluster.shutdown()
