@@ -86,6 +86,13 @@ class Config:
     # (reference: pull_manager.h:50 admission control).
     object_pull_concurrency: int = 8
 
+    # --- GCS durability ---
+    # Journal file for control-plane state (KV, jobs, functions): a new
+    # head started with the same path replays it (reference:
+    # Redis-backed GCS fault tolerance, redis_store_client.h). Empty
+    # disables persistence.
+    gcs_persistence_path: str = ""
+
     # --- lineage / spilling ---
     # Completed stateless task specs retained for object reconstruction
     # (reference: max_lineage_bytes, task_manager.h:184). 0 disables.

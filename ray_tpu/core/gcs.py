@@ -85,13 +85,19 @@ class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.cc,
     python/ray/experimental/internal_kv.py)."""
 
-    def __init__(self):
+    def __init__(self, on_change=None):
         self._data: Dict[Tuple[str, bytes], bytes] = {}
         self._lock = threading.Lock()
+        # persistence hook: feeds the durability journal when set
+        self._on_change = on_change
 
     def put(self, key: bytes, value: bytes, namespace: str = "") -> None:
         with self._lock:
             self._data[(namespace, key)] = value
+            # hook fires under the lock: the journal must record
+            # same-key mutations in their in-memory apply order
+            if self._on_change is not None:
+                self._on_change("put", (namespace, key), value)
 
     def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
         with self._lock:
@@ -99,7 +105,10 @@ class KVStore:
 
     def delete(self, key: bytes, namespace: str = "") -> bool:
         with self._lock:
-            return self._data.pop((namespace, key), None) is not None
+            existed = self._data.pop((namespace, key), None) is not None
+            if existed and self._on_change is not None:
+                self._on_change("del", (namespace, key), None)
+        return existed
 
     def keys(self, prefix: bytes = b"", namespace: str = "") -> List[bytes]:
         with self._lock:
@@ -140,9 +149,22 @@ class Pubsub:
 
 
 class Gcs:
-    def __init__(self):
+    def __init__(self, store=None):
+        """``store``: optional FileStoreClient for control-plane
+        durability — the KV store, job records, and the function store
+        are journaled and replayed on restart (reference: Redis-backed
+        GCS + gcs_init_data.cc replay). Node/actor tables are not:
+        their processes die with the head."""
         self.lock = threading.RLock()
-        self.kv = KVStore()
+        self.store = store
+
+        def kv_change(op, key, value):
+            if op == "put":
+                store.put("kv", key, value)
+            else:
+                store.delete("kv", key)
+
+        self.kv = KVStore(on_change=kv_change if store else None)
         self.pubsub = Pubsub()
         self.nodes: Dict[NodeID, NodeRecord] = {}
         self.actors: Dict[ActorID, ActorRecord] = {}
@@ -152,6 +174,22 @@ class Gcs:
         self.functions: Dict[str, bytes] = {}  # function/class store
         cfg = get_config()
         self.task_events: deque = deque(maxlen=cfg.task_events_buffer_size)
+        if store is not None:
+            self._restore_from_store()
+
+    def _restore_from_store(self) -> None:
+        """Replay the durability journal into the fresh tables
+        (reference: gcs_init_data.cc loading all tables on GCS start).
+        Node/actor records are NOT restored — processes died with the
+        previous head; only control-plane state that outlives processes
+        (KV, jobs, functions) comes back."""
+        for key, value in self.store.items("kv").items():
+            namespace, k = key
+            self.kv._data[(namespace, k)] = value
+        for job_id_bin, record in self.store.items("jobs").items():
+            self.jobs[JobID(job_id_bin)] = record
+        for function_id, blob in self.store.items("functions").items():
+            self.functions[function_id] = blob
 
     # --- nodes ---------------------------------------------------------
     def register_node(self, record: NodeRecord) -> None:
@@ -180,6 +218,8 @@ class Gcs:
     def put_function(self, function_id: str, blob: bytes) -> None:
         with self.lock:
             self.functions[function_id] = blob
+        if self.store is not None:
+            self.store.put("functions", function_id, blob)
 
     def get_function(self, function_id: str) -> Optional[bytes]:
         with self.lock:
@@ -237,6 +277,8 @@ class Gcs:
     def register_job(self, record: JobRecord) -> None:
         with self.lock:
             self.jobs[record.job_id] = record
+        if self.store is not None:
+            self.store.put("jobs", record.job_id.binary(), record)
 
     # --- placement groups ----------------------------------------------
     def register_placement_group(self, record: PlacementGroupRecord) -> None:

@@ -1,0 +1,115 @@
+"""Pluggable GCS storage: in-memory or file-backed journal.
+
+Capability parity with the reference's GCS store clients
+(reference: src/ray/gcs/store_client/in_memory_store_client.h and
+redis_store_client.h — Redis gives the reference GCS fault tolerance;
+state is replayed on restart via gcs_init_data.cc). Here the durable
+backend is an append-only journal file with snapshot compaction: every
+table mutation appends one record; on restart the journal replays into
+a fresh Gcs, so control-plane state (KV, jobs, functions, named actors)
+survives the head process.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+
+class FileStoreClient:
+    """Append-only journal of (table, op, key, value) records."""
+
+    COMPACT_EVERY = 5000  # appended ops between snapshot compactions
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._lock = threading.Lock()
+        self._state: Dict[str, Dict[Any, Any]] = {}
+        if os.path.exists(path):
+            self._replay_into_state()
+        self._file = open(path, "ab")
+        self._ops_since_compact = 0
+
+    # --- write path -----------------------------------------------------
+    def put(self, table: str, key: Any, value: Any) -> None:
+        blob = pickle.dumps(("put", table, key, value), protocol=5)
+        with self._lock:
+            # state first: compaction (triggered below) rewrites the
+            # journal FROM state, so the triggering record must already
+            # be applied or it would vanish from disk
+            self._state.setdefault(table, {})[key] = value
+            self._append_locked(blob)
+
+    def delete(self, table: str, key: Any) -> None:
+        blob = pickle.dumps(("del", table, key, None), protocol=5)
+        with self._lock:
+            self._state.get(table, {}).pop(key, None)
+            self._append_locked(blob)
+
+    def _append_locked(self, blob: bytes) -> None:
+        self._file.write(len(blob).to_bytes(4, "little") + blob)
+        self._file.flush()
+        self._ops_since_compact += 1
+        if self._ops_since_compact >= self.COMPACT_EVERY:
+            self._compact_locked()
+
+    # --- read path ------------------------------------------------------
+    def get(self, table: str, key: Any) -> Optional[Any]:
+        with self._lock:
+            return self._state.get(table, {}).get(key)
+
+    def items(self, table: str) -> Dict[Any, Any]:
+        with self._lock:
+            return dict(self._state.get(table, {}))
+
+    def tables(self) -> Dict[str, Dict[Any, Any]]:
+        with self._lock:
+            return {t: dict(entries) for t, entries in self._state.items()}
+
+    # --- journal mechanics ----------------------------------------------
+    def _iter_journal(self) -> Iterator[Tuple]:
+        with open(self.path, "rb") as f:
+            while True:
+                header = f.read(4)
+                if len(header) < 4:
+                    return
+                length = int.from_bytes(header, "little")
+                blob = f.read(length)
+                if len(blob) < length:
+                    return  # torn tail write (crash mid-append): drop it
+                try:
+                    yield pickle.loads(blob)
+                except Exception:  # noqa: BLE001 — corrupt record
+                    return
+
+    def _replay_into_state(self) -> None:
+        for record in self._iter_journal():
+            op, table, key, value = record
+            if op == "put":
+                self._state.setdefault(table, {})[key] = value
+            elif op == "del":
+                self._state.get(table, {}).pop(key, None)
+
+    def _compact_locked(self) -> None:
+        """Rewrite the journal as one snapshot of the live state."""
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            for table, entries in self._state.items():
+                for key, value in entries.items():
+                    blob = pickle.dumps(("put", table, key, value),
+                                        protocol=5)
+                    f.write(len(blob).to_bytes(4, "little") + blob)
+        self._file.close()
+        os.replace(tmp, self.path)
+        self._file = open(self.path, "ab")
+        self._ops_since_compact = 0
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._file.close()
+            except OSError:
+                pass

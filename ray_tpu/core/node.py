@@ -288,6 +288,16 @@ class Node:
                            msg: dict) -> Optional[WorkerHandle]:
             kind = msg["kind"]
             if kind == "REGISTER":
+                from ray_tpu.core.protocol import PROTOCOL_VERSION
+                peer_version = msg.get("proto_version", 0)
+                if peer_version != PROTOCOL_VERSION:
+                    # version skew (e.g. a stale worker binary): reject
+                    # cleanly instead of failing on message shapes later
+                    conn.send({"kind": "SHUTDOWN",
+                               "reason": f"protocol version mismatch: "
+                                         f"head={PROTOCOL_VERSION} "
+                                         f"worker={peer_version}"})
+                    return handle
                 worker_id = WorkerID(msg["worker_id"])
                 with self._lock:
                     handle = self._workers.get(worker_id)
@@ -305,6 +315,10 @@ class Node:
                     self._idle[handle.profile].append(handle)
                 handle.registered.set()
                 self._pump()
+            elif handle is None:
+                # unregistered (or version-rejected) connection: ignore
+                # everything but REGISTER — handlers dereference handle
+                return handle
             elif kind == "TASK_DONE":
                 self._on_task_done(handle, msg)
             elif kind == "TASK_DONE_BATCH":
@@ -335,6 +349,8 @@ class Node:
                 self.runtime.on_worker_put(self, msg)
             elif kind == "STREAM_ITEM":
                 self.runtime.on_stream_item(self, msg)
+            elif kind == "SUBSCRIBE":
+                self.runtime.handle_subscribe(self, handle, msg)
             elif kind == "SPILL_REQUEST":
                 self.runtime.handle_spill_request(self, handle, msg)
             elif kind == "GCS_REQUEST":

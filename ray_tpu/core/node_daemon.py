@@ -104,6 +104,11 @@ class HeadProxy:
                    "object_ids": msg["object_ids"],
                    "req_id": msg.get("req_id")})
 
+    def handle_subscribe(self, node, handle, msg: dict) -> None:
+        self.send({"kind": "SUBSCRIBE",
+                   "worker_id": handle.worker_id.binary(),
+                   "channel": msg["channel"]})
+
     def handle_spill_request(self, node, handle, msg: dict) -> None:
         self.send({"kind": "SPILL_REQUEST",
                    "worker_id": handle.worker_id.binary(),
@@ -168,8 +173,10 @@ class NodeDaemon:
         self._advertise = advertise_host or get_config().head_host
         self.object_server = ObjectServer(self._resolve_store,
                                           host=self._advertise)
+        from ray_tpu.core.protocol import PROTOCOL_VERSION
         self.conn.send({
             "kind": "NODE_REGISTER",
+            "proto_version": PROTOCOL_VERSION,
             "node_id": self.node_id.binary(),
             "resources": resources,
             "labels": dict(labels or {}),
@@ -178,7 +185,8 @@ class NodeDaemon:
         })
         reply = self.conn.recv()
         if reply is None or reply.get("kind") != "REGISTERED":
-            raise RuntimeError("head rejected node registration")
+            reason = (reply or {}).get("reason", "connection closed")
+            raise RuntimeError(f"head rejected node registration: {reason}")
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, name="heartbeat", daemon=True)
         self._heartbeat_thread.start()

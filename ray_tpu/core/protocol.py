@@ -23,6 +23,13 @@ from ray_tpu.core import serialization
 
 _LEN = struct.Struct("<I")
 
+# Wire-schema version (reference: protocol versioning in the gRPC
+# schema, src/ray/protobuf/). Carried in the REGISTER / NODE_REGISTER
+# handshakes; a mismatched peer is rejected cleanly instead of failing
+# on an unknown/renamed message mid-stream. Bump on any incompatible
+# message-shape change.
+PROTOCOL_VERSION = 1
+
 
 def _send_all(sock: socket.socket, data: bytes) -> None:
     """sendall that also works on non-blocking sockets (the node's

@@ -210,6 +210,14 @@ class HeadServer:
                 if node is None:
                     if msg.get("kind") != "NODE_REGISTER":
                         break
+                    from ray_tpu.core.protocol import PROTOCOL_VERSION
+                    peer_version = msg.get("proto_version", 0)
+                    if peer_version != PROTOCOL_VERSION:
+                        conn.send({"kind": "REGISTER_REJECTED",
+                                   "reason": "protocol version mismatch: "
+                                             f"head={PROTOCOL_VERSION} "
+                                             f"daemon={peer_version}"})
+                        break
                     node = self.runtime.register_remote_node(conn, msg)
                     conn.send({"kind": "REGISTERED"})
                 else:
@@ -261,6 +269,9 @@ class HeadServer:
         elif kind == "CHECK_READY":
             worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
             rt.handle_check_ready(worker, msg)
+        elif kind == "SUBSCRIBE":
+            worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
+            rt.handle_subscribe(node, worker, msg)
         elif kind == "SPILL_REQUEST":
             worker = RemoteWorkerStub(node, WorkerID(msg["worker_id"]))
             rt.handle_spill_request(node, worker, msg)

@@ -99,7 +99,12 @@ class DriverRuntime:
                  system_config: Optional[dict] = None,
                  namespace: str = ""):
         reset_config(system_config)
-        self.gcs = Gcs()
+        cfg = get_config()
+        store = None
+        if cfg.gcs_persistence_path:
+            from ray_tpu.core.gcs_store import FileStoreClient
+            store = FileStoreClient(cfg.gcs_persistence_path)
+        self.gcs = Gcs(store=store)
         self.scheduler = ClusterScheduler(self.gcs)
         self.task_manager = TaskManager()
         self.reference_counter = ReferenceCounter()
@@ -112,6 +117,9 @@ class DriverRuntime:
         # streaming-task yields (reference: _raylet.pyx:299)
         self._streams: Dict[TaskID, StreamState] = {}
         self._streams_lock = threading.Lock()
+        # pubsub push routes per worker, removed at death
+        self._worker_subs: Dict[tuple, list] = {}
+        self._worker_subs_lock = threading.Lock()
         # Lineage: specs of completed stateless tasks, kept (bounded
         # LRU) so lost objects can be reconstructed by re-execution
         # (reference: task_manager.h:175 lineage + max_lineage_bytes;
@@ -247,6 +255,7 @@ class DriverRuntime:
         self.nodes.pop(node_id, None)
         self.scheduler.remove_node(node_id)
         self.gcs.mark_node_dead(node_id)
+        self._drop_worker_subscriptions(node_id)
         node.close()
         # Replica bookkeeping: drop copies on the dead node; objects whose
         # primary lived there survive if any replica exists.
@@ -922,6 +931,8 @@ class DriverRuntime:
     def on_worker_crashed(self, node: Node, worker, running: List[TaskSpec],
                           actor_id: Optional[ActorID]) -> None:
         cfg = get_config()
+        self._drop_worker_subscriptions(node.node_id,
+                                        worker.worker_id.binary())
         for spec in running:
             if not spec.is_actor_creation and spec.actor_id is None:
                 self.scheduler.release(node.node_id, self._spec_resources(spec))
@@ -1512,6 +1523,51 @@ class DriverRuntime:
         worker.send({"kind": "READY_REPLY", "req_id": msg.get("req_id"),
                      "ready": ready})
 
+    def subscribe_channel(self, channel: str, callback) -> None:
+        """Driver-side pubsub subscription (workers reach the same
+        publisher through SUBSCRIBE messages; reference: publisher.h:245
+        long-poll push — here a direct push over the worker socket)."""
+        self.gcs.pubsub.subscribe(channel, callback)
+
+    def publish_channel(self, channel: str, message: Any) -> None:
+        self.gcs.pubsub.publish(channel, message)
+
+    def handle_subscribe(self, node, worker, msg: dict) -> None:
+        """A worker subscribed to a pubsub channel: push every publish
+        to its socket. Routes are tracked per worker so death cleanup
+        removes them (a remote worker's stub send can't observe its
+        death — the daemon connection stays alive)."""
+        channel = msg["channel"]
+
+        def push(payload):
+            ok = worker.send({"kind": "PUBSUB_MSG", "channel": channel,
+                              "data": serialization.dumps(payload)})
+            if not ok:
+                self.gcs.pubsub.unsubscribe(channel, push)
+
+        key = (node.node_id, worker.worker_id.binary())
+        with self._worker_subs_lock:
+            self._worker_subs.setdefault(key, []).append((channel, push))
+        self.gcs.pubsub.subscribe(channel, push)
+
+    def _drop_worker_subscriptions(self, node_id: NodeID,
+                                   worker_id_bytes: Optional[bytes] = None
+                                   ) -> None:
+        """Unsubscribe a dead worker's (or a dead node's every worker's)
+        pubsub push routes."""
+        with self._worker_subs_lock:
+            if worker_id_bytes is not None:
+                doomed = {(node_id, worker_id_bytes):
+                          self._worker_subs.pop(
+                              (node_id, worker_id_bytes), [])}
+            else:
+                doomed = {k: self._worker_subs.pop(k)
+                          for k in [k for k in self._worker_subs
+                                    if k[0] == node_id]}
+        for subs in doomed.values():
+            for channel, push in subs:
+                self.gcs.pubsub.unsubscribe(channel, push)
+
     def handle_gcs_request(self, worker, msg: dict) -> None:
         method = msg["method"]
         args = serialization.loads(msg["args"])
@@ -1554,6 +1610,9 @@ class DriverRuntime:
             return self.cluster_resources()
         if method == "available_resources":
             return self.available_resources()
+        if method == "publish":
+            self.gcs.pubsub.publish(args[0], serialization.loads(args[1]))
+            return True
         if method == "metrics_apply":
             from ray_tpu.util.metrics import _registry
             kind, name, tag_items, value, boundaries = args
@@ -1660,4 +1719,6 @@ class DriverRuntime:
         for node in list(self.nodes.values()):
             node.stop()
         self.nodes.clear()
+        if self.gcs.store is not None:
+            self.gcs.store.close()
         set_runtime(None)

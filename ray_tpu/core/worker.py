@@ -59,6 +59,7 @@ class WorkerRuntime:
         # set by worker_main: flushes queued specs back to the node
         # before this worker blocks on an object
         self.on_block = None
+        self._pubsub_callbacks: Dict[str, list] = {}
         self._req_lock = threading.Lock()
         self._req_counter = 0
         self._replies: Dict[int, Tuple[threading.Event, list]] = {}
@@ -287,6 +288,32 @@ class WorkerRuntime:
             return "done", None
         return "error", serialization.loads(reply["error"])
 
+    # --- pubsub ----------------------------------------------------------
+    def subscribe_channel(self, channel: str, callback) -> None:
+        """Subscribe to a GCS pubsub channel from inside a worker
+        (reference: subscriber.h:215 — workers couldn't subscribe in
+        round 1). Callbacks run on the worker's socket-reader thread;
+        keep them fast."""
+        with self._req_lock:
+            first = channel not in self._pubsub_callbacks
+            self._pubsub_callbacks.setdefault(channel, []).append(callback)
+        if first:
+            self.conn.send({"kind": "SUBSCRIBE", "channel": channel})
+
+    def publish_channel(self, channel: str, message: Any) -> None:
+        self.gcs_call("publish", channel, serialization.dumps(message))
+
+    def _on_pubsub(self, msg: dict) -> None:
+        with self._req_lock:
+            callbacks = list(self._pubsub_callbacks.get(msg["channel"], ()))
+        payload = serialization.loads(msg["data"])
+        for cb in callbacks:
+            try:
+                cb(payload)
+            except Exception:  # noqa: BLE001 — user callback
+                import traceback
+                traceback.print_exc()
+
     # --- control plane --------------------------------------------------
     def gcs_call(self, method: str, *args) -> Any:
         reply = self.request({"kind": "GCS_REQUEST", "method": method,
@@ -503,8 +530,9 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
     from ray_tpu.core import runtime as runtime_mod
     runtime_mod.set_runtime(rt)
 
+    from ray_tpu.core.protocol import PROTOCOL_VERSION
     conn.send({"kind": "REGISTER", "worker_id": worker_id.binary(),
-               "pid": os.getpid()})
+               "pid": os.getpid(), "proto_version": PROTOCOL_VERSION})
 
     exec_pool = ThreadPoolExecutor(max_workers=1)
     pool_lock = threading.Lock()
@@ -680,6 +708,8 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
         elif kind in ("OBJECT_VALUE", "GCS_REPLY", "READY_REPLY",
                       "STREAM_REPLY", "SPILL_REPLY"):
             rt.deliver_reply(msg)
+        elif kind == "PUBSUB_MSG":
+            rt._on_pubsub(msg)
         elif kind == "SHUTDOWN":
             break
         elif kind == "KILL":

@@ -108,15 +108,29 @@ def save_sharded_state(directory: str, rank: int, world_size: int,
     def write():
         final = os.path.join(step_dir, f"shard_{rank:05d}.pkl")
         tmp = final + f".tmp{os.getpid()}"
-        with open(tmp, "wb") as f:
-            pickle.dump(state, f)
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+        except FileNotFoundError:
+            # rank 0's prune raced this lagging rank's write: recreate
+            # the step dir and retry once (the age guard below makes
+            # this window small)
+            os.makedirs(step_dir, exist_ok=True)
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
         os.replace(tmp, final)
         if rank == 0 and keep:
             steps = sorted(d for d in os.listdir(directory)
                            if d.startswith("step_"))
+            now = time.time()
             for old in steps[:-keep]:
-                shutil.rmtree(os.path.join(directory, old),
-                              ignore_errors=True)
+                path = os.path.join(directory, old)
+                try:
+                    if now - os.path.getmtime(path) < 30.0:
+                        continue  # a lagging rank may still be writing
+                except OSError:
+                    continue
+                shutil.rmtree(path, ignore_errors=True)
 
     if background:
         import threading

@@ -1,0 +1,30 @@
+"""Cluster-wide pubsub usable from the driver AND from workers.
+
+Capability parity with the reference's pubsub clients (reference:
+src/ray/pubsub/publisher.h:245 / subscriber.h:215 and the Python GCS
+subscriber, python_gcs_subscriber.cc). The publisher lives in the head;
+worker subscriptions register a push route over the worker's node
+socket (and the daemon's control connection for remote hosts).
+
+    from ray_tpu.util import pubsub
+    pubsub.subscribe("my-channel", lambda msg: ...)
+    pubsub.publish("my-channel", {"anything": "picklable"})
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def subscribe(channel: str, callback: Callable[[Any], None]) -> None:
+    """Invoke ``callback(message)`` for every publish on ``channel``.
+    Callbacks run on a runtime thread — keep them fast and non-blocking.
+    """
+    from ray_tpu.core import runtime as runtime_mod
+    runtime_mod.get_runtime().subscribe_channel(channel, callback)
+
+
+def publish(channel: str, message: Any) -> None:
+    """Publish a picklable message to every subscriber, cluster-wide."""
+    from ray_tpu.core import runtime as runtime_mod
+    runtime_mod.get_runtime().publish_channel(channel, message)

