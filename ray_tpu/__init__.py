@@ -31,6 +31,7 @@ from ray_tpu.api import (
 from ray_tpu.core.generator import ObjectRefGenerator
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu import exceptions
+from ray_tpu.runtime_env import RuntimeEnv
 
 __all__ = [
     "__version__",
@@ -49,6 +50,7 @@ __all__ = [
     "ObjectRefGenerator",
     "put",
     "remote",
+    "RuntimeEnv",
     "shutdown",
     "wait",
 ]

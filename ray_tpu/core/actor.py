@@ -153,6 +153,12 @@ class ActorClass:
         opts = self._options
         actor_id = ActorID.from_random()
         cfg = get_config()
+        from ray_tpu.runtime_env import (merge_runtime_envs,
+                                         normalize_runtime_env,
+                                         runtime_env_hash)
+        renv = merge_runtime_envs(
+            getattr(rt, "current_runtime_env", None),
+            normalize_runtime_env(opts.get("runtime_env"), rt))
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id=class_id,
@@ -168,6 +174,8 @@ class ActorClass:
             max_restarts=opts.get("max_restarts",
                                   cfg.actor_default_max_restarts),
             max_concurrency=opts.get("max_concurrency", 1),
+            runtime_env=renv,
+            runtime_env_hash=runtime_env_hash(renv) if renv else "",
         )
         handle = ActorHandle(actor_id, self._cls.__name__, self._method_names)
         name = opts.get("name")

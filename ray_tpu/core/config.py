@@ -45,6 +45,10 @@ class Config:
     object_store_full_retry_s: float = 0.05
     object_store_full_max_retries: int = 100
 
+    # Size budget for the node-local cache of extracted runtime_env
+    # packages and pip venvs (reference: uri_cache.py default 10 GiB).
+    runtime_env_cache_bytes: int = 10 * 1024 * 1024 * 1024
+
     # --- workers / scheduling ---
     # Max workers a node's pool will fork (0 => num_cpus).
     max_workers_per_node: int = 0

@@ -101,6 +101,9 @@ class Node:
         from collections import defaultdict
         self._idle: Dict[str, Deque[WorkerHandle]] = defaultdict(deque)
         self._dispatch_queue: Dict[str, Deque[TaskSpec]] = defaultdict(deque)
+        # runtime_env_hash → normalized env dict, registered on first
+        # dispatch of a spec carrying that env (ray_tpu/runtime_env/)
+        self._runtime_envs: Dict[str, dict] = {}
         self._free_chips: List[int] = list(
             range(int(self.resources.get("TPU", 0))))
         self._total_chips = len(self._free_chips)
@@ -147,7 +150,13 @@ class Node:
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
         chips: List[int] = []
-        if profile == "cpu":
+        hw_profile, _, renv_part = profile.partition("|")
+        if renv_part:
+            renv = self._runtime_envs.get(renv_part[3:])  # strip "re:"
+            if renv is not None:
+                import json
+                env["RTPU_RUNTIME_ENV"] = json.dumps(renv)
+        if hw_profile == "cpu":
             # Mask the accelerator: no TPU runtime import (which costs
             # seconds per process and can contend for chips), and any jax
             # the user code imports runs on CPU.
@@ -159,7 +168,8 @@ class Node:
             # runtime via TPU_VISIBLE_CHIPS + bounds vars (reference:
             # tpu.py:283-323). k=0 (fractional TPU request) shares the
             # full host.
-            need = int(profile.split(":", 1)[1]) if ":" in profile else 0
+            need = (int(hw_profile.split(":", 1)[1])
+                    if ":" in hw_profile else 0)
             with self._lock:
                 allocated = self._allocate_chips(need)
                 victim = None
@@ -215,18 +225,28 @@ class Node:
         for _ in range(count):
             self._spawn_worker(profile)
 
-    @staticmethod
-    def _profile_for(spec: TaskSpec) -> str:
+    def _profile_for(self, spec: TaskSpec) -> str:
         amount = 0.0
         for key, value in spec.resources.items():
             if value > 0 and (key == "TPU" or key.startswith("TPU_group")):
                 amount = max(amount, value)
         if amount <= 0:
-            return "cpu"
-        if amount < 1:
-            return "tpu:0"  # fractional request: shares the full host
-        import math
-        return f"tpu:{int(math.ceil(amount))}"
+            base = "cpu"
+        elif amount < 1:
+            base = "tpu:0"  # fractional request: shares the full host
+        else:
+            import math
+            base = f"tpu:{int(math.ceil(amount))}"
+        if spec.runtime_env_hash:
+            # Workers with a runtime env form their own sub-pool: a
+            # default worker must never execute inside someone else's
+            # env, nor vice versa (reference: dedicated workers per
+            # runtime_env in worker_pool.cc).
+            with self._lock:
+                self._runtime_envs.setdefault(
+                    spec.runtime_env_hash, spec.runtime_env)
+            return f"{base}|re:{spec.runtime_env_hash}"
+        return base
 
     def _io_loop(self) -> None:
         from ray_tpu.core.protocol import FrameReader
@@ -417,7 +437,7 @@ class Node:
         if cfg.max_workers_per_node > 0:
             return cfg.max_workers_per_node
         if profile.startswith("tpu:"):
-            k = int(profile.split(":", 1)[1])
+            k = int(profile.partition("|")[0].split(":", 1)[1])
             if k > 0 and self._total_chips:
                 return max(1, self._total_chips // k)
         return max(1, int(self.resources.get("CPU", 1)))

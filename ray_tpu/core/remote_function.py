@@ -106,6 +106,27 @@ class RemoteFunction:
         self._strategy = strategy_from_options(self._options)
         self._name = (self._options.get("name")
                       or getattr(fn, "__qualname__", ""))
+        self._norm_env = None
+        self._norm_env_with: Optional[int] = None
+
+    def _resolve_runtime_env(self, rt):
+        """Normalized runtime env for this call: the explicit option
+        (packaged once per runtime — uploads are content-addressed so
+        re-normalizing after re-init is cheap) merged over the
+        submitting worker's own env (child tasks inherit)."""
+        from ray_tpu.runtime_env import (merge_runtime_envs,
+                                         normalize_runtime_env,
+                                         runtime_env_hash)
+        explicit = self._options.get("runtime_env")
+        if explicit is not None:
+            with self._lock:
+                if self._norm_env_with != id(rt):
+                    self._norm_env = normalize_runtime_env(explicit, rt)
+                    self._norm_env_with = id(rt)
+                explicit = self._norm_env
+        env = merge_runtime_envs(
+            getattr(rt, "current_runtime_env", None), explicit)
+        return (env, runtime_env_hash(env)) if env else (None, "")
 
     @property
     def options_dict(self):
@@ -147,6 +168,7 @@ class RemoteFunction:
         # (reference: num_returns="streaming", _raylet.pyx:299).
         if num_returns == "streaming":
             num_returns = -1
+        renv, renv_hash = self._resolve_runtime_env(rt)
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id=function_id,
@@ -158,6 +180,8 @@ class RemoteFunction:
             max_retries=opts.get("max_retries", get_config().task_max_retries),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             name=self._name,
+            runtime_env=renv,
+            runtime_env_hash=renv_hash,
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)

@@ -62,6 +62,10 @@ class TaskSpec:
     is_actor_creation: bool = False
     max_restarts: int = 0
     max_concurrency: int = 1
+    # runtime environment (normalized dict; see ray_tpu/runtime_env/) —
+    # workers are pooled per (hardware profile, runtime_env_hash)
+    runtime_env: Optional[Dict[str, Any]] = None
+    runtime_env_hash: str = ""
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]

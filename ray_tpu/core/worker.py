@@ -68,6 +68,12 @@ class WorkerRuntime:
         self._current_task_id: threading.local = threading.local()
         self.actor_instance = None
         self.actor_id: Optional[ActorID] = None
+        # normalized runtime env this worker runs inside (child tasks
+        # submitted from here inherit it; see runtime_env/__init__.py)
+        self.current_runtime_env: Optional[dict] = None
+        # set when runtime_env setup failed: every task handed to this
+        # worker fails fast with this error instead of executing
+        self.setup_error: Optional[Exception] = None
 
     # --- request/reply with the node manager ---------------------------
     def _next_req(self) -> Tuple[int, threading.Event, list]:
@@ -445,6 +451,11 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     rt._current_task_id.value = spec.task_id
     reply: dict = {"kind": "TASK_DONE", "task_id": spec.task_id.binary(),
                    "spec_is_actor_creation": spec.is_actor_creation}
+    if rt.setup_error is not None:
+        reply["results"] = []
+        reply["error"] = serialization.dumps(rt.setup_error)
+        reply["error_str"] = str(rt.setup_error)
+        return reply
     try:
         args, kwargs = _resolve_args(rt, spec)
         if spec.is_actor_creation:
@@ -534,6 +545,33 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
     conn.send({"kind": "REGISTER", "worker_id": worker_id.binary(),
                "pid": os.getpid(), "proto_version": PROTOCOL_VERSION})
 
+    # Apply this worker's runtime env (env_vars / working_dir /
+    # py_modules) before any task can run; messages arriving during the
+    # blocking KV fetches are deferred into the main loop (ray_tpu/
+    # runtime_env/worker_setup.py). pip envs were handled pre-connect.
+    deferred_msgs: List[dict] = []
+    pip_error = os.environ.get("RTPU_PIP_ERROR")
+    if pip_error:
+        from ray_tpu.exceptions import RuntimeEnvSetupError
+        rt.setup_error = RuntimeEnvSetupError(
+            f"runtime_env pip setup failed: {pip_error}")
+    renv_json = os.environ.get("RTPU_RUNTIME_ENV")
+    if renv_json and rt.setup_error is None:
+        import json as _json
+        from ray_tpu.runtime_env import worker_setup
+        try:
+            worker_setup.apply_runtime_env(renv_json, conn, deferred_msgs)
+            rt.current_runtime_env = _json.loads(renv_json)
+        except Exception as setup_exc:  # noqa: BLE001
+            # A broken env (bad URI, failed extract) must fail the tasks
+            # that require it — not crash-loop the worker pool. The
+            # worker stays alive and replies RuntimeEnvSetupError to
+            # every spec it is handed (_execute short-circuit).
+            from ray_tpu.exceptions import RuntimeEnvSetupError
+            traceback.print_exc()
+            rt.setup_error = RuntimeEnvSetupError(
+                f"runtime_env setup failed: {setup_exc!r}")
+
     exec_pool = ThreadPoolExecutor(max_workers=1)
     pool_lock = threading.Lock()
     # Plain tasks run off a local pending queue on one runner thread;
@@ -605,6 +643,15 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
                 conn.send(reply)
             else:
                 collector.add(reply)
+            if rt.setup_error is not None:
+                # A setup-failed worker must not rejoin the idle pool —
+                # a transient cause (GCS blip) would otherwise poison
+                # this env's sub-pool forever. Fail what we were handed,
+                # then die so the node respawns a clean worker.
+                with pending_cv:
+                    drained = not pending
+                if drained:
+                    os._exit(1)
 
     threading.Thread(target=runner_loop, name="task-runner",
                      daemon=True).start()
@@ -630,6 +677,8 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
     def run_task(spec: TaskSpec):
         reply = _execute(rt, spec)
         conn.send(reply)
+        if rt.setup_error is not None:
+            os._exit(1)  # see runner_loop: don't poison the pool
 
     def ensure_actor_loop():
         import asyncio
@@ -679,10 +728,8 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
         actor_state["is_async"] = result
         return result
 
-    while True:
-        msg = conn.recv()
-        if msg is None:
-            break
+    def handle_msg(msg: dict) -> bool:
+        nonlocal exec_pool
         kind = msg["kind"]
         if kind == "EXECUTE_BATCH":
             # Batched dispatch: execute sequentially off the pending
@@ -711,9 +758,18 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
         elif kind == "PUBSUB_MSG":
             rt._on_pubsub(msg)
         elif kind == "SHUTDOWN":
-            break
+            return False
         elif kind == "KILL":
             os._exit(1)
+        return True
+
+    for msg in deferred_msgs:
+        if not handle_msg(msg):
+            os._exit(0)
+    while True:
+        msg = conn.recv()
+        if msg is None or not handle_msg(msg):
+            break
     os._exit(0)
 
 
@@ -724,6 +780,29 @@ def main():
     parser.add_argument("--worker-id", required=True)
     parser.add_argument("--store-name", required=True)
     args = parser.parse_args()
+    # pip runtime envs must take effect before this process touches its
+    # node connection: build (or reuse) the cached venv and re-exec into
+    # its interpreter (exec closes the not-yet-opened socket safely;
+    # RTPU_PIP_READY breaks the loop on the second pass).
+    renv_json = os.environ.get("RTPU_RUNTIME_ENV")
+    if renv_json and not os.environ.get("RTPU_PIP_READY"):
+        import json as _json
+        pip_spec = (_json.loads(renv_json) or {}).get("pip")
+        if pip_spec:
+            try:
+                from ray_tpu.runtime_env.pip_env import ensure_pip_env
+                python = ensure_pip_env(pip_spec)
+            except Exception as exc:  # noqa: BLE001
+                # Still connect and register: the failure must travel to
+                # the requesting task as RuntimeEnvSetupError, not
+                # strand the spec in the node's dispatch queue.
+                os.environ["RTPU_PIP_ERROR"] = repr(exc)
+            else:
+                os.environ["RTPU_PIP_READY"] = "1"
+                os.execve(
+                    python,
+                    [python, "-m", "ray_tpu.core.worker"] + sys.argv[1:],
+                    dict(os.environ))
     worker_main(args.socket, args.node_id, args.worker_id, args.store_name)
 
 

@@ -1,0 +1,166 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Capability parity with the reference's runtime_env subsystem
+(reference: python/ray/_private/runtime_env/ — plugins for
+env_vars/working_dir/py_modules/pip with URI-cached packages staged by a
+per-node agent; python/ray/_private/runtime_env/plugin.py plugin ABC,
+packaging.py zip+hash upload, uri_cache.py).
+
+Design (TPU-first, daemonless): there is no separate runtime-env agent
+process. The *driver* packages local directories into content-addressed
+archives in the GCS KV (`packaging.upload_package`); the *worker
+process* applies its environment at startup, before its task loop —
+fetching archives over its existing blocking GCS bridge, extracting into
+a node-local content-addressed cache (flock-guarded, LRU-pruned), and
+for `pip` envs re-exec()ing into a cached virtualenv before connecting.
+Workers with different runtime envs never share a pool slot: the node's
+worker pool is keyed by (hardware profile, runtime-env hash).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+# Fields a runtime env may carry. Anything else is rejected up front so
+# typos fail at submit time, not silently at worker start.
+_KNOWN_FIELDS = ("env_vars", "working_dir", "py_modules", "pip",
+                 "excludes", "config")
+
+
+class RuntimeEnv(dict):
+    """A validated runtime environment description.
+
+    reference: python/ray/runtime_env/runtime_env.py — the user-facing
+    dict-like wrapper. Accepts:
+      env_vars:    {str: str}
+      working_dir: local directory path (packaged at submit) or kv:// URI
+      py_modules:  list of local module-dir paths or kv:// URIs
+      pip:         list of requirement strings, or {"packages": [...],
+                   "pip_install_options": [...]}
+      excludes:    fnmatch patterns skipped when packaging working_dir
+    """
+
+    def __init__(self, **kwargs: Any):
+        super().__init__()
+        for key, value in kwargs.items():
+            if value is None:
+                continue
+            if key not in _KNOWN_FIELDS:
+                raise ValueError(
+                    f"unknown runtime_env field {key!r}; "
+                    f"supported: {_KNOWN_FIELDS}")
+            self[key] = value
+        validate_runtime_env(self)
+
+
+def validate_runtime_env(env: Dict[str, Any]) -> None:
+    for key in env:
+        if key not in _KNOWN_FIELDS:
+            raise ValueError(
+                f"unknown runtime_env field {key!r}; "
+                f"supported: {_KNOWN_FIELDS}")
+    env_vars = env.get("env_vars")
+    if env_vars is not None:
+        if not isinstance(env_vars, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in env_vars.items()):
+            raise TypeError("runtime_env['env_vars'] must be {str: str}")
+    working_dir = env.get("working_dir")
+    if working_dir is not None and not isinstance(working_dir, str):
+        raise TypeError("runtime_env['working_dir'] must be a path or URI")
+    py_modules = env.get("py_modules")
+    if py_modules is not None and not isinstance(py_modules, (list, tuple)):
+        raise TypeError("runtime_env['py_modules'] must be a list")
+    pip = env.get("pip")
+    if pip is not None and not isinstance(pip, (list, tuple, dict)):
+        raise TypeError("runtime_env['pip'] must be a list of requirements "
+                        "or a dict with 'packages'")
+
+
+def normalize_runtime_env(env: Optional[Dict[str, Any]],
+                          runtime) -> Optional[Dict[str, Any]]:
+    """Resolve local paths into content-addressed kv:// URIs and return
+    a canonical, fully-portable env dict (or None if empty). The result
+    is safe to ship inside a TaskSpec to any node."""
+    if not env:
+        return None
+    validate_runtime_env(env)
+    from ray_tpu.runtime_env import packaging
+    out: Dict[str, Any] = {}
+    env_vars = env.get("env_vars")
+    if env_vars:
+        out["env_vars"] = dict(sorted(env_vars.items()))
+    excludes = list(env.get("excludes") or ())
+    working_dir = env.get("working_dir")
+    if working_dir:
+        if working_dir.startswith("kv://"):
+            out["working_dir"] = working_dir
+        else:
+            out["working_dir"] = packaging.upload_package(
+                runtime, working_dir, excludes=excludes)
+    py_modules = env.get("py_modules")
+    if py_modules:
+        uris = []
+        for mod in py_modules:
+            if isinstance(mod, str) and mod.startswith("kv://"):
+                uris.append(mod)
+            else:
+                base = os.path.basename(
+                    os.path.abspath(os.path.expanduser(mod)))
+                wrap = "" if os.path.isfile(mod) else base
+                uris.append(packaging.upload_package(
+                    runtime, mod, excludes=excludes, wrap=wrap))
+        out["py_modules"] = uris
+    pip = env.get("pip")
+    if pip:
+        if isinstance(pip, dict):
+            out["pip"] = {
+                "packages": list(pip.get("packages") or ()),
+                "pip_install_options": list(
+                    pip.get("pip_install_options") or ()),
+            }
+        else:
+            out["pip"] = {"packages": list(pip), "pip_install_options": []}
+    if env.get("config"):
+        out["config"] = dict(env["config"])
+    if not out:
+        return None
+    return out
+
+
+def runtime_env_hash(env: Dict[str, Any]) -> str:
+    """Stable content hash of a *normalized* env — the worker-pool key."""
+    blob = json.dumps(env, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def merge_runtime_envs(parent: Optional[Dict[str, Any]],
+                       child: Optional[Dict[str, Any]],
+                       ) -> Optional[Dict[str, Any]]:
+    """Child tasks inherit the parent's env; an explicit child env
+    overrides per-field, with env_vars merged key-wise (reference
+    semantics: runtime_env inheritance merges env_vars, replaces other
+    fields)."""
+    if not parent:
+        return child
+    if not child:
+        return parent
+    merged = dict(parent)
+    for key, value in child.items():
+        if key == "env_vars" and parent.get("env_vars"):
+            combined = dict(parent["env_vars"])
+            combined.update(value)
+            merged["env_vars"] = combined
+        else:
+            merged[key] = value
+    return merged
+
+
+def current_runtime_env() -> Optional[Dict[str, Any]]:
+    """The runtime env of the current worker process (None on the
+    driver or for default-env workers)."""
+    blob = os.environ.get("RTPU_RUNTIME_ENV")
+    return json.loads(blob) if blob else None

@@ -1,0 +1,206 @@
+"""Packaging: content-addressed archives for working_dir / py_modules.
+
+Capability parity with the reference's package pipeline
+(reference: python/ray/_private/runtime_env/packaging.py —
+zip-with-excludes, content hash → gcs:// URI, upload once, per-node
+download + extract into a URI cache; uri_cache.py LRU bounded by size).
+
+Archives live in the GCS KV under the ``runtime_env`` namespace keyed by
+content hash, so identical directories upload exactly once per cluster.
+Extraction on each node goes into a content-addressed cache directory
+guarded by an flock (many workers may start concurrently) and pruned
+LRU when it exceeds ``runtime_env_cache_bytes``.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import fnmatch
+import hashlib
+import io
+import os
+import shutil
+import tempfile
+import zipfile
+from typing import List, Optional
+
+KV_NAMESPACE = "runtime_env"
+# Refuse to package directories larger than this (reference caps uploads
+# at ~500MB; huge working dirs belong in real storage, not the KV).
+MAX_PACKAGE_BYTES = 512 * 1024 * 1024
+_ALWAYS_EXCLUDE = ("__pycache__", "*.pyc", ".git")
+
+
+def _iter_files(root: str, excludes: List[str]):
+    patterns = list(excludes) + list(_ALWAYS_EXCLUDE)
+
+    def skip(rel: str) -> bool:
+        parts = rel.split(os.sep)
+        return any(
+            fnmatch.fnmatch(part, pat) or fnmatch.fnmatch(rel, pat)
+            for part in parts for pat in patterns)
+
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        dirnames[:] = [
+            d for d in dirnames
+            if not skip(os.path.normpath(os.path.join(rel_dir, d)))]
+        for name in sorted(filenames):
+            rel = os.path.normpath(os.path.join(rel_dir, name))
+            if not skip(rel):
+                yield rel
+
+
+def package_directory(path: str,
+                      excludes: Optional[List[str]] = None,
+                      wrap: str = "") -> bytes:
+    """Zip ``path`` deterministically (sorted entries, fixed mtimes) so
+    the archive bytes — and thus the URI — depend only on content.
+    ``wrap`` prefixes every entry with a directory name — used for
+    py_modules, where the extracted root must *contain* the package dir
+    so it can go on sys.path (reference: packaging.py py_modules zips
+    the module directory itself, working_dir zips its contents)."""
+    path = os.path.abspath(os.path.expanduser(path))
+    if os.path.isfile(path):
+        # single-file module (py_modules accepts lone .py files)
+        with open(path, "rb") as f:
+            data = f.read()
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            info = zipfile.ZipInfo(os.path.basename(path),
+                                   date_time=(2000, 1, 1, 0, 0, 0))
+            zf.writestr(info, data)
+        return buf.getvalue()
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory not found: {path}")
+    buf = io.BytesIO()
+    total = 0
+    prefix = f"{wrap}/" if wrap else ""
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel in sorted(_iter_files(path, list(excludes or ()))):
+            full = os.path.join(path, rel)
+            total += os.path.getsize(full)
+            if total > MAX_PACKAGE_BYTES:
+                raise ValueError(
+                    f"runtime_env package {path} exceeds "
+                    f"{MAX_PACKAGE_BYTES} bytes; use excludes or "
+                    "external storage")
+            info = zipfile.ZipInfo(prefix + rel,
+                                   date_time=(2000, 1, 1, 0, 0, 0))
+            info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            with open(full, "rb") as f:
+                zf.writestr(info, f.read())
+    return buf.getvalue()
+
+
+def upload_package(runtime, path: str,
+                   excludes: Optional[List[str]] = None,
+                   wrap: str = "") -> str:
+    """Package ``path`` and store it in the cluster KV; returns its
+    ``kv://pkg/<sha1>/<basename>`` URI. Idempotent by content."""
+    data = package_directory(path, excludes, wrap=wrap)
+    digest = hashlib.sha1(data).hexdigest()
+    base = os.path.basename(os.path.abspath(os.path.expanduser(path)))
+    uri = f"kv://pkg/{digest}/{base}"
+    key = f"pkg/{digest}".encode()
+    if not runtime.gcs_call("kv_exists", key, KV_NAMESPACE):
+        runtime.gcs_call("kv_put", key, data, KV_NAMESPACE)
+    return uri
+
+
+def parse_uri(uri: str):
+    if not uri.startswith("kv://pkg/"):
+        raise ValueError(f"unsupported runtime_env URI: {uri}")
+    rest = uri[len("kv://"):]
+    parts = rest.split("/")
+    digest = parts[1]
+    base = parts[2] if len(parts) > 2 else "pkg"
+    return f"pkg/{digest}".encode(), digest, base
+
+
+def cache_root() -> str:
+    root = os.environ.get(
+        "RTPU_RUNTIME_ENV_CACHE",
+        os.path.join(tempfile.gettempdir(), "rtpu_runtime_resources"))
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+def fetch_package(uri: str, kv_get) -> str:
+    """Ensure the package behind ``uri`` is extracted into the node-local
+    cache; returns the extracted directory. ``kv_get(key, namespace)``
+    is any blocking KV fetch (driver-direct or the worker's GCS bridge).
+    Concurrent workers coordinate through an flock; the extract is
+    atomic (tempdir + rename) so a crash mid-extract never poisons the
+    cache."""
+    key, digest, _base = parse_uri(uri)
+    root = cache_root()
+    target = os.path.join(root, digest)
+    if os.path.isdir(target):
+        os.utime(target)  # LRU touch
+        return target
+    lock_path = os.path.join(root, f".{digest}.lock")
+    with open(lock_path, "w") as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        try:
+            if os.path.isdir(target):
+                os.utime(target)
+                return target
+            data = kv_get(key, KV_NAMESPACE)
+            if data is None:
+                raise RuntimeError(
+                    f"runtime_env package {uri} not found in the cluster "
+                    "KV (was the cluster restarted?)")
+            tmp = tempfile.mkdtemp(prefix=f".{digest}.", dir=root)
+            try:
+                with zipfile.ZipFile(io.BytesIO(data)) as zf:
+                    zf.extractall(tmp)
+                    for info in zf.infolist():
+                        mode = info.external_attr >> 16
+                        if mode:
+                            os.chmod(os.path.join(tmp, info.filename),
+                                     mode & 0o777)
+                os.rename(tmp, target)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+        finally:
+            fcntl.flock(lock_file, fcntl.LOCK_UN)
+    _prune_cache(root, keep=digest)
+    return target
+
+
+def _prune_cache(root: str, keep: str) -> None:
+    """LRU-prune extracted packages beyond the size budget (reference:
+    uri_cache.py). Entries are whole directories; in-use entries are
+    protected only by recency — matching the reference's best-effort
+    deletion of unused URIs."""
+    from ray_tpu.core.config import get_config
+    budget = getattr(get_config(), "runtime_env_cache_bytes",
+                     10 * 1024 * 1024 * 1024)
+    entries = []
+    total = 0
+    for name in os.listdir(root):
+        full = os.path.join(root, name)
+        if name.startswith(".") or not os.path.isdir(full):
+            continue
+        if name.startswith("venv-"):
+            # Never prune virtualenvs: a long-lived worker is executing
+            # *from* its venv (its mtime reflects spawn time, not use),
+            # and deleting it under a running interpreter breaks every
+            # later import. Venvs are bounded by distinct pip specs and
+            # reclaimed only by explicit cache cleanup.
+            continue
+        size = sum(
+            os.path.getsize(os.path.join(dp, f))
+            for dp, _dn, fn in os.walk(full) for f in fn)
+        entries.append((os.stat(full).st_mtime, size, name, full))
+        total += size
+    entries.sort()
+    for _mtime, size, name, full in entries:
+        if total <= budget:
+            break
+        if name == keep:
+            continue
+        shutil.rmtree(full, ignore_errors=True)
+        total -= size

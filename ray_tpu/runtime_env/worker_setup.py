@@ -1,0 +1,70 @@
+"""Worker-side runtime env application.
+
+The reference stages runtime envs through a per-node agent process the
+raylet consults before launching the worker (reference:
+src/ray/raylet/runtime_env_agent_client.cc,
+python/ray/_private/runtime_env/agent/). Here the worker process itself
+applies its env at startup, before entering its task loop: it already
+has a blocking GCS bridge through its node connection, so no extra
+daemon or HTTP hop is needed — and a failed setup surfaces as a worker
+startup failure on exactly the task that required the env.
+
+Order of application:
+  1. pip      — handled even earlier, pre-connect (see core/worker.main:
+                re-exec into the cached venv's interpreter)
+  2. env_vars — os.environ, before any user import runs
+  3. working_dir — fetch+extract, chdir, sys.path[0]
+  4. py_modules  — fetch+extract each, prepend to sys.path
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+from ray_tpu.core import serialization
+
+
+def _sync_gcs_call(conn, deferred: List[dict], method: str, *args) -> Any:
+    """One blocking GCS call over the raw node connection, used before
+    the worker's reply-routing loop exists. Non-reply messages that
+    arrive meanwhile (e.g. an eager task dispatch) are deferred for the
+    main loop — worker task execution is FIFO, so this preserves order."""
+    conn.send({"kind": "GCS_REQUEST", "method": method,
+               "args": serialization.dumps(args), "req_id": None})
+    while True:
+        msg = conn.recv()
+        if msg is None:
+            raise RuntimeError(
+                "node connection closed during runtime_env setup")
+        if msg.get("kind") == "GCS_REPLY":
+            if msg.get("error"):
+                raise serialization.loads(msg["error"])
+            return serialization.loads(msg["result"])
+        deferred.append(msg)
+
+
+def apply_runtime_env(env_json: str, conn, deferred: List[dict]) -> None:
+    """Apply this worker's runtime env (normalized JSON). Called from
+    worker_main after REGISTER, before the message loop."""
+    env: Dict[str, Any] = json.loads(env_json)
+    env_vars = env.get("env_vars")
+    if env_vars:
+        os.environ.update(env_vars)
+    from ray_tpu.runtime_env import packaging
+
+    def kv_get(key, namespace):
+        return _sync_gcs_call(conn, deferred, "kv_get", key, namespace)
+
+    working_dir = env.get("working_dir")
+    if working_dir:
+        path = packaging.fetch_package(working_dir, kv_get)
+        os.chdir(path)
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    for uri in env.get("py_modules") or ():
+        path = packaging.fetch_package(uri, kv_get)
+        if path not in sys.path:
+            sys.path.insert(0, path)

@@ -1,0 +1,197 @@
+"""Runtime environment tests (reference model:
+python/ray/tests/test_runtime_env*.py — env_vars, working_dir,
+py_modules, pip, inheritance, caching)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RuntimeEnvSetupError
+from ray_tpu.runtime_env import (
+    RuntimeEnv,
+    merge_runtime_envs,
+    normalize_runtime_env,
+    runtime_env_hash,
+)
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        RuntimeEnv(bogus_field=1)
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+    env = RuntimeEnv(env_vars={"A": "1"})
+    assert env["env_vars"] == {"A": "1"}
+
+
+def test_merge_semantics():
+    parent = {"env_vars": {"A": "1", "B": "2"}, "working_dir": "kv://pkg/x/y"}
+    child = {"env_vars": {"B": "3"}}
+    merged = merge_runtime_envs(parent, child)
+    assert merged["env_vars"] == {"A": "1", "B": "3"}
+    assert merged["working_dir"] == "kv://pkg/x/y"
+    assert merge_runtime_envs(None, child) == child
+    assert merge_runtime_envs(parent, None) == parent
+
+
+def test_hash_stability():
+    a = {"env_vars": {"X": "1", "Y": "2"}}
+    b = {"env_vars": {"Y": "2", "X": "1"}}
+    assert runtime_env_hash(a) == runtime_env_hash(b)
+    assert runtime_env_hash(a) != runtime_env_hash({"env_vars": {"X": "2"}})
+
+
+def test_env_vars_applied_and_isolated(ray_start_regular):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "hello"}})
+    def read_env():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    @ray_tpu.remote
+    def read_default():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+    # default-env workers must not see the var (separate worker pool)
+    assert ray_tpu.get(read_default.remote()) is None
+
+
+def test_env_vars_inherited_by_child_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def child():
+        return os.environ.get("RTPU_INHERIT")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_INHERIT": "yes"}})
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    assert ray_tpu.get(parent.remote()) == "yes"
+
+
+def test_working_dir(tmp_path, ray_start_regular):
+    (tmp_path / "data.txt").write_text("payload-42")
+    (tmp_path / "helper_mod.py").write_text("VALUE = 1234\n")
+    sub = tmp_path / "skipme"
+    sub.mkdir()
+    (sub / "big.bin").write_text("x")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path),
+                                 "excludes": ["skipme"]})
+    def use_working_dir():
+        import helper_mod
+        with open("data.txt") as f:
+            content = f.read()
+        return content, helper_mod.VALUE, os.path.exists("skipme")
+
+    content, value, has_excluded = ray_tpu.get(use_working_dir.remote())
+    assert content == "payload-42"
+    assert value == 1234
+    assert not has_excluded
+
+
+def test_py_modules(tmp_path, ray_start_regular):
+    mod_dir = tmp_path / "mymodpkg"
+    mod_dir.mkdir()
+    (mod_dir / "__init__.py").write_text("ANSWER = 7\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_module():
+        import mymodpkg
+        return mymodpkg.ANSWER
+
+    assert ray_tpu.get(use_module.remote()) == 7
+
+
+def test_actor_runtime_env(tmp_path, ray_start_regular):
+    (tmp_path / "actor_data.txt").write_text("actor-sees-me")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    class Reader:
+        def read(self):
+            with open("actor_data.txt") as f:
+                return f.read()
+
+    actor = Reader.remote()
+    assert ray_tpu.get(actor.read.remote()) == "actor-sees-me"
+
+
+def test_bad_working_dir_fails_task(ray_start_regular):
+    @ray_tpu.remote(
+        max_retries=0,
+        runtime_env={"working_dir": "kv://pkg/deadbeef/nope"})
+    def f():
+        return 1
+
+    with pytest.raises((RuntimeEnvSetupError, Exception)) as exc_info:
+        ray_tpu.get(f.remote(), timeout=60)
+    assert "runtime_env" in str(exc_info.value)
+
+
+def test_package_cache_reuse(tmp_path, ray_start_regular):
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.runtime_env import packaging
+
+    (tmp_path / "f.txt").write_text("cache-me")
+    rt = runtime_mod.get_runtime()
+    uri1 = packaging.upload_package(rt, str(tmp_path))
+    uri2 = packaging.upload_package(rt, str(tmp_path))
+    assert uri1 == uri2  # content-addressed: identical dirs dedupe
+
+    extracted = packaging.fetch_package(
+        uri1, lambda key, ns: rt.gcs_call("kv_get", key, ns))
+    marker = os.path.join(extracted, "f.txt")
+    assert open(marker).read() == "cache-me"
+    # second fetch reuses the directory (no re-extract)
+    ino = os.stat(extracted).st_ino
+    again = packaging.fetch_package(
+        uri1, lambda key, ns: rt.gcs_call("kv_get", key, ns))
+    assert os.stat(again).st_ino == ino
+
+
+def _make_trivial_wheel(tmp_path) -> str:
+    """Hand-build a minimal wheel (a zip with METADATA + RECORD) so the
+    pip test runs fully offline."""
+    import zipfile
+    name, version = "rtpu_testpkg", "0.1"
+    wheel = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    dist_info = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(wheel, "w") as zf:
+        zf.writestr(f"{name}.py", "MAGIC = 'from-pip-env'\n")
+        zf.writestr(
+            f"{dist_info}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n")
+        zf.writestr(
+            f"{dist_info}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n")
+        zf.writestr(f"{dist_info}/RECORD", "")
+    return str(wheel)
+
+
+def test_pip_runtime_env(tmp_path, ray_start_regular):
+    try:
+        subprocess.run([sys.executable, "-m", "pip", "--version"],
+                       check=True, capture_output=True, timeout=30)
+        subprocess.run([sys.executable, "-m", "venv", "--help"],
+                       check=True, capture_output=True, timeout=30)
+    except Exception:
+        pytest.skip("pip/venv unavailable")
+    wheel = _make_trivial_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"pip": {
+        "packages": [wheel],
+        "pip_install_options": ["--no-index", "--no-deps"]}})
+    def use_pip_pkg():
+        import rtpu_testpkg
+        return rtpu_testpkg.MAGIC
+
+    assert ray_tpu.get(use_pip_pkg.remote(), timeout=110) == "from-pip-env"
+
+
+def test_normalize_empty_is_none(ray_start_regular):
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime()
+    assert normalize_runtime_env({}, rt) is None
+    assert normalize_runtime_env(None, rt) is None
