@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import os
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu.core import runtime as runtime_mod
@@ -26,12 +27,20 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          namespace: str = "",
          system_config: Optional[dict] = None,
          head_port: Optional[int] = None,
+         include_dashboard: bool = True,
+         dashboard_port: int = 0,
          ignore_reinit_error: bool = False) -> DriverRuntime:
     """Start the head runtime (worker pool + object store + scheduler).
 
     ``head_port`` >= 0 additionally opens the multi-host control plane:
     a TCP listener node daemons join via ``ray-tpu start --address``
     (0 picks a free port; see ``runtime.head_address``).
+
+    ``include_dashboard`` starts the HTTP dashboard (REST state API +
+    /metrics + log tail; see ray_tpu/dashboard/) on ``dashboard_port``
+    (0 = ephemeral; URL at ``runtime.dashboard_url``) and the log
+    monitor that echoes worker logs to this process when the
+    ``log_to_driver`` flag is set.
     """
     existing = runtime_mod.get_runtime_or_none()
     if existing is not None:
@@ -50,6 +59,27 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
                        object_store_memory=object_store_memory,
                        system_config=system_config, namespace=namespace)
     runtime_mod.set_runtime(rt)
+    rt._shutdown_hooks = []
+    rt.dashboard_url = None
+    # The log monitor is how worker prints reach the driver at all now
+    # that worker stdout/stderr go to session log files — it must run
+    # regardless of the dashboard.
+    from ray_tpu.core.config import get_config
+    from ray_tpu.dashboard.log_monitor import LogMonitor
+    log_dirs = [os.path.join(node.session_dir, "logs")
+                for node in rt.nodes.values()]
+    monitor = LogMonitor(log_dirs, echo=get_config().log_to_driver)
+    rt._log_monitor = monitor
+    rt._shutdown_hooks.append(monitor.stop)
+    if include_dashboard:
+        try:
+            from ray_tpu.dashboard import DashboardServer
+            dashboard = DashboardServer(rt, port=dashboard_port)
+            rt.dashboard_url = dashboard.url
+            rt._shutdown_hooks.append(dashboard.stop)
+        except OSError:
+            # a dashboard bind failure must never block init
+            pass
     return rt
 
 

@@ -121,7 +121,9 @@ class ActorClass:
         self._lock = threading.Lock()
         self._blob: Optional[bytes] = None
         self._class_id: Optional[str] = None
-        self._registered_with: Optional[int] = None
+        self._registered_with = None  # weakref.ref to the runtime
+        self._norm_env = None
+        self._norm_env_with = None  # weakref.ref to the runtime
         self._method_names = [
             name for name, member in inspect.getmembers(cls)
             if callable(member) and not name.startswith("__")
@@ -141,10 +143,30 @@ class ActorClass:
                 self._blob = serialization.dumps(self._cls)
                 digest = hashlib.sha1(self._blob).hexdigest()[:24]
                 self._class_id = f"cls:{self._cls.__name__}:{digest}"
-            if self._registered_with != id(runtime):
+            cached = (self._registered_with()
+                      if self._registered_with is not None else None)
+            if cached is not runtime:  # weakref: id() could be recycled
+                import weakref
                 runtime.put_function(self._class_id, self._blob)
-                self._registered_with = id(runtime)
+                self._registered_with = weakref.ref(runtime)
             return self._class_id
+
+    def _normalized_env(self, rt):
+        """Normalize (package/upload) the class's runtime_env once per
+        runtime — re-zipping a large working_dir per Actor.remote()
+        would cost seconds of driver CPU each call."""
+        if self._options.get("runtime_env") is None:
+            return None
+        import weakref
+        from ray_tpu.runtime_env import normalize_runtime_env
+        with self._lock:
+            cached_rt = (self._norm_env_with()
+                         if self._norm_env_with is not None else None)
+            if cached_rt is not rt:
+                self._norm_env = normalize_runtime_env(
+                    self._options["runtime_env"], rt)
+                self._norm_env_with = weakref.ref(rt)
+            return self._norm_env
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         from ray_tpu.core import runtime as runtime_mod
@@ -154,11 +176,10 @@ class ActorClass:
         actor_id = ActorID.from_random()
         cfg = get_config()
         from ray_tpu.runtime_env import (merge_runtime_envs,
-                                         normalize_runtime_env,
                                          runtime_env_hash)
         renv = merge_runtime_envs(
             getattr(rt, "current_runtime_env", None),
-            normalize_runtime_env(opts.get("runtime_env"), rt))
+            self._normalized_env(rt))
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id=class_id,

@@ -201,16 +201,26 @@ class Node:
                         env.pop(key, None)
                     else:
                         env[key] = value
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.core.worker",
-             "--socket", self.socket_path,
-             "--node-id", self.node_id.hex(),
-             "--worker-id", worker_id.hex(),
-             "--store-name", self.store_name],
-            env=env,
-            stdout=None if get_config().log_to_driver else subprocess.DEVNULL,
-            stderr=None if get_config().log_to_driver else subprocess.DEVNULL,
-        )
+        # Workers write stdout+stderr to a per-worker session log file
+        # (reference: workers log under the session dir; log_monitor.py
+        # tails and streams to the driver). The dashboard serves these
+        # via /api/logs; PYTHONUNBUFFERED so lines appear as printed.
+        env["PYTHONUNBUFFERED"] = "1"
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir,
+                                f"worker-{worker_id.hex()[:8]}.log")
+        with open(log_path, "ab") as log_file:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.worker",
+                 "--socket", self.socket_path,
+                 "--node-id", self.node_id.hex(),
+                 "--worker-id", worker_id.hex(),
+                 "--store-name", self.store_name],
+                env=env,
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+            )
         handle = WorkerHandle(worker_id, proc, profile)
         handle.chips = chips
         handle.node = self

@@ -99,7 +99,7 @@ class RemoteFunction:
         self._lock = threading.Lock()
         self._blob: Optional[bytes] = None
         self._function_id: Optional[str] = None
-        self._registered_with: Optional[int] = None
+        self._registered_with = None  # weakref.ref to the runtime
         # Options are immutable per RemoteFunction (options() clones):
         # precompute the per-call constants off the submit hot path.
         self._resources = resources_from_options(self._options)
@@ -107,22 +107,27 @@ class RemoteFunction:
         self._name = (self._options.get("name")
                       or getattr(fn, "__qualname__", ""))
         self._norm_env = None
-        self._norm_env_with: Optional[int] = None
+        # weakref, not id(): a recycled id() after shutdown()+init()
+        # would serve kv:// URIs never uploaded to the new cluster
+        self._norm_env_with = None
 
     def _resolve_runtime_env(self, rt):
         """Normalized runtime env for this call: the explicit option
         (packaged once per runtime — uploads are content-addressed so
         re-normalizing after re-init is cheap) merged over the
         submitting worker's own env (child tasks inherit)."""
+        import weakref
         from ray_tpu.runtime_env import (merge_runtime_envs,
                                          normalize_runtime_env,
                                          runtime_env_hash)
         explicit = self._options.get("runtime_env")
         if explicit is not None:
             with self._lock:
-                if self._norm_env_with != id(rt):
+                cached_rt = (self._norm_env_with()
+                             if self._norm_env_with is not None else None)
+                if cached_rt is not rt:
                     self._norm_env = normalize_runtime_env(explicit, rt)
-                    self._norm_env_with = id(rt)
+                    self._norm_env_with = weakref.ref(rt)
                 explicit = self._norm_env
         env = merge_runtime_envs(
             getattr(rt, "current_runtime_env", None), explicit)
@@ -139,9 +144,12 @@ class RemoteFunction:
                 name = getattr(self._fn, "__qualname__", "fn")
                 digest = hashlib.sha1(self._blob).hexdigest()[:24]
                 self._function_id = f"fn:{name}:{digest}"
-            if self._registered_with != id(runtime):
+            cached = (self._registered_with()
+                      if self._registered_with is not None else None)
+            if cached is not runtime:  # weakref: id() could be recycled
+                import weakref
                 runtime.put_function(self._function_id, self._blob)
-                self._registered_with = id(runtime)
+                self._registered_with = weakref.ref(runtime)
             return self._function_id
 
     def options(self, **overrides) -> "RemoteFunction":

@@ -206,6 +206,9 @@ class DriverRuntime:
         node = Node(self, node_id, resources, labels,
                     object_store_memory=object_store_memory)
         self.nodes[node_id] = node
+        monitor = getattr(self, "_log_monitor", None)
+        if monitor is not None:  # tail the new node's worker logs too
+            monitor.add_dir(os.path.join(node.session_dir, "logs"))
         self.scheduler.add_node(node_id, resources, labels)
         self.gcs.register_node(NodeRecord(
             node_id=node_id, address=node.socket_path,
@@ -1711,6 +1714,11 @@ class DriverRuntime:
 
     def shutdown(self) -> None:
         self._stopped.set()
+        for hook in getattr(self, "_shutdown_hooks", ()):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
         self._signal_scheduler()
         if self.head_server is not None:
             self.head_server.stop()

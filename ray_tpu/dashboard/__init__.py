@@ -1,0 +1,17 @@
+"""Dashboard: HTTP observability plane for a running cluster.
+
+Capability parity with the reference's dashboard head + modules
+(reference: python/ray/dashboard/head.py, modules/{node,actor,job,
+metrics,log}/ and the state aggregator state_aggregator.py) — minus the
+React frontend: the UI here is one self-contained HTML page over the
+same REST API the CLI and state API use.
+
+Components:
+  server.py      — DashboardServer: REST API + /metrics + HTML index
+  log_monitor.py — tails per-worker log files, echoes to the driver
+                   (reference: python/ray/_private/log_monitor.py)
+"""
+
+from ray_tpu.dashboard.server import DashboardServer
+
+__all__ = ["DashboardServer"]

@@ -1,0 +1,85 @@
+"""Log monitor: tail worker log files, stream new lines to the driver.
+
+Capability parity with the reference's log monitor
+(reference: python/ray/_private/log_monitor.py — tails the session log
+dir and publishes new lines; python/ray/_private/worker.py:2266
+print_worker_logs renders them with a per-worker prefix).
+
+Workers write stdout+stderr to ``{session_dir}/logs/worker-<id>.log``
+(ray_tpu/core/node.py spawn path). One monitor thread per driver scans
+the directory, remembers per-file offsets, and echoes appended content
+to the driver's stdout prefixed with the worker id. The same files back
+the dashboard's ``/api/logs`` endpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List
+
+
+class LogMonitor:
+    def __init__(self, log_dirs: List[str], echo: bool = True,
+                 interval_s: float = 0.2):
+        self._log_dirs = list(log_dirs)
+        self._echo = echo
+        self._interval_s = interval_s
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, bytes] = {}
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="log-monitor", daemon=True)
+        self._thread.start()
+
+    def add_dir(self, log_dir: str) -> None:
+        self._log_dirs.append(log_dir)
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                pass
+
+    def poll_once(self) -> None:
+        for log_dir in list(self._log_dirs):
+            if not os.path.isdir(log_dir):
+                continue
+            for name in sorted(os.listdir(log_dir)):
+                if not name.endswith(".log"):
+                    continue
+                self._drain(os.path.join(log_dir, name))
+
+    def _drain(self, path: str) -> None:
+        offset = self._offsets.get(path, 0)
+        try:
+            size = os.path.getsize(path)
+            if size <= offset:
+                return
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(size - offset)
+        except OSError:
+            return
+        self._offsets[path] = offset + len(data)
+        if not self._echo:
+            return
+        # line-buffer across reads so a worker's partial line isn't
+        # printed split under two prefixes
+        data = self._partial.pop(path, b"") + data
+        lines = data.split(b"\n")
+        if lines and lines[-1]:
+            self._partial[path] = lines[-1]
+        prefix = f"({os.path.basename(path)[:-4]}) "
+        out = "".join(
+            prefix + line.decode("utf-8", "replace") + "\n"
+            for line in lines[:-1])
+        if out:
+            sys.stdout.write(out)
+            sys.stdout.flush()

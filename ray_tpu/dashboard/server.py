@@ -1,0 +1,244 @@
+"""Dashboard HTTP server: REST state API + metrics + logs + HTML index.
+
+Capability parity with the reference's dashboard head server and its
+modules (reference: python/ray/dashboard/head.py; modules/node, actor,
+job, log, metrics; state aggregation via state_aggregator.py → the
+``ray.util.state`` API). Routes:
+
+  GET /                      — HTML summary page (auto-refreshing)
+  GET /api/cluster           — resources total/available, head address
+  GET /api/nodes             — node table
+  GET /api/actors            — actor table
+  GET /api/tasks?limit=N     — latest task events
+  GET /api/summary           — task-state counts
+  GET /api/objects           — referenced objects
+  GET /api/placement_groups  — placement groups
+  GET /api/jobs              — driver + submitted jobs
+  GET /api/logs              — log files per node log dir
+  GET /api/logs/tail?file=F&lines=N[&follow=1] — tail (SSE when follow)
+  GET /metrics               — Prometheus exposition text
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import parse_qs, urlparse
+
+_INDEX_HTML = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2em; color: #222; }
+ h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.4em; }
+ table { border-collapse: collapse; font-size: 0.85em; }
+ td, th { border: 1px solid #ccc; padding: 4px 8px; text-align: left; }
+ th { background: #f0f0f0; }
+ code { background: #f6f6f6; padding: 1px 4px; }
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id=cluster></div>
+<h2>Nodes</h2><table id=nodes></table>
+<h2>Actors</h2><table id=actors></table>
+<h2>Task states</h2><table id=summary></table>
+<h2>Jobs</h2><table id=jobs></table>
+<p>API: <code>/api/nodes</code> <code>/api/actors</code>
+<code>/api/tasks</code> <code>/api/objects</code> <code>/api/jobs</code>
+<code>/api/logs</code> <code>/metrics</code></p>
+<script>
+async function grab(u){ return (await fetch(u)).json(); }
+function table(el, rows){
+  if(!rows.length){ el.innerHTML = '<tr><td>none</td></tr>'; return; }
+  const keys = Object.keys(rows[0]);
+  el.innerHTML = '<tr>' + keys.map(k=>'<th>'+k+'</th>').join('') + '</tr>' +
+    rows.map(r=>'<tr>'+keys.map(k=>'<td>'+JSON.stringify(r[k])+'</td>')
+    .join('')+'</tr>').join('');
+}
+async function refresh(){
+  const c = await grab('/api/cluster');
+  document.getElementById('cluster').innerHTML =
+    '<b>head:</b> <code>' + (c.head_address||'local') + '</code> ' +
+    '<b>resources:</b> <code>' + JSON.stringify(c.available) + '</code>' +
+    ' of <code>' + JSON.stringify(c.total) + '</code>';
+  table(document.getElementById('nodes'), await grab('/api/nodes'));
+  table(document.getElementById('actors'), await grab('/api/actors'));
+  const s = await grab('/api/summary');
+  table(document.getElementById('summary'),
+        Object.entries(s).map(([k,v])=>({state:k, count:v})));
+  table(document.getElementById('jobs'), await grab('/api/jobs'));
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class DashboardServer:
+    """Serves cluster state over HTTP from inside the driver process
+    (the control plane lives here, so reads are direct — the reference's
+    aggregation hop from GCS to the dashboard head collapses away)."""
+
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self._runtime = runtime
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence per-request noise
+                pass
+
+            def do_GET(self):
+                try:
+                    dashboard._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    try:
+                        self.send_error(500, str(exc))
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dashboard",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------------
+    def _log_dirs(self) -> List[str]:
+        dirs = []
+        for node in self._runtime.nodes.values():
+            d = os.path.join(node.session_dir, "logs")
+            if os.path.isdir(d):
+                dirs.append(d)
+        return dirs
+
+    def _resolve_log(self, name: str) -> Optional[str]:
+        """Map a client-supplied file name onto a real log file —
+        basename-only, so requests can't traverse the filesystem."""
+        base = os.path.basename(name)
+        for d in self._log_dirs():
+            full = os.path.join(d, base)
+            if os.path.isfile(full):
+                return full
+        return None
+
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/") or "/"
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        from ray_tpu.util import state as state_api
+
+        if path == "/":
+            return self._send(req, _INDEX_HTML, "text/html")
+        if path == "/metrics":
+            from ray_tpu.util.metrics import prometheus_text
+            return self._send(req, prometheus_text(),
+                              "text/plain; version=0.0.4")
+        if path == "/api/cluster":
+            rt = self._runtime
+            return self._send_json(req, {
+                "head_address": getattr(rt, "head_address", None),
+                "total": rt.cluster_resources(),
+                "available": rt.available_resources(),
+                "dashboard_url": self.url,
+            })
+        if path == "/api/nodes":
+            return self._send_json(req, state_api.list_nodes())
+        if path == "/api/actors":
+            return self._send_json(req, state_api.list_actors())
+        if path == "/api/tasks":
+            limit = int(query.get("limit", 1000))
+            return self._send_json(req, state_api.list_tasks(limit=limit))
+        if path == "/api/summary":
+            return self._send_json(req, state_api.summarize_tasks())
+        if path == "/api/objects":
+            return self._send_json(req, state_api.list_objects())
+        if path == "/api/placement_groups":
+            return self._send_json(req, state_api.list_placement_groups())
+        if path == "/api/jobs":
+            return self._send_json(req, state_api.list_jobs())
+        if path == "/api/logs":
+            files = {}
+            for d in self._log_dirs():
+                files[d] = sorted(
+                    name for name in os.listdir(d)
+                    if name.endswith(".log"))
+            return self._send_json(req, files)
+        if path == "/api/logs/tail":
+            return self._tail_log(req, query)
+        req.send_error(404, "unknown route")
+
+    def _tail_log(self, req, query) -> None:
+        name = query.get("file", "")
+        path = self._resolve_log(name)
+        if path is None:
+            return req.send_error(404, f"log file not found: {name}")
+        lines = int(query.get("lines", 100))
+        # bounded read: never load a multi-GB log into the driver —
+        # seek to a generous per-line budget from the end
+        bound = min(lines * 4096, 8 * 1024 * 1024)
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(0, size - bound))
+            data = f.read(bound)
+        offset_base = max(0, size - bound)
+        tail = b"\n".join(data.splitlines()[-lines:])
+        if not query.get("follow"):
+            return self._send(req, tail.decode("utf-8", "replace"),
+                              "text/plain")
+        # follow: SSE stream of appended chunks until the client leaves
+        # (reference: dashboard log streaming over websockets; SSE keeps
+        # the stdlib server sufficient)
+        req.send_response(200)
+        req.send_header("Content-Type", "text/event-stream")
+        req.send_header("Cache-Control", "no-cache")
+        req.end_headers()
+        offset = offset_base + len(data)
+        for line in tail.splitlines():
+            req.wfile.write(b"data: " + line + b"\n\n")
+        req.wfile.flush()
+        deadline = time.time() + float(query.get("timeout", 300))
+        while time.time() < deadline:
+            try:
+                size = os.path.getsize(path)
+                if size > offset:
+                    with open(path, "rb") as f:
+                        f.seek(offset)
+                        chunk = f.read(size - offset)
+                    offset = size
+                    for line in chunk.splitlines():
+                        req.wfile.write(b"data: " + line + b"\n\n")
+                    req.wfile.flush()
+                else:
+                    time.sleep(0.25)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _send(req, body: str, content_type: str) -> None:
+        payload = body.encode()
+        req.send_response(200)
+        req.send_header("Content-Type", content_type)
+        req.send_header("Content-Length", str(len(payload)))
+        req.end_headers()
+        req.wfile.write(payload)
+
+    @classmethod
+    def _send_json(cls, req, obj) -> None:
+        cls._send(req, json.dumps(obj), "application/json")
