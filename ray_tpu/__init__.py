@@ -26,6 +26,7 @@ from ray_tpu.api import (
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ray_tpu.core.generator import ObjectRefGenerator
@@ -52,5 +53,6 @@ __all__ = [
     "remote",
     "RuntimeEnv",
     "shutdown",
+    "timeline",
     "wait",
 ]

@@ -188,6 +188,19 @@ class _RuntimeContext:
         job_id = getattr(rt, "job_id", None)
         return job_id.hex() if job_id else None
 
+    def get_task_id(self) -> Optional[str]:
+        from ray_tpu.core.remote_function import submitting_task_id
+        rt = runtime_mod.get_runtime_or_none()
+        task_id = submitting_task_id(rt) if rt is not None else None
+        return task_id.hex() if task_id else None
+
 
 def get_runtime_context() -> _RuntimeContext:
     return _RuntimeContext()
+
+
+def timeline(filename: Optional[str] = None):
+    """Export the cluster task timeline as Chrome trace events
+    (reference: ``ray timeline``). See ray_tpu/util/timeline.py."""
+    from ray_tpu.util.timeline import timeline as _timeline
+    return _timeline(filename)

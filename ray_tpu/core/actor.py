@@ -24,6 +24,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.remote_function import (
     resources_from_options,
     strategy_from_options,
+    submitting_task_id,
     value_to_arg,
 )
 from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
@@ -69,6 +70,7 @@ class ActorMethod:
             actor_id=self._handle._actor_id,
             method_name=self._method_name,
             seq_no=self._handle._next_seq(),
+            parent_task_id=submitting_task_id(rt),
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)

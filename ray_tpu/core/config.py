@@ -48,6 +48,9 @@ class Config:
     # Size budget for the node-local cache of extracted runtime_env
     # packages and pip venvs (reference: uri_cache.py default 10 GiB).
     runtime_env_cache_bytes: int = 10 * 1024 * 1024 * 1024
+    # Per-worker log file rotation threshold (one .1 backup kept; 0
+    # disables rotation).
+    worker_log_max_bytes: int = 64 * 1024 * 1024
 
     # --- workers / scheduling ---
     # Max workers a node's pool will fork (0 => num_cpus).

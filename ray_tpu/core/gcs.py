@@ -292,7 +292,9 @@ class Gcs:
     # --- task events (observability) -----------------------------------
     def add_task_event(self, event: TaskEvent) -> None:
         if get_config().task_events_enabled:
-            self.task_events.append(event)
+            with self.lock:  # readers list() the deque concurrently
+                self.task_events.append(event)
 
     def list_task_events(self, limit: int = 1000) -> List[TaskEvent]:
-        return list(self.task_events)[-limit:]
+        with self.lock:  # appends during iteration raise RuntimeError
+            return list(self.task_events)[-limit:]

@@ -210,6 +210,7 @@ class Node:
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir,
                                 f"worker-{worker_id.hex()[:8]}.log")
+        env["RTPU_WORKER_LOG"] = log_path  # worker self-rotates at cap
         with open(log_path, "ab") as log_file:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu.core.worker",

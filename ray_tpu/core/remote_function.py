@@ -52,6 +52,13 @@ def validate_tpu_quantity(quantity: float) -> None:
             "configuration; supported: fractional (<1), 1, 2, 4, 8")
 
 
+def submitting_task_id(rt):
+    """TaskID of the task currently executing in this process (None on
+    the driver) — recorded as the child's parent for timeline tracing."""
+    local = getattr(rt, "_current_task_id", None)
+    return getattr(local, "value", None) if local is not None else None
+
+
 def strategy_from_options(options: Dict[str, Any]) -> SchedulingStrategy:
     strategy = options.get("scheduling_strategy")
     if strategy is None:
@@ -190,6 +197,7 @@ class RemoteFunction:
             name=self._name,
             runtime_env=renv,
             runtime_env_hash=renv_hash,
+            parent_task_id=submitting_task_id(rt),
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)

@@ -838,8 +838,9 @@ class DriverRuntime:
                 if info is not None:
                     self._release_actor_resources(info)
                 self._fail_actor_buffer(spec.actor_id, err)
-            self._record_event(spec, "FAILED", node_id=node.node_id,
-                              error=msg.get("error_str"))
+            self._record_execution_events(spec, node, worker, msg,
+                                          "FAILED",
+                                          error=msg.get("error_str"))
             self._fail_task(spec, err)
             self._release_task_resources(spec, node.node_id)
             self._signal_scheduler()
@@ -891,7 +892,7 @@ class DriverRuntime:
                 self._finish_stream(spec.task_id, None)
             self._record_lineage(spec)
             self._release_task_resources(spec, node.node_id)
-        self._record_event(spec, "FINISHED", node_id=node.node_id)
+        self._record_execution_events(spec, node, worker, msg, "FINISHED")
         self._signal_scheduler()
 
     def _release_task_resources(self, spec: TaskSpec, node_id: NodeID) -> None:
@@ -1707,10 +1708,42 @@ class DriverRuntime:
 
     def _record_event(self, spec: TaskSpec, state: str,
                       node_id: Optional[NodeID] = None,
-                      error: Optional[str] = None) -> None:
-        self.gcs.add_task_event(TaskEvent(
-            task_id=spec.task_id, name=spec.name or spec.function_id,
-            state=state, node_id=node_id, error=error))
+                      error: Optional[str] = None,
+                      worker_id=None, timestamp: Optional[float] = None,
+                      duration: Optional[float] = None,
+                      name: Optional[str] = None) -> None:
+        event = TaskEvent(
+            task_id=spec.task_id,
+            name=name or spec.name or spec.function_id,
+            state=state, node_id=node_id, error=error,
+            worker_id=worker_id, duration=duration,
+            parent_task_id=spec.parent_task_id)
+        if timestamp is not None:
+            event.timestamp = timestamp
+        self.gcs.add_task_event(event)
+
+    def _record_execution_events(self, spec: TaskSpec, node: Node,
+                                 worker, msg: dict, state: str,
+                                 error: Optional[str] = None) -> None:
+        """Record worker-timed RUNNING + user PROFILE spans + the final
+        state for one executed task (timestamps come from the worker so
+        the timeline reflects true execution windows, reference:
+        task_event_buffer.h:297 + profile_event.cc)."""
+        worker_id = worker.worker_id if worker is not None else None
+        t_start, t_end = msg.get("t_start"), msg.get("t_end")
+        if t_start is not None:
+            self._record_event(spec, "RUNNING", node_id=node.node_id,
+                               worker_id=worker_id, timestamp=t_start,
+                               duration=((t_end - t_start)
+                                         if t_end else None))
+        for span in msg.get("profile", ()):
+            span_name, s0, s1 = span
+            self._record_event(spec, "PROFILE", node_id=node.node_id,
+                               worker_id=worker_id, timestamp=s0,
+                               duration=s1 - s0, name=span_name)
+        self._record_event(spec, state, node_id=node.node_id,
+                           worker_id=worker_id, timestamp=t_end,
+                           error=error)
 
     def shutdown(self) -> None:
         self._stopped.set()

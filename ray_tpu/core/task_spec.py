@@ -66,6 +66,10 @@ class TaskSpec:
     # workers are pooled per (hardware profile, runtime_env_hash)
     runtime_env: Optional[Dict[str, Any]] = None
     runtime_env_hash: str = ""
+    # tracing: the task (if any) that submitted this one — drawn as a
+    # flow arrow in the timeline (reference: span context in TaskSpec,
+    # util/tracing/tracing_helper.py)
+    parent_task_id: Optional[TaskID] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
@@ -82,8 +86,14 @@ class TaskEvent:
     (reference: src/ray/core_worker/task_event_buffer.h:297)."""
     task_id: TaskID
     name: str
-    state: str    # PENDING | SCHEDULED | RUNNING | FINISHED | FAILED
+    state: str    # PENDING | SCHEDULED | RUNNING | FINISHED | FAILED | PROFILE
     timestamp: float = field(default_factory=time.time)
     node_id: Optional[NodeID] = None
     worker_id: Optional[WorkerID] = None
     error: Optional[str] = None
+    # PROFILE spans (user ray_tpu.util.tracing.profile blocks) carry an
+    # explicit duration; parent_task_id links nested submissions for
+    # timeline flow arrows (reference: ProfileEvent, profile_event.cc +
+    # span context propagated in the task spec, tracing_helper.py)
+    duration: Optional[float] = None
+    parent_task_id: Optional[TaskID] = None

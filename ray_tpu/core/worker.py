@@ -36,6 +36,41 @@ from ray_tpu.core.task_spec import Arg, TaskSpec
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError, TaskError
 
 
+class _ContextValue:
+    """threading.local-compatible ``.value`` holder backed by a
+    ContextVar — isolated per thread AND per asyncio task."""
+
+    def __init__(self, name: str):
+        import contextvars
+        object.__setattr__(self, "_var",
+                           contextvars.ContextVar(name, default=None))
+
+    @property
+    def value(self):
+        return self._var.get()
+
+    @value.setter
+    def value(self, v):
+        self._var.set(v)
+
+
+class _ContextItems:
+    """Same, for the per-task span list (``.items`` attribute)."""
+
+    def __init__(self, name: str):
+        import contextvars
+        object.__setattr__(self, "_var",
+                           contextvars.ContextVar(name, default=None))
+
+    @property
+    def items(self):
+        return self._var.get()
+
+    @items.setter
+    def items(self, v):
+        self._var.set(v)
+
+
 class WorkerRuntime:
     """The runtime visible to user code executing inside this worker."""
 
@@ -65,7 +100,14 @@ class WorkerRuntime:
         self._replies: Dict[int, Tuple[threading.Event, list]] = {}
         self._fn_cache: Dict[str, Any] = {}
         self._put_counter = 0
-        self._current_task_id: threading.local = threading.local()
+        # contextvars, not threading.local: async-actor coroutines
+        # interleave on ONE event-loop thread, and each asyncio Task
+        # runs in its own context copy — a thread-local would be
+        # clobbered across awaits (wrong task ids / merged spans)
+        self._current_task_id = _ContextValue("current_task_id")
+        # per-task user profile spans (ray_tpu.util.tracing.profile),
+        # shipped with the TASK_DONE reply into the GCS event store
+        self._profile_spans = _ContextItems("profile_spans")
         self.actor_instance = None
         self.actor_id: Optional[ActorID] = None
         # normalized runtime env this worker runs inside (child tasks
@@ -456,6 +498,9 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
         reply["error"] = serialization.dumps(rt.setup_error)
         reply["error_str"] = str(rt.setup_error)
         return reply
+    import time as _time
+    rt._profile_spans.items = []
+    reply["t_start"] = _time.time()
     try:
         args, kwargs = _resolve_args(rt, spec)
         if spec.is_actor_creation:
@@ -473,6 +518,10 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     except Exception:  # noqa: BLE001 — user code may raise anything
         return _pack_error(spec, reply)
     finally:
+        reply["t_end"] = _time.time()
+        spans = getattr(rt._profile_spans, "items", None)
+        if spans:
+            reply["profile"] = spans
         rt._current_task_id.value = None
 
 
@@ -487,6 +536,9 @@ async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     rt._current_task_id.value = spec.task_id
     reply: dict = {"kind": "TASK_DONE", "task_id": spec.task_id.binary(),
                    "spec_is_actor_creation": False}
+    import time as _time
+    rt._profile_spans.items = []
+    reply["t_start"] = _time.time()
     loop = asyncio.get_running_loop()
     try:
         # Argument resolution may block on object fetches; keep the loop
@@ -511,6 +563,10 @@ async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     except Exception:  # noqa: BLE001 — user code may raise anything
         return _pack_error(spec, reply)
     finally:
+        reply["t_end"] = _time.time()
+        spans = rt._profile_spans.items
+        if spans:
+            reply["profile"] = spans
         rt._current_task_id.value = None
 
 
@@ -631,6 +687,34 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
             conn.send({"kind": "BLOCKED" if entering else "UNBLOCKED"})
 
     rt.on_block = on_block
+
+    def log_rotation_loop() -> None:
+        """Bound this worker's log file: a chatty long-lived worker must
+        not fill the disk (reference: rotated worker logs in the session
+        dir). At the cap, keep one .1 backup and dup2 a fresh file over
+        stdout/stderr — O_APPEND writers continue seamlessly."""
+        from ray_tpu.core.config import get_config
+        log_path = os.environ.get("RTPU_WORKER_LOG")
+        cap = get_config().worker_log_max_bytes
+        if not log_path or cap <= 0:
+            return
+        import time as _time
+        while True:
+            _time.sleep(30.0)
+            try:
+                if os.path.getsize(log_path) <= cap:
+                    continue
+                os.replace(log_path, log_path + ".1")
+                fd = os.open(log_path,
+                             os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+                os.dup2(fd, 1)
+                os.dup2(fd, 2)
+                os.close(fd)
+            except OSError:
+                pass
+
+    threading.Thread(target=log_rotation_loop, name="log-rotate",
+                     daemon=True).start()
 
     def runner_loop() -> None:
         while True:

@@ -60,7 +60,14 @@ class LogMonitor:
         offset = self._offsets.get(path, 0)
         try:
             size = os.path.getsize(path)
-            if size <= offset:
+            if size < offset:
+                offset = 0  # file rotated/truncated: start over
+            if size == offset:
+                return
+            if not self._echo:
+                # nothing consumes the bytes (dashboard serves the files
+                # directly) — just advance past them
+                self._offsets[path] = size
                 return
             with open(path, "rb") as f:
                 f.seek(offset)
@@ -68,8 +75,6 @@ class LogMonitor:
         except OSError:
             return
         self._offsets[path] = offset + len(data)
-        if not self._echo:
-            return
         # line-buffer across reads so a worker's partial line isn't
         # printed split under two prefixes
         data = self._partial.pop(path, b"") + data

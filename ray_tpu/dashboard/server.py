@@ -51,18 +51,43 @@ _INDEX_HTML = """<!doctype html>
 <script>
 async function grab(u){ return (await fetch(u)).json(); }
 function table(el, rows){
-  if(!rows.length){ el.innerHTML = '<tr><td>none</td></tr>'; return; }
+  // textContent, never innerHTML: task/actor names and error strings
+  // are user-controlled and must not execute as markup
+  el.replaceChildren();
+  if(!rows.length){
+    const tr = document.createElement('tr');
+    const td = document.createElement('td');
+    td.textContent = 'none'; tr.appendChild(td); el.appendChild(tr);
+    return;
+  }
   const keys = Object.keys(rows[0]);
-  el.innerHTML = '<tr>' + keys.map(k=>'<th>'+k+'</th>').join('') + '</tr>' +
-    rows.map(r=>'<tr>'+keys.map(k=>'<td>'+JSON.stringify(r[k])+'</td>')
-    .join('')+'</tr>').join('');
+  const head = document.createElement('tr');
+  for(const k of keys){
+    const th = document.createElement('th');
+    th.textContent = k; head.appendChild(th);
+  }
+  el.appendChild(head);
+  for(const r of rows){
+    const tr = document.createElement('tr');
+    for(const k of keys){
+      const td = document.createElement('td');
+      td.textContent = JSON.stringify(r[k]); tr.appendChild(td);
+    }
+    el.appendChild(tr);
+  }
 }
 async function refresh(){
   const c = await grab('/api/cluster');
-  document.getElementById('cluster').innerHTML =
-    '<b>head:</b> <code>' + (c.head_address||'local') + '</code> ' +
-    '<b>resources:</b> <code>' + JSON.stringify(c.available) + '</code>' +
-    ' of <code>' + JSON.stringify(c.total) + '</code>';
+  const cl = document.getElementById('cluster');
+  cl.replaceChildren();
+  for(const [label, text] of [
+      ['head: ', c.head_address || 'local'],
+      [' available: ', JSON.stringify(c.available)],
+      [' of ', JSON.stringify(c.total)]]){
+    const b = document.createElement('b'); b.textContent = label;
+    const code = document.createElement('code'); code.textContent = text;
+    cl.appendChild(b); cl.appendChild(code);
+  }
   table(document.getElementById('nodes'), await grab('/api/nodes'));
   table(document.getElementById('actors'), await grab('/api/actors'));
   const s = await grab('/api/summary');
@@ -172,6 +197,10 @@ class DashboardServer:
             return self._send_json(req, state_api.list_placement_groups())
         if path == "/api/jobs":
             return self._send_json(req, state_api.list_jobs())
+        if path == "/api/timeline":
+            from ray_tpu.util.timeline import chrome_trace_events
+            return self._send_json(
+                req, chrome_trace_events(self._runtime))
         if path == "/api/logs":
             files = {}
             for d in self._log_dirs():

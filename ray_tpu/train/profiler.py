@@ -1,0 +1,85 @@
+"""XLA/JAX profiler hooks for train workers.
+
+Capability parity with the reference's profiler runtime-env plugins
+(reference: python/ray/_private/runtime_env/nsight.py, rocprof_sys.py —
+per-worker profiler attachment; SURVEY.md §5.1 names jax.profiler as
+the TPU equivalent). Captures an XLA trace (HLO timelines, host events)
+viewable in TensorBoard or Perfetto.
+
+Usage inside a train loop::
+
+    from ray_tpu.train.profiler import xla_profile
+    with xla_profile("/tmp/prof", rank0_only=True):
+        for step in range(k):
+            train_step(...)
+
+or step-windowed::
+
+    prof = StepProfiler("/tmp/prof", start_step=10, num_steps=5)
+    for step in range(n):
+        prof.on_step(step)
+        train_step(...)
+    prof.close()
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Optional
+
+
+def _rank() -> int:
+    try:
+        from ray_tpu.train.context import get_context
+        return get_context().get_world_rank()
+    except Exception:  # noqa: BLE001 — outside a train worker
+        return 0
+
+
+@contextmanager
+def xla_profile(logdir: str, rank0_only: bool = True):
+    """Capture a jax.profiler trace for the with-block. ``rank0_only``
+    keeps multi-host runs to one trace (the usual want: every host's
+    programs are the same SPMD program)."""
+    if rank0_only and _rank() != 0:
+        yield
+        return
+    import jax
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepProfiler:
+    """Trace a window of steps [start_step, start_step + num_steps) —
+    skipping warmup/compile steps, the standard profiling recipe."""
+
+    def __init__(self, logdir: str, start_step: int = 2,
+                 num_steps: int = 3, rank0_only: bool = True):
+        self._logdir = logdir
+        self._start = start_step
+        self._stop = start_step + num_steps
+        self._enabled = not (rank0_only and _rank() != 0)
+        self._active = False
+
+    def on_step(self, step: int) -> None:
+        if not self._enabled:
+            return
+        import jax
+        if step == self._start and not self._active:
+            os.makedirs(self._logdir, exist_ok=True)
+            jax.profiler.start_trace(self._logdir)
+            self._active = True
+        elif step >= self._stop and self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False

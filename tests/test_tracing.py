@@ -1,0 +1,155 @@
+"""Tracing/timeline tests (reference model: ray timeline +
+ProfileEvent tests; python/ray/tests/test_advanced.py timeline)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_task_slices_in_timeline(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.05)
+        return 1
+
+    assert ray_tpu.get(slow.remote()) == 1
+    events = ray_tpu.timeline()
+    slices = [e for e in events if e["ph"] == "X" and e["cat"] == "task"]
+    assert slices, "no task slices in timeline"
+    ev = next(e for e in slices if "slow" in e["name"])
+    assert ev["dur"] >= 0.05 * 1e6
+    assert ev["pid"].startswith("node:")
+    assert ev["tid"].startswith("worker:")
+
+
+def test_profile_spans(ray_start_regular):
+    @ray_tpu.remote
+    def with_spans():
+        from ray_tpu.util.tracing import profile
+        with profile("phase-a"):
+            time.sleep(0.02)
+        with profile("phase-b"):
+            time.sleep(0.01)
+        return "ok"
+
+    assert ray_tpu.get(with_spans.remote()) == "ok"
+    events = ray_tpu.timeline()
+    profs = [e for e in events if e["cat"] == "profile"]
+    names = {e["name"] for e in profs}
+    assert {"phase-a", "phase-b"} <= names
+    phase_a = next(e for e in profs if e["name"] == "phase-a")
+    assert phase_a["dur"] >= 0.02 * 1e6
+
+
+def test_parent_child_flow(ray_start_regular):
+    @ray_tpu.remote
+    def child():
+        return 2
+
+    @ray_tpu.remote
+    def parent():
+        return ray_tpu.get(child.remote())
+
+    assert ray_tpu.get(parent.remote()) == 2
+    events = ray_tpu.timeline()
+    flows = [e for e in events if e.get("cat") == "flow"]
+    assert any(e["ph"] == "s" for e in flows)
+    assert any(e["ph"] == "f" for e in flows)
+
+
+def test_timeline_file_export(tmp_path, ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    path = str(tmp_path / "trace.json")
+    ray_tpu.timeline(path)
+    with open(path) as fh:
+        data = json.load(fh)
+    assert isinstance(data, list) and data
+
+
+def test_failed_task_instant_event(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    events = ray_tpu.timeline()
+    assert any(e["ph"] == "i" and e["name"].startswith("FAILED")
+               for e in events)
+
+
+def test_get_task_id_in_task(ray_start_regular):
+    @ray_tpu.remote
+    def who():
+        return ray_tpu.get_runtime_context().get_task_id()
+
+    assert ray_tpu.get_runtime_context().get_task_id() is None
+    task_id = ray_tpu.get(who.remote())
+    assert isinstance(task_id, str) and len(task_id) > 8
+
+
+def test_async_actor_span_and_task_id_isolation(ray_start_regular):
+    """Interleaved coroutines must keep distinct task ids and spans
+    (contextvars, not thread-locals — they share one loop thread)."""
+    @ray_tpu.remote(max_concurrency=4)
+    class AsyncA:
+        async def work(self, delay):
+            import asyncio
+            from ray_tpu.util.tracing import profile
+            with profile(f"span-{delay}"):
+                await asyncio.sleep(delay)
+            return ray_tpu.get_runtime_context().get_task_id()
+
+    actor = AsyncA.remote()
+    refs = [actor.work.remote(d) for d in (0.08, 0.04, 0.01)]
+    task_ids = ray_tpu.get(refs)
+    assert len(set(task_ids)) == 3 and all(task_ids)
+    events = ray_tpu.timeline()
+    span_names = {e["name"] for e in events if e.get("cat") == "profile"}
+    assert {"span-0.08", "span-0.04", "span-0.01"} <= span_names
+    # each span belongs to its own task slice
+    by_task = {}
+    for e in events:
+        if e.get("cat") == "profile":
+            by_task.setdefault(e["args"]["task_id"], set()).add(e["name"])
+    assert all(len(names) == 1 for names in by_task.values())
+
+
+def test_xla_step_profiler(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.train.profiler import StepProfiler
+
+    logdir = str(tmp_path / "prof")
+    prof = StepProfiler(logdir, start_step=1, num_steps=2)
+
+    @jax.jit
+    def step(x):
+        return x @ x
+
+    x = jnp.ones((64, 64))
+    for i in range(4):
+        prof.on_step(i)
+        step(x).block_until_ready()
+    prof.close()
+    found = any("xplane" in f or f.endswith(".pb") or f.endswith(".json.gz")
+                for _root, _dirs, files in os.walk(logdir) for f in files)
+    assert found, f"no profiler output under {logdir}"
+
+
+def test_xla_profile_ctx(tmp_path):
+    import jax.numpy as jnp
+    from ray_tpu.train.profiler import xla_profile
+
+    logdir = str(tmp_path / "prof2")
+    with xla_profile(logdir):
+        (jnp.ones((8, 8)) * 2).block_until_ready()
+    assert os.path.isdir(logdir)
