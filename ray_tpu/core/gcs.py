@@ -90,6 +90,10 @@ class KVStore:
         self._lock = threading.Lock()
         # persistence hook: feeds the durability journal when set
         self._on_change = on_change
+        # key waiters: blocking/async waits fire on put, replacing the
+        # reference's long-poll pattern (and our earlier client-side
+        # 2ms polling) with event-driven wakeups
+        self._waiters: Dict[Tuple[str, bytes], List] = {}
 
     def put(self, key: bytes, value: bytes, namespace: str = "") -> None:
         with self._lock:
@@ -98,6 +102,53 @@ class KVStore:
             # same-key mutations in their in-memory apply order
             if self._on_change is not None:
                 self._on_change("put", (namespace, key), value)
+            waiters = self._waiters.pop((namespace, key), None)
+        for callback in waiters or ():
+            try:
+                callback(value)
+            except Exception:  # noqa: BLE001 — one waiter can't break put
+                pass
+
+    def add_waiter(self, key: bytes, namespace: str, callback):
+        """Register ``callback(value)`` to fire on the next put of the
+        key; returns the current value instead if it already exists
+        (atomic check-or-register, no missed-wakeup window)."""
+        with self._lock:
+            value = self._data.get((namespace, key))
+            if value is not None:
+                return value
+            self._waiters.setdefault((namespace, key), []).append(callback)
+            return None
+
+    def remove_waiter(self, key: bytes, namespace: str, callback) -> None:
+        with self._lock:
+            waiters = self._waiters.get((namespace, key))
+            if waiters is not None:
+                try:
+                    waiters.remove(callback)
+                except ValueError:
+                    pass
+                if not waiters:
+                    del self._waiters[(namespace, key)]
+
+    def wait(self, key: bytes, namespace: str = "",
+             timeout: Optional[float] = None) -> Optional[bytes]:
+        """Block until the key exists (or timeout → None)."""
+        event = threading.Event()
+        slot: List[Optional[bytes]] = [None]
+
+        def callback(value):
+            slot[0] = value
+            event.set()
+
+        existing = self.add_waiter(key, namespace, callback)
+        if existing is not None:
+            return existing
+        if not event.wait(timeout):
+            self.remove_waiter(key, namespace, callback)
+            # a put may have fired between wait() expiry and removal
+            return slot[0]
+        return slot[0]
 
     def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
         with self._lock:

@@ -1576,6 +1576,43 @@ class DriverRuntime:
         method = msg["method"]
         args = serialization.loads(msg["args"])
         out = {"kind": "GCS_REPLY", "req_id": msg.get("req_id"), "error": None}
+        if method == "kv_wait":
+            # Async on the head side: this runs on a node's single IO
+            # thread, which must never block — the reply is sent by the
+            # KV waiter callback when the key lands (or by the timer).
+            key, namespace, timeout = args
+            import threading as _threading
+            claim_lock = _threading.Lock()
+            claimed = [False]
+            timer_box: list = []
+
+            def _reply(value) -> None:
+                # atomic claim: the put callback and the timeout timer
+                # race — exactly one may send the reply (a lost put
+                # must not be overwritten by the timer's None)
+                with claim_lock:
+                    if claimed[0]:
+                        return
+                    claimed[0] = True
+                if timer_box:
+                    timer_box[0].cancel()
+                out["result"] = serialization.dumps(value)
+                worker.send(out)
+
+            existing = self.gcs.kv.add_waiter(key, namespace, _reply)
+            if existing is not None:
+                _reply(existing)
+                return
+
+            def _expire() -> None:
+                self.gcs.kv.remove_waiter(key, namespace, _reply)
+                _reply(None)
+
+            timer = _threading.Timer(timeout, _expire)
+            timer.daemon = True
+            timer_box.append(timer)
+            timer.start()
+            return
         try:
             result = self._gcs_dispatch(method, args)
             out["result"] = serialization.dumps(result)
@@ -1605,6 +1642,10 @@ class DriverRuntime:
             return gcs.kv.keys(args[0], namespace=args[1])
         if method == "kv_exists":
             return gcs.kv.exists(args[0], namespace=args[1])
+        if method == "kv_wait":
+            # driver-direct path (worker requests take the async branch
+            # in handle_gcs_request): blocking is fine on a user thread
+            return gcs.kv.wait(args[0], namespace=args[1], timeout=args[2])
         if method == "actor_state":
             rec = gcs.get_actor(ActorID(args[0]))
             return rec.state if rec else None

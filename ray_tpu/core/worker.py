@@ -54,23 +54,6 @@ class _ContextValue:
         self._var.set(v)
 
 
-class _ContextItems:
-    """Same, for the per-task span list (``.items`` attribute)."""
-
-    def __init__(self, name: str):
-        import contextvars
-        object.__setattr__(self, "_var",
-                           contextvars.ContextVar(name, default=None))
-
-    @property
-    def items(self):
-        return self._var.get()
-
-    @items.setter
-    def items(self, v):
-        self._var.set(v)
-
-
 class WorkerRuntime:
     """The runtime visible to user code executing inside this worker."""
 
@@ -107,7 +90,7 @@ class WorkerRuntime:
         self._current_task_id = _ContextValue("current_task_id")
         # per-task user profile spans (ray_tpu.util.tracing.profile),
         # shipped with the TASK_DONE reply into the GCS event store
-        self._profile_spans = _ContextItems("profile_spans")
+        self._profile_spans = _ContextValue("profile_spans")
         self.actor_instance = None
         self.actor_id: Optional[ActorID] = None
         # normalized runtime env this worker runs inside (child tasks
@@ -363,9 +346,10 @@ class WorkerRuntime:
                 traceback.print_exc()
 
     # --- control plane --------------------------------------------------
-    def gcs_call(self, method: str, *args) -> Any:
+    def gcs_call(self, method: str, *args, timeout: float = 30.0) -> Any:
         reply = self.request({"kind": "GCS_REQUEST", "method": method,
-                              "args": serialization.dumps(args)}, timeout=30.0)
+                              "args": serialization.dumps(args)},
+                             timeout=timeout)
         if reply.get("error"):
             raise serialization.loads(reply["error"])
         return serialization.loads(reply["result"])
@@ -499,7 +483,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
         reply["error_str"] = str(rt.setup_error)
         return reply
     import time as _time
-    rt._profile_spans.items = []
+    rt._profile_spans.value = []
     reply["t_start"] = _time.time()
     try:
         args, kwargs = _resolve_args(rt, spec)
@@ -519,7 +503,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
         return _pack_error(spec, reply)
     finally:
         reply["t_end"] = _time.time()
-        spans = getattr(rt._profile_spans, "items", None)
+        spans = rt._profile_spans.value
         if spans:
             reply["profile"] = spans
         rt._current_task_id.value = None
@@ -537,7 +521,7 @@ async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     reply: dict = {"kind": "TASK_DONE", "task_id": spec.task_id.binary(),
                    "spec_is_actor_creation": False}
     import time as _time
-    rt._profile_spans.items = []
+    rt._profile_spans.value = []
     reply["t_start"] = _time.time()
     loop = asyncio.get_running_loop()
     try:
@@ -564,7 +548,7 @@ async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
         return _pack_error(spec, reply)
     finally:
         reply["t_end"] = _time.time()
-        spans = rt._profile_spans.items
+        spans = rt._profile_spans.value
         if spans:
             reply["profile"] = spans
         rt._current_task_id.value = None

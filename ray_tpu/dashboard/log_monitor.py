@@ -62,6 +62,9 @@ class LogMonitor:
             size = os.path.getsize(path)
             if size < offset:
                 offset = 0  # file rotated/truncated: start over
+                # drop any dangling pre-rotation line fragment — it
+                # must not splice onto the new file's first line
+                self._partial.pop(path, None)
             if size == offset:
                 return
             if not self._echo:

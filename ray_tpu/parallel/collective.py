@@ -18,12 +18,28 @@ transfer reads from anyway).
 
 Ops must be called in the same order by every rank of a group (the
 standard collective contract).
+
+Design notes (round-2 rework):
+- Rendezvous is EVENT-DRIVEN: ranks block on a GCS ``kv_wait`` (head
+  fires the reply when the key lands) instead of polling — no 2ms
+  busy-loops, no per-wait head load (reference analog: long-poll
+  subscribers, src/ray/pubsub/publisher.h:245).
+- Payloads above an inline threshold move through the OBJECT PLANE
+  (put → ref in KV → peers get()), so tensor bytes travel shm/direct
+  node-to-node transfer, not inline through the head's control socket.
+- ``allreduce`` is a binomial TREE (reduce up, broadcast down):
+  2·log2(world) p2p transfers instead of world² reads through one
+  process.
+- Round keys are garbage-collected LAZILY one round behind: a rank
+  completing round S has read every round-S deposit, which proves all
+  ranks finished round S-1 — so S-1's keys and payload refs are
+  reclaimed then, with the remainder swept by destroy_collective_group.
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -33,7 +49,8 @@ from ray_tpu.core import serialization
 from ray_tpu.exceptions import GetTimeoutError
 
 _DEFAULT_TIMEOUT = 60.0
-_POLL_S = 0.002
+# payloads larger than this ride the object plane instead of the KV
+_INLINE_MAX = 32 * 1024
 
 
 def _kv_put(key: str, value: bytes) -> None:
@@ -60,14 +77,44 @@ def _kv_del(key: str) -> None:
 
 
 def _kv_wait(key: str, timeout: float) -> bytes:
-    deadline = time.monotonic() + timeout
-    while True:
-        value = _kv_get(key)
-        if value is not None:
-            return value
-        if time.monotonic() >= deadline:
-            raise GetTimeoutError(f"collective rendezvous timed out on {key}")
-        time.sleep(_POLL_S)
+    """Block until the key exists — event-driven: the head wakes us via
+    the KV waiter hook (gcs.py KVStore.add_waiter), no polling."""
+    rt = runtime_mod.get_runtime()
+    if rt.is_driver:
+        value = rt.gcs.kv.wait(key.encode(), namespace="collective",
+                               timeout=timeout)
+    else:
+        value = rt.gcs_call("kv_wait", key.encode(), "collective", timeout,
+                            timeout=timeout + 10.0)
+    if value is None:
+        raise GetTimeoutError(f"collective rendezvous timed out on {key}")
+    return value
+
+
+def _pack_payload(value: Optional[np.ndarray], keepalive: List) -> bytes:
+    """Inline small tensors; large ones go through the object plane so
+    the bytes move node-to-node, not through the head's control socket.
+    The producer must keep ``keepalive`` refs until consumers have
+    certainly read (see the round-GC invariant in the module docstring)."""
+    if value is None:
+        return b""
+    blob = serialization.pack(value)
+    if len(blob) <= _INLINE_MAX:
+        return b"I" + blob
+    import ray_tpu
+    ref = ray_tpu.put(value)
+    keepalive.append(ref)
+    return b"R" + serialization.dumps(ref)
+
+
+def _unpack_payload(blob: bytes) -> Optional[np.ndarray]:
+    if not blob:
+        return None
+    tag, body = blob[:1], blob[1:]
+    if tag == b"I":
+        return serialization.unpack(body)
+    import ray_tpu
+    return ray_tpu.get(serialization.loads(body))
 
 
 @dataclass
@@ -76,6 +123,8 @@ class GroupInfo:
     rank: int
     name: str
     seq: int = 0
+    # round → this rank's keys + object refs pending lazy GC
+    pending_gc: Dict[int, List] = field(default_factory=dict)
 
 
 _groups: Dict[str, GroupInfo] = {}
@@ -90,8 +139,39 @@ def init_collective_group(world_size: int, rank: int,
     _kv_put(f"grp/{group_name}/{rank}", str(world_size).encode())
 
 
-def destroy_collective_group(group_name: str = "default") -> None:
-    _groups.pop(group_name, None)
+def destroy_collective_group(group_name: str = "default",
+                             timeout: float = _DEFAULT_TIMEOUT) -> None:
+    """Tear down a group. This is itself a COLLECTIVE call — every rank
+    must call it, like the ops. A closing barrier proves all ranks
+    finished the last real op, making its keys/refs safe to reclaim
+    (the lazy-GC invariant covers only rounds strictly before the one a
+    rank just completed — GC'ing the in-flight round here would yank
+    keys out from under slower peers). The barrier round's own
+    world_size empty keys are intentionally leaked: deleting them has
+    the same race, and they are ~20 bytes each."""
+    group = _groups.pop(group_name, None)
+    if group is None:
+        return
+    barrier_seq = group.seq
+    try:
+        _groups[group_name] = group  # barrier() needs the group entry
+        barrier(group_name=group_name, timeout=timeout)
+    finally:
+        _groups.pop(group_name, None)
+    for seq in list(group.pending_gc):
+        if seq < barrier_seq:
+            _gc_round(group, seq)
+    _kv_del(f"grp/{group.name}/{group.rank}")
+
+
+def _gc_round(group: GroupInfo, seq: int) -> None:
+    """Reclaim this rank's keys + payload refs from a finished round."""
+    entries = group.pending_gc.pop(seq, None)
+    if not entries:
+        return
+    for key in entries[0]:
+        _kv_del(key)
+    entries[1].clear()  # drop ObjectRefs → owner may reclaim
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -113,42 +193,87 @@ def _group(group_name: str) -> GroupInfo:
 
 def _exchange(group: GroupInfo, tensor: Optional[np.ndarray],
               timeout: float) -> List[Optional[np.ndarray]]:
-    """All ranks deposit, all ranks read everyone's payload."""
+    """All ranks deposit, all ranks read everyone's payload.
+
+    GC invariant: completing round S required reading every rank's
+    round-S deposit, and a rank deposits in S only after fully finishing
+    S-1 — so on completing S, round S-1's keys/refs are provably done
+    and are reclaimed here (each rank deletes its own; idempotent)."""
     seq = group.seq
     group.seq += 1
     prefix = f"col/{group.name}/{seq}"
-    _kv_put(f"{prefix}/{group.rank}",
-            serialization.pack(tensor) if tensor is not None else b"")
+    my_key = f"{prefix}/{group.rank}"
+    keepalive: List = []
+    _kv_put(my_key, _pack_payload(tensor, keepalive))
+    group.pending_gc[seq] = [[my_key], keepalive]
     out: List[Optional[np.ndarray]] = []
     for rank in range(group.world_size):
         blob = _kv_wait(f"{prefix}/{rank}", timeout)
-        out.append(serialization.unpack(blob) if blob else None)
-    # Everyone acks; the last rank out cleans the round's keys.
-    _kv_put(f"{prefix}/ack/{group.rank}", b"1")
-    if all(_kv_get(f"{prefix}/ack/{r}") is not None
-           for r in range(group.world_size)):
-        # Last rank out cleans payload AND ack keys — without this the
-        # head KV leaks world_size entries per collective call.
-        for rank in range(group.world_size):
-            _kv_del(f"{prefix}/{rank}")
-            _kv_del(f"{prefix}/ack/{rank}")
+        out.append(_unpack_payload(blob))
+    _gc_round(group, seq - 1)
     return out
 
 
-_REDUCE_OPS = {
-    "sum": lambda xs: np.sum(xs, axis=0),
-    "prod": lambda xs: np.prod(xs, axis=0),
-    "max": lambda xs: np.max(xs, axis=0),
-    "min": lambda xs: np.min(xs, axis=0),
-    "mean": lambda xs: np.mean(xs, axis=0),
+_PAIR_OPS = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "max": np.maximum,
+    "min": np.minimum,
 }
 
 
 def allreduce(tensor, op: str = "sum", group_name: str = "default",
               timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
+    """Binomial-tree allreduce: partial sums flow up the tree (log2
+    rounds of p2p transfers), the root broadcasts the result back down —
+    2·log2(world) payload movements total vs the naive world² reads of
+    an all-to-all through one KV (reference analog: NCCL's tree
+    algorithms; here payloads ride the object plane between nodes)."""
     group = _group(group_name)
-    parts = _exchange(group, np.asarray(tensor), timeout)
-    return _REDUCE_OPS[op](np.stack([np.asarray(p) for p in parts]))
+    world, rank = group.world_size, group.rank
+    pair = _PAIR_OPS["sum" if op == "mean" else op]
+    acc = np.asarray(tensor)
+    if world == 1:
+        return acc / world if op == "mean" else acc.copy()
+    seq = group.seq
+    group.seq += 1
+    prefix = f"col/{group.name}/{seq}"
+    my_keys: List[str] = []
+    keepalive: List = []
+    group.pending_gc[seq] = [my_keys, keepalive]
+
+    # reduce up: at level k, odd multiples of k send to even multiples
+    k = 1
+    sent_at = 0  # level at which this rank handed off (0 = never → root)
+    while k < world:
+        if rank % (2 * k) == k:
+            dst = rank - k
+            key = f"{prefix}/up/{rank}"
+            _kv_put(key, _pack_payload(acc, keepalive))
+            my_keys.append(key)
+            sent_at = k
+            break
+        if rank % (2 * k) == 0 and rank + k < world:
+            blob = _kv_wait(f"{prefix}/up/{rank + k}", timeout)
+            acc = pair(acc, _unpack_payload(blob))
+        k *= 2
+
+    # broadcast down: reverse the tree, highest level first
+    top = 1
+    while top < world:
+        top *= 2
+    k = top // 2
+    while k >= 1:
+        if rank % (2 * k) == k and k == sent_at:
+            blob = _kv_wait(f"{prefix}/down/{rank}", timeout)
+            acc = _unpack_payload(blob)
+        elif rank % (2 * k) == 0 and rank + k < world:
+            key = f"{prefix}/down/{rank + k}"
+            _kv_put(key, _pack_payload(acc, keepalive))
+            my_keys.append(key)
+        k //= 2
+    _gc_round(group, seq - 1)
+    return acc / world if op == "mean" else acc
 
 
 def allgather(tensor, group_name: str = "default",

@@ -70,7 +70,9 @@ class StepProfiler:
         if not self._enabled:
             return
         import jax
-        if step == self._start and not self._active:
+        # range check, not equality: resumed loops start at arbitrary
+        # step counters and must still hit the window
+        if self._start <= step < self._stop and not self._active:
             os.makedirs(self._logdir, exist_ok=True)
             jax.profiler.start_trace(self._logdir)
             self._active = True

@@ -29,7 +29,7 @@ def profile(name: str):
     from ray_tpu.core import runtime as runtime_mod
     rt = runtime_mod.get_runtime_or_none()
     spans = getattr(rt, "_profile_spans", None) if rt is not None else None
-    items = getattr(spans, "items", None) if spans is not None else None
+    items = spans.value if spans is not None else None
     t0 = time.time()
     try:
         yield

@@ -78,3 +78,61 @@ def test_allreduce_and_friends(ray_start_regular):
 
     sr = ray_tpu.get([w.do_sendrecv.remote() for w in workers], timeout=90)
     np.testing.assert_array_equal(sr[1], np.array([42.0]))
+
+
+def test_tree_allreduce_odd_world(ray_start_regular):
+    """5 ranks: exercises the binomial tree with a non-power-of-two
+    world (uneven tree depth) and repeated rounds (lazy key GC).
+    Zero-CPU actors: 5 ranks must all be schedulable on the 4-CPU
+    fixture or the group never forms."""
+    @ray_tpu.remote(num_cpus=0)
+    class OddWorker:
+        def __init__(self, rank, world):
+            from ray_tpu.parallel import collective
+            collective.init_collective_group(world, rank, "oddgrp")
+            self.rank = rank
+
+        def go(self, op):
+            from ray_tpu.parallel import collective
+            return collective.allreduce(
+                np.full(4, self.rank + 1.0), op=op, group_name="oddgrp")
+
+    workers = [OddWorker.remote(i, 5) for i in range(5)]
+    for _round in range(3):
+        out = ray_tpu.get([w.go.remote("sum") for w in workers],
+                          timeout=90)
+        expected = np.full(4, float(sum(range(1, 6))))
+        for arr in out:
+            np.testing.assert_array_equal(arr, expected)
+    out = ray_tpu.get([w.go.remote("mean") for w in workers], timeout=90)
+    for arr in out:
+        np.testing.assert_array_equal(arr, np.full(4, 3.0))
+
+
+def test_large_payload_object_plane(ray_start_regular):
+    """Payloads above the inline threshold ride the object plane; the
+    reduced result must still be exact."""
+    @ray_tpu.remote
+    class BigWorker:
+        def __init__(self, rank, world):
+            from ray_tpu.parallel import collective
+            collective.init_collective_group(world, rank, "biggrp")
+            self.rank = rank
+
+        def go(self):
+            from ray_tpu.parallel import collective
+            big = np.full((256, 256), self.rank + 1.0)  # 512KB >> inline
+            out = collective.allreduce(big, group_name="biggrp")
+            bc = collective.broadcast(
+                np.arange(100_000, dtype=np.float64)
+                if self.rank == 0 else np.zeros(100_000),
+                src_rank=0, group_name="biggrp")
+            return float(out[0, 0]), float(bc[-1])
+
+    workers = [BigWorker.remote(i, 3) for i in range(3)]
+    results = ray_tpu.get([w.go.remote() for w in workers], timeout=120)
+    for total, tail in results:
+        assert total == 6.0
+        assert tail == 99_999.0
+
+
