@@ -21,6 +21,9 @@ class DataContext:
     op_resource_budget_fraction: float = 1.0
     max_tasks_in_flight_per_op: int = 8
     max_blocks_in_op_output_queue: int = 32
+    # Global queued-bytes budget for one stream; sources pause above it
+    # (None = half the object store; see execution.ResourceManager).
+    memory_budget_bytes: Optional[int] = None
     # Defaults for map_batches.
     default_batch_format: str = "numpy"
     # Read parallelism when not specified.

@@ -119,6 +119,19 @@ class Dataset:
     def zip(self, other: "Dataset") -> "Dataset":
         return self._with_op(L.Zip(self._plan.dag, other._plan.dag))
 
+    def join(self, other: "Dataset", on: Union[str, List[str]], *,
+             how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Hash join on key column(s). ``how``: inner | left | right |
+        outer. Both sides are hash-partitioned on ``on`` and partitions
+        join independently (reference: Dataset.join backed by the
+        hash-shuffle join operator, data/_internal/execution/operators/
+        join.py). Identically-named non-key columns from ``other`` get
+        an ``_r`` suffix."""
+        return self._with_op(L.Join(self._plan.dag, other._plan.dag,
+                                    on=on, how=how,
+                                    num_partitions=num_partitions))
+
     def random_sample(self, fraction: float,
                       seed: Optional[int] = None) -> "Dataset":
         rng_seed = seed if seed is not None else 0x5EED

@@ -89,6 +89,11 @@ def _sort_sample_task(block: Block, keys: List[str]):
 
 def _sort_partition_task(block: Block, keys: List[str], boundaries,
                          descending: bool, n: int):
+    if block.num_rows == 0 and not block.column_names:
+        # schema-less empty block (e.g. a row-map over zero rows):
+        # nothing to sort, and sort_by on missing keys would raise
+        empty = block
+        return empty if n == 1 else tuple(empty for _ in range(n))
     acc = BlockAccessor(block)
     sorted_block = acc.sort(keys, descending)
     if n == 1:
@@ -144,6 +149,26 @@ def _zip_task(left: Block, right: Block):
         out_name = name if name not in cols else name + "_1"
         cols[out_name] = right.column(name)
     out = pa.table(cols)
+    return out, _meta(out)
+
+
+_JOIN_TYPES = {
+    "inner": "inner",
+    "left": "left outer",
+    "right": "right outer",
+    "outer": "full outer",
+}
+
+
+def _join_partition_task(keys: List[str], how: str, n_left: int,
+                         *parts: Block):
+    """Join one hash partition: the first ``n_left`` parts are the left
+    side's shards, the rest the right's (reference analog: hash_shuffle
+    join reducers, data/_internal/execution/operators/join.py)."""
+    left = BlockAccessor.concat(list(parts[:n_left]))
+    right = BlockAccessor.concat(list(parts[n_left:]))
+    out = left.join(right, keys=keys, join_type=_JOIN_TYPES[how],
+                    right_suffix="_r")
     return out, _meta(out)
 
 
@@ -232,6 +257,19 @@ class UnionPhysicalOp(PhysicalOp):
         super().__init__("Union", inputs)
 
 
+class JoinPhysicalOp(PhysicalOp):
+    def __init__(self, left: PhysicalOp, right: PhysicalOp, *,
+                 on: List[str], how: str = "inner",
+                 num_partitions: Optional[int] = None):
+        super().__init__(f"Join({how})", [left, right])
+        if how not in _JOIN_TYPES:
+            raise ValueError(
+                f"unknown join type {how!r}; one of {list(_JOIN_TYPES)}")
+        self.on = list(on)
+        self.how = how
+        self.num_partitions = num_partitions
+
+
 class ZipPhysicalOp(PhysicalOp):
     def __init__(self, left: PhysicalOp, right: PhysicalOp):
         super().__init__("Zip", [left, right])
@@ -316,6 +354,45 @@ class _OpState:
                 and len(self.outqueue) < self.ctx.max_blocks_in_op_output_queue)
 
 
+class ResourceManager:
+    """Global memory accounting + source backpressure for one stream
+    (reference: data/_internal/execution/resource_manager.py and the
+    backpressure policies under execution/backpressure_policy/ — here
+    two policies are built in: a per-op concurrency/output-queue cap
+    (_OpState.under_limits) and this global queued-bytes budget that
+    pauses sources while the pipeline holds too much data)."""
+
+    def __init__(self, states: Dict[int, "_OpState"], ctx: DataContext):
+        self._states = states
+        from ray_tpu.core.config import get_config
+        self.budget = (ctx.memory_budget_bytes
+                       or get_config().object_store_memory // 2)
+        self.peak_queued_bytes = 0
+
+    def queued_bytes(self) -> int:
+        total = 0
+        for st in self._states.values():
+            for q in (st.outqueue, *st.inqueues):
+                for bundle in q:
+                    total += bundle.metadata.size_bytes or 0
+        if total > self.peak_queued_bytes:
+            self.peak_queued_bytes = total
+        return total
+
+    def refresh(self) -> None:
+        """Recompute once per scheduling step — a full queue walk per
+        dispatch attempt would be O(blocks x queued) over a run; the
+        within-step staleness only adds the same slack class as
+        in-flight task outputs."""
+        self._cached = self.queued_bytes()
+
+    def allow_source_dispatch(self) -> bool:
+        cached = getattr(self, "_cached", None)
+        if cached is None:
+            cached = self.queued_bytes()
+        return cached < self.budget
+
+
 class StreamingExecutor:
     """Executes a physical DAG, yielding output RefBundles as they become
     available. Pull-based: work only advances while the consumer iterates,
@@ -327,6 +404,7 @@ class StreamingExecutor:
         self.states: Dict[int, _OpState] = {}
         self.topo: List[PhysicalOp] = []
         self._build(dag)
+        self.resource_manager = ResourceManager(self.states, self.ctx)
         # pending task ref -> completion callback info
         self.pending: Dict[Any, Tuple] = {}
 
@@ -495,6 +573,51 @@ class StreamingExecutor:
             meta = ray_tpu.get(m_ref)
             st.outqueue.append(RefBundle(b_ref, meta, order=i))
 
+    def _run_join(self, op: JoinPhysicalOp, st: _OpState):
+        """Hash-partition both sides on the join keys, join partitions
+        independently (barrier, like the reference's hash-shuffle join)."""
+        left = sorted(st.inqueues[0], key=lambda b: b.order)
+        st.inqueues[0].clear()
+        right = sorted(st.inqueues[1], key=lambda b: b.order)
+        st.inqueues[1].clear()
+        if not left or not right:
+            # empty result cases need no schema: inner always, and an
+            # outer-preserved side that is itself empty
+            if (op.how == "inner" or (not left and not right)
+                    or (op.how == "left" and not left)
+                    or (op.how == "right" and not right)):
+                return
+            raise ValueError(
+                f"cannot {op.how}-join against an empty dataset: the "
+                "empty side's schema is unknown (materialize it with a "
+                "schema or use an inner join)")
+        n_parts = op.num_partitions or max(len(left), len(right))
+        part_refs = []  # per input block: list of n_parts shard refs
+        for bundles in (left, right):
+            for b in bundles:
+                shards = ray_tpu.remote(num_returns=n_parts)(
+                    _groupby_map_task).remote(b.block_ref, op.on, n_parts)
+                if n_parts == 1:
+                    shards = [shards]
+                part_refs.append(shards)
+        n_left = len(left)
+        # launch every partition's join first, then collect metas — a
+        # get() inside the launch loop would serialize the reducers
+        pairs = [
+            ray_tpu.remote(num_returns=2)(_join_partition_task).remote(
+                op.on, op.how, n_left,
+                *[part_refs[j][i] for j in range(len(part_refs))])
+            for i in range(n_parts)
+        ]
+        metas = ray_tpu.get([m for _b, m in pairs])
+        order = 0
+        for (b, _m), meta in zip(pairs, metas):
+            if meta.num_rows == 0:
+                continue  # keys may hash to few partitions; don't emit
+                # schema-losing empty blocks downstream
+            st.outqueue.append(RefBundle(b, meta, order=order))
+            order += 1
+
     def _run_zip(self, op: ZipPhysicalOp, st: _OpState):
         left = sorted(st.inqueues[0], key=lambda b: b.order)
         st.inqueues[0].clear()
@@ -580,6 +703,7 @@ class StreamingExecutor:
 
     def _step(self) -> bool:
         progressed = False
+        self.resource_manager.refresh()
         # 1. Completions.
         if self.pending:
             ready, _ = ray_tpu.wait(list(self.pending.keys()),
@@ -626,6 +750,15 @@ class StreamingExecutor:
                 for b in list(st.outqueue) if op is not self.dag else []:
                     self._forward(op, b)
                 if op is not self.dag:
+                    st.outqueue.clear()
+                self._mark_finished(op)
+                progressed = True
+            elif isinstance(op, JoinPhysicalOp) and all(st.inputs_done) \
+                    and st.in_flight == 0:
+                self._run_join(op, st)
+                if op is not self.dag:
+                    for b in list(st.outqueue):
+                        self._forward(op, b)
                     st.outqueue.clear()
                 self._mark_finished(op)
                 progressed = True
@@ -691,10 +824,40 @@ class StreamingExecutor:
         for op in reversed(self.topo):
             st = self.states[id(op)]
             if st.finished or isinstance(
-                    op, (AllToAllPhysicalOp, ZipPhysicalOp, LimitPhysicalOp,
-                         UnionPhysicalOp, InputDataOp)):
+                    op, (AllToAllPhysicalOp, ZipPhysicalOp, JoinPhysicalOp,
+                         LimitPhysicalOp, UnionPhysicalOp, InputDataOp)):
                 continue
             while st.has_input() and st.under_limits():
+                if (isinstance(op, ReadPhysicalOp)
+                        and not self.resource_manager.allow_source_dispatch()
+                        and self._work_elsewhere(op)):
+                    # memory backpressure: sources pause while queued
+                    # bytes exceed the budget — unless nothing else can
+                    # progress, which would deadlock the pipeline
+                    break
                 self._dispatch(op, st)
                 progressed = True
         return progressed
+
+    def _work_elsewhere(self, source: PhysicalOp) -> bool:
+        """True if something else can make progress THIS step — i.e.
+        pausing this source cannot deadlock the stream. Barrier ops
+        (sort/join/zip/...) buffering input do NOT count: they can't run
+        until their sources finish, so treating their backlog as
+        progress would pause the source forever and trip the executor's
+        deadlock check."""
+        if self.pending:
+            return True
+        for other in self.topo:
+            if other is source or isinstance(other, ReadPhysicalOp):
+                continue
+            st = self.states[id(other)]
+            if st.finished:
+                continue
+            if isinstance(other, (AllToAllPhysicalOp, ZipPhysicalOp,
+                                  JoinPhysicalOp)):
+                if all(st.inputs_done) and st.has_input():
+                    return True  # barrier will actually fire this step
+            elif st.has_input():
+                return True
+        return False

@@ -95,6 +95,15 @@ class Union(LogicalOp):
         super().__init__("Union", inputs)
 
 
+class Join(LogicalOp):
+    def __init__(self, left: "LogicalOp", right: "LogicalOp", *,
+                 on, how: str = "inner", num_partitions=None):
+        super().__init__(f"Join({how})", [left, right])
+        self.on = [on] if isinstance(on, str) else list(on)
+        self.how = how
+        self.num_partitions = num_partitions
+
+
 class Zip(LogicalOp):
     def __init__(self, left: LogicalOp, right: LogicalOp):
         super().__init__("Zip", [left, right])

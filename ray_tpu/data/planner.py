@@ -22,6 +22,7 @@ from ray_tpu.data.execution import (
     RefBundle,
     UnionPhysicalOp,
     WritePhysicalOp,
+    JoinPhysicalOp,
     ZipPhysicalOp,
 )
 from ray_tpu.data.transforms import MapTransform
@@ -89,6 +90,11 @@ class Planner:
             return LimitPhysicalOp(self._lower(op.inputs[0], memo), op.limit)
         if isinstance(op, L.Union):
             return UnionPhysicalOp([self._lower(i, memo) for i in op.inputs])
+        if isinstance(op, L.Join):
+            return JoinPhysicalOp(self._lower(op.inputs[0], memo),
+                                  self._lower(op.inputs[1], memo),
+                                  on=op.on, how=op.how,
+                                  num_partitions=op.num_partitions)
         if isinstance(op, L.Zip):
             return ZipPhysicalOp(self._lower(op.inputs[0], memo),
                                  self._lower(op.inputs[1], memo))

@@ -236,3 +236,122 @@ def test_groupby_map_groups():
     rows = sorted(out.take_all(), key=lambda r: r["g"])
     assert rows == [{"g": 0, "total": 0 + 2 + 4 + 6 + 8},
                     {"g": 1, "total": 1 + 3 + 5 + 7 + 9}]
+
+
+# --- join -----------------------------------------------------------------
+
+def _join_reference(left_rows, right_rows, on, how):
+    """Plain-python join oracle."""
+    import collections
+    right_by_key = collections.defaultdict(list)
+    for r in right_rows:
+        right_by_key[tuple(r[k] for k in on)].append(r)
+    out = []
+    matched_right = set()
+    for l in left_rows:
+        key = tuple(l[k] for k in on)
+        matches = right_by_key.get(key, [])
+        if matches:
+            for r in matches:
+                matched_right.add(id(r))
+                row = dict(l)
+                for k, v in r.items():
+                    if k not in on:
+                        row[k + "_r" if k in l else k] = v
+                out.append(row)
+        elif how in ("left", "outer"):
+            out.append(dict(l))
+    if how in ("right", "outer"):
+        for rows in right_by_key.values():
+            for r in rows:
+                if id(r) not in matched_right:
+                    out.append({k: v for k, v in r.items()})
+    return out
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "outer"])
+def test_join(how):
+    left_rows = [{"k": i % 5, "lv": i} for i in range(12)]
+    right_rows = [{"k": i, "rv": i * 10} for i in range(3, 8)]
+    left = rd.from_items(left_rows, parallelism=3)
+    right = rd.from_items(right_rows, parallelism=2)
+    got = left.join(right, on="k", how=how, num_partitions=4).take_all()
+    want = _join_reference(left_rows, right_rows, ["k"], how)
+
+    def norm(rows):
+        return sorted(
+            (tuple(sorted((k, v) for k, v in r.items() if v is not None)))
+            for r in rows)
+    assert norm(got) == norm(want), (how, len(got), len(want))
+
+
+def test_join_multi_key_and_suffix():
+    left = rd.from_items(
+        [{"a": i % 2, "b": i % 3, "v": i} for i in range(12)])
+    right = rd.from_items(
+        [{"a": i % 2, "b": i % 3, "v": 100 + i} for i in range(6)])
+    out = left.join(right, on=["a", "b"], how="inner").take_all()
+    assert out, "multi-key inner join produced nothing"
+    assert all("v" in r and "v_r" in r for r in out)
+
+
+def test_join_empty_side():
+    left = rd.from_items([{"k": 1, "v": 2}])
+    empty = rd.from_items([{"k": 9, "w": 0}]).filter(lambda r: False)
+    assert left.join(empty, on="k", how="inner").take_all() == []
+
+
+def test_memory_backpressure_budget():
+    """A stream over ~8MB of blocks with a 1MB budget must still finish,
+    and queued bytes must stay near the budget (sources pause)."""
+    from ray_tpu.data.context import DataContext
+    ctx = DataContext.get_current()
+    old = ctx.memory_budget_bytes
+    ctx.memory_budget_bytes = 1 * 1024 * 1024
+    try:
+        ds = rd.range_tensor(64, shape=(16384,), parallelism=16)  # 8MB
+        total = 0
+        it = ds.map_batches(lambda b: b, batch_format="numpy")
+        executor = None
+        for batch in it.iter_batches(batch_size=None):
+            total += 1
+        assert total > 0
+        # peak accounting: rebuild with explicit executor to observe
+        from ray_tpu.data.planner import Planner
+        from ray_tpu.data.execution import StreamingExecutor
+        plan = Planner().plan(ds._plan)
+        ex = StreamingExecutor(plan)
+        for _ in ex.execute():
+            pass
+        budget = ex.resource_manager.budget
+        # sources pause above budget; in-flight tasks can overshoot by
+        # roughly one round of task outputs
+        slack = 16 * 128 * 1024  # one block per in-flight task
+        assert ex.resource_manager.peak_queued_bytes <= budget + slack, (
+            ex.resource_manager.peak_queued_bytes, budget)
+    finally:
+        ctx.memory_budget_bytes = old
+
+
+def test_sort_with_tiny_budget_no_deadlock():
+    """Barrier ops buffering more than the budget must not deadlock the
+    source-pause logic (the barrier can't consume until sources finish)."""
+    from ray_tpu.data.context import DataContext
+    ctx = DataContext.get_current()
+    old = ctx.memory_budget_bytes
+    ctx.memory_budget_bytes = 64 * 1024  # far below the dataset size
+    try:
+        ds = rd.from_items(
+            [{"id": i, "pad": "x" * 8192} for i in range(64)],
+            parallelism=8)  # 512KB total >> 64KB budget
+        out = ds.sort("id").take(3)
+        assert [r["id"] for r in out] == [0, 1, 2]
+    finally:
+        ctx.memory_budget_bytes = old
+
+
+def test_left_join_empty_left_is_empty():
+    left = rd.from_items([{"k": 1, "v": 2}]).filter(lambda r: False)
+    right = rd.from_items([{"k": 1, "w": 3}])
+    assert left.join(right, on="k", how="left").take_all() == []
+    assert right.join(left, on="k", how="right").take_all() == []
