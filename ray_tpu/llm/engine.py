@@ -13,8 +13,18 @@ continuous batching, paged KV). TPU-native redesign (JetStream-style):
 - Prefill pads prompts into power-of-two buckets so only O(log S)
   prefill programs ever compile.
 
-Sampling (temperature / top-k / greedy) is host-side numpy on [B, V]
-logits — tiny relative to the decode matmuls and trivially flexible.
+Sampling (temperature / top-k / greedy) is ON-DEVICE, fused into the
+jitted decode step: only the sampled [B] int32 tokens cross to the
+host each iteration, not [B, V] float logits (at 32k vocab x batch 8
+that copy would eat the decode budget). Per-request temperature/top-k
+ride in as [B] arrays; randomness is a counter-folded PRNG key so the
+program never recompiles.
+
+Multi-LoRA multiplexing (reference: vLLM multi-LoRA behind
+serve.llm): adapters register into a fixed-size bank ({A,B} stacks,
+index 0 = all-zero base); each request may name an adapter, and the
+batched decode gathers per-slot A/B — different requests in the SAME
+decode batch can use different adapters.
 """
 
 from __future__ import annotations
@@ -41,6 +51,15 @@ class EngineConfig:
     max_seq: int = 512
     tokenizer: Optional[str] = None  # None/"byte" or an HF id
     seed: int = 0
+    # multi-LoRA bank size (adapter slot 0 is the zero/base adapter);
+    # 0 disables the LoRA path entirely (no bank in the decode program)
+    max_loras: int = 0
+    lora_rank: int = 8
+    # Static top-k width for on-device sampling: XLA needs a fixed
+    # lax.top_k width, so per-request top_k is CLAMPED to this (also at
+    # add_request, so the effective value is visible on the request).
+    # top_k=0 samples the full vocab.
+    max_top_k: int = 256
 
 
 @dataclass
@@ -50,6 +69,8 @@ class GenerationRequest:
     temperature: float = 0.0
     top_k: int = 0
     stop_ids: tuple = ()
+    # LoRA adapter name (must be register_adapter'd); None = base model
+    adapter: Optional[str] = None
     request_id: int = field(default_factory=itertools.count().__next__)
     # Streaming: when set (queue.Queue), the stepper pushes each emitted
     # token as it decodes; None terminates the stream (reference: vLLM's
@@ -91,21 +112,71 @@ class ContinuousBatchingEngine:
             # random weights — real checkpoints load via orbax/train
             params = llama_init(jax.random.PRNGKey(config.seed), c)
         self.params = params
-        self._rng = np.random.default_rng(config.seed)
         self.cache_k, self.cache_v = llama_init_cache(
             c, config.max_batch, config.max_seq)
         self.slots = [_Slot(i) for i in range(config.max_batch)]
         self.waiting: List[GenerationRequest] = []
         self._lock = threading.Lock()
         self.total_generated = 0
+        self._base_key = jax.random.PRNGKey(config.seed)
+        self._step_counter = 0
+        # multi-LoRA bank: slot 0 is the all-zero base adapter, so
+        # "no adapter" needs no conditional in the decode program
+        self._adapters: Dict[str, int] = {}
+        self._adapter_prefill: Dict[str, Any] = {}
+        self._next_adapter_slot = 1  # slot 0 = base (all-zero)
+        if config.max_loras > 0:
+            n, r, hd = config.max_loras + 1, config.lora_rank, c.head_dim
+            self.lora_bank = {
+                "A_q": jnp.zeros((n, c.n_layers, c.dim, r), c.dtype),
+                "B_q": jnp.zeros((n, c.n_layers, r, c.n_heads * hd),
+                                 c.dtype),
+                "A_v": jnp.zeros((n, c.n_layers, c.dim, r), c.dtype),
+                "B_v": jnp.zeros((n, c.n_layers, r, c.n_kv_heads * hd),
+                                 c.dtype),
+                # per-adapter scale folded into B at registration
+                "scale": jnp.asarray(1.0, c.dtype),
+            }
+        else:
+            self.lora_bank = None
 
-        def decode(params, cache_k, cache_v, tokens, pos):
-            return llama_decode_step(params, tokens, cache_k, cache_v,
-                                     pos, c)
+        max_k = min(config.max_top_k, c.vocab_size)
 
-        def prefill(params, tokens):
-            logits, ks, vs = llama_prefill(params, tokens, c)
-            return logits, ks, vs
+        def sample_tokens(logits, temp, topk, key):
+            """On-device sampling: greedy / temperature / top-k per
+            slot, [B, V] logits -> [B] int32 — only the token ids cross
+            to the host."""
+            n_b = logits.shape[0]
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
+            keys = jax.random.split(key, n_b)
+            full = jax.vmap(jax.random.categorical)(
+                keys, scaled).astype(jnp.int32)
+            vals, idx = jax.lax.top_k(scaled, max_k)
+            mask = (jnp.arange(max_k)[None, :]
+                    < jnp.clip(topk, 1, max_k)[:, None])
+            vals = jnp.where(mask, vals, -jnp.inf)
+            choice = jax.vmap(jax.random.categorical)(keys, vals)
+            topk_tok = jnp.take_along_axis(
+                idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)
+            sampled = jnp.where(topk > 0, topk_tok, full)
+            return jnp.where(temp <= 0.0, greedy, sampled)
+
+        def decode(params, cache_k, cache_v, tokens, pos, temp, topk,
+                   base_key, step, lora_bank, lora_idx):
+            logits, ck, cv = llama_decode_step(
+                params, tokens, cache_k, cache_v, pos, c,
+                lora_bank=lora_bank, lora_idx=lora_idx)
+            key = jax.random.fold_in(base_key, step)
+            return sample_tokens(logits, temp, topk, key), ck, cv
+
+        def prefill(params, tokens, lora):
+            return llama_prefill(params, tokens, c, lora=lora)
+
+        def sample_one(logits, temp, topk, key):
+            return sample_tokens(
+                logits[None, :], jnp.full((1,), temp),
+                jnp.full((1,), topk, dtype=jnp.int32), key)[0]
 
         def insert(cache_k, cache_v, ks, vs, slot):
             # in-place (donated) slot write — no whole-cache copy.
@@ -116,16 +187,82 @@ class ContinuousBatchingEngine:
                 cache_v, vs, (0, slot, 0, 0, 0))
             return ck, cv
 
-        self._decode = jax.jit(decode, donate_argnums=(1, 2))
+        self._decode = jax.jit(decode, donate_argnums=(1, 2),
+                               static_argnames=())
         self._prefill = jax.jit(prefill)
+        self._sample_one = jax.jit(sample_one)
         self._insert = jax.jit(insert, donate_argnums=(0, 1))
+        self._jax = jax
         self._jnp = jnp
 
     # ------------------------------------------------------------------
+    def register_adapter(self, name: str, lora_params) -> None:
+        """Install a LoRA adapter into the bank under ``name``
+        (reference: vLLM add_lora / serve model multiplexing). The
+        adapter's alpha/rank scale is folded into its B matrices so the
+        decode program stays scale-free."""
+        if self.lora_bank is None:
+            raise ValueError("engine built with max_loras=0")
+        jnp = self._jnp
+        scale = float(lora_params.get("scale", 1.0))
+        folded = dict(lora_params)
+        folded["B_q"] = lora_params["B_q"] * scale
+        folded["B_v"] = lora_params["B_v"] * scale
+        folded["scale"] = jnp.asarray(1.0, self.config.model.dtype)
+        rank = int(folded["A_q"].shape[-1])
+        bank_rank = self.config.lora_rank
+        if rank > bank_rank:
+            raise ValueError(
+                f"adapter rank {rank} exceeds the engine's lora_rank "
+                f"{bank_rank}")
+        if rank < bank_rank:
+            # zero-pad up to the bank's static rank: the extra zero
+            # columns are exactly the identity, so the math is unchanged
+            pad = bank_rank - rank
+            for part, axis in (("A_q", -1), ("A_v", -1),
+                               ("B_q", -2), ("B_v", -2)):
+                widths = [(0, 0)] * folded[part].ndim
+                widths[axis] = (0, pad)
+                folded[part] = jnp.pad(folded[part], widths)
+        # Reserve a slot AFTER validation (a failed registration must
+        # not leak a slot), write the bank tensors and prefill entry,
+        # and only then publish the name — requests racing this call
+        # must either see nothing or a fully-installed adapter (the
+        # serve stepper admits concurrently with registration).
+        with self._lock:
+            idx = self._adapters.get(name)
+            if idx is None:
+                idx = self._next_adapter_slot
+                if idx > self.config.max_loras:
+                    raise ValueError(
+                        f"LoRA bank full ({self.config.max_loras}); "
+                        "raise max_loras")
+                self._next_adapter_slot += 1
+        for part in ("A_q", "B_q", "A_v", "B_v"):
+            self.lora_bank[part] = (
+                self.lora_bank[part].at[idx].set(folded[part]))
+        self._adapter_prefill[name] = folded
+        with self._lock:
+            self._adapters[name] = idx
+
+    def _adapter_index(self, request: GenerationRequest) -> int:
+        if request.adapter is None:
+            return 0
+        idx = self._adapters.get(request.adapter)
+        if idx is None:
+            raise ValueError(f"unknown LoRA adapter {request.adapter!r}")
+        return idx
+
     def add_request(self, request: GenerationRequest) -> GenerationRequest:
         limit = self.config.max_seq - 1
         if len(request.prompt_ids) > limit:
             request.prompt_ids = request.prompt_ids[-limit:]
+        if request.adapter is not None:
+            self._adapter_index(request)  # fail fast on unknown names
+        if request.top_k > self.config.max_top_k:
+            # the sampler's static width bounds per-request top-k; make
+            # the effective value visible rather than silently narrower
+            request.top_k = self.config.max_top_k
         with self._lock:
             self.waiting.append(request)
         return request
@@ -158,25 +295,21 @@ class ContinuousBatchingEngine:
             bucket = min(bucket, self.config.max_seq)
             padded = np.zeros((1, bucket), dtype=np.int32)
             padded[0, : len(ids)] = ids
-            logits, ks, vs = self._prefill(self.params, jnp.asarray(padded))
+            lora = (self._adapter_prefill.get(request.adapter)
+                    if request.adapter else None)
+            logits, ks, vs = self._prefill(self.params,
+                                           jnp.asarray(padded), lora)
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot.index)
-            last = np.asarray(logits[0, len(ids) - 1])
-            slot.next_token = self._sample(last, request)
+            self._step_counter += 1
+            token = self._sample_one(
+                logits[0, len(ids) - 1], float(request.temperature),
+                int(request.top_k),
+                self._jax.random.fold_in(self._base_key,
+                                         self._step_counter))
+            slot.next_token = int(token)
             slot.pos = len(ids)
             self._emit(slot, slot.next_token)
-
-    def _sample(self, logits: np.ndarray, request: GenerationRequest) -> int:
-        if request.temperature <= 0.0:
-            return int(np.argmax(logits))
-        logits = logits / request.temperature
-        if request.top_k > 0:
-            kth = np.partition(logits, -request.top_k)[-request.top_k]
-            logits = np.where(logits < kth, -np.inf, logits)
-        logits = logits - logits.max()
-        probs = np.exp(logits)
-        probs /= probs.sum()
-        return int(self._rng.choice(len(probs), p=probs))
 
     def _emit(self, slot: _Slot, token: int) -> None:
         request = slot.request
@@ -194,24 +327,37 @@ class ContinuousBatchingEngine:
             slot.request = None
 
     def step(self) -> int:
-        """Admit + one whole-batch decode step. Returns #active slots."""
+        """Admit + one whole-batch decode step (sampling fused on
+        device — only [B] token ids come back). Returns #active slots."""
         self._admit()
         active = [s for s in self.slots if s.request is not None]
         if not active:
             return 0
         jnp = self._jnp
-        tokens = np.zeros(self.config.max_batch, dtype=np.int32)
-        pos = np.zeros(self.config.max_batch, dtype=np.int32)
+        n = self.config.max_batch
+        tokens = np.zeros(n, dtype=np.int32)
+        pos = np.zeros(n, dtype=np.int32)
+        temp = np.zeros(n, dtype=np.float32)
+        topk = np.zeros(n, dtype=np.int32)
+        lora_idx = np.zeros(n, dtype=np.int32)
         for slot in active:
+            request = slot.request
             tokens[slot.index] = slot.next_token
             pos[slot.index] = slot.pos
-        logits, self.cache_k, self.cache_v = self._decode(
+            temp[slot.index] = request.temperature
+            topk[slot.index] = request.top_k
+            lora_idx[slot.index] = self._adapter_index(request)
+        self._step_counter += 1
+        sampled, self.cache_k, self.cache_v = self._decode(
             self.params, self.cache_k, self.cache_v,
-            jnp.asarray(tokens), jnp.asarray(pos))
-        logits = np.asarray(logits)
+            jnp.asarray(tokens), jnp.asarray(pos),
+            jnp.asarray(temp), jnp.asarray(topk),
+            self._base_key, self._step_counter,
+            self.lora_bank, jnp.asarray(lora_idx))
+        sampled = np.asarray(sampled)
         for slot in active:
             slot.pos += 1
-            slot.next_token = self._sample(logits[slot.index], slot.request)
+            slot.next_token = int(sampled[slot.index])
             self._emit(slot, slot.next_token)
         return len(active)
 

@@ -328,6 +328,40 @@ def llama_sharding_rules(mode: str = "fsdp_tp") -> ShardingRules:
 # whole batch, continuous batching handled by ray_tpu.llm.engine)
 # ---------------------------------------------------------------------------
 
+def lora_init(rng, config: LlamaConfig, rank: int = 8,
+              alpha: float = 16.0) -> Dict[str, Any]:
+    """A LoRA adapter on the q/v projections (the classic placement).
+    B starts at zero so a fresh adapter is the identity; ``alpha/rank``
+    scaling is folded into B so inference needs no extra multiply."""
+    c = config
+    hd = c.head_dim
+    kq, kv = jax.random.split(rng)
+    scale = alpha / rank
+
+    def a(key):
+        # A maps dim -> rank regardless of the projection's output size
+        return (jax.random.normal(key, (c.n_layers, c.dim, rank),
+                                  dtype=jnp.float32)
+                * (c.dim ** -0.5)).astype(c.dtype)
+
+    return {
+        "A_q": a(kq),
+        "B_q": jnp.zeros((c.n_layers, rank, c.n_heads * hd), c.dtype),
+        "A_v": a(kv),
+        "B_v": jnp.zeros((c.n_layers, rank, c.n_kv_heads * hd), c.dtype),
+        "scale": jnp.asarray(scale, c.dtype),
+    }
+
+
+def _lora_delta(h, a, b):
+    """h @ A @ B with a possibly per-slot-gathered A/B.
+    h: [B, S, D]; a: [D, r] or [B, D, r]; b: [r, H] or [B, r, H]."""
+    if a.ndim == 2:
+        return (h @ a) @ b
+    t = jnp.einsum("bsd,bdr->bsr", h, a)
+    return jnp.einsum("bsr,brh->bsh", t, b)
+
+
 def llama_init_cache(config: LlamaConfig, batch: int, max_seq: int):
     """KV cache pair, each [L, B, S, KVH, HD] in the model dtype."""
     c = config
@@ -335,36 +369,67 @@ def llama_init_cache(config: LlamaConfig, batch: int, max_seq: int):
     return jnp.zeros(shape, c.dtype), jnp.zeros(shape, c.dtype)
 
 
-def llama_prefill(params, tokens, config: LlamaConfig):
+def llama_prefill(params, tokens, config: LlamaConfig, lora=None):
     """Forward over a padded prompt, keeping per-layer K/V.
 
     tokens: [B, S] int32 -> (logits [B, S, vocab] f32,
     k [L, B, S, KVH, HD], v [L, B, S, KVH, HD]). Positions are arange;
     junk K/V at padding positions is never attended later because decode
-    masks by true position.
+    masks by true position. ``lora``: optional adapter pytree
+    (lora_init) applied to the whole (batch-1) prefill — per-request
+    multi-LoRA happens at decode via the bank path.
     """
     c = config
     hd = c.head_dim
+    b, s = tokens.shape
     x = params["embedding"][tokens].astype(c.dtype)
-    cos, sin = rope_frequencies(hd, tokens.shape[1], c.rope_theta)
+    cos, sin = rope_frequencies(hd, s, c.rope_theta)
 
-    def body(x, layer_params):
-        x, kv, _aux = _block(layer_params, x, cos, sin, c, None)
-        return x, kv
+    if lora is None:
+        def body(x, layer_params):
+            x, kv, _aux = _block(layer_params, x, cos, sin, c, None)
+            return x, kv
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+        x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    else:
+        def body(x, layer):
+            layer_params, a_q, b_q, a_v, b_v = layer
+            h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
+            q = (h @ layer_params["wq"]
+                 + lora["scale"] * _lora_delta(h, a_q, b_q)
+                 ).reshape(b, s, c.n_heads, hd)
+            k = (h @ layer_params["wk"]).reshape(b, s, c.n_kv_heads, hd)
+            v = (h @ layer_params["wv"]
+                 + lora["scale"] * _lora_delta(h, a_v, b_v)
+                 ).reshape(b, s, c.n_kv_heads, hd)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            attn = _attention(q, k, v, c, None)
+            x = x + attn.reshape(b, s, c.n_heads * hd) @ layer_params["wo"]
+            h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
+            y, _aux = _ffn(layer_params, h, c)
+            return x + y, (k, v)
+
+        x, (ks, vs) = jax.lax.scan(
+            body, x, (params["layers"], lora["A_q"], lora["B_q"],
+                      lora["A_v"], lora["B_v"]))
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, ks, vs
 
 
 def llama_decode_step(params, token, cache_k, cache_v, pos,
-                      config: LlamaConfig):
+                      config: LlamaConfig, lora_bank=None, lora_idx=None):
     """One token for every sequence in the batch.
 
     token: [B] int32 (the token at position `pos`); pos: [B] int32;
     cache_k/v: [L, B, S, KVH, HD]. Returns (logits [B, vocab] f32,
     cache_k, cache_v) with the new K/V written at `pos`.
+
+    Multi-LoRA: ``lora_bank`` stacks adapters on a leading axis
+    ({A_q: [N, L, D, r], ...}; index 0 all-zero = no adapter) and
+    ``lora_idx`` [B] picks one per slot — the vLLM-style batched-gather
+    design, TPU-friendly because N and r are static and tiny.
     """
     c = config
     n_layers, b, s, kvh, hd = cache_k.shape
@@ -374,13 +439,26 @@ def llama_decode_step(params, token, cache_k, cache_v, pos,
     pos_2d = pos[:, None]                                       # [B,1]
     # causal visibility: this token may attend to cache slots <= pos
     visible = jnp.arange(s)[None, :] <= pos_2d                  # [B,S]
+    if lora_bank is not None:
+        # [N, L, ...] -> [L, N, ...] so the layer scan consumes them
+        bank = {k2: jnp.swapaxes(v2, 0, 1)
+                for k2, v2 in lora_bank.items() if k2 != "scale"}
+        lora_scale = lora_bank["scale"]
 
     def body(x, layer):
-        layer_params, ck, cv = layer                            # ck [B,S,KVH,HD]
+        if lora_bank is not None:
+            layer_params, ck, cv, a_q, b_q, a_v, b_v = layer
+        else:
+            layer_params, ck, cv = layer                        # ck [B,S,KVH,HD]
         h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
         q = (h @ layer_params["wq"]).reshape(b, 1, c.n_heads, hd)
         k = (h @ layer_params["wk"]).reshape(b, 1, kvh, hd)
         v = (h @ layer_params["wv"]).reshape(b, 1, kvh, hd)
+        if lora_bank is not None:
+            dq = _lora_delta(h, a_q[lora_idx], b_q[lora_idx])
+            dv = _lora_delta(h, a_v[lora_idx], b_v[lora_idx])
+            q = q + (lora_scale * dq).reshape(b, 1, c.n_heads, hd)
+            v = v + (lora_scale * dv).reshape(b, 1, kvh, hd)
         q = apply_rope(q, cos, sin, positions=pos_2d)
         k = apply_rope(k, cos, sin, positions=pos_2d)
         write = jax.vmap(
@@ -400,8 +478,12 @@ def llama_decode_step(params, token, cache_k, cache_v, pos,
         y, _aux = _ffn(layer_params, h, c)  # MoE-aware (decode too)
         return x + y, (ck, cv)
 
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache_k, cache_v))
+    if lora_bank is not None:
+        xs = (params["layers"], cache_k, cache_v,
+              bank["A_q"], bank["B_q"], bank["A_v"], bank["B_v"])
+    else:
+        xs = (params["layers"], cache_k, cache_v)
+    x, (new_k, new_v) = jax.lax.scan(body, x, xs)
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, new_k, new_v

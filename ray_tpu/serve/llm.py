@@ -102,9 +102,27 @@ class LLMServer:
         top_k = body.get("top_k", 0)
         if isinstance(top_k, bool) or not isinstance(top_k, int) or top_k < 0:
             raise ValueError("top_k must be a non-negative integer")
-        # top_k > vocab makes np.partition raise inside the stepper
+        # clamp to vocab: the on-device sampler clips to its static
+        # top-k width anyway, but a sane bound keeps intent clear
         out["top_k"] = min(top_k, self.config.engine.model.vocab_size)
+        out["adapter"] = self._resolve_adapter(body.get("model"))
         return out
+
+    def register_adapter(self, name: str, lora_params) -> None:
+        """Serve a LoRA adapter as an additional model id (reference:
+        serve/llm multi-LoRA — requests select it via `model`)."""
+        self.engine.register_adapter(name, lora_params)
+
+    def _resolve_adapter(self, model: Optional[str]) -> Optional[str]:
+        """Map the request's `model` onto a registered LoRA adapter;
+        the base model_id (or absent) means no adapter."""
+        if model is None or model == self.config.model_id:
+            return None
+        if model in self.engine._adapters:
+            return model
+        raise ValueError(
+            f"unknown model {model!r}; available: "
+            f"{[self.config.model_id, *self.engine._adapters]}")
 
     @staticmethod
     def _flatten_content(content: Any) -> str:
@@ -130,7 +148,8 @@ class LLMServer:
 
     def _generate(self, prompt: str, *, max_tokens: Optional[int] = None,
                   temperature: Optional[float] = None,
-                  top_k: int = 0) -> Dict[str, Any]:
+                  top_k: int = 0,
+                  adapter: Optional[str] = None) -> Dict[str, Any]:
         ids = self.tokenizer.encode(prompt)
         request = GenerationRequest(
             prompt_ids=ids,
@@ -138,6 +157,7 @@ class LLMServer:
             temperature=(self.config.temperature if temperature is None
                          else temperature),
             top_k=top_k,
+            adapter=adapter,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else ())
         self.engine.add_request(request)
@@ -158,7 +178,8 @@ class LLMServer:
     def _generate_stream(self, prompt: str, *,
                          max_tokens: Optional[int] = None,
                          temperature: Optional[float] = None,
-                         top_k: int = 0):
+                         top_k: int = 0,
+                         adapter: Optional[str] = None):
         """Yield decoded text per emitted token (reference: vLLM output
         streams behind serve token streaming). The engine's stepper
         pushes each token onto the request's queue as it decodes."""
@@ -171,6 +192,7 @@ class LLMServer:
             temperature=(self.config.temperature if temperature is None
                          else temperature),
             top_k=top_k,
+            adapter=adapter,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else (),
             stream_queue=queue.Queue())
@@ -231,7 +253,8 @@ class LLMServer:
             prompt,
             max_tokens=sampling.get("max_tokens"),
             temperature=sampling.get("temperature"),
-            top_k=sampling["top_k"])
+            top_k=sampling["top_k"],
+            adapter=sampling.get("adapter"))
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -260,7 +283,8 @@ class LLMServer:
         for text in self._generate_stream(
                 prompt, max_tokens=sampling.get("max_tokens"),
                 temperature=sampling.get("temperature"),
-                top_k=sampling["top_k"]):
+                top_k=sampling["top_k"],
+            adapter=sampling.get("adapter")):
             chunk = {"id": cmpl_id, "object": "text_completion",
                      "model": model,
                      "choices": [{"index": 0, "text": text,
@@ -287,7 +311,8 @@ class LLMServer:
         for text in self._generate_stream(
                 prompt, max_tokens=sampling.get("max_tokens"),
                 temperature=sampling.get("temperature"),
-                top_k=sampling["top_k"]):
+                top_k=sampling["top_k"],
+            adapter=sampling.get("adapter")):
             chunk = {"id": chat_id, "object": "chat.completion.chunk",
                      "model": model,
                      "choices": [{"index": 0, "delta": {"content": text},
@@ -321,7 +346,8 @@ class LLMServer:
             prompt,
             max_tokens=sampling.get("max_tokens"),
             temperature=sampling.get("temperature"),
-            top_k=sampling["top_k"])
+            top_k=sampling["top_k"],
+            adapter=sampling.get("adapter"))
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",

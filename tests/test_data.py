@@ -269,7 +269,7 @@ def _join_reference(left_rows, right_rows, on, how):
     return out
 
 
-@pytest.mark.parametrize("how", ["inner", "left", "outer"])
+@pytest.mark.parametrize("how", ["inner", "left", "right", "outer"])
 def test_join(how):
     left_rows = [{"k": i % 5, "lv": i} for i in range(12)]
     right_rows = [{"k": i, "rv": i * 10} for i in range(3, 8)]
