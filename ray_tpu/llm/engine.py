@@ -224,11 +224,21 @@ class ContinuousBatchingEngine:
                 widths = [(0, 0)] * folded[part].ndim
                 widths[axis] = (0, pad)
                 folded[part] = jnp.pad(folded[part], widths)
-        # Reserve a slot AFTER validation (a failed registration must
-        # not leak a slot), write the bank tensors and prefill entry,
-        # and only then publish the name — requests racing this call
-        # must either see nothing or a fully-installed adapter (the
-        # serve stepper admits concurrently with registration).
+        # Full shape validation BEFORE reserving anything — a failed
+        # registration must not leak a bank slot.
+        for part in ("A_q", "B_q", "A_v", "B_v"):
+            want = self.lora_bank[part].shape[1:]
+            got = tuple(folded[part].shape)
+            if got != want:
+                raise ValueError(
+                    f"adapter {part} shape {got} does not match the "
+                    f"engine's bank slot shape {want} (built for a "
+                    "different model config?)")
+        # Install under the lock, publishing a COMPLETE new bank dict in
+        # one reference swap: the serve stepper reads self.lora_bank
+        # once per step, so it sees either the old or the new bank,
+        # never mismatched A/B factors; the lock serializes concurrent
+        # registrations so neither's slot write is lost.
         with self._lock:
             idx = self._adapters.get(name)
             if idx is None:
@@ -238,11 +248,12 @@ class ContinuousBatchingEngine:
                         f"LoRA bank full ({self.config.max_loras}); "
                         "raise max_loras")
                 self._next_adapter_slot += 1
-        for part in ("A_q", "B_q", "A_v", "B_v"):
-            self.lora_bank[part] = (
-                self.lora_bank[part].at[idx].set(folded[part]))
-        self._adapter_prefill[name] = folded
-        with self._lock:
+            new_bank = dict(self.lora_bank)
+            for part in ("A_q", "B_q", "A_v", "B_v"):
+                new_bank[part] = self.lora_bank[part].at[idx].set(
+                    folded[part])
+            self.lora_bank = new_bank
+            self._adapter_prefill[name] = folded
             self._adapters[name] = idx
 
     def _adapter_index(self, request: GenerationRequest) -> int:

@@ -201,16 +201,26 @@ def _ffn(layer_params, h, config: LlamaConfig):
     return (gate * up) @ layer_params["w2"], jnp.zeros((), jnp.float32)
 
 
-def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh):
+def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh,
+           lora=None):
     """One transformer block. Returns (x, (k, v)) — K/V are post-rope,
-    the layout the KV cache stores; training callers discard them."""
+    the layout the KV cache stores; training callers discard them.
+    ``lora``: optional (A_q, B_q, A_v, B_v, scale) low-rank deltas on
+    the q/v projections (zero extra cost when absent)."""
     c = config
     b, s, _ = x.shape
     hd = c.head_dim
     h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
-    q = (h @ layer_params["wq"]).reshape(b, s, c.n_heads, hd)
-    k = (h @ layer_params["wk"]).reshape(b, s, c.n_kv_heads, hd)
-    v = (h @ layer_params["wv"]).reshape(b, s, c.n_kv_heads, hd)
+    q = h @ layer_params["wq"]
+    k = h @ layer_params["wk"]
+    v = h @ layer_params["wv"]
+    if lora is not None:
+        a_q, b_q, a_v, b_v, scale = lora
+        q = q + scale * _lora_delta(h, a_q, b_q)
+        v = v + scale * _lora_delta(h, a_v, b_v)
+    q = q.reshape(b, s, c.n_heads, hd)
+    k = k.reshape(b, s, c.n_kv_heads, hd)
+    v = v.reshape(b, s, c.n_kv_heads, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     attn = _attention(q, k, v, c, mesh)
@@ -394,21 +404,10 @@ def llama_prefill(params, tokens, config: LlamaConfig, lora=None):
     else:
         def body(x, layer):
             layer_params, a_q, b_q, a_v, b_v = layer
-            h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
-            q = (h @ layer_params["wq"]
-                 + lora["scale"] * _lora_delta(h, a_q, b_q)
-                 ).reshape(b, s, c.n_heads, hd)
-            k = (h @ layer_params["wk"]).reshape(b, s, c.n_kv_heads, hd)
-            v = (h @ layer_params["wv"]
-                 + lora["scale"] * _lora_delta(h, a_v, b_v)
-                 ).reshape(b, s, c.n_kv_heads, hd)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            attn = _attention(q, k, v, c, None)
-            x = x + attn.reshape(b, s, c.n_heads * hd) @ layer_params["wo"]
-            h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
-            y, _aux = _ffn(layer_params, h, c)
-            return x + y, (k, v)
+            x, kv, _aux = _block(
+                layer_params, x, cos, sin, c, None,
+                lora=(a_q, b_q, a_v, b_v, lora["scale"]))
+            return x, kv
 
         x, (ks, vs) = jax.lax.scan(
             body, x, (params["layers"], lora["A_q"], lora["B_q"],

@@ -116,3 +116,46 @@ def test_pipeline_rejects_bad_microbatch():
     x = jnp.zeros((10, 8))
     with pytest.raises(ValueError, match="divisible"):
         pipeline(lambda p, x: x, params, x, mesh, num_microbatches=4)
+
+
+def test_pipeline_multi_round_and_grad():
+    """More microbatches than stages (R=3 rounds of the sharded input
+    stream) and gradient flow with remat."""
+    mesh = make_mesh(MeshSpec(pipe=4, data=2))
+    n_stages, d = 4, 16
+    w = jax.random.normal(jax.random.PRNGKey(0), (n_stages, d, d)) * 0.1
+    params = {"w": w}
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (24, d))
+    ref = x
+    for i in range(n_stages):
+        ref = stage_fn({"w": w[i]}, ref)
+    out = pipeline(stage_fn, params, x, mesh, num_microbatches=12)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+    def loss(p, x):
+        return jnp.sum(pipeline(stage_fn, p, x, mesh,
+                                num_microbatches=12, remat=True) ** 2)
+
+    def ref_loss(p, x):
+        h = x
+        for i in range(n_stages):
+            h = stage_fn({"w": p["w"][i]}, h)
+        return jnp.sum(h ** 2)
+
+    g = jax.grad(loss)(params, x)
+    g_ref = jax.grad(ref_loss)(params, x)
+    np.testing.assert_allclose(np.asarray(g["w"]), np.asarray(g_ref["w"]),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_pipeline_rejects_uneven_stage_split():
+    mesh = make_mesh(MeshSpec(pipe=4, data=2))
+    params = {"w": jnp.zeros((4, 8, 8))}
+    x = jnp.zeros((12, 8))
+    with pytest.raises(ValueError, match="pipe size"):
+        pipeline(lambda p, x: x, params, x, mesh, num_microbatches=6)
