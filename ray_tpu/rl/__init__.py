@@ -7,8 +7,13 @@ gradient allreduce across learner actors.
 """
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig
+from ray_tpu.rl.connectors import (
+    Connector, ConnectorPipeline, FrameStack, ObsNormalizer, RewardClip)
+from ray_tpu.rl.offline import OfflineData, collect_episodes
 from ray_tpu.rl.env import (
     CartPole, CartPoleJax, Env, JaxEnv, Pendulum, make_env, register_env)
 from ray_tpu.rl.env_runner import JaxEnvRunner, SingleAgentEnvRunner
@@ -18,9 +23,12 @@ from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
 from ray_tpu.rl import spaces
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "CartPole", "CartPoleJax", "DQN",
-    "DQNConfig", "Env", "JaxEnv", "JaxEnvRunner", "Learner",
-    "LearnerGroup", "PPO", "PPOConfig", "Pendulum", "RLModuleSpec",
-    "SampleBatch", "SingleAgentEnvRunner", "compute_gae",
+    "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "CartPole",
+    "CartPoleJax", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
+    "Env", "FrameStack", "JaxEnv", "JaxEnvRunner", "Learner",
+    "LearnerGroup", "MARWIL", "MARWILConfig", "ObsNormalizer",
+    "OfflineData", "PPO", "PPOConfig", "Pendulum", "RLModuleSpec",
+    "RewardClip", "SAC", "SACConfig", "SampleBatch",
+    "SingleAgentEnvRunner", "collect_episodes", "compute_gae",
     "concat_samples", "make_env", "register_env", "spaces",
 ]

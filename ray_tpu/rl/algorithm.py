@@ -43,6 +43,9 @@ class AlgorithmConfig:
         self.num_learners: int = 0
         # module
         self.hidden: Tuple[int, ...] = (64, 64)
+        # env→module connectors: FACTORIES (each runner builds its own
+        # stateful pipeline; see ray_tpu/rl/connectors.py)
+        self.connector_factories: list = []
         # misc
         self.seed: int = 0
 
@@ -111,9 +114,34 @@ class AlgorithmConfig:
             return self.env
         return None
 
+    def env_to_module(self, connectors: list) -> "AlgorithmConfig":
+        """Configure the env→module connector pipeline (reference:
+        AlgorithmConfig.env_to_module_connector). Pass factories
+        (zero-arg callables) so every env runner gets its own state."""
+        self.connector_factories = list(connectors)
+        return self
+
+    def build_connectors(self):
+        if not self.connector_factories:
+            return None
+        from ray_tpu.rl.connectors import ConnectorPipeline
+        return ConnectorPipeline([f() for f in self.connector_factories])
+
     def module_spec(self) -> RLModuleSpec:
         env = self.make_jax_env() or self.make_python_env()
-        return RLModuleSpec(obs_space=env.observation_space,
+        obs_space = env.observation_space
+        pipeline = self.build_connectors()
+        if pipeline is not None:
+            mult = pipeline.obs_dim_multiplier()
+            if mult > 1:  # e.g. FrameStack widens the module's input
+                from ray_tpu.rl.spaces import Box
+                lo = np.tile(np.broadcast_to(
+                    obs_space.low, obs_space.shape).ravel(), mult)
+                hi = np.tile(np.broadcast_to(
+                    obs_space.high, obs_space.shape).ravel(), mult)
+                obs_space = Box(lo.astype(np.float32),
+                                hi.astype(np.float32))
+        return RLModuleSpec(obs_space=obs_space,
                             action_space=env.action_space,
                             hidden=self.hidden)
 

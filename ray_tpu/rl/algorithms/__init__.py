@@ -1,4 +1,7 @@
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig
+from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig"]
+__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
+           "BC", "BCConfig", "MARWIL", "MARWILConfig"]

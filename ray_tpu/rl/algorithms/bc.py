@@ -1,0 +1,151 @@
+"""BC and MARWIL — offline RL.
+
+Reference: rllib/algorithms/bc/ (behavior cloning = pure imitation,
+-log π(a|s)) and rllib/algorithms/marwil/ (advantage-weighted
+imitation: exp(β·Â) weights on the log-likelihood plus a value-head
+regression; BC is exactly MARWIL with β = 0 — the reference implements
+it that way, and so does this module).
+
+Offline training consumes an ``OfflineData`` store (ray_tpu/rl/
+offline.py); per training_step the learner takes ``num_gradient_steps``
+jitted updates on sampled minibatches. Evaluation (episode returns in
+train results) rolls the greedy policy in the configured env.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.offline import RETURNS, OfflineData
+from ray_tpu.rl.sample_batch import ACTIONS, OBS, SampleBatch
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0              # advantage-weighting temperature
+        self.vf_coeff = 1.0
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.num_gradient_steps = 32
+        self.offline_data: Optional[OfflineData] = None
+        self.evaluation_episodes = 2
+
+    def offline(self, data: OfflineData) -> "MARWILConfig":
+        self.offline_data = data
+        return self
+
+
+class BCConfig(MARWILConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 0.0  # BC = MARWIL with no advantage weighting
+
+
+class MARWILLearner(Learner):
+    def __init__(self, module_spec, *, beta: float = 1.0,
+                 vf_coeff: float = 1.0, **kwargs):
+        self.beta = beta
+        self.vf_coeff = vf_coeff
+        super().__init__(module_spec, **kwargs)
+
+    def loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        dist, values = self.spec.forward(params, batch[OBS])
+        logp = dist.log_prob(batch[ACTIONS])
+        if self.beta == 0.0:
+            policy_loss = -jnp.mean(logp)
+            vf_loss = jnp.zeros(())
+        else:
+            adv = batch[RETURNS] - values
+            # moving normalization collapses to per-batch normalization
+            # here (the reference keeps an EMA of adv²; per-batch is the
+            # deterministic equivalent for full-batch offline training)
+            adv_n = adv / (jnp.sqrt(jnp.mean(adv ** 2)) + 1e-8)
+            weights = jnp.exp(
+                jnp.clip(self.beta * jax.lax.stop_gradient(adv_n),
+                         -10.0, 10.0))
+            policy_loss = -jnp.mean(weights * logp)
+            vf_loss = jnp.mean(adv ** 2)
+        total = policy_loss + self.vf_coeff * vf_loss
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "mean_logp": jnp.mean(logp)}
+
+
+class MARWIL(Algorithm):
+    def setup(self, config: MARWILConfig) -> None:
+        if config.offline_data is None:
+            raise ValueError(
+                "MARWIL/BC require offline data: "
+                "config.offline(OfflineData(episodes))")
+        self.spec = config.module_spec()
+        self.learner = MARWILLearner(
+            self.spec, beta=config.beta, vf_coeff=config.vf_coeff,
+            lr=config.lr, grad_clip=config.grad_clip, seed=config.seed)
+        self.data = config.offline_data
+        self._rng = np.random.default_rng(config.seed)
+        # eval artifacts hoisted out of the loop: a fresh lambda per
+        # training_step would retrace/recompile every iteration
+        self._eval_env = None
+        if config.env is not None or config.env_creator is not None:
+            import jax
+            self._eval_env = config.make_python_env()
+            self._eval_act = jax.jit(
+                lambda p, o: self.spec.forward(p, o)[0].mode())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.num_gradient_steps):
+            batch = self.data.sample(cfg.train_batch_size, self._rng)
+            metrics = self.learner.update(batch)
+        if cfg.evaluation_episodes and self._eval_env is not None:
+            self.record_episodes(self._evaluate(cfg.evaluation_episodes))
+        return metrics
+
+    def _evaluate(self, episodes: int):
+        env, act = self._eval_env, self._eval_act
+        returns = []
+        for e in range(episodes):
+            obs, _ = env.reset(seed=10_000 + self.iteration * 100 + e)
+            total, done = 0.0, False
+            for _ in range(1000):
+                action = np.asarray(act(self.learner.params, obs[None]))[0]
+                if not self.spec.is_continuous:
+                    action = int(action)
+                obs, rew, term, trunc, _ = env.step(action)
+                total += rew
+                self._env_steps_lifetime += 1
+                if term or trunc:
+                    break
+            returns.append(total)
+        return returns
+
+    def compute_single_action(self, obs: np.ndarray):
+        import jax
+        dist, _ = self.spec.forward(self.learner.params, obs[None])
+        action = np.asarray(dist.mode())[0]
+        return int(action) if not self.spec.is_continuous else action
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state["learner"] = self.learner.get_state()
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        self.learner.set_state(state["learner"])
+
+
+class BC(MARWIL):
+    pass
+
+
+MARWILConfig.algo_class = MARWIL
+BCConfig.algo_class = BC

@@ -87,6 +87,11 @@ class _EnvRunnerActor:
     def __init__(self, blob: bytes):
         from ray_tpu.core import serialization
         kwargs = serialization.loads(blob)
+        factories = kwargs.pop("connector_factories", None)
+        if factories:
+            from ray_tpu.rl.connectors import ConnectorPipeline
+            kwargs["connectors"] = ConnectorPipeline(
+                [f() for f in factories])
         self.runner = SingleAgentEnvRunner(**kwargs)
 
     def sample(self) -> bytes:
@@ -96,6 +101,12 @@ class _EnvRunnerActor:
 
     def set_weights(self, weights) -> None:
         self.runner.set_weights(weights)
+
+    def get_connector_state(self):
+        return self.runner.get_connector_state()
+
+    def set_connector_state(self, state) -> None:
+        self.runner.set_connector_state(state)
 
     def ping(self):
         return True
@@ -117,7 +128,8 @@ class PPO(Algorithm):
 
         jax_env = config.make_jax_env()
         if (jax_env is not None and config.num_env_runners == 0
-                and config.num_learners <= 1):
+                and config.num_learners <= 1
+                and not config.connector_factories):
             self.jax_runner = JaxEnvRunner(
                 jax_env, self.spec,
                 num_envs=config.num_envs_per_env_runner,
@@ -133,8 +145,9 @@ class PPO(Algorithm):
             num_envs=config.num_envs_per_env_runner,
             rollout_len=config.rollout_fragment_length)
         if config.num_env_runners == 0:
-            self.runners = [SingleAgentEnvRunner(seed=config.seed,
-                                                 **runner_kwargs)]
+            self.runners = [SingleAgentEnvRunner(
+                seed=config.seed,
+                connectors=config.build_connectors(), **runner_kwargs)]
             self._remote = False
         else:
             import ray_tpu
@@ -142,7 +155,9 @@ class PPO(Algorithm):
             actor_cls = ray_tpu.remote(_EnvRunnerActor)
             self.runners = [
                 actor_cls.remote(serialization.dumps(
-                    dict(seed=config.seed + i, **runner_kwargs)))
+                    dict(seed=config.seed + i,
+                         connector_factories=config.connector_factories,
+                         **runner_kwargs)))
                 for i in range(config.num_env_runners)]
             ray_tpu.get([r.ping.remote() for r in self.runners])
             self._remote = True
@@ -215,6 +230,19 @@ class PPO(Algorithm):
                 cols, metrics = serialization.loads(blob)
                 batches.append(self._postprocess(cols, weights))
                 self.record_episodes(metrics["episode_returns"])
+            if self.config.connector_factories and len(self.runners) > 1:
+                # connector-state sync: merge per-runner statistics
+                # (e.g. obs mean/var) and broadcast, so normalization
+                # is consistent across the fleet (reference: connector
+                # state aggregation across env runners)
+                states = ray_tpu.get(
+                    [r.get_connector_state.remote()
+                     for r in self.runners])
+                merged = self.config.build_connectors().merge_states(
+                    states)
+                ray_tpu.get(
+                    [r.set_connector_state.remote(merged)
+                     for r in self.runners])
         else:
             for runner in self.runners:
                 runner.set_weights(weights)

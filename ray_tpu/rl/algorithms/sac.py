@@ -1,0 +1,274 @@
+"""SAC — Soft Actor-Critic for continuous control.
+
+Reference: rllib/algorithms/sac/ (SACConfig defaults, twin-Q critic
+loss + squashed-Gaussian actor loss + automatic entropy temperature in
+sac_torch_learner.py). TPU-first shape: the whole update — twin-Q
+targets, reparameterized actor, alpha — is ONE jitted step over a
+replay minibatch; target networks soft-update inside the same program
+(polyak), so a gradient step is a single device dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.rl_module import _dense_forward, _dense_init
+from ray_tpu.rl.spaces import Box
+
+_LOG_STD_MIN, _LOG_STD_MAX = -20.0, 2.0
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.tau = 0.005                 # polyak target coefficient
+        self.train_batch_size = 256
+        self.buffer_capacity = 100_000
+        self.learning_starts = 1_000
+        self.rollout_fragment_length = 64
+        self.num_gradient_steps = 32
+        self.num_envs_per_env_runner = 4
+        self.initial_alpha = 1.0
+        self.target_entropy: float = None  # default: -act_dim
+
+
+class _SACNets:
+    """Pure-function SAC networks over flat obs/action vectors."""
+
+    def __init__(self, obs_dim: int, act_dim: int, hidden, low, high):
+        self.obs_dim, self.act_dim, self.hidden = obs_dim, act_dim, hidden
+        # tanh squashes to [-1, 1]; rescale to the action bounds
+        self.scale = (high - low) / 2.0
+        self.center = (high + low) / 2.0
+
+    def init(self, key):
+        import jax
+        kp, k1, k2 = jax.random.split(key, 3)
+        return {
+            # policy head outputs [mean, log_std]
+            "pi": _dense_init(kp, [self.obs_dim, *self.hidden,
+                                   2 * self.act_dim], final_gain=0.01),
+            "q1": _dense_init(k1, [self.obs_dim + self.act_dim,
+                                   *self.hidden, 1]),
+            "q2": _dense_init(k2, [self.obs_dim + self.act_dim,
+                                   *self.hidden, 1]),
+        }
+
+    def pi(self, params, obs, key):
+        """Reparameterized squashed-Gaussian sample.
+        Returns (action in env bounds, log-prob with tanh correction)."""
+        import jax
+        import jax.numpy as jnp
+        out = _dense_forward(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(key, mean.shape)
+        logp_u = jnp.sum(
+            -0.5 * (((u - mean) / std) ** 2 + 2 * log_std
+                    + jnp.log(2 * jnp.pi)), axis=-1)
+        a = jnp.tanh(u)
+        # tanh change of variables (the numerically stable SAC form)
+        logp = logp_u - jnp.sum(
+            2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1)
+        return a * self.scale + self.center, logp
+
+    def pi_mode(self, params, obs):
+        import jax.numpy as jnp
+        out = _dense_forward(params["pi"], obs)
+        mean, _ = jnp.split(out, 2, axis=-1)
+        return jnp.tanh(mean) * self.scale + self.center
+
+    def q(self, params, which: str, obs, act):
+        import jax.numpy as jnp
+        x = jnp.concatenate([obs, act], axis=-1)
+        return _dense_forward(params[which], x).squeeze(-1)
+
+
+class _ContReplay:
+    """Uniform replay with vector-valued actions."""
+
+    def __init__(self, capacity: int, obs_dim: int, act_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros_like(self.obs)
+        self.actions = np.zeros((capacity, act_dim), np.float32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.float32)
+        self.pos = 0
+        self.size = 0
+
+    def add(self, obs, action, reward, next_obs, done):
+        p = self.pos
+        self.obs[p], self.actions[p] = obs, action
+        self.rewards[p], self.next_obs[p], self.dones[p] = (
+            reward, next_obs, done)
+        self.pos = (p + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n: int, rng) -> Dict[str, np.ndarray]:
+        idx = rng.integers(self.size, size=n)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "next_obs": self.next_obs[idx], "dones": self.dones[idx]}
+
+
+class SAC(Algorithm):
+    def setup(self, config: SACConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        env0 = config.make_python_env()
+        if not isinstance(env0.action_space, Box):
+            raise ValueError("SAC requires a continuous (Box) action "
+                             "space; use DQN/PPO for discrete")
+        obs_dim = int(np.prod(env0.observation_space.shape))
+        act_dim = int(np.prod(env0.action_space.shape))
+        low = np.broadcast_to(env0.action_space.low, (act_dim,)).astype(
+            np.float32)
+        high = np.broadcast_to(env0.action_space.high, (act_dim,)).astype(
+            np.float32)
+        nets = self.nets = _SACNets(obs_dim, act_dim, config.hidden,
+                                    low, high)
+        self.envs = [env0] + [config.make_python_env()
+                              for _ in range(
+                                  config.num_envs_per_env_runner - 1)]
+        self._rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed)
+        self.params = nets.init(jax.random.PRNGKey(config.seed))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.log_alpha = jnp.asarray(np.log(config.initial_alpha),
+                                     jnp.float32)
+        target_entropy = (config.target_entropy
+                          if config.target_entropy is not None
+                          else -float(act_dim))
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self.alpha_opt = optax.adam(config.lr)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+        self.buffer = _ContReplay(config.buffer_capacity, obs_dim, act_dim)
+        self._obs = np.stack([env.reset(seed=config.seed + i)[0]
+                              for i, env in enumerate(self.envs)])
+        self._ep_return = np.zeros(len(self.envs))
+        gamma, tau = config.gamma, config.tau
+
+        def train_step(params, target_params, log_alpha, opt_state,
+                       alpha_opt_state, batch, key):
+            k1, k2 = jax.random.split(key)
+            alpha = jnp.exp(log_alpha)
+
+            # critic: y = r + γ(1-d)(min_i Qtgt_i(s', a') − α log π(a'|s'))
+            next_a, next_logp = nets.pi(params, batch["next_obs"], k1)
+            q_next = jnp.minimum(
+                nets.q(target_params, "q1", batch["next_obs"], next_a),
+                nets.q(target_params, "q2", batch["next_obs"], next_a))
+            y = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1.0 - batch["dones"])
+                * (q_next - alpha * next_logp))
+
+            def critic_actor_loss(p):
+                q1 = nets.q(p, "q1", batch["obs"], batch["actions"])
+                q2 = nets.q(p, "q2", batch["obs"], batch["actions"])
+                critic = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+                a, logp = nets.pi(p, batch["obs"], k2)
+                q_pi = jnp.minimum(
+                    nets.q(jax.lax.stop_gradient(p), "q1",
+                           batch["obs"], a),
+                    nets.q(jax.lax.stop_gradient(p), "q2",
+                           batch["obs"], a))
+                actor = jnp.mean(alpha * logp - q_pi)
+                return critic + actor, (critic, actor, logp)
+
+            (loss, (critic_l, actor_l, logp)), grads = jax.value_and_grad(
+                critic_actor_loss, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            def alpha_loss(la):
+                return -jnp.mean(jnp.exp(la)
+                                 * jax.lax.stop_gradient(
+                                     logp + target_entropy))
+
+            a_grads = jax.grad(alpha_loss)(log_alpha)
+            a_updates, alpha_opt_state = self.alpha_opt.update(
+                a_grads, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, a_updates)
+
+            target_params = jax.tree.map(
+                lambda t, p: (1.0 - tau) * t + tau * p,
+                target_params, params)
+            return (params, target_params, log_alpha, opt_state,
+                    alpha_opt_state, critic_l, actor_l)
+
+        self._train_step = jax.jit(train_step)
+        self._act = jax.jit(nets.pi)
+        self._act_mode = jax.jit(nets.pi_mode)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        cfg = self.config
+        N = len(self.envs)
+        for _ in range(cfg.rollout_fragment_length):
+            self._key, sub = jax.random.split(self._key)
+            if self.buffer.size < cfg.learning_starts:
+                actions = np.stack([
+                    self._rng.uniform(self.nets.center - self.nets.scale,
+                                      self.nets.center + self.nets.scale)
+                    for _ in range(N)]).astype(np.float32)
+            else:
+                actions, _ = self._act(self.params, self._obs, sub)
+                actions = np.asarray(actions)
+            for i, env in enumerate(self.envs):
+                obs, rew, term, trunc, _ = env.step(actions[i])
+                self._ep_return[i] += rew
+                self.buffer.add(self._obs[i], actions[i], rew, obs,
+                                float(term))
+                if term or trunc:
+                    self.record_episodes([float(self._ep_return[i])])
+                    self._ep_return[i] = 0.0
+                    obs, _ = env.reset()
+                self._obs[i] = obs
+            self._env_steps_lifetime += N
+
+        critic_l = actor_l = float("nan")
+        if self.buffer.size >= cfg.learning_starts:
+            for _ in range(cfg.num_gradient_steps):
+                self._key, sub = jax.random.split(self._key)
+                batch = self.buffer.sample(cfg.train_batch_size, self._rng)
+                (self.params, self.target_params, self.log_alpha,
+                 self.opt_state, self.alpha_opt_state, critic_l,
+                 actor_l) = self._train_step(
+                    self.params, self.target_params, self.log_alpha,
+                    self.opt_state, self.alpha_opt_state, batch, sub)
+        import jax.numpy as jnp
+        return {
+            "critic_loss": float(critic_l),
+            "actor_loss": float(actor_l),
+            "alpha": float(jnp.exp(self.log_alpha)),
+            "buffer_size": self.buffer.size,
+        }
+
+    def compute_single_action(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._act_mode(self.params, obs[None]))[0]
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state.update(params=self.params, target_params=self.target_params,
+                     log_alpha=self.log_alpha)
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self.log_alpha = state["log_alpha"]
+
+
+SACConfig.algo_class = SAC

@@ -1,0 +1,226 @@
+"""Connectors: composable observation/reward transforms between env and
+module.
+
+Reference: rllib/connectors/ — ConnectorV2 pipelines transforming data
+on the env→module path (frame stacking, observation normalization) and
+the learner path, with state that syncs from env runners to the
+learner. Here a connector is a small stateful object with two hooks:
+
+  on_obs(obs [N, ...]) -> transformed obs     (every policy query)
+  on_batch(SampleBatch) -> SampleBatch        (post-rollout, pre-learn)
+
+Pipelines apply connectors in order; ``get_state``/``set_state`` let
+an algorithm broadcast driver-merged statistics (e.g. running obs
+mean/var) back to remote env-runner actors, the reference's
+connector-state sync.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.sample_batch import FINAL_OBS, OBS, REWARDS, SampleBatch
+
+
+class Connector:
+    def on_obs(self, obs: np.ndarray,
+               resets: Optional[np.ndarray] = None) -> np.ndarray:
+        """``resets``: bool [N] marking envs whose obs is a fresh
+        episode's first observation (stateful connectors must not leak
+        the previous episode into it)."""
+        return obs
+
+    def merge_states(self, states: list) -> Dict[str, Any]:
+        """Combine per-runner states into one (driver-side merge before
+        broadcast; reference: connector-state aggregation)."""
+        return states[0] if states else {}
+
+    def on_batch(self, batch: SampleBatch) -> SampleBatch:
+        return batch
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+    def obs_dim_multiplier(self) -> int:
+        """How this connector scales the flat obs dim (FrameStack > 1)."""
+        return 1
+
+
+class ObsNormalizer(Connector):
+    """Running mean/var normalization (reference:
+    rllib/connectors/env_to_module/mean_std_filter.py). Statistics
+    update from every observed obs; normalized output is clipped."""
+
+    def __init__(self, clip: float = 10.0, eps: float = 1e-8):
+        self.clip = clip
+        self.eps = eps
+        self.count = 0.0
+        self.mean: Optional[np.ndarray] = None
+        self.m2: Optional[np.ndarray] = None  # sum of squared deviations
+
+    def _update(self, obs: np.ndarray) -> None:
+        flat = obs.reshape(-1, obs.shape[-1]).astype(np.float64)
+        if self.mean is None:
+            self.mean = np.zeros(flat.shape[-1])
+            # zeros, not ones: a ones-init biases the variance by
+            # 1/(count-1); _apply's eps already guards the divide
+            self.m2 = np.zeros(flat.shape[-1])
+        for row in flat:  # Welford; rollout sizes keep this cheap
+            self.count += 1.0
+            delta = row - self.mean
+            self.mean += delta / self.count
+            self.m2 += delta * (row - self.mean)
+
+    def _apply(self, obs: np.ndarray) -> np.ndarray:
+        if self.mean is None or self.count < 2:
+            return obs
+        var = self.m2 / max(self.count - 1, 1.0)
+        out = (obs - self.mean) / np.sqrt(var + self.eps)
+        return np.clip(out, -self.clip, self.clip).astype(np.float32)
+
+    def on_obs(self, obs: np.ndarray,
+               resets: Optional[np.ndarray] = None) -> np.ndarray:
+        self._update(obs)
+        return self._apply(obs)
+
+    def on_batch(self, batch: SampleBatch) -> SampleBatch:
+        # rollout obs were already normalized on_obs; normalize the
+        # final-obs column (used for bootstrap values) consistently
+        if FINAL_OBS in batch:
+            batch[FINAL_OBS] = self._apply(batch[FINAL_OBS])
+        return batch
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"count": self.count, "mean": self.mean, "m2": self.m2}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.count = state["count"]
+        self.mean = state["mean"]
+        self.m2 = state["m2"]
+
+    def merge_states(self, states: list) -> Dict[str, Any]:
+        """Parallel Welford merge (Chan et al.) of per-runner stats."""
+        states = [s for s in states if s and s.get("mean") is not None]
+        if not states:
+            return self.get_state()
+        count = states[0]["count"]
+        mean = np.array(states[0]["mean"], np.float64)
+        m2 = np.array(states[0]["m2"], np.float64)
+        for s in states[1:]:
+            nb, mb, m2b = s["count"], s["mean"], s["m2"]
+            delta = mb - mean
+            total = count + nb
+            mean = mean + delta * (nb / total)
+            m2 = m2 + m2b + delta ** 2 * (count * nb / total)
+            count = total
+        return {"count": count, "mean": mean, "m2": m2}
+
+
+class FrameStack(Connector):
+    """Concatenate the last k observations along the feature axis
+    (reference: rllib/connectors/env_to_module/frame_stacking.py).
+    The module's obs_dim must be built k× wider (the algorithm config
+    accounts for this via obs_dim_multiplier)."""
+
+    def __init__(self, k: int = 4):
+        if k < 1:
+            raise ValueError("FrameStack k must be >= 1")
+        self.k = k
+        self._frames: Optional[deque] = None
+
+    def on_obs(self, obs: np.ndarray,
+               resets: Optional[np.ndarray] = None) -> np.ndarray:
+        if self._frames is None or self._frames[0].shape != obs.shape:
+            self._frames = deque([obs] * self.k, maxlen=self.k)
+        else:
+            self._frames.append(obs)
+            if resets is not None and resets.any():
+                # a fresh episode's stack must not contain the dead
+                # episode's frames: restart those envs' stacks with
+                # k copies of the reset observation
+                frames = list(self._frames)
+                for j in range(self.k):
+                    frame = frames[j].copy()
+                    frame[resets] = obs[resets]
+                    frames[j] = frame
+                self._frames = deque(frames, maxlen=self.k)
+        return np.concatenate(list(self._frames), axis=-1)
+
+    def on_batch(self, batch: SampleBatch) -> SampleBatch:
+        # FINAL_OBS arrives raw (one frame); the stacked equivalent at
+        # step t is the step's stack shifted by one frame with the
+        # final frame appended — OBS[t][..., D:] ++ final[t]
+        if FINAL_OBS in batch and OBS in batch and self.k > 1:
+            raw_dim = batch[FINAL_OBS].shape[-1]
+            if batch[OBS].shape[-1] == raw_dim * self.k:
+                batch[FINAL_OBS] = np.concatenate(
+                    [batch[OBS][..., raw_dim:], batch[FINAL_OBS]],
+                    axis=-1)
+        return batch
+
+    def obs_dim_multiplier(self) -> int:
+        return self.k
+
+
+class RewardClip(Connector):
+    """Clip rewards into [-bound, bound] on the learner path
+    (reference: the Atari reward-clipping connector)."""
+
+    def __init__(self, bound: float = 1.0):
+        self.bound = bound
+
+    def on_batch(self, batch: SampleBatch) -> SampleBatch:
+        if REWARDS in batch:
+            batch[REWARDS] = np.clip(batch[REWARDS], -self.bound,
+                                     self.bound)
+        return batch
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, connectors: List[Connector]):
+        self.connectors = list(connectors)
+        # An obs-widening connector (FrameStack) must come LAST: its
+        # on_batch reconstructs stacked FINAL_OBS from the OBS column,
+        # which only matches if every other transform already ran —
+        # any other position silently corrupts bootstrap values.
+        for i, c in enumerate(self.connectors):
+            if (c.obs_dim_multiplier() > 1
+                    and i != len(self.connectors) - 1):
+                raise ValueError(
+                    f"{type(c).__name__} widens the observation and "
+                    "must be the last connector in the pipeline")
+
+    def on_obs(self, obs: np.ndarray,
+               resets: Optional[np.ndarray] = None) -> np.ndarray:
+        for c in self.connectors:
+            obs = c.on_obs(obs, resets)
+        return obs
+
+    def on_batch(self, batch: SampleBatch) -> SampleBatch:
+        for c in self.connectors:
+            batch = c.on_batch(batch)
+        return batch
+
+    def get_state(self) -> Dict[str, Any]:
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        for i, c in enumerate(self.connectors):
+            if i in state:
+                c.set_state(state[i])
+
+    def merge_states(self, states: list) -> Dict[str, Any]:
+        return {i: c.merge_states([s.get(i, {}) for s in states if s])
+                for i, c in enumerate(self.connectors)}
+
+    def obs_dim_multiplier(self) -> int:
+        out = 1
+        for c in self.connectors:
+            out *= c.obs_dim_multiplier()
+        return out

@@ -34,17 +34,22 @@ class SingleAgentEnvRunner:
     def __init__(self, env_creator: Callable[[], Env],
                  module_spec: RLModuleSpec, *, num_envs: int = 1,
                  rollout_len: int = 128, seed: int = 0,
-                 explore: bool = True):
+                 explore: bool = True, connectors=None):
         import jax
         self.envs = [env_creator() for _ in range(num_envs)]
         self.spec = module_spec
         self.rollout_len = rollout_len
         self.explore = explore
+        # env→module connector pipeline (ray_tpu/rl/connectors.py);
+        # raw env observations pass through it before every policy query
+        self.connectors = connectors
         self._key = jax.random.PRNGKey(seed)
         self.params = jax.tree.map(np.asarray,
                                    module_spec.init(jax.random.PRNGKey(seed)))
         self._obs = np.stack(
             [env.reset(seed=seed + i)[0] for i, env in enumerate(self.envs)])
+        if self.connectors is not None:
+            self._obs = self.connectors.on_obs(self._obs)
         self._ep_return = np.zeros(num_envs)
         self._ep_len = np.zeros(num_envs, dtype=np.int64)
         self._completed: List[float] = []
@@ -85,9 +90,16 @@ class SingleAgentEnvRunner:
             rewards = np.zeros(N, dtype=np.float32)
             dones = np.zeros(N, dtype=bool)
             truncateds = np.zeros(N, dtype=bool)
-            final_obs = np.empty_like(self._obs)
+            final_obs = None
+            raw_next = None
             for i, env in enumerate(self.envs):
                 obs, rew, term, trunc, _ = env.step(action[i])
+                if final_obs is None:
+                    # raw env shape — with connectors (e.g. FrameStack)
+                    # it differs from the transformed self._obs shape
+                    final_obs = np.zeros((N, *np.shape(obs)),
+                                         dtype=np.asarray(obs).dtype)
+                    raw_next = np.zeros_like(final_obs)
                 rewards[i] = rew
                 final_obs[i] = obs  # the true next obs, pre-reset
                 self._ep_return[i] += rew
@@ -100,14 +112,22 @@ class SingleAgentEnvRunner:
                     self._ep_return[i] = 0.0
                     self._ep_len[i] = 0
                     obs, _ = env.reset()
-                self._obs[i] = obs
+                raw_next[i] = obs
+            if self.connectors is not None:
+                # dones marks envs that just reset: stateful connectors
+                # (FrameStack) must not leak the dead episode's frames
+                self._obs = self.connectors.on_obs(raw_next, resets=dones)
+            else:
+                self._obs = raw_next
             cols[REWARDS].append(rewards)
             cols[DONES].append(dones)
             cols[TRUNCATEDS].append(truncateds)
             cols[FINAL_OBS].append(final_obs)
+        batch = SampleBatch({k: np.stack(v) for k, v in cols.items()})
+        if self.connectors is not None:
+            batch = self.connectors.on_batch(batch)
         bootstrap = np.asarray(
             self.spec.compute_values(self.params, self._obs))
-        batch = SampleBatch({k: np.stack(v) for k, v in cols.items()})
         batch["bootstrap_value"] = bootstrap
         return batch
 
@@ -119,6 +139,14 @@ class SingleAgentEnvRunner:
         self._completed = []
         self._completed_lens = []
         return out
+
+    def get_connector_state(self):
+        return (self.connectors.get_state()
+                if self.connectors is not None else {})
+
+    def set_connector_state(self, state) -> None:
+        if self.connectors is not None:
+            self.connectors.set_state(state)
 
     def ping(self) -> bool:
         return True

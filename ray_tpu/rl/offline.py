@@ -1,0 +1,97 @@
+"""Offline RL data: episode storage + minibatch sampling.
+
+Reference: rllib/offline/ — OfflineData reads experience datasets
+(episodes of obs/actions/rewards) and feeds learner minibatches;
+rllib/offline/offline_data.py + the input readers. Here episodes come
+from plain dicts, a ``ray_tpu.data.Dataset`` of row-dicts, or a
+running policy (``collect_episodes``), and Monte-Carlo returns are
+precomputed at load so advantage-weighted methods (MARWIL) need no
+bootstrapping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.sample_batch import SampleBatch
+
+RETURNS = "returns"
+
+
+class OfflineData:
+    """Flat transition store with per-transition Monte-Carlo returns."""
+
+    def __init__(self, episodes: List[Dict[str, np.ndarray]], *,
+                 gamma: float = 0.99):
+        obs, actions, rewards, returns = [], [], [], []
+        for ep in episodes:
+            r = np.asarray(ep["rewards"], np.float32)
+            g = np.zeros_like(r)
+            acc = 0.0
+            for t in range(len(r) - 1, -1, -1):
+                acc = r[t] + gamma * acc
+                g[t] = acc
+            obs.append(np.asarray(ep["obs"], np.float32))
+            actions.append(np.asarray(ep["actions"]))
+            rewards.append(r)
+            returns.append(g)
+        if not episodes:
+            raise ValueError("OfflineData needs at least one episode")
+        self.obs = np.concatenate(obs)
+        self.actions = np.concatenate(actions)
+        self.rewards = np.concatenate(rewards)
+        self.returns = np.concatenate(returns)
+        self.num_episodes = len(episodes)
+
+    def __len__(self) -> int:
+        return len(self.obs)
+
+    def sample(self, batch_size: int, rng) -> SampleBatch:
+        idx = rng.integers(len(self.obs), size=batch_size)
+        return SampleBatch({
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            RETURNS: self.returns[idx],
+        })
+
+    @staticmethod
+    def from_dataset(dataset, *, gamma: float = 0.99,
+                     episode_id_col: str = "episode_id") -> "OfflineData":
+        """Build from a ray_tpu.data Dataset of transition rows with
+        obs/actions/rewards (+ an episode id column to group by)."""
+        rows = dataset.take_all()
+        episodes: Dict[Any, Dict[str, list]] = {}
+        for row in rows:
+            ep = episodes.setdefault(
+                row.get(episode_id_col, 0),
+                {"obs": [], "actions": [], "rewards": []})
+            ep["obs"].append(row["obs"])
+            ep["actions"].append(row["actions"])
+            ep["rewards"].append(row["rewards"])
+        return OfflineData(list(episodes.values()), gamma=gamma)
+
+
+def collect_episodes(env_creator, policy_fn, *, num_episodes: int,
+                     seed: int = 0,
+                     max_steps: int = 1000) -> List[Dict[str, np.ndarray]]:
+    """Roll a behavior policy to build an offline dataset
+    (``policy_fn(obs) -> action``)."""
+    episodes = []
+    env = env_creator()
+    for e in range(num_episodes):
+        obs, _ = env.reset(seed=seed + e)
+        ep: Dict[str, list] = {"obs": [], "actions": [], "rewards": []}
+        for _ in range(max_steps):
+            action = policy_fn(obs)
+            ep["obs"].append(obs)
+            ep["actions"].append(action)
+            nxt, rew, term, trunc, _ = env.step(action)
+            ep["rewards"].append(rew)
+            obs = nxt
+            if term or trunc:
+                break
+        episodes.append({k: np.asarray(v) for k, v in ep.items()})
+    return episodes
