@@ -20,7 +20,8 @@ from ray_tpu.core.remote_function import RemoteFunction
 from ray_tpu.core.runtime import DriverRuntime
 
 
-def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
+def init(*, address: Optional[str] = None,
+         num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
          resources: Optional[Dict[str, float]] = None,
          labels: Optional[Dict[str, str]] = None,
          object_store_memory: Optional[int] = None,
@@ -47,6 +48,14 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
         if ignore_reinit_error:
             return existing
         raise RuntimeError("ray_tpu is already initialized; call shutdown() first")
+    if address is not None:
+        # CLIENT MODE (reference: Ray Client, python/ray/util/client/):
+        # this process becomes a remote driver proxied through the
+        # head's TCP listener; no local services start.
+        from ray_tpu.core.client import ClientRuntime
+        rt = ClientRuntime(address, namespace=namespace)
+        runtime_mod.set_runtime(rt)
+        return rt
     if head_port is not None:
         system_config = dict(system_config or {})
         system_config.setdefault("head_port", head_port)
@@ -85,8 +94,13 @@ def init(*, num_cpus: Optional[int] = None, num_tpus: Optional[int] = None,
 
 def shutdown() -> None:
     rt = runtime_mod.get_runtime_or_none()
-    if rt is not None and getattr(rt, "is_driver", False):
+    if rt is None:
+        return
+    if getattr(rt, "is_driver", False):
         rt.shutdown()
+    elif getattr(rt, "is_client", False):
+        rt.shutdown()
+        runtime_mod.set_runtime(None)
 
 
 def is_initialized() -> bool:
@@ -151,16 +165,9 @@ def available_resources() -> Dict[str, float]:
 
 
 def nodes() -> List[dict]:
-    rt = runtime_mod.get_runtime()
-    out = []
-    for rec in rt.gcs.alive_nodes():
-        out.append({
-            "NodeID": rec.node_id.hex(),
-            "Alive": rec.alive,
-            "Resources": dict(rec.resources_total),
-            "Labels": dict(rec.labels),
-        })
-    return out
+    # one record shape for every mode: driver dispatches directly,
+    # workers/clients go through their GCS bridge
+    return runtime_mod.get_runtime().gcs_call("list_nodes")
 
 
 class _RuntimeContext:
@@ -176,7 +183,8 @@ class _RuntimeContext:
             return None
         if rt.is_driver:
             return rt.head_node_id.hex()
-        return rt.node_id.hex()
+        node_id = getattr(rt, "node_id", None)
+        return node_id.hex() if node_id is not None else None  # client
 
     def get_actor_id(self) -> Optional[str]:
         rt = runtime_mod.get_runtime_or_none()

@@ -1,0 +1,339 @@
+"""Client mode: a remote driver over TCP.
+
+Capability parity with Ray Client (reference: python/ray/util/client/ —
+a driver outside the cluster connects to a server over gRPC and proxies
+init/remote/get/put/actor calls; server side holds the real driver
+state). Here the head's existing TCP listener (remote_node.HeadServer)
+accepts ``CLIENT_REGISTER`` sessions next to node daemons; the client
+runtime speaks the same message vocabulary as a worker (GCS_REQUEST /
+SUBMIT / GET_OBJECT / CHECK_READY / STREAM_NEXT / REF_ADD / REF_DROP),
+so the whole public API — tasks, actors, named actors, streaming
+generators, runtime envs, collectives rendezvous — works unchanged
+from another host:
+
+    ray_tpu.init(address="head-host:6379")   # client mode
+    @ray_tpu.remote
+    def f(x): ...
+
+Object payloads: puts ship inline to the head (which stores them in
+its arena and owns them on the client's behalf); gets return small
+objects inline and large ones via a chunked pull from the holder
+node's ObjectServer — tensor bytes never squeeze through the control
+message stream.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.protocol import (
+    PROTOCOL_VERSION, MessageConnection, connect_tcp, parse_address)
+from ray_tpu.core.task_manager import ReferenceCounter
+from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+
+
+class _MemStore:
+    """Minimal in-memory store satisfying object_transfer.pull_object's
+    destination interface (the client has no shm arena)."""
+
+    def __init__(self):
+        self._bufs: Dict[ObjectID, bytearray] = {}
+        self._sealed: Dict[ObjectID, threading.Event] = {}
+        self._lock = threading.Lock()
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            event = self._sealed.get(oid)
+        return event is not None and event.is_set()
+
+    def create(self, oid: ObjectID, size: int) -> memoryview:
+        with self._lock:
+            if oid in self._bufs:
+                raise FileExistsError(oid.hex())
+            self._bufs[oid] = bytearray(size)
+            self._sealed[oid] = threading.Event()
+            return memoryview(self._bufs[oid])
+
+    def seal(self, oid: ObjectID) -> None:
+        self._sealed[oid].set()
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._bufs.pop(oid, None)
+            self._sealed.pop(oid, None)
+
+    def get_buffer(self, oid: ObjectID, timeout_s: float = 0.0):
+        with self._lock:
+            event = self._sealed.get(oid)
+        if event is None or not event.wait(timeout_s):
+            return None
+        return memoryview(self._bufs[oid])
+
+    def release(self, oid: ObjectID) -> None:
+        pass
+
+    def take(self, oid: ObjectID) -> bytes:
+        with self._lock:
+            data = bytes(self._bufs.pop(oid))
+            self._sealed.pop(oid, None)
+        return data
+
+
+class ClientRuntime:
+    """The runtime the public API talks to in client mode."""
+
+    is_driver = False
+    is_client = True
+
+    def __init__(self, address: str, namespace: str = ""):
+        host, port = parse_address(address)
+        self.address = address
+        self.conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
+        self.conn.send({"kind": "CLIENT_REGISTER",
+                        "proto_version": PROTOCOL_VERSION,
+                        "namespace": namespace})
+        reply = self.conn.recv()
+        if reply is None or reply.get("kind") != "REGISTERED":
+            reason = (reply or {}).get("reason", "connection closed")
+            raise ConnectionError(f"head rejected client: {reason}")
+        self.head_node_id = NodeID(reply["head_node_id"])
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
+        self._replies: Dict[int, Tuple[threading.Event, list]] = {}
+        self._pubsub_callbacks: Dict[str, list] = {}
+        self._closed = threading.Event()
+        self._pull_store = _MemStore()
+        self.current_runtime_env: Optional[dict] = None
+        self.on_block = None  # worker-interface compat (never blocks a pool)
+        self.reference_counter = ReferenceCounter()
+        self.reference_counter.set_on_first(
+            lambda oid: self._send({"kind": "REF_ADD",
+                                    "object_id": oid.binary()}))
+        self.reference_counter.set_deleter(
+            lambda oid: self._send({"kind": "REF_DROP",
+                                    "object_id": oid.binary()}))
+        self._reader = threading.Thread(target=self._reader_loop,
+                                        name="client-reader", daemon=True)
+        self._reader.start()
+
+    # -- transport -------------------------------------------------------
+    def _send(self, msg: dict) -> None:
+        if self._closed.is_set():
+            return
+        try:
+            self.conn.send(msg)
+        except OSError:
+            self._closed.set()
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        with self._req_lock:
+            self._req_counter += 1
+            rid = self._req_counter
+            event = threading.Event()
+            slot: list = [None]
+            self._replies[rid] = (event, slot)
+        msg["req_id"] = rid
+        self._send(msg)
+        if self._closed.is_set():
+            # the reader already woke (only) the requests registered at
+            # disconnect time; a request registered after must not wait
+            # on a reply that can never arrive
+            with self._req_lock:
+                self._replies.pop(rid, None)
+            raise ConnectionError("connection to head lost")
+        if not event.wait(timeout):
+            with self._req_lock:
+                self._replies.pop(rid, None)
+            raise GetTimeoutError(
+                f"client request {msg.get('kind')} timed out")
+        with self._req_lock:
+            self._replies.pop(rid, None)
+        if slot[0] is None:
+            raise ConnectionError("connection to head lost")
+        return slot[0]
+
+    def _reader_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                msg = self.conn.recv()
+            except OSError:
+                msg = None
+            if msg is None:
+                break
+            kind = msg.get("kind")
+            if kind == "PUBSUB_MSG":
+                for cb in list(self._pubsub_callbacks.get(
+                        msg["channel"], ())):
+                    try:
+                        cb(serialization.loads(msg["data"]))
+                    except Exception:  # noqa: BLE001 — user callback
+                        pass
+                continue
+            rid = msg.get("req_id")
+            with self._req_lock:
+                entry = self._replies.get(rid)
+            if entry is not None:
+                event, slot = entry
+                slot[0] = msg
+                event.set()
+        self._closed.set()
+        # unblock every pending request with a connection error
+        with self._req_lock:
+            entries = list(self._replies.values())
+            self._replies.clear()
+        for event, _slot in entries:
+            event.set()
+
+    # -- object plane ----------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        with serialization.collect_contained_refs() as contained:
+            data, buffers = serialization.serialize(value)
+        return self.put_serialized(
+            data, buffers, contained=[o.binary() for o in contained])
+
+    def put_serialized(self, data: bytes, buffers, contained=()) -> ObjectRef:
+        packed = serialization.pack_parts(data, list(buffers))
+        reply = self.request({"kind": "CLIENT_PUT", "data": packed,
+                              "contained": list(contained)}, timeout=120.0)
+        if reply.get("status") == "error":
+            raise serialization.loads(reply["error"])
+        oid = ObjectID(reply["object_id"])
+        # constructing the ref registers the first local reference,
+        # which sends REF_ADD — the head then holds the object for this
+        # session until the matching REF_DROP
+        return ObjectRef(oid)
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float]):
+        reply = self.request({"kind": "GET_OBJECT",
+                              "object_id": oid.binary()}, timeout=timeout)
+        status = reply["status"]
+        if status == "inline":
+            return serialization.unpack(reply["data"])
+        if status == "pull":
+            from ray_tpu.core.object_transfer import pull_object
+            if not pull_object(tuple(reply["addr"]), oid, self._pull_store):
+                raise ObjectLostError(oid)
+            return serialization.unpack(self._pull_store.take(oid))
+        if status == "error":
+            raise serialization.loads(reply["error"])
+        raise ObjectLostError(oid)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        import time as _time
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        out = []
+        for ref in refs:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - _time.monotonic()))
+            out.append(self._get_one(ref.id, remaining))
+        return out[0] if single else out
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        # mirrors the worker's CHECK_READY polling protocol
+        import time as _time
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds the number of refs")
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        while True:
+            ids = [r.id.binary() for r in pending]
+            reply = self.request({"kind": "CHECK_READY",
+                                  "object_ids": ids}, timeout=30.0)
+            ready_set = set(reply["ready"])
+            ready.extend(r for r in pending if r.id.binary() in ready_set)
+            pending = [r for r in pending
+                       if r.id.binary() not in ready_set]
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and _time.monotonic() >= deadline:
+                break
+            _time.sleep(0.005)
+        done = ready[:num_returns]
+        return done, ready[num_returns:] + pending
+
+    # -- control plane ---------------------------------------------------
+    def submit_spec(self, spec) -> None:
+        self._send({"kind": "SUBMIT",
+                    "spec": serialization.dumps_fast(spec)})
+
+    def create_actor(self, spec, name: Optional[str] = None) -> None:
+        self.submit_spec(spec)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._send({"kind": "KILL_ACTOR", "actor_id": actor_id.binary(),
+                    "no_restart": no_restart})
+
+    def cancel(self, object_id: ObjectID, force: bool = False) -> None:
+        self._send({"kind": "CANCEL", "object_id": object_id.binary(),
+                    "force": force})
+
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: Optional[float]):
+        reply = self.request({"kind": "STREAM_NEXT",
+                              "task_id": task_id.binary(),
+                              "index": index}, timeout=timeout)
+        status = reply["status"]
+        if status == "item":
+            return "item", ObjectID(reply["object_id"])
+        if status == "done":
+            return "done", None
+        return "error", serialization.loads(reply["error"])
+
+    def gcs_call(self, method: str, *args, timeout: float = 30.0) -> Any:
+        reply = self.request({"kind": "GCS_REQUEST", "method": method,
+                              "args": serialization.dumps(args)},
+                             timeout=timeout)
+        if reply.get("error"):
+            raise serialization.loads(reply["error"])
+        return serialization.loads(reply["result"])
+
+    def get_function(self, function_id: str):
+        blob = self.gcs_call("get_function", function_id)
+        if blob is None:
+            raise RuntimeError(f"function {function_id} not found")
+        return serialization.loads(blob)
+
+    def put_function(self, function_id: str, blob: bytes) -> None:
+        self.gcs_call("put_function", function_id, blob)
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    def subscribe_channel(self, channel: str, callback) -> None:
+        with self._req_lock:
+            callbacks = self._pubsub_callbacks.setdefault(channel, [])
+            first = not callbacks
+            callbacks.append(callback)
+        if first:
+            self._send({"kind": "SUBSCRIBE", "channel": channel})
+
+    def publish_channel(self, channel: str, message: Any) -> None:
+        self.gcs_call("publish", channel, serialization.dumps(message))
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.gcs_call("cluster_resources")
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.gcs_call("available_resources")
+
+    def list_nodes(self) -> List[dict]:
+        return self.gcs_call("list_nodes")
+
+    def shutdown(self) -> None:
+        self._closed.set()
+        try:
+            self.conn.send({"kind": "CLIENT_DISCONNECT"})
+        except OSError:
+            pass
+        self.conn.close()

@@ -163,6 +163,102 @@ class RemoteNode:
             pass
 
 
+class ClientSession:
+    """A remote-driver session on the head's TCP listener
+    (reference: python/ray/util/client/server/ — the server-side proxy
+    holding real driver state for an out-of-cluster client). Plays the
+    roles the runtime handlers expect of a (node, worker) pair:
+    ``is_remote=True`` so object replies use inline data or chunked
+    pulls, never local-shm pointers."""
+
+    is_remote = True
+    object_addr = None
+
+    def __init__(self, runtime, conn: MessageConnection):
+        self.runtime = runtime
+        self.conn = conn
+        self.node_id = NodeID.from_random()   # identity only; never
+        self.worker_id = WorkerID.from_random()  # scheduled onto
+        self.held_refs: set = set()
+        self._lock = threading.Lock()
+
+    def send(self, msg: dict) -> bool:
+        try:
+            self.conn.send(msg)
+            return True
+        except OSError:
+            return False
+
+    def handle(self, msg: dict) -> bool:
+        rt = self.runtime
+        kind = msg["kind"]
+        if kind == "CLIENT_DISCONNECT":
+            return False
+        if kind == "GCS_REQUEST":
+            rt.handle_gcs_request(self, msg)
+        elif kind == "SUBMIT":
+            rt.submit_spec(serialization.loads(msg["spec"]))
+        elif kind == "CLIENT_PUT":
+            self._client_put(msg)
+        elif kind == "GET_OBJECT":
+            rt.handle_get_object(self, self, msg)
+        elif kind == "CHECK_READY":
+            rt.handle_check_ready(self, msg)
+        elif kind == "STREAM_NEXT":
+            rt.handle_stream_next(self, msg)
+        elif kind == "SUBSCRIBE":
+            rt.handle_subscribe(self, self, msg)
+        elif kind == "REF_ADD":
+            oid = ObjectID(msg["object_id"])
+            with self._lock:
+                self.held_refs.add(oid)
+            rt.reference_counter.add_local_reference(oid)
+        elif kind == "REF_DROP":
+            oid = ObjectID(msg["object_id"])
+            with self._lock:
+                self.held_refs.discard(oid)
+            rt.deferred_remove_reference(oid)
+        elif kind == "KILL_ACTOR":
+            rt.kill_actor(ActorID(msg["actor_id"]),
+                          no_restart=msg.get("no_restart", True))
+        elif kind == "CANCEL":
+            rt.cancel(ObjectID(msg["object_id"]),
+                      force=msg.get("force", False))
+        return True
+
+    def _client_put(self, msg: dict) -> None:
+        """Store a client-shipped payload on the head (owner side), pin
+        it for this session, and reply with the assigned object id."""
+        rt = self.runtime
+        oid = ObjectID.from_random()
+        out = {"kind": "OBJECT_VALUE", "req_id": msg.get("req_id"),
+               "object_id": oid.binary()}
+        try:
+            rt.store_packed_object(oid, msg["data"],
+                                   contained=msg.get("contained", ()))
+        except Exception as exc:  # noqa: BLE001 — e.g. arena full
+            out.update(status="error",
+                       error=serialization.dumps(exc))
+            self.send(out)
+            return
+        # no pin here: the client's ObjectRef construction sends REF_ADD
+        # on this same ordered connection right after the reply — a
+        # second pin would leak one count forever
+        out["status"] = "stored"
+        self.send(out)
+
+    def close(self) -> None:
+        """Client disconnected: release every reference it held —
+        objects it exclusively pinned become reclaimable — and drop its
+        pubsub push routes (they capture this dead connection)."""
+        with self._lock:
+            held = list(self.held_refs)
+            self.held_refs.clear()
+        for oid in held:
+            self.runtime.reference_counter.remove_local_reference(oid)
+        self.runtime._drop_worker_subscriptions(self.node_id)
+
+
 class HeadServer:
     """The head's TCP listener for node daemons."""
 
@@ -202,24 +298,35 @@ class HeadServer:
 
     def _reader_loop(self, conn: MessageConnection) -> None:
         node: Optional[RemoteNode] = None
+        client: Optional["ClientSession"] = None
         while True:
             msg = conn.recv()
             if msg is None:
                 break
             try:
-                if node is None:
-                    if msg.get("kind") != "NODE_REGISTER":
-                        break
+                if node is None and client is None:
                     from ray_tpu.core.protocol import PROTOCOL_VERSION
+                    kind = msg.get("kind")
                     peer_version = msg.get("proto_version", 0)
+                    if kind not in ("NODE_REGISTER", "CLIENT_REGISTER"):
+                        break
                     if peer_version != PROTOCOL_VERSION:
                         conn.send({"kind": "REGISTER_REJECTED",
                                    "reason": "protocol version mismatch: "
                                              f"head={PROTOCOL_VERSION} "
-                                             f"daemon={peer_version}"})
+                                             f"peer={peer_version}"})
                         break
+                    if kind == "CLIENT_REGISTER":
+                        client = ClientSession(self.runtime, conn)
+                        conn.send({"kind": "REGISTERED",
+                                   "head_node_id":
+                                       self.runtime.head_node_id.binary()})
+                        continue
                     node = self.runtime.register_remote_node(conn, msg)
                     conn.send({"kind": "REGISTERED"})
+                elif client is not None:
+                    if not client.handle(msg):
+                        break
                 else:
                     self._handle(node, msg)
             except Exception:  # noqa: BLE001 — keep the daemon link alive
@@ -227,6 +334,8 @@ class HeadServer:
                 traceback.print_exc()
         if node is not None:
             self.runtime.on_remote_node_death(node.node_id)
+        if client is not None:
+            client.close()
 
     def _handle(self, node: RemoteNode, msg: dict) -> None:
         rt = self.runtime

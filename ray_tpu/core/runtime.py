@@ -1059,6 +1059,35 @@ class DriverRuntime:
         self.task_manager.mark_object_ready(oid)
         return ObjectRef(oid)
 
+    def store_packed_object(self, oid: ObjectID, packed: bytes,
+                            contained=()) -> None:
+        """Store an already-packed payload under a given id (client-mode
+        puts: the client ships packed bytes, the head owns the object).
+        Small payloads go to the memory store; large ones into the head
+        arena via a raw create/seal write."""
+        cfg = get_config()
+        if len(packed) < cfg.max_inline_object_size:
+            self.memory_store.put(oid, ("packed", packed))
+            self.task_manager.set_location(oid, ObjectLocation("memory"))
+        else:
+            head = self.nodes[self.head_node_id]
+            from ray_tpu.exceptions import ObjectStoreFullError
+            try:
+                buf = head.store.create(oid, len(packed))
+            except ObjectStoreFullError:
+                self.spill_on_node(head, len(packed))
+                buf = head.store.create(oid, len(packed))
+            try:
+                buf[:] = packed
+            finally:
+                del buf
+            head.store.seal(oid)
+            self.task_manager.set_location(
+                oid, ObjectLocation("shm", self.head_node_id))
+        if contained:
+            self._pin_contained(oid, contained)
+        self.task_manager.mark_object_ready(oid)
+
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
         if single:
@@ -1655,6 +1684,13 @@ class DriverRuntime:
             return self.cluster_resources()
         if method == "available_resources":
             return self.available_resources()
+        if method == "list_nodes":
+            return [{
+                "NodeID": rec.node_id.hex(),
+                "Alive": rec.alive,
+                "Resources": dict(rec.resources_total),
+                "Labels": dict(rec.labels),
+            } for rec in gcs.alive_nodes()]
         if method == "publish":
             self.gcs.pubsub.publish(args[0], serialization.loads(args[1]))
             return True

@@ -105,6 +105,9 @@ class _EnvRunnerActor:
     def get_connector_state(self):
         return self.runner.get_connector_state()
 
+    def pop_connector_delta(self):
+        return self.runner.pop_connector_delta()
+
     def set_connector_state(self, state) -> None:
         self.runner.set_connector_state(state)
 
@@ -125,6 +128,13 @@ class PPO(Algorithm):
         self.learner_group = LearnerGroup(
             PPOLearner, num_learners=config.num_learners, **learner_kwargs)
         self._rng = np.random.default_rng(config.seed)
+        # connector sync (remote runners): one template pipeline holds
+        # the driver's canonical state; rebuilt-per-step pipelines would
+        # churn objects and lose the canonical accumulation
+        self._connector_template = config.build_connectors()
+        self._connector_state = (
+            self._connector_template.get_state()
+            if self._connector_template is not None else None)
 
         jax_env = config.make_jax_env()
         if (jax_env is not None and config.num_env_runners == 0
@@ -230,18 +240,22 @@ class PPO(Algorithm):
                 cols, metrics = serialization.loads(blob)
                 batches.append(self._postprocess(cols, weights))
                 self.record_episodes(metrics["episode_returns"])
-            if self.config.connector_factories and len(self.runners) > 1:
-                # connector-state sync: merge per-runner statistics
-                # (e.g. obs mean/var) and broadcast, so normalization
-                # is consistent across the fleet (reference: connector
-                # state aggregation across env runners)
-                states = ray_tpu.get(
-                    [r.get_connector_state.remote()
+            if self._connector_template is not None and len(self.runners) > 1:
+                # connector-state sync: each runner reports only the
+                # statistics accumulated SINCE the last sync (disjoint
+                # deltas); the driver folds them into its canonical
+                # state and broadcasts — merging full states would
+                # double-count shared history and inflate the Welford
+                # count ~world_size× per iteration (reference: rllib
+                # filter delta buffers / apply_changes)
+                deltas = ray_tpu.get(
+                    [r.pop_connector_delta.remote()
                      for r in self.runners])
-                merged = self.config.build_connectors().merge_states(
-                    states)
+                self._connector_state = (
+                    self._connector_template.merge_states(
+                        [self._connector_state] + deltas))
                 ray_tpu.get(
-                    [r.set_connector_state.remote(merged)
+                    [r.set_connector_state.remote(self._connector_state)
                      for r in self.runners])
         else:
             for runner in self.runners:

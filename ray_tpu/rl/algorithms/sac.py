@@ -260,8 +260,21 @@ class SAC(Algorithm):
 
     def get_state(self) -> Dict[str, Any]:
         state = super().get_state()
-        state.update(params=self.params, target_params=self.target_params,
-                     log_alpha=self.log_alpha)
+        state.update(
+            params=self.params, target_params=self.target_params,
+            log_alpha=self.log_alpha,
+            # optimizer moments + alpha optimizer + PRNG + replay: a
+            # restore must continue training, not silently restart
+            # warmup with fresh Adam moments and an empty buffer
+            opt_state=self.opt_state,
+            alpha_opt_state=self.alpha_opt_state,
+            key=self._key,
+            buffer={
+                "obs": self.buffer.obs, "next_obs": self.buffer.next_obs,
+                "actions": self.buffer.actions,
+                "rewards": self.buffer.rewards, "dones": self.buffer.dones,
+                "pos": self.buffer.pos, "size": self.buffer.size,
+            })
         return state
 
     def set_state(self, state: Dict[str, Any]) -> None:
@@ -269,6 +282,18 @@ class SAC(Algorithm):
         self.params = state["params"]
         self.target_params = state["target_params"]
         self.log_alpha = state["log_alpha"]
+        if "opt_state" in state:
+            self.opt_state = state["opt_state"]
+            self.alpha_opt_state = state["alpha_opt_state"]
+            self._key = state["key"]
+            buf = state["buffer"]
+            self.buffer.obs[:] = buf["obs"]
+            self.buffer.next_obs[:] = buf["next_obs"]
+            self.buffer.actions[:] = buf["actions"]
+            self.buffer.rewards[:] = buf["rewards"]
+            self.buffer.dones[:] = buf["dones"]
+            self.buffer.pos = buf["pos"]
+            self.buffer.size = buf["size"]
 
 
 SACConfig.algo_class = SAC

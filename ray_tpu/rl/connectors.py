@@ -35,8 +35,16 @@ class Connector:
 
     def merge_states(self, states: list) -> Dict[str, Any]:
         """Combine per-runner states into one (driver-side merge before
-        broadcast; reference: connector-state aggregation)."""
+        broadcast; reference: connector-state aggregation). The inputs
+        must cover DISJOINT samples — the sync protocol passes the
+        driver's canonical state plus per-runner deltas
+        (``pop_delta_state``), never two copies of shared history."""
         return states[0] if states else {}
+
+    def pop_delta_state(self) -> Dict[str, Any]:
+        """Return (and clear) the state accumulated since the last sync
+        (reference: rllib filters' apply_changes delta buffers)."""
+        return {}
 
     def on_batch(self, batch: SampleBatch) -> SampleBatch:
         return batch
@@ -63,6 +71,13 @@ class ObsNormalizer(Connector):
         self.count = 0.0
         self.mean: Optional[np.ndarray] = None
         self.m2: Optional[np.ndarray] = None  # sum of squared deviations
+        # since-last-sync accumulator: the sync protocol merges ONLY
+        # disjoint deltas into the driver's canonical state — merging
+        # full states would double-count shared history and blow the
+        # count up by ~world_size per sync
+        self._d_count = 0.0
+        self._d_mean: Optional[np.ndarray] = None
+        self._d_m2: Optional[np.ndarray] = None
 
     def _update(self, obs: np.ndarray) -> None:
         flat = obs.reshape(-1, obs.shape[-1]).astype(np.float64)
@@ -71,11 +86,18 @@ class ObsNormalizer(Connector):
             # zeros, not ones: a ones-init biases the variance by
             # 1/(count-1); _apply's eps already guards the divide
             self.m2 = np.zeros(flat.shape[-1])
+        if self._d_mean is None:
+            self._d_mean = np.zeros(flat.shape[-1])
+            self._d_m2 = np.zeros(flat.shape[-1])
         for row in flat:  # Welford; rollout sizes keep this cheap
             self.count += 1.0
             delta = row - self.mean
             self.mean += delta / self.count
             self.m2 += delta * (row - self.mean)
+            self._d_count += 1.0
+            d_delta = row - self._d_mean
+            self._d_mean += d_delta / self._d_count
+            self._d_m2 += d_delta * (row - self._d_mean)
 
     def _apply(self, obs: np.ndarray) -> np.ndarray:
         if self.mean is None or self.count < 2:
@@ -103,6 +125,14 @@ class ObsNormalizer(Connector):
         self.count = state["count"]
         self.mean = state["mean"]
         self.m2 = state["m2"]
+
+    def pop_delta_state(self) -> Dict[str, Any]:
+        out = {"count": self._d_count, "mean": self._d_mean,
+               "m2": self._d_m2}
+        self._d_count = 0.0
+        self._d_mean = None
+        self._d_m2 = None
+        return out
 
     def merge_states(self, states: list) -> Dict[str, Any]:
         """Parallel Welford merge (Chan et al.) of per-runner stats."""
@@ -217,6 +247,10 @@ class ConnectorPipeline(Connector):
 
     def merge_states(self, states: list) -> Dict[str, Any]:
         return {i: c.merge_states([s.get(i, {}) for s in states if s])
+                for i, c in enumerate(self.connectors)}
+
+    def pop_delta_state(self) -> Dict[str, Any]:
+        return {i: c.pop_delta_state()
                 for i, c in enumerate(self.connectors)}
 
     def obs_dim_multiplier(self) -> int:

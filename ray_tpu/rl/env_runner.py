@@ -144,6 +144,10 @@ class SingleAgentEnvRunner:
         return (self.connectors.get_state()
                 if self.connectors is not None else {})
 
+    def pop_connector_delta(self):
+        return (self.connectors.pop_delta_state()
+                if self.connectors is not None else {})
+
     def set_connector_state(self, state) -> None:
         if self.connectors is not None:
             self.connectors.set_state(state)

@@ -1,0 +1,158 @@
+"""Client-mode tests (reference model: python/ray/util/client tests —
+a remote driver proxied through the cluster's server).
+
+The head runs in this process with a TCP listener; the CLIENT runs in a
+real subprocess (its own interpreter, no shared memory with the head)
+and drives tasks/actors/objects through ray_tpu.init(address=...).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import os
+    import numpy as np
+    import ray_tpu
+
+    rt = ray_tpu.init(address=os.environ["RTPU_HEAD_ADDR"])
+    assert not rt.is_driver
+
+    # tasks + inline objects
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+    assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5
+
+    # large object: put ships to the head, get pulls chunked
+    big = np.arange(300_000, dtype=np.float64)  # 2.4MB > inline cap
+    ref = ray_tpu.put(big)
+    back = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_array_equal(back, big)
+
+    # large TASK RESULT pulled from the head's arena
+    @ray_tpu.remote
+    def make_big(n):
+        return np.ones(n, dtype=np.float64)
+    out = ray_tpu.get(make_big.remote(400_000), timeout=60)
+    assert out.shape == (400_000,) and out[0] == 1.0
+
+    # object as task arg (dependency through the head)
+    assert ray_tpu.get(add.remote(ref, ref), timeout=60).sum() == 2 * big.sum()
+
+    # actors incl. named lookup
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+    c = Counter.options(name="client-counter").remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    assert ray_tpu.get(c.incr.remote(4), timeout=60) == 5
+    c2 = ray_tpu.get_actor("client-counter")
+    assert ray_tpu.get(c2.incr.remote(), timeout=60) == 6
+
+    # wait()
+    refs = [add.remote(i, i) for i in range(4)]
+    done, rest = ray_tpu.wait(refs, num_returns=4, timeout=60)
+    assert len(done) == 4 and not rest
+
+    # streaming generator across the client boundary
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+    got = [ray_tpu.get(r, timeout=60) for r in gen.remote(4)]
+    assert got == [0, 10, 20, 30]
+
+    # error propagation
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("kapow")
+    try:
+        ray_tpu.get(boom.remote(), timeout=60)
+        raise SystemExit("expected failure")
+    except Exception as e:
+        assert "kapow" in str(e)
+
+    # cluster introspection
+    assert ray_tpu.cluster_resources().get("CPU", 0) >= 2
+    assert len(ray_tpu.nodes()) >= 1
+
+    ray_tpu.shutdown()
+    print("CLIENT-OK")
+""")
+
+
+@pytest.fixture
+def head_with_port():
+    rt = ray_tpu.init(num_cpus=4, head_port=0)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _run_client(script: str, address: str, timeout: float = 180.0):
+    env = dict(os.environ)
+    env["RTPU_HEAD_ADDR"] = address
+    env["PYTHONPATH"] = (os.getcwd() + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_client_end_to_end(head_with_port):
+    proc = _run_client(CLIENT_SCRIPT, head_with_port.head_address)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "CLIENT-OK" in proc.stdout
+
+
+def test_client_disconnect_releases_refs(head_with_port):
+    script = textwrap.dedent("""
+        import os
+        import numpy as np
+        import ray_tpu
+        ray_tpu.init(address=os.environ["RTPU_HEAD_ADDR"])
+        ref = ray_tpu.put(np.ones(300_000))
+        print("OID", ref.hex())
+        # exit WITHOUT dropping the ref: disconnect must release it
+    """)
+    proc = _run_client(script, head_with_port.head_address)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    oid_hex = [line.split()[1] for line in proc.stdout.splitlines()
+               if line.startswith("OID")][0]
+    import time
+    from ray_tpu.core.ids import ObjectID
+    oid = ObjectID.from_hex(oid_hex)
+    rt = head_with_port
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        with rt.reference_counter._lock:
+            if rt.reference_counter._counts.get(oid, 0) == 0:
+                return
+        time.sleep(0.2)
+    raise AssertionError("client refs not released on disconnect")
+
+
+def test_client_rejected_on_version_skew(head_with_port):
+    script = textwrap.dedent("""
+        import os
+        from ray_tpu.core.protocol import (MessageConnection, connect_tcp,
+                                           parse_address)
+        host, port = parse_address(os.environ["RTPU_HEAD_ADDR"])
+        conn = MessageConnection(connect_tcp(host, port, timeout=10))
+        conn.send({"kind": "CLIENT_REGISTER", "proto_version": -1})
+        reply = conn.recv()
+        assert reply["kind"] == "REGISTER_REJECTED", reply
+        print("REJECTED-OK")
+    """)
+    proc = _run_client(script, head_with_port.head_address)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "REJECTED-OK" in proc.stdout

@@ -467,6 +467,30 @@ def test_connector_state_merge():
     assert merged["count"] == full.count
 
 
+def test_connector_delta_sync_no_double_count():
+    """The sync protocol (canonical + disjoint deltas) must keep the
+    count equal to the true number of samples across repeated rounds —
+    merging full states would inflate it ~world_size x per round."""
+    from ray_tpu.rl.connectors import ObsNormalizer
+
+    rng = np.random.default_rng(1)
+    template = ObsNormalizer()
+    canonical = template.get_state()
+    runners = [ObsNormalizer() for _ in range(3)]
+    total = 0
+    for _round in range(5):
+        for r in runners:
+            r.on_obs(rng.normal(size=(10, 2)).astype(np.float32))
+            total += 10
+        deltas = [r.pop_delta_state() for r in runners]
+        canonical = template.merge_states([canonical] + deltas)
+        for r in runners:
+            r.set_state(canonical)
+    assert canonical["count"] == total, (canonical["count"], total)
+    # a second pop without new data is empty (no re-reporting)
+    assert runners[0].pop_delta_state()["mean"] is None
+
+
 def test_ppo_with_connectors_learns():
     """PPO through the connector pipeline (obs-normalize + frame-stack):
     the module sees the widened obs and still trains end to end."""
@@ -488,3 +512,31 @@ def test_ppo_with_connectors_learns():
         result = algo.train()
     assert np.isfinite(result["episode_return_mean"])
     assert result["episode_return_mean"] > 40, result["episode_return_mean"]
+
+
+def test_sac_state_roundtrip(tmp_path):
+    from ray_tpu.rl import SACConfig
+
+    config = (SACConfig()
+              .environment("Pendulum-v1")
+              .training(train_batch_size=32, learning_starts=64,
+                        num_gradient_steps=4, rollout_fragment_length=20)
+              .env_runners(num_envs_per_env_runner=2)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    algo.train()
+    algo.train()
+    path = algo.save_to_path(str(tmp_path / "ckpt"))
+    algo2 = config.copy().build_algo()
+    algo2.restore_from_path(path)
+    import jax
+    # params, optimizer moments, buffer, and rng all travel
+    for a, b in zip(jax.tree.leaves(algo.params),
+                    jax.tree.leaves(algo2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(algo.opt_state),
+                    jax.tree.leaves(algo2.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert algo2.buffer.size == algo.buffer.size
+    assert algo2.iteration == algo.iteration
+    algo2.train()  # restored run continues without re-warmup
