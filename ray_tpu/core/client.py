@@ -76,11 +76,13 @@ class _MemStore:
     def release(self, oid: ObjectID) -> None:
         pass
 
-    def take(self, oid: ObjectID) -> bytes:
+    def take(self, oid: ObjectID) -> Optional[bytes]:
+        """Pop the sealed payload; None if a concurrent get of the same
+        ref already consumed it (the caller re-pulls)."""
         with self._lock:
-            data = bytes(self._bufs.pop(oid))
+            buf = self._bufs.pop(oid, None)
             self._sealed.pop(oid, None)
-        return data
+        return bytes(buf) if buf is not None else None
 
 
 class ClientRuntime:
@@ -215,9 +217,16 @@ class ClientRuntime:
             return serialization.unpack(reply["data"])
         if status == "pull":
             from ray_tpu.core.object_transfer import pull_object
-            if not pull_object(tuple(reply["addr"]), oid, self._pull_store):
-                raise ObjectLostError(oid)
-            return serialization.unpack(self._pull_store.take(oid))
+            for _attempt in range(3):
+                if not pull_object(tuple(reply["addr"]), oid,
+                                   self._pull_store):
+                    raise ObjectLostError(oid)
+                data = self._pull_store.take(oid)
+                if data is not None:
+                    return serialization.unpack(data)
+                # a concurrent get of the same ref consumed the buffer
+                # between seal and take: pull again
+            raise ObjectLostError(oid)
         if status == "error":
             raise serialization.loads(reply["error"])
         raise ObjectLostError(oid)
@@ -320,6 +329,20 @@ class ClientRuntime:
 
     def publish_channel(self, channel: str, message: Any) -> None:
         self.gcs_call("publish", channel, serialization.dumps(message))
+
+    def as_future(self, ref: ObjectRef):
+        """concurrent.futures bridge (reference: ObjectRef.future())."""
+        from concurrent.futures import Future
+        future: Future = Future()
+
+        def resolve():
+            try:
+                future.set_result(self.get(ref))
+            except Exception as exc:  # noqa: BLE001 — future carries it
+                future.set_exception(exc)
+
+        threading.Thread(target=resolve, daemon=True).start()
+        return future
 
     def cluster_resources(self) -> Dict[str, float]:
         return self.gcs_call("cluster_resources")

@@ -22,7 +22,9 @@ def _sources():
     return sorted(
         os.path.join(_SRC_DIR, f)
         for f in os.listdir(_SRC_DIR)
-        if f.endswith(".cc")
+        # *_main.cc are standalone test binaries (stress harness), not
+        # part of the runtime library
+        if f.endswith(".cc") and not f.endswith("_main.cc")
     )
 
 
@@ -42,3 +44,29 @@ def ensure_built() -> str:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
         return _LIB_PATH
+
+
+def build_stress(sanitizer: str = "") -> str:
+    """Build the shm-store stress binary (ray_tpu/native/src/
+    stress_test_main.cc), optionally under ASan/TSan — the seam the
+    reference covers with its sanitizer bazel configs (SURVEY.md §5.2,
+    .bazelrc:112-132). Returns the binary path; raises
+    subprocess.CalledProcessError with compiler output on failure."""
+    if sanitizer not in ("", "address", "thread"):
+        raise ValueError(f"unknown sanitizer {sanitizer!r}")
+    suffix = f"-{sanitizer}" if sanitizer else ""
+    out = os.path.join(_BUILD_DIR, f"shm_stress{suffix}")
+    with _lock:
+        srcs = _sources() + [os.path.join(_SRC_DIR, "stress_test_main.cc")]
+        if os.path.exists(out):
+            bin_mtime = os.path.getmtime(out)
+            if all(os.path.getmtime(s) <= bin_mtime for s in srcs):
+                return out
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        cmd = ["g++", "-O1", "-g", "-std=c++17", "-Wall", "-pthread"]
+        if sanitizer:
+            cmd += [f"-fsanitize={sanitizer}", "-fno-omit-frame-pointer"]
+        cmd += ["-o", out + ".tmp", *srcs]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(out + ".tmp", out)
+        return out

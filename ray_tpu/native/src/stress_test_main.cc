@@ -1,0 +1,187 @@
+// Stress/sanitizer harness for the shm store (SURVEY.md §5.2 parity:
+// the reference runs its C++ unit tests under TSan/ASan bazel configs,
+// .bazelrc:112-132; this binary is the equivalent seam for the
+// daemonless store).
+//
+// Not compiled into the runtime library: built on demand by
+// ray_tpu/native/build.py (plain, -fsanitize=address, or
+// -fsanitize=thread) and driven by tests/test_native_stress.py.
+//
+//   stress_test <threads|procs> <workers> <iters> [arena_mb]
+//
+// Workers hammer create/seal/get/verify/release/delete concurrently
+// over one MAP_SHARED arena. Payloads are filled with a pattern
+// derived from the object id, and every reader verifies every byte —
+// a torn write, a use-after-free, or an allocator overlap shows up as
+// a pattern mismatch (exit 2), a lost wakeup as a watchdog kill
+// (exit 3). Thread mode runs under TSan (which is per-process);
+// process mode exercises the robust-mutex / cross-process paths.
+
+#include <pthread.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int64_t shm_required_overhead(uint64_t max_objects);
+int64_t shm_init(void* base, uint64_t total_size, uint64_t max_objects);
+int64_t shm_attach(void* base);
+int64_t shm_create(void* base, const uint8_t* id, uint64_t size,
+                   uint64_t* offset_out);
+int64_t shm_seal(void* base, const uint8_t* id);
+int64_t shm_get(void* base, const uint8_t* id, double timeout_s,
+                uint64_t* offset_out, uint64_t* size_out);
+int64_t shm_release(void* base, const uint8_t* id);
+int64_t shm_delete(void* base, const uint8_t* id);
+int64_t shm_used_bytes(void* base);
+int64_t shm_num_objects(void* base);
+}
+
+namespace {
+
+constexpr int kIdLen = 20;  // ObjectID binary length
+void* g_base = nullptr;
+std::atomic<int> g_failures{0};
+
+void make_id(uint8_t* id, int worker, uint64_t i) {
+  memset(id, 0, kIdLen);
+  memcpy(id, &worker, sizeof(worker));
+  memcpy(id + 8, &i, sizeof(i));
+}
+
+uint8_t pattern(const uint8_t* id, uint64_t pos) {
+  return (uint8_t)(id[0] * 31 + id[8] * 17 + pos * 7 + 13);
+}
+
+void worker_loop(int worker, int n_workers, int iters) {
+  uint8_t id[kIdLen];
+  unsigned seed = 0x9e3779b9u * (worker + 1);
+  for (int i = 0; i < iters; i++) {
+    seed = seed * 1664525u + 1013904223u;
+    uint64_t size = 64 + (seed % 8192);
+    make_id(id, worker, (uint64_t)i);
+    uint64_t off = 0;
+    int64_t rc = shm_create(g_base, id, size, &off);
+    if (rc == -3 /*kFull*/) {
+      // arena pressure: retire an old object of ours and retry once
+      if (i > 4) {
+        uint8_t old_id[kIdLen];
+        make_id(old_id, worker, (uint64_t)(i - 4));
+        shm_delete(g_base, old_id);
+      }
+      rc = shm_create(g_base, id, size, &off);
+      if (rc != 0) continue;  // still full: skip this round
+    } else if (rc != 0) {
+      fprintf(stderr, "worker %d: create rc=%ld\n", worker, (long)rc);
+      g_failures.fetch_add(1);
+      continue;
+    }
+    uint8_t* payload = (uint8_t*)g_base + off;
+    for (uint64_t p = 0; p < size; p++) payload[p] = pattern(id, p);
+    if (shm_seal(g_base, id) != 0) {
+      fprintf(stderr, "worker %d: seal failed\n", worker);
+      g_failures.fetch_add(1);
+      continue;
+    }
+
+    // read-verify a NEIGHBOR's recent object (cross-worker contention)
+    uint8_t other[kIdLen];
+    int peer = (worker + 1) % n_workers;
+    uint64_t peer_iter = (uint64_t)(i > 2 ? i - 2 : 0);
+    make_id(other, peer, peer_iter);
+    uint64_t roff = 0, rsize = 0;
+    rc = shm_get(g_base, other, 0.05, &roff, &rsize);
+    if (rc == 0) {
+      const uint8_t* rp = (const uint8_t*)g_base + roff;
+      for (uint64_t p = 0; p < rsize; p++) {
+        if (rp[p] != pattern(other, p)) {
+          fprintf(stderr,
+                  "CORRUPTION worker %d: peer %d iter %lu byte %lu "
+                  "got %u want %u\n",
+                  worker, peer, (unsigned long)peer_iter,
+                  (unsigned long)p, rp[p], pattern(other, p));
+          g_failures.fetch_add(1);
+          break;
+        }
+      }
+      shm_release(g_base, other);
+    }
+
+    // churn: retire our object from a few iterations back
+    if (i >= 8) {
+      make_id(id, worker, (uint64_t)(i - 8));
+      shm_delete(g_base, id);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <threads|procs> <workers> <iters> [mb]\n",
+            argv[0]);
+    return 64;
+  }
+  const bool use_procs = std::string(argv[1]) == "procs";
+  const int n_workers = atoi(argv[2]);
+  const int iters = atoi(argv[3]);
+  const uint64_t arena_mb = argc > 4 ? (uint64_t)atoll(argv[4]) : 64;
+
+  alarm(120);  // watchdog: a lost wakeup / deadlock kills us (exit 3)
+  signal(SIGALRM, [](int) { _exit(3); });
+
+  const uint64_t max_objects = 4096;
+  const uint64_t total =
+      arena_mb * 1024 * 1024 + (uint64_t)shm_required_overhead(max_objects);
+  g_base = mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                MAP_SHARED | MAP_ANONYMOUS, -1, 0);
+  if (g_base == MAP_FAILED) { perror("mmap"); return 64; }
+  if (shm_init(g_base, total, max_objects) != 0) {
+    fprintf(stderr, "init failed\n");
+    return 64;
+  }
+
+  if (use_procs) {
+    std::vector<pid_t> pids;
+    for (int w = 0; w < n_workers; w++) {
+      pid_t pid = fork();
+      if (pid == 0) {
+        worker_loop(w, n_workers, iters);
+        _exit(g_failures.load() ? 2 : 0);
+      }
+      pids.push_back(pid);
+    }
+    int bad = 0;
+    for (pid_t pid : pids) {
+      int status = 0;
+      waitpid(pid, &status, 0);
+      if (!WIFEXITED(status) || WEXITSTATUS(status) != 0) bad++;
+    }
+    if (bad) {
+      fprintf(stderr, "%d child(ren) failed\n", bad);
+      return 2;
+    }
+  } else {
+    std::vector<std::thread> threads;
+    for (int w = 0; w < n_workers; w++)
+      threads.emplace_back(worker_loop, w, n_workers, iters);
+    for (auto& t : threads) t.join();
+    if (g_failures.load()) return 2;
+  }
+  fprintf(stderr, "stress ok: objects=%ld used=%ld\n",
+          (long)shm_num_objects(g_base), (long)shm_used_bytes(g_base));
+  printf("STRESS-OK\n");
+  return 0;
+}

@@ -270,9 +270,13 @@ class SAC(Algorithm):
             alpha_opt_state=self.alpha_opt_state,
             key=self._key,
             buffer={
-                "obs": self.buffer.obs, "next_obs": self.buffer.next_obs,
-                "actions": self.buffer.actions,
-                "rewards": self.buffer.rewards, "dones": self.buffer.dones,
+                # slice to the filled region: a fresh run's checkpoint
+                # must not carry capacity-many zero rows
+                "obs": self.buffer.obs[:self.buffer.size].copy(),
+                "next_obs": self.buffer.next_obs[:self.buffer.size].copy(),
+                "actions": self.buffer.actions[:self.buffer.size].copy(),
+                "rewards": self.buffer.rewards[:self.buffer.size].copy(),
+                "dones": self.buffer.dones[:self.buffer.size].copy(),
                 "pos": self.buffer.pos, "size": self.buffer.size,
             })
         return state
@@ -287,13 +291,14 @@ class SAC(Algorithm):
             self.alpha_opt_state = state["alpha_opt_state"]
             self._key = state["key"]
             buf = state["buffer"]
-            self.buffer.obs[:] = buf["obs"]
-            self.buffer.next_obs[:] = buf["next_obs"]
-            self.buffer.actions[:] = buf["actions"]
-            self.buffer.rewards[:] = buf["rewards"]
-            self.buffer.dones[:] = buf["dones"]
+            n = buf["size"]
+            self.buffer.obs[:n] = buf["obs"]
+            self.buffer.next_obs[:n] = buf["next_obs"]
+            self.buffer.actions[:n] = buf["actions"]
+            self.buffer.rewards[:n] = buf["rewards"]
+            self.buffer.dones[:n] = buf["dones"]
             self.buffer.pos = buf["pos"]
-            self.buffer.size = buf["size"]
+            self.buffer.size = n
 
 
 SACConfig.algo_class = SAC

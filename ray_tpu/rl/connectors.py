@@ -71,13 +71,10 @@ class ObsNormalizer(Connector):
         self.count = 0.0
         self.mean: Optional[np.ndarray] = None
         self.m2: Optional[np.ndarray] = None  # sum of squared deviations
-        # since-last-sync accumulator: the sync protocol merges ONLY
-        # disjoint deltas into the driver's canonical state — merging
-        # full states would double-count shared history and blow the
-        # count up by ~world_size per sync
-        self._d_count = 0.0
-        self._d_mean: Optional[np.ndarray] = None
-        self._d_m2: Optional[np.ndarray] = None
+        # snapshot at the last sync: pop_delta_state derives the
+        # since-sync delta by inverse Chan merge, so the hot update
+        # loop pays nothing for the sync protocol
+        self._snap = (0.0, None, None)
 
     def _update(self, obs: np.ndarray) -> None:
         flat = obs.reshape(-1, obs.shape[-1]).astype(np.float64)
@@ -86,18 +83,11 @@ class ObsNormalizer(Connector):
             # zeros, not ones: a ones-init biases the variance by
             # 1/(count-1); _apply's eps already guards the divide
             self.m2 = np.zeros(flat.shape[-1])
-        if self._d_mean is None:
-            self._d_mean = np.zeros(flat.shape[-1])
-            self._d_m2 = np.zeros(flat.shape[-1])
         for row in flat:  # Welford; rollout sizes keep this cheap
             self.count += 1.0
             delta = row - self.mean
             self.mean += delta / self.count
             self.m2 += delta * (row - self.mean)
-            self._d_count += 1.0
-            d_delta = row - self._d_mean
-            self._d_mean += d_delta / self._d_count
-            self._d_m2 += d_delta * (row - self._d_mean)
 
     def _apply(self, obs: np.ndarray) -> np.ndarray:
         if self.mean is None or self.count < 2:
@@ -125,14 +115,30 @@ class ObsNormalizer(Connector):
         self.count = state["count"]
         self.mean = state["mean"]
         self.m2 = state["m2"]
+        # broadcast state is fully-shared history: future deltas are
+        # relative to it
+        self._snap = (self.count,
+                      None if self.mean is None else self.mean.copy(),
+                      None if self.m2 is None else self.m2.copy())
 
     def pop_delta_state(self) -> Dict[str, Any]:
-        out = {"count": self._d_count, "mean": self._d_mean,
-               "m2": self._d_m2}
-        self._d_count = 0.0
-        self._d_mean = None
-        self._d_m2 = None
-        return out
+        """Since-last-sync stats via inverse Chan merge against the
+        snapshot: total = merge(snapshot, delta) solved for delta."""
+        s_count, s_mean, s_m2 = self._snap
+        d_count = self.count - s_count
+        if d_count <= 0 or self.mean is None:
+            return {"count": 0.0, "mean": None, "m2": None}
+        if s_mean is None:
+            d_mean, d_m2 = self.mean.copy(), self.m2.copy()
+        else:
+            d_mean = (self.count * self.mean
+                      - s_count * s_mean) / d_count
+            gap = d_mean - s_mean
+            d_m2 = (self.m2 - s_m2
+                    - gap ** 2 * (s_count * d_count / self.count))
+            np.maximum(d_m2, 0.0, out=d_m2)  # numeric floor
+        self._snap = (self.count, self.mean.copy(), self.m2.copy())
+        return {"count": d_count, "mean": d_mean, "m2": d_m2}
 
     def merge_states(self, states: list) -> Dict[str, Any]:
         """Parallel Welford merge (Chan et al.) of per-runner stats."""

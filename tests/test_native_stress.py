@@ -1,0 +1,63 @@
+"""Sanitizer/stress coverage for the native shm store (SURVEY.md §5.2:
+the reference runs C++ tests under TSan/ASan bazel configs,
+.bazelrc:112-132). Builds ray_tpu/native/src/stress_test_main.cc and
+runs concurrent create/seal/get/verify/delete churn; payload patterns
+catch torn writes and allocator overlap, the in-binary watchdog
+catches lost wakeups, and the sanitizer variants catch data races and
+heap errors in the store's own code."""
+
+import subprocess
+
+import pytest
+
+from ray_tpu.native.build import build_stress
+
+
+def _sanitizer_available(kind: str) -> bool:
+    """Probe: can g++ link -fsanitize=<kind> on this image?"""
+    import os
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "probe.cc")
+        with open(src, "w") as f:
+            f.write("int main(){return 0;}\n")
+        proc = subprocess.run(
+            ["g++", f"-fsanitize={kind}", "-o", os.path.join(d, "probe"),
+             src], capture_output=True)
+        return proc.returncode == 0
+
+
+def _run(binary: str, mode: str, workers: int, iters: int,
+         timeout: float = 150.0) -> None:
+    proc = subprocess.run([binary, mode, str(workers), str(iters)],
+                          capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, (
+        f"stress rc={proc.returncode}\nstdout={proc.stdout}\n"
+        f"stderr={proc.stderr[-4000:]}")
+    assert "STRESS-OK" in proc.stdout
+
+
+def test_stress_threads_plain():
+    _run(build_stress(), "threads", workers=8, iters=250)
+
+
+def test_stress_procs_plain():
+    """Cross-process path: robust mutex + shared arena under fork."""
+    _run(build_stress(), "procs", workers=6, iters=200)
+
+
+@pytest.mark.skipif(not _sanitizer_available("address"),
+                    reason="ASan unavailable")
+def test_stress_asan():
+    _run(build_stress("address"), "threads", workers=6, iters=120)
+    # process mode under ASan too: shadow memory is per-process, but
+    # each child self-checks its own accesses into the shared arena
+    _run(build_stress("address"), "procs", workers=4, iters=100)
+
+
+@pytest.mark.skipif(not _sanitizer_available("thread"),
+                    reason="TSan unavailable")
+def test_stress_tsan():
+    # TSan only sees intra-process races: thread mode is the one that
+    # matters (the store's mutex discipline is identical cross-process)
+    _run(build_stress("thread"), "threads", workers=6, iters=120)
