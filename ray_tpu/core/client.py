@@ -71,15 +71,23 @@ class _MemStore:
             event = self._sealed.get(oid)
         if event is None or not event.wait(timeout_s):
             return None
-        return memoryview(self._bufs[oid])
+        with self._lock:
+            # a concurrent take() may have popped between the seal and
+            # this read — caller treats None as "re-pull"
+            buf = self._bufs.get(oid)
+            return memoryview(buf) if buf is not None else None
 
     def release(self, oid: ObjectID) -> None:
         pass
 
     def take(self, oid: ObjectID) -> Optional[bytes]:
-        """Pop the sealed payload; None if a concurrent get of the same
-        ref already consumed it (the caller re-pulls)."""
+        """Pop the SEALED payload; None if a concurrent get consumed it
+        or a re-pull is still in flight (caller re-pulls). Never hands
+        out a partially-downloaded buffer."""
         with self._lock:
+            event = self._sealed.get(oid)
+            if event is None or not event.is_set():
+                return None
             buf = self._bufs.pop(oid, None)
             self._sealed.pop(oid, None)
         return bytes(buf) if buf is not None else None

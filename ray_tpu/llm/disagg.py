@@ -1,0 +1,200 @@
+"""Prefill/decode disaggregation for LLM serving.
+
+Capability parity with the reference's prefill-decode disaggregated
+deployments (reference: python/ray/llm/_internal/serve/deployments/ —
+separate prefill and decode replica pools with KV blocks transferred
+between engines). TPU-native shape: prefill replicas run compute-bound
+batch-1 prefills (MXU-heavy, benefits from dedicated chips); decode
+replicas run the latency-bound continuous-batching loop; the KV block
+for each admitted request moves prefill→decode through the object
+plane — shared memory on one host, chunked node-to-node transfer
+across hosts (the DCN analog of the reference's NIXL KV transfer).
+
+    from ray_tpu.llm.disagg import build_disagg_app
+    app = build_disagg_app(LLMConfig(...), num_prefill=2, num_decode=1)
+    handle = serve.run(app)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import serve
+from ray_tpu.llm.engine import ContinuousBatchingEngine, GenerationRequest
+from ray_tpu.llm.tokenizer import get_tokenizer
+from ray_tpu.serve.llm import LLMConfig, LLMServer
+
+
+class PrefillServer:
+    """Prefill-only replica: owns model weights, runs one prefill per
+    call, returns the KV block + first sampled token. Never decodes."""
+
+    def __init__(self, config: LLMConfig,
+                 params_blob: Optional[bytes] = None):
+        params = None
+        if params_blob is not None:
+            from ray_tpu.core import serialization
+            params = serialization.loads(params_blob)
+        self.config = config
+        self.engine = ContinuousBatchingEngine(config.engine, params)
+        self.tokenizer = get_tokenizer(config.engine.tokenizer)
+
+    def prefill(self, prompt: str, *, temperature: float = 0.0,
+                top_k: int = 0,
+                adapter: Optional[str] = None) -> Dict[str, Any]:
+        ids = self.tokenizer.encode(prompt)
+        ks, vs, prompt_len, first_token = self.engine.prefill_only(
+            ids, temperature=temperature, top_k=top_k, adapter=adapter)
+        return {"ks": ks, "vs": vs, "prompt_len": prompt_len,
+                "first_token": first_token, "prompt_tokens": len(ids)}
+
+
+class DecodeServer(LLMServer):
+    """Decode replica: the normal continuous-batching LLMServer plus an
+    entry point for requests whose prefill ran elsewhere."""
+
+    def decode_prefilled(self, prefill_out: Any, *,
+                         max_tokens: int, temperature: float = 0.0,
+                         top_k: int = 0,
+                         adapter: Optional[str] = None) -> Dict[str, Any]:
+        from ray_tpu.core.object_ref import ObjectRef
+        if isinstance(prefill_out, ObjectRef):
+            # fast path: the router forwarded the prefill replica's raw
+            # result ref — the KV block reads straight from the object
+            # plane here, never materializing in the router
+            import ray_tpu
+            prefill_out = ray_tpu.get(prefill_out, timeout=60)
+        if not isinstance(prefill_out, dict):
+            # a saturated prefill replica answered with a rejection
+            # sentinel; the router's slow path re-routes
+            raise RuntimeError("prefill result unavailable (rejected)")
+        request = GenerationRequest(
+            prompt_ids=[],  # KV already computed; ids not needed
+            max_tokens=max_tokens,
+            temperature=temperature,
+            top_k=top_k,
+            adapter=adapter,
+            stop_ids=(self.tokenizer.eos_id,)
+            if self.tokenizer.eos_id is not None else ())
+        self.engine.add_prefilled(
+            request, prefill_out["ks"], prefill_out["vs"],
+            prefill_out["prompt_len"], prefill_out["first_token"])
+        self._wake.set()
+        while not request.done:
+            time.sleep(0.001)
+        if request.error is not None:
+            raise RuntimeError(request.error)
+        out_ids = [t for t in request.output_ids
+                   if t not in request.stop_ids]
+        return {
+            "text": self.tokenizer.decode(out_ids),
+            "prompt_tokens": prefill_out["prompt_tokens"],
+            "completion_tokens": len(request.output_ids),
+            "finish_reason": request.finish_reason,
+        }
+
+
+class DisaggRouter:
+    """Ingress: validates, fans prefill→decode, shapes the OpenAI
+    response. The prefill result (with its KV block) flows between the
+    two pools as a task result through the object plane — the router
+    only moves the reference."""
+
+    def __init__(self, config: LLMConfig, prefill_handle, decode_handle):
+        self.config = config
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+        # reuse LLMServer's sampling validation without building an
+        # engine: bind the unbound method to this router
+        self._validate = LLMServer._validate_sampling
+
+    def _resolve_adapter(self, model):
+        if model is None or model == self.config.model_id:
+            return None
+        raise ValueError(f"unknown model {model!r}")
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        path = request.get("__path__", "")
+        if path.endswith("/completions"):
+            return self.completions(request)
+        if path.endswith("/models"):
+            return {"object": "list",
+                    "data": [{"id": self.config.model_id,
+                              "object": "model"}]}
+        return {"error": f"unknown route {path!r}"}
+
+    def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        import uuid
+
+        prompt = body.get("prompt", "")
+        if not isinstance(prompt, str):
+            return {"error": {"message": "prompt must be a string",
+                              "type": "invalid_request_error"}}
+        try:
+            sampling = self._validate(self, body)
+        except ValueError as e:
+            return {"error": {"message": str(e),
+                              "type": "invalid_request_error"}}
+        temperature = sampling.get("temperature",
+                                   self.config.temperature)
+        top_k = sampling["top_k"]
+        decode_kwargs = dict(
+            max_tokens=sampling.get("max_tokens", self.config.max_tokens),
+            temperature=temperature, top_k=top_k,
+            adapter=sampling.get("adapter"))
+        prefill_ref = self.prefill.prefill.remote(
+            prompt, temperature=temperature, top_k=top_k,
+            adapter=sampling.get("adapter"))
+        try:
+            # fast path: forward the raw result ref so the KV block
+            # moves prefill→decode directly through the object plane
+            result = self.decode.decode_prefilled.remote(
+                prefill_ref._ref, **decode_kwargs).result()
+        except RuntimeError:
+            # prefill replica rejected under load: materialize via the
+            # handle's re-routing result() and retry once
+            prefill_out = prefill_ref.result()
+            result = self.decode.decode_prefilled.remote(
+                prefill_out, **decode_kwargs).result()
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "model": body.get("model", self.config.model_id),
+            "choices": [{
+                "index": 0,
+                "text": result["text"],
+                "finish_reason": result["finish_reason"],
+            }],
+            "usage": {
+                "prompt_tokens": result["prompt_tokens"],
+                "completion_tokens": result["completion_tokens"],
+                "total_tokens": (result["prompt_tokens"]
+                                 + result["completion_tokens"]),
+            },
+        }
+
+
+def build_disagg_app(config: LLMConfig, *, params=None,
+                     num_prefill: int = 1, num_decode: int = 1):
+    """A prefill/decode-disaggregated OpenAI app."""
+    params_blob = None
+    if params is not None:
+        from ray_tpu.core import serialization
+        params_blob = serialization.dumps(params)
+    prefill_dep = serve.deployment(
+        PrefillServer, name=f"{config.model_id}-prefill",
+        num_replicas=num_prefill,
+        max_ongoing_requests=config.max_ongoing_requests)
+    decode_dep = serve.deployment(
+        DecodeServer, name=f"{config.model_id}-decode",
+        num_replicas=num_decode,
+        max_ongoing_requests=config.max_ongoing_requests)
+    router_dep = serve.deployment(
+        DisaggRouter, name=f"{config.model_id}-router",
+        num_replicas=1,
+        max_ongoing_requests=4 * config.max_ongoing_requests)
+    return router_dep.bind(config,
+                           prefill_dep.bind(config, params_blob),
+                           decode_dep.bind(config, params_blob))

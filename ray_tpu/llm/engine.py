@@ -116,6 +116,8 @@ class ContinuousBatchingEngine:
             c, config.max_batch, config.max_seq)
         self.slots = [_Slot(i) for i in range(config.max_batch)]
         self.waiting: List[GenerationRequest] = []
+        # disaggregated requests: (request, ks, vs, prompt_len, token)
+        self._prefilled_waiting: List[tuple] = []
         self._lock = threading.Lock()
         self.total_generated = 0
         self._base_key = jax.random.PRNGKey(config.seed)
@@ -264,6 +266,44 @@ class ContinuousBatchingEngine:
             raise ValueError(f"unknown LoRA adapter {request.adapter!r}")
         return idx
 
+    def prefill_only(self, prompt_ids: List[int], *,
+                     temperature: float = 0.0, top_k: int = 0,
+                     adapter: Optional[str] = None):
+        """Prefill without occupying a decode slot — the PREFILL side of
+        prefill/decode disaggregation (reference: serve/llm
+        prefill-decode disagg deployments). Returns numpy
+        (ks, vs, prompt_len, first_token): the KV block ships through
+        the object plane to a decode engine's add_prefilled()."""
+        limit = self.config.max_seq - 1
+        ids = list(prompt_ids)[-limit:]
+        if adapter is not None and adapter not in self._adapters:
+            raise ValueError(f"unknown LoRA adapter {adapter!r}")
+        ks, vs, token = self._run_prefill(ids, adapter, temperature,
+                                          top_k)
+        return (np.asarray(ks), np.asarray(vs), len(ids), token)
+
+    def add_prefilled(self, request: GenerationRequest, ks, vs,
+                      prompt_len: int, first_token: int) -> GenerationRequest:
+        """DECODE side of disaggregation: adopt a request whose prefill
+        ran elsewhere — the KV block is inserted into a free slot at the
+        next admit, skipping local prefill entirely."""
+        if prompt_len > self.config.max_seq - 1:
+            raise ValueError("prefilled prompt exceeds this engine's "
+                             "max_seq")
+        if ks.shape[2] > self.config.max_seq:
+            raise ValueError(
+                f"prefilled KV bucket ({ks.shape[2]}) exceeds this "
+                f"engine's max_seq ({self.config.max_seq})")
+        if request.adapter is not None:
+            self._adapter_index(request)  # fail fast: an unknown
+            # adapter raising inside step() would fail_all the replica
+        if request.top_k > self.config.max_top_k:
+            request.top_k = self.config.max_top_k
+        with self._lock:
+            self._prefilled_waiting.append(
+                (request, ks, vs, prompt_len, first_token))
+        return request
+
     def add_request(self, request: GenerationRequest) -> GenerationRequest:
         limit = self.config.max_seq - 1
         if len(request.prompt_ids) > limit:
@@ -280,15 +320,58 @@ class ContinuousBatchingEngine:
 
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self.waiting) or any(
-                s.request is not None for s in self.slots)
+            return (bool(self.waiting) or bool(self._prefilled_waiting)
+                    or any(s.request is not None for s in self.slots))
 
     def _free_slots(self) -> List[_Slot]:
         return [s for s in self.slots if s.request is None]
 
+    def _admit_prefilled(self) -> None:
+        """Adopt disaggregated requests: their KV arrives ready-made
+        from a prefill engine; just insert into a free slot."""
+        jnp = self._jnp
+        while True:
+            with self._lock:
+                if not self._prefilled_waiting:
+                    return
+                free = self._free_slots()
+                if not free:
+                    return
+                request, ks, vs, plen, tok = self._prefilled_waiting.pop(0)
+                slot = free[0]
+                slot.request = request
+            self.cache_k, self.cache_v = self._insert(
+                self.cache_k, self.cache_v, jnp.asarray(ks),
+                jnp.asarray(vs), slot.index)
+            slot.next_token = tok
+            slot.pos = plen
+            self._emit(slot, tok)
+
+    def _run_prefill(self, ids: List[int], adapter: Optional[str],
+                     temperature: float, top_k: int):
+        """Shared prefill: bucket/pad the prompt, run the jitted
+        prefill, sample the first token. Both the colocated admit path
+        and prefill_only (disaggregation) call this — one copy, so the
+        exact-parity guarantee between the two modes can't drift."""
+        jnp = self._jnp
+        bucket = 1
+        while bucket < len(ids):
+            bucket *= 2
+        bucket = min(bucket, self.config.max_seq)
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, : len(ids)] = ids
+        lora = self._adapter_prefill.get(adapter) if adapter else None
+        logits, ks, vs = self._prefill(self.params, jnp.asarray(padded),
+                                       lora)
+        self._step_counter += 1
+        token = self._sample_one(
+            logits[0, len(ids) - 1], float(temperature), int(top_k),
+            self._jax.random.fold_in(self._base_key, self._step_counter))
+        return ks, vs, int(token)
+
     def _admit(self) -> None:
         """Prefill waiting requests into free slots."""
-        jnp = self._jnp
+        self._admit_prefilled()
         while True:
             with self._lock:
                 if not self.waiting:
@@ -300,25 +383,11 @@ class ContinuousBatchingEngine:
                 slot = free[0]
                 slot.request = request
             ids = request.prompt_ids
-            bucket = 1
-            while bucket < len(ids):
-                bucket *= 2
-            bucket = min(bucket, self.config.max_seq)
-            padded = np.zeros((1, bucket), dtype=np.int32)
-            padded[0, : len(ids)] = ids
-            lora = (self._adapter_prefill.get(request.adapter)
-                    if request.adapter else None)
-            logits, ks, vs = self._prefill(self.params,
-                                           jnp.asarray(padded), lora)
+            ks, vs, token = self._run_prefill(
+                ids, request.adapter, request.temperature, request.top_k)
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot.index)
-            self._step_counter += 1
-            token = self._sample_one(
-                logits[0, len(ids) - 1], float(request.temperature),
-                int(request.top_k),
-                self._jax.random.fold_in(self._base_key,
-                                         self._step_counter))
-            slot.next_token = int(token)
+            slot.next_token = token
             slot.pos = len(ids)
             self._emit(slot, slot.next_token)
 
@@ -398,6 +467,8 @@ class ContinuousBatchingEngine:
         with self._lock:
             pending = list(self.waiting)
             self.waiting.clear()
+            pending += [entry[0] for entry in self._prefilled_waiting]
+            self._prefilled_waiting.clear()
         for request in pending:
             request.error = message
             request.finish_reason = "error"

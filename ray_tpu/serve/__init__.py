@@ -24,6 +24,7 @@ from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 _proxy = None
+_grpc_proxy = None
 
 
 def _get_or_start_controller():
@@ -40,14 +41,19 @@ def _get_or_start_controller():
 
 
 def start(http_options: Optional[HTTPOptions] = None,
-          proxy: bool = False):
-    """Start the serve control plane (and optionally the HTTP proxy)."""
-    global _proxy
+          proxy: bool = False, grpc_port: Optional[int] = None):
+    """Start the serve control plane (and optionally the HTTP proxy
+    and/or the gRPC ingress — reference: serve's HTTP + gRPC proxies,
+    serve/_private/proxy.py:530,706)."""
+    global _proxy, _grpc_proxy
     controller = _get_or_start_controller()
     if proxy and _proxy is None:
         from ray_tpu.serve.proxy import HttpProxy
         opts = http_options or HTTPOptions()
         _proxy = HttpProxy(controller, opts.host, opts.port)
+    if grpc_port is not None and _grpc_proxy is None:
+        from ray_tpu.serve.grpc_proxy import GrpcProxy
+        _grpc_proxy = GrpcProxy(controller, port=grpc_port)
     return controller
 
 
@@ -99,10 +105,13 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _proxy
+    global _proxy, _grpc_proxy
     if _proxy is not None:
         _proxy.stop()
         _proxy = None
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None
     if not ray_tpu.is_initialized():
         return
     try:
