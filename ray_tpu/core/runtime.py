@@ -686,11 +686,30 @@ class DriverRuntime:
                 self._schedulable.clear()
             backlog.extend(work)
             made_progress = False
+            # Per-pass memo: once a resource signature fails to place,
+            # every identical request this pass fails too (availability
+            # only shrinks within a pass) — without this, a deep
+            # backlog pays O(backlog) pick_node scans per completion
+            # and throughput collapses with queue depth (reference:
+            # owner-side lease caching per resource shape, SURVEY §3.2).
+            blocked_sigs: set = set()
             for _ in range(len(backlog)):
                 spec = backlog.popleft()
                 task = self.task_manager.get_pending(spec.task_id)
                 if task is None:
                     continue  # cancelled/failed meanwhile
+                strategy = spec.strategy
+                sig = (strategy.kind,
+                       strategy.node_id,
+                       strategy.soft,  # soft affinity falls through to
+                       # the general policy — distinct placement from hard
+                       tuple(sorted(strategy.labels.items())),
+                       strategy.placement_group_id,
+                       strategy.bundle_index,
+                       tuple(sorted(spec.resources.items())))
+                if sig in blocked_sigs:
+                    backlog.append(spec)
+                    continue
                 try:
                     node_id = self.scheduler.pick_node(
                         spec, preferred=self.head_node_id)
@@ -700,6 +719,7 @@ class DriverRuntime:
                     continue
                 if node_id is None or not self.scheduler.try_acquire(
                         node_id, self._spec_resources(spec)):
+                    blocked_sigs.add(sig)
                     backlog.append(spec)
                     continue
                 if spec.is_actor_creation:

@@ -117,6 +117,13 @@ class DisaggRouter:
 
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         path = request.get("__path__", "")
+        if path.endswith("/chat/completions"):
+            # must be checked BEFORE /completions (suffix overlap);
+            # chat is not offered on the disagg surface yet
+            return {"error": {
+                "message": "chat completions are not supported on the "
+                           "disaggregated deployment; use /v1/completions",
+                "type": "invalid_request_error"}}
         if path.endswith("/completions"):
             return self.completions(request)
         if path.endswith("/models"):
@@ -132,6 +139,13 @@ class DisaggRouter:
         if not isinstance(prompt, str):
             return {"error": {"message": "prompt must be a string",
                               "type": "invalid_request_error"}}
+        if body.get("stream"):
+            # explicit rejection beats silently buffering: an SSE
+            # client would otherwise hang on a plain JSON body
+            return {"error": {
+                "message": "streaming is not supported on the "
+                           "disaggregated deployment yet",
+                "type": "invalid_request_error"}}
         try:
             sampling = self._validate(self, body)
         except ValueError as e:
@@ -152,9 +166,10 @@ class DisaggRouter:
             # moves prefill→decode directly through the object plane
             result = self.decode.decode_prefilled.remote(
                 prefill_ref._ref, **decode_kwargs).result()
-        except RuntimeError:
-            # prefill replica rejected under load: materialize via the
-            # handle's re-routing result() and retry once
+        except Exception:  # noqa: BLE001 — replica exceptions surface
+            # as TaskError (not RuntimeError); retry once on the slow
+            # path: materialize via the handle's re-routing result(),
+            # which absorbs prefill-replica rejection/restart
             prefill_out = prefill_ref.result()
             result = self.decode.decode_prefilled.remote(
                 prefill_out, **decode_kwargs).result()

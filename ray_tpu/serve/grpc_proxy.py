@@ -135,7 +135,6 @@ class GrpcProxy:
 
         self.state = _ProxyState(controller)
         generic = _GenericHandler(self.state)
-        proxy = self
 
         class Router(grpc.GenericRpcHandler):
             def service(self, call_details):
