@@ -1,0 +1,62 @@
+// ray_tpu C++ client API.
+//
+// Capability analog of the reference's C++ public API
+// (reference: cpp/include/ray/api.h — Put/Get/Task). Divergence,
+// stated plainly: the reference embeds a C++ core worker that executes
+// C++ tasks; this is a CLIENT library — it connects to a running
+// cluster head over TCP (the same listener node daemons and Python
+// clients use), puts/gets byte objects, and invokes Python functions
+// registered via ray_tpu.capi.register_function, executed as ordinary
+// cluster tasks. Wire protocol: ray_tpu/capi.py docstring.
+//
+//   ray_tpu::Client client;
+//   client.Connect("127.0.0.1", 6379);
+//   auto id  = client.Put("hello");
+//   auto val = client.Get(id);            // "hello"
+//   auto out = client.Call("double", "ab");  // python fn, as a task
+//   client.Drop(id);
+//
+// Every method throws std::runtime_error on failure. Header-only
+// client struct; implementation in cpp/src/capi_client.cc.
+
+#pragma once
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+
+namespace ray_tpu {
+
+class Client {
+ public:
+  Client() = default;
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Connect + handshake (magic frame, version check). timeout_s is
+  // the per-syscall send/recv timeout; it must exceed the longest
+  // server-side request budget (CALL waits up to 300s on the task).
+  void Connect(const std::string& host, int port,
+               double timeout_s = 330.0);
+
+  // Store a byte object on the cluster; returns its 16-byte id.
+  std::string Put(const std::string& payload);
+
+  // Fetch a byte object (created here or by any Python task).
+  std::string Get(const std::string& object_id);
+
+  // Invoke a registered Python function (bytes -> bytes) as a task.
+  std::string Call(const std::string& name, const std::string& args);
+
+  // Release this client's reference to an object it Put().
+  void Drop(const std::string& object_id);
+
+  void Close();
+
+ private:
+  std::string Request(uint8_t kind, const std::string& body);
+  int fd_ = -1;
+};
+
+}  // namespace ray_tpu

@@ -1,0 +1,169 @@
+// C++ client implementation — plain POSIX sockets, no dependencies.
+// Wire protocol: ray_tpu/capi.py (length-prefixed little-endian TLV).
+
+#include "ray_tpu/capi_client.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <vector>
+
+namespace ray_tpu {
+namespace {
+
+constexpr uint8_t kPut = 2, kGet = 3, kCall = 4, kDrop = 5;
+constexpr uint8_t kOk = 0;
+constexpr uint32_t kVersion = 1;
+
+void SendAll(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n) {
+    ssize_t w = ::send(fd, p, n, 0);
+    if (w <= 0) throw std::runtime_error("ray_tpu: send failed");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+void RecvAll(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) throw std::runtime_error("ray_tpu: connection closed");
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+void SendFrame(int fd, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  char header[4];
+  memcpy(header, &len, 4);  // little-endian hosts only (x86/arm64)
+  SendAll(fd, header, 4);
+  SendAll(fd, payload.data(), payload.size());
+}
+
+std::string RecvFrame(int fd) {
+  char header[4];
+  RecvAll(fd, header, 4);
+  uint32_t len;
+  memcpy(&len, header, 4);
+  std::string out(len, '\0');
+  if (len) RecvAll(fd, &out[0], len);
+  return out;
+}
+
+}  // namespace
+
+Client::~Client() { Close(); }
+
+// Default must exceed the server's longest per-request budget (300s
+// CALL task wait) — a shorter recv timeout would not only fail the
+// call but desynchronize the request/reply stream.
+void Client::Connect(const std::string& host, int port,
+                     double timeout_s) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  const std::string port_str = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res) != 0) {
+    throw std::runtime_error("ray_tpu: cannot resolve " + host);
+  }
+  int fd = -1;
+  for (auto* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv;
+    tv.tv_sec = static_cast<long>(timeout_s);
+    tv.tv_usec = static_cast<long>((timeout_s - tv.tv_sec) * 1e6);
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    throw std::runtime_error("ray_tpu: cannot connect to " + host + ":" +
+                             port_str);
+  }
+  fd_ = fd;
+  std::string hello = "CAPI";
+  hello.resize(8);
+  memcpy(&hello[4], &kVersion, 4);
+  SendFrame(fd_, hello);
+  std::string reply = RecvFrame(fd_);
+  if (reply.empty() || reply[0] != kOk) {
+    Close();
+    throw std::runtime_error("ray_tpu: handshake rejected: " +
+                             reply.substr(1));
+  }
+}
+
+std::string Client::Request(uint8_t kind, const std::string& body) {
+  if (fd_ < 0) throw std::runtime_error("ray_tpu: not connected");
+  std::string frame(1, static_cast<char>(kind));
+  frame += body;
+  std::string reply;
+  try {
+    SendFrame(fd_, frame);
+    reply = RecvFrame(fd_);
+  } catch (...) {
+    // A transport failure (incl. recv timeout) desynchronizes the
+    // request/reply stream: a later request would read this one's
+    // late reply as its own. Poison the connection instead.
+    Close();
+    throw;
+  }
+  if (reply.empty()) {
+    Close();
+    throw std::runtime_error("ray_tpu: empty reply");
+  }
+  if (reply[0] != kOk) {
+    // server-reported error: the stream stays aligned, keep the fd
+    throw std::runtime_error("ray_tpu: " + reply.substr(1));
+  }
+  return reply.substr(1);
+}
+
+std::string Client::Put(const std::string& payload) {
+  std::string id = Request(kPut, payload);
+  if (id.size() != 16) throw std::runtime_error("ray_tpu: bad object id");
+  return id;
+}
+
+std::string Client::Get(const std::string& object_id) {
+  return Request(kGet, object_id);
+}
+
+std::string Client::Call(const std::string& name,
+                         const std::string& args) {
+  if (name.size() > 0xFFFF) {
+    throw std::runtime_error("ray_tpu: function name too long");
+  }
+  uint16_t n = static_cast<uint16_t>(name.size());
+  std::string body(2, '\0');
+  memcpy(&body[0], &n, 2);
+  body += name;
+  body += args;
+  return Request(kCall, body);
+}
+
+void Client::Drop(const std::string& object_id) {
+  Request(kDrop, object_id);
+}
+
+void Client::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+}  // namespace ray_tpu

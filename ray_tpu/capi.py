@@ -1,0 +1,185 @@
+"""C API bridge: the language-neutral surface for non-Python clients.
+
+Capability analog of the reference's C++ public API (reference:
+cpp/include/ray/api.h — Put/Get/Task over the core worker). Divergence,
+stated plainly: the reference runs C++ task *workers*; here C++ (or any
+language) is a CLIENT of the cluster — it puts/gets raw byte objects
+and invokes Python functions registered under names, executed as
+ordinary tasks. The wire format is a dependency-free binary TLV over
+the head's existing TCP listener (cpp/ holds the C++ client library).
+
+Frames (little-endian, length-prefixed like every head connection):
+  request  = [u32 len][u8 kind][body]
+  reply    = [u32 len][u8 status(0 ok / 1 err)][body]
+  kinds: 2 PUT   body = payload bytes          → ok body = 16B object id
+         3 GET   body = 16B object id          → ok body = payload bytes
+         4 CALL  body = u16 name_len, name, args bytes
+                                               → ok body = result bytes
+         5 DROP  body = 16B object id          → ok body = empty
+
+A connection opens with the magic frame b"CAPI" + u32 version, which is
+how the head tells a C client from a pickle-speaking peer (pickle
+frames start with 0x80).
+
+Python side::
+
+    import ray_tpu
+    from ray_tpu import capi
+    ray_tpu.init(num_cpus=4, head_port=6379)
+    capi.register_function("double", lambda b: b * 2)   # bytes -> bytes
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.protocol import recv_frame, send_frame
+
+CAPI_MAGIC = b"CAPI"
+CAPI_VERSION = 1
+KV_NAMESPACE = "capi_functions"
+
+_K_PUT, _K_GET, _K_CALL, _K_DROP = 2, 3, 4, 5
+ID_LEN = 16  # ObjectID.binary() length
+_OK, _ERR = 0, 1
+
+
+def register_function(name: str, fn: Callable[[bytes], bytes]) -> None:
+    """Expose ``fn`` (bytes -> bytes/str) to C-API clients under
+    ``name``. Stored in the cluster KV so it survives the registering
+    driver's module scope and is visible head-wide."""
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime()
+    blob = serialization.dumps(fn)
+    if rt.is_driver:
+        rt.gcs.kv.put(name.encode(), blob, namespace=KV_NAMESPACE)
+    else:
+        rt.gcs_call("kv_put", name.encode(), blob, KV_NAMESPACE)
+
+
+class CapiSession:
+    """Services one C-API connection on the head (its own thread —
+    CALLs block on task results)."""
+
+    def __init__(self, runtime, sock, first_frame: bytes):
+        self.runtime = runtime
+        self.sock = sock
+        self._first = first_frame
+        self._fn_cache: Dict[str, object] = {}
+        self._held: set = set()
+        self._lock = threading.Lock()
+
+    def _reply(self, status: int, body: bytes = b"") -> None:
+        send_frame(self.sock, bytes([status]) + body)
+
+    def serve(self) -> None:
+        try:
+            if (len(self._first) < 8
+                    or self._first[:4] != CAPI_MAGIC
+                    or struct.unpack_from("<I", self._first, 4)[0]
+                    != CAPI_VERSION):
+                self._reply(_ERR, b"unsupported C-API version")
+                return
+            self._reply(_OK, b"")
+            while True:
+                frame = recv_frame(self.sock)
+                if frame is None or not frame:
+                    return
+                try:
+                    self._handle(frame[0], frame[1:])
+                except Exception as exc:  # noqa: BLE001 — per-request
+                    try:
+                        self._reply(_ERR, repr(exc).encode())
+                    except OSError:
+                        return
+        finally:
+            self.close()
+
+    def _handle(self, kind: int, body: bytes) -> None:
+        rt = self.runtime
+        if kind == _K_PUT:
+            oid = ObjectID.from_random()
+            # wrap as a serialized python `bytes` so Python tasks can
+            # ray_tpu.get() C-created objects directly
+            data, buffers = serialization.serialize(bytes(body))
+            rt.store_packed_object(
+                oid, serialization.pack_parts(data, buffers))
+            with self._lock:
+                self._held.add(oid)
+            rt.reference_counter.add_local_reference(oid)
+            self._reply(_OK, oid.binary())
+        elif kind == _K_GET:
+            oid = ObjectID(body[:ID_LEN])
+            value = rt.get(ObjectRef(oid), timeout=60)
+            if isinstance(value, str):
+                value = value.encode()
+            if not isinstance(value, (bytes, bytearray)):
+                raise TypeError(
+                    f"object {oid.hex()[:8]} is {type(value).__name__}, "
+                    "not bytes — only byte objects cross the C API")
+            self._reply(_OK, bytes(value))
+        elif kind == _K_CALL:
+            (name_len,) = struct.unpack_from("<H", body, 0)
+            name = body[2:2 + name_len].decode()
+            args = bytes(body[2 + name_len:])
+            result = self._call(name, args)
+            if isinstance(result, str):
+                result = result.encode()
+            if not isinstance(result, (bytes, bytearray)):
+                raise TypeError(
+                    f"registered function {name!r} returned "
+                    f"{type(result).__name__}; must return bytes/str")
+            self._reply(_OK, bytes(result))
+        elif kind == _K_DROP:
+            oid = ObjectID(body[:ID_LEN])
+            with self._lock:
+                if oid in self._held:
+                    self._held.discard(oid)
+                    self.runtime.reference_counter \
+                        .remove_local_reference(oid)
+            self._reply(_OK, b"")
+        else:
+            raise ValueError(f"unknown C-API request kind {kind}")
+
+    def _call(self, name: str, args: bytes):
+        rf = self._fn_cache.get(name)
+        if rf is None:
+            blob = self.runtime.gcs.kv.get(name.encode(),
+                                           namespace=KV_NAMESPACE)
+            if blob is None:
+                raise KeyError(
+                    f"no C-API function registered under {name!r}")
+            from ray_tpu.core.remote_function import RemoteFunction
+            rf = RemoteFunction(serialization.loads(blob))
+            self._fn_cache[name] = rf
+        # runs as an ordinary task on the cluster — scheduling,
+        # retries, and observability all apply
+        from ray_tpu.core import runtime as runtime_mod
+        prev = runtime_mod.get_runtime_or_none()
+        if prev is None:
+            runtime_mod.set_runtime(self.runtime)
+        elif prev is not self.runtime:
+            # the head re-initialized under this session: installing
+            # our (dead) runtime as the global would clobber the new
+            # driver — refuse instead
+            raise RuntimeError(
+                "cluster runtime changed since this C-API session "
+                "connected; reconnect")
+        ref = rf.remote(args)
+        return self.runtime.get(ref, timeout=300)
+
+    def close(self) -> None:
+        with self._lock:
+            held = list(self._held)
+            self._held.clear()
+        for oid in held:
+            self.runtime.reference_counter.remove_local_reference(oid)
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -79,12 +79,23 @@ class FrameReader:
         return out
 
 
-def recv_msg(sock: socket.socket) -> Optional[dict]:
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    """One raw length-prefixed frame (no deserialization) — used where
+    the peer's codec isn't known yet (e.g. C-API vs pickle clients on
+    the head listener)."""
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None
     (length,) = _LEN.unpack(header)
-    data = _recv_exact(sock, length)
+    return _recv_exact(sock, length)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    _send_all(sock, _LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    data = recv_frame(sock)
     if data is None:
         return None
     return serialization.loads(data)

@@ -297,10 +297,28 @@ class HeadServer:
                     self.runtime.on_remote_node_death(node.node_id)
 
     def _reader_loop(self, conn: MessageConnection) -> None:
+        # The first frame decides the peer's codec: C-API clients open
+        # with the b"CAPI" magic (binary TLV, any language); everything
+        # else is a pickled dict (nodes, Python clients).
+        from ray_tpu.core.protocol import recv_frame
+        first = recv_frame(conn.sock)
+        if first is None:
+            conn.close()
+            return
+        if first[:4] == b"CAPI":
+            from ray_tpu.capi import CapiSession
+            CapiSession(self.runtime, conn.sock, first).serve()
+            return
+        try:
+            pending = [serialization.loads(first)]
+        except Exception:  # noqa: BLE001 — garbage frame (port probe,
+            # mis-pointed client): close instead of leaking the socket
+            conn.close()
+            return
         node: Optional[RemoteNode] = None
         client: Optional["ClientSession"] = None
         while True:
-            msg = conn.recv()
+            msg = pending.pop() if pending else conn.recv()
             if msg is None:
                 break
             try:
