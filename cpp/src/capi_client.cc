@@ -22,7 +22,11 @@ constexpr uint32_t kVersion = 1;
 void SendAll(int fd, const void* data, size_t n) {
   const char* p = static_cast<const char*>(data);
   while (n) {
+    #ifdef MSG_NOSIGNAL
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);  // error, not SIGPIPE
+#else
     ssize_t w = ::send(fd, p, n, 0);
+#endif
     if (w <= 0) throw std::runtime_error("ray_tpu: send failed");
     p += w;
     n -= static_cast<size_t>(w);
@@ -66,6 +70,7 @@ Client::~Client() { Close(); }
 // call but desynchronize the request/reply stream.
 void Client::Connect(const std::string& host, int port,
                      double timeout_s) {
+  Close();  // reconnecting must not leak the previous socket/session
   struct addrinfo hints;
   memset(&hints, 0, sizeof(hints));
   hints.ai_family = AF_UNSPEC;

@@ -147,16 +147,21 @@ class CapiSession:
             raise ValueError(f"unknown C-API request kind {kind}")
 
     def _call(self, name: str, args: bytes):
-        rf = self._fn_cache.get(name)
-        if rf is None:
-            blob = self.runtime.gcs.kv.get(name.encode(),
-                                           namespace=KV_NAMESPACE)
-            if blob is None:
-                raise KeyError(
-                    f"no C-API function registered under {name!r}")
+        # cache keyed by the registered blob, so re-registering a name
+        # takes effect for connected sessions on their next call
+        blob = self.runtime.gcs.kv.get(name.encode(),
+                                       namespace=KV_NAMESPACE)
+        if blob is None:
+            raise KeyError(
+                f"no C-API function registered under {name!r}")
+        import hashlib
+        digest = hashlib.sha1(blob).digest()
+        cached = self._fn_cache.get(name)
+        if cached is None or cached[0] != digest:
             from ray_tpu.core.remote_function import RemoteFunction
-            rf = RemoteFunction(serialization.loads(blob))
-            self._fn_cache[name] = rf
+            cached = (digest, RemoteFunction(serialization.loads(blob)))
+            self._fn_cache[name] = cached
+        rf = cached[1]
         # runs as an ordinary task on the cluster — scheduling,
         # retries, and observability all apply
         from ray_tpu.core import runtime as runtime_mod

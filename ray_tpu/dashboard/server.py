@@ -201,6 +201,10 @@ class DashboardServer:
             from ray_tpu.util.timeline import chrome_trace_events
             return self._send_json(
                 req, chrome_trace_events(self._runtime))
+        if path == "/api/serve":
+            return self._send_json(req, self._serve_status())
+        if path == "/api/train":
+            return self._send_json(req, self._train_runs())
         if path == "/api/logs":
             files = {}
             for d in self._log_dirs():
@@ -211,6 +215,30 @@ class DashboardServer:
         if path == "/api/logs/tail":
             return self._tail_log(req, query)
         req.send_error(404, "unknown route")
+
+    def _serve_status(self):
+        """Deployment/replica status from the serve controller
+        (reference: dashboard/modules/serve)."""
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            return ray_tpu.get(controller.get_status.remote(), timeout=10)
+        except Exception:  # noqa: BLE001 — serve not running
+            return {}
+
+    def _train_runs(self):
+        """Train run states published by JaxTrainer (reference:
+        dashboard/modules/train)."""
+        from ray_tpu.core import serialization
+        gcs = self._runtime.gcs
+        out = []
+        for key in gcs.kv.keys(namespace="train_runs"):
+            blob = gcs.kv.get(key, namespace="train_runs")
+            if blob:
+                out.append(serialization.loads(blob))
+        out.sort(key=lambda r: -r.get("updated_at", 0))
+        return out
 
     def _tail_log(self, req, query) -> None:
         name = query.get("file", "")

@@ -136,6 +136,40 @@ class JaxTrainer:
 
     def _transition(self, state: str) -> None:
         self.state_history.append(state)
+        # publish run state for the dashboard's train module
+        # (reference: dashboard/modules/train — run states from the
+        # controller); best-effort: observability must not fail a run
+        try:
+            import time as _time
+
+            from ray_tpu.core import runtime as runtime_mod
+            from ray_tpu.core import serialization as _ser
+            rt = runtime_mod.get_runtime_or_none()
+            if rt is None:
+                return
+            if not hasattr(self, "_run_record_id"):
+                # unique per trainer: same-named (or unnamed) runs must
+                # not clobber each other's dashboard records
+                import uuid as _uuid
+                self._run_record_id = _uuid.uuid4().hex[:8]
+            record = _ser.dumps({
+                "name": self.run_config.name or "train_run",
+                "run_id": self._run_record_id,
+                "state": state,
+                "history": list(self.state_history),
+                "num_workers": self.scaling_config.num_workers,
+                "use_tpu": bool(getattr(self.scaling_config,
+                                        "use_tpu", False)),
+                "updated_at": _time.time(),
+            })
+            key = (f"{self.run_config.name or 'train_run'}"
+                   f":{self._run_record_id}").encode()
+            if rt.is_driver:
+                rt.gcs.kv.put(key, record, namespace="train_runs")
+            else:
+                rt.gcs_call("kv_put", key, record, "train_runs")
+        except Exception:  # noqa: BLE001
+            pass
 
     def fit(self) -> Result:
         if not ray_tpu.is_initialized():

@@ -119,3 +119,47 @@ def test_log_monitor_echoes(tmp_path, capsys):
         raise AssertionError("log line never echoed")
     finally:
         monitor.stop()
+
+
+def test_serve_status_route(dash_runtime):
+    base = dash_runtime.dashboard_url
+    _, body = _get(base + "/api/serve")
+    assert json.loads(body) == {}  # serve not running: empty but valid
+
+    from ray_tpu import serve
+
+    @serve.deployment
+    class S:
+        def __call__(self, request):
+            return {"ok": True}
+
+    try:
+        serve.run(S.bind(), name="dashapp", route_prefix="/dash")
+        _, body = _get(base + "/api/serve")
+        status = json.loads(body)
+        assert status, "serve status empty"
+        assert any("S" in name for name in status), status
+    finally:
+        serve.shutdown()
+
+
+def test_train_status_route(dash_runtime):
+    base = dash_runtime.dashboard_url
+    _, body = _get(base + "/api/train")
+    assert json.loads(body) == []
+
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        from ray_tpu import train
+        train.report({"loss": 1.0})
+
+    trainer = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="dash-run"))
+    trainer.fit()
+    _, body = _get(base + "/api/train")
+    runs = json.loads(body)
+    assert runs and runs[0]["name"] == "dash-run"
+    assert runs[0]["state"] == "FINISHED"
+    assert "RUNNING" in runs[0]["history"]
