@@ -61,6 +61,9 @@ class Config:
     # Queue-depth threshold at which the hybrid policy spills to other nodes
     # (reference: scheduler_spread_threshold).
     scheduler_spread_threshold: float = 0.5
+    # Same-shape plain-CPU specs dispatched per scheduler acquisition
+    # (lease-reuse burst; the node worker cap bounds real concurrency).
+    scheduler_burst_grant: int = 16
     # Max consecutive task retries on worker failure.
     task_max_retries: int = 3
     # Polling interval of the node-manager control loops.

@@ -156,6 +156,9 @@ class DriverRuntime:
         self._sched_cond = threading.Condition()
         self._schedulable: deque = deque()
         self._infeasible: List[TaskSpec] = []
+        # task ids dispatched by burst grant (lease reuse): they hold
+        # no scheduler resources; release paths consume the marker
+        self._overcommitted: set = set()
         # snapshot of the scheduling backlog, refreshed each loop pass;
         # read by the autoscaler's demand export (reference:
         # gcs_autoscaler_state_manager.h pending-demand reporting)
@@ -278,6 +281,11 @@ class DriverRuntime:
         actor_ids = {aid for aid, info in self.actors.items()
                      if info.node_id == node_id}
         for spec in specs:
+            # the node's whole resource accounting vanished with
+            # remove_node — but a burst-grant marker left behind would
+            # misfire on this spec's RETRY (normally-acquired resources
+            # skipped at release → permanent capacity leak)
+            self._consume_overcommit(spec.task_id)
             if spec.is_actor_creation:
                 actor_ids.add(spec.actor_id)
                 continue
@@ -358,7 +366,9 @@ class DriverRuntime:
         # Tasks queued but never started are rescheduled without consuming
         # a retry (the lease was never granted).
         for spec in queued:
-            self.scheduler.release(node_id, self._spec_resources(spec))
+            if not self._consume_overcommit(spec.task_id):
+                self.scheduler.release(node_id,
+                                       self._spec_resources(spec))
             self._enqueue(spec)
 
     # --- streaming generators -------------------------------------------
@@ -694,6 +704,8 @@ class DriverRuntime:
             # owner-side lease caching per resource shape, SURVEY §3.2).
             blocked_sigs: set = set()
             for _ in range(len(backlog)):
+                if not backlog:
+                    break  # burst grants drained ahead of this count
                 spec = backlog.popleft()
                 task = self.task_manager.get_pending(spec.task_id)
                 if task is None:
@@ -736,6 +748,49 @@ class DriverRuntime:
                 self._record_event(spec, "SCHEDULED", node_id=node_id)
                 node.dispatch(spec)
                 made_progress = True
+                # Burst grant (reference: owner-side lease reuse,
+                # SURVEY §3.2): ride this acquisition with follow-up
+                # same-shape plain-CPU specs from the queue head —
+                # the node's worker cap enforces REAL concurrency, so
+                # per-task scheduler round trips stop being the
+                # throughput ceiling for homogeneous task floods.
+                if (strategy.kind == "DEFAULT"
+                        and not spec.is_actor_creation
+                        and spec.resources == {"CPU": 1.0}):
+                    # Head-of-line guard: a deep burst onto a saturated
+                    # node only hurts when ANOTHER node has free CPU
+                    # (long tasks would pin here while it idles). With
+                    # nowhere else to run, burst deep — queued is
+                    # queued, and node-side pipelining is the win.
+                    budget = get_config().scheduler_burst_grant
+                    free_here = self.scheduler.available(node_id).get(
+                        "CPU", 0.0)
+                    if free_here < 1.0:
+                        for other_id, res in (
+                                self.scheduler.snapshot().items()):
+                            if (other_id != node_id
+                                    and res.available.get("CPU", 0.0)
+                                    >= 1.0):
+                                budget = min(budget, 4)
+                                break
+                    while budget > 0 and backlog:
+                        follower = backlog[0]
+                        fs = follower.strategy
+                        if (follower.is_actor_creation
+                                or fs.kind != "DEFAULT"
+                                or follower.resources != {"CPU": 1.0}):
+                            break
+                        backlog.popleft()
+                        if self.task_manager.get_pending(
+                                follower.task_id) is None:
+                            continue  # cancelled while queued
+                        self._overcommitted.add(follower.task_id)
+                        self.task_manager.mark_dispatched(
+                            follower.task_id, node_id)
+                        self._record_event(follower, "SCHEDULED",
+                                           node_id=node_id)
+                        node.dispatch(follower)
+                        budget -= 1
             self._backlog_view = list(backlog)
             if backlog and not made_progress:
                 # All blocked on capacity; wait for a release/completion
@@ -915,10 +970,22 @@ class DriverRuntime:
         self._record_execution_events(spec, node, worker, msg, "FINISHED")
         self._signal_scheduler()
 
+    def _consume_overcommit(self, task_id: TaskID) -> bool:
+        """True if this spec was burst-granted (holds NO scheduler
+        resources); consumes the marker so each release path sees it
+        exactly once. set.remove is atomic under the GIL."""
+        try:
+            self._overcommitted.remove(task_id)
+            return True
+        except KeyError:
+            return False
+
     def _release_task_resources(self, spec: TaskSpec, node_id: NodeID) -> None:
         if spec.actor_id is not None:
             # Method tasks hold no scheduler resources; creation resources
             # are owned by the actor lifecycle (_release_actor_resources).
+            return
+        if self._consume_overcommit(spec.task_id):
             return
         self.scheduler.release(node_id, self._spec_resources(spec))
 
@@ -958,7 +1025,8 @@ class DriverRuntime:
         self._drop_worker_subscriptions(node.node_id,
                                         worker.worker_id.binary())
         for spec in running:
-            if not spec.is_actor_creation and spec.actor_id is None:
+            if (not spec.is_actor_creation and spec.actor_id is None
+                    and not self._consume_overcommit(spec.task_id)):
                 self.scheduler.release(node.node_id, self._spec_resources(spec))
             # Streaming tasks never retry: already-consumed yields would
             # replay (reference keeps generator retries behind a flag for

@@ -166,6 +166,21 @@ class JaxTrainer:
                    f":{self._run_record_id}").encode()
             if rt.is_driver:
                 rt.gcs.kv.put(key, record, namespace="train_runs")
+                # retention: keep the newest 50 run records — a
+                # long-lived cluster running periodic jobs must not
+                # grow the KV (and /api/train) without bound
+                keys = rt.gcs.kv.keys(namespace="train_runs")
+                if len(keys) > 50:
+                    aged = []
+                    for k in keys:
+                        blob = rt.gcs.kv.get(k, namespace="train_runs")
+                        if blob is None:
+                            continue
+                        aged.append(
+                            (_ser.loads(blob).get("updated_at", 0), k))
+                    aged.sort()
+                    for _ts, k in aged[:len(aged) - 50]:
+                        rt.gcs.kv.delete(k, namespace="train_runs")
             else:
                 rt.gcs_call("kv_put", key, record, "train_runs")
         except Exception:  # noqa: BLE001
