@@ -723,6 +723,18 @@ class Node:
         self.runtime.on_worker_crashed(self, worker, running,
                                        worker.actor_id if was_actor else None)
 
+    def cancel_queued(self, task_id: TaskID) -> Optional[TaskSpec]:
+        """Remove a not-yet-running spec from this node's dispatch
+        queues (burst-granted specs park here); None if the spec
+        already reached a worker."""
+        with self._lock:
+            for queue in self._dispatch_queue.values():
+                for spec in queue:
+                    if spec.task_id == task_id:
+                        queue.remove(spec)
+                        return spec
+        return None
+
     def idle_worker_count(self) -> int:
         with self._lock:
             return sum(len(q) for q in self._idle.values())

@@ -252,7 +252,8 @@ class NodeDaemon:
         elif kind == "SPILL_OBJECTS":
             self._spill_objects(msg)
         elif kind == "CANCEL_TASK":
-            self._cancel_task(TaskID(msg["task_id"]))
+            self._cancel_task(TaskID(msg["task_id"]),
+                              force=msg.get("force", True))
         elif kind == "STOP":
             return False
         return True
@@ -308,7 +309,16 @@ class NodeDaemon:
                          "reply_worker": msg.get("reply_worker"),
                          "req_id": msg.get("req_id")})
 
-    def _cancel_task(self, task_id: TaskID) -> None:
+    def _cancel_task(self, task_id: TaskID, force: bool = True) -> None:
+        # node-queued (not yet running): drop + report so the head can
+        # fail the ref immediately (queued-task cancel semantics)
+        spec = self.node.cancel_queued(task_id)
+        if spec is not None:
+            self.proxy.send({"kind": "TASK_CANCELLED_FWD",
+                             "spec": serialization.dumps_fast(spec)})
+            return
+        if not force:
+            return
         with self.node._lock:
             target = None
             for worker in self.node._workers.values():

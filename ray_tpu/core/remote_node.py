@@ -145,8 +145,9 @@ class RemoteNode:
     def prestart_workers(self, count: int, profile: str = "cpu") -> None:
         self.send({"kind": "PRESTART", "count": count, "profile": profile})
 
-    def cancel_task(self, task_id: TaskID) -> None:
-        self.send({"kind": "CANCEL_TASK", "task_id": task_id.binary()})
+    def cancel_task(self, task_id: TaskID, force: bool = True) -> None:
+        self.send({"kind": "CANCEL_TASK", "task_id": task_id.binary(),
+                   "force": force})
 
     def idle_worker_count(self) -> int:
         return self.idle_workers
@@ -379,6 +380,11 @@ class HeadServer:
             spec = serialization.loads(msg["spec"])
             node.untrack(spec.task_id)
             rt._route_actor_task(spec)
+        elif kind == "TASK_CANCELLED_FWD":
+            # daemon dropped a node-queued spec on cancel: fail the ref
+            spec = serialization.loads(msg["spec"])
+            node.untrack(spec.task_id)
+            rt.on_task_cancelled(node, spec)
         elif kind == "SUBMIT":
             rt.submit_spec(serialization.loads(msg["spec"]))
         elif kind == "PUT_META":

@@ -784,6 +784,12 @@ class DriverRuntime:
                         if self.task_manager.get_pending(
                                 follower.task_id) is None:
                             continue  # cancelled while queued
+                        if self.nodes.get(node_id) is not node:
+                            # node removed mid-burst: a dispatch onto
+                            # the stale object would strand the spec
+                            # (the death harvest already ran)
+                            backlog.appendleft(follower)
+                            break
                         self._overcommitted.add(follower.task_id)
                         self.task_manager.mark_dispatched(
                             follower.task_id, node_id)
@@ -1807,7 +1813,7 @@ class DriverRuntime:
         task = self.task_manager.get_pending(task_id)
         if task is None:
             return  # already finished/failed
-        if task.node_id is None and task.actor_id is None:
+        if task.node_id is None and task.spec.actor_id is None:
             # Plain task not dispatched anywhere yet; fail it and let the
             # queues drop it when they encounter the dead pending entry.
             # Actor tasks are excluded: they are routed to the actor
@@ -1817,10 +1823,33 @@ class DriverRuntime:
             self.task_manager.fail(task_id, TaskCancelledError(task_id))
             self._signal_scheduler()
             return
+        if task.spec.actor_id is None and task.node_id is not None:
+            # Dispatched to a node but possibly still in its dispatch
+            # queue (burst-granted followers park there): a queued spec
+            # cancels immediately, keeping the documented queued-task
+            # semantics (reference: cancellation of leased-not-started
+            # tasks).
+            node = self.nodes.get(task.node_id)
+            if node is not None and not getattr(node, "is_remote", False):
+                spec = node.cancel_queued(task_id)
+                if spec is not None:
+                    self._release_task_resources(spec, task.node_id)
+                    self._record_event(spec, "FAILED",
+                                       node_id=task.node_id,
+                                       error="cancelled")
+                    self.task_manager.fail(
+                        task_id, TaskCancelledError(task_id))
+                    self._signal_scheduler()
+                    return
+            elif node is not None:
+                # remote node: the daemon drops it from its queue and
+                # reports back (TASK_CANCELLED_FWD); force also kills
+                node.cancel_task(task_id, force=force)
+                return
         if force:
             node_id = task.node_id
-            if node_id is None and task.actor_id is not None:
-                info = self.actors.get(task.actor_id)
+            if node_id is None and task.spec.actor_id is not None:
+                info = self.actors.get(task.spec.actor_id)
                 node_id = info.node_id if info else None
             node = self.nodes.get(node_id)
             if node is None:
@@ -1833,6 +1862,16 @@ class DriverRuntime:
                     if task_id in w.running:
                         node.kill_worker(w.worker_id)
                         break
+
+    def on_task_cancelled(self, node, spec: TaskSpec) -> None:
+        """A node dropped a queued spec in response to cancel()."""
+        from ray_tpu.exceptions import TaskCancelledError
+        self._release_task_resources(spec, node.node_id)
+        self._record_event(spec, "FAILED", node_id=node.node_id,
+                           error="cancelled")
+        self.task_manager.fail(spec.task_id,
+                               TaskCancelledError(spec.task_id))
+        self._signal_scheduler()
 
     def cluster_resources(self) -> Dict[str, float]:
         totals: Dict[str, float] = {}

@@ -7,7 +7,7 @@ operators (lazy); consumption plans + streams execution
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 import numpy as np
 import pyarrow as pa
@@ -48,7 +48,7 @@ class Dataset:
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: Optional[str] = None,
                     compute: Optional[str] = None,
-                    concurrency: Optional[int] = None,
+                    concurrency: Union[int, Tuple[int, int], None] = None,
                     fn_args=(), fn_kwargs=None,
                     num_cpus: Optional[float] = None,
                     resources: Optional[Dict[str, float]] = None,

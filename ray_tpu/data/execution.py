@@ -300,24 +300,91 @@ class _MapWorker:
 
 
 class _ActorPool:
-    def __init__(self, size: int, resources: Dict[str, float]):
-        actor_cls = ray_tpu.remote(
-            num_cpus=resources.get("CPU", 1), resources={
-                k: v for k, v in resources.items() if k != "CPU"} or None,
+    """Autoscaling actor pool (reference: data/_internal/execution/
+    autoscaler/actor_autoscaler — pools scale within [min, max] on
+    utilization). ``pick`` grows the pool when every actor is busy;
+    ``maybe_scale_down`` reaps actors idle beyond a grace period."""
+
+    IDLE_REAP_S = 10.0
+
+    def __init__(self, size, resources: Dict[str, float]):
+        import time as _time
+        if isinstance(size, (tuple, list)):
+            self.min_size, self.max_size = int(size[0]), int(size[1])
+        else:
+            self.min_size = self.max_size = int(size)
+        if not 1 <= self.min_size <= self.max_size:
+            raise ValueError(f"bad actor pool bounds {size!r}")
+        self._cpu_per_actor = resources.get("CPU", 1)
+        self._extra_resources = {k: v for k, v in resources.items()
+                                 if k != "CPU"}
+        self._actor_cls = ray_tpu.remote(
+            num_cpus=self._cpu_per_actor,
+            resources=self._extra_resources or None,
         )(_MapWorker)
-        self.actors = [actor_cls.remote() for _ in range(size)]
-        self.load = {i: 0 for i in range(size)}
+        self.actors: Dict[int, Any] = {}
+        self.load: Dict[int, int] = {}
+        self._idle_since: Dict[int, float] = {}
+        self._next_id = 0
+        self._time = _time
+        for _ in range(self.min_size):
+            self._add_actor()
+
+    def _add_actor(self) -> int:
+        i = self._next_id
+        self._next_id += 1
+        self.actors[i] = self._actor_cls.remote()
+        self.load[i] = 0
+        self._idle_since[i] = self._time.monotonic()
+        return i
+
+    def _cluster_has_room(self) -> bool:
+        """Only scale up when the cluster can actually place another
+        actor — an unplaceable actor would buffer its bundles behind a
+        never-ALIVE creation forever."""
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:  # noqa: BLE001
+            return False
+        if avail.get("CPU", 0.0) < self._cpu_per_actor:
+            return False
+        return all(avail.get(k, 0.0) >= v
+                   for k, v in self._extra_resources.items())
 
     def pick(self) -> Tuple[int, Any]:
         i = min(self.load, key=lambda k: self.load[k])
+        if (self.load[i] > 0 and len(self.actors) < self.max_size
+                and self._cluster_has_room()):
+            i = self._add_actor()  # all busy + capacity: scale up
         self.load[i] += 1
+        self._idle_since.pop(i, None)
         return i, self.actors[i]
 
     def release(self, i: int):
+        if i not in self.load:
+            return  # reaped while its last task was in flight
         self.load[i] -= 1
+        if self.load[i] == 0:
+            self._idle_since[i] = self._time.monotonic()
+
+    def maybe_scale_down(self) -> None:
+        if len(self.actors) <= self.min_size:
+            return
+        now = self._time.monotonic()
+        for i, since in list(self._idle_since.items()):
+            if len(self.actors) <= self.min_size:
+                return
+            if self.load.get(i) == 0 and now - since > self.IDLE_REAP_S:
+                actor = self.actors.pop(i)
+                self.load.pop(i, None)
+                self._idle_since.pop(i, None)
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def shutdown(self):
-        for a in self.actors:
+        for a in self.actors.values():
             try:
                 ray_tpu.kill(a)
             except Exception:
@@ -704,6 +771,9 @@ class StreamingExecutor:
     def _step(self) -> bool:
         progressed = False
         self.resource_manager.refresh()
+        for st in self.states.values():  # reap idle autoscaled actors
+            if st.actor_pool is not None:
+                st.actor_pool.maybe_scale_down()
         # 1. Completions.
         if self.pending:
             ready, _ = ray_tpu.wait(list(self.pending.keys()),

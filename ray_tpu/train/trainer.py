@@ -166,21 +166,27 @@ class JaxTrainer:
                    f":{self._run_record_id}").encode()
             if rt.is_driver:
                 rt.gcs.kv.put(key, record, namespace="train_runs")
-                # retention: keep the newest 50 run records — a
-                # long-lived cluster running periodic jobs must not
-                # grow the KV (and /api/train) without bound
-                keys = rt.gcs.kv.keys(namespace="train_runs")
-                if len(keys) > 50:
-                    aged = []
-                    for k in keys:
-                        blob = rt.gcs.kv.get(k, namespace="train_runs")
-                        if blob is None:
-                            continue
-                        aged.append(
-                            (_ser.loads(blob).get("updated_at", 0), k))
-                    aged.sort()
-                    for _ts, k in aged[:len(aged) - 50]:
-                        rt.gcs.kv.delete(k, namespace="train_runs")
+                # Retention: keep the newest 50 records. Pruning only on
+                # TERMINAL transitions keeps the hot path N+1-free, and
+                # skipping our own key means an old-but-active run can't
+                # be evicted by a flood of quick newer runs.
+                if state in ("FINISHED", "ERRORED", "ABORTED"):
+                    keys = rt.gcs.kv.keys(namespace="train_runs")
+                    if len(keys) > 50:
+                        aged = []
+                        for k in keys:
+                            if k == key:
+                                continue
+                            blob = rt.gcs.kv.get(k,
+                                                 namespace="train_runs")
+                            if blob is None:
+                                continue
+                            aged.append(
+                                (_ser.loads(blob).get("updated_at", 0),
+                                 k))
+                        aged.sort()
+                        for _ts, k in aged[:len(aged) - 49]:
+                            rt.gcs.kv.delete(k, namespace="train_runs")
             else:
                 rt.gcs_call("kv_put", key, record, "train_runs")
         except Exception:  # noqa: BLE001

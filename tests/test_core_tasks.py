@@ -296,3 +296,33 @@ def test_burst_grant_crash_retry_releases_all_resources(
         time.sleep(0.1)
     raise AssertionError(
         (rt.scheduler.snapshot(), len(rt._overcommitted)))
+
+
+def test_cancel_burst_queued_task(ray_start_regular):
+    """A burst-granted spec parked in the node's dispatch queue must
+    cancel immediately with TaskCancelledError (queued semantics),
+    releasing its accounting."""
+    from ray_tpu.core import runtime as runtime_mod
+    from ray_tpu.exceptions import TaskCancelledError
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(0.4)
+        return 1
+
+    # flood so followers queue at the node behind busy workers
+    refs = [slow.remote() for _ in range(60)]
+    victim = refs[-1]
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=60)
+    # everything else still completes and accounting balances
+    rest = [r for r in refs[:-1]]
+    assert ray_tpu.get(rest, timeout=120) == [1] * 59
+    rt = runtime_mod.get_runtime()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if _scheduler_fully_released(rt) and not rt._overcommitted:
+            return
+        time.sleep(0.1)
+    raise AssertionError(rt.scheduler.snapshot())

@@ -355,3 +355,36 @@ def test_left_join_empty_left_is_empty():
     right = rd.from_items([{"k": 1, "w": 3}])
     assert left.join(right, on="k", how="left").take_all() == []
     assert right.join(left, on="k", how="right").take_all() == []
+
+
+def test_actor_pool_autoscaling():
+    """concurrency=(min, max): the pool grows under load and stays
+    within bounds; results are correct either way."""
+    from ray_tpu.data.execution import _ActorPool
+
+    class AddOne:
+        def __call__(self, batch):
+            return {"v": np.asarray(batch["v"]) + 1}
+
+    ds = rd.from_items([{"v": i} for i in range(64)], parallelism=8)
+    out = ds.map_batches(AddOne, compute="actors",
+                         concurrency=(1, 3)).take_all()
+    assert sorted(r["v"] for r in out) == list(range(1, 65))
+
+    # unit: pick() scales up only while under max and all actors busy
+    pool = _ActorPool((1, 2), {"CPU": 0})
+    try:
+        i0, _ = pool.pick()
+        assert len(pool.actors) == 1
+        i1, _ = pool.pick()      # first is busy -> grow
+        assert len(pool.actors) == 2
+        pool.pick()              # both busy, at max -> no growth
+        assert len(pool.actors) == 2
+        pool.release(i0)
+        pool.release(i1)
+        # idle reaping respects min_size and the grace period
+        pool.IDLE_REAP_S = 0.0
+        pool.maybe_scale_down()
+        assert len(pool.actors) >= 1
+    finally:
+        pool.shutdown()
