@@ -7,6 +7,7 @@ gradient allreduce across learner actors.
 """
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
@@ -23,7 +24,7 @@ from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
 from ray_tpu.rl import spaces
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "CartPole",
+    "APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "CartPole",
     "CartPoleJax", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
     "Env", "FrameStack", "JaxEnv", "JaxEnvRunner", "Learner",
     "LearnerGroup", "MARWIL", "MARWILConfig", "ObsNormalizer",

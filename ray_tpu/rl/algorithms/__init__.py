@@ -1,7 +1,8 @@
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 
-__all__ = ["PPO", "PPOConfig", "DQN", "DQNConfig", "SAC", "SACConfig",
-           "BC", "BCConfig", "MARWIL", "MARWILConfig"]
+__all__ = ["APPO", "APPOConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
+           "SAC", "SACConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig"]

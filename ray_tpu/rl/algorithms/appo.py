@@ -1,0 +1,110 @@
+"""APPO — asynchronous PPO.
+
+Reference: rllib/algorithms/appo/ — PPO's clipped surrogate applied
+asynchronously: env-runner actors sample continuously and the learner
+consumes whichever fragment arrives next, so slow runners never stall
+the update loop (decoupled sampling/learning, the IMPALA architecture
+with PPO's loss). Staleness is bounded by the ratio clip: the surrogate
+is computed against the BEHAVIOR policy's log-probs recorded at sample
+time, exactly PPO's importance-sampling form, so a fragment collected a
+few weight versions ago contributes a clipped, conservative update.
+
+Weights are pushed to runners fire-and-forget after every update; each
+runner's next fragment uses whatever version it last received.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
+
+
+class APPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        # APPO defaults: single pass per fragment (stale data does not
+        # reward many epochs), more runners than PPO
+        self.num_epochs = 1
+        self.num_env_runners = 2
+        # max fragments consumed per training_step() call
+        self.max_fragments_per_step = 4
+
+
+class APPO(PPO):
+    def setup(self, config: APPOConfig) -> None:
+        if config.num_env_runners < 1:
+            raise ValueError("APPO requires num_env_runners >= 1 "
+                             "(asynchronous sampling needs actors)")
+        super().setup(config)
+        assert self._remote, "APPO runner group must be remote actors"
+        # ref -> runner index, for resubmission on completion
+        self._inflight: Dict[Any, int] = {}
+
+    def _launch(self, idx: int) -> None:
+        ref = self.runners[idx].sample.remote()
+        self._inflight[ref] = idx
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        from ray_tpu.core import serialization
+        from ray_tpu.rl.sample_batch import concat_samples
+
+        cfg = self.config
+        weights = self.learner_group.get_weights()
+        if not self._inflight:
+            for idx, runner in enumerate(self.runners):
+                runner.set_weights.remote(weights)
+                self._launch(idx)
+
+        batches = []
+        consumed = 0
+        metrics: Dict[str, Any] = {}
+        while consumed < cfg.max_fragments_per_step:
+            ready, _ = ray_tpu.wait(list(self._inflight),
+                                    num_returns=1, timeout=60.0)
+            if not ready:
+                break  # stall: surface it via the metrics below
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            try:
+                cols, runner_metrics = serialization.loads(
+                    ray_tpu.get(ref))
+            except Exception:  # noqa: BLE001 — a crashed runner must
+                # not leave its slot out of the sampling rotation
+                self.runners[idx].set_weights.remote(weights)
+                self._launch(idx)
+                continue
+            self.record_episodes(runner_metrics["episode_returns"])
+            batches.append(self._postprocess(cols, weights))
+            consumed += 1
+            # resume sampling IMMEDIATELY with the freshest weights the
+            # runner can have — learning continues while it samples
+            self.runners[idx].set_weights.remote(weights)
+            self._launch(idx)
+        if batches:
+            batch = concat_samples(batches)
+            self._env_steps_lifetime += len(batch)
+            metrics = self._sgd_epochs(batch)
+        if (self._connector_template is not None
+                and len(self.runners) > 1):
+            # same delta-sync protocol as synchronous PPO (ppo.py):
+            # disjoint per-runner deltas fold into the canonical state
+            deltas = ray_tpu.get([r.pop_connector_delta.remote()
+                                  for r in self.runners])
+            self._connector_state = (
+                self._connector_template.merge_states(
+                    [self._connector_state] + deltas))
+            ray_tpu.get([
+                r.set_connector_state.remote(self._connector_state)
+                for r in self.runners])
+        metrics["fragments_consumed"] = consumed
+        metrics["fragments_in_flight"] = len(self._inflight)
+        return metrics
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()
+
+
+APPOConfig.algo_class = APPO
