@@ -40,6 +40,7 @@ class APPO(PPO):
         assert self._remote, "APPO runner group must be remote actors"
         # ref -> runner index, for resubmission on completion
         self._inflight: Dict[Any, int] = {}
+        self._runner_failures: Dict[int, int] = {}
 
     def _launch(self, idx: int) -> None:
         ref = self.runners[idx].sample.remote()
@@ -58,9 +59,16 @@ class APPO(PPO):
                 self._launch(idx)
 
         batches = []
+        deltas = []
         consumed = 0
+        failures = 0
+        pushed = set()  # weights are fixed within a step: push once
         metrics: Dict[str, Any] = {}
         while consumed < cfg.max_fragments_per_step:
+            if failures > 3 * max(1, len(self.runners)):
+                raise RuntimeError(
+                    "APPO: every env runner is failing repeatedly; "
+                    "giving up this step (check runner logs)")
             ready, _ = ray_tpu.wait(list(self._inflight),
                                     num_returns=1, timeout=60.0)
             if not ready:
@@ -68,36 +76,52 @@ class APPO(PPO):
             ref = ready[0]
             idx = self._inflight.pop(ref)
             try:
-                cols, runner_metrics = serialization.loads(
+                cols, runner_metrics, delta = serialization.loads(
                     ray_tpu.get(ref))
-            except Exception:  # noqa: BLE001 — a crashed runner must
-                # not leave its slot out of the sampling rotation
+                self.record_episodes(runner_metrics["episode_returns"])
+                batches.append(self._postprocess(cols, weights))
+                deltas.append(delta)
+                consumed += 1
+                self._runner_failures[idx] = 0
+            except Exception as exc:  # noqa: BLE001 — a failing runner
+                # must not silently leave the rotation NOR busy-spin:
+                # after repeated failures, recreate the actor from its
+                # construction blob (a dead actor fails new tasks
+                # instantly, which would otherwise livelock this loop)
+                failures += 1
+                count = self._runner_failures.get(idx, 0) + 1
+                self._runner_failures[idx] = count
+                if count >= 2:
+                    print(f"APPO: recreating env runner {idx} after "
+                          f"{count} failures ({exc!r})")
+                    try:
+                        # the old actor may be alive (application
+                        # errors don't kill the process) — leaking it
+                        # would pin its CPU forever
+                        ray_tpu.kill(self.runners[idx])
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.runners[idx] = self._runner_actor_cls.remote(
+                        self._runner_blobs[idx])
+                    self._runner_failures[idx] = 0
+            # resume sampling IMMEDIATELY; weights go once per runner
+            # per step (they only change after the sgd below)
+            if idx not in pushed:
                 self.runners[idx].set_weights.remote(weights)
-                self._launch(idx)
-                continue
-            self.record_episodes(runner_metrics["episode_returns"])
-            batches.append(self._postprocess(cols, weights))
-            consumed += 1
-            # resume sampling IMMEDIATELY with the freshest weights the
-            # runner can have — learning continues while it samples
-            self.runners[idx].set_weights.remote(weights)
+                pushed.add(idx)
             self._launch(idx)
         if batches:
             batch = concat_samples(batches)
             self._env_steps_lifetime += len(batch)
             metrics = self._sgd_epochs(batch)
-        if (self._connector_template is not None
-                and len(self.runners) > 1):
-            # same delta-sync protocol as synchronous PPO (ppo.py):
-            # disjoint per-runner deltas fold into the canonical state
-            deltas = ray_tpu.get([r.pop_connector_delta.remote()
-                                  for r in self.runners])
+        if (self._connector_template is not None and deltas):
+            # deltas arrived WITH the sample payloads (no extra round
+            # trip — a gather here would barrier on in-flight samples)
             self._connector_state = (
                 self._connector_template.merge_states(
                     [self._connector_state] + deltas))
-            ray_tpu.get([
+            for r in self.runners:  # fire-and-forget broadcast
                 r.set_connector_state.remote(self._connector_state)
-                for r in self.runners])
         metrics["fragments_consumed"] = consumed
         metrics["fragments_in_flight"] = len(self._inflight)
         return metrics

@@ -97,7 +97,11 @@ class _EnvRunnerActor:
     def sample(self) -> bytes:
         from ray_tpu.core import serialization
         batch = self.runner.sample()
-        return serialization.dumps((dict(batch), self.runner.pop_metrics()))
+        # connector deltas piggyback on the payload: a separate
+        # pop_connector_delta round trip would queue behind the NEXT
+        # in-flight sample and turn the sync into a barrier
+        return serialization.dumps((dict(batch), self.runner.pop_metrics(),
+                                    self.runner.pop_connector_delta()))
 
     def set_weights(self, weights) -> None:
         self.runner.set_weights(weights)
@@ -163,16 +167,33 @@ class PPO(Algorithm):
             import ray_tpu
             from ray_tpu.core import serialization
             actor_cls = ray_tpu.remote(_EnvRunnerActor)
-            self.runners = [
-                actor_cls.remote(serialization.dumps(
+            self._runner_actor_cls = actor_cls
+            self._runner_blobs = [
+                serialization.dumps(
                     dict(seed=config.seed + i,
                          connector_factories=config.connector_factories,
-                         **runner_kwargs)))
+                         **runner_kwargs))
                 for i in range(config.num_env_runners)]
+            self.runners = [actor_cls.remote(blob)
+                            for blob in self._runner_blobs]
             ray_tpu.get([r.ping.remote() for r in self.runners])
             self._remote = True
 
     # ------------------------------------------------------------------
+    def stop(self) -> None:
+        """Release remote actors — leaked env runners would keep
+        sampling (and holding CPUs) after the algorithm is done."""
+        if getattr(self, "_remote", False) and self.runners:
+            import ray_tpu
+            for runner in self.runners:
+                try:
+                    ray_tpu.kill(runner)
+                except Exception:  # noqa: BLE001
+                    pass
+        group = getattr(self, "learner_group", None)
+        if group is not None and hasattr(group, "shutdown"):
+            group.shutdown()
+
     def training_step(self) -> Dict[str, Any]:
         if self.jax_runner is not None:
             return self._training_step_jax()
@@ -235,28 +256,26 @@ class PPO(Algorithm):
             from ray_tpu.core import serialization
             ray_tpu.get([r.set_weights.remote(weights)
                          for r in self.runners])
+            deltas = []
             for blob in ray_tpu.get([r.sample.remote()
                                      for r in self.runners]):
-                cols, metrics = serialization.loads(blob)
+                cols, metrics, delta = serialization.loads(blob)
                 batches.append(self._postprocess(cols, weights))
                 self.record_episodes(metrics["episode_returns"])
+                deltas.append(delta)
             if self._connector_template is not None and len(self.runners) > 1:
-                # connector-state sync: each runner reports only the
+                # connector-state sync: each runner reported only the
                 # statistics accumulated SINCE the last sync (disjoint
-                # deltas); the driver folds them into its canonical
-                # state and broadcasts — merging full states would
-                # double-count shared history and inflate the Welford
-                # count ~world_size× per iteration (reference: rllib
-                # filter delta buffers / apply_changes)
-                deltas = ray_tpu.get(
-                    [r.pop_connector_delta.remote()
-                     for r in self.runners])
+                # deltas, shipped with its sample payload); the driver
+                # folds them into its canonical state and broadcasts —
+                # merging full states would double-count shared history
+                # and inflate the Welford count ~world_size× per
+                # iteration (reference: rllib filter delta buffers)
                 self._connector_state = (
                     self._connector_template.merge_states(
                         [self._connector_state] + deltas))
-                ray_tpu.get(
-                    [r.set_connector_state.remote(self._connector_state)
-                     for r in self.runners])
+                for r in self.runners:  # fire-and-forget broadcast
+                    r.set_connector_state.remote(self._connector_state)
         else:
             for runner in self.runners:
                 runner.set_weights(weights)

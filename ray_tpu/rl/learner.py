@@ -222,6 +222,17 @@ class LearnerGroup:
             for rank in range(num_learners)]
         ray_tpu.get([a.ping.remote() for a in self._actors])
 
+    def shutdown(self) -> None:
+        """Kill learner actors (leaked ones would hold CPUs forever)."""
+        if self._actors:
+            import ray_tpu
+            for actor in self._actors:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._actors = None
+
     def update(self, batch) -> Dict[str, Any]:
         if self._local is not None:
             return self._local.update(batch)

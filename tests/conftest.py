@@ -30,6 +30,9 @@ import pytest
 def ray_start_regular():
     """A fresh single-node runtime per test."""
     import ray_tpu
+    if ray_tpu.is_initialized():
+        # a failed test elsewhere must not cascade into fixture errors
+        ray_tpu.shutdown()
     rt = ray_tpu.init(num_cpus=4, system_config={"task_max_retries": 0})
     yield rt
     ray_tpu.shutdown()
@@ -41,7 +44,15 @@ def ray_start_shared():
     import ray_tpu
     rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     yield rt
-    ray_tpu.shutdown()
+    try:
+        ray_tpu.shutdown()
+    finally:
+        # a shutdown that raises mid-teardown (hung serve controller,
+        # dead node) must not leave the global runtime set — the next
+        # module's fixtures would all error with "already initialized"
+        from ray_tpu.core import runtime as runtime_mod
+        if runtime_mod.get_runtime_or_none() is not None:
+            runtime_mod.set_runtime(None)
 
 
 @pytest.fixture

@@ -236,6 +236,7 @@ def test_ppo_remote_env_runners(ray_start_regular):
     result = algo.train()
     assert result["num_env_steps_sampled"] == 2 * 2 * 16
     assert "policy_loss" in result
+    algo.stop()  # release the runner actors' CPUs
 
 
 def test_learner_group_allreduce(ray_start_regular):
@@ -558,14 +559,16 @@ def test_appo_async_learns():
               .training(lr=3e-3, minibatch_size=256)
               .debugging(seed=0))
     algo = config.build_algo()
-    result = {}
-    for _ in range(12):
-        result = algo.train()
-    assert result.get("fragments_consumed", 0) >= 1
-    assert result["fragments_in_flight"] >= 1  # sampling never stops
-    assert np.isfinite(result["policy_loss"])
-    assert result["episode_return_mean"] > 40, result
-    algo.stop()
+    try:
+        result = {}
+        for _ in range(12):
+            result = algo.train()
+        assert result.get("fragments_consumed", 0) >= 1
+        assert result["fragments_in_flight"] >= 1  # sampling never stops
+        assert np.isfinite(result["policy_loss"])
+        assert result["episode_return_mean"] > 40, result
+    finally:
+        algo.stop()  # a failed assert must not leak runner actors
 
 
 def test_appo_requires_runners():

@@ -87,8 +87,11 @@ def test_composition(serve_instance):
 
     app = Pipeline.bind(Adder.options(name="add1").bind(1),
                         Adder.options(name="add10").bind(10))
-    handle = serve.run(app, name="comp_app")
-    assert handle.remote({"x": 0}).result() == 11
+    # 3 deployments × worker spawn can exceed the 60s default readiness
+    # budget on a loaded shared box; total must stay under the 150s
+    # per-test watchdog
+    handle = serve.run(app, name="comp_app", timeout_s=110.0)
+    assert handle.remote({"x": 0}).result(timeout_s=30) == 11
 
 
 def test_batching(serve_instance):
