@@ -167,10 +167,14 @@ class NodeDaemon:
         labels = dict(labels or {})
         from ray_tpu.accelerators.tpu import TpuAcceleratorManager
         TpuAcceleratorManager.augment_node(resources, labels)
+        self._advertise = advertise_host or get_config().head_host
+        # must be set BEFORE the Node prestarts workers: they inherit
+        # it for cross-host endpoints they advertise (e.g.
+        # compiled-graph TCP channel listeners)
+        os.environ["RTPU_NODE_ADVERTISE_HOST"] = self._advertise
         self.node = Node(self.proxy, self.node_id, resources, labels,
                          object_store_memory=object_store_memory,
                          session_dir=session_dir)
-        self._advertise = advertise_host or get_config().head_host
         self.object_server = ObjectServer(self._resolve_store,
                                           host=self._advertise)
         from ray_tpu.core.protocol import PROTOCOL_VERSION

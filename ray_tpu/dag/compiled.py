@@ -14,10 +14,12 @@ flow through the channels as error tokens (the DAG stays alive);
 `teardown()` injects a stop token that propagates through every
 channel and unwinds the loops.
 
-Same-node only in this round: channels need writer and readers on one
-shm arena (the head node). Cross-slice DAGs ride DCN in the reference
-via NCCL channels; the TPU equivalent (jax transfer-server channels)
-is future work.
+Channel transport is chosen per edge at compile time: co-located
+writer/readers share a shm ring (zero-copy); edges that cross nodes
+move over pre-established worker-to-worker TCP links with credit-based
+backpressure (dag/tcp_channel.py) — the DCN analog of the reference's
+NCCL channels (experimental/channel/nccl_group.py:21), with reader
+listeners created before loop install so connects can't race.
 """
 
 from __future__ import annotations
@@ -49,14 +51,45 @@ class DAGExecutionError(RuntimeError):
     pass
 
 
+def _make_reader(entry):
+    if entry[0] == "shm":
+        return ChannelReader(entry[1], entry[2])
+    from ray_tpu.dag.tcp_channel import adopt_listener
+    return adopt_listener(entry[1])  # ("tcp", token)
+
+
+def _make_writer(entry):
+    if entry[0] == "shm":
+        return ChannelWriter(entry[1])
+    from ray_tpu.dag.tcp_channel import TcpChannelWriter
+    return TcpChannelWriter(entry[1], entry[2])  # ("tcp", endpoints, cap)
+
+
+def _create_listener(instance, token):
+    """__ray_call__ helper: reader-side TCP endpoint, pre-install."""
+    from ray_tpu.dag.tcp_channel import create_listener
+    return create_listener(token)
+
+
+def _close_listener(instance, token):
+    """__ray_call__ helper: reclaim a never-adopted listener after a
+    failed compile (otherwise its bound socket leaks in the actor
+    process registry for the actor's lifetime)."""
+    from ray_tpu.dag import tcp_channel
+    with tcp_channel._registry_lock:
+        listener = tcp_channel._listener_registry.pop(token, None)
+    if listener is not None:
+        listener.close()
+
+
 def _compiled_dag_loop(instance, schedule):
     """Resident per-actor loop. Reads lazily (just before the first
     node that needs a channel) so actor-level cycles like
     A.n1 -> B.n2 -> A.n3 can't deadlock."""
-    readers = {key: ChannelReader(spec, idx)
-               for key, (spec, idx) in schedule["reads"].items()}
-    writers = {uid: ChannelWriter(spec)
-               for uid, spec in schedule["writes"].items()}
+    readers = {key: _make_reader(entry)
+               for key, entry in schedule["reads"].items()}
+    writers = {uid: _make_writer(entry)
+               for uid, entry in schedule["writes"].items()}
     zero_copy = schedule.get("zero_copy", False)
     seq = 0
     while True:
@@ -74,8 +107,10 @@ def _compiled_dag_loop(instance, schedule):
                 # experimental_compile(zero_copy_reads=True) when no
                 # method retains its inputs (saves an O(payload) copy per
                 # hop).
-                if not zero_copy and not isinstance(
-                        value, (_Stop, _ErrorToken)):
+                if (not zero_copy
+                        and not getattr(readers[key], "owned_reads",
+                                        False)
+                        and not isinstance(value, (_Stop, _ErrorToken))):
                     value = copy.deepcopy(value)
                 cache[key] = value
             value = cache[key]
@@ -137,6 +172,10 @@ def _compiled_dag_loop(instance, schedule):
                 writer.write(_STOP, seq, timeout=None)
             for key in cache:
                 readers[key].ack(seq)
+            for endpoint in list(readers.values()) + list(writers.values()):
+                close = getattr(endpoint, "close", None)
+                if close is not None:  # TCP endpoints hold sockets
+                    close()
             return seq
         for key in readers:
             readers[key].ack(seq)
@@ -279,11 +318,18 @@ class CompiledDAG:
                 sched["writes"][n._node_uid] = self._chan_specs[n._node_uid]
             sched["nodes"].append(entry)
 
-        # channels are same-arena: every participating actor must sit on
-        # the head node (where the driver's endpoints live)
+        # --- transport assignment ---------------------------------------
+        # A channel stays on the shm ring only when the writer and EVERY
+        # reader share one arena (same node; driver endpoints live on
+        # the head node). Otherwise the whole channel moves to
+        # pre-established worker-to-worker TCP links (dag/tcp_channel.py
+        # — the DCN analog of the reference's NCCL channels), readers'
+        # listeners created before loop install so connects can't race.
         import time as _time
+        import ray_tpu
         from ray_tpu.core import runtime as runtime_mod
         rt = runtime_mod.get_runtime()
+        placement: Dict[Any, Any] = {}
         if getattr(rt, "is_driver", False):
             deadline = _time.monotonic() + 10.0
             for aid in handles:
@@ -296,26 +342,138 @@ class CompiledDAG:
                             f"actor {aid} not placed within 10s; cannot "
                             "compile DAG")
                     _time.sleep(0.01)
-                if info.node_id != rt.head_node_id:
-                    raise ValueError(
-                        f"compiled graphs require all actors on the head "
-                        f"node (shared shm arena); actor {aid} is on "
-                        f"node {info.node_id}")
+                placement[aid] = info.node_id
+            driver_node = rt.head_node_id
+        else:
+            driver_node = None  # worker-driven compile: same-node only
+
+        def chan_is_local(writer_node, reader_aids, driver_reads) -> bool:
+            if driver_node is None:
+                # worker-compiled DAG: placement unknown; keep the
+                # pre-existing same-arena behavior
+                return True
+            nodes_involved = {writer_node}
+            nodes_involved.update(placement.get(a) for a in reader_aids)
+            if driver_reads:
+                nodes_involved.add(driver_node)
+            return len(nodes_involved) == 1 and None not in nodes_involved
+
+        def tcp_token(uid, aid) -> str:
+            tag = "input" if uid is None else str(uid)
+            peer = aid if isinstance(aid, str) else aid.hex()
+            return f"dag:{id(self)}:{tag}:{peer}"
+
+        # rewrite schedule entries with transports; collect listener
+        # requests per reader actor, then resolve endpoints in one pass
+        listener_reqs: List = []  # (aid, token)
+
+        def assign(uid, writer_node, reader_aids, driver_reads):
+            if chan_is_local(writer_node, reader_aids, driver_reads):
+                return "shm"
+            for aid in reader_aids:
+                listener_reqs.append((aid, tcp_token(uid, aid)))
+            return "tcp"
+
+        input_transport = assign(None, driver_node, input_reader_order,
+                                 False)
+        chan_transport: Dict[int, str] = {}
+        for n in compute:
+            uid = n._node_uid
+            if uid in self._chan_specs:
+                writer_node = placement.get(actor_of[uid])
+                chan_transport[uid] = assign(
+                    uid, writer_node, reader_order[uid],
+                    uid in out_uids)
+
+        endpoints: Dict[str, tuple] = {}
+        if listener_reqs:
+            refs = [handles[aid].__ray_call__.remote(_create_listener,
+                                                     token)
+                    for aid, token in listener_reqs]
+            try:
+                for (aid, token), addr in zip(listener_reqs,
+                                              ray_tpu.get(refs)):
+                    endpoints[token] = tuple(addr)
+            except Exception:
+                # partial success: reclaim already-created listeners so
+                # repeated failed compiles can't leak actor-side sockets
+                for aid, token in listener_reqs:
+                    try:
+                        handles[aid].__ray_call__.remote(_close_listener,
+                                                         token)
+                    except Exception:  # noqa: BLE001
+                        pass
+                raise
+        # driver-read TCP outputs: local listeners, created pre-install
+        self._driver_tcp_readers: Dict[int, Any] = {}
+        from ray_tpu.dag.tcp_channel import (
+            TcpChannelListener, TcpChannelReader, TcpChannelWriter)
+        for o in self._outputs:
+            uid = o._node_uid
+            if chan_transport.get(uid) == "tcp":
+                # driver address must be reachable from the writer's
+                # host; hostname resolution covers LAN and localhost
+                listener = TcpChannelListener()
+                endpoints[tcp_token(uid, "driver")] = listener.address
+                self._driver_tcp_readers[uid] = TcpChannelReader(listener)
+
+        def reader_entry(uid, spec, idx, aid):
+            transport = (input_transport if uid is None
+                         else chan_transport[uid])
+            if transport == "shm":
+                return ("shm", spec, idx)
+            return ("tcp", tcp_token(uid, aid))
+
+        def writer_entry(uid, spec, reader_aids, driver_reads):
+            transport = (input_transport if uid is None
+                         else chan_transport[uid])
+            if transport == "shm":
+                return ("shm", spec)
+            eps = [endpoints[tcp_token(uid, a)] for a in reader_aids]
+            if driver_reads:
+                eps.append(endpoints[tcp_token(uid, "driver")])
+            return ("tcp", eps, spec.capacity)
+
+        for aid, sched in schedules.items():
+            new_reads = {}
+            for key, (spec, idx) in sched["reads"].items():
+                uid = None if key == "__input__" else int(key[1:])
+                new_reads[key] = reader_entry(uid, spec, idx, aid)
+            sched["reads"] = new_reads
+            new_writes = {}
+            for uid, spec in sched["writes"].items():
+                new_writes[uid] = writer_entry(
+                    uid, spec, reader_order[uid], uid in out_uids)
+            sched["writes"] = new_writes
 
         # driver-side endpoints
-        self._input_writer = ChannelWriter(self._input_spec)
-        self._output_readers = [
-            ChannelReader(self._chan_specs[o._node_uid],
-                          # driver is always the last reader index
-                          self._chan_specs[o._node_uid].num_readers - 1)
-            for o in self._outputs]
+        if input_transport == "shm":
+            self._input_writer = ChannelWriter(self._input_spec)
+        else:
+            eps = [endpoints[tcp_token(None, a)]
+                   for a in input_reader_order]
+            self._input_writer = None  # connected after install below
+            self._pending_input_eps = eps
+        self._output_readers = []
+        for o in self._outputs:
+            uid = o._node_uid
+            if chan_transport.get(uid) == "tcp":
+                self._output_readers.append(self._driver_tcp_readers[uid])
+            else:
+                spec = self._chan_specs[uid]
+                self._output_readers.append(
+                    ChannelReader(spec, spec.num_readers - 1))
         self._next_seq = 0
         self._torn_down = False
 
-        # install the loops
+        # install the loops (reader listeners already exist, so writer
+        # connects inside the loops can't race)
         self._loop_refs = [
             handles[aid].__ray_call__.remote(_compiled_dag_loop, sched)
             for aid, sched in schedules.items()]
+        if self._input_writer is None:
+            self._input_writer = TcpChannelWriter(
+                self._pending_input_eps, self._input_spec.capacity)
 
     # ------------------------------------------------------------------
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
@@ -331,10 +489,13 @@ class CompiledDAG:
         # output leaves the whole seq re-readable
         raw = [reader.read(seq, timeout)
                for reader in self._output_readers]
-        # deep-copy: read values may be zero-copy views into channel
-        # slots the writer will reuse after `capacity` more executions
-        values = [v if isinstance(v, _ErrorToken) else copy.deepcopy(v)
-                  for v in raw]
+        # deep-copy shm reads: they may be zero-copy views into slots
+        # the writer reuses after `capacity` more executions (TCP reads
+        # deserialize into owned objects — no copy needed)
+        values = [v if (isinstance(v, _ErrorToken)
+                        or getattr(reader, "owned_reads", False))
+                  else copy.deepcopy(v)
+                  for reader, v in zip(self._output_readers, raw)]
         for reader in self._output_readers:
             reader.ack(seq)
         errors = [v for v in values if isinstance(v, _ErrorToken)]
@@ -347,11 +508,23 @@ class CompiledDAG:
             return
         self._torn_down = True
         import ray_tpu
-        self._input_writer.write(_STOP, self._next_seq)
+        try:
+            self._input_writer.write(_STOP, self._next_seq)
+        except Exception:  # noqa: BLE001 — a dead reader (lost node)
+            # must not abort teardown: still join loops + close sockets
+            pass
         try:
             ray_tpu.get(self._loop_refs, timeout=30.0)
         except Exception:  # noqa: BLE001 — teardown is best-effort
             pass
+        for endpoint in ([self._input_writer]
+                         + list(self._output_readers)):
+            close = getattr(endpoint, "close", None)
+            if close is not None:  # TCP endpoints hold sockets
+                try:
+                    close()
+                except Exception:  # noqa: BLE001
+                    pass
 
     def __del__(self):
         try:

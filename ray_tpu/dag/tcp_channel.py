@@ -1,0 +1,243 @@
+"""Cross-node compiled-graph channels over pre-established TCP.
+
+Reference: python/ray/experimental/channel/nccl_group.py:21 — compiled
+DAGs move cross-GPU edges over pre-created NCCL P2P channels, no
+per-call RPC. The TPU-host analog for cross-NODE edges is a dedicated
+worker-to-worker TCP connection per (writer, reader) link, established
+once at compile time: frames are length-prefixed serialized values,
+and capacity semantics come from a credit loop (the reader returns one
+credit byte per consumed item; the writer blocks once ``capacity``
+items are unacknowledged — the same bounded-buffer backpressure the
+shm ring gives co-located actors).
+
+Interface-compatible with dag.channel.ChannelWriter/ChannelReader
+(write(value, seq) / read(seq) / ack(seq)): TCP ordering makes the
+seq implicit, and the compiled loop consumes strictly sequentially.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.dag.channel import ChannelTimeoutError
+
+_LEN = struct.Struct("<I")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        try:
+            chunk = sock.recv(n)
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+class TcpChannelListener:
+    """Reader-side endpoint, created BEFORE the writer connects.
+
+    One listener per (channel, reader); accept() runs lazily on first
+    read so install order can't deadlock."""
+
+    def __init__(self, host: Optional[str] = None):
+        import os
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(1)
+        if host is None:
+            # prefer the node's advertised address (daemons export it
+            # to their workers): gethostbyname(gethostname()) resolves
+            # to 127.0.1.1 on stock Debian /etc/hosts, unreachable from
+            # other physical hosts
+            host = (os.environ.get("RTPU_NODE_ADVERTISE_HOST")
+                    or socket.gethostbyname(socket.gethostname()))
+        self.address: Tuple[str, int] = (host,
+                                         self._sock.getsockname()[1])
+        self._conn: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _ensure_accepted(self, timeout: Optional[float]) -> socket.socket:
+        with self._lock:
+            if self._conn is None:
+                self._sock.settimeout(timeout)
+                try:
+                    conn, _ = self._sock.accept()
+                except (socket.timeout, OSError):
+                    raise ChannelTimeoutError(
+                        "tcp channel writer never connected")
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conn = conn
+            return self._conn
+
+    def close(self) -> None:
+        with self._lock:
+            for s in (self._conn, self._sock):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            self._conn = None
+
+
+class TcpChannelReader:
+    """read(seq)/ack(seq) over the accepted connection.
+
+    Frames arrive in the writer's seq order; a seq-indexed buffer makes
+    reads ADDRESSABLE like the shm ring: out-of-order ``get()``s return
+    the right execution's value, and a timed-out read leaves the seq
+    re-readable (incoming bytes accumulate across calls — a partial
+    frame is never lost to a timeout). ``ack`` drops the buffered value
+    and returns one credit."""
+
+    owned_reads = True  # deserialization yields owned objects: the
+    # compiled loop may skip its defensive copy
+
+    def __init__(self, listener: TcpChannelListener):
+        self._listener = listener
+        self._rx = bytearray()
+        self._values: Dict[int, Any] = {}
+        self._next_seq = 0
+
+    def _pump(self, conn, timeout: Optional[float]) -> bool:
+        """Receive once, parse any completed frames; False on timeout."""
+        conn.settimeout(timeout)
+        try:
+            chunk = conn.recv(1 << 20)
+        except socket.timeout:
+            return False
+        except OSError:
+            raise ChannelTimeoutError("tcp channel connection lost")
+        if not chunk:
+            raise ChannelTimeoutError("tcp channel writer closed")
+        self._rx += chunk
+        while len(self._rx) >= _LEN.size:
+            (length,) = _LEN.unpack_from(self._rx)
+            end = _LEN.size + length
+            if len(self._rx) < end:
+                break
+            payload = bytes(self._rx[_LEN.size:end])
+            del self._rx[:end]
+            self._values[self._next_seq] = serialization.loads(payload)
+            self._next_seq += 1
+        return True
+
+    def read(self, seq: int, timeout: Optional[float] = 60.0) -> Any:
+        import time as _time
+        conn = self._listener._ensure_accepted(timeout)
+        deadline = (None if timeout is None
+                    else _time.monotonic() + timeout)
+        while seq not in self._values:
+            remaining = (None if deadline is None
+                         else deadline - _time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise ChannelTimeoutError(
+                    f"tcp channel read timed out at seq {seq}")
+            if not self._pump(conn, remaining):
+                raise ChannelTimeoutError(
+                    f"tcp channel read timed out at seq {seq}")
+        return self._values[seq]
+
+    def ack(self, seq: int) -> None:
+        self._values.pop(seq, None)
+        conn = self._listener._ensure_accepted(None)
+        try:
+            conn.sendall(b"\x01")  # one credit back to the writer
+        except OSError:
+            pass  # writer gone (teardown): nothing to backpressure
+
+    def close(self) -> None:
+        self._listener.close()
+
+
+class TcpChannelWriter:
+    """Writer-side fan-out: one connection per remote reader, with a
+    per-reader credit window of ``capacity``."""
+
+    def __init__(self, endpoints, capacity: int,
+                 connect_timeout: float = 30.0):
+        self._conns = []
+        self._credits = []
+        self._capacity = capacity
+        for host, port in endpoints:
+            sock = socket.create_connection((host, port),
+                                            timeout=connect_timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(sock)
+            self._credits.append(capacity)
+
+    def write(self, value: Any, seq: int,
+              timeout: Optional[float] = 60.0) -> None:
+        payload = serialization.dumps(value)
+        frame = _LEN.pack(len(payload)) + payload
+        for i, conn in enumerate(self._conns):
+            # consume acks to refill the credit window; block when empty
+            conn.settimeout(timeout)
+            while self._credits[i] <= 0:
+                try:
+                    acks = conn.recv(4096)
+                except socket.timeout:
+                    raise ChannelTimeoutError(
+                        f"tcp channel writer blocked at seq {seq}: "
+                        f"reader {i} not consuming")
+                except OSError:
+                    raise ChannelTimeoutError(
+                        f"tcp channel reader {i} disconnected")
+                if not acks:
+                    raise ChannelTimeoutError(
+                        f"tcp channel reader {i} closed")
+                self._credits[i] += len(acks)
+            # drain any queued acks opportunistically (non-blocking)
+            conn.setblocking(False)
+            try:
+                acks = conn.recv(4096)
+                if acks:
+                    self._credits[i] += len(acks)
+            except (BlockingIOError, OSError):
+                pass
+            conn.setblocking(True)
+            conn.settimeout(timeout)
+            try:
+                conn.sendall(frame)
+            except OSError:
+                raise ChannelTimeoutError(
+                    f"tcp channel send failed to reader {i}")
+            self._credits[i] -= 1
+
+    def close(self) -> None:
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# process-global registry: listeners created during the pre-install
+# step, adopted by the compiled loop when it starts (both run in the
+# same actor process via __ray_call__)
+_listener_registry: Dict[str, TcpChannelListener] = {}
+_registry_lock = threading.Lock()
+
+
+def create_listener(token: str) -> Tuple[str, int]:
+    """Called on the reader's actor via __ray_call__ before install."""
+    listener = TcpChannelListener()
+    with _registry_lock:
+        _listener_registry[token] = listener
+    return listener.address
+
+
+def adopt_listener(token: str) -> TcpChannelReader:
+    with _registry_lock:
+        listener = _listener_registry.pop(token)
+    return TcpChannelReader(listener)

@@ -75,13 +75,9 @@ class APPO(PPO):
                 break  # stall: surface it via the metrics below
             ref = ready[0]
             idx = self._inflight.pop(ref)
+            payload = None
             try:
-                cols, runner_metrics, delta = serialization.loads(
-                    ray_tpu.get(ref))
-                self.record_episodes(runner_metrics["episode_returns"])
-                batches.append(self._postprocess(cols, weights))
-                deltas.append(delta)
-                consumed += 1
+                payload = serialization.loads(ray_tpu.get(ref))
                 self._runner_failures[idx] = 0
             except Exception as exc:  # noqa: BLE001 — a failing runner
                 # must not silently leave the rotation NOR busy-spin:
@@ -104,12 +100,24 @@ class APPO(PPO):
                     self.runners[idx] = self._runner_actor_cls.remote(
                         self._runner_blobs[idx])
                     self._runner_failures[idx] = 0
+                    pushed.discard(idx)  # the fresh actor has
+                    # construction-time weights; it MUST get a push
             # resume sampling IMMEDIATELY; weights go once per runner
             # per step (they only change after the sgd below)
             if idx not in pushed:
                 self.runners[idx].set_weights.remote(weights)
                 pushed.add(idx)
             self._launch(idx)
+            if payload is None:
+                continue
+            # driver-side processing stays OUTSIDE the runner-failure
+            # handler: a postprocess bug must surface as itself, not
+            # kill healthy actors as misattributed "runner failures"
+            cols, runner_metrics, delta = payload
+            self.record_episodes(runner_metrics["episode_returns"])
+            batches.append(self._postprocess(cols, weights))
+            deltas.append(delta)
+            consumed += 1
         if batches:
             batch = concat_samples(batches)
             self._env_steps_lifetime += len(batch)

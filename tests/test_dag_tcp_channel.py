@@ -1,0 +1,113 @@
+"""Unit tests for the cross-node DAG channel transport
+(reference model: experimental/channel tests — bounded-buffer
+semantics over a P2P link)."""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.dag.channel import ChannelTimeoutError
+from ray_tpu.dag.tcp_channel import (
+    TcpChannelListener, TcpChannelReader, TcpChannelWriter,
+    adopt_listener, create_listener)
+
+
+def test_roundtrip_and_order():
+    listener = TcpChannelListener(host="127.0.0.1")
+    reader = TcpChannelReader(listener)
+    writer = TcpChannelWriter([listener.address], capacity=4)
+    try:
+        got = []
+        def consume():
+            for seq in range(8):
+                got.append(reader.read(seq, timeout=30))
+                reader.ack(seq)
+        t = threading.Thread(target=consume)
+        t.start()
+        for seq in range(8):
+            writer.write({"seq": seq, "blob": b"x" * 1000}, seq)
+        t.join(30)
+        assert [g["seq"] for g in got] == list(range(8))
+    finally:
+        writer.close()
+        reader.close()
+
+
+def test_capacity_backpressure():
+    """The writer must block after `capacity` unacked items."""
+    listener = TcpChannelListener(host="127.0.0.1")
+    reader = TcpChannelReader(listener)
+    writer = TcpChannelWriter([listener.address], capacity=2)
+    try:
+        # reader accepts the connection but consumes nothing yet
+        threading.Thread(target=lambda: reader.read(0, timeout=30),
+                         daemon=True).start()
+        time.sleep(0.2)
+        writer.write("a", 0)
+        writer.write("b", 1)
+        with pytest.raises(ChannelTimeoutError):
+            writer.write("c", 2, timeout=0.5)  # window full: blocks
+        reader.ack(0)  # one credit frees the window
+        writer.write("c", 2, timeout=10)
+    finally:
+        writer.close()
+        reader.close()
+
+
+def test_fanout_two_readers():
+    l1 = TcpChannelListener(host="127.0.0.1")
+    l2 = TcpChannelListener(host="127.0.0.1")
+    r1, r2 = TcpChannelReader(l1), TcpChannelReader(l2)
+    writer = TcpChannelWriter([l1.address, l2.address], capacity=4)
+    try:
+        out = {}
+        def consume(name, r):
+            vals = []
+            for seq in range(4):
+                vals.append(r.read(seq, timeout=30))
+                r.ack(seq)
+            out[name] = vals
+        ts = [threading.Thread(target=consume, args=("a", r1)),
+              threading.Thread(target=consume, args=("b", r2))]
+        for t in ts:
+            t.start()
+        for seq in range(4):
+            writer.write(seq * 10, seq)
+        for t in ts:
+            t.join(30)
+        assert out["a"] == out["b"] == [0, 10, 20, 30]
+    finally:
+        writer.close()
+        r1.close()
+        r2.close()
+
+
+def test_registry_create_adopt():
+    addr = create_listener("tok-1")
+    assert isinstance(addr, tuple) and addr[1] > 0
+    writer = TcpChannelWriter([("127.0.0.1", addr[1])], capacity=2)
+    reader = adopt_listener("tok-1")
+    try:
+        writer.write("hello", 0)
+        assert reader.read(0, timeout=10) == "hello"
+        reader.ack(0)
+    finally:
+        writer.close()
+        reader.close()
+
+
+def test_reader_disconnect_surfaces():
+    listener = TcpChannelListener(host="127.0.0.1")
+    reader = TcpChannelReader(listener)
+    writer = TcpChannelWriter([listener.address], capacity=1)
+    threading.Thread(target=lambda: reader.read(0, timeout=10),
+                     daemon=True).start()
+    time.sleep(0.2)
+    writer.write("x", 0)
+    reader.close()
+    time.sleep(0.2)
+    with pytest.raises(ChannelTimeoutError):
+        # window is full and the reader is gone: must error, not hang
+        writer.write("y", 1, timeout=2)
+    writer.close()
