@@ -7,17 +7,113 @@ vs_baseline = achieved MFU / 0.35 (BASELINE.json north star: Llama-2-7B
 fine-tune at >=35% MFU; on the single-chip CI device we run the largest
 Llama-architecture model that trains comfortably in HBM and report MFU
 against the same bar).
+
+Structure (hardened after round 2, where a wedged axon TPU tunnel made
+the bench hang/abort and the driver recorded `parsed: null`):
+
+- The PARENT process never initializes a JAX backend. It probes the TPU
+  backend in a short-lived subprocess, runs the real bench in a
+  subprocess with a watchdog + one retry, and on persistent TPU failure
+  falls back to a clean-CPU subprocess — so this script ALWAYS prints a
+  parseable JSON line, annotated with the TPU failure when degraded.
+- `python bench.py --inner` is the actual benchmark body (imports jax,
+  initializes whatever backend the env dictates).
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
-from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+def _cpu_env() -> dict:
+    """A copy of the env forcing a clean CPU JAX backend."""
+    from __graft_entry__ import cpu_mesh_env
+    return cpu_mesh_env(1)
+
+
+def _run_child(args, env, timeout_s):
+    """Run a child, return (ok, parsed_json_or_None, diagnostic_str)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + args,
+            env=env, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return False, None, f"timeout after {timeout_s}s"
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                break
+            ok = proc.returncode == 0
+            diag = "" if ok else (
+                f"rc={proc.returncode} after printing JSON: "
+                + (proc.stderr or "")[-300:].strip())
+            return ok, parsed, diag
+    tail = (proc.stdout or "")[-500:] + (proc.stderr or "")[-500:]
+    return False, None, f"rc={proc.returncode}: {tail.strip()[-600:]}"
+
+
+def _probe_tpu(timeout_s: int) -> str:
+    """'' if the TPU backend initializes in a child, else the failure."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS") and \
+            os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return "no TPU configured (JAX_PLATFORMS=cpu)"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print('PROBE_OK', len(d), jax.default_backend())"],
+            env=os.environ.copy(), timeout=timeout_s,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return (f"backend init hung >{timeout_s}s "
+                "(axon tunnel wedged)")
+    if proc.returncode != 0 or "PROBE_OK" not in proc.stdout:
+        return ("backend init failed: "
+                + (proc.stderr or proc.stdout).strip()[-400:])
+    return ""
+
+
+def main():
+    t_int = lambda k, d: int(os.environ.get(k, d))
+    probe_s = t_int("RTPU_BENCH_PROBE_TIMEOUT_S", "120")
+    run_s = t_int("RTPU_BENCH_TIMEOUT_S", "600")
+    retry_s = t_int("RTPU_BENCH_RETRY_TIMEOUT_S", "300")
+    cpu_s = t_int("RTPU_BENCH_CPU_TIMEOUT_S", "420")
+
+    tpu_error = _probe_tpu(probe_s)
+    if not tpu_error:
+        for timeout_s in (run_s, retry_s):
+            ok, parsed, diag = _run_child(
+                ["--inner"], os.environ.copy(), timeout_s)
+            if ok and parsed is not None:
+                print(json.dumps(parsed))
+                return
+            tpu_error = f"bench failed on TPU: {diag}"
+            sys.stderr.write(f"[bench] {tpu_error}; retrying\n")
+
+    # Degraded path: clean-CPU child so the driver still gets a line.
+    sys.stderr.write(f"[bench] falling back to CPU: {tpu_error}\n")
+    ok, parsed, diag = _run_child(["--inner"], _cpu_env(), cpu_s)
+    if ok and parsed is not None:
+        parsed["degraded"] = "cpu-fallback"
+        parsed["tpu_error"] = tpu_error
+        print(json.dumps(parsed))
+        return
+    # Last resort: a parseable line that says exactly what went wrong.
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+        "degraded": "no-backend",
+        "tpu_error": tpu_error, "cpu_error": diag,
+    }))
+
 
 # Peak bf16 FLOP/s per chip by TPU generation (public numbers).
 PEAK_FLOPS = {
@@ -38,7 +134,14 @@ def peak_flops(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
-def main():
+def inner():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
     devices = jax.devices()
     dev = devices[0]
     on_tpu = jax.default_backend() in ("tpu", "axon")
@@ -118,4 +221,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv:
+        inner()
+    else:
+        main()
