@@ -224,10 +224,10 @@ class ClientRuntime:
         if status == "inline":
             return serialization.unpack(reply["data"])
         if status == "pull":
-            from ray_tpu.core.object_transfer import pull_object
+            from ray_tpu.core.object_transfer import get_pull_manager
             for _attempt in range(3):
-                if not pull_object(tuple(reply["addr"]), oid,
-                                   self._pull_store):
+                if not get_pull_manager().pull(tuple(reply["addr"]), oid,
+                                               self._pull_store):
                     raise ObjectLostError(oid)
                 data = self._pull_store.take(oid)
                 if data is not None:

@@ -95,6 +95,10 @@ class Config:
     # Max concurrent inbound pulls an object server admits
     # (reference: pull_manager.h:50 admission control).
     object_pull_concurrency: int = 8
+    # Puller-side in-flight byte budget shared by all concurrent pulls
+    # in one process (reference: push_manager.h:28 in-flight chunk
+    # limit). A lone pull may exceed it so oversize objects still move.
+    object_pull_inflight_bytes: int = 256 * 1024 * 1024
 
     # --- GCS durability ---
     # Journal file for control-plane state (KV, jobs, functions): a new

@@ -28,7 +28,7 @@ from typing import Optional
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config, reset_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
-from ray_tpu.core.object_transfer import ObjectServer, pull_object
+from ray_tpu.core.object_transfer import ObjectServer
 from ray_tpu.core.protocol import (
     MessageConnection,
     connect_tcp,
@@ -278,7 +278,10 @@ class NodeDaemon:
         oid = ObjectID(payload["object_id"])
         addr = tuple(payload["addr"])
         out = {"kind": "OBJECT_VALUE", "req_id": payload.get("req_id")}
-        if pull_object(addr, oid, self.node.store):
+        from ray_tpu.core.object_transfer import (
+            PRIORITY_TASK_ARG, get_pull_manager)
+        if get_pull_manager().pull(addr, oid, self.node.store,
+                                   priority=PRIORITY_TASK_ARG):
             self.proxy.send({"kind": "REPLICA", "object_id": oid.binary()})
             out["status"] = "shm_local"
         else:

@@ -17,9 +17,11 @@ Wire protocol (framed messages, see protocol.py):
 
 from __future__ import annotations
 
+import heapq
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Optional, Tuple
 
 from ray_tpu.core.config import get_config
@@ -33,6 +35,154 @@ from ray_tpu.core.protocol import (
 )
 
 _LEN = struct.Struct("<I")
+
+# Pull priorities (reference: pull_manager.h:50 — task-argument fetches
+# outrank ray.get which outranks background/rebalance traffic).
+PRIORITY_TASK_ARG = 0
+PRIORITY_GET = 1
+PRIORITY_BACKGROUND = 2
+
+
+class _ByteBudget:
+    """Bounded in-flight transfer bytes (reference: push_manager.h:28
+    in-flight chunk limit). A single pull is always admitted when
+    nothing else is in flight, so an object larger than the budget can
+    still move; everyone else waits. TCP flow control provides the
+    backpressure while a puller waits (the server blocks in sendall)."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._used = 0
+        self._active = 0
+        self._cv = threading.Condition()
+
+    def charge(self, size: int, deadline_s: float = 30.0) -> None:
+        """Block until the charge fits (or nothing is in flight). The
+        deadline bounds starvation: an oversize object under sustained
+        small-pull traffic is eventually admitted over-budget rather
+        than holding its slot + socket forever — the budget is
+        backpressure, not a correctness invariant."""
+        deadline = time.monotonic() + deadline_s
+        with self._cv:
+            while (self._active > 0 and self._used + size > self.cap
+                   and time.monotonic() < deadline):
+                self._cv.wait(0.5)
+            self._used += size
+            self._active += 1
+
+    def release(self, size: int) -> None:
+        with self._cv:
+            self._used -= size
+            self._active -= 1
+            self._cv.notify_all()
+
+    @property
+    def inflight_bytes(self) -> int:
+        with self._cv:
+            return self._used
+
+
+class _PullFailed(Exception):
+    """One pull attempt failed on a (possibly transient) transport
+    error; retried by retry_call inside PullManager.pull."""
+
+
+class _PullNotFound(Exception):
+    """The holder definitively answered PULL_ERR — not retried."""
+
+
+class PullManager:
+    """Puller-side admission control: a bounded number of concurrent
+    pulls, admitted in priority order, with a shared in-flight byte
+    budget and bounded retry on transient failures.
+
+    Reference: src/ray/object_manager/pull_manager.h:50 (admission
+    control + prioritized pull queues) — the design here is simpler
+    because chunking/restore is handled by ``pull_object`` itself.
+    """
+
+    def __init__(self, max_concurrent: Optional[int] = None,
+                 max_inflight_bytes: Optional[int] = None):
+        cfg = get_config()
+        self._max = max_concurrent or cfg.object_pull_concurrency
+        self.budget = _ByteBudget(
+            max_inflight_bytes or cfg.object_pull_inflight_bytes)
+        self._cv = threading.Condition()
+        self._active = 0
+        self._seq = 0
+        self._waiting: list = []  # heap of (priority, seq)
+
+    def pull(self, addr: Tuple[str, int], object_id: ObjectID, dest_store,
+             *, priority: int = PRIORITY_GET, timeout: float = 30.0,
+             attempts: int = 3) -> bool:
+        # Admission wait is deadline-bounded like _ByteBudget.charge:
+        # after `timeout` of queueing (sustained higher-priority traffic
+        # or slot exhaustion), the pull proceeds over-cap rather than
+        # blocking its caller forever — the caps are backpressure, not
+        # correctness invariants.
+        deadline = time.monotonic() + max(timeout, 10.0)
+        with self._cv:
+            ticket = (priority, self._seq)
+            self._seq += 1
+            heapq.heappush(self._waiting, ticket)
+            while not (self._active < self._max
+                       and self._waiting[0] == ticket):
+                if time.monotonic() >= deadline:
+                    break
+                self._cv.wait(0.5)
+            self._waiting.remove(ticket)
+            heapq.heapify(self._waiting)
+            self._active += 1
+            # Another waiter may now be at the heap head with a free
+            # slot; wake the pack so it can claim it.
+            self._cv.notify_all()
+        try:
+            from ray_tpu.core.protocol import retry_call
+
+            def _attempt():
+                if dest_store.contains(object_id):
+                    return True
+                result = pull_object(addr, object_id, dest_store,
+                                     timeout=timeout, budget=self.budget)
+                if result:
+                    return True
+                if result is None:
+                    # Definitive server-side "not found" — retrying the
+                    # same holder only delays ObjectLostError upstream.
+                    raise _PullNotFound(object_id.hex())
+                raise _PullFailed(object_id.hex())
+
+            try:
+                return retry_call(_attempt, attempts=attempts,
+                                  backoff_s=0.05, retry_on=(_PullFailed,),
+                                  description=f"pull {object_id.hex()[:8]}")
+            except (_PullFailed, _PullNotFound):
+                return False
+        finally:
+            with self._cv:
+                self._active -= 1
+                self._cv.notify_all()
+
+
+_pull_manager: Optional[PullManager] = None
+_pull_manager_cfg = None
+_pull_manager_lock = threading.Lock()
+
+
+def get_pull_manager() -> PullManager:
+    """Process-wide PullManager (head runtime, node daemons, clients).
+
+    Rebuilt when the session config object changes (init's
+    ``system_config`` rebinds the module-global Config), so repeated
+    init/shutdown cycles in one process pick up new limits.
+    """
+    global _pull_manager, _pull_manager_cfg
+    cfg = get_config()
+    with _pull_manager_lock:
+        if _pull_manager is None or _pull_manager_cfg is not cfg:
+            _pull_manager = PullManager()
+            _pull_manager_cfg = cfg
+        return _pull_manager
 
 
 class ObjectServer:
@@ -156,11 +306,18 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 
 def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
-                timeout: float = 30.0) -> bool:
+                timeout: float = 30.0,
+                budget: Optional[_ByteBudget] = None) -> bool:
     """Pull one object from a remote ObjectServer into ``dest_store``.
 
-    Returns True on success. If another puller races us into the same
-    store (create -> EXISTS), wait for its seal instead of re-pulling.
+    Returns True on success, None when the holder definitively answers
+    PULL_ERR (object gone — don't retry this address), False on
+    transport errors (retryable). If another puller races us into the
+    same store (create -> EXISTS), wait for its seal instead of
+    re-pulling. With ``budget``, the transfer charges the object's size
+    against the shared in-flight byte budget after PULL_META reveals it
+    and before any chunk is read — while blocked, TCP flow control
+    backpressures the server.
     """
     if dest_store.contains(object_id):
         return True
@@ -168,6 +325,8 @@ def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
         sock = connect_tcp(addr[0], addr[1], timeout=timeout)
     except OSError:
         return False
+    charged = 0
+    created = False
     try:
         sock.settimeout(timeout)
         send_msg(sock, {"kind": "PULL", "object_id": object_id.binary()})
@@ -180,11 +339,21 @@ def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
             return False
         from ray_tpu.core import serialization
         meta = serialization.loads(meta_raw)
-        if meta.get("kind") != "PULL_META":
+        kind = meta.get("kind")
+        if kind == "PULL_ERR":
+            return None  # definitive: holder does not have the object
+        if kind != "PULL_META":
             return False
         size = meta["size"]
         try:
             dest = dest_store.create(object_id, size)
+            created = True
+            # Charge only once we own the transfer — the losing side of
+            # a concurrent-pull race waits on the winner's seal and must
+            # not hold budget while transferring nothing.
+            if budget is not None:
+                budget.charge(size, deadline_s=timeout)
+                charged = size
         except FileExistsError:
             # concurrent pull of the same object; wait for its seal
             buf = dest_store.get_buffer(object_id, timeout_s=timeout)
@@ -217,12 +386,19 @@ def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
         dest_store.seal(object_id)
         return True
     except OSError:
-        try:
-            dest_store.delete(object_id)
-        except Exception:  # noqa: BLE001
-            pass
+        # Only roll back an entry THIS call created — a concurrent
+        # puller may own an in-progress or sealed buffer for the same
+        # object (create raced to FileExistsError, or we failed before
+        # create), and deleting it would destroy their copy.
+        if created:
+            try:
+                dest_store.delete(object_id)
+            except Exception:  # noqa: BLE001
+                pass
         return False
     finally:
+        if budget is not None and charged:
+            budget.release(charged)
         try:
             sock.close()
         except OSError:

@@ -13,11 +13,13 @@ messages and small inline values.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
 
@@ -29,6 +31,86 @@ _LEN = struct.Struct("<I")
 # on an unknown/renamed message mid-stream. Bump on any incompatible
 # message-shape change.
 PROTOCOL_VERSION = 1
+
+
+# --- fault injection ---------------------------------------------------
+# Env-gated RPC chaos (reference: src/ray/rpc/rpc_chaos.h:24-46,
+# RAY_testing_rpc_failure / RAY_testing_asio_delay_us). Spec:
+#   RTPU_RPC_CHAOS="PULL=fail:2;HEARTBEAT=delay:50;*=fail:1"
+# ``KIND=fail:N`` makes the first N sends of that message kind raise
+# ConnectionResetError (simulating a dropped link mid-call); ``delay:MS``
+# sleeps before every matching send. ``*`` matches any kind. Counts are
+# per-process. Production cost when unset: one dict lookup per send.
+
+
+class _RpcChaos:
+    def __init__(self, spec: str):
+        self.delay_ms: Dict[str, float] = {}
+        self.fail_left: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            kind, _, action = part.partition("=")
+            what, _, arg = action.partition(":")
+            if what == "fail":
+                self.fail_left[kind] = int(arg or 1)
+            elif what == "delay":
+                self.delay_ms[kind] = float(arg or 0)
+
+    def on_send(self, kind: Optional[str]) -> None:
+        if kind is None:
+            kind = "?"
+        for k in (kind, "*"):
+            ms = self.delay_ms.get(k)
+            if ms:
+                time.sleep(ms / 1000.0)
+        with self._lock:
+            for k in (kind, "*"):
+                left = self.fail_left.get(k, 0)
+                if left > 0:
+                    self.fail_left[k] = left - 1
+                    raise ConnectionResetError(
+                        f"rpc chaos: injected failure for {kind!r}")
+
+
+_chaos: Optional[_RpcChaos] = None
+_chaos_spec: Optional[str] = None
+
+
+def _maybe_chaos(kind: Optional[str]) -> None:
+    global _chaos, _chaos_spec
+    spec = os.environ.get("RTPU_RPC_CHAOS")
+    if not spec:
+        if _chaos is not None:
+            _chaos = _chaos_spec = None
+        return
+    if spec != _chaos_spec:
+        _chaos_spec, _chaos = spec, _RpcChaos(spec)
+    _chaos.on_send(kind)
+
+
+def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
+               backoff_s: float = 0.05, max_backoff_s: float = 2.0,
+               retry_on: tuple = (OSError,),
+               description: str = "rpc") -> Any:
+    """Run ``fn`` with exponential backoff on transient transport errors.
+
+    For IDEMPOTENT calls only (reference:
+    src/ray/rpc/retryable_grpc_client.h — retries are the caller's
+    promise that the server can see the request twice). Re-raises the
+    last error once attempts are exhausted.
+    """
+    delay = backoff_s
+    for i in range(attempts):
+        try:
+            return fn()
+        except retry_on:
+            if i == attempts - 1:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, max_backoff_s)
 
 
 def _send_all(sock: socket.socket, data: bytes) -> None:
@@ -50,6 +132,7 @@ def _send_all(sock: socket.socket, data: bytes) -> None:
 def send_msg(sock: socket.socket, msg: dict) -> None:
     # Messages carry only framework structures and pre-serialized bytes
     # (user values are packed upstream), so the fast pickle path is safe.
+    _maybe_chaos(msg.get("kind"))
     data = serialization.dumps_fast(msg)
     _send_all(sock, _LEN.pack(len(data)) + data)
 
@@ -148,6 +231,7 @@ class MessageConnection:
         self._send_lock = threading.Lock()
 
     def send(self, msg: dict) -> None:
+        _maybe_chaos(msg.get("kind"))
         data = serialization.dumps_fast(msg)
         framed = _LEN.pack(len(data)) + data
         with self._send_lock:

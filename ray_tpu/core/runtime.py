@@ -1230,12 +1230,13 @@ class DriverRuntime:
             # (reference: PullManager-driven transfer, pull_manager.h:50).
             head = self.nodes.get(self.head_node_id)
             if head is not None:
-                from ray_tpu.core.object_transfer import pull_object
+                from ray_tpu.core.object_transfer import get_pull_manager
                 for nid in holders:
                     node = self.nodes.get(nid)
                     if node is None or not getattr(node, "is_remote", False):
                         continue
-                    if pull_object(node.object_addr, oid, head.store):
+                    if get_pull_manager().pull(node.object_addr, oid,
+                                               head.store):
                         self.add_object_replica(oid, self.head_node_id)
                         found, value = head.store.get_value(oid,
                                                             timeout_s=5.0)
@@ -1259,8 +1260,8 @@ class DriverRuntime:
         head = self.nodes.get(self.head_node_id)
         if (node is not None and getattr(node, "is_remote", False)
                 and head is not None):
-            from ray_tpu.core.object_transfer import pull_object
-            if pull_object(node.object_addr, oid, head.store):
+            from ray_tpu.core.object_transfer import get_pull_manager
+            if get_pull_manager().pull(node.object_addr, oid, head.store):
                 self.add_object_replica(oid, self.head_node_id)
                 found, value = head.store.get_value(oid, timeout_s=5.0)
                 if found:
@@ -1608,16 +1609,22 @@ class DriverRuntime:
         if loc is not None and loc.kind == "spilled":
             src = self.nodes.get(loc.node_id)
             if src is not None and getattr(src, "is_remote", False):
-                from ray_tpu.core.object_transfer import pull_object
-                return pull_object(src.object_addr, oid, dst_node.store)
+                from ray_tpu.core.object_transfer import (
+                    PRIORITY_TASK_ARG, get_pull_manager)
+                return get_pull_manager().pull(src.object_addr, oid,
+                                               dst_node.store,
+                                               priority=PRIORITY_TASK_ARG)
             return False  # local files are served via spilled_local
         for nid in self.object_holders(oid):
             src = self.nodes.get(nid)
             if src is None or nid == dst_node.node_id:
                 continue
             if getattr(src, "is_remote", False):
-                from ray_tpu.core.object_transfer import pull_object
-                if pull_object(src.object_addr, oid, dst_node.store):
+                from ray_tpu.core.object_transfer import (
+                    PRIORITY_TASK_ARG, get_pull_manager)
+                if get_pull_manager().pull(src.object_addr, oid,
+                                           dst_node.store,
+                                           priority=PRIORITY_TASK_ARG):
                     return True
                 continue
             buf = src.store.get_buffer(oid, timeout_s=2.0)

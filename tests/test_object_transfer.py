@@ -1,0 +1,261 @@
+"""Object-plane admission control + RPC retry/chaos.
+
+Covers reference capabilities: pull admission control with prioritized
+queues (reference: src/ray/object_manager/pull_manager.h:50), in-flight
+byte budget (reference: push_manager.h:28), retryable idempotent RPC
+(reference: src/ray/rpc/retryable_grpc_client.h), and env-gated fault
+injection (reference: src/ray/rpc/rpc_chaos.h:24-46).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu.core import object_transfer, protocol
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_transfer import (
+    PRIORITY_BACKGROUND,
+    PRIORITY_GET,
+    PRIORITY_TASK_ARG,
+    ObjectServer,
+    PullManager,
+    _ByteBudget,
+    pull_object,
+)
+
+
+class _Store:
+    """In-memory store satisfying both the ObjectServer source side
+    (get_buffer/release) and the pull destination side
+    (contains/create/seal/delete)."""
+
+    def __init__(self):
+        self._bufs = {}
+        self._sealed = {}
+        self._lock = threading.Lock()
+
+    def put(self, oid, payload: bytes):
+        with self._lock:
+            self._bufs[oid] = bytearray(payload)
+            self._sealed[oid] = True
+
+    def contains(self, oid):
+        with self._lock:
+            return self._sealed.get(oid, False)
+
+    def create(self, oid, size):
+        with self._lock:
+            if oid in self._bufs:
+                raise FileExistsError(oid.hex())
+            self._bufs[oid] = bytearray(size)
+            self._sealed[oid] = False
+            return memoryview(self._bufs[oid])
+
+    def seal(self, oid):
+        with self._lock:
+            self._sealed[oid] = True
+
+    def delete(self, oid):
+        with self._lock:
+            self._bufs.pop(oid, None)
+            self._sealed.pop(oid, None)
+
+    def get_buffer(self, oid, timeout_s=0.0):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if self._sealed.get(oid):
+                    return memoryview(self._bufs[oid])
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    def release(self, oid):
+        pass
+
+
+@pytest.fixture
+def server_store():
+    store = _Store()
+    server = ObjectServer(lambda oid: store if store.contains(oid) else None)
+    yield server, store
+    server.stop()
+
+
+def test_pull_roundtrip(server_store):
+    server, store = server_store
+    oid = ObjectID.from_random()
+    payload = os.urandom(2 * 1024 * 1024 + 17)
+    store.put(oid, payload)
+    dest = _Store()
+    assert pull_object(server.address, oid, dest)
+    buf = dest.get_buffer(oid, timeout_s=1.0)
+    assert bytes(buf) == payload
+
+
+def test_byte_budget_invariant():
+    """Concurrent charges never exceed the cap (except a lone oversize
+    charge), and waiters make progress."""
+    budget = _ByteBudget(16 * 1024 * 1024)
+    peak = [0]
+    peak_lock = threading.Lock()
+
+    def worker():
+        for _ in range(5):
+            budget.charge(8 * 1024 * 1024)
+            with peak_lock:
+                peak[0] = max(peak[0], budget.inflight_bytes)
+            time.sleep(0.002)
+            budget.release(8 * 1024 * 1024)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert peak[0] <= 16 * 1024 * 1024
+    assert budget.inflight_bytes == 0
+
+
+def test_byte_budget_oversize_admitted_alone():
+    budget = _ByteBudget(1024)
+    budget.charge(10_000)  # must not deadlock: sole pull always admitted
+    assert budget.inflight_bytes == 10_000
+    budget.release(10_000)
+    assert budget.inflight_bytes == 0
+
+
+def test_pull_manager_budget_respected(server_store):
+    """N concurrent pulls of real objects keep in-flight bytes under the
+    budget (VERDICT round-2 item 7 done-criterion, scaled down)."""
+    server, store = server_store
+    size = 4 * 1024 * 1024
+    oids = []
+    for _ in range(6):
+        oid = ObjectID.from_random()
+        store.put(oid, os.urandom(size))
+        oids.append(oid)
+    mgr = PullManager(max_concurrent=6, max_inflight_bytes=2 * size)
+    peak = [0]
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            peak[0] = max(peak[0], mgr.budget.inflight_bytes)
+            time.sleep(0.0005)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+    results = [None] * len(oids)
+    dests = [_Store() for _ in oids]
+
+    def do_pull(i):
+        results[i] = mgr.pull(server.address, oids[i], dests[i],
+                              priority=PRIORITY_GET)
+
+    threads = [threading.Thread(target=do_pull, args=(i,))
+               for i in range(len(oids))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    sampler_t.join()
+    assert all(results)
+    assert peak[0] <= 2 * size
+
+
+def test_pull_manager_priority_order(monkeypatch):
+    """With one slot busy, a later TASK_ARG pull is admitted before an
+    earlier-queued BACKGROUND pull."""
+    order = []
+    release_first = threading.Event()
+    entered_first = threading.Event()
+
+    def fake_pull(addr, oid, dest, timeout=30.0, budget=None):
+        if not entered_first.is_set():
+            entered_first.set()
+            release_first.wait(5.0)
+        order.append(oid)
+        return True
+
+    monkeypatch.setattr(object_transfer, "pull_object", fake_pull)
+    mgr = PullManager(max_concurrent=1)
+    dest = _Store()
+    oid_hold, oid_bg, oid_arg = (ObjectID.from_random() for _ in range(3))
+    threads = [threading.Thread(
+        target=mgr.pull, args=(("h", 0), oid_hold, dest),
+        kwargs={"priority": PRIORITY_GET})]
+    threads[0].start()
+    assert entered_first.wait(5.0)
+    # Queue background first, then task-arg; both wait on the one slot.
+    threads.append(threading.Thread(
+        target=mgr.pull, args=(("h", 0), oid_bg, dest),
+        kwargs={"priority": PRIORITY_BACKGROUND}))
+    threads[1].start()
+    time.sleep(0.1)
+    threads.append(threading.Thread(
+        target=mgr.pull, args=(("h", 0), oid_arg, dest),
+        kwargs={"priority": PRIORITY_TASK_ARG}))
+    threads[2].start()
+    time.sleep(0.1)
+    release_first.set()
+    for t in threads:
+        t.join(10.0)
+    assert order == [oid_hold, oid_arg, oid_bg]
+
+
+def test_retry_call_recovers():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("transient")
+        return "ok"
+
+    assert protocol.retry_call(flaky, attempts=4, backoff_s=0.001) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_call_exhausts():
+    def always_down():
+        raise ConnectionResetError("down")
+
+    with pytest.raises(ConnectionResetError):
+        protocol.retry_call(always_down, attempts=2, backoff_s=0.001)
+
+
+def test_chaos_injected_pull_failure_recovered(server_store, monkeypatch):
+    """RTPU_RPC_CHAOS drops the first two PULL sends; the PullManager's
+    bounded retry still lands the object (reference: rpc_chaos.h +
+    retryable_grpc_client.h interplay)."""
+    server, store = server_store
+    oid = ObjectID.from_random()
+    payload = os.urandom(128 * 1024)
+    store.put(oid, payload)
+    monkeypatch.setenv("RTPU_RPC_CHAOS", "PULL=fail:2")
+    try:
+        mgr = PullManager(max_concurrent=2)
+        dest = _Store()
+        assert mgr.pull(server.address, oid, dest, attempts=3)
+        assert bytes(dest.get_buffer(oid, timeout_s=1.0)) == payload
+    finally:
+        monkeypatch.delenv("RTPU_RPC_CHAOS")
+        protocol._maybe_chaos(None)  # reset cached spec
+
+
+def test_chaos_delay(monkeypatch):
+    monkeypatch.setenv("RTPU_RPC_CHAOS", "PING=delay:30")
+    try:
+        t0 = time.perf_counter()
+        protocol._maybe_chaos("PING")
+        assert time.perf_counter() - t0 >= 0.025
+        t0 = time.perf_counter()
+        protocol._maybe_chaos("OTHER")
+        assert time.perf_counter() - t0 < 0.02
+    finally:
+        monkeypatch.delenv("RTPU_RPC_CHAOS")
+        protocol._maybe_chaos(None)
