@@ -49,7 +49,7 @@ def _run_child(args, env, timeout_s):
             try:
                 parsed = json.loads(line)
             except ValueError:
-                break
+                continue  # stray '{'-prefixed noise; keep scanning up
             ok = proc.returncode == 0
             diag = "" if ok else (
                 f"rc={proc.returncode} after printing JSON: "
@@ -89,14 +89,16 @@ def main():
 
     tpu_error = _probe_tpu(probe_s)
     if not tpu_error:
-        for timeout_s in (run_s, retry_s):
+        timeouts = (run_s, retry_s)
+        for i, timeout_s in enumerate(timeouts):
             ok, parsed, diag = _run_child(
                 ["--inner"], os.environ.copy(), timeout_s)
             if ok and parsed is not None:
                 print(json.dumps(parsed))
                 return
             tpu_error = f"bench failed on TPU: {diag}"
-            sys.stderr.write(f"[bench] {tpu_error}; retrying\n")
+            suffix = "; retrying" if i < len(timeouts) - 1 else ""
+            sys.stderr.write(f"[bench] {tpu_error}{suffix}\n")
 
     # Degraded path: clean-CPU child so the driver still gets a line.
     sys.stderr.write(f"[bench] falling back to CPU: {tpu_error}\n")

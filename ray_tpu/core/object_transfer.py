@@ -307,7 +307,7 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
 
 def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
                 timeout: float = 30.0,
-                budget: Optional[_ByteBudget] = None) -> bool:
+                budget: Optional[_ByteBudget] = None) -> Optional[bool]:
     """Pull one object from a remote ObjectServer into ``dest_store``.
 
     Returns True on success, None when the holder definitively answers

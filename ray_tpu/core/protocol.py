@@ -77,6 +77,7 @@ class _RpcChaos:
 
 _chaos: Optional[_RpcChaos] = None
 _chaos_spec: Optional[str] = None
+_chaos_build_lock = threading.Lock()
 
 
 def _maybe_chaos(kind: Optional[str]) -> None:
@@ -84,11 +85,18 @@ def _maybe_chaos(kind: Optional[str]) -> None:
     spec = os.environ.get("RTPU_RPC_CHAOS")
     if not spec:
         if _chaos is not None:
-            _chaos = _chaos_spec = None
+            with _chaos_build_lock:
+                _chaos = _chaos_spec = None
         return
-    if spec != _chaos_spec:
-        _chaos_spec, _chaos = spec, _RpcChaos(spec)
-    _chaos.on_send(kind)
+    chaos = _chaos
+    if spec != _chaos_spec or chaos is None:
+        # Build under a lock so concurrent first senders don't replace
+        # a live instance and reset its fail counters.
+        with _chaos_build_lock:
+            if spec != _chaos_spec or _chaos is None:
+                _chaos_spec, _chaos = spec, _RpcChaos(spec)
+            chaos = _chaos
+    chaos.on_send(kind)
 
 
 def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
@@ -102,13 +110,17 @@ def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
     promise that the server can see the request twice). Re-raises the
     last error once attempts are exhausted.
     """
+    import logging
     delay = backoff_s
     for i in range(attempts):
         try:
             return fn()
-        except retry_on:
+        except retry_on as err:
             if i == attempts - 1:
                 raise
+            logging.getLogger("ray_tpu.rpc").debug(
+                "%s failed (%s), retry %d/%d in %.2fs",
+                description, err, i + 1, attempts - 1, delay)
             time.sleep(delay)
             delay = min(delay * 2, max_backoff_s)
 

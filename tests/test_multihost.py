@@ -135,6 +135,31 @@ def test_nested_submission_from_remote(tcp_cluster):
         _kill_daemon(proc)
 
 
+def test_object_transfer_survives_rpc_chaos(tcp_cluster, monkeypatch):
+    """With RTPU_RPC_CHAOS dropping the first PULL sends in the head
+    process, a cross-node object transfer still completes through the
+    PullManager's bounded retry (reference: rpc_chaos.h:24-46 +
+    retryable_grpc_client.h)."""
+    from ray_tpu.core import protocol
+
+    node_id, proc = tcp_cluster.add_remote_node(
+        num_cpus=2, resources={"spot": 1.0})
+    monkeypatch.setenv("RTPU_RPC_CHAOS", "PULL=fail:2")
+    try:
+        @ray_tpu.remote(resources={"spot": 0.1})
+        def produce():
+            return np.arange(500_000, dtype=np.float64)  # ~4 MiB -> pull
+
+        # The head pulls the remote result; the first two PULL sends in
+        # this process raise injected ConnectionResetError.
+        out = ray_tpu.get(produce.remote(), timeout=60)
+        assert out[-1] == 499_999.0
+    finally:
+        monkeypatch.delenv("RTPU_RPC_CHAOS", raising=False)
+        protocol._maybe_chaos(None)  # drop cached chaos spec
+        _kill_daemon(proc)
+
+
 def test_daemon_process_kill_retries_elsewhere(tcp_cluster):
     """Kill the remote node PROCESS mid-task; the head detects the death
     (connection drop / missed heartbeats) and retries the task, which
