@@ -341,11 +341,35 @@ class Gcs:
             return self.placement_groups.get(pg_id)
 
     # --- task events (observability) -----------------------------------
-    def add_task_event(self, event: TaskEvent) -> None:
+    def add_task_event(self, event) -> None:
+        """Append one task event — either a TaskEvent or the hot-path
+        tuple layout (task_id, name, state, timestamp, node_id,
+        worker_id, error, duration, parent_task_id). Tuples avoid
+        dataclass construction on the submit/complete hot path (3
+        events/task; reference batches via task_event_buffer.h:297) and
+        are materialized lazily in list_task_events."""
         if get_config().task_events_enabled:
             with self.lock:  # readers list() the deque concurrently
                 self.task_events.append(event)
 
+    def add_task_events(self, events) -> None:
+        """Batch append (one lock) — see add_task_event for the layout."""
+        if get_config().task_events_enabled:
+            with self.lock:
+                self.task_events.extend(events)
+
     def list_task_events(self, limit: int = 1000) -> List[TaskEvent]:
         with self.lock:  # appends during iteration raise RuntimeError
-            return list(self.task_events)[-limit:]
+            raw = list(self.task_events)[-limit:]
+        out: List[TaskEvent] = []
+        for ev in raw:
+            if type(ev) is tuple:
+                (task_id, name, state, ts, node_id, worker_id, error,
+                 duration, parent_task_id) = ev
+                ev = TaskEvent(task_id=task_id, name=name, state=state,
+                               node_id=node_id, worker_id=worker_id,
+                               error=error, duration=duration,
+                               parent_task_id=parent_task_id)
+                ev.timestamp = ts
+            out.append(ev)
+        return out

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import hashlib
 import threading
+import weakref
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization
@@ -17,6 +18,18 @@ from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import Arg, SchedulingStrategy, TaskSpec
+
+# Bound lazily on first use: remote_function is imported during package
+# init before ray_tpu.core.runtime finishes loading.
+_runtime_get = None
+
+
+def _get_runtime():
+    global _runtime_get
+    if _runtime_get is None:
+        from ray_tpu.core.runtime import get_runtime
+        _runtime_get = get_runtime
+    return _runtime_get()
 
 
 def resources_from_options(options: Dict[str, Any],
@@ -123,11 +136,13 @@ class RemoteFunction:
         (packaged once per runtime — uploads are content-addressed so
         re-normalizing after re-init is cheap) merged over the
         submitting worker's own env (child tasks inherit)."""
-        import weakref
+        explicit = self._options.get("runtime_env")
+        inherited = getattr(rt, "current_runtime_env", None)
+        if explicit is None and not inherited:
+            return (None, "")  # hot path: no env anywhere
         from ray_tpu.runtime_env import (merge_runtime_envs,
                                          normalize_runtime_env,
                                          runtime_env_hash)
-        explicit = self._options.get("runtime_env")
         if explicit is not None:
             with self._lock:
                 cached_rt = (self._norm_env_with()
@@ -136,8 +151,7 @@ class RemoteFunction:
                     self._norm_env = normalize_runtime_env(explicit, rt)
                     self._norm_env_with = weakref.ref(rt)
                 explicit = self._norm_env
-        env = merge_runtime_envs(
-            getattr(rt, "current_runtime_env", None), explicit)
+        env = merge_runtime_envs(inherited, explicit)
         return (env, runtime_env_hash(env)) if env else (None, "")
 
     @property
@@ -154,7 +168,6 @@ class RemoteFunction:
             cached = (self._registered_with()
                       if self._registered_with is not None else None)
             if cached is not runtime:  # weakref: id() could be recycled
-                import weakref
                 runtime.put_function(self._function_id, self._blob)
                 self._registered_with = weakref.ref(runtime)
             return self._function_id
@@ -174,8 +187,7 @@ class RemoteFunction:
         return FunctionNode(self, args, kwargs)
 
     def remote(self, *args, **kwargs):
-        from ray_tpu.core import runtime as runtime_mod
-        rt = runtime_mod.get_runtime()
+        rt = _get_runtime()
         function_id = self._ensure_registered(rt)
         opts = self._options
         num_returns = opts.get("num_returns", 1)

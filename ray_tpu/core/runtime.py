@@ -26,7 +26,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import MemoryStore
 from ray_tpu.core.scheduler import ClusterScheduler
 from ray_tpu.core.task_manager import ObjectLocation, ReferenceCounter, TaskManager
-from ray_tpu.core.task_spec import TaskEvent, TaskSpec
+from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -163,6 +163,12 @@ class DriverRuntime:
         # read by the autoscaler's demand export (reference:
         # gcs_autoscaler_state_manager.h pending-demand reporting)
         self._backlog_view: List[TaskSpec] = []
+        # Fast-dispatch lease cache: resource-shape -> last node that
+        # granted it (reference: owner-side lease caching per resource
+        # shape, normal_task_submitter.cc:499). try_acquire on the
+        # cached node skips the full pick_node scan on the hot path;
+        # a failed acquire falls back and refreshes the entry.
+        self._dispatch_cache: Dict[tuple, NodeID] = {}
         self._sched_thread = threading.Thread(
             target=self._scheduling_loop, name="scheduler", daemon=True)
         # objects replicated beyond their primary location by node-to-node
@@ -621,8 +627,8 @@ class DriverRuntime:
             self.create_actor(spec)
             return
         self.task_manager.add_pending(spec)
-        self._record_event(spec, "PENDING")
         if spec.actor_id is not None and not spec.is_actor_creation:
+            self._record_event(spec, "PENDING")
             self._route_actor_task(spec)
             return
         deps = [d for d in spec.dependencies()
@@ -632,11 +638,15 @@ class DriverRuntime:
             # free (reference: owner-to-worker direct push with cached
             # leases, normal_task_submitter.cc:499 — the scheduler
             # thread only handles contention/backlog). Two thread hops
-            # fewer per task on the hot path.
+            # fewer per task on the hot path. The PENDING event is
+            # elided on this path (SCHEDULED subsumes it — reference
+            # samples task events too, task_event_buffer.h:297).
             if self._try_fast_dispatch(spec):
                 return
+            self._record_event(spec, "PENDING")
             self._enqueue(spec)
             return
+        self._record_event(spec, "PENDING")
         remaining = [len(deps)]
         lock = threading.Lock()
 
@@ -653,14 +663,28 @@ class DriverRuntime:
     def _try_fast_dispatch(self, spec: TaskSpec) -> bool:
         if self._schedulable or self._backlog_view:
             return False  # don't jump ahead of parked work
-        try:
-            node_id = self.scheduler.pick_node(spec,
-                                               preferred=self.head_node_id)
-        except ValueError:
-            return False  # infeasible: let the slow path park it
-        if node_id is None or not self.scheduler.try_acquire(
-                node_id, self._spec_resources(spec)):
-            return False
+        strategy = spec.strategy
+        cache_key = None
+        node_id = None
+        if strategy.kind == "DEFAULT" and not strategy.labels:
+            cache_key = tuple(sorted(spec.resources.items()))
+            cached = self._dispatch_cache.get(cache_key)
+            if cached is not None and self.scheduler.try_acquire(
+                    cached, spec.resources):
+                node_id = cached
+        if node_id is None:
+            try:
+                node_id = self.scheduler.pick_node(
+                    spec, preferred=self.head_node_id)
+            except ValueError:
+                return False  # infeasible: let the slow path park it
+            if node_id is None or not self.scheduler.try_acquire(
+                    node_id, self._spec_resources(spec)):
+                if cache_key is not None:
+                    self._dispatch_cache.pop(cache_key, None)
+                return False
+            if cache_key is not None:
+                self._dispatch_cache[cache_key] = node_id
         node = self.nodes.get(node_id)
         if node is None:
             self.scheduler.release(node_id, self._spec_resources(spec))
@@ -935,11 +959,11 @@ class DriverRuntime:
             self._pin_contained(oid, contained)
             if kind == "inline":
                 self.memory_store.put(oid, ("packed", bytes(data)))
-                self.task_manager.set_location(oid, ObjectLocation("memory"))
+                self.task_manager.set_location_and_ready(
+                    oid, ObjectLocation("memory"))
             else:
-                self.task_manager.set_location(
+                self.task_manager.set_location_and_ready(
                     oid, ObjectLocation("shm", node.node_id))
-            self.task_manager.mark_object_ready(oid)
             # fire-and-forget caller may have dropped the result ref
             # already; reclaim after the borrow grace window (checked
             # under the counter lock — races with REF_ADD are safe).
@@ -1923,15 +1947,14 @@ class DriverRuntime:
                       worker_id=None, timestamp: Optional[float] = None,
                       duration: Optional[float] = None,
                       name: Optional[str] = None) -> None:
-        event = TaskEvent(
-            task_id=spec.task_id,
-            name=name or spec.name or spec.function_id,
-            state=state, node_id=node_id, error=error,
-            worker_id=worker_id, duration=duration,
-            parent_task_id=spec.parent_task_id)
-        if timestamp is not None:
-            event.timestamp = timestamp
-        self.gcs.add_task_event(event)
+        if not get_config().task_events_enabled:
+            return
+        # Tuple layout (see Gcs.add_task_event): no dataclass
+        # construction on the hot path.
+        self.gcs.add_task_event((
+            spec.task_id, name or spec.name or spec.function_id, state,
+            time.time() if timestamp is None else timestamp,
+            node_id, worker_id, error, duration, spec.parent_task_id))
 
     def _record_execution_events(self, spec: TaskSpec, node: Node,
                                  worker, msg: dict, state: str,
@@ -1939,22 +1962,28 @@ class DriverRuntime:
         """Record worker-timed RUNNING + user PROFILE spans + the final
         state for one executed task (timestamps come from the worker so
         the timeline reflects true execution windows, reference:
-        task_event_buffer.h:297 + profile_event.cc)."""
+        task_event_buffer.h:297 + profile_event.cc). All events for the
+        task are appended under one GCS lock acquisition."""
+        if not get_config().task_events_enabled:
+            return
         worker_id = worker.worker_id if worker is not None else None
         t_start, t_end = msg.get("t_start"), msg.get("t_end")
+        name = spec.name or spec.function_id
+        node_id = node.node_id
+        parent = spec.parent_task_id
+        events = []
         if t_start is not None:
-            self._record_event(spec, "RUNNING", node_id=node.node_id,
-                               worker_id=worker_id, timestamp=t_start,
-                               duration=((t_end - t_start)
-                                         if t_end else None))
+            events.append((spec.task_id, name, "RUNNING", t_start,
+                           node_id, worker_id, None,
+                           (t_end - t_start) if t_end else None, parent))
         for span in msg.get("profile", ()):
             span_name, s0, s1 = span
-            self._record_event(spec, "PROFILE", node_id=node.node_id,
-                               worker_id=worker_id, timestamp=s0,
-                               duration=s1 - s0, name=span_name)
-        self._record_event(spec, state, node_id=node.node_id,
-                           worker_id=worker_id, timestamp=t_end,
-                           error=error)
+            events.append((spec.task_id, span_name, "PROFILE", s0,
+                           node_id, worker_id, None, s1 - s0, parent))
+        events.append((spec.task_id, name, state,
+                       time.time() if t_end is None else t_end,
+                       node_id, worker_id, error, None, parent))
+        self.gcs.add_task_events(events)
 
     def shutdown(self) -> None:
         self._stopped.set()

@@ -151,7 +151,19 @@ class TaskManager:
         self._pending: Dict[TaskID, PendingTask] = {}
         self._object_to_task: Dict[ObjectID, TaskID] = {}
         self._locations: Dict[ObjectID, ObjectLocation] = {}
-        self._object_ready: Dict[ObjectID, threading.Event] = {}
+        # Readiness is a set + one shared condition instead of one
+        # threading.Event per object: Event construction (lock+condvar)
+        # was a top entry in the task-throughput profile, and the common
+        # case (pipelined submit, result consumed as it lands) rarely
+        # waits. notify_all fires only while a waiter is registered
+        # (reference: memory_store.h:48 GetAsync callback design).
+        self._ready_objects: Set[ObjectID] = set()
+        self._ready_cond = threading.Condition(self._lock)
+        # Object ids some thread is currently blocked on (value =
+        # waiter count): completions notify only when THEIR object is
+        # being waited for, so a getter blocked on a late ref is not
+        # woken O(backlog) times while unrelated tasks finish.
+        self._waited: Dict[ObjectID, int] = {}
         self._ready_callbacks: Dict[ObjectID, List[Callable[[], None]]] = {}
         # Failed objects: get() raises the stored error.
         self._errors: Dict[ObjectID, Exception] = {}
@@ -223,35 +235,60 @@ class TaskManager:
             return self._object_to_task.get(object_id)
 
     def mark_object_ready(self, object_id: ObjectID) -> None:
+        self.set_location_and_ready(object_id, None)
+
+    def set_location_and_ready(self, object_id: ObjectID,
+                               location: Optional[ObjectLocation]) -> None:
+        """Record the primary-copy location and flip readiness under ONE
+        lock acquisition — this pair runs once per task result on the
+        completion hot path."""
         with self._lock:
-            ev = self._object_ready.get(object_id)
-            callbacks = self._ready_callbacks.pop(object_id, [])
-            if ev is None:
-                ev = threading.Event()
-                self._object_ready[object_id] = ev
-        ev.set()
-        for cb in callbacks:
-            try:
-                cb()
-            except Exception:
-                pass
+            if location is not None:
+                self._locations[object_id] = location
+            self._ready_objects.add(object_id)
+            callbacks = self._ready_callbacks.pop(object_id, None)
+            if self._waited and object_id in self._waited:
+                self._ready_cond.notify_all()
+        if callbacks:
+            for cb in callbacks:
+                try:
+                    cb()
+                except Exception:
+                    pass
 
     def is_ready(self, object_id: ObjectID) -> bool:
         with self._lock:
-            ev = self._object_ready.get(object_id)
-            return ev is not None and ev.is_set()
+            return object_id in self._ready_objects
 
     def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
-        with self._lock:
-            ev = self._object_ready.setdefault(object_id, threading.Event())
-        return ev.wait(timeout)
+        with self._ready_cond:
+            if object_id in self._ready_objects:
+                return True
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            self._waited[object_id] = self._waited.get(object_id, 0) + 1
+            try:
+                while object_id not in self._ready_objects:
+                    if deadline is None:
+                        self._ready_cond.wait()
+                    else:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return False
+                        self._ready_cond.wait(remaining)
+                return True
+            finally:
+                left = self._waited.get(object_id, 1) - 1
+                if left <= 0:
+                    self._waited.pop(object_id, None)
+                else:
+                    self._waited[object_id] = left
 
     def on_ready(self, object_id: ObjectID, callback: Callable[[], None]) -> None:
         """Invoke callback when object becomes ready (immediately if it is)."""
         fire = False
         with self._lock:
-            ev = self._object_ready.get(object_id)
-            if ev is not None and ev.is_set():
+            if object_id in self._ready_objects:
                 fire = True
             else:
                 self._ready_callbacks.setdefault(object_id, []).append(callback)
@@ -270,13 +307,13 @@ class TaskManager:
         get()/dep-waits block until the re-executed producer completes
         (reference: object_recovery_manager.h:41)."""
         with self._lock:
-            self._object_ready.pop(object_id, None)
+            self._ready_objects.discard(object_id)
             self._locations.pop(object_id, None)
             self._errors.pop(object_id, None)
 
     def forget_object(self, object_id: ObjectID) -> None:
         with self._lock:
             self._locations.pop(object_id, None)
-            self._object_ready.pop(object_id, None)
+            self._ready_objects.discard(object_id)
             self._errors.pop(object_id, None)
             self._object_to_task.pop(object_id, None)

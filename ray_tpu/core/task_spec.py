@@ -72,7 +72,24 @@ class TaskSpec:
     parent_task_id: Optional[TaskID] = None
 
     def return_ids(self) -> List[ObjectID]:
-        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+        """Derived return ObjectIDs (cached — callers must not mutate).
+
+        Called several times per task on the submit/complete hot path;
+        each derivation is a sha1, so memoize per spec instance.
+        """
+        cached = self.__dict__.get("_return_ids_cache")
+        if cached is None:
+            cached = [ObjectID.for_task_return(self.task_id, i)
+                      for i in range(self.num_returns)]
+            self.__dict__["_return_ids_cache"] = cached
+        return cached
+
+    def __getstate__(self):
+        # Don't ship the derived-ID cache over the wire: each side
+        # re-derives lazily, and specs cross a socket once per dispatch.
+        state = dict(self.__dict__)
+        state.pop("_return_ids_cache", None)
+        return state
 
     def dependencies(self) -> List[ObjectID]:
         deps = [a.object_id for a in self.args if a.object_id is not None]
@@ -80,7 +97,7 @@ class TaskSpec:
         return deps
 
 
-@dataclass
+@dataclass(slots=True)
 class TaskEvent:
     """Observability record for one task state transition
     (reference: src/ray/core_worker/task_event_buffer.h:297)."""

@@ -29,14 +29,41 @@ def bench_trivial_tasks(rt, n: int) -> dict:
     def nop():
         return None
 
-    # warmup: spin the worker pool up
-    ray_tpu.get([nop.remote() for _ in range(20)])
+    # warmup: spin the worker pool up, prime dispatch/lease caches and
+    # worker pipelining (sustained throughput, not ramp, is the metric)
+    ray_tpu.get([nop.remote() for _ in range(1000)])
     t0 = time.perf_counter()
     refs = [nop.remote() for _ in range(n)]
     ray_tpu.get(refs)
     dt = time.perf_counter() - t0
     return {"bench": "trivial_tasks", "n": n, "seconds": round(dt, 3),
             "per_second": _rate(n, dt)}
+
+
+def bench_deep_backlog(rt, n: int) -> dict:
+    """Throughput with every task queued up-front (reference envelope:
+    1M+ queued per node without collapse, release/benchmarks/README.md:32).
+
+    ``per_second`` is the HONEST end-to-end rate n/(submit start ->
+    last completion); completions overlap the submit phase, so a
+    phase-sliced "drain rate" would double-count early completions and
+    overstate throughput. ``submit_per_second`` isolates the owner-side
+    submission leg."""
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(1000)])
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(n)]
+    t1 = time.perf_counter()
+    ray_tpu.get(refs)
+    t2 = time.perf_counter()
+    return {"bench": "deep_backlog", "n": n,
+            "submit_per_second": _rate(n, t1 - t0),
+            "per_second": _rate(n, t2 - t0)}
 
 
 def bench_task_sync_latency(rt, n: int) -> dict:
@@ -126,18 +153,20 @@ def bench_put_get_1mb(rt, n: int) -> dict:
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--tasks", type=int, default=2000)
+    parser.add_argument("--tasks", type=int, default=20000)
+    parser.add_argument("--backlog", type=int, default=100000)
     parser.add_argument("--sync-tasks", type=int, default=300)
     parser.add_argument("--actor-calls", type=int, default=2000)
     parser.add_argument("--puts", type=int, default=1000)
     args = parser.parse_args(argv)
 
     import ray_tpu
-    rt = ray_tpu.init(num_cpus=8, ignore_reinit_error=True,
+    rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,
                       system_config={"log_to_driver": False})
     results = []
     for fn, n in (
         (bench_trivial_tasks, args.tasks),
+        (bench_deep_backlog, args.backlog),
         (bench_task_sync_latency, args.sync_tasks),
         (bench_actor_calls, args.actor_calls),
         (bench_actor_sync, args.sync_tasks),
