@@ -299,6 +299,11 @@ def reserve_tpu_slice(topology: str, accelerator_type: str,
             "ray.io/tpu-pod-type": pod_type,
         }])
     if not pg.ready(timeout=timeout):
+        # The PG queued (creation never fails fast now) — cancel it, or
+        # the abandoned gang would reserve a slice head later with no
+        # owner to release it.
+        from ray_tpu.util.placement_group import remove_placement_group
+        remove_placement_group(pg)
         raise TimeoutError(
             f"failed to reserve a TPU slice head for pod type {pod_type}")
     try:

@@ -92,10 +92,25 @@ class StandardAutoscaler:
                 # unplaceable on any type: permanently infeasible, skip
             to_launch = [nt for _, nt in virtual]
 
-        # cap burst size by upscaling_speed
+        # cap burst size by upscaling_speed (task demand only)
         max_new = max(1, int(len(live) * self.config.upscaling_speed)) \
             if live else len(to_launch) or 1
+        created: Dict[str, str] = {}
         for nt in to_launch[:max_new]:
+            pid = self.provider.create_node(nt)
+            created[pid] = nt.name
+            launched[nt.name] = launched.get(nt.name, 0) + 1
+            counts[nt.name] = counts.get(nt.name, 0) + 1
+
+        # 3b. gang demand: queued placement groups need whole nodes /
+        # slices provisioned atomically (reference: autoscaler.proto
+        # GangResourceRequest; kuberay TPU slice webhooks). Gang
+        # launches are EXEMPT from the upscaling_speed cap — a
+        # sustained task backlog filling the capped launch list must
+        # not starve a pending STRICT_SPREAD slice PG (the planner
+        # already bounds launches by max_workers and subtracts units
+        # still booting).
+        for nt in self._plan_pending_pgs(counts, {**live, **created}):
             self.provider.create_node(nt)
             launched[nt.name] = launched.get(nt.name, 0) + 1
             counts[nt.name] = counts.get(nt.name, 0) + 1
@@ -111,20 +126,91 @@ class StandardAutoscaler:
         self._terminate_idle(counts)
         return launched
 
+    def _plan_pending_pgs(self, counts: Dict[str, int],
+                          live: Dict[str, str]) -> List[NodeTypeConfig]:
+        """Launch units needed to satisfy queued placement groups.
+
+        STRICT_SPREAD/SPREAD bundles each claim a distinct host;
+        PACK/STRICT_PACK bundles co-locate onto one host when they fit.
+        Hosts are grouped per node type and converted to launch units
+        of ``count`` hosts (a pod slice). Launch units still booting
+        (provider node with no registered runtime hosts yet) count as
+        incoming capacity so repeated update() rounds don't re-launch
+        for the same PG.
+        """
+        pending = getattr(self.runtime, "pending_pg_demand", lambda: [])()
+        if not pending:
+            return []
+        # Hosts already launched but not yet registered, per type.
+        incoming: Dict[str, int] = {}
+        for pid, type_name in live.items():
+            if not self.provider.runtime_node_ids(pid):
+                nt = self.config.node_type(type_name)
+                incoming[type_name] = (incoming.get(type_name, 0)
+                                       + (nt.count if nt else 1))
+
+        hosts_needed: Dict[str, int] = {}
+        for strategy, bundles in pending:
+            if strategy in ("PACK", "STRICT_PACK"):
+                # try to co-locate the whole gang on one host
+                combined: Dict[str, float] = {}
+                for b in bundles:
+                    for k, v in b.items():
+                        combined[k] = combined.get(k, 0.0) + v
+                groups = [combined]
+                if not any(_fits(dict(nt.resources), combined)
+                           for nt in self.config.node_types):
+                    if strategy == "STRICT_PACK":
+                        continue  # infeasible on any single host
+                    groups = [dict(b) for b in bundles]  # loose PACK
+            else:  # SPREAD / STRICT_SPREAD: one host per bundle
+                groups = [dict(b) for b in bundles]
+            for need in groups:
+                for nt in self.config.node_types:
+                    if not _fits(dict(nt.resources), need):
+                        continue
+                    hosts_needed[nt.name] = hosts_needed.get(nt.name, 0) + 1
+                    break
+                # unplaceable on any type: permanently infeasible, skip
+
+        launches: List[NodeTypeConfig] = []
+        for type_name, hosts in hosts_needed.items():
+            nt = self.config.node_type(type_name)
+            if nt is None:
+                continue
+            hosts -= incoming.get(type_name, 0)
+            if hosts <= 0:
+                continue
+            units = -(-hosts // max(nt.count, 1))  # ceil
+            room = nt.max_workers - counts.get(type_name, 0)
+            for _ in range(min(units, max(room, 0))):
+                launches.append(nt)
+        return launches
+
     def _terminate_idle(self, counts: Dict[str, int]) -> None:
         now = time.monotonic()
         snapshot = self.runtime.scheduler.snapshot()
         live = self.provider.non_terminated_nodes()
         for pid, type_name in list(live.items()):
-            node_id = getattr(self.provider, "runtime_node_id",
-                              lambda p: None)(pid)
-            if node_id is None or node_id == self.runtime.head_node_id:
-                continue
-            res = snapshot.get(node_id)
-            if res is None:
-                continue
-            busy = any(res.available.get(k, 0.0) < v - 1e-9
-                       for k, v in res.total.items())
+            node_ids = self.provider.runtime_node_ids(pid)
+            if not node_ids or self.runtime.head_node_id in node_ids:
+                continue  # still booting, or hosts the head
+            busy = False
+            for node_id in node_ids:
+                res = snapshot.get(node_id)
+                if res is None:
+                    continue
+                # A node carrying placement-group bundle resources is
+                # RESERVED even when no task runs — culling it would
+                # silently break a gang reservation (reference:
+                # placement_group_resource_manager.cc bundle holds).
+                if any("_group_" in k for k in res.total):
+                    busy = True
+                    break
+                if any(res.available.get(k, 0.0) < v - 1e-9
+                       for k, v in res.total.items()):
+                    busy = True
+                    break
             if busy:
                 self._idle_since.pop(pid, None)
                 continue

@@ -12,10 +12,20 @@ from typing import Dict, List, Optional
 @dataclass
 class NodeTypeConfig:
     name: str
-    resources: Dict[str, float]
+    resources: Dict[str, float]   # PER HOST
     min_workers: int = 0
-    max_workers: int = 10
+    max_workers: int = 10          # in LAUNCH units (slices for count>1)
     labels: Dict[str, str] = field(default_factory=dict)
+    # Hosts per launch unit: a TPU pod slice provisions as ONE unit of
+    # `count` hosts (e.g. v5litepod-16 = 2 hosts x 8 chips). The
+    # autoscaler plans gang (placement-group) demand in hosts and
+    # launches ceil(hosts/count) units (reference: slice-granular
+    # scaling in _private/accelerators/tpu.py + kuberay TPU webhooks).
+    count: int = 1
+    # Provider-specific knobs (e.g. accelerator_type, runtime_version
+    # for the GCE TPU API; reference: available_node_types.node_config
+    # in the cluster YAML schema, autoscaler/ray-schema.json).
+    provider_params: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

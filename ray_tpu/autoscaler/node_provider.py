@@ -15,7 +15,8 @@ class NodeProvider:
     """Launch/terminate nodes of declared types."""
 
     def create_node(self, node_type: NodeTypeConfig) -> str:
-        """Returns an opaque provider node id."""
+        """Returns an opaque provider node id (one LAUNCH unit — a
+        whole pod slice for node types with count > 1)."""
         raise NotImplementedError
 
     def terminate_node(self, provider_node_id: str) -> None:
@@ -24,6 +25,16 @@ class NodeProvider:
     def non_terminated_nodes(self) -> Dict[str, str]:
         """provider_node_id -> node_type name."""
         raise NotImplementedError
+
+    def runtime_node_ids(self, provider_node_id: str) -> List:
+        """Runtime NodeIDs of the hosts this launch unit contributed
+        (empty while the unit is still booting). Default adapts the
+        legacy single-node hook."""
+        single = getattr(self, "runtime_node_id", None)
+        if single is None:
+            return []
+        node_id = single(provider_node_id)
+        return [node_id] if node_id is not None else []
 
 
 class FakeMultiNodeProvider(NodeProvider):

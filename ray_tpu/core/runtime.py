@@ -163,6 +163,12 @@ class DriverRuntime:
         # read by the autoscaler's demand export (reference:
         # gcs_autoscaler_state_manager.h pending-demand reporting)
         self._backlog_view: List[TaskSpec] = []
+        # Placement groups waiting for capacity: creation is queued,
+        # not fail-fast — the autoscaler reads these as gang demand and
+        # new-node registration retries them (reference:
+        # gcs_placement_group_scheduler.h:281 pending queue + 2PC).
+        self._pending_pgs: List = []
+        self._pg_lock = threading.Lock()
         # Fast-dispatch lease cache: resource-shape -> last node that
         # granted it (reference: owner-side lease caching per resource
         # shape, normal_task_submitter.cc:499). try_acquire on the
@@ -223,7 +229,9 @@ class DriverRuntime:
             node_id=node_id, address=node.socket_path,
             resources_total=resources, labels=dict(labels or {}),
             node_manager=node))
-        # New capacity: re-check infeasible + queued work.
+        # New capacity: gang reservations first (a queued PG may claim
+        # this node whole), then re-check infeasible + queued work.
+        self.retry_pending_placement_groups()
         with self._sched_cond:
             self._schedulable.extend(self._infeasible)
             self._infeasible.clear()
@@ -245,6 +253,7 @@ class DriverRuntime:
         self.gcs.register_node(NodeRecord(
             node_id=node_id, address=node.address,
             resources_total=resources, labels=labels, node_manager=node))
+        self.retry_pending_placement_groups()
         with self._sched_cond:
             self._schedulable.extend(self._infeasible)
             self._infeasible.clear()
@@ -392,11 +401,11 @@ class DriverRuntime:
         self._pin_contained(oid, msg.get("contained", ()))
         if msg["item_kind"] == "inline":
             self.memory_store.put(oid, ("packed", bytes(msg["data"])))
-            self.task_manager.set_location(oid, ObjectLocation("memory"))
+            self.task_manager.set_location_and_ready(
+                oid, ObjectLocation("memory"))
         else:
-            self.task_manager.set_location(
+            self.task_manager.set_location_and_ready(
                 oid, ObjectLocation("shm", node.node_id))
-        self.task_manager.mark_object_ready(oid)
         state = self._stream(TaskID(msg["task_id"]))
         with state.cond:
             abandoned = state.abandoned
@@ -711,9 +720,14 @@ class DriverRuntime:
         backlog: deque = deque()
         self._backlog_blocked = False
         while not self._stopped.is_set():
+            # Task completions free resources without a node-join event:
+            # give queued gangs a shot each pass (no-op when none wait).
+            self.retry_pending_placement_groups()
             with self._sched_cond:
                 while not self._schedulable and not backlog and not self._stopped.is_set():
                     self._sched_cond.wait(timeout=0.2)
+                    if self._pending_pgs:
+                        break  # idle pass: retry pending gangs above
                 if self._stopped.is_set():
                     return
                 work = list(self._schedulable)
@@ -840,6 +854,62 @@ class DriverRuntime:
         specs = self._backlog_view + infeasible
         return [dict(self._spec_resources(s)) for s in specs
                 if s.resources]
+
+    # --- pending placement groups --------------------------------------
+    # All PENDING<->CREATED<->REMOVED transitions happen under
+    # self._pg_lock (lock order: _pg_lock before scheduler lock), so a
+    # concurrent retry can never reserve a record another thread is
+    # removing (reference: GcsPlacementGroupManager serializes these on
+    # the GCS main loop).
+
+    def queue_pending_placement_group(self, record) -> None:
+        """Park an unplaceable PG until capacity appears (reference:
+        gcs_placement_group_scheduler.h:281 pending queue)."""
+        with self._pg_lock:
+            record.state = "PENDING"
+            self._pending_pgs.append(record)
+
+    def retry_pending_placement_groups(self) -> None:
+        """Attempt reservation of every queued PG; called when capacity
+        changes (node joins, PG removed, scheduler pass with pending
+        gangs). Success flips the GCS record to CREATED, which unblocks
+        PlacementGroup.ready() waiters."""
+        from ray_tpu.exceptions import PlacementGroupUnschedulableError
+        if not self._pending_pgs:  # unlocked peek: usually empty
+            return
+        with self._pg_lock:
+            remaining = []
+            for record in self._pending_pgs:
+                if record.state != "PENDING":
+                    continue
+                try:
+                    self.scheduler.reserve_placement_group(record)
+                except PlacementGroupUnschedulableError:
+                    remaining.append(record)
+            self._pending_pgs = remaining
+
+    def remove_placement_group_record(self, record) -> None:
+        """Release or cancel a PG in any state (idempotent)."""
+        released = False
+        with self._pg_lock:
+            if record.state == "CREATED":
+                self.scheduler.return_placement_group(record)
+                released = True
+            elif record.state == "PENDING":
+                if record in self._pending_pgs:
+                    self._pending_pgs.remove(record)
+                record.state = "REMOVED"
+        if released:
+            # Freed capacity may satisfy a queued gang.
+            self.retry_pending_placement_groups()
+
+    def pending_pg_demand(self) -> List:
+        """[(strategy, [bundle resource dicts])] for queued PGs — the
+        autoscaler's gang-demand input (reference:
+        autoscaler.proto GangResourceRequest)."""
+        with self._pg_lock:
+            return [(r.strategy, [dict(b.resources) for b in r.bundles])
+                    for r in self._pending_pgs]
 
     def _spec_resources(self, spec: TaskSpec) -> Dict[str, float]:
         from ray_tpu.core.scheduler import _pg_resources
@@ -1160,7 +1230,7 @@ class DriverRuntime:
         if not buffers and len(data) < cfg.max_inline_object_size:
             packed = serialization.pack_parts(data, buffers)
             self.memory_store.put(oid, ("packed", packed))
-            self.task_manager.set_location(oid, ObjectLocation("memory"))
+            location = ObjectLocation("memory")
         else:
             head = self.nodes[self.head_node_id]
             sizes = [b.nbytes for b in buffers]
@@ -1172,9 +1242,8 @@ class DriverRuntime:
                 self.spill_on_node(
                     head, serialization.packed_size(data, sizes))
                 head.store.put_parts(oid, data, buffers, sizes)
-            self.task_manager.set_location(
-                oid, ObjectLocation("shm", self.head_node_id))
-        self.task_manager.mark_object_ready(oid)
+            location = ObjectLocation("shm", self.head_node_id)
+        self.task_manager.set_location_and_ready(oid, location)
         return ObjectRef(oid)
 
     def store_packed_object(self, oid: ObjectID, packed: bytes,
@@ -1186,7 +1255,7 @@ class DriverRuntime:
         cfg = get_config()
         if len(packed) < cfg.max_inline_object_size:
             self.memory_store.put(oid, ("packed", packed))
-            self.task_manager.set_location(oid, ObjectLocation("memory"))
+            location = ObjectLocation("memory")
         else:
             head = self.nodes[self.head_node_id]
             from ray_tpu.exceptions import ObjectStoreFullError
@@ -1200,11 +1269,10 @@ class DriverRuntime:
             finally:
                 del buf
             head.store.seal(oid)
-            self.task_manager.set_location(
-                oid, ObjectLocation("shm", self.head_node_id))
+            location = ObjectLocation("shm", self.head_node_id)
         if contained:
             self._pin_contained(oid, contained)
-        self.task_manager.mark_object_ready(oid)
+        self.task_manager.set_location_and_ready(oid, location)
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -1499,8 +1567,8 @@ class DriverRuntime:
     def on_worker_put(self, node: Node, msg: dict) -> None:
         oid = ObjectID(msg["object_id"])
         self._pin_contained(oid, msg.get("contained", ()))
-        self.task_manager.set_location(oid, ObjectLocation("shm", node.node_id))
-        self.task_manager.mark_object_ready(oid)
+        self.task_manager.set_location_and_ready(
+            oid, ObjectLocation("shm", node.node_id))
 
     def handle_get_object(self, node: Node, worker, msg: dict) -> None:
         oid = ObjectID(msg["object_id"])

@@ -296,6 +296,16 @@ class JaxTrainer:
         try:
             pg = placement_group([dict(res)] * num_workers,
                                  strategy=strategy)
+            # Creation queues (never raises) when the gang doesn't fit
+            # yet; give the reservation a short window, then fall back
+            # to loose scheduling so single-node dev boxes still train
+            # (an unready queued PG must be removed, or it would grab
+            # resources later with no owner).
+            if not pg.ready(timeout=2.0):
+                from ray_tpu.util.placement_group import (
+                    remove_placement_group)
+                remove_placement_group(pg)
+                pg = None
         except Exception:
             pg = None
         group_name = f"train/{os.path.basename(storage)}/{time.time_ns()}"

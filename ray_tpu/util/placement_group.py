@@ -53,12 +53,12 @@ def placement_group(bundles: List[Dict[str, float]],
                     name: str = "",
                     bundle_label_selector: Optional[List[Dict[str, str]]]
                     = None) -> PlacementGroup:
-    """Create and synchronously reserve a placement group.
-
-    Raises PlacementGroupUnschedulableError if no feasible assignment
-    exists right now (the reference queues pending PGs for the
-    autoscaler; here creation is synchronous and the autoscaler seam is
-    the pending-PG list in the GCS).
+    """Create a placement group; reservation is immediate when capacity
+    exists, otherwise the PG queues as PENDING and is retried whenever
+    capacity changes (node joins, another PG removed) — the autoscaler
+    reads queued PGs as gang demand and provisions slices to satisfy
+    them (reference: gcs_placement_group_scheduler.h:281 pending queue;
+    python/ray/util/placement_group.py:146 async creation + ready()).
     """
     if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
         raise ValueError(f"unknown placement strategy: {strategy}")
@@ -75,15 +75,20 @@ def placement_group(bundles: List[Dict[str, float]],
                         label_selector=dict(sel))
                  for i, (b, sel) in enumerate(zip(bundles, selectors))])
     rt.gcs.register_placement_group(record)
-    rt.scheduler.reserve_placement_group(record)
+    try:
+        rt.scheduler.reserve_placement_group(record)
+    except PlacementGroupUnschedulableError:
+        rt.queue_pending_placement_group(record)
     return PlacementGroup(pg_id, [dict(b) for b in bundles], strategy)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     rt = runtime_mod.get_runtime()
     record = rt.gcs.get_placement_group(pg.id)
-    if record is not None and record.state == "CREATED":
-        rt.scheduler.return_placement_group(record)
+    if record is not None:
+        # State transition runs under the runtime's PG lock so it can't
+        # race a concurrent pending-PG retry into a leaked reservation.
+        rt.remove_placement_group_record(record)
 
 
 class PlacementGroupSchedulingStrategy(SchedulingStrategy):

@@ -146,3 +146,128 @@ def test_background_loop(small_head):
         assert provider.non_terminated_nodes()
     finally:
         autoscaler.stop()
+
+
+# --- GCE TPU slice provider + gang (placement-group) provisioning -------
+
+class _FakeTpuApi:
+    """Hermetic stand-in for tpu.googleapis.com: records requests and
+    'boots' slice hosts into the live runtime on create, the way real
+    hosts join via their startup script."""
+
+    def __init__(self, rt, hosts_per_slice=2,
+                 host_resources=None):
+        self.rt = rt
+        self.hosts_per_slice = hosts_per_slice
+        self.host_resources = host_resources or {"CPU": 1.0, "TPU": 4.0}
+        self.requests = []
+        self.nodes = {}          # provider_id -> node_type name
+        self.runtime_nodes = {}  # provider_id -> [NodeID]
+        self.fail_next_list = False
+
+    def __call__(self, method, url, body):
+        from ray_tpu.autoscaler.gce import (
+            NODE_TYPE_LABEL, PROVIDER_ID_LABEL)
+        self.requests.append((method, url))
+        if method == "POST":
+            pid = url.rsplit("nodeId=", 1)[-1]
+            node_type = body["labels"]["ray-tpu-node-type"]
+            assert "startup-script" in body["metadata"]
+            assert "ray-tpu start --address" in body["metadata"]["startup-script"]
+            self.nodes[pid] = node_type
+            joined = []
+            for _ in range(self.hosts_per_slice):
+                nid = self.rt.add_node(
+                    resources=dict(self.host_resources),
+                    labels={PROVIDER_ID_LABEL: pid,
+                            NODE_TYPE_LABEL: node_type})
+                joined.append(nid)
+            self.runtime_nodes[pid] = joined
+            return 200, {"name": f"operations/op-{pid}"}
+        if method == "DELETE":
+            pid = url.rsplit("/", 1)[-1]
+            self.nodes.pop(pid, None)
+            for nid in self.runtime_nodes.pop(pid, []):
+                self.rt.remove_node(nid)
+            return 200, {}
+        if method == "GET":
+            if self.fail_next_list:
+                self.fail_next_list = False
+                return 503, {"error": "backend unavailable"}
+            return 200, {"nodes": [
+                {"name": f"projects/p/locations/z/nodes/{pid}",
+                 "state": "READY",
+                 "labels": {"ray-tpu-node-type": t}}
+                for pid, t in self.nodes.items()]}
+        raise AssertionError(f"unexpected {method} {url}")
+
+
+def test_pending_strict_spread_pg_satisfied_by_slice_launch(small_head):
+    """VERDICT round-2 item 4 done-criterion: a queued STRICT_SPREAD
+    slice PG is satisfied by the autoscaler 'launching' mocked TPU
+    hosts through the GCE slice provider."""
+    from ray_tpu.autoscaler import GceTpuSliceNodeProvider
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+
+    rt = small_head
+    fake_api = _FakeTpuApi(rt, hosts_per_slice=2)
+    provider = GceTpuSliceNodeProvider(
+        "proj", "us-central2-b", "head:6379", runtime=rt,
+        http_request=fake_api, name_prefix="ray-tpu")
+    slice_type = NodeTypeConfig(
+        "v5e-slice", {"CPU": 1.0, "TPU": 4.0}, max_workers=4, count=2,
+        provider_params={"accelerator_type": "v5litepod-8"})
+    autoscaler = StandardAutoscaler(
+        AutoscalerConfig(node_types=[slice_type], idle_timeout_s=0.0),
+        provider, rt)
+
+    pg = placement_group([{"TPU": 4.0}] * 2, strategy="STRICT_SPREAD")
+    assert not pg.ready(timeout=0.2)  # queued: no TPU hosts exist
+
+    autoscaler.update()
+    # One slice (2 hosts) launched, gang reserved on distinct hosts.
+    assert len(fake_api.nodes) == 1
+    assert pg.ready(timeout=5)
+    assert len(set(n.hex() for n in pg.bundle_node_ids())) == 2
+
+    # Reserved (but task-idle) slice must NOT be culled even with a
+    # zero idle timeout, and repeated rounds must not re-launch.
+    autoscaler.update()
+    autoscaler.update()
+    assert len(fake_api.nodes) == 1
+
+    # Releasing the gang makes the slice idle: it is terminated.
+    remove_placement_group(pg)
+    autoscaler.update()
+    autoscaler.update()
+    assert len(fake_api.nodes) == 0
+
+
+def test_gce_provider_api_shapes(small_head):
+    """Provider unit contract: URLs, accelerator type plumb-through,
+    list filtering, and the local-view fallback on an API hiccup."""
+    from ray_tpu.autoscaler import GceTpuSliceNodeProvider
+
+    rt = small_head
+    fake_api = _FakeTpuApi(rt, hosts_per_slice=1)
+    provider = GceTpuSliceNodeProvider(
+        "proj", "us-central2-b", "head:6379", runtime=rt,
+        http_request=fake_api)
+    nt = NodeTypeConfig("v5p-host", {"CPU": 1.0, "TPU": 4.0},
+                        provider_params={"accelerator_type": "v5p-8"})
+    pid = provider.create_node(nt)
+    method, url = fake_api.requests[0]
+    assert method == "POST"
+    assert url.startswith("https://tpu.googleapis.com/v2/projects/proj"
+                          "/locations/us-central2-b/nodes?nodeId=")
+    assert provider.non_terminated_nodes() == {pid: "v5p-host"}
+    assert len(provider.runtime_node_ids(pid)) == 1
+
+    # API hiccup on list: fall back to the local view, no relaunch.
+    fake_api.fail_next_list = True
+    assert provider.non_terminated_nodes() == {pid: "v5p-host"}
+
+    provider.terminate_node(pid)
+    assert provider.non_terminated_nodes() == {}
+    assert provider.runtime_node_ids(pid) == []

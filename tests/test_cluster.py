@@ -11,7 +11,6 @@ import pytest
 import ray_tpu
 from ray_tpu.core.task_spec import SchedulingStrategy
 from ray_tpu.exceptions import (
-    PlacementGroupUnschedulableError,
     TaskError,
     WorkerCrashedError,
 )
@@ -77,9 +76,17 @@ def test_placement_group_strict_spread(ray_start_cluster):
     remove_placement_group(pg)
 
 
-def test_placement_group_infeasible(ray_start_cluster):
-    with pytest.raises(PlacementGroupUnschedulableError):
-        placement_group([{"TPU": 128}], strategy="STRICT_PACK")
+def test_placement_group_infeasible_queues_pending(ray_start_cluster):
+    """Unplaceable PGs queue as PENDING instead of failing fast — the
+    autoscaler satisfies them later (reference:
+    gcs_placement_group_scheduler.h:281 pending queue). A node joining
+    with the needed capacity flips the PG to CREATED."""
+    cluster = ray_start_cluster
+    pg = placement_group([{"TPU": 8}], strategy="STRICT_PACK")
+    assert not pg.ready(timeout=0.2)  # queued, not raised
+    cluster.add_node(num_cpus=1, resources={"TPU": 8})
+    assert pg.ready(timeout=5)
+    remove_placement_group(pg)
 
 
 def test_placement_group_task_targeting(ray_start_cluster):
