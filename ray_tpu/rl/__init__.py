@@ -19,6 +19,8 @@ from ray_tpu.rl.env import (
     CartPole, CartPoleJax, Env, JaxEnv, Pendulum, make_env, register_env)
 from ray_tpu.rl.env_runner import JaxEnvRunner, SingleAgentEnvRunner
 from ray_tpu.rl.learner import Learner, LearnerGroup, compute_gae
+from ray_tpu.rl.multi_agent import (
+    MultiAgentEnv, MultiAgentEnvRunner, RepeatedRockPaperScissors)
 from ray_tpu.rl.rl_module import RLModuleSpec
 from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
 from ray_tpu.rl import spaces
@@ -27,9 +29,11 @@ __all__ = [
     "APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "CartPole",
     "CartPoleJax", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
     "Env", "FrameStack", "JaxEnv", "JaxEnvRunner", "Learner",
-    "LearnerGroup", "MARWIL", "MARWILConfig", "ObsNormalizer",
+    "LearnerGroup", "MARWIL", "MARWILConfig", "MultiAgentEnv",
+    "MultiAgentEnvRunner", "ObsNormalizer",
     "OfflineData", "PPO", "PPOConfig", "Pendulum", "RLModuleSpec",
-    "RewardClip", "SAC", "SACConfig", "SampleBatch",
+    "RepeatedRockPaperScissors", "RewardClip", "SAC", "SACConfig",
+    "SampleBatch",
     "SingleAgentEnvRunner", "collect_episodes", "compute_gae",
     "concat_samples", "make_env", "register_env", "spaces",
 ]

@@ -46,6 +46,17 @@ class AlgorithmConfig:
         # env→module connectors: FACTORIES (each runner builds its own
         # stateful pipeline; see ray_tpu/rl/connectors.py)
         self.connector_factories: list = []
+        # evaluation-runner split (reference: algorithm.py:1407 evaluate
+        # + evaluation_config): separate runners, exploit-mode policy,
+        # metrics reported under the "evaluation" key
+        self.evaluation_interval: Optional[int] = None
+        self.evaluation_duration: int = 10  # episodes per evaluate()
+        self.evaluation_num_envs: int = 4
+        # multi-agent (reference: algorithm_config.py multi_agent():
+        # policies + policy_mapping_fn + policies_to_train)
+        self.policies: Optional[Dict[str, Any]] = None
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
+        self.policies_to_train: Optional[List[str]] = None
         # misc
         self.seed: int = 0
 
@@ -87,6 +98,51 @@ class AlgorithmConfig:
         if hidden is not None:
             self.hidden = tuple(hidden)
         return self
+
+    def evaluation(self, *, evaluation_interval=None,
+                   evaluation_duration=None,
+                   evaluation_num_envs=None) -> "AlgorithmConfig":
+        """Evaluation-runner split (reference: algorithm.py:1407
+        evaluate; evaluation_interval in iterations, duration in
+        episodes)."""
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        if evaluation_num_envs is not None:
+            self.evaluation_num_envs = evaluation_num_envs
+        return self
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None,
+                    policies_to_train=None) -> "AlgorithmConfig":
+        """Multi-agent setup (reference: algorithm_config.py
+        multi_agent()). ``policies`` maps policy id -> (obs_space,
+        action_space) or None to infer from the first mapped agent;
+        ``policy_mapping_fn(agent_id) -> policy_id``."""
+        if policies is not None:
+            self.policies = (dict.fromkeys(policies)
+                             if not isinstance(policies, dict)
+                             else dict(policies))
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if policies_to_train is not None:
+            self.policies_to_train = list(policies_to_train)
+        return self
+
+    @property
+    def is_multi_agent(self) -> bool:
+        return self.policy_mapping_fn is not None
+
+    def make_multi_agent_env(self):
+        from ray_tpu.rl.multi_agent import MultiAgentEnv
+        if self.env_creator is not None:
+            return self.env_creator()
+        if isinstance(self.env, type) and issubclass(self.env,
+                                                     MultiAgentEnv):
+            return self.env()
+        raise ValueError(
+            f"multi-agent config needs an env_creator or a "
+            f"MultiAgentEnv class, got {self.env!r}")
 
     def debugging(self, *, seed=None) -> "AlgorithmConfig":
         if seed is not None:
@@ -166,6 +222,12 @@ class Algorithm:
         self.iteration = 0
         self._env_steps_lifetime = 0
         self._episode_returns: List[float] = []
+        if (config.evaluation_interval
+                and type(self).evaluate is Algorithm.evaluate):
+            # Fail at build time, not at iteration N mid-job.
+            raise ValueError(
+                f"{type(self).__name__} does not implement evaluate(); "
+                "remove evaluation_interval from the config")
         self.setup(config)
 
     # -- subclass hooks --------------------------------------------------
@@ -196,7 +258,16 @@ class Algorithm:
             "episodes_total": len(self._episode_returns),
         }
         result.update(metrics)
+        if (self.config.evaluation_interval
+                and self.iteration % self.config.evaluation_interval == 0):
+            result["evaluation"] = self.evaluate()
         return result
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Run the evaluation-runner split (reference:
+        algorithm.py:1407). Subclasses with evaluation support override."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement evaluate()")
 
     def record_episodes(self, returns: List[float]) -> None:
         self._episode_returns.extend(returns)

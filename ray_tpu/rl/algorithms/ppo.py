@@ -121,6 +121,11 @@ class _EnvRunnerActor:
 
 class PPO(Algorithm):
     def setup(self, config: PPOConfig) -> None:
+        self._eval_runner = None
+        if config.is_multi_agent:
+            self._setup_multi_agent(config)
+            return
+        self.ma_runner = None
         self.spec = config.module_spec()
         learner_kwargs = dict(
             module_spec=self.spec, lr=config.lr,
@@ -179,6 +184,153 @@ class PPO(Algorithm):
             ray_tpu.get([r.ping.remote() for r in self.runners])
             self._remote = True
 
+    # -- multi-agent (reference: multi_rl_module.py:40 module dict +
+    #    per-policy learners; policy_mapping_fn routes agent streams) ---
+    def _setup_multi_agent(self, config: PPOConfig) -> None:
+        from ray_tpu.rl.multi_agent import (
+            MultiAgentEnvRunner, infer_module_specs)
+        if (config.num_env_runners or config.num_learners > 1
+                or config.connector_factories):
+            raise NotImplementedError(
+                "multi-agent PPO currently runs one local env runner "
+                "and per-module local learners; num_env_runners, "
+                "num_learners and env_to_module connectors are "
+                "single-agent-only for now")
+        env = config.make_multi_agent_env()
+        try:
+            self.ma_specs = infer_module_specs(
+                env, config.policy_mapping_fn, config.policies,
+                hidden=config.hidden)
+        finally:
+            env.close()
+        self._rng = np.random.default_rng(config.seed)
+        self.jax_runner = None
+        self.runners = None
+        self._remote = False
+        self._connector_template = None
+        # One PPOLearner per module (shared mapping = self-play when
+        # several agents feed one module; independent learners when the
+        # mapping splits them).
+        self.ma_learners = {
+            mid: PPOLearner(
+                spec, lr=config.lr, grad_clip=config.grad_clip,
+                seed=config.seed + j, clip_param=config.clip_param,
+                vf_clip_param=config.vf_clip_param,
+                vf_loss_coeff=config.vf_loss_coeff,
+                entropy_coeff=config.entropy_coeff)
+            for j, (mid, spec) in enumerate(sorted(self.ma_specs.items()))}
+        self._to_train = (set(config.policies_to_train)
+                          if config.policies_to_train is not None
+                          else set(self.ma_specs))
+        unknown = self._to_train - set(self.ma_specs)
+        if unknown:
+            raise ValueError(f"policies_to_train has unknown ids {unknown}")
+        self.ma_runner = MultiAgentEnvRunner(
+            config.make_multi_agent_env, self.ma_specs,
+            config.policy_mapping_fn,
+            num_envs=config.num_envs_per_env_runner,
+            rollout_len=config.rollout_fragment_length,
+            seed=config.seed)
+
+    def _training_step_multi(self) -> Dict[str, Any]:
+        cfg = self.config
+        self.ma_runner.set_weights(
+            {mid: lrn.get_weights()
+             for mid, lrn in self.ma_learners.items()})
+        batches = self.ma_runner.sample()
+        metrics: Dict[str, Any] = {}
+        runner_metrics = self.ma_runner.pop_metrics()
+        self.record_episodes(runner_metrics["episode_returns"])
+        for mid, vals in runner_metrics["module_returns"].items():
+            if vals:
+                metrics[f"policy_reward_mean/{mid}"] = float(np.mean(vals))
+        # env steps (not agent steps), once — matching the reference's
+        # num_env_steps_sampled accounting.
+        self._env_steps_lifetime += (self.ma_runner.rollout_len
+                                     * len(self.ma_runner.envs))
+        for mid, cols in batches.items():
+            if mid not in self._to_train:
+                continue  # frozen: skip GAE/value forward entirely
+            learner = self.ma_learners[mid]
+            batch = self._postprocess(cols, learner.params,
+                                      spec=self.ma_specs[mid])
+            mb = min(cfg.minibatch_size, len(batch))
+            mod_metrics: List[Dict] = []
+            for _ in range(cfg.num_epochs):
+                for minibatch in batch.minibatches(mb, self._rng):
+                    mod_metrics.append(learner.update(minibatch))
+            host = [{k: float(np.asarray(v)) for k, v in m.items()}
+                    for m in mod_metrics]
+            for key in host[0]:
+                metrics[f"{mid}/{key}"] = float(
+                    np.mean([m[key] for m in host]))
+        return metrics
+
+    # -- evaluation-runner split (reference: algorithm.py:1407) ---------
+    def evaluate(self) -> Dict[str, Any]:
+        """Sample `evaluation_duration` episodes on dedicated runners
+        with exploration OFF; metrics stay separate from training."""
+        cfg = self.config
+        if self._eval_runner is None:
+            if cfg.is_multi_agent:
+                from ray_tpu.rl.multi_agent import MultiAgentEnvRunner
+                self._eval_runner = MultiAgentEnvRunner(
+                    cfg.make_multi_agent_env, self.ma_specs,
+                    cfg.policy_mapping_fn,
+                    num_envs=cfg.evaluation_num_envs,
+                    rollout_len=cfg.rollout_fragment_length,
+                    seed=cfg.seed + 10_000, explore=False)
+            else:
+                self._eval_runner = SingleAgentEnvRunner(
+                    env_creator=(cfg.env_creator
+                                 or (lambda c=cfg: c.make_python_env())),
+                    module_spec=self.spec,
+                    num_envs=cfg.evaluation_num_envs,
+                    rollout_len=cfg.rollout_fragment_length,
+                    seed=cfg.seed + 10_000, explore=False,
+                    connectors=cfg.build_connectors())
+        if cfg.is_multi_agent:
+            self._eval_runner.set_weights(
+                {mid: lrn.get_weights()
+                 for mid, lrn in self.ma_learners.items()})
+        else:
+            self._eval_runner.set_weights(self.learner_group.get_weights())
+            # Stateful connectors (ObsNormalizer): evaluation must see
+            # the statistics the policy was trained under, not a fresh
+            # pipeline's identity transform.
+            if self._connector_template is not None:
+                state = (self._connector_state if self._remote
+                         else self.runners[0].get_connector_state())
+                self._eval_runner.set_connector_state(state)
+        # Episodes begun under previous weights must not leak into this
+        # measurement: restart every env.
+        self._eval_runner.reset_envs()
+        returns: List[float] = []
+        lens: List[int] = []
+        by_module: Dict[str, List[float]] = {}
+        sampled = 0
+        while len(returns) < cfg.evaluation_duration:
+            self._eval_runner.sample()
+            m = self._eval_runner.pop_metrics()
+            returns.extend(m["episode_returns"])
+            lens.extend(m["episode_lens"])
+            for mid, vals in m.get("module_returns", {}).items():
+                by_module.setdefault(mid, []).extend(vals)
+            sampled += 1
+            if sampled > 100:  # env never finishes an episode: bail
+                break
+        out = {
+            "episode_return_mean": (float(np.mean(returns)) if returns
+                                    else float("nan")),
+            "episode_len_mean": (float(np.mean(lens)) if lens
+                                 else float("nan")),
+            "episodes_this_eval": len(returns),
+        }
+        for mid, vals in by_module.items():
+            if vals:
+                out[f"policy_reward_mean/{mid}"] = float(np.mean(vals))
+        return out
+
     # ------------------------------------------------------------------
     def stop(self) -> None:
         """Release remote actors — leaked env runners would keep
@@ -195,11 +347,13 @@ class PPO(Algorithm):
             group.shutdown()
 
     def training_step(self) -> Dict[str, Any]:
+        if self.ma_runner is not None:
+            return self._training_step_multi()
         if self.jax_runner is not None:
             return self._training_step_jax()
         return self._training_step_python()
 
-    def _postprocess(self, cols, params) -> SampleBatch:
+    def _postprocess(self, cols, params, spec=None) -> SampleBatch:
         """[T, N] columns -> flat [T*N] batch with GAE columns.
 
         Truncated episodes (time limits) must not be treated as true
@@ -209,7 +363,8 @@ class PPO(Algorithm):
         then GAE cuts the trace at every episode end.
         """
         import jax.numpy as jnp
-        v_final = self.spec.compute_values(params, cols[FINAL_OBS])
+        spec = spec if spec is not None else self.spec
+        v_final = spec.compute_values(params, cols[FINAL_OBS])
         rewards = (jnp.asarray(cols[REWARDS])
                    + self.config.gamma * v_final
                    * jnp.asarray(cols[TRUNCATEDS], jnp.float32))
@@ -290,12 +445,20 @@ class PPO(Algorithm):
 
     def get_state(self) -> Dict[str, Any]:
         state = super().get_state()
-        state["learner"] = self.learner_group.get_state()
+        if self.ma_runner is not None:
+            state["ma_learners"] = {mid: lrn.get_state()
+                                    for mid, lrn in self.ma_learners.items()}
+        else:
+            state["learner"] = self.learner_group.get_state()
         return state
 
     def set_state(self, state: Dict[str, Any]) -> None:
         super().set_state(state)
-        self.learner_group.set_state(state["learner"])
+        if self.ma_runner is not None:
+            for mid, lrn_state in state["ma_learners"].items():
+                self.ma_learners[mid].set_state(lrn_state)
+        else:
+            self.learner_group.set_state(state["learner"])
 
 
 PPOConfig.algo_class = PPO

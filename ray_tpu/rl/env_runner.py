@@ -131,6 +131,19 @@ class SingleAgentEnvRunner:
         batch["bootstrap_value"] = bootstrap
         return batch
 
+    def reset_envs(self) -> None:
+        """Fresh episodes + cleared accumulators — evaluation reuses a
+        cached runner across calls, and episodes begun under the
+        previous weights must not contaminate the new measurement."""
+        self._obs = np.stack([env.reset()[0] for env in self.envs])
+        if self.connectors is not None:
+            self._obs = self.connectors.on_obs(
+                self._obs, resets=np.ones(len(self.envs), bool))
+        self._ep_return[:] = 0.0
+        self._ep_len[:] = 0
+        self._completed = []
+        self._completed_lens = []
+
     def pop_metrics(self) -> Dict[str, Any]:
         out = {
             "episode_returns": self._completed,

@@ -1,0 +1,323 @@
+"""Multi-agent RL: envs, module dicts, and the multi-agent env runner.
+
+Reference surface: rllib/env/multi_agent_env.py:33 (MultiAgentEnv —
+per-agent obs/action dicts, "__all__" termination),
+rllib/core/rl_module/multi_rl_module.py:40 (module dict keyed by
+module_id), and the policy-mapping seam
+(AlgorithmConfig.multi_agent(policies=..., policy_mapping_fn=...)).
+
+Scope note: this runner targets PARALLEL multi-agent envs — every
+agent observes and acts at every step (the PettingZoo parallel-env
+shape). Turn-based envs (agents appearing/disappearing mid-episode)
+are out of scope for now; the reference supports them via episode
+bookkeeping this runner deliberately avoids so the per-module streams
+stay dense [T, S] columns that the single-agent GAE/learner path
+consumes unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.rl_module import RLModuleSpec
+from ray_tpu.rl.sample_batch import (
+    ACTIONS, DONES, FINAL_OBS, LOGP, OBS, REWARDS, TRUNCATEDS, VF_PREDS,
+    SampleBatch)
+from ray_tpu.rl.spaces import Box, Discrete, Space
+
+
+class MultiAgentEnv:
+    """Parallel multi-agent env (reference: multi_agent_env.py:33).
+
+    ``step`` takes/returns per-agent dicts; the termination dict carries
+    the reference's ``"__all__"`` key marking episode end for everyone.
+    """
+
+    agents: List[str]
+    observation_spaces: Dict[str, Space]
+    action_spaces: Dict[str, Space]
+    max_episode_steps: int = 10_000
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        """-> (obs, rewards, terminateds, truncateds, infos) dicts;
+        terminateds/truncateds include "__all__"."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class RepeatedRockPaperScissors(MultiAgentEnv):
+    """Two-player zero-sum repeated rock-paper-scissors (the canonical
+    rllib competitive example: rllib/examples/envs/classes/
+    rock_paper_scissors.py). Observation = one-hot of both players'
+    previous moves (zeros on the first step)."""
+
+    agents = ["player_0", "player_1"]
+    max_episode_steps = 10
+
+    _WIN = {(0, 2), (1, 0), (2, 1)}  # rock>scissors, paper>rock, scissors>paper
+
+    def __init__(self, episode_len: int = 10):
+        self.max_episode_steps = episode_len
+        obs_space = Box(np.zeros(6, np.float32), np.ones(6, np.float32))
+        self.observation_spaces = {a: obs_space for a in self.agents}
+        self.action_spaces = {a: Discrete(3) for a in self.agents}
+        self._t = 0
+        self._last = None
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for idx, agent in enumerate(self.agents):
+            vec = np.zeros(6, np.float32)
+            if self._last is not None:
+                mine, theirs = self._last[idx], self._last[1 - idx]
+                vec[mine] = 1.0
+                vec[3 + theirs] = 1.0
+            out[agent] = vec
+        return out
+
+    def reset(self, *, seed: Optional[int] = None):
+        self._t = 0
+        self._last = None
+        return self._obs(), {a: {} for a in self.agents}
+
+    def step(self, action_dict):
+        a0 = int(action_dict["player_0"])
+        a1 = int(action_dict["player_1"])
+        self._last = (a0, a1)
+        self._t += 1
+        if (a0, a1) in self._WIN:
+            r0, r1 = 1.0, -1.0
+        elif (a1, a0) in self._WIN:
+            r0, r1 = -1.0, 1.0
+        else:
+            r0 = r1 = 0.0
+        done = self._t >= self.max_episode_steps
+        rewards = {"player_0": r0, "player_1": r1}
+        terminateds = {a: False for a in self.agents}
+        terminateds["__all__"] = False
+        truncateds = {a: done for a in self.agents}
+        truncateds["__all__"] = done
+        return (self._obs(), rewards, terminateds, truncateds,
+                {a: {} for a in self.agents})
+
+
+class MultiAgentEnvRunner:
+    """Vectorized sampler over parallel MultiAgentEnvs.
+
+    Experiences are grouped by module: ``policy_mapping_fn(agent_id)``
+    names the module an agent's stream feeds, and sample() returns
+    ``{module_id: [T, S] columns}`` where S = (num_envs x agents mapped
+    to that module) — the exact shape the single-agent learner path
+    already consumes (reference: multi-agent EnvRunner producing
+    MultiAgentBatch keyed by module_id).
+    """
+
+    def __init__(self, env_creator: Callable[[], MultiAgentEnv],
+                 module_specs: Dict[str, RLModuleSpec],
+                 policy_mapping_fn: Callable[[str], str], *,
+                 num_envs: int = 1, rollout_len: int = 64, seed: int = 0,
+                 explore: bool = True):
+        import jax
+        self.envs = [env_creator() for _ in range(num_envs)]
+        self.specs = module_specs
+        self.rollout_len = rollout_len
+        self.explore = explore
+        self._key = jax.random.PRNGKey(seed)
+
+        env0 = self.envs[0]
+        self.agents = list(env0.agents)
+        self.mapping = {a: policy_mapping_fn(a) for a in self.agents}
+        unknown = set(self.mapping.values()) - set(module_specs)
+        if unknown:
+            raise ValueError(
+                f"policy_mapping_fn maps to unknown module(s) {unknown}; "
+                f"configured modules: {sorted(module_specs)}")
+        # Dense streams: one per (env, agent), grouped by module.
+        self.streams: Dict[str, List[Tuple[int, str]]] = {
+            mid: [] for mid in module_specs}
+        for i in range(num_envs):
+            for agent in self.agents:
+                self.streams[self.mapping[agent]].append((i, agent))
+
+        self.params = {
+            mid: jax.tree.map(np.asarray,
+                              spec.init(jax.random.PRNGKey(seed + j)))
+            for j, (mid, spec) in enumerate(sorted(module_specs.items()))}
+        self._obs = [env.reset(seed=seed + i)[0]
+                     for i, env in enumerate(self.envs)]
+        self._ep_return = {(i, a): 0.0 for i in range(num_envs)
+                           for a in self.agents}
+        self._ep_len = np.zeros(num_envs, dtype=np.int64)
+        self._completed: List[float] = []           # per-episode sum
+        self._completed_lens: List[int] = []
+        self._completed_by_module: Dict[str, List[float]] = {
+            mid: [] for mid in module_specs}
+
+        def make_act(spec):
+            def _act(params, obs, key):
+                dist, value = spec.forward(params, obs)
+                action = dist.sample(key) if explore else dist.mode()
+                return action, dist.log_prob(action), value
+            return jax.jit(_act)
+
+        self._act = {mid: make_act(spec)
+                     for mid, spec in module_specs.items()}
+
+    # -- weights ---------------------------------------------------------
+    def set_weights(self, params_by_module: Dict[str, Any]) -> None:
+        import jax
+        for mid, params in params_by_module.items():
+            self.params[mid] = jax.tree.map(np.asarray, params)
+
+    # -- sampling --------------------------------------------------------
+    def _stacked_obs(self, mid: str) -> np.ndarray:
+        return np.stack([self._obs[i][agent]
+                         for i, agent in self.streams[mid]])
+
+    def sample(self) -> Dict[str, SampleBatch]:
+        import jax
+        T = self.rollout_len
+        cols: Dict[str, Dict[str, list]] = {
+            mid: {k: [] for k in (OBS, ACTIONS, LOGP, VF_PREDS, REWARDS,
+                                  DONES, TRUNCATEDS, FINAL_OBS)}
+            for mid in self.specs}
+        for _ in range(T):
+            actions_by_env: List[Dict[str, Any]] = [
+                {} for _ in range(len(self.envs))]
+            per_mid_step: Dict[str, Dict[str, np.ndarray]] = {}
+            for mid in self.specs:
+                obs = self._stacked_obs(mid)
+                self._key, sub = jax.random.split(self._key)
+                action, logp, value = self._act[mid](
+                    self.params[mid], obs, sub)
+                action = np.asarray(action)
+                per_mid_step[mid] = {
+                    OBS: obs, ACTIONS: action,
+                    LOGP: np.asarray(logp), VF_PREDS: np.asarray(value)}
+                for s, (i, agent) in enumerate(self.streams[mid]):
+                    actions_by_env[i][agent] = action[s]
+
+            step_out = []
+            for i, env in enumerate(self.envs):
+                obs, rew, term, trunc, _ = env.step(actions_by_env[i])
+                done = bool(term.get("__all__")) or bool(
+                    trunc.get("__all__"))
+                self._ep_len[i] += 1
+                for agent in self.agents:
+                    self._ep_return[(i, agent)] += float(
+                        rew.get(agent, 0.0))
+                final = obs  # true next obs, pre-reset
+                if done:
+                    ep_sum = sum(self._ep_return[(i, a)]
+                                 for a in self.agents)
+                    self._completed.append(float(ep_sum))
+                    self._completed_lens.append(int(self._ep_len[i]))
+                    for agent in self.agents:
+                        self._completed_by_module[
+                            self.mapping[agent]].append(
+                            float(self._ep_return[(i, agent)]))
+                        self._ep_return[(i, agent)] = 0.0
+                    self._ep_len[i] = 0
+                    obs, _ = env.reset()
+                self._obs[i] = obs
+                step_out.append((final, rew, term, trunc, done))
+
+            for mid in self.specs:
+                streams = self.streams[mid]
+                n = len(streams)
+                rewards = np.zeros(n, np.float32)
+                dones = np.zeros(n, bool)
+                truncs = np.zeros(n, bool)
+                finals = np.stack([step_out[i][0][agent]
+                                   for i, agent in streams])
+                for s, (i, agent) in enumerate(streams):
+                    _, rew, term, trunc, done = step_out[i]
+                    rewards[s] = rew.get(agent, 0.0)
+                    agent_term = bool(term.get(agent)) or bool(
+                        term.get("__all__"))
+                    agent_trunc = bool(trunc.get(agent)) or bool(
+                        trunc.get("__all__"))
+                    dones[s] = done or agent_term or agent_trunc
+                    truncs[s] = agent_trunc and not agent_term
+                c = cols[mid]
+                c[OBS].append(per_mid_step[mid][OBS])
+                c[ACTIONS].append(per_mid_step[mid][ACTIONS])
+                c[LOGP].append(per_mid_step[mid][LOGP])
+                c[VF_PREDS].append(per_mid_step[mid][VF_PREDS])
+                c[REWARDS].append(rewards)
+                c[DONES].append(dones)
+                c[TRUNCATEDS].append(truncs)
+                c[FINAL_OBS].append(finals)
+
+        out: Dict[str, SampleBatch] = {}
+        for mid, c in cols.items():
+            batch = SampleBatch({k: np.stack(v) for k, v in c.items()})
+            batch["bootstrap_value"] = np.asarray(
+                self.specs[mid].compute_values(
+                    self.params[mid], self._stacked_obs(mid)))
+            out[mid] = batch
+        return out
+
+    def reset_envs(self) -> None:
+        """Fresh episodes + cleared accumulators (see
+        SingleAgentEnvRunner.reset_envs)."""
+        self._obs = [env.reset()[0] for env in self.envs]
+        for key in self._ep_return:
+            self._ep_return[key] = 0.0
+        self._ep_len[:] = 0
+        self._completed = []
+        self._completed_lens = []
+        self._completed_by_module = {mid: [] for mid in self.specs}
+
+    def pop_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_returns": self._completed,
+            "episode_lens": self._completed_lens,
+            "module_returns": {mid: vals for mid, vals
+                               in self._completed_by_module.items()},
+        }
+        self._completed = []
+        self._completed_lens = []
+        self._completed_by_module = {mid: [] for mid in self.specs}
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+def infer_module_specs(env: MultiAgentEnv,
+                       policy_mapping_fn: Callable[[str], str],
+                       policies: Optional[Dict[str, Any]] = None,
+                       hidden: Tuple[int, ...] = (64, 64)
+                       ) -> Dict[str, RLModuleSpec]:
+    """Module specs per policy id: explicit (obs_space, action_space)
+    pairs win; otherwise inferred from the first agent mapped to each
+    module (reference: MultiRLModuleSpec inference in
+    AlgorithmConfig.get_multi_rl_module_spec)."""
+    specs: Dict[str, RLModuleSpec] = {}
+    for agent in env.agents:
+        mid = policy_mapping_fn(agent)
+        if mid in specs:
+            continue
+        if policies and policies.get(mid) is not None:
+            obs_space, act_space = policies[mid]
+        else:
+            obs_space = env.observation_spaces[agent]
+            act_space = env.action_spaces[agent]
+        specs[mid] = RLModuleSpec(obs_space=obs_space,
+                                  action_space=act_space, hidden=hidden)
+    if policies:
+        for mid in policies:
+            if mid not in specs:
+                raise ValueError(
+                    f"policy {mid!r} has no agent mapped to it by "
+                    "policy_mapping_fn")
+    return specs

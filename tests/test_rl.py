@@ -576,3 +576,116 @@ def test_appo_requires_runners():
     with pytest.raises(ValueError, match="num_env_runners"):
         (APPOConfig().environment("CartPole-v1")
          .env_runners(num_env_runners=0).build_algo())
+
+
+# --- multi-agent (reference: rllib/env/multi_agent_env.py:33,
+#     multi_rl_module.py:40, algorithm.py:1407 evaluate) ---------------
+
+def test_multi_agent_env_runner_shapes_and_zero_sum():
+    from ray_tpu.rl import RepeatedRockPaperScissors
+    from ray_tpu.rl.multi_agent import (
+        MultiAgentEnvRunner, infer_module_specs)
+
+    env = RepeatedRockPaperScissors()
+    mapping = {"player_0": "pol_a", "player_1": "pol_b"}
+    specs = infer_module_specs(env, mapping.__getitem__)
+    assert set(specs) == {"pol_a", "pol_b"}
+    runner = MultiAgentEnvRunner(
+        RepeatedRockPaperScissors, specs, mapping.__getitem__,
+        num_envs=3, rollout_len=20, seed=0)
+    out = runner.sample()
+    assert set(out) == {"pol_a", "pol_b"}
+    for batch in out.values():
+        assert batch["obs"].shape == (20, 3, 6)
+        assert batch["actions"].shape == (20, 3)
+        assert batch["bootstrap_value"].shape == (3,)
+    # zero-sum: per-step rewards of the two policies cancel exactly
+    np.testing.assert_allclose(
+        out["pol_a"]["rewards"] + out["pol_b"]["rewards"], 0.0)
+    # 20 steps / 10-step episodes => 2 completed episodes per env
+    metrics = runner.pop_metrics()
+    assert len(metrics["episode_returns"]) == 6
+    assert set(metrics["module_returns"]) == {"pol_a", "pol_b"}
+
+
+def test_multi_agent_shared_policy_self_play():
+    """Both agents mapped to ONE module: self-play, single stream set
+    twice as wide (reference: shared-policy mapping)."""
+    from ray_tpu.rl import RepeatedRockPaperScissors
+    from ray_tpu.rl.multi_agent import (
+        MultiAgentEnvRunner, infer_module_specs)
+
+    env = RepeatedRockPaperScissors()
+    specs = infer_module_specs(env, lambda aid: "shared")
+    runner = MultiAgentEnvRunner(
+        RepeatedRockPaperScissors, specs, lambda aid: "shared",
+        num_envs=2, rollout_len=10, seed=0)
+    out = runner.sample()
+    assert set(out) == {"shared"}
+    assert out["shared"]["obs"].shape == (10, 4, 6)  # 2 envs x 2 agents
+
+
+def test_multi_agent_ppo_competitive_trains_and_evaluates():
+    """VERDICT round-2 item 5 done-criterion: a 2-policy competitive
+    env trains under PPO and evaluate() reports separately. The
+    trainable policy exploits a frozen rock-biased opponent (best
+    response: paper), so its evaluation reward must go positive."""
+    import jax.numpy as jnp
+    from ray_tpu.rl import PPOConfig, RepeatedRockPaperScissors
+
+    config = (
+        PPOConfig()
+        .environment(RepeatedRockPaperScissors)
+        .multi_agent(
+            policy_mapping_fn=lambda aid: ("learner" if aid == "player_0"
+                                           else "opponent"),
+            policies_to_train=["learner"])
+        .env_runners(num_envs_per_env_runner=8, rollout_fragment_length=40)
+        .training(lr=0.02, num_epochs=4, minibatch_size=128,
+                  entropy_coeff=0.0)
+        .evaluation(evaluation_duration=8, evaluation_num_envs=4)
+        .debugging(seed=0))
+    algo = config.build_algo()
+    # Freeze the opponent into a rock-heavy strategy: bias the policy
+    # head hard toward action 0.
+    opp = algo.ma_learners["opponent"]
+    opp_params = opp.get_weights()
+    opp_params["pi"][-1]["b"] = np.array([5.0, 0.0, 0.0], np.float32)
+    opp.set_weights(opp_params)
+
+    for _ in range(12):
+        result = algo.train()
+    # separate per-policy training metrics
+    assert "learner/total_loss" in result
+    assert "opponent/total_loss" not in result  # frozen: never updated
+    ev = algo.evaluate()
+    assert ev["episodes_this_eval"] >= 8
+    # zero-sum split reported separately per policy
+    assert ev["policy_reward_mean/learner"] == pytest.approx(
+        -ev["policy_reward_mean/opponent"], abs=1e-5)
+    # exploiting rock with paper: clearly positive (max +10 per episode)
+    assert ev["policy_reward_mean/learner"] > 3.0, ev
+    algo.stop()
+
+
+def test_single_agent_evaluation_split():
+    """evaluate() runs on dedicated exploit-mode runners and train()
+    folds it in under the 'evaluation' key at evaluation_interval."""
+    from ray_tpu.rl import PPOConfig
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=4, rollout_fragment_length=32)
+        .evaluation(evaluation_interval=2, evaluation_duration=3,
+                    evaluation_num_envs=2)
+        .debugging(seed=0))
+    algo = config.build_algo()
+    r1 = algo.train()
+    assert "evaluation" not in r1        # iteration 1: off-interval
+    r2 = algo.train()
+    assert "evaluation" in r2            # iteration 2: on-interval
+    ev = r2["evaluation"]
+    assert ev["episodes_this_eval"] >= 3
+    assert np.isfinite(ev["episode_return_mean"])
+    algo.stop()
