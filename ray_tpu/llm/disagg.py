@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional
 from ray_tpu import serve
 from ray_tpu.llm.engine import ContinuousBatchingEngine, GenerationRequest
 from ray_tpu.llm.tokenizer import get_tokenizer
-from ray_tpu.serve.llm import LLMConfig, LLMServer
+from ray_tpu.serve.llm import LLMConfig, LLMServer, stream_text_deltas
 
 
 class PrefillServer:
@@ -52,13 +52,11 @@ class PrefillServer:
 
 
 class DecodeServer(LLMServer):
-    """Decode replica: the normal continuous-batching LLMServer plus an
-    entry point for requests whose prefill ran elsewhere."""
+    """Decode replica: the normal continuous-batching LLMServer plus
+    entry points for requests whose prefill ran elsewhere."""
 
-    def decode_prefilled(self, prefill_out: Any, *,
-                         max_tokens: int, temperature: float = 0.0,
-                         top_k: int = 0,
-                         adapter: Optional[str] = None) -> Dict[str, Any]:
+    @staticmethod
+    def _materialize_prefill(prefill_out: Any) -> Dict[str, Any]:
         from ray_tpu.core.object_ref import ObjectRef
         if isinstance(prefill_out, ObjectRef):
             # fast path: the router forwarded the prefill replica's raw
@@ -70,18 +68,63 @@ class DecodeServer(LLMServer):
             # a saturated prefill replica answered with a rejection
             # sentinel; the router's slow path re-routes
             raise RuntimeError("prefill result unavailable (rejected)")
+        return prefill_out
+
+    def _adopt_prefilled(self, prefill_out: Dict[str, Any], *,
+                         max_tokens: int, temperature: float,
+                         top_k: int, adapter: Optional[str],
+                         stream_queue=None) -> GenerationRequest:
         request = GenerationRequest(
             prompt_ids=[],  # KV already computed; ids not needed
             max_tokens=max_tokens,
             temperature=temperature,
             top_k=top_k,
             adapter=adapter,
+            stream_queue=stream_queue,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else ())
         self.engine.add_prefilled(
             request, prefill_out["ks"], prefill_out["vs"],
             prefill_out["prompt_len"], prefill_out["first_token"])
         self._wake.set()
+        return request
+
+    def decode_prefilled_stream(self, prefill_out: Any, *,
+                                max_tokens: int, temperature: float = 0.0,
+                                top_k: int = 0,
+                                adapter: Optional[str] = None):
+        """Streaming disagg decode: yields text deltas as tokens land,
+        then one final dict carrying finish_reason + usage (reference:
+        python/ray/serve/llm streaming surface over disaggregated
+        deployments). The KV handoff cost is the object-plane transfer
+        inside _materialize_prefill."""
+        import queue
+        t_handoff0 = time.perf_counter()
+        prefill_out = self._materialize_prefill(prefill_out)
+        kv_handoff_s = time.perf_counter() - t_handoff0
+        request = self._adopt_prefilled(
+            prefill_out, max_tokens=max_tokens, temperature=temperature,
+            top_k=top_k, adapter=adapter, stream_queue=queue.Queue())
+        yield from stream_text_deltas(self.tokenizer, request)
+        yield {
+            "finish_reason": request.finish_reason,
+            "kv_handoff_ms": round(1000 * kv_handoff_s, 3),
+            "usage": {
+                "prompt_tokens": prefill_out["prompt_tokens"],
+                "completion_tokens": len(request.output_ids),
+                "total_tokens": (prefill_out["prompt_tokens"]
+                                 + len(request.output_ids)),
+            },
+        }
+
+    def decode_prefilled(self, prefill_out: Any, *,
+                         max_tokens: int, temperature: float = 0.0,
+                         top_k: int = 0,
+                         adapter: Optional[str] = None) -> Dict[str, Any]:
+        prefill_out = self._materialize_prefill(prefill_out)
+        request = self._adopt_prefilled(
+            prefill_out, max_tokens=max_tokens, temperature=temperature,
+            top_k=top_k, adapter=adapter)
         while not request.done:
             time.sleep(0.001)
         if request.error is not None:
@@ -139,13 +182,6 @@ class DisaggRouter:
         if not isinstance(prompt, str):
             return {"error": {"message": "prompt must be a string",
                               "type": "invalid_request_error"}}
-        if body.get("stream"):
-            # explicit rejection beats silently buffering: an SSE
-            # client would otherwise hang on a plain JSON body
-            return {"error": {
-                "message": "streaming is not supported on the "
-                           "disaggregated deployment yet",
-                "type": "invalid_request_error"}}
         try:
             sampling = self._validate(self, body)
         except ValueError as e:
@@ -161,6 +197,9 @@ class DisaggRouter:
         prefill_ref = self.prefill.prefill.remote(
             prompt, temperature=temperature, top_k=top_k,
             adapter=sampling.get("adapter"))
+        if body.get("stream"):
+            return self._stream_completions(body, prefill_ref,
+                                            decode_kwargs)
         try:
             # fast path: forward the raw result ref so the KV block
             # moves prefill→decode directly through the object plane
@@ -189,6 +228,62 @@ class DisaggRouter:
                                  + result["completion_tokens"]),
             },
         }
+
+
+    def _stream_completions(self, body: Dict[str, Any], prefill_ref,
+                            decode_kwargs: Dict[str, Any]):
+        """SSE generator over the disaggregated path: token deltas
+        stream from the decode replica through the router (reference:
+        serve/llm streaming everywhere, incl. disagg deployments)."""
+        import json as _json
+        import uuid
+
+        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        model = body.get("model", self.config.model_id)
+
+        def chunks(gen):
+            finish = "stop"
+            usage = None
+            handoff_ms = None
+            for item in gen:
+                if isinstance(item, dict):  # trailing usage record
+                    finish = item.get("finish_reason", finish)
+                    usage = item.get("usage")
+                    handoff_ms = item.get("kv_handoff_ms")
+                    continue
+                yield {"id": cid, "object": "text_completion",
+                       "model": model,
+                       "choices": [{"index": 0, "text": item,
+                                    "finish_reason": None}]}
+            final = {"id": cid, "object": "text_completion",
+                     "model": model,
+                     "choices": [{"index": 0, "text": "",
+                                  "finish_reason": finish}]}
+            if usage is not None:
+                final["usage"] = usage
+            if handoff_ms is not None:
+                final["kv_handoff_ms"] = handoff_ms
+            yield final
+
+        stream_handle = self.decode.options(stream=True)
+        gen = stream_handle.decode_prefilled_stream.remote(
+            prefill_ref._ref, **decode_kwargs)
+        emitted = False
+        try:
+            for chunk in chunks(gen):
+                emitted = True
+                yield f"data: {_json.dumps(chunk)}\n\n"
+        except Exception:  # noqa: BLE001 — replica rejection/restart
+            if emitted:
+                raise  # mid-stream failure: surface, don't restart text
+            # slow path: materialize the prefill via the handle's
+            # re-routing result(), then retry once
+            prefill_out = prefill_ref.result()
+            gen = stream_handle.decode_prefilled_stream.remote(
+                prefill_out, **decode_kwargs)
+            for chunk in chunks(gen):
+                yield f"data: {_json.dumps(chunk)}\n\n"
+        yield "data: [DONE]\n\n"
 
 
 def build_disagg_app(config: LLMConfig, *, params=None,

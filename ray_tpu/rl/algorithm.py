@@ -217,6 +217,11 @@ class AlgorithmConfig:
 class Algorithm:
     """Iteration-driven trainer; also a Tune trainable surface."""
 
+    # Subclasses that consume config.multi_agent() set this; everything
+    # else fails at build time instead of mis-running a MultiAgentEnv
+    # through the single-agent path.
+    supports_multi_agent = False
+
     def __init__(self, config: AlgorithmConfig):
         self.config = config
         self.iteration = 0
@@ -228,6 +233,10 @@ class Algorithm:
             raise ValueError(
                 f"{type(self).__name__} does not implement evaluate(); "
                 "remove evaluation_interval from the config")
+        if config.is_multi_agent and not self.supports_multi_agent:
+            raise ValueError(
+                f"{type(self).__name__} does not support multi_agent(); "
+                "use PPO, or drop the policy_mapping_fn")
         self.setup(config)
 
     # -- subclass hooks --------------------------------------------------

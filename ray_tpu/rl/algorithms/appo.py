@@ -32,6 +32,9 @@ class APPOConfig(PPOConfig):
 
 
 class APPO(PPO):
+    # async runner-group path has no multi-agent support yet
+    supports_multi_agent = False
+
     def setup(self, config: APPOConfig) -> None:
         if config.num_env_runners < 1:
             raise ValueError("APPO requires num_env_runners >= 1 "

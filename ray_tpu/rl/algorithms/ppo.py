@@ -120,6 +120,8 @@ class _EnvRunnerActor:
 
 
 class PPO(Algorithm):
+    supports_multi_agent = True
+
     def setup(self, config: PPOConfig) -> None:
         self._eval_runner = None
         if config.is_multi_agent:
@@ -418,14 +420,16 @@ class PPO(Algorithm):
                 batches.append(self._postprocess(cols, weights))
                 self.record_episodes(metrics["episode_returns"])
                 deltas.append(delta)
-            if self._connector_template is not None and len(self.runners) > 1:
+            if self._connector_template is not None:
                 # connector-state sync: each runner reported only the
                 # statistics accumulated SINCE the last sync (disjoint
                 # deltas, shipped with its sample payload); the driver
                 # folds them into its canonical state and broadcasts —
                 # merging full states would double-count shared history
                 # and inflate the Welford count ~world_size× per
-                # iteration (reference: rllib filter delta buffers)
+                # iteration (reference: rllib filter delta buffers).
+                # Runs for ONE remote runner too: the canonical state
+                # feeds evaluate()'s eval runner and must stay fresh.
                 self._connector_state = (
                     self._connector_template.merge_states(
                         [self._connector_state] + deltas))

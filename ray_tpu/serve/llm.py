@@ -39,6 +39,36 @@ class LLMConfig:
     temperature: float = 0.0
 
 
+def stream_text_deltas(tokenizer, request):
+    """Incremental detokenization over a request's stream queue: decode
+    the full output each step and emit the text delta, holding back
+    while the tail is an incomplete multi-byte/multi-piece character
+    (U+FFFD) so streamed text matches the non-streamed decode exactly
+    (reference: vLLM output streams behind serve token streaming).
+    Shared by the co-located and disaggregated streaming paths."""
+    out_ids: List[int] = []
+    emitted = ""
+    while True:
+        token = request.stream_queue.get()
+        if token is None:
+            break
+        if token in request.stop_ids:
+            continue
+        out_ids.append(token)
+        text = tokenizer.decode(out_ids)
+        if text.endswith("�"):
+            continue
+        delta = text[len(emitted):]
+        if delta:
+            emitted = text
+            yield delta
+    if request.error is not None:
+        raise RuntimeError(request.error)
+    final = tokenizer.decode(out_ids)
+    if len(final) > len(emitted):
+        yield final[len(emitted):]
+
+
 class LLMServer:
     """Deployment class hosting one engine per replica."""
 
@@ -198,31 +228,7 @@ class LLMServer:
             stream_queue=queue.Queue())
         self.engine.add_request(request)
         self._wake.set()
-        # Incremental detokenization: decode the full output each step
-        # and emit the text delta, holding back while the tail is an
-        # incomplete multi-byte/multi-piece character (U+FFFD) so
-        # streamed text matches the non-streamed decode exactly.
-        out_ids: List[int] = []
-        emitted = ""
-        while True:
-            token = request.stream_queue.get()
-            if token is None:
-                break
-            if token in request.stop_ids:
-                continue
-            out_ids.append(token)
-            text = self.tokenizer.decode(out_ids)
-            if text.endswith("�"):
-                continue
-            delta = text[len(emitted):]
-            if delta:
-                emitted = text
-                yield delta
-        if request.error is not None:
-            raise RuntimeError(request.error)
-        final = self.tokenizer.decode(out_ids)
-        if len(final) > len(emitted):
-            yield final[len(emitted):]
+        yield from stream_text_deltas(self.tokenizer, request)
 
     # -- OpenAI-compatible surface (routed by path) --------------------
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:

@@ -322,3 +322,48 @@ def test_prefill_decode_disaggregation(ray_start_shared):
         assert got2["choices"][0]["text"] == want2["choices"][0]["text"]
     finally:
         serve.shutdown()
+
+
+def test_disagg_token_streaming(ray_start_shared):
+    """Token streaming over the DISAGGREGATED path (VERDICT round-2
+    item 6): SSE deltas flow decode replica -> router -> client, the
+    concatenated stream matches the colocated greedy output exactly,
+    and the final chunk reports usage + the KV-handoff latency."""
+    import json
+
+    from ray_tpu import serve
+    from ray_tpu.llm.disagg import build_disagg_app
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+
+    cfg = LLMConfig(
+        model_id="llama-disagg-stream",
+        engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=2, max_seq=64, seed=0),
+        max_tokens=8)
+
+    colocated = LLMServer(cfg)
+    want = colocated.completions({"prompt": "hello world",
+                                  "max_tokens": 6})
+    assert "error" not in want
+
+    try:
+        app = build_disagg_app(cfg, num_prefill=1, num_decode=1)
+        handle = serve.run(app, name="disagg-stream",
+                           route_prefix="/llm-stream")
+        events = list(handle.options(stream=True).remote(
+            {"__path__": "/v1/completions", "prompt": "hello world",
+             "max_tokens": 6, "stream": True}))
+        assert events[-1] == "data: [DONE]\n\n"
+        chunks = [json.loads(e[len("data: "):]) for e in events[:-1]]
+        text = "".join(c["choices"][0]["text"] for c in chunks)
+        assert text == want["choices"][0]["text"]
+        # genuinely incremental: more than one non-empty delta chunk
+        assert sum(1 for c in chunks if c["choices"][0]["text"]) >= 2
+        final = chunks[-1]
+        assert final["choices"][0]["finish_reason"] in ("stop", "length")
+        assert final["usage"] == want["usage"]
+        assert final["kv_handoff_ms"] >= 0.0
+    finally:
+        serve.shutdown()
