@@ -17,7 +17,9 @@ from ray_tpu.data.aggregate import (
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.expressions import col, lit
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data import preprocessors
 from ray_tpu.data.read_api import (
     from_arrow,
     from_blocks,
@@ -26,15 +28,21 @@ from ray_tpu.data.read_api import (
     from_pandas,
     range,
     range_tensor,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
+    read_numpy,
     read_parquet,
+    read_text,
 )
 
 __all__ = [
     "AggregateFn", "Block", "BlockAccessor", "BlockMetadata", "Count",
     "DataContext", "DataIterator", "Dataset", "GroupedData", "Max",
     "MaterializedDataset", "Mean", "Min", "Quantile", "Std", "Sum",
-    "from_arrow", "from_blocks", "from_items", "from_numpy", "from_pandas",
-    "range", "range_tensor", "read_csv", "read_json", "read_parquet",
+    "col", "from_arrow", "from_blocks", "from_items", "from_numpy",
+    "from_pandas", "lit", "preprocessors", "range", "range_tensor",
+    "read_binary_files", "read_csv", "read_images", "read_json",
+    "read_numpy", "read_parquet", "read_text",
 ]

@@ -73,7 +73,17 @@ class Dataset:
     def _map_op(self, kind: str, fn, **opts) -> L.AbstractMap:
         return L.AbstractMap(kind, fn, self._plan.dag, **opts)
 
-    def filter(self, fn: Callable[[dict], bool], **opts) -> "Dataset":
+    def filter(self, fn: Optional[Callable[[dict], bool]] = None, *,
+               expr=None, **opts) -> "Dataset":
+        """Row predicate (Python fn) or vectorized expression filter
+        (reference: dataset.py filter(expr=...) over
+        data/expressions.py)."""
+        if expr is not None:
+            if fn is not None:
+                raise ValueError("pass either fn or expr, not both")
+            from ray_tpu.data.expressions import _FilterExprFn
+            return self.map_batches(_FilterExprFn(expr),
+                                    batch_format="pyarrow", **opts)
         return self._with_op(self._map_op("filter", fn, **opts))
 
     def flat_map(self, fn: Callable[[dict], Iterable[dict]], **opts) -> "Dataset":
@@ -90,6 +100,17 @@ class Dataset:
 
     def add_column(self, name: str, fn: Callable) -> "Dataset":
         return self._with_op(self._map_op("add_column", (name, fn)))
+
+    def with_column(self, name: str, expr) -> "Dataset":
+        """Append/replace a column computed from an expression,
+        vectorized over blocks (reference: dataset.py with_column +
+        data/expressions.py)."""
+        return self.with_columns(**{name: expr})
+
+    def with_columns(self, **exprs) -> "Dataset":
+        from ray_tpu.data.expressions import _WithColumnsFn
+        return self.map_batches(_WithColumnsFn(exprs),
+                                batch_format="pyarrow")
 
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._with_op(L.AbstractAllToAll(

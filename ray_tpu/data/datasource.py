@@ -62,6 +62,73 @@ class _FileRead:
         raise ValueError(f"unknown format {self.fmt!r}")
 
 
+class _TextRead:
+    """One row per line (reference: read_api.py read_text)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __call__(self):
+        with open(self.path, "r", errors="replace") as f:
+            lines = f.read().splitlines()
+        return pa.table({"text": pa.array(lines, pa.string())})
+
+
+class _BinaryRead:
+    """Whole file as one row (reference: read_binary_files)."""
+
+    def __init__(self, path: str, include_paths: bool = False):
+        self.path = path
+        self.include_paths = include_paths
+
+    def __call__(self):
+        with open(self.path, "rb") as f:
+            data = f.read()
+        cols = {"bytes": pa.array([data], pa.binary())}
+        if self.include_paths:
+            cols["path"] = pa.array([self.path], pa.string())
+        return pa.table(cols)
+
+
+class _ImageRead:
+    """Decode one image file into an HxWxC uint8 row (reference:
+    datasource/image_datasource.py via PIL)."""
+
+    def __init__(self, path: str, size=None, mode: Optional[str] = None,
+                 include_paths: bool = False):
+        self.path = path
+        self.size = size
+        self.mode = mode
+        self.include_paths = include_paths
+
+    def __call__(self):
+        from PIL import Image
+        img = Image.open(self.path)
+        if self.mode is not None:
+            img = img.convert(self.mode)
+        if self.size is not None:
+            # reference semantics: size=(height, width); PIL takes (w, h)
+            img = img.resize((self.size[1], self.size[0]))
+        arr = np.asarray(img)
+        cols = {"image": pa.array([arr.tolist()])}
+        if self.include_paths:
+            cols["path"] = pa.array([self.path], pa.string())
+        return pa.table(cols)
+
+
+class _NumpyRead:
+    """One .npy file -> rows along axis 0 (reference: read_numpy)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __call__(self):
+        arr = np.load(self.path)
+        if arr.ndim == 1:
+            return pa.table({"data": pa.array(arr)})
+        return pa.table({"data": pa.array([a.tolist() for a in arr])})
+
+
 def expand_paths(paths) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]

@@ -1,0 +1,219 @@
+"""Preprocessors: fit statistics on a Dataset, transform batches.
+
+Capability parity with the reference's preprocessor library
+(reference: python/ray/data/preprocessors/ — Preprocessor base with
+fit/transform/fit_transform, scalers.py StandardScaler/MinMaxScaler,
+encoders.py LabelEncoder/OneHotEncoder, concatenator.py, chain.py).
+Fitting runs as distributed aggregates over the Dataset; transforming
+is a map_batches over numpy batches, so a fitted preprocessor chains
+straight into iter_batches / to_jax pipelines.
+
+    scaler = StandardScaler(columns=["x"]).fit(ds)
+    for batch in scaler.transform(ds).iter_batches():
+        ...
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class PreprocessorNotFittedError(RuntimeError):
+    pass
+
+
+class Preprocessor:
+    """Base: subclasses implement _fit (optional) + transform_batch.
+    Statelessness is detected from the class: no _fit override means
+    transform() works without fit()."""
+
+    def __init__(self):
+        self._fitted = False
+
+    @property
+    def _fittable(self) -> bool:
+        return type(self)._fit is not Preprocessor._fit
+
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform(self, ds):
+        if self._fittable and not self._fitted:
+            raise PreprocessorNotFittedError(
+                f"{type(self).__name__} must be fit() before transform()")
+        return ds.map_batches(self.transform_batch)
+
+    # -- subclass hooks --------------------------------------------------
+    def _fit(self, ds) -> None:
+        pass  # default: stateless
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]
+                        ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class StandardScaler(Preprocessor):
+    """(x - mean) / std per column (reference: scalers.py
+    StandardScaler)."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        self.stats_ = {
+            c: (float(ds.mean(c)), float(ds.std(c) or 0.0))
+            for c in self.columns}
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            denom = std if std > 0 else 1.0
+            out[c] = (np.asarray(batch[c], np.float64) - mean) / denom
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """(x - min) / (max - min) per column (reference: scalers.py
+    MinMaxScaler)."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds) -> None:
+        self.stats_ = {c: (float(ds.min(c)), float(ds.max(c)))
+                       for c in self.columns}
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            span = (hi - lo) if hi > lo else 1.0
+            out[c] = (np.asarray(batch[c], np.float64) - lo) / span
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    """Categorical column -> dense int codes (reference: encoders.py
+    LabelEncoder)."""
+
+    def __init__(self, label_column: str):
+        super().__init__()
+        self.label_column = label_column
+        self.mapping_: Dict[Any, int] = {}
+
+    def _fit(self, ds) -> None:
+        values = sorted(ds.unique(self.label_column))
+        self.mapping_ = {v: i for i, v in enumerate(values)}
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        cats = np.asarray(sorted(self.mapping_))
+        vals = np.asarray(batch[self.label_column])
+        idx = np.searchsorted(cats, vals)
+        clipped = np.clip(idx, 0, len(cats) - 1)
+        unseen = cats[clipped] != vals
+        if unseen.any():
+            sample = sorted(set(np.asarray(vals)[unseen][:5].tolist()))
+            raise ValueError(
+                f"LabelEncoder: label(s) {sample} in column "
+                f"{self.label_column!r} were not seen during fit()")
+        out[self.label_column] = clipped.astype(np.int64)
+        return out
+
+    def inverse_transform_batch(self, batch):
+        inverse = {i: v for v, i in self.mapping_.items()}
+        out = dict(batch)
+        out[self.label_column] = np.asarray(
+            [inverse[int(i)] for i in batch[self.label_column]])
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    """Categorical columns -> {col}_{value} indicator columns
+    (reference: encoders.py OneHotEncoder)."""
+
+    def __init__(self, columns: List[str]):
+        super().__init__()
+        self.columns = list(columns)
+        self.categories_: Dict[str, List[Any]] = {}
+
+    def _fit(self, ds) -> None:
+        self.categories_ = {c: sorted(ds.unique(c)) for c in self.columns}
+
+    def transform_batch(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            values = np.asarray(out.pop(c))
+            for cat in self.categories_[c]:
+                out[f"{c}_{cat}"] = (values == cat).astype(np.int8)
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Merge numeric columns into one feature-vector column
+    (reference: concatenator.py — the standard last step before
+    feeding a model). Stateless: no _fit override."""
+
+    def __init__(self, columns: Optional[List[str]] = None,
+                 output_column_name: str = "concat",
+                 dtype=np.float32, exclude: Optional[List[str]] = None):
+        super().__init__()
+        self.columns = list(columns) if columns else None
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+        self.exclude = set(exclude or ())
+
+    def transform_batch(self, batch):
+        cols = (self.columns if self.columns is not None
+                else [c for c in batch if c not in self.exclude])
+        parts = []
+        for c in cols:
+            arr = np.asarray(batch[c])
+            parts.append(arr.reshape(len(arr), -1))
+        out = {k: v for k, v in batch.items()
+               if k not in cols}
+        out[self.output_column_name] = np.concatenate(
+            parts, axis=1).astype(self.dtype)
+        return out
+
+
+class Chain(Preprocessor):
+    """Run preprocessors in sequence; fit is staged so each stage fits
+    on the PREVIOUS stages' transformed data (reference: chain.py)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        super().__init__()
+        self.preprocessors = list(preprocessors)
+
+    def fit(self, ds) -> "Chain":
+        for prep in self.preprocessors:
+            if prep._fittable:
+                prep.fit(ds)
+            ds = prep.transform(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted:
+            raise PreprocessorNotFittedError(
+                "Chain must be fit() before transform()")
+        for prep in self.preprocessors:
+            ds = prep.transform(ds)
+        return ds
+
+    def transform_batch(self, batch):
+        for prep in self.preprocessors:
+            batch = prep.transform_batch(batch)
+        return batch

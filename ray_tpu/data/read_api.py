@@ -96,3 +96,39 @@ def read_csv(paths, *, columns: Optional[List[str]] = None) -> Dataset:
 def read_json(paths, *, columns: Optional[List[str]] = None) -> Dataset:
     return _ds(L.Read(make_file_read_tasks(paths, "json", columns),
                       name="ReadJSON"))
+
+
+def read_text(paths) -> Dataset:
+    """One row per line, column "text" (reference: read_api.py
+    read_text)."""
+    from ray_tpu.data.datasource import _TextRead, expand_paths
+    return _ds(L.Read([_TextRead(p) for p in expand_paths(paths)],
+                      name="ReadText"))
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """One row per file, column "bytes" (reference: read_api.py
+    read_binary_files)."""
+    from ray_tpu.data.datasource import _BinaryRead, expand_paths
+    return _ds(L.Read([_BinaryRead(p, include_paths)
+                       for p in expand_paths(paths)],
+                      name="ReadBinary"))
+
+
+def read_images(paths, *, size=None, mode: Optional[str] = None,
+                include_paths: bool = False) -> Dataset:
+    """Decoded images as HxWxC rows in column "image"; ``size`` is
+    (height, width) resize, ``mode`` a PIL mode like "RGB" (reference:
+    read_api.py read_images / image_datasource.py)."""
+    from ray_tpu.data.datasource import _ImageRead, expand_paths
+    return _ds(L.Read([_ImageRead(p, size, mode, include_paths)
+                       for p in expand_paths(paths)],
+                      name="ReadImages"))
+
+
+def read_numpy(paths) -> Dataset:
+    """.npy files, rows along axis 0 in column "data" (reference:
+    read_api.py read_numpy)."""
+    from ray_tpu.data.datasource import _NumpyRead, expand_paths
+    return _ds(L.Read([_NumpyRead(p) for p in expand_paths(paths)],
+                      name="ReadNumpy"))

@@ -388,3 +388,115 @@ def test_actor_pool_autoscaling():
         assert len(pool.actors) >= 1
     finally:
         pool.shutdown()
+
+
+# --- round-3 data breadth: readers, expressions, preprocessors ----------
+
+def test_read_text_and_binary(ray_start_shared, tmp_path):
+    from ray_tpu import data as rd
+    p1 = tmp_path / "a.txt"
+    p1.write_text("alpha\nbeta\ngamma\n")
+    p2 = tmp_path / "b.txt"
+    p2.write_text("delta\n")
+    ds = rd.read_text([str(p1), str(p2)])
+    assert sorted(r["text"] for r in ds.take_all()) == [
+        "alpha", "beta", "delta", "gamma"]
+
+    blob = bytes(range(256))
+    (tmp_path / "x.bin").write_bytes(blob)
+    ds = rd.read_binary_files(str(tmp_path / "x.bin"), include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 1
+    assert rows[0]["bytes"] == blob
+    assert rows[0]["path"].endswith("x.bin")
+
+
+def test_read_images(ray_start_shared, tmp_path):
+    from PIL import Image
+
+    from ray_tpu import data as rd
+    for i, color in enumerate([(255, 0, 0), (0, 255, 0)]):
+        Image.new("RGB", (8, 6), color).save(tmp_path / f"img{i}.png")
+    ds = rd.read_images(str(tmp_path) + "/*.png", size=(3, 4), mode="RGB")
+    rows = ds.take_all()
+    assert len(rows) == 2
+    arrs = [np.asarray(r["image"], np.uint8) for r in rows]
+    assert all(a.shape == (3, 4, 3) for a in arrs)  # (h, w, c) resize
+    channels = sorted(int(np.argmax(a.mean(axis=(0, 1)))) for a in arrs)
+    assert channels == [0, 1]  # one red-dominant, one green-dominant
+
+
+def test_read_numpy(ray_start_shared, tmp_path):
+    from ray_tpu import data as rd
+    arr = np.arange(12, dtype=np.float32).reshape(6, 2)
+    np.save(tmp_path / "x.npy", arr)
+    ds = rd.read_numpy(str(tmp_path / "x.npy"))
+    rows = ds.take_all()
+    assert len(rows) == 6
+    np.testing.assert_allclose(rows[3]["data"], arr[3])
+
+
+def test_expressions_with_column_and_filter(ray_start_shared):
+    from ray_tpu import data as rd
+    from ray_tpu.data import col, lit
+    ds = rd.range(10)  # column "id"
+    out = (ds.with_column("double", col("id") * 2)
+             .with_column("shifted", col("double") + lit(1))
+             .filter(expr=(col("shifted") > 9) & (col("id") != 9)))
+    rows = out.take_all()
+    assert [r["id"] for r in rows] == [5, 6, 7, 8]
+    assert [r["shifted"] for r in rows] == [11, 13, 15, 17]
+
+
+def test_expressions_replace_existing_column(ray_start_shared):
+    from ray_tpu import data as rd
+    from ray_tpu.data import col
+    ds = rd.range(4).with_column("id", col("id") + 100)
+    assert [r["id"] for r in ds.take_all()] == [100, 101, 102, 103]
+
+
+def test_standard_scaler_chained_into_iter_batches(ray_start_shared):
+    """VERDICT round-2 item 9 done-criterion: a preprocessor chained
+    into iter_batches."""
+    from ray_tpu import data as rd
+    from ray_tpu.data.preprocessors import StandardScaler
+    values = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    ds = rd.from_items([{"x": float(v), "y": i}
+                        for i, v in enumerate(values)])
+    scaler = StandardScaler(columns=["x"]).fit(ds)
+    got = np.concatenate([b["x"] for b in
+                          scaler.transform(ds).iter_batches(batch_size=2)])
+    want = (values - values.mean()) / values.std(ddof=1)
+    np.testing.assert_allclose(np.sort(got), np.sort(want), rtol=1e-6)
+
+
+def test_preprocessor_requires_fit(ray_start_shared):
+    from ray_tpu import data as rd
+    from ray_tpu.data.preprocessors import (
+        PreprocessorNotFittedError, StandardScaler)
+    ds = rd.range(4)
+    with pytest.raises(PreprocessorNotFittedError):
+        StandardScaler(columns=["id"]).transform(ds)
+
+
+def test_encoders_and_concatenator(ray_start_shared):
+    from ray_tpu import data as rd
+    from ray_tpu.data.preprocessors import (
+        Chain, Concatenator, LabelEncoder, MinMaxScaler, OneHotEncoder)
+    rows = [{"size": s, "price": p, "label": lab}
+            for s, p, lab in [("S", 1.0, "cheap"), ("M", 5.0, "mid"),
+                              ("L", 9.0, "dear"), ("M", 5.0, "mid")]]
+    ds = rd.from_items(rows)
+    chain = Chain(
+        OneHotEncoder(columns=["size"]),
+        LabelEncoder(label_column="label"),
+        MinMaxScaler(columns=["price"]),
+        Concatenator(columns=["size_L", "size_M", "size_S", "price"],
+                     output_column_name="features"))
+    out = chain.fit_transform(ds).take_all()
+    feats = [np.asarray(r["features"], np.float32) for r in out]
+    assert all(f.shape == (4,) for f in feats)
+    by_label = {r["label"] for r in out}
+    assert by_label == {0, 1, 2}  # dense codes
+    prices = sorted(float(f[3]) for f in feats)
+    assert prices[0] == 0.0 and prices[-1] == 1.0  # min-max scaled
