@@ -150,12 +150,14 @@ class Node:
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
         chips: List[int] = []
+        image_uri = None
         hw_profile, _, renv_part = profile.partition("|")
         if renv_part:
             renv = self._runtime_envs.get(renv_part[3:])  # strip "re:"
             if renv is not None:
                 import json
                 env["RTPU_RUNTIME_ENV"] = json.dumps(renv)
+                image_uri = renv.get("image_uri")
         if hw_profile == "cpu":
             # Mask the accelerator: no TPU runtime import (which costs
             # seconds per process and can contend for chips), and any jax
@@ -211,13 +213,49 @@ class Node:
         log_path = os.path.join(log_dir,
                                 f"worker-{worker_id.hex()[:8]}.log")
         env["RTPU_WORKER_LOG"] = log_path  # worker self-rotates at cap
+        cmd = [sys.executable, "-m", "ray_tpu.core.worker",
+               "--socket", self.socket_path,
+               "--node-id", self.node_id.hex(),
+               "--worker-id", worker_id.hex(),
+               "--store-name", self.store_name]
+        if image_uri:
+            # Containerized worker (reference: _private/runtime_env/
+            # image_uri.py:24 — podman-run with host net/IPC so the
+            # unix socket + shm arena pass through; session/cache/src
+            # dirs mounted).
+            from ray_tpu.runtime_env.container import (
+                container_worker_command)
+            from ray_tpu.runtime_env.packaging import cache_root
+            sock_dir = os.path.dirname(self.socket_path)
+            mounts = [f"{self.session_dir}:{self.session_dir}",
+                      f"{cache_root()}:{cache_root()}",
+                      f"{pkg_parent}:{pkg_parent}:ro"]
+            if os.path.commonpath(
+                    [sock_dir, self.session_dir]) != self.session_dir:
+                mounts.append(f"{sock_dir}:{sock_dir}")
+            if chips or hw_profile.startswith("tpu"):
+                # TPU device nodes must be mapped explicitly — host
+                # net/IPC do not expose /dev (reference: image_uri
+                # worker flags for accelerator access).
+                import glob as _glob
+                devices = _glob.glob("/dev/accel*")
+                if os.path.exists("/dev/vfio"):
+                    devices.append("/dev/vfio")
+            else:
+                devices = []
+            try:
+                cmd = container_worker_command(image_uri, cmd, env,
+                                               mounts=mounts,
+                                               devices=devices)
+            except RuntimeError as exc:
+                # No container runtime on this node: launch plain and
+                # let the worker surface RuntimeEnvSetupError to the
+                # requesting task (same path as pip failures) instead
+                # of stranding the spec in the dispatch queue.
+                env["RTPU_PIP_ERROR"] = repr(exc)
         with open(log_path, "ab") as log_file:
             proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu.core.worker",
-                 "--socket", self.socket_path,
-                 "--node-id", self.node_id.hex(),
-                 "--worker-id", worker_id.hex(),
-                 "--store-name", self.store_name],
+                cmd,
                 env=env,
                 stdout=log_file,
                 stderr=subprocess.STDOUT,

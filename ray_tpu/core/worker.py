@@ -594,7 +594,7 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
     if pip_error:
         from ray_tpu.exceptions import RuntimeEnvSetupError
         rt.setup_error = RuntimeEnvSetupError(
-            f"runtime_env pip setup failed: {pip_error}")
+            f"runtime_env setup failed: {pip_error}")
     renv_json = os.environ.get("RTPU_RUNTIME_ENV")
     if renv_json and rt.setup_error is None:
         import json as _json
@@ -848,24 +848,32 @@ def main():
     parser.add_argument("--worker-id", required=True)
     parser.add_argument("--store-name", required=True)
     args = parser.parse_args()
-    # pip runtime envs must take effect before this process touches its
-    # node connection: build (or reuse) the cached venv and re-exec into
-    # its interpreter (exec closes the not-yet-opened socket safely;
-    # RTPU_PIP_READY breaks the loop on the second pass).
+    # pip/conda runtime envs must take effect before this process
+    # touches its node connection: build (or reuse) the cached
+    # venv/conda env and re-exec into its interpreter (exec closes the
+    # not-yet-opened socket safely; RTPU_PIP_READY breaks the loop on
+    # the second pass).
     renv_json = os.environ.get("RTPU_RUNTIME_ENV")
     if renv_json and not os.environ.get("RTPU_PIP_READY"):
         import json as _json
-        pip_spec = (_json.loads(renv_json) or {}).get("pip")
-        if pip_spec:
-            try:
+        renv = _json.loads(renv_json) or {}
+        pip_spec = renv.get("pip")
+        conda_spec = renv.get("conda")
+        python = None
+        try:
+            if pip_spec:
                 from ray_tpu.runtime_env.pip_env import ensure_pip_env
                 python = ensure_pip_env(pip_spec)
-            except Exception as exc:  # noqa: BLE001
-                # Still connect and register: the failure must travel to
-                # the requesting task as RuntimeEnvSetupError, not
-                # strand the spec in the node's dispatch queue.
-                os.environ["RTPU_PIP_ERROR"] = repr(exc)
-            else:
+            elif conda_spec:
+                from ray_tpu.runtime_env.conda_env import ensure_conda_env
+                python = ensure_conda_env(conda_spec)
+        except Exception as exc:  # noqa: BLE001
+            # Still connect and register: the failure must travel to
+            # the requesting task as RuntimeEnvSetupError, not
+            # strand the spec in the node's dispatch queue.
+            os.environ["RTPU_PIP_ERROR"] = repr(exc)
+        else:
+            if python is not None:
                 os.environ["RTPU_PIP_READY"] = "1"
                 os.execve(
                     python,

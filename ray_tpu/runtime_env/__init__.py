@@ -27,7 +27,7 @@ from typing import Any, Dict, Optional
 # Fields a runtime env may carry. Anything else is rejected up front so
 # typos fail at submit time, not silently at worker start.
 _KNOWN_FIELDS = ("env_vars", "working_dir", "py_modules", "pip",
-                 "excludes", "config")
+                 "conda", "image_uri", "excludes", "config")
 
 
 class RuntimeEnv(dict):
@@ -78,6 +78,23 @@ def validate_runtime_env(env: Dict[str, Any]) -> None:
     if pip is not None and not isinstance(pip, (list, tuple, dict)):
         raise TypeError("runtime_env['pip'] must be a list of requirements "
                         "or a dict with 'packages'")
+    conda = env.get("conda")
+    if conda is not None and not isinstance(conda, (str, dict)):
+        raise TypeError("runtime_env['conda'] must be an env name or a "
+                        "dict spec (environment.yml shape)")
+    if pip is not None and conda is not None:
+        # reference: conda.py — pip deps go INSIDE the conda spec
+        raise ValueError("runtime_env cannot set both 'pip' and 'conda'; "
+                         "put pip packages inside the conda spec")
+    image_uri = env.get("image_uri")
+    if image_uri is not None and not isinstance(image_uri, str):
+        raise TypeError("runtime_env['image_uri'] must be a string")
+    if image_uri is not None and (pip is not None or conda is not None):
+        # A host-built venv/conda prefix is meaningless inside the
+        # image (interpreter paths differ); bake packages into the
+        # image instead (reference: image_uri.py precludes pip/conda).
+        raise ValueError("runtime_env cannot combine 'image_uri' with "
+                         "'pip'/'conda'; bake packages into the image")
 
 
 def normalize_runtime_env(env: Optional[Dict[str, Any]],
@@ -124,6 +141,13 @@ def normalize_runtime_env(env: Optional[Dict[str, Any]],
             }
         else:
             out["pip"] = {"packages": list(pip), "pip_install_options": []}
+    conda = env.get("conda")
+    if conda:
+        # str = named env (resolved node-side); dict = canonicalized spec
+        out["conda"] = (conda if isinstance(conda, str)
+                        else json.loads(json.dumps(conda, sort_keys=True)))
+    if env.get("image_uri"):
+        out["image_uri"] = env["image_uri"]
     if env.get("config"):
         out["config"] = dict(env["config"])
     if not out:

@@ -1,0 +1,108 @@
+"""conda runtime envs: named or created-on-demand conda environments
+the worker re-execs into.
+
+Capability parity with the reference's conda plugin
+(reference: python/ray/_private/runtime_env/conda.py:297 — named envs
+resolve to an existing prefix; dict specs create a content-hashed env
+under the cache dir). Same flock + ready-marker discipline as
+pip_env.py; the worker re-exec mechanism is shared (core/worker.main).
+
+The conda executable resolves from ``RTPU_CONDA_EXE`` (tests inject a
+fake here) or PATH (conda/mamba/micromamba).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+from typing import Dict, Union
+
+from ray_tpu.runtime_env.packaging import cache_root
+
+
+def conda_exe() -> str:
+    exe = os.environ.get("RTPU_CONDA_EXE")
+    if exe:
+        return exe
+    for name in ("conda", "mamba", "micromamba"):
+        found = shutil.which(name)
+        if found:
+            return found
+    raise RuntimeError(
+        "runtime_env['conda'] requires a conda executable on this node "
+        "(conda/mamba/micromamba on PATH, or RTPU_CONDA_EXE)")
+
+
+def conda_env_hash(spec: Dict) -> str:
+    return hashlib.sha1(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def _named_env_python(exe: str, name: str) -> str:
+    """Resolve an EXISTING named env to its interpreter via
+    `conda env list --json` (reference: conda.py get_conda_env_list)."""
+    proc = subprocess.run([exe, "env", "list", "--json"],
+                          capture_output=True, text=True, timeout=120)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"conda env list failed: {proc.stderr.strip()[-500:]}")
+    prefixes = json.loads(proc.stdout).get("envs", [])
+    for prefix in prefixes:
+        if os.path.basename(prefix) == name or prefix == name:
+            python = os.path.join(prefix, "bin", "python")
+            if os.path.exists(python):
+                return python
+    if name == "base":
+        # The base env is the install root, whose basename is e.g.
+        # "miniconda3", never "base": it's the prefix NOT under envs/.
+        for prefix in prefixes:
+            if os.path.basename(os.path.dirname(prefix)) != "envs":
+                python = os.path.join(prefix, "bin", "python")
+                if os.path.exists(python):
+                    return python
+    raise RuntimeError(
+        f"conda env {name!r} not found (known: "
+        f"{[os.path.basename(p) for p in prefixes]})")
+
+
+def ensure_conda_env(conda_spec: Union[str, Dict]) -> str:
+    """Resolve (named) or create (dict spec) the conda env; returns the
+    path to its python interpreter."""
+    exe = conda_exe()
+    if isinstance(conda_spec, str):
+        return _named_env_python(exe, conda_spec)
+
+    digest = conda_env_hash(conda_spec)
+    root = cache_root()
+    env_dir = os.path.join(root, f"conda-{digest}")
+    python = os.path.join(env_dir, "bin", "python")
+    marker = os.path.join(env_dir, ".rtpu_ready")
+    if os.path.exists(marker):
+        os.utime(env_dir)
+        return python
+    lock_path = os.path.join(root, f".conda-{digest}.lock")
+    os.makedirs(root, exist_ok=True)
+    with open(lock_path, "w") as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        if os.path.exists(marker):  # built while we waited
+            return python
+        if os.path.exists(env_dir):
+            shutil.rmtree(env_dir)  # half-built leftover
+        yml_path = os.path.join(root, f".conda-{digest}.yml")
+        with open(yml_path, "w") as f:
+            json.dump(conda_spec, f)  # YAML is a JSON superset
+        proc = subprocess.run(
+            [exe, "env", "create", "-p", env_dir, "-f", yml_path,
+             "--yes"],
+            capture_output=True, text=True)
+        if proc.returncode != 0 or not os.path.exists(python):
+            tail = (proc.stdout + proc.stderr)[-800:]
+            shutil.rmtree(env_dir, ignore_errors=True)
+            raise RuntimeError(f"conda env create failed: {tail}")
+        with open(marker, "w") as f:
+            f.write("ok")
+    return python
