@@ -124,6 +124,17 @@ class DashboardServer:
                     except Exception:  # noqa: BLE001
                         pass
 
+            def do_POST(self):
+                try:
+                    dashboard._route_post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    try:
+                        self.send_error(500, str(exc))
+                    except Exception:  # noqa: BLE001
+                        pass
+
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(
@@ -161,6 +172,29 @@ class DashboardServer:
             if os.path.isfile(full):
                 return full
         return None
+
+    def _route_post(self, req: BaseHTTPRequestHandler) -> None:
+        """REST mutations (reference: serve REST surface,
+        PUT/POST /api/serve/applications on the dashboard agent)."""
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/")
+        if path == "/api/serve/deploy":
+            length = int(req.headers.get("Content-Length", 0))
+            body = req.rfile.read(length)
+            try:
+                config = json.loads(body or b"{}")
+            except ValueError:
+                return req.send_error(400, "request body is not JSON")
+            from ray_tpu.serve.schema import deploy_config
+            try:
+                deployed = deploy_config(config)
+            except (ValueError, TypeError) as exc:
+                # config errors are the CLIENT's fault: 400, with the
+                # validation message intact (a 500 would read as a
+                # dashboard fault and invite retries of a bad config)
+                return req.send_error(400, str(exc))
+            return self._send_json(req, {"deployed": deployed})
+        req.send_error(404, "unknown route")
 
     def _route(self, req: BaseHTTPRequestHandler) -> None:
         parsed = urlparse(req.path)

@@ -120,6 +120,52 @@ def cmd_version(args) -> None:
     print(__version__)
 
 
+def cmd_serve(args) -> None:
+    """`ray-tpu serve deploy <yaml>` / `ray-tpu serve status` talk to
+    the dashboard REST surface in the driver process (reference:
+    python/ray/serve/scripts.py deploying via the dashboard agent)."""
+    import urllib.request
+
+    state = _require_state()
+    url = state.get("dashboard_url")
+    if not url:
+        print("the live session has no dashboard (init with "
+              "include_dashboard=True)", file=sys.stderr)
+        sys.exit(1)
+    import urllib.error
+
+    try:
+        if args.action == "deploy":
+            if not args.config:
+                print("usage: ray-tpu serve deploy <config.yaml>",
+                      file=sys.stderr)
+                sys.exit(1)
+            import yaml
+            with open(args.config) as f:
+                config = yaml.safe_load(f)
+            req = urllib.request.Request(
+                url + "/api/serve/deploy",
+                data=json.dumps(config).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                print(resp.read().decode())
+        elif args.action == "status":
+            with urllib.request.urlopen(url + "/api/serve",
+                                        timeout=30) as resp:
+                print(json.dumps(json.load(resp), indent=2))
+    except urllib.error.HTTPError as err:
+        # surface the server's message (e.g. config validation) cleanly
+        detail = err.read().decode(errors="replace")
+        print(f"serve {args.action} failed ({err.code}): {detail}",
+              file=sys.stderr)
+        sys.exit(1)
+    except urllib.error.URLError as err:
+        print(f"cannot reach the dashboard at {url}: {err.reason} "
+              "(driver exited?)", file=sys.stderr)
+        sys.exit(1)
+
+
 def cmd_start(args) -> None:
     from ray_tpu.core import node_daemon
     argv = ["--address", args.address, "--resources", args.resources,
@@ -148,6 +194,13 @@ def main(argv=None) -> None:
     p.add_argument("entrypoint", nargs=argparse.REMAINDER)
     p.set_defaults(fn=cmd_submit)
     sub.add_parser("version").set_defaults(fn=cmd_version)
+    p = sub.add_parser(
+        "serve", help="declarative serve ops against the live session "
+        "(reference: the `serve` CLI, python/ray/serve/scripts.py)")
+    p.add_argument("action", choices=["deploy", "status"])
+    p.add_argument("config", nargs="?", default=None,
+                   help="YAML config for `deploy`")
+    p.set_defaults(fn=cmd_serve)
     p = sub.add_parser(
         "start", help="start a node daemon joining a head over TCP "
         "(reference: `ray start --address`)")

@@ -128,7 +128,20 @@ def shutdown() -> None:
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
-    "delete", "deployment", "get_app_handle", "get_deployment_handle",
+    "delete", "deploy_config", "deploy_config_file", "deployment",
+    "get_app_handle", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
     "status",
 ]
+
+
+def deploy_config(config):
+    """Apply a declarative application config dict (reference:
+    serve/schema.py ServeDeploySchema + REST deploy)."""
+    from ray_tpu.serve.schema import deploy_config as _deploy
+    return _deploy(config)
+
+
+def deploy_config_file(path: str):
+    from ray_tpu.serve.schema import deploy_config_file as _deploy_file
+    return _deploy_file(path)

@@ -12,6 +12,7 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.external import OptunaSearch
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
@@ -33,7 +34,8 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 
 __all__ = [
     "ASHAScheduler", "BasicVariantGenerator", "FIFOScheduler",
-    "PopulationBasedTraining", "ResultGrid", "Searcher", "TPESearcher",
+    "OptunaSearch", "PopulationBasedTraining", "ResultGrid", "Searcher",
+    "TPESearcher",
     "Trainable", "TrialScheduler", "TuneConfig", "Tuner", "choice",
     "get_checkpoint", "grid_search", "loguniform", "randint", "report",
     "run", "sample_from", "uniform", "wrap_function",

@@ -1,0 +1,97 @@
+"""External HPO searcher adapters.
+
+Capability parity with the reference's pluggable searcher integrations
+(reference: python/ray/tune/search/optuna/optuna_search.py:127 — an
+adapter translating Tune's param space into the external library's
+ask/tell API, behind the same ``Searcher`` interface the in-tree
+searchers implement). Libraries import lazily: the adapter is always
+importable; constructing it without the library installed raises with
+an install hint. Flat ``Domain`` dimensions are driven by the external
+optimizer; nested dicts / grid_search / sample_from fall back to the
+same random resolution the in-tree TPESearcher uses.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Dict, Optional
+
+from ray_tpu.tune.search import (
+    Categorical,
+    Float,
+    Integer,
+    Searcher,
+    flat_domains,
+    random_grid_assignment,
+    resolve_config,
+)
+
+
+class OptunaSearch(Searcher):
+    """optuna-backed suggestions over the flat Domain dimensions
+    (reference: OptunaSearch wrapping an optuna.Study via ask/tell)."""
+
+    def __init__(self, num_samples: int = 32, sampler=None,
+                 seed: Optional[int] = None):
+        try:
+            import optuna
+        except ImportError as err:
+            raise ImportError(
+                "OptunaSearch requires the 'optuna' package "
+                "(pip install optuna)") from err
+        self._optuna = optuna
+        self.num_samples = num_samples
+        self._sampler = sampler
+        self._seed = seed
+        self.rng = random.Random(seed)
+        self._study = None
+        self._trials: Dict[str, Any] = {}
+        self._suggested = 0
+
+    def _ensure_study(self):
+        if self._study is None:
+            direction = ("maximize" if getattr(self, "mode", "max") == "max"
+                         else "minimize")
+            sampler = self._sampler or self._optuna.samplers.TPESampler(
+                seed=self._seed)
+            self._study = self._optuna.create_study(
+                direction=direction, sampler=sampler)
+        return self._study
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        study = self._ensure_study()
+        trial = study.ask()
+        # Ask optuna FIRST, then resolve the space with the suggestions
+        # substituted as literals — so sample_from entries depending on
+        # optuna-driven dimensions see the final values, not a discarded
+        # random draw (they resolve after their siblings).
+        space = dict(self.param_space)
+        for key, dom in flat_domains(self.param_space).items():
+            if isinstance(dom, Float):
+                space[key] = trial.suggest_float(
+                    key, dom.lower, dom.upper, log=dom.log)
+            elif isinstance(dom, Integer):
+                # ray_tpu Integer is [lower, upper); optuna inclusive
+                space[key] = trial.suggest_int(key, dom.lower,
+                                               dom.upper - 1)
+            elif isinstance(dom, Categorical):
+                space[key] = trial.suggest_categorical(
+                    key, dom.categories)
+        grid = random_grid_assignment(space, self.rng)
+        cfg = resolve_config(space, self.rng, grid)
+        self._trials[trial_id] = trial
+        return cfg
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        if result is None or self.metric not in result:
+            self._study.tell(trial,
+                             state=self._optuna.trial.TrialState.FAIL)
+            return
+        self._study.tell(trial, float(result[self.metric]))

@@ -167,6 +167,20 @@ def grid_axes(param_space: Dict[str, Any], prefix: str = "",
     return axes
 
 
+def flat_domains(param_space: Dict[str, Any]) -> Dict[str, "Domain"]:
+    """Top-level Domain dimensions an external/model-based searcher can
+    drive directly (nested dicts / grid_search / sample_from fall back
+    to random resolution)."""
+    return {k: v for k, v in param_space.items()
+            if isinstance(v, Domain) and not isinstance(v, SampleFrom)}
+
+
+def random_grid_assignment(param_space: Dict[str, Any],
+                           rng: random.Random) -> Dict[str, Any]:
+    return {path: rng.choice(vals)
+            for path, vals in grid_axes(param_space)}
+
+
 class Searcher:
     """ABC (reference: python/ray/tune/search/searcher.py).
 
@@ -244,8 +258,7 @@ class TPESearcher(Searcher):
         self._observed: List[Tuple[Dict[str, Any], float]] = []
 
     def _flat_domains(self) -> Dict[str, Domain]:
-        return {k: v for k, v in self.param_space.items()
-                if isinstance(v, Domain) and not isinstance(v, SampleFrom)}
+        return flat_domains(self.param_space)
 
     def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
         if self._suggested >= self.num_samples:
@@ -285,8 +298,7 @@ class TPESearcher(Searcher):
         return cfg
 
     def _random_grid_assignment(self) -> Dict[str, Any]:
-        return {path: self.rng.choice(vals)
-                for path, vals in grid_axes(self.param_space)}
+        return random_grid_assignment(self.param_space, self.rng)
 
     def on_trial_complete(self, trial_id: str,
                           result: Optional[Dict[str, Any]]) -> None:

@@ -183,8 +183,11 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
 # ---------------------------------------------------------------------------
 
 def state_snapshot() -> dict:
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime_or_none()
     return {
         "timestamp": time.time(),
+        "dashboard_url": getattr(rt, "dashboard_url", None),
         "nodes": list_nodes(),
         "actors": list_actors(),
         "tasks": list_tasks(limit=200),

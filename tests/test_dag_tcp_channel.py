@@ -101,8 +101,17 @@ def test_reader_disconnect_surfaces():
     listener = TcpChannelListener(host="127.0.0.1")
     reader = TcpChannelReader(listener)
     writer = TcpChannelWriter([listener.address], capacity=1)
-    threading.Thread(target=lambda: reader.read(0, timeout=10),
-                     daemon=True).start()
+
+    def accept_then_die():
+        # reader.close() below severs the link mid-read: the expected
+        # ChannelTimeoutError must not escape the helper thread (pytest
+        # records unhandled thread exceptions as a suite warning)
+        try:
+            reader.read(0, timeout=10)
+        except ChannelTimeoutError:
+            pass
+
+    threading.Thread(target=accept_then_die, daemon=True).start()
     time.sleep(0.2)
     writer.write("x", 0)
     reader.close()

@@ -329,3 +329,101 @@ def test_grpc_ingress(ray_start_shared):
         channel.close()
     finally:
         serve.shutdown()
+
+
+# --- declarative config deploy (round 3; reference: serve/schema.py:431
+#     + `serve deploy` scripts.py) --------------------------------------
+
+def _write_app_module(tmp_path):
+    mod = tmp_path / "myserveapp.py"
+    mod.write_text(
+        "from ray_tpu import serve\n"
+        "\n"
+        "@serve.deployment(num_replicas=1, max_ongoing_requests=8)\n"
+        "class Doubler:\n"
+        "    def __init__(self, bias=0):\n"
+        "        self.bias = bias\n"
+        "    def __call__(self, x):\n"
+        "        return 2 * x + self.bias\n"
+        "\n"
+        "app = Doubler.bind()\n"
+        "\n"
+        "def build(bias=0):\n"
+        "    return Doubler.bind(bias)\n")
+    return str(tmp_path)
+
+
+def test_declarative_deploy_with_overrides(ray_start_shared, tmp_path,
+                                           monkeypatch):
+    import sys as _sys
+    monkeypatch.syspath_prepend(_write_app_module(tmp_path))
+    _sys.modules.pop("myserveapp", None)
+    try:
+        deployed = serve.deploy_config({
+            "applications": [{
+                "name": "decl",
+                "route_prefix": "/decl",
+                "import_path": "myserveapp:build",
+                "args": {"bias": 5},
+                "deployments": [{"name": "Doubler", "num_replicas": 2,
+                                 "max_ongoing_requests": 4}],
+            }],
+        })
+        assert deployed == ["decl"]
+        handle = serve.get_app_handle("decl")
+        assert handle.remote(10).result(timeout_s=60) == 25  # bias applied
+        info = serve.status()["Doubler"]
+        assert info["target_replicas"] == 2  # override applied
+    finally:
+        serve.shutdown()
+        _sys.modules.pop("myserveapp", None)
+
+
+def test_declarative_deploy_validation_errors():
+    from ray_tpu.serve.schema import ServeDeploySchema
+    with pytest.raises(ValueError):
+        ServeDeploySchema.from_dict({"applications": []})
+    with pytest.raises(ValueError):
+        ServeDeploySchema.from_dict({"applications": [
+            {"name": "a", "import_path": "no_colon_here"}]})
+    with pytest.raises(ValueError):
+        ServeDeploySchema.from_dict({"applications": [
+            {"name": "a", "import_path": "m:x", "bogus": 1}]})
+    with pytest.raises(ValueError):  # duplicate names
+        ServeDeploySchema.from_dict({"applications": [
+            {"name": "a", "import_path": "m:x"},
+            {"name": "a", "import_path": "m:y"}]})
+
+
+def test_declarative_deploy_over_rest(ray_start_shared, tmp_path,
+                                      monkeypatch):
+    """POST /api/serve/deploy on the dashboard applies the config —
+    the CLI's `serve deploy` path (reference: dashboard REST deploy)."""
+    import json as _json
+    import sys as _sys
+    import urllib.request
+
+    from ray_tpu.dashboard import DashboardServer
+
+    monkeypatch.syspath_prepend(_write_app_module(tmp_path))
+    _sys.modules.pop("myserveapp", None)
+    rt = ray_start_shared
+    dash = DashboardServer(rt, port=0)
+    try:
+        body = _json.dumps({
+            "applications": [{"name": "restapp",
+                              "route_prefix": "/rest",
+                              "import_path": "myserveapp:app"}],
+        }).encode()
+        req = urllib.request.Request(
+            dash.url + "/api/serve/deploy", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = _json.load(resp)
+        assert out == {"deployed": ["restapp"]}
+        assert serve.get_app_handle("restapp").remote(3).result(
+            timeout_s=60) == 6
+    finally:
+        dash.stop()
+        serve.shutdown()
+        _sys.modules.pop("myserveapp", None)

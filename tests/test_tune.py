@@ -271,3 +271,106 @@ def test_tuner_over_jax_trainer(ray_start_shared, tmp_path):
         run_config=RunConfig(name="trainer_tune", storage_path=str(tmp_path)))
     grid = tuner.fit()
     assert grid.get_best_result().metrics["score"] == 0.0
+
+
+# --- external searcher seam (round 3; reference:
+#     tune/search/optuna/optuna_search.py:127) --------------------------
+
+class _FakeOptunaTrial:
+    def __init__(self, rng):
+        self._rng = rng
+        self.params = {}
+
+    def suggest_float(self, name, low, high, log=False):
+        v = self._rng.uniform(low, high)
+        self.params[name] = v
+        return v
+
+    def suggest_int(self, name, low, high):
+        v = self._rng.randint(low, high)
+        self.params[name] = v
+        return v
+
+    def suggest_categorical(self, name, choices):
+        v = self._rng.choice(list(choices))
+        self.params[name] = v
+        return v
+
+
+class _FakeOptunaStudy:
+    def __init__(self, rng):
+        self._rng = rng
+        self.asked = []
+        self.told = []
+
+    def ask(self):
+        t = _FakeOptunaTrial(self._rng)
+        self.asked.append(t)
+        return t
+
+    def tell(self, trial, value=None, state=None):
+        self.told.append((trial, value, state))
+
+
+def _install_fake_optuna(monkeypatch):
+    import sys as _sys
+    import types
+
+    fake = types.ModuleType("optuna")
+    fake._studies = []
+
+    def create_study(direction, sampler=None):
+        study = _FakeOptunaStudy(random.Random(0))
+        study.direction = direction
+        fake._studies.append(study)
+        return study
+
+    fake.create_study = create_study
+    fake.samplers = types.SimpleNamespace(
+        TPESampler=lambda seed=None: None)
+    fail = types.SimpleNamespace(FAIL="FAIL")
+    fake.trial = types.SimpleNamespace(TrialState=fail)
+    monkeypatch.setitem(_sys.modules, "optuna", fake)
+    return fake
+
+
+def test_optuna_search_adapter(monkeypatch):
+    fake = _install_fake_optuna(monkeypatch)
+    searcher = tune.OptunaSearch(num_samples=6, seed=0)
+    space = {"lr": tune.loguniform(1e-4, 1e-1),
+             "layers": tune.randint(1, 5),
+             "act": tune.choice(["relu", "tanh"]),
+             "fixed": 7}
+    searcher.set_search_properties("score", "max", space)
+    for i in range(6):
+        cfg = searcher.suggest(f"t{i}")
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        assert 1 <= cfg["layers"] <= 4  # [1, 5) exclusive upper
+        assert cfg["act"] in ("relu", "tanh")
+        assert cfg["fixed"] == 7
+        if i == 5:
+            searcher.on_trial_complete(f"t{i}", None)  # failure path
+        else:
+            searcher.on_trial_complete(f"t{i}", {"score": float(i)})
+    assert searcher.suggest("t6") is None  # num_samples exhausted
+    study = fake._studies[0]
+    assert study.direction == "maximize"
+    assert len(study.told) == 6
+    assert study.told[-1][2] == "FAIL"
+    values = [v for _, v, s in study.told if s is None]
+    assert values == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_optuna_search_drives_tuner(ray_start_shared, monkeypatch):
+    _install_fake_optuna(monkeypatch)
+
+    def loop(config):
+        tune.report({"score": -abs(config["x"] - 3.0)})
+
+    results = tune.run(
+        loop, config={"x": tune.uniform(0.0, 10.0)},
+        metric="score", mode="max", num_samples=4,
+        search_alg=tune.OptunaSearch(num_samples=4, seed=0))
+    best = results.get_best_result()
+    assert best.metrics["score"] <= 0.0
+    assert len(results) == 4
