@@ -1,0 +1,92 @@
+// ray_tpu C++ worker API — run C++ tasks and actors in a C++ worker
+// process (reference capability: cpp/include/ray/api.h — RAY_REMOTE
+// registration + ray::Task(...).Remote() executing in C++ workers; the
+// design here is ray_tpu's TLV worker channel, ray_tpu/capi.py kinds
+// 6/7/8).
+//
+//   static std::string Add(const std::string& args) { ... }
+//   RAY_TPU_REMOTE(Add);
+//
+//   class Counter : public ray_tpu::Actor {
+//    public:
+//     std::string Call(const std::string& method,
+//                      const std::string& args) override;
+//   };
+//   RAY_TPU_ACTOR(Counter);
+//
+//   int main() {
+//     ray_tpu::WorkerRuntime rt("127.0.0.1", 6379);
+//     rt.Run();  // serve executions until the head disconnects
+//   }
+
+#ifndef RAY_TPU_WORKER_API_H_
+#define RAY_TPU_WORKER_API_H_
+
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+
+namespace ray_tpu {
+
+using TaskFn = std::function<std::string(const std::string&)>;
+
+// Stateful C++ actor: one instance per actor_new, methods dispatched
+// by name through Call. Executions on one instance are serialized by
+// the worker's single-threaded loop (the ordering guarantee actors
+// need).
+class Actor {
+ public:
+  virtual ~Actor() = default;
+  virtual std::string Call(const std::string& method,
+                           const std::string& args) = 0;
+};
+
+using ActorFactory =
+    std::function<std::unique_ptr<Actor>(const std::string& args)>;
+
+// Process-wide registries (populated before WorkerRuntime::Run).
+void RegisterFunction(const std::string& name, TaskFn fn);
+void RegisterActorClass(const std::string& name, ActorFactory factory);
+
+namespace internal {
+struct Registrar {
+  Registrar(const std::string& name, TaskFn fn) {
+    RegisterFunction(name, std::move(fn));
+  }
+  Registrar(const std::string& name, ActorFactory factory) {
+    RegisterActorClass(name, std::move(factory));
+  }
+};
+}  // namespace internal
+
+#define RAY_TPU_REMOTE(fn) \
+  static ::ray_tpu::internal::Registrar ray_tpu_reg_##fn(#fn, fn)
+
+#define RAY_TPU_ACTOR(cls)                                          \
+  static ::ray_tpu::internal::Registrar ray_tpu_actor_##cls(        \
+      #cls, ::ray_tpu::ActorFactory([](const std::string& args) {   \
+        (void)args;                                                 \
+        return std::unique_ptr<::ray_tpu::Actor>(new cls());        \
+      }))
+
+// Connects to the head's TCP listener as a C++ worker, registers every
+// function/actor class, then serves EXEC frames until disconnect.
+class WorkerRuntime {
+ public:
+  WorkerRuntime(const std::string& host, int port);
+  ~WorkerRuntime();
+
+  // Blocks; returns when the head closes the connection.
+  void Run();
+
+ private:
+  int fd_;
+  uint64_t next_instance_ = 1;
+  std::map<uint64_t, std::unique_ptr<Actor>> instances_;
+};
+
+}  // namespace ray_tpu
+
+#endif  // RAY_TPU_WORKER_API_H_

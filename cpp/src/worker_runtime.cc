@@ -1,0 +1,205 @@
+// C++ worker runtime — executes registered C++ functions/actors for
+// the cluster (wire protocol: ray_tpu/capi.py kinds 6 EXEC-register,
+// 7 EXEC, 8 RESULT; reference capability: C++ workers behind
+// cpp/include/ray/api.h). Plain POSIX sockets, no dependencies.
+
+#include "ray_tpu/worker_api.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <vector>
+
+namespace ray_tpu {
+namespace {
+
+constexpr uint8_t kWorkerRegister = 6, kExec = 7, kResult = 8;
+constexpr uint8_t kOk = 0, kErr = 1;
+constexpr uint8_t kOpFn = 0, kOpActorNew = 1, kOpActorCall = 2,
+                  kOpActorDel = 3;
+constexpr uint32_t kVersion = 1;
+
+std::map<std::string, TaskFn>& Functions() {
+  static std::map<std::string, TaskFn> fns;
+  return fns;
+}
+
+std::map<std::string, ActorFactory>& ActorClasses() {
+  static std::map<std::string, ActorFactory> classes;
+  return classes;
+}
+
+void SendAll(int fd, const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n) {
+#ifdef MSG_NOSIGNAL
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+#else
+    ssize_t w = ::send(fd, p, n, 0);
+#endif
+    if (w <= 0) throw std::runtime_error("ray_tpu worker: send failed");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+bool RecvAll(int fd, void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;  // head closed: clean shutdown
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void SendFrame(int fd, const std::string& payload) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  char header[4];
+  memcpy(header, &len, 4);  // little-endian hosts (x86/arm64)
+  SendAll(fd, header, 4);
+  SendAll(fd, payload.data(), payload.size());
+}
+
+bool RecvFrame(int fd, std::string* out) {
+  char header[4];
+  if (!RecvAll(fd, header, 4)) return false;
+  uint32_t len;
+  memcpy(&len, header, 4);
+  out->assign(len, '\0');
+  return len == 0 || RecvAll(fd, &(*out)[0], len);
+}
+
+void Append(std::string* s, const void* data, size_t n) {
+  s->append(static_cast<const char*>(data), n);
+}
+
+}  // namespace
+
+void RegisterFunction(const std::string& name, TaskFn fn) {
+  Functions()[name] = std::move(fn);
+}
+
+void RegisterActorClass(const std::string& name, ActorFactory factory) {
+  ActorClasses()[name] = std::move(factory);
+}
+
+WorkerRuntime::WorkerRuntime(const std::string& host, int port) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_str = std::to_string(port);
+  if (getaddrinfo(host.c_str(), port_str.c_str(), &hints, &res) != 0) {
+    throw std::runtime_error("ray_tpu worker: cannot resolve " + host);
+  }
+  fd_ = -1;
+  for (auto* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd_ = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd_ < 0) continue;
+    if (::connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd_);
+    fd_ = -1;
+  }
+  freeaddrinfo(res);
+  if (fd_ < 0) throw std::runtime_error("ray_tpu worker: connect failed");
+
+  // magic handshake, then register every compiled-in entry point
+  std::string magic = "CAPI";
+  Append(&magic, &kVersion, 4);
+  SendFrame(fd_, magic);
+  std::string ack;
+  if (!RecvFrame(fd_, &ack) || ack.empty() || ack[0] != kOk) {
+    throw std::runtime_error("ray_tpu worker: handshake rejected");
+  }
+
+  std::string reg;
+  reg.push_back(static_cast<char>(kWorkerRegister));
+  uint16_t count = static_cast<uint16_t>(Functions().size()
+                                         + ActorClasses().size());
+  Append(&reg, &count, 2);
+  auto add_entry = [&reg](uint8_t entry_kind, const std::string& name) {
+    reg.push_back(static_cast<char>(entry_kind));
+    uint16_t len = static_cast<uint16_t>(name.size());
+    Append(&reg, &len, 2);
+    reg += name;
+  };
+  for (const auto& kv : Functions()) add_entry(0, kv.first);
+  for (const auto& kv : ActorClasses()) add_entry(1, kv.first);
+  SendFrame(fd_, reg);
+  if (!RecvFrame(fd_, &ack) || ack.empty() || ack[0] != kOk) {
+    throw std::runtime_error("ray_tpu worker: registration rejected");
+  }
+}
+
+WorkerRuntime::~WorkerRuntime() {
+  if (fd_ >= 0) ::close(fd_);
+}
+
+void WorkerRuntime::Run() {
+  std::string frame;
+  while (RecvFrame(fd_, &frame)) {
+    if (frame.empty() || frame[0] != kExec) continue;
+    // EXEC: u64 call_id, u8 op, u64 instance_id, u16 name_len, name,
+    // args
+    uint64_t call_id, instance_id;
+    uint8_t op;
+    uint16_t name_len;
+    size_t off = 1;
+    memcpy(&call_id, frame.data() + off, 8), off += 8;
+    memcpy(&op, frame.data() + off, 1), off += 1;
+    memcpy(&instance_id, frame.data() + off, 8), off += 8;
+    memcpy(&name_len, frame.data() + off, 2), off += 2;
+    std::string name = frame.substr(off, name_len);
+    std::string args = frame.substr(off + name_len);
+
+    uint8_t status = kOk;
+    std::string payload;
+    try {
+      if (op == kOpFn) {
+        auto it = Functions().find(name);
+        if (it == Functions().end()) {
+          throw std::runtime_error("unknown function " + name);
+        }
+        payload = it->second(args);
+      } else if (op == kOpActorNew) {
+        auto it = ActorClasses().find(name);
+        if (it == ActorClasses().end()) {
+          throw std::runtime_error("unknown actor class " + name);
+        }
+        uint64_t id = next_instance_++;
+        instances_[id] = it->second(args);
+        Append(&payload, &id, 8);
+      } else if (op == kOpActorCall) {
+        auto it = instances_.find(instance_id);
+        if (it == instances_.end()) {
+          throw std::runtime_error("dead or unknown actor instance");
+        }
+        payload = it->second->Call(name, args);
+      } else if (op == kOpActorDel) {
+        instances_.erase(instance_id);
+      } else {
+        throw std::runtime_error("unknown op");
+      }
+    } catch (const std::exception& e) {
+      status = kErr;
+      payload = e.what();
+    }
+
+    std::string result;
+    result.push_back(static_cast<char>(kResult));
+    Append(&result, &call_id, 8);
+    result.push_back(static_cast<char>(status));
+    result += payload;
+    SendFrame(fd_, result);
+  }
+}
+
+}  // namespace ray_tpu

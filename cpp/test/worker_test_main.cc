@@ -1,0 +1,47 @@
+// Test C++ worker: one function + one stateful actor, driven by
+// tests/test_cpp_api.py against a live head.
+
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+
+#include "ray_tpu/worker_api.h"
+
+static std::string Add(const std::string& args) {
+  // args = "a,b" decimal ints
+  auto comma = args.find(',');
+  long a = std::stol(args.substr(0, comma));
+  long b = std::stol(args.substr(comma + 1));
+  return std::to_string(a + b);
+}
+RAY_TPU_REMOTE(Add);
+
+static std::string Fail(const std::string& args) {
+  throw std::runtime_error("intentional C++ failure: " + args);
+}
+RAY_TPU_REMOTE(Fail);
+
+class Counter : public ray_tpu::Actor {
+ public:
+  std::string Call(const std::string& method,
+                   const std::string& args) override {
+    if (method == "incr") {
+      total_ += std::stol(args);
+      return std::to_string(total_);
+    }
+    if (method == "get") return std::to_string(total_);
+    throw std::runtime_error("unknown method " + method);
+  }
+
+ private:
+  long total_ = 0;
+};
+RAY_TPU_ACTOR(Counter);
+
+int main(int argc, char** argv) {
+  const char* host = argc > 1 ? argv[1] : "127.0.0.1";
+  int port = argc > 2 ? std::atoi(argv[2]) : 6379;
+  ray_tpu::WorkerRuntime rt(host, port);
+  rt.Run();
+  return 0;
+}

@@ -17,6 +17,19 @@ Frames (little-endian, length-prefixed like every head connection):
                                                → ok body = result bytes
          5 DROP  body = 16B object id          → ok body = empty
 
+C++ WORKER mode (reference: cpp/include/ray/api.h runs C++ tasks and
+actors in C++ worker processes; here a worker process registers its
+compiled functions/actor classes and the head pushes executions):
+  6 WORKER_REGISTER body = u16 count, then per entry:
+        u8 entry_kind (0 fn / 1 actor class), u16 name_len, name
+    → ok reply, after which the connection is a worker channel:
+  7 EXEC (head→worker, no reply frame — results arrive as kind 8):
+        u64 call_id, u8 op (0 fn / 1 actor_new / 2 actor_call /
+        3 actor_del), u64 instance_id, u16 name_len, name, args
+  8 RESULT (worker→head):
+        u64 call_id, u8 status, payload
+        (actor_new payload = u64 instance id)
+
 A connection opens with the magic frame b"CAPI" + u32 version, which is
 how the head tells a C client from a pickle-speaking peer (pickle
 frames start with 0x80).
@@ -45,8 +58,172 @@ CAPI_VERSION = 1
 KV_NAMESPACE = "capi_functions"
 
 _K_PUT, _K_GET, _K_CALL, _K_DROP = 2, 3, 4, 5
+_K_WORKER_REGISTER, _K_EXEC, _K_RESULT = 6, 7, 8
+_OP_FN, _OP_ACTOR_NEW, _OP_ACTOR_CALL, _OP_ACTOR_DEL = 0, 1, 2, 3
 ID_LEN = 16  # ObjectID.binary() length
 _OK, _ERR = 0, 1
+_EXEC_HEAD = struct.Struct("<QBQH")  # call_id, op, instance_id, name_len
+
+
+class CppWorkerError(RuntimeError):
+    """A C++ worker failed an execution (or died with calls in flight)."""
+
+
+class _CppWorker:
+    """Head-side record of one registered C++ worker connection."""
+
+    def __init__(self, session, functions, actor_classes):
+        self.session = session
+        self.functions = set(functions)
+        self.actor_classes = set(actor_classes)
+        self.pending: Dict[int, ObjectID] = {}  # call_id -> result oid
+        self.lock = threading.Lock()
+        self.alive = True
+
+    def send_exec(self, call_id: int, op: int, instance_id: int,
+                  name: str, args: bytes, result_oid: ObjectID) -> None:
+        encoded = name.encode()
+        with self.lock:
+            if not self.alive:
+                raise CppWorkerError("C++ worker connection is closed")
+            self.pending[call_id] = result_oid
+        frame = (bytes([_K_EXEC])
+                 + _EXEC_HEAD.pack(call_id, op, instance_id, len(encoded))
+                 + encoded + args)
+        self.session.send_locked(frame)
+
+
+class CppWorkerManager:
+    """Routes C++ task/actor executions to registered C++ workers
+    (reference: worker-side cpp/include/ray/api.h — normal tasks pick
+    any worker advertising the function; actor instances pin to the
+    worker that created them)."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self._workers: list = []
+        self._lock = threading.Lock()
+        self._call_seq = 0
+        self._rr = 0
+
+    # -- registry --------------------------------------------------------
+    def add_worker(self, worker: _CppWorker) -> None:
+        with self._lock:
+            self._workers.append(worker)
+
+    def remove_worker(self, worker: _CppWorker) -> None:
+        with self._lock:
+            if worker in self._workers:
+                self._workers.remove(worker)
+        with worker.lock:
+            worker.alive = False
+            pending = dict(worker.pending)
+            worker.pending.clear()
+        err = CppWorkerError("C++ worker died with calls in flight")
+        for oid in pending.values():
+            self.runtime.task_manager.put_error(oid, err)
+
+    def _pick(self, *, function: Optional[str] = None,
+              actor_class: Optional[str] = None) -> _CppWorker:
+        with self._lock:
+            candidates = [w for w in self._workers
+                          if (function in w.functions if function
+                              else actor_class in w.actor_classes)]
+            if not candidates:
+                what = function or actor_class
+                raise CppWorkerError(
+                    f"no connected C++ worker provides {what!r}")
+            self._rr += 1
+            return candidates[self._rr % len(candidates)]
+
+    def _next_call(self) -> int:
+        with self._lock:
+            self._call_seq += 1
+            return self._call_seq
+
+    # -- submissions -----------------------------------------------------
+    def submit_task(self, name: str, args: bytes) -> ObjectRef:
+        worker = self._pick(function=name)
+        return self._submit(worker, _OP_FN, 0, name, args)
+
+    def create_actor(self, class_name: str,
+                     args: bytes = b"") -> "CppActorHandle":
+        worker = self._pick(actor_class=class_name)
+        ref = self._submit(worker, _OP_ACTOR_NEW, 0, class_name, args)
+        raw = self.runtime.get(ref, timeout=60)
+        (instance_id,) = struct.unpack("<Q", raw)
+        return CppActorHandle(self, worker, class_name, instance_id)
+
+    def _submit(self, worker: _CppWorker, op: int, instance_id: int,
+                name: str, args: bytes) -> ObjectRef:
+        call_id = self._next_call()
+        oid = ObjectID.from_random()
+        # ObjectRef's constructor registers the local reference; the
+        # returned handle is the only pin, so results free when the
+        # caller drops it.
+        ref = ObjectRef(oid)
+        worker.send_exec(call_id, op, instance_id, name, args, oid)
+        return ref
+
+    # -- results (called from the worker session's reader thread) -------
+    def on_result(self, worker: _CppWorker, body: bytes) -> None:
+        call_id, status = struct.unpack_from("<QB", body, 0)
+        payload = bytes(body[9:])
+        with worker.lock:
+            oid = worker.pending.pop(call_id, None)
+        if oid is None:
+            return  # cancelled/duplicate
+        rt = self.runtime
+        if status != _OK:
+            rt.task_manager.put_error(
+                oid, CppWorkerError(payload.decode(errors="replace")))
+            return
+        data, buffers = serialization.serialize(payload)
+        rt.store_packed_object(oid,
+                               serialization.pack_parts(data, buffers))
+
+
+class CppActorHandle:
+    """Handle to a C++ actor instance, pinned to its worker
+    (reference: ray::Actor(...).Remote() handles in cpp/ api.h)."""
+
+    def __init__(self, manager: CppWorkerManager, worker: _CppWorker,
+                 class_name: str, instance_id: int):
+        self._manager = manager
+        self._worker = worker
+        self.class_name = class_name
+        self.instance_id = instance_id
+
+    def call(self, method: str, args: bytes = b"") -> ObjectRef:
+        return self._manager._submit(
+            self._worker, _OP_ACTOR_CALL, self.instance_id, method, args)
+
+    def kill(self) -> None:
+        try:
+            self._manager._submit(
+                self._worker, _OP_ACTOR_DEL, self.instance_id, "", b"")
+        except CppWorkerError:
+            pass  # worker already gone
+
+
+def get_cpp_worker_manager(runtime=None) -> CppWorkerManager:
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime or runtime_mod.get_runtime()
+    manager = getattr(rt, "_cpp_worker_manager", None)
+    if manager is None:
+        manager = rt._cpp_worker_manager = CppWorkerManager(rt)
+    return manager
+
+
+def cpp_task(name: str, args: bytes = b"") -> ObjectRef:
+    """Run a function registered by a connected C++ worker; resolve the
+    result with ray_tpu.get (bytes)."""
+    return get_cpp_worker_manager().submit_task(name, bytes(args))
+
+
+def cpp_actor(class_name: str, args: bytes = b"") -> CppActorHandle:
+    """Instantiate a C++ actor class on a connected C++ worker."""
+    return get_cpp_worker_manager().create_actor(class_name, bytes(args))
 
 
 def register_function(name: str, fn: Callable[[bytes], bytes]) -> None:
@@ -73,9 +250,18 @@ class CapiSession:
         self._fn_cache: Dict[str, object] = {}
         self._held: set = set()
         self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._worker: Optional[_CppWorker] = None
 
     def _reply(self, status: int, body: bytes = b"") -> None:
-        send_frame(self.sock, bytes([status]) + body)
+        with self._send_lock:
+            send_frame(self.sock, bytes([status]) + body)
+
+    def send_locked(self, frame: bytes) -> None:
+        """Push a frame (EXEC) from any thread; serialized against
+        replies on this connection."""
+        with self._send_lock:
+            send_frame(self.sock, frame)
 
     def serve(self) -> None:
         try:
@@ -143,6 +329,31 @@ class CapiSession:
                     self.runtime.reference_counter \
                         .remove_local_reference(oid)
             self._reply(_OK, b"")
+        elif kind == _K_WORKER_REGISTER:
+            (count,) = struct.unpack_from("<H", body, 0)
+            offset = 2
+            functions, actor_classes = [], []
+            for _ in range(count):
+                entry_kind = body[offset]
+                (name_len,) = struct.unpack_from("<H", body, offset + 1)
+                offset += 3
+                name = body[offset:offset + name_len].decode()
+                offset += name_len
+                (actor_classes if entry_kind == 1
+                 else functions).append(name)
+            self._worker = _CppWorker(self, functions, actor_classes)
+            # Ack BEFORE publishing to the manager: once the worker is
+            # visible, another thread may push an EXEC frame, and the
+            # worker's constructor must not read that frame as its
+            # registration ack.
+            self._reply(_OK, b"")
+            get_cpp_worker_manager(self.runtime).add_worker(self._worker)
+        elif kind == _K_RESULT:
+            if self._worker is None:
+                raise ValueError("RESULT frame before WORKER_REGISTER")
+            # no reply: results flow head-ward only
+            get_cpp_worker_manager(self.runtime).on_result(
+                self._worker, body)
         else:
             raise ValueError(f"unknown C-API request kind {kind}")
 
@@ -179,6 +390,10 @@ class CapiSession:
         return self.runtime.get(ref, timeout=300)
 
     def close(self) -> None:
+        if self._worker is not None:
+            get_cpp_worker_manager(self.runtime).remove_worker(
+                self._worker)
+            self._worker = None
         with self._lock:
             held = list(self._held)
             self._held.clear()

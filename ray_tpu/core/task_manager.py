@@ -237,6 +237,14 @@ class TaskManager:
     def mark_object_ready(self, object_id: ObjectID) -> None:
         self.set_location_and_ready(object_id, None)
 
+    def put_error(self, object_id: ObjectID, error: Exception) -> None:
+        """Resolve an object as failed — get() raises ``error``. For
+        results produced outside the task path (e.g. C++ worker calls,
+        reference: task_manager.h error-object storage)."""
+        with self._lock:
+            self._errors[object_id] = error
+        self.mark_object_ready(object_id)
+
     def set_location_and_ready(self, object_id: ObjectID,
                                location: Optional[ObjectLocation]) -> None:
         """Record the primary-copy location and flip readiness under ONE

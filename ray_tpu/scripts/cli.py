@@ -141,8 +141,17 @@ def cmd_serve(args) -> None:
                       file=sys.stderr)
                 sys.exit(1)
             import yaml
-            with open(args.config) as f:
-                config = yaml.safe_load(f)
+            try:
+                with open(args.config) as f:
+                    config = yaml.safe_load(f)
+            except OSError as err:
+                print(f"cannot read {args.config}: {err}",
+                      file=sys.stderr)
+                sys.exit(1)
+            except yaml.YAMLError as err:
+                print(f"invalid YAML in {args.config}: {err}",
+                      file=sys.stderr)
+                sys.exit(1)
             req = urllib.request.Request(
                 url + "/api/serve/deploy",
                 data=json.dumps(config).encode(),

@@ -127,26 +127,35 @@ def _import_target(import_path: str):
     return target
 
 
-def _apply_overrides(app, overrides: Dict[str, Dict[str, Any]]):
-    """Rebuild a bound Application graph with per-deployment option
-    overrides applied by deployment name (reference: schema.py
-    deployment overrides merged over the code-declared options)."""
+def _map_deployments(app, transform):
+    """Rebuild a bound Application graph with ``transform(deployment)``
+    applied to every node (the one graph-walk shape shared by override
+    and runtime_env application)."""
     from ray_tpu.serve.deployment import Application
 
-    applied = set()
-
     def visit(node: Application) -> Application:
-        dep = node.deployment
-        if dep.name in overrides:
-            applied.add(dep.name)
-            dep = dep.options(**overrides[dep.name])
+        dep = transform(node.deployment)
         args = tuple(visit(a) if isinstance(a, Application) else a
                      for a in node.args)
         kwargs = {k: (visit(v) if isinstance(v, Application) else v)
                   for k, v in node.kwargs.items()}
         return Application(dep, args, kwargs)
 
-    out = visit(app)
+    return visit(app)
+
+
+def _apply_overrides(app, overrides: Dict[str, Dict[str, Any]]):
+    """Per-deployment option overrides by name (reference: schema.py
+    deployment overrides merged over the code-declared options)."""
+    applied = set()
+
+    def transform(dep):
+        if dep.name in overrides:
+            applied.add(dep.name)
+            return dep.options(**overrides[dep.name])
+        return dep
+
+    out = _map_deployments(app, transform)
     missing = set(overrides) - applied
     if missing:
         raise ValueError(
@@ -188,21 +197,15 @@ def _apply_runtime_env(app, runtime_env: Dict[str, Any]):
     """Application-level runtime_env: every replica actor inherits it
     via ray_actor_options unless a deployment set its own (reference:
     ServeApplicationSchema.runtime_env applied per deployment)."""
-    from ray_tpu.serve.deployment import Application
 
-    def visit(node: Application) -> Application:
-        dep = node.deployment
+    def transform(dep):
         opts = dict(dep.config.ray_actor_options)
-        if "runtime_env" not in opts:
-            opts["runtime_env"] = dict(runtime_env)
-            dep = dep.options(ray_actor_options=opts)
-        args = tuple(visit(a) if isinstance(a, Application) else a
-                     for a in node.args)
-        kwargs = {k: (visit(v) if isinstance(v, Application) else v)
-                  for k, v in node.kwargs.items()}
-        return Application(dep, args, kwargs)
+        if "runtime_env" in opts:
+            return dep
+        opts["runtime_env"] = dict(runtime_env)
+        return dep.options(ray_actor_options=opts)
 
-    return visit(app)
+    return _map_deployments(app, transform)
 
 
 def deploy_config(config: Dict[str, Any]) -> List[str]:

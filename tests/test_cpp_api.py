@@ -90,3 +90,99 @@ def test_capi_objects_visible_to_python_tasks(tmp_path):
         sock.close()
     finally:
         ray_tpu.shutdown()
+
+
+# --- C++ WORKER-side tasks/actors (round 3; reference capability:
+#     cpp/include/ray/api.h running C++ tasks/actors in C++ workers) ----
+
+def _build_worker_binary(tmp_path) -> str:
+    out = str(tmp_path / "cpp_worker")
+    cmd = [
+        "g++", "-O1", "-g", "-std=c++17", "-Wall",
+        "-I", os.path.join(_REPO, "cpp", "include"),
+        os.path.join(_REPO, "cpp", "src", "worker_runtime.cc"),
+        os.path.join(_REPO, "cpp", "test", "worker_test_main.cc"),
+        "-o", out,
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    return out
+
+
+def _wait_worker_registered(rt, timeout=30.0):
+    import time
+    manager = capi.get_cpp_worker_manager(rt)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with manager._lock:
+            if manager._workers:
+                return manager
+        time.sleep(0.05)
+    raise TimeoutError("C++ worker never registered")
+
+
+@needs_gxx
+def test_cpp_worker_tasks_and_actors(tmp_path):
+    binary = _build_worker_binary(tmp_path)
+    rt = ray_tpu.init(num_cpus=2, head_port=0)
+    worker = None
+    try:
+        host, port = rt.head_address.split(":")
+        worker = subprocess.Popen([binary, host, port])
+        _wait_worker_registered(rt)
+
+        # task: executed by compiled C++ code in the worker process
+        ref = capi.cpp_task("Add", b"40,2")
+        assert ray_tpu.get(ref, timeout=30) == b"42"
+
+        # C++ exception -> Python-side CppWorkerError with the message
+        with pytest.raises(capi.CppWorkerError, match="intentional"):
+            ray_tpu.get(capi.cpp_task("Fail", b"boom"), timeout=30)
+
+        # after a failure, the worker keeps serving
+        assert ray_tpu.get(capi.cpp_task("Add", b"1,2"), timeout=30) == b"3"
+
+        # stateful actor: ordered methods on one instance
+        counter = capi.cpp_actor("Counter")
+        refs = [counter.call("incr", b"5"), counter.call("incr", b"7")]
+        assert [ray_tpu.get(r, timeout=30) for r in refs] == [b"5", b"12"]
+        assert ray_tpu.get(counter.call("get"), timeout=30) == b"12"
+
+        # a second instance is independent state
+        other = capi.cpp_actor("Counter")
+        assert ray_tpu.get(other.call("get"), timeout=30) == b"0"
+        counter.kill()
+        with pytest.raises(capi.CppWorkerError):
+            ray_tpu.get(counter.call("get"), timeout=30)
+
+        # unknown function: routed nowhere, clear error
+        with pytest.raises(capi.CppWorkerError, match="no connected"):
+            capi.cpp_task("Nope", b"")
+    finally:
+        if worker is not None:
+            worker.kill()
+            worker.wait(timeout=10)
+        ray_tpu.shutdown()
+
+
+@needs_gxx
+def test_cpp_worker_death_fails_inflight(tmp_path):
+    import time
+
+    binary = _build_worker_binary(tmp_path)
+    rt = ray_tpu.init(num_cpus=2, head_port=0)
+    try:
+        host, port = rt.head_address.split(":")
+        worker = subprocess.Popen([binary, host, port])
+        _wait_worker_registered(rt)
+        counter = capi.cpp_actor("Counter")
+        assert ray_tpu.get(counter.call("incr", b"1"), timeout=30) == b"1"
+        # kill mid-flight: a pending call must fail, not hang
+        ref = counter.call("incr", b"1")
+        worker.kill()
+        worker.wait(timeout=10)
+        time.sleep(0.5)  # let the head observe the EOF
+        with pytest.raises(capi.CppWorkerError):
+            ray_tpu.get(ref, timeout=30)
+    finally:
+        ray_tpu.shutdown()
