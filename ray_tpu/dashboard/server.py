@@ -5,7 +5,7 @@ modules (reference: python/ray/dashboard/head.py; modules/node, actor,
 job, log, metrics; state aggregation via state_aggregator.py → the
 ``ray.util.state`` API). Routes:
 
-  GET /                      — HTML summary page (auto-refreshing)
+  GET /                      — web UI (vanilla-JS SPA, client.html)
   GET /api/cluster           — resources total/available, head address
   GET /api/nodes             — node table
   GET /api/actors            — actor table
@@ -29,75 +29,23 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 from urllib.parse import parse_qs, urlparse
 
-_INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title>
-<style>
- body { font-family: system-ui, sans-serif; margin: 2em; color: #222; }
- h1 { font-size: 1.3em; } h2 { font-size: 1.05em; margin-top: 1.4em; }
- table { border-collapse: collapse; font-size: 0.85em; }
- td, th { border: 1px solid #ccc; padding: 4px 8px; text-align: left; }
- th { background: #f0f0f0; }
- code { background: #f6f6f6; padding: 1px 4px; }
-</style></head><body>
-<h1>ray_tpu dashboard</h1>
-<div id=cluster></div>
-<h2>Nodes</h2><table id=nodes></table>
-<h2>Actors</h2><table id=actors></table>
-<h2>Task states</h2><table id=summary></table>
-<h2>Jobs</h2><table id=jobs></table>
-<p>API: <code>/api/nodes</code> <code>/api/actors</code>
-<code>/api/tasks</code> <code>/api/objects</code> <code>/api/jobs</code>
-<code>/api/logs</code> <code>/metrics</code></p>
-<script>
-async function grab(u){ return (await fetch(u)).json(); }
-function table(el, rows){
-  // textContent, never innerHTML: task/actor names and error strings
-  // are user-controlled and must not execute as markup
-  el.replaceChildren();
-  if(!rows.length){
-    const tr = document.createElement('tr');
-    const td = document.createElement('td');
-    td.textContent = 'none'; tr.appendChild(td); el.appendChild(tr);
-    return;
-  }
-  const keys = Object.keys(rows[0]);
-  const head = document.createElement('tr');
-  for(const k of keys){
-    const th = document.createElement('th');
-    th.textContent = k; head.appendChild(th);
-  }
-  el.appendChild(head);
-  for(const r of rows){
-    const tr = document.createElement('tr');
-    for(const k of keys){
-      const td = document.createElement('td');
-      td.textContent = JSON.stringify(r[k]); tr.appendChild(td);
-    }
-    el.appendChild(tr);
-  }
-}
-async function refresh(){
-  const c = await grab('/api/cluster');
-  const cl = document.getElementById('cluster');
-  cl.replaceChildren();
-  for(const [label, text] of [
-      ['head: ', c.head_address || 'local'],
-      [' available: ', JSON.stringify(c.available)],
-      [' of ', JSON.stringify(c.total)]]){
-    const b = document.createElement('b'); b.textContent = label;
-    const code = document.createElement('code'); code.textContent = text;
-    cl.appendChild(b); cl.appendChild(code);
-  }
-  table(document.getElementById('nodes'), await grab('/api/nodes'));
-  table(document.getElementById('actors'), await grab('/api/actors'));
-  const s = await grab('/api/summary');
-  table(document.getElementById('summary'),
-        Object.entries(s).map(([k,v])=>({state:k, count:v})));
-  table(document.getElementById('jobs'), await grab('/api/jobs'));
-}
-refresh(); setInterval(refresh, 3000);
-</script></body></html>
-"""
+_client_html_cache: Optional[str] = None
+
+
+def _client_html() -> str:
+    """The web UI is a standalone vanilla-JS SPA (reference capability:
+    the React client under python/ray/dashboard/client — multi-view
+    cluster console; here dependency-free, served from one file).
+    Loaded lazily on the first GET / so a missing file degrades that
+    request, never the dashboard module import (which ray_tpu.init
+    performs even with the dashboard disabled)."""
+    global _client_html_cache
+    if _client_html_cache is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "client.html")
+        with open(path, encoding="utf-8") as f:
+            _client_html_cache = f.read()
+    return _client_html_cache
 
 
 class DashboardServer:
@@ -203,7 +151,7 @@ class DashboardServer:
         from ray_tpu.util import state as state_api
 
         if path == "/":
-            return self._send(req, _INDEX_HTML, "text/html")
+            return self._send(req, _client_html(), "text/html")
         if path == "/metrics":
             from ray_tpu.util.metrics import prometheus_text
             return self._send(req, prometheus_text(),
