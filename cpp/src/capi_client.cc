@@ -9,6 +9,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -102,6 +103,9 @@ void Client::Connect(const std::string& host, int port,
   std::string hello = "CAPI";
   hello.resize(8);
   memcpy(&hello[4], &kVersion, 4);
+  // shared-secret auth: the token (if the cluster requires one) rides
+  // after magic+version; RTPU_AUTH_TOKEN matches the head's config
+  if (const char* token = ::getenv("RTPU_AUTH_TOKEN")) hello += token;
   SendFrame(fd_, hello);
   std::string reply = RecvFrame(fd_);
   if (reply.empty() || reply[0] != kOk) {

@@ -11,6 +11,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cstdlib>
 #include <cstring>
 #include <stdexcept>
 #include <vector>
@@ -111,9 +112,11 @@ WorkerRuntime::WorkerRuntime(const std::string& host, int port) {
   freeaddrinfo(res);
   if (fd_ < 0) throw std::runtime_error("ray_tpu worker: connect failed");
 
-  // magic handshake, then register every compiled-in entry point
+  // magic handshake (+ shared-secret token when the cluster requires
+  // one), then register every compiled-in entry point
   std::string magic = "CAPI";
   Append(&magic, &kVersion, 4);
+  if (const char* token = ::getenv("RTPU_AUTH_TOKEN")) magic += token;
   SendFrame(fd_, magic);
   std::string ack;
   if (!RecvFrame(fd_, &ack) || ack.empty() || ack[0] != kOk) {

@@ -271,6 +271,14 @@ class CapiSession:
                     != CAPI_VERSION):
                 self._reply(_ERR, b"unsupported C-API version")
                 return
+            from ray_tpu.core.config import (auth_token_matches,
+                                             get_config)
+            if get_config().auth_token:
+                # token rides after the magic+version (absent = empty);
+                # compared as raw bytes — this frame is never unpickled
+                if not auth_token_matches(self._first[8:]):
+                    self._reply(_ERR, b"authentication failed")
+                    return
             self._reply(_OK, b"")
             while True:
                 frame = recv_frame(self.sock)

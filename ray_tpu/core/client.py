@@ -103,6 +103,13 @@ class ClientRuntime:
         host, port = parse_address(address)
         self.address = address
         self.conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
+        from ray_tpu.core.config import get_config
+        token = get_config().auth_token
+        if token:
+            # plaintext auth frame BEFORE any pickled message (the head
+            # refuses to unpickle from unauthenticated peers)
+            from ray_tpu.core.protocol import send_frame
+            send_frame(self.conn.sock, b"AUTH" + token.encode("utf-8"))
         self.conn.send({"kind": "CLIENT_REGISTER",
                         "proto_version": PROTOCOL_VERSION,
                         "namespace": namespace})

@@ -77,6 +77,11 @@ class Cluster:
             os.path.dirname(os.path.abspath(__file__))))
         env = dict(os.environ)
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        from ray_tpu.core.config import get_config
+        if get_config().auth_token:
+            # a token set via system_config (not env) must still reach
+            # the daemon, or every join is rejected
+            env["RTPU_AUTH_TOKEN"] = get_config().auth_token
         proc = subprocess.Popen(cmd, env=env)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:

@@ -92,6 +92,14 @@ class Config:
     # Chunk size for node-to-node object transfer (reference: chunked
     # push/pull, object_manager.proto:63-66).
     object_chunk_size: int = 1024 * 1024
+    # Shared-secret authentication for cross-host connections
+    # (reference: src/ray/rpc/authentication/ — cluster-wide token).
+    # When set on the head (RTPU_AUTH_TOKEN), peers must open with a
+    # plaintext AUTH frame carrying the same token — validated BEFORE
+    # the head deserializes anything from the connection (pickle from
+    # an unauthenticated peer would be code execution). Empty = open
+    # cluster (the default, matching the reference's default).
+    auth_token: str = ""
     # Max concurrent inbound pulls an object server admits
     # (reference: pull_manager.h:50 admission control).
     object_pull_concurrency: int = 8
@@ -140,6 +148,23 @@ class Config:
 
 _config_lock = threading.Lock()
 _config: Config | None = None
+
+
+def auth_token_matches(supplied) -> bool:
+    """Constant-time check of a peer-supplied token (bytes or str)
+    against the configured cluster token. The ONE comparison both the
+    pickle and C-API handshake paths use — always over bytes, so
+    non-ASCII tokens or garbage peer input can't raise out of the
+    session thread (hmac.compare_digest on str is ASCII-only)."""
+    import hmac
+    required = get_config().auth_token.encode("utf-8")
+    if supplied is None:
+        supplied = b""
+    elif isinstance(supplied, str):
+        supplied = supplied.encode("utf-8", "replace")
+    elif not isinstance(supplied, (bytes, bytearray)):
+        return False
+    return hmac.compare_digest(bytes(supplied), required)
 
 
 def get_config() -> Config:

@@ -156,6 +156,12 @@ class NodeDaemon:
 
         host, port = parse_address(head_address)
         self.conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
+        token = get_config().auth_token
+        if token:
+            # plaintext auth frame BEFORE any pickled message (the head
+            # refuses to unpickle from unauthenticated peers)
+            from ray_tpu.core.protocol import send_frame
+            send_frame(self.conn.sock, b"AUTH" + token.encode("utf-8"))
         self.proxy = HeadProxy(self.conn)
         self.node_id = NodeID.from_random()
         if resources is None:

@@ -301,15 +301,43 @@ class HeadServer:
         # The first frame decides the peer's codec: C-API clients open
         # with the b"CAPI" magic (binary TLV, any language); everything
         # else is a pickled dict (nodes, Python clients).
-        from ray_tpu.core.protocol import recv_frame
+        from ray_tpu.core.protocol import recv_frame, send_frame
         first = recv_frame(conn.sock)
         if first is None:
             conn.close()
             return
         if first[:4] == b"CAPI":
+            # C-API peers authenticate inside their own (binary,
+            # never-unpickled) handshake.
             from ray_tpu.capi import CapiSession
             CapiSession(self.runtime, conn.sock, first).serve()
             return
+        # Auth gate BEFORE any unpickling: deserializing bytes from an
+        # unauthenticated peer would be arbitrary code execution
+        # (pickle). With a token configured, the first frame must be
+        # the plaintext b"AUTH" + token; only then is the next frame
+        # parsed (reference: rpc/authentication/ token middleware).
+        from ray_tpu.core.config import auth_token_matches, get_config
+        if get_config().auth_token:
+            if first[:4] != b"AUTH" or not auth_token_matches(first[4:]):
+                try:
+                    send_frame(conn.sock, serialization.dumps_fast(
+                        {"kind": "REGISTER_REJECTED",
+                         "reason": "authentication failed"}))
+                except OSError:
+                    pass
+                conn.close()
+                return
+            first = recv_frame(conn.sock)
+            if first is None:
+                conn.close()
+                return
+        elif first[:4] == b"AUTH":
+            # peer supplies a token the head doesn't require: accept
+            first = recv_frame(conn.sock)
+            if first is None:
+                conn.close()
+                return
         try:
             pending = [serialization.loads(first)]
         except Exception:  # noqa: BLE001 — garbage frame (port probe,

@@ -202,3 +202,88 @@ def test_daemon_death_without_retries_fails_task(tcp_cluster):
     _kill_daemon(proc)
     with pytest.raises(WorkerCrashedError):
         ray_tpu.get(ref, timeout=60)
+
+
+def test_auth_token_gates_cross_host_connections(monkeypatch, tmp_path):
+    """Shared-secret auth (reference: src/ray/rpc/authentication/):
+    with RTPU_AUTH_TOKEN set on the head, daemons and clients carrying
+    the wrong token are rejected at the handshake; matching tokens
+    join normally."""
+    import json
+    import subprocess
+    import sys
+
+    import ray_tpu
+
+    monkeypatch.setenv("RTPU_AUTH_TOKEN", "s3cret")
+    rt = ray_tpu.init(num_cpus=1, head_port=0)
+    try:
+        base_env = dict(os.environ)
+        base_env["PYTHONPATH"] = os.getcwd()
+
+        # wrong token: daemon registration rejected, process exits != 0
+        bad = dict(base_env, RTPU_AUTH_TOKEN="wrong")
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+             "--address", rt.head_address,
+             "--resources", json.dumps({"CPU": 1})],
+            env=bad, capture_output=True, text=True, timeout=60)
+        assert proc.returncode != 0
+        assert "authentication failed" in (proc.stderr + proc.stdout)
+        assert len(rt.nodes) == 1  # nothing joined
+
+        # Unauthenticated bytes are NEVER unpickled: a pickle whose
+        # loads() would have side effects must leave no trace (pickle
+        # from an untrusted peer is code execution; the auth gate runs
+        # on the plaintext frame first).
+        import pickle
+        import socket as socket_mod
+
+        from ray_tpu.core.protocol import recv_frame, send_frame
+
+        class _Canary:
+            def __reduce__(self):
+                return (open, (str(tmp_path / "pwned"), "w"))
+
+        host, port_str = rt.head_address.split(":")
+        sock = socket_mod.create_connection((host, int(port_str)),
+                                            timeout=10)
+        send_frame(sock, pickle.dumps({"kind": "NODE_REGISTER",
+                                       "canary": _Canary()}))
+        reply = recv_frame(sock)  # rejected (pickled reply is fine out)
+        sock.close()
+        assert reply is not None and b"authentication failed" in reply
+        assert not (tmp_path / "pwned").exists(), \
+            "head unpickled bytes from an unauthenticated peer"
+
+        # wrong token: client rejected too
+        client_probe = (
+            "import ray_tpu\n"
+            f"ray_tpu.init(address={rt.head_address!r})\n")
+        proc = subprocess.run([sys.executable, "-c", client_probe],
+                              env=bad, capture_output=True, text=True,
+                              timeout=60)
+        assert proc.returncode != 0
+        assert "authentication failed" in (proc.stderr + proc.stdout)
+
+        # matching token: joins and runs work
+        good = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+             "--address", rt.head_address,
+             "--resources", json.dumps({"CPU": 1, "authed": 1.0})],
+            env=base_env)
+        try:
+            deadline = time.time() + 30
+            while len(rt.nodes) < 2 and time.time() < deadline:
+                time.sleep(0.1)
+            assert len(rt.nodes) == 2
+
+            @ray_tpu.remote(resources={"authed": 0.1})
+            def f():
+                return "ok"
+
+            assert ray_tpu.get(f.remote(), timeout=30) == "ok"
+        finally:
+            _kill_daemon(good)
+    finally:
+        ray_tpu.shutdown()
