@@ -10,6 +10,7 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.connectors import (
@@ -28,7 +29,8 @@ from ray_tpu.rl import spaces
 __all__ = [
     "APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "CartPole",
     "CartPoleJax", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
-    "Env", "FrameStack", "JaxEnv", "JaxEnvRunner", "Learner",
+    "Env", "FrameStack", "IMPALA", "IMPALAConfig", "JaxEnv",
+    "JaxEnvRunner", "Learner",
     "LearnerGroup", "MARWIL", "MARWILConfig", "MultiAgentEnv",
     "MultiAgentEnvRunner", "ObsNormalizer",
     "OfflineData", "PPO", "PPOConfig", "Pendulum", "RLModuleSpec",

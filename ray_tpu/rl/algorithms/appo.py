@@ -49,10 +49,20 @@ class APPO(PPO):
         ref = self.runners[idx].sample.remote()
         self._inflight[ref] = idx
 
+    # -- fragment hooks (IMPALA overrides both: V-trace consumes the
+    #    fragments time-major, without GAE or shuffled SGD epochs) -----
+    def _prepare_fragment(self, cols, weights):
+        return self._postprocess(cols, weights)
+
+    def _train_fragments(self, batches) -> Dict[str, Any]:
+        from ray_tpu.rl.sample_batch import concat_samples
+        batch = concat_samples(batches)
+        self._env_steps_lifetime += len(batch)
+        return self._sgd_epochs(batch)
+
     def training_step(self) -> Dict[str, Any]:
         import ray_tpu
         from ray_tpu.core import serialization
-        from ray_tpu.rl.sample_batch import concat_samples
 
         cfg = self.config
         weights = self.learner_group.get_weights()
@@ -118,13 +128,11 @@ class APPO(PPO):
             # kill healthy actors as misattributed "runner failures"
             cols, runner_metrics, delta = payload
             self.record_episodes(runner_metrics["episode_returns"])
-            batches.append(self._postprocess(cols, weights))
+            batches.append(self._prepare_fragment(cols, weights))
             deltas.append(delta)
             consumed += 1
         if batches:
-            batch = concat_samples(batches)
-            self._env_steps_lifetime += len(batch)
-            metrics = self._sgd_epochs(batch)
+            metrics = self._train_fragments(batches)
         if (self._connector_template is not None and deltas):
             # deltas arrived WITH the sample payloads (no extra round
             # trip — a gather here would barrier on in-flight samples)

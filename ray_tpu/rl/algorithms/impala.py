@@ -1,0 +1,159 @@
+"""IMPALA — importance-weighted actor-learner architecture.
+
+Reference: rllib/algorithms/impala/ — decoupled actors sample with a
+stale behavior policy while the learner trains continuously; V-trace
+(Espeholt et al. 2018) corrects the off-policyness with truncated
+importance weights, giving n-step value targets that contract to the
+target policy's value function. The actor/learner plumbing is shared
+with APPO (same async fragment loop); only the loss and the batch
+layout differ: V-trace's recursion needs TIME-MAJOR [T, N] fragments,
+so IMPALA trains one pass per fragment without GAE or shuffled
+minibatch epochs. The whole V-trace computation jits — on TPU the
+scan lowers to one fused XLA while-loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.sample_batch import (
+    ACTIONS, DONES, FINAL_OBS, LOGP, OBS, REWARDS, TRUNCATEDS)
+
+
+def vtrace_returns(log_rhos, discounts, rewards, values, bootstrap_value,
+                   *, clip_rho_threshold: float = 1.0,
+                   clip_pg_rho_threshold: float = 1.0):
+    """V-trace targets ``vs`` and policy-gradient advantages over
+    time-major [T, N] columns (reference:
+    rllib/algorithms/impala/vtrace; Espeholt et al. 2018 eq. 1).
+    jit/grad-safe — callers stop_gradient as needed."""
+    import jax
+    import jax.numpy as jnp
+
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho_threshold, rhos)
+    cs = jnp.minimum(1.0, rhos)
+    next_values = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * next_values - values)
+
+    def scan_fn(acc, inp):
+        delta, discount, c = inp
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho_threshold, rhos)
+    pg_advantages = clipped_pg_rhos * (
+        rewards + discounts * next_vs - values)
+    return vs, pg_advantages
+
+
+class IMPALAConfig(APPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_rho_threshold = 1.0
+        self.clip_pg_rho_threshold = 1.0
+        self.lr = 5e-4
+        self.entropy_coeff = 0.01
+        self.vf_loss_coeff = 0.5
+
+
+class IMPALALearner(Learner):
+    def __init__(self, module_spec, *, gamma: float = 0.99,
+                 clip_rho_threshold: float = 1.0,
+                 clip_pg_rho_threshold: float = 1.0,
+                 vf_loss_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, **kwargs):
+        self.gamma = gamma
+        self.clip_rho_threshold = clip_rho_threshold
+        self.clip_pg_rho_threshold = clip_pg_rho_threshold
+        self.vf_loss_coeff = vf_loss_coeff
+        self.entropy_coeff = entropy_coeff
+        super().__init__(module_spec, **kwargs)
+
+    def loss(self, params, batch):
+        import jax
+        import jax.numpy as jnp
+
+        dist, values = self.spec.forward(params, batch[OBS])  # [T, N]
+        logp = dist.log_prob(batch[ACTIONS])
+        log_rhos = logp - batch[LOGP]  # current vs behavior policy
+        dones = jnp.asarray(batch[DONES], jnp.float32)
+        # truncated episodes bootstrap from the true next obs (time
+        # limits are not terminations)
+        v_final = jax.lax.stop_gradient(
+            self.spec.compute_values(params, batch[FINAL_OBS]))
+        rewards = (jnp.asarray(batch[REWARDS], jnp.float32)
+                   + self.gamma * v_final
+                   * jnp.asarray(batch[TRUNCATEDS], jnp.float32))
+        discounts = self.gamma * (1.0 - dones)
+        # Bootstrap with the LEARNER's value of the fragment's true
+        # next obs (v_final[-1] — FINAL_OBS is pre-reset): the
+        # runner-shipped bootstrap_value came from the stale behavior
+        # weights and would mix two value functions at every fragment
+        # tail (reference: vtrace computes bootstrap learner-side).
+        vs, pg_adv = vtrace_returns(
+            jax.lax.stop_gradient(log_rhos), discounts, rewards,
+            jax.lax.stop_gradient(values), v_final[-1],
+            clip_rho_threshold=self.clip_rho_threshold,
+            clip_pg_rho_threshold=self.clip_pg_rho_threshold)
+        policy_loss = -(logp * pg_adv).mean()
+        vf_loss = 0.5 * ((values - vs) ** 2).mean()
+        entropy = dist.entropy().mean()
+        total = (policy_loss + self.vf_loss_coeff * vf_loss
+                 - self.entropy_coeff * entropy)
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": jnp.exp(log_rhos).mean(),
+        }
+
+
+class IMPALA(APPO):
+    """APPO's async actor loop + the V-trace learner."""
+
+    learner_cls = IMPALALearner
+
+    def _learner_kwargs(self, config) -> Dict[str, Any]:
+        return dict(
+            module_spec=self.spec, lr=config.lr,
+            grad_clip=config.grad_clip, seed=config.seed,
+            gamma=config.gamma,
+            clip_rho_threshold=config.clip_rho_threshold,
+            clip_pg_rho_threshold=config.clip_pg_rho_threshold,
+            vf_loss_coeff=config.vf_loss_coeff,
+            entropy_coeff=config.entropy_coeff)
+
+    def setup(self, config: IMPALAConfig) -> None:
+        if config.num_learners > 1:
+            # V-trace consumes whole time-major sequences; splitting a
+            # fragment's rows across learner actors would cut them
+            raise ValueError("IMPALA supports num_learners <= 1 "
+                             "(fragments train whole, time-major)")
+        super().setup(config)
+
+    # -- fragment hooks: keep time-major, no GAE/epochs ---------------
+    def _prepare_fragment(self, cols, weights):
+        return {key: np.asarray(value) for key, value in cols.items()}
+
+    def _train_fragments(self, batches: List[dict]) -> Dict[str, Any]:
+        from ray_tpu.rl.learner import mean_metrics
+        learner = self.learner_group.local_learner
+        all_metrics = []
+        for batch in batches:
+            self._env_steps_lifetime += int(batch[REWARDS].size)
+            all_metrics.append(learner.update(batch))
+        return mean_metrics(all_metrics)
+
+
+IMPALAConfig.algo_class = IMPALA

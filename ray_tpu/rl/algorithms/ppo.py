@@ -121,6 +121,16 @@ class _EnvRunnerActor:
 
 class PPO(Algorithm):
     supports_multi_agent = True
+    learner_cls = PPOLearner  # subclass hook (IMPALA swaps in V-trace)
+
+    def _learner_kwargs(self, config) -> Dict[str, Any]:
+        return dict(
+            module_spec=self.spec, lr=config.lr,
+            grad_clip=config.grad_clip, seed=config.seed,
+            clip_param=config.clip_param,
+            vf_clip_param=config.vf_clip_param,
+            vf_loss_coeff=config.vf_loss_coeff,
+            entropy_coeff=config.entropy_coeff)
 
     def setup(self, config: PPOConfig) -> None:
         self._eval_runner = None
@@ -129,15 +139,9 @@ class PPO(Algorithm):
             return
         self.ma_runner = None
         self.spec = config.module_spec()
-        learner_kwargs = dict(
-            module_spec=self.spec, lr=config.lr,
-            grad_clip=config.grad_clip, seed=config.seed,
-            clip_param=config.clip_param,
-            vf_clip_param=config.vf_clip_param,
-            vf_loss_coeff=config.vf_loss_coeff,
-            entropy_coeff=config.entropy_coeff)
         self.learner_group = LearnerGroup(
-            PPOLearner, num_learners=config.num_learners, **learner_kwargs)
+            type(self).learner_cls, num_learners=config.num_learners,
+            **self._learner_kwargs(config))
         self._rng = np.random.default_rng(config.seed)
         # connector sync (remote runners): one template pipeline holds
         # the driver's canonical state; rebuilt-per-step pipelines would
@@ -389,10 +393,8 @@ class PPO(Algorithm):
         for _ in range(cfg.num_epochs):
             for minibatch in batch.minibatches(mb, self._rng):
                 all_metrics.append(self.learner_group.update(minibatch))
-        import jax
-        host = [{k: float(np.asarray(v)) for k, v in m.items()}
-                for m in all_metrics]
-        return {k: float(np.mean([m[k] for m in host])) for k in host[0]}
+        from ray_tpu.rl.learner import mean_metrics
+        return mean_metrics(all_metrics)
 
     def _training_step_jax(self) -> Dict[str, Any]:
         learner = self.learner_group.local_learner

@@ -51,6 +51,13 @@ def compute_gae(rewards, values, dones, bootstrap_value, *,
     return advantages, advantages + values
 
 
+def mean_metrics(all_metrics: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Average a list of per-update metric dicts (host floats)."""
+    return {k: float(np.mean([float(np.asarray(m[k]))
+                              for m in all_metrics]))
+            for k in all_metrics[0]}
+
+
 class Learner:
     """Holds params + optimizer; subclasses define `loss`."""
 
@@ -249,9 +256,7 @@ class LearnerGroup:
         for actor, chunk in zip(self._actors, chunks):
             sub = {k: np.asarray(v)[chunk] for k, v in batch.items()}
             refs.append(actor.update.remote(serialization.dumps(sub)))
-        all_metrics = ray_tpu.get(refs)
-        return {k: float(np.mean([m[k] for m in all_metrics]))
-                for k in all_metrics[0]}
+        return mean_metrics(ray_tpu.get(refs))
 
     def get_weights(self):
         if self._local is not None:
