@@ -301,9 +301,10 @@ class JaxTrainer:
             # to loose scheduling so single-node dev boxes still train
             # (an unready queued PG must be removed, or it would grab
             # resources later with no owner).
+            # NOTE: uses the module-level remove_placement_group — a
+            # function-local import here would shadow it for the whole
+            # function scope and break the later failure-path call.
             if not pg.ready(timeout=2.0):
-                from ray_tpu.util.placement_group import (
-                    remove_placement_group)
                 remove_placement_group(pg)
                 pg = None
         except Exception:
