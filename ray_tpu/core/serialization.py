@@ -60,6 +60,36 @@ def _align(offset: int) -> int:
     return (offset + ALIGNMENT - 1) & ~(ALIGNMENT - 1)
 
 
+_PLAIN_TYPES = frozenset((int, float, bool, str, bytes, type(None)))
+_SEQ_TYPES = frozenset((tuple, list))
+
+
+def _plain_picklable(value: Any) -> bool:
+    """True for values plain pickle serializes IDENTICALLY to
+    cloudpickle — primitives, non-object numpy, and small flat
+    containers of primitives. Callables/classes must NOT take this
+    path: plain pickle serializes __main__ definitions by reference,
+    which unpickles to the wrong (or no) object in a worker whose
+    __main__ is the worker module."""
+    t = type(value)
+    if t in _PLAIN_TYPES:
+        return True  # before the numpy import: ints/strs need no numpy
+    import numpy as np  # module is cached; the name lookup is cheap
+    if t is np.ndarray:
+        # hasobject also catches structured dtypes with object FIELDS
+        # (dtype != object misses those) — any embedded Python object
+        # could be a __main__ callable that must go by value
+        return not value.dtype.hasobject
+    if isinstance(value, np.generic):
+        return not value.dtype.hasobject
+    if t in _SEQ_TYPES and len(value) <= 32:
+        return all(type(v) in _PLAIN_TYPES for v in value)
+    if t is dict and len(value) <= 32:
+        return all(type(k) in _PLAIN_TYPES and type(v) in _PLAIN_TYPES
+                   for k, v in value.items())
+    return False
+
+
 def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
     """Serialize to (pickled_bytes, out_of_band_buffers)."""
     buffers: List[pickle.PickleBuffer] = []
@@ -70,7 +100,15 @@ def serialize(value: Any) -> Tuple[bytes, List[memoryview]]:
             return False  # keep out of band
         return True  # serialize in band
 
-    data = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    if _plain_picklable(value):
+        # C pickler: ~10-40x cheaper than cloudpickle's Python Pickler
+        # (which was a top entry in the actor-call profile). Identical
+        # wire semantics for these types, including protocol-5 buffers.
+        data = pickle.dumps(value, protocol=5,
+                            buffer_callback=buffer_callback)
+    else:
+        data = cloudpickle.dumps(value, protocol=5,
+                                 buffer_callback=buffer_callback)
     return data, [b.raw() for b in buffers]
 
 
