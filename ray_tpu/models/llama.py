@@ -48,6 +48,11 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     attention: str = "flash"  # flash | reference | ring | ulysses
     remat: bool = True
+    # Chunked cross-entropy: tokens per chunk (0/None = dense loss).
+    # Avoids materializing [B, S, vocab] fp32 logits — at large batch
+    # the dominant activation — trading ~one extra lm_head forward in
+    # the backward pass (see chunked_cross_entropy).
+    ce_chunk_tokens: int = 0
     # Mixture-of-Experts: >0 replaces the dense FFN with moe_experts
     # expert FFNs routed top-k, expert-parallel over the "expert" mesh
     # axis (ray_tpu/parallel/moe.py; no reference analog — SURVEY §2.3
@@ -231,9 +236,13 @@ def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh,
 
 
 def llama_forward(params, tokens, config: LlamaConfig, mesh=None,
-                  return_aux: bool = False):
+                  return_aux: bool = False, return_hidden: bool = False):
     """tokens: [B, S] int32 -> logits [B, S, vocab] (float32).
-    With return_aux, also returns the summed MoE load-balancing loss."""
+    With return_aux, also returns the summed MoE load-balancing loss.
+    With return_hidden, returns the final-norm hidden states INSTEAD of
+    logits (the lm_head matmul is skipped — chunked_cross_entropy
+    applies it chunk-wise so the [B, S, vocab] tensor never
+    materializes)."""
     c = config
     x = params["embedding"][tokens].astype(c.dtype)
     cos, sin = rope_frequencies(c.head_dim, tokens.shape[1], c.rope_theta)
@@ -250,23 +259,77 @@ def llama_forward(params, tokens, config: LlamaConfig, mesh=None,
     (x, aux_sum), _ = jax.lax.scan(
         scan_body, (x, jnp.zeros((), jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], c.norm_eps)
+    if return_hidden:
+        return (x, aux_sum) if return_aux else x
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if return_aux:
         return logits, aux_sum
     return logits
 
 
+def chunked_cross_entropy(hidden, lm_head, targets, mask=None, *,
+                          chunk_tokens: int = 2048):
+    """Token-mean NLL without materializing [B, S, vocab] logits.
+
+    The output projection + log-softmax run per token-chunk inside a
+    rematerialized scan: peak memory drops from O(B*S*V) fp32 (the
+    dominant activation at train shapes — e.g. 4.2 GB at B16/S2048/
+    V32k) to O(chunk*V), at the cost of recomputing each chunk's
+    lm_head matmul in the backward pass (~one extra head forward,
+    a few percent of model FLOPs). On TPU the freed HBM buys a larger
+    batch, which is where the MFU is (reference analog: memory-
+    efficient losses in large-vocab LM training; the reference itself
+    has no in-tree model code).
+    """
+    dim = hidden.shape[-1]
+    flat_h = hidden.reshape(-1, dim)
+    flat_t = targets.reshape(-1)
+    n = flat_h.shape[0]
+    flat_m = (jnp.ones((n,), jnp.float32) if mask is None
+              else mask.reshape(-1).astype(jnp.float32))
+    chunk = min(chunk_tokens, n)
+    pad = (-n) % chunk
+    if pad:
+        flat_h = jnp.pad(flat_h, ((0, pad), (0, 0)))
+        flat_t = jnp.pad(flat_t, (0, pad))
+        flat_m = jnp.pad(flat_m, (0, pad))  # padded tokens weigh 0
+    n_chunks = flat_h.shape[0] // chunk
+
+    def body(carry, inp):
+        h_c, t_c, m_c = inp
+        logits = (h_c @ lm_head).astype(jnp.float32)  # [chunk, V]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[:, None], axis=-1)[:, 0]
+        return carry + jnp.sum((lse - tgt) * m_c), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body), jnp.zeros((), jnp.float32),
+        (flat_h.reshape(n_chunks, chunk, dim),
+         flat_t.reshape(n_chunks, chunk),
+         flat_m.reshape(n_chunks, chunk)))
+    return total / jnp.maximum(jnp.sum(flat_m), 1.0)
+
+
 def llama_loss(params, tokens, targets, config: LlamaConfig, mesh=None,
                mask=None):
-    """Next-token cross-entropy (+ MoE load-balancing aux when MoE)."""
-    logits, aux = llama_forward(params, tokens, config, mesh,
-                                return_aux=True)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    if mask is None:
-        loss = -jnp.mean(ll)
+    """Next-token cross-entropy (+ MoE load-balancing aux when MoE).
+    ``config.ce_chunk_tokens`` switches to the chunked loss that never
+    materializes the [B, S, vocab] logits."""
+    if config.ce_chunk_tokens:
+        hidden, aux = llama_forward(params, tokens, config, mesh,
+                                    return_aux=True, return_hidden=True)
+        loss = chunked_cross_entropy(
+            hidden, params["lm_head"], targets, mask,
+            chunk_tokens=config.ce_chunk_tokens)
     else:
-        loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        logits, aux = llama_forward(params, tokens, config, mesh,
+                                    return_aux=True)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        if mask is None:
+            loss = -jnp.mean(ll)
+        else:
+            loss = -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     if config.moe_experts:
         loss = loss + config.moe_aux_weight * aux / config.n_layers
     return loss

@@ -81,3 +81,38 @@ def test_param_count_formula():
     params = llama_init(jax.random.PRNGKey(0), cfg)
     actual = sum(x.size for x in jax.tree.leaves(params))
     assert actual == cfg.num_params()
+
+
+def test_chunked_cross_entropy_matches_dense():
+    """Chunked CE (no [B,S,V] materialization) must match the dense
+    loss in value AND gradients, with and without a mask."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    cfg = LlamaConfig.tiny(vocab_size=97)  # odd vocab, exercises padding
+    cfg_chunked = dataclasses.replace(cfg, ce_chunk_tokens=13)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 21), 0,
+                                cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (2, 21), 0,
+                                 cfg.vocab_size)
+    mask = (jax.random.uniform(jax.random.PRNGKey(3), (2, 21))
+            > 0.3).astype(jnp.float32)
+
+    for m in (None, mask):
+        dense, dense_grads = jax.value_and_grad(
+            lambda p: llama_loss(p, tokens, targets, cfg, mask=m))(params)
+        chunked, chunked_grads = jax.value_and_grad(
+            lambda p: llama_loss(p, tokens, targets, cfg_chunked,
+                                 mask=m))(params)
+        assert jnp.allclose(dense, chunked, rtol=2e-4, atol=2e-4), (
+            float(dense), float(chunked), m is not None)
+        flat_d = ravel_pytree(dense_grads)[0]
+        flat_c = ravel_pytree(chunked_grads)[0]
+        assert jnp.allclose(flat_d, flat_c, rtol=5e-3, atol=5e-4), (
+            "grad mismatch", float(jnp.abs(flat_d - flat_c).max()))
