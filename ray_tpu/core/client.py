@@ -110,14 +110,19 @@ class ClientRuntime:
             # refuses to unpickle from unauthenticated peers)
             from ray_tpu.core.protocol import send_frame
             send_frame(self.conn.sock, b"AUTH" + token.encode("utf-8"))
+        from ray_tpu.core.protocol import PROTOCOL_MINOR
         self.conn.send({"kind": "CLIENT_REGISTER",
                         "proto_version": PROTOCOL_VERSION,
+                        "proto_minor": PROTOCOL_MINOR,
                         "namespace": namespace})
         reply = self.conn.recv()
         if reply is None or reply.get("kind") != "REGISTERED":
             reason = (reply or {}).get("reason", "connection closed")
             raise ConnectionError(f"head rejected client: {reason}")
         self.head_node_id = NodeID(reply["head_node_id"])
+        # Negotiated head features (additive minors; protocol.py policy)
+        self.head_proto_minor = reply.get("proto_minor", 0)
+        self.head_capabilities = frozenset(reply.get("capabilities", ()))
         self._req_lock = threading.Lock()
         self._req_counter = 0
         self._replies: Dict[int, Tuple[threading.Event, list]] = {}

@@ -183,10 +183,11 @@ class NodeDaemon:
                          session_dir=session_dir)
         self.object_server = ObjectServer(self._resolve_store,
                                           host=self._advertise)
-        from ray_tpu.core.protocol import PROTOCOL_VERSION
+        from ray_tpu.core.protocol import PROTOCOL_MINOR, PROTOCOL_VERSION
         self.conn.send({
             "kind": "NODE_REGISTER",
             "proto_version": PROTOCOL_VERSION,
+            "proto_minor": PROTOCOL_MINOR,
             "node_id": self.node_id.binary(),
             "resources": resources,
             "labels": dict(labels or {}),
@@ -197,6 +198,9 @@ class NodeDaemon:
         if reply is None or reply.get("kind") != "REGISTERED":
             reason = (reply or {}).get("reason", "connection closed")
             raise RuntimeError(f"head rejected node registration: {reason}")
+        # Negotiated head features (additive minors; protocol.py policy)
+        self.head_proto_minor = reply.get("proto_minor", 0)
+        self.head_capabilities = frozenset(reply.get("capabilities", ()))
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, name="heartbeat", daemon=True)
         self._heartbeat_thread.start()
@@ -266,6 +270,13 @@ class NodeDaemon:
                               force=msg.get("force", True))
         elif kind == "STOP":
             return False
+        else:
+            # Additive evolution (protocol.py policy): answer probes for
+            # kinds this daemon predates so a newer head can fall back.
+            if msg.get("req_id") is not None:
+                self.proxy.send({"kind": "UNSUPPORTED",
+                                 "req_id": msg["req_id"],
+                                 "unsupported_kind": kind})
         return True
 
     def _route_to_worker(self, worker_id: WorkerID, payload: dict) -> None:

@@ -25,12 +25,32 @@ from ray_tpu.core import serialization
 
 _LEN = struct.Struct("<I")
 
-# Wire-schema version (reference: protocol versioning in the gRPC
-# schema, src/ray/protobuf/). Carried in the REGISTER / NODE_REGISTER
-# handshakes; a mismatched peer is rejected cleanly instead of failing
-# on an unknown/renamed message mid-stream. Bump on any incompatible
-# message-shape change.
+# Wire-schema versioning (reference: protocol evolution in the gRPC
+# schema, src/ray/protobuf/ — proto3 tolerates unknown fields; breaking
+# changes get new RPCs). Evolution policy:
+#
+# - PROTOCOL_VERSION (major): bump ONLY on an incompatible change to an
+#   existing message's shape or meaning. Mismatched peers are rejected
+#   at the handshake, never mid-stream.
+# - PROTOCOL_MINOR: bump when ADDING message kinds or optional fields.
+#   Peers with equal major but different minor interoperate: readers
+#   use dict.get with defaults for post-v1 fields, and an unknown kind
+#   from a newer peer is answered with UNSUPPORTED (not a crash), so a
+#   newer node can probe and fall back.
+# - The REGISTERED reply carries the head's (major, minor) and its
+#   `capabilities` set; peers gate optional features on membership
+#   instead of sniffing versions.
 PROTOCOL_VERSION = 1
+PROTOCOL_MINOR = 1
+
+# Feature names the head advertises in REGISTERED (grow-only).
+CAPABILITIES = (
+    "auth-token",          # plaintext AUTH preamble frames
+    "rpc-chaos",           # RTPU_RPC_CHAOS fault injection
+    "pull-manager",        # prioritized pulls + byte budget
+    "streaming-generators",
+    "cpp-workers",         # TLV worker channel (kinds 6/7/8)
+)
 
 
 # --- fault injection ---------------------------------------------------

@@ -225,6 +225,14 @@ class ClientSession:
         elif kind == "CANCEL":
             rt.cancel(ObjectID(msg["object_id"]),
                       force=msg.get("force", False))
+        else:
+            # Additive evolution (protocol.py policy): a newer-minor
+            # client probing a kind this head predates must get a
+            # definitive answer, not a request that never resolves.
+            if msg.get("req_id") is not None:
+                self.send({"kind": "UNSUPPORTED",
+                           "req_id": msg["req_id"],
+                           "unsupported_kind": kind})
         return True
 
     def _client_put(self, msg: dict) -> None:
@@ -352,25 +360,38 @@ class HeadServer:
                 break
             try:
                 if node is None and client is None:
-                    from ray_tpu.core.protocol import PROTOCOL_VERSION
+                    from ray_tpu.core.protocol import (
+                        CAPABILITIES, PROTOCOL_MINOR, PROTOCOL_VERSION)
                     kind = msg.get("kind")
                     peer_version = msg.get("proto_version", 0)
                     if kind not in ("NODE_REGISTER", "CLIENT_REGISTER"):
                         break
+                    # Major must match; minor may differ (additive-only
+                    # evolution — see protocol.py policy).
                     if peer_version != PROTOCOL_VERSION:
                         conn.send({"kind": "REGISTER_REJECTED",
                                    "reason": "protocol version mismatch: "
                                              f"head={PROTOCOL_VERSION} "
                                              f"peer={peer_version}"})
                         break
+                    handshake_extra = {
+                        "proto_version": PROTOCOL_VERSION,
+                        "proto_minor": PROTOCOL_MINOR,
+                        "capabilities": list(CAPABILITIES),
+                    }
                     if kind == "CLIENT_REGISTER":
                         client = ClientSession(self.runtime, conn)
+                        client.proto_minor = msg.get("proto_minor", 0)
                         conn.send({"kind": "REGISTERED",
                                    "head_node_id":
-                                       self.runtime.head_node_id.binary()})
+                                       self.runtime.head_node_id.binary(),
+                                   **handshake_extra})
                         continue
                     node = self.runtime.register_remote_node(conn, msg)
-                    conn.send({"kind": "REGISTERED"})
+                    # negotiation is two-way: record the peer's minor so
+                    # a newer head can gate additive kinds per node
+                    node.proto_minor = msg.get("proto_minor", 0)
+                    conn.send({"kind": "REGISTERED", **handshake_extra})
                 elif client is not None:
                     if not client.handle(msg):
                         break
@@ -455,6 +476,17 @@ class HeadServer:
         elif kind == "CANCEL":
             rt.cancel(ObjectID(msg["object_id"]),
                       force=msg.get("force", False))
+        else:
+            # Additive wire-schema evolution: a newer-minor peer may
+            # send kinds this head predates. Probes carrying a req_id
+            # get a definitive UNSUPPORTED answer (so the peer can fall
+            # back) instead of a silent drop or a crash (protocol.py
+            # evolution policy; reference: proto3 unknown-field
+            # tolerance + capability probing).
+            if msg.get("req_id") is not None:
+                node.send({"kind": "UNSUPPORTED",
+                           "req_id": msg["req_id"],
+                           "unsupported_kind": kind})
 
     def stop(self) -> None:
         self._stopped.set()

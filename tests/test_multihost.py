@@ -287,3 +287,61 @@ def test_auth_token_gates_cross_host_connections(monkeypatch, tmp_path):
             _kill_daemon(good)
     finally:
         ray_tpu.shutdown()
+
+
+def test_protocol_minor_negotiation_and_unknown_kind_probe(tcp_cluster):
+    """Additive wire-schema evolution (protocol.py policy): the
+    REGISTERED handshake advertises (major, minor) + capabilities, and
+    a kind the head predates is answered with UNSUPPORTED instead of a
+    silent drop — so newer-minor peers can probe and fall back."""
+    from ray_tpu.core.protocol import (
+        CAPABILITIES, PROTOCOL_MINOR, PROTOCOL_VERSION)
+
+    node_id, proc = tcp_cluster.add_remote_node(
+        num_cpus=1, resources={"spot": 1.0})
+    try:
+        # two-way: the head recorded the daemon's advertised minor
+        assert (tcp_cluster.runtime.nodes[node_id].proto_minor
+                == PROTOCOL_MINOR)
+
+        # client-side negotiation: a fresh client session sees them
+        import subprocess
+        import sys
+        script = (
+            "import ray_tpu\n"
+            "from ray_tpu.core.protocol import PROTOCOL_MINOR\n"
+            f"rt = ray_tpu.init(address={tcp_cluster.runtime.head_address!r})\n"
+            "assert rt.head_proto_minor == PROTOCOL_MINOR\n"
+            "assert 'pull-manager' in rt.head_capabilities\n"
+            "print('NEGOTIATED-OK')\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.getcwd()
+        out = subprocess.run([sys.executable, "-c", script], env=env,
+                             capture_output=True, text=True, timeout=60)
+        assert "NEGOTIATED-OK" in out.stdout, (out.stdout, out.stderr)
+
+        # future-kind probe from a daemon connection: UNSUPPORTED reply
+        import socket as socket_mod
+
+        from ray_tpu.core import serialization
+        from ray_tpu.core.protocol import recv_frame, send_frame
+        host, port = tcp_cluster.runtime.head_address.split(":")
+        sock = socket_mod.create_connection((host, int(port)), timeout=10)
+        from ray_tpu.core.ids import NodeID
+        send_frame(sock, serialization.dumps_fast({
+            "kind": "NODE_REGISTER", "proto_version": PROTOCOL_VERSION,
+            "node_id": NodeID.from_random().binary(),
+            "resources": {"CPU": 0.0}, "labels": {},
+            "object_addr": ["127.0.0.1", 1], "address": "probe:0"}))
+        reply = serialization.loads(recv_frame(sock))
+        assert reply["kind"] == "REGISTERED"
+        assert reply["proto_minor"] == PROTOCOL_MINOR
+        assert set(CAPABILITIES) <= set(reply["capabilities"])
+        send_frame(sock, serialization.dumps_fast(
+            {"kind": "FUTURE_FEATURE_KIND", "req_id": 77}))
+        reply2 = serialization.loads(recv_frame(sock))
+        assert reply2["kind"] == "UNSUPPORTED"
+        assert reply2["req_id"] == 77
+        sock.close()
+    finally:
+        _kill_daemon(proc)
