@@ -71,6 +71,8 @@ class WorkerHandle:
 
 
 class Node:
+    proto_minor = 0  # in-process nodes share the head's schema
+
     def __init__(self, runtime, node_id: NodeID, resources: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None,
                  object_store_memory: Optional[int] = None,

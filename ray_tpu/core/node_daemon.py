@@ -270,6 +270,8 @@ class NodeDaemon:
                               force=msg.get("force", True))
         elif kind == "STOP":
             return False
+        elif kind == "UNSUPPORTED":
+            pass  # answer to OUR probe; never re-answered (echo loop)
         else:
             # Additive evolution (protocol.py policy): answer probes for
             # kinds this daemon predates so a newer head can fall back.

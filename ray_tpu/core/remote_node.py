@@ -58,6 +58,8 @@ class RemoteStoreProxy:
 
 
 class RemoteNode:
+    proto_minor = 0  # negotiated at NODE_REGISTER
+
     is_remote = True
 
     def __init__(self, runtime, conn: MessageConnection, node_id: NodeID,
@@ -174,6 +176,7 @@ class ClientSession:
 
     is_remote = True
     object_addr = None
+    proto_minor = 0  # negotiated at CLIENT_REGISTER
 
     def __init__(self, runtime, conn: MessageConnection):
         self.runtime = runtime
@@ -476,6 +479,9 @@ class HeadServer:
         elif kind == "CANCEL":
             rt.cancel(ObjectID(msg["object_id"]),
                       force=msg.get("force", False))
+        elif kind == "UNSUPPORTED":
+            pass  # peer's answer to OUR probe; NEVER re-answered (an
+            # UNSUPPORTED->UNSUPPORTED echo would loop forever)
         else:
             # Additive wire-schema evolution: a newer-minor peer may
             # send kinds this head predates. Probes carrying a req_id

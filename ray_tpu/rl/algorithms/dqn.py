@@ -182,6 +182,20 @@ class DQN(Algorithm):
         }
 
 
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy (argmax-Q) episodes on a dedicated env (reference:
+        algorithm.py:1407 evaluate with exploration off)."""
+        from ray_tpu.rl.evaluation import evaluate_policy
+
+        def act(obs):
+            q = np.asarray(self._q_values(self.params,
+                                          np.asarray(obs)[None]))
+            return int(np.argmax(q[0]))
+
+        return evaluate_policy(
+            self.config.make_python_env, act,
+            num_episodes=self.config.evaluation_duration)
+
     def get_state(self) -> Dict[str, Any]:
         import jax
         state = super().get_state()

@@ -258,6 +258,20 @@ class SAC(Algorithm):
     def compute_single_action(self, obs: np.ndarray) -> np.ndarray:
         return np.asarray(self._act_mode(self.params, obs[None]))[0]
 
+    def evaluate(self) -> Dict[str, Any]:
+        """Deterministic (tanh-mean) episodes on a dedicated env
+        (reference: algorithm.py:1407 evaluate, exploration off)."""
+        from ray_tpu.rl.evaluation import evaluate_policy
+
+        def act(obs):
+            a = self._act_mode(self.params,
+                               np.asarray(obs, np.float32)[None])
+            return np.asarray(a)[0]
+
+        return evaluate_policy(
+            self.config.make_python_env, act,
+            num_episodes=self.config.evaluation_duration)
+
     def get_state(self) -> Dict[str, Any]:
         state = super().get_state()
         state.update(

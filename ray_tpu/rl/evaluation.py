@@ -1,0 +1,45 @@
+"""Shared evaluation rollouts for algorithms with bespoke policies.
+
+PPO's evaluation-runner split reuses its env runners; value-based /
+off-policy algorithms (DQN, SAC) have their own networks, so their
+``evaluate()`` implementations share this one exploit-mode episode
+loop instead (reference: rllib/algorithms/algorithm.py:1407 evaluate —
+dedicated rollouts with exploration off, metrics reported under the
+"evaluation" key).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+
+def evaluate_policy(env_creator: Callable[[], Any],
+                    act_fn: Callable[[Any], Any], *,
+                    num_episodes: int = 10,
+                    max_steps: int = 10_000) -> Dict[str, Any]:
+    """Run ``num_episodes`` greedy episodes; ``act_fn(obs) -> action``."""
+    returns: List[float] = []
+    lengths: List[int] = []
+    env = env_creator()
+    try:
+        for _ in range(num_episodes):
+            obs, _ = env.reset()
+            total, steps = 0.0, 0
+            for _ in range(max_steps):
+                obs, reward, terminated, truncated, _ = env.step(
+                    act_fn(obs))
+                total += float(reward)
+                steps += 1
+                if terminated or truncated:
+                    break
+            returns.append(total)
+            lengths.append(steps)
+    finally:
+        env.close()
+    return {
+        "episode_return_mean": float(np.mean(returns)),
+        "episode_len_mean": float(np.mean(lengths)),
+        "episodes_this_eval": len(returns),
+    }

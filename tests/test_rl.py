@@ -767,3 +767,38 @@ def test_impala_rejects_multi_learner():
               .learners(num_learners=2))
     with pytest.raises(ValueError, match="num_learners"):
         config.build_algo()
+
+
+def test_dqn_and_sac_evaluation_split():
+    """DQN/SAC evaluate() runs dedicated exploit-mode episodes — the
+    evaluation split now covers the off-policy algorithms too."""
+    from ray_tpu.rl import DQNConfig, SACConfig
+
+    dqn = (DQNConfig().environment("CartPole-v1")
+           .env_runners(num_envs_per_env_runner=2,
+                        rollout_fragment_length=8)
+           .evaluation(evaluation_interval=2, evaluation_duration=3)
+           .debugging(seed=0)).build_algo()
+    try:
+        r1 = dqn.train()
+        assert "evaluation" not in r1
+        r2 = dqn.train()
+        ev = r2["evaluation"]
+        assert ev["episodes_this_eval"] == 3
+        assert np.isfinite(ev["episode_return_mean"])
+    finally:
+        dqn.stop()
+
+    sac = (SACConfig().environment("Pendulum-v1")
+           .env_runners(num_envs_per_env_runner=1,
+                        rollout_fragment_length=8)
+           .training(learning_starts=16)
+           .evaluation(evaluation_duration=2)
+           .debugging(seed=0)).build_algo()
+    try:
+        sac.train()
+        ev = sac.evaluate()
+        assert ev["episodes_this_eval"] == 2
+        assert np.isfinite(ev["episode_return_mean"])
+    finally:
+        sac.stop()
