@@ -92,6 +92,13 @@ class Config:
     # Chunk size for node-to-node object transfer (reference: chunked
     # push/pull, object_manager.proto:63-66).
     object_chunk_size: int = 1024 * 1024
+    # Seconds a node daemon keeps retrying its head connection after
+    # losing it (head crash/restart) before giving up and exiting
+    # (reference: raylets reconnecting to a restarted GCS,
+    # gcs_init_data.cc replay). 0 = exit immediately (legacy behavior).
+    # The daemon re-registers under its same node id; work in flight
+    # across the outage is lost and re-driven by the new head's driver.
+    node_reconnect_s: float = 0.0
     # Shared-secret authentication for cross-host connections
     # (reference: src/ray/rpc/authentication/ — cluster-wide token).
     # When set on the head (RTPU_AUTH_TOKEN), peers must open with a

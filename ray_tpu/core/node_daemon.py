@@ -154,16 +154,9 @@ class NodeDaemon:
                  advertise_host: Optional[str] = None):
         from ray_tpu.core.node import Node  # late: spawns worker procs
 
-        host, port = parse_address(head_address)
-        self.conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
-        token = get_config().auth_token
-        if token:
-            # plaintext auth frame BEFORE any pickled message (the head
-            # refuses to unpickle from unauthenticated peers)
-            from ray_tpu.core.protocol import send_frame
-            send_frame(self.conn.sock, b"AUTH" + token.encode("utf-8"))
-        self.proxy = HeadProxy(self.conn)
+        self.head_address = head_address
         self.node_id = NodeID.from_random()
+        self._stop_requested = False
         if resources is None:
             resources = {}
         resources = dict(resources)
@@ -173,37 +166,126 @@ class NodeDaemon:
         labels = dict(labels or {})
         from ray_tpu.accelerators.tpu import TpuAcceleratorManager
         TpuAcceleratorManager.augment_node(resources, labels)
+        self.resources = resources
+        self.node_labels = dict(labels)
         self._advertise = advertise_host or get_config().head_host
         # must be set BEFORE the Node prestarts workers: they inherit
         # it for cross-host endpoints they advertise (e.g.
         # compiled-graph TCP channel listeners)
         os.environ["RTPU_NODE_ADVERTISE_HOST"] = self._advertise
+
+        self.conn = self._dial()
+        self.proxy = HeadProxy(self.conn)
         self.node = Node(self.proxy, self.node_id, resources, labels,
                          object_store_memory=object_store_memory,
                          session_dir=session_dir)
         self.object_server = ObjectServer(self._resolve_store,
                                           host=self._advertise)
+        self._adopt(self.conn, self._register_on(self.conn))
+
+    def _dial(self) -> MessageConnection:
+        """Dial the head and send the AUTH preamble (registration is a
+        separate step — its NODE_REGISTER carries the object-server
+        port, which only exists after the ObjectServer starts)."""
+        host, port = parse_address(self.head_address)
+        conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
+        token = get_config().auth_token
+        if token:
+            # plaintext auth frame BEFORE any pickled message (the head
+            # refuses to unpickle from unauthenticated peers)
+            from ray_tpu.core.protocol import send_frame
+            send_frame(conn.sock, b"AUTH" + token.encode("utf-8"))
+        return conn
+
+    def _register_on(self, conn: MessageConnection,
+                     timeout_s: float = 30.0) -> dict:
+        """NODE_REGISTER/REGISTERED exchange on ``conn`` — bounded, and
+        touching NO daemon state (the live connection stays untouched
+        until the new one is fully registered)."""
         from ray_tpu.core.protocol import PROTOCOL_MINOR, PROTOCOL_VERSION
-        self.conn.send({
-            "kind": "NODE_REGISTER",
-            "proto_version": PROTOCOL_VERSION,
-            "proto_minor": PROTOCOL_MINOR,
-            "node_id": self.node_id.binary(),
-            "resources": resources,
-            "labels": dict(labels or {}),
-            "object_addr": [self._advertise, self.object_server.address[1]],
-            "address": f"{socket.gethostname()}:{os.getpid()}",
-        })
-        reply = self.conn.recv()
+        conn.sock.settimeout(timeout_s)
+        try:
+            conn.send({
+                "kind": "NODE_REGISTER",
+                "proto_version": PROTOCOL_VERSION,
+                "proto_minor": PROTOCOL_MINOR,
+                "node_id": self.node_id.binary(),
+                "resources": self.resources,
+                "labels": dict(self.node_labels),
+                "object_addr": [self._advertise,
+                                self.object_server.address[1]],
+                "address": f"{socket.gethostname()}:{os.getpid()}",
+            })
+            reply = conn.recv()
+        finally:
+            try:
+                conn.sock.settimeout(None)
+            except OSError:
+                pass
         if reply is None or reply.get("kind") != "REGISTERED":
             reason = (reply or {}).get("reason", "connection closed")
             raise RuntimeError(f"head rejected node registration: {reason}")
+        return reply
+
+    def _adopt(self, conn: MessageConnection, reply: dict) -> None:
+        """Switch the daemon onto a REGISTERED connection. Ordering
+        matters: proxy.dead stays SET until the swap is complete, so
+        worker completions can't write frames ahead of registration
+        and poison the handshake."""
+        self.conn = conn
+        self.proxy.conn = conn
         # Negotiated head features (additive minors; protocol.py policy)
         self.head_proto_minor = reply.get("proto_minor", 0)
         self.head_capabilities = frozenset(reply.get("capabilities", ()))
+        self.proxy.dead.clear()
         self._heartbeat_thread = threading.Thread(
-            target=self._heartbeat_loop, name="heartbeat", daemon=True)
+            target=self._heartbeat_loop, args=(conn,),
+            name="heartbeat", daemon=True)
         self._heartbeat_thread.start()
+
+    def _try_reconnect(self) -> bool:
+        """Head link lost: retry within node_reconnect_s, re-registering
+        under the SAME node id so a restarted head (journal-replayed
+        control plane) adopts this node (reference: raylets reconnecting
+        to a restarted GCS, gcs_init_data.cc). Work dispatched before
+        the outage is lost — the new head never owned it — and any late
+        completions are dropped by the head as unknown tasks. The dead
+        flag stays set for the whole attempt, so nothing else writes to
+        the half-established connection."""
+        import time as _time
+
+        window = get_config().node_reconnect_s
+        if window <= 0 or self._stop_requested:
+            return False
+        deadline = _time.monotonic() + window
+        delay = 0.5
+        old = self.conn
+        while not self._stop_requested:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                conn = self._dial()
+            except OSError:
+                _time.sleep(min(delay, max(0.0, remaining)))
+                delay = min(delay * 2, 3.0)
+                continue
+            try:
+                reply = self._register_on(conn,
+                                          timeout_s=min(15.0, remaining))
+            except (RuntimeError, OSError):
+                conn.close()  # every failed attempt frees its socket
+                _time.sleep(min(delay, max(0.0,
+                                           deadline - _time.monotonic())))
+                delay = min(delay * 2, 3.0)
+                continue
+            self._adopt(conn, reply)
+            try:
+                old.close()
+            except OSError:
+                pass
+            return True
+        return False
 
     def _resolve_store(self, oid: ObjectID):
         if self.node.store.contains(oid):
@@ -213,9 +295,11 @@ class NodeDaemon:
             return ("file", path)  # spilled: serve straight off disk
         return None
 
-    def _heartbeat_loop(self) -> None:
+    def _heartbeat_loop(self, conn) -> None:
         cfg = get_config()
         while not self.proxy.dead.wait(cfg.heartbeat_interval_s):
+            if self.proxy.conn is not conn:
+                return  # superseded: a reconnect started a fresh thread
             self.proxy.send({"kind": "HEARTBEAT",
                              "idle": self.node.idle_worker_count(),
                              "store_used": self.node.store.used_bytes()})
@@ -226,9 +310,15 @@ class NodeDaemon:
             while True:
                 msg = self.conn.recv()
                 if msg is None:
+                    # head link lost: survive a head restart when the
+                    # reconnect window allows (node_reconnect_s)
+                    self.proxy.dead.set()
+                    if self._try_reconnect():
+                        continue
                     break
                 try:
                     if not self._handle(msg):
+                        self._stop_requested = True
                         break
                 except Exception:  # noqa: BLE001 — keep serving
                     import traceback

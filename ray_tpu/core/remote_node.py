@@ -495,8 +495,24 @@ class HeadServer:
                            "unsupported_kind": kind})
 
     def stop(self) -> None:
+        import socket as socket_mod
         self._stopped.set()
+        # A thread parked in accept() holds the underlying listen socket
+        # open PAST close() (Linux close doesn't wake accept), which
+        # keeps the port bound and makes a same-address head restart
+        # fail with EADDRINUSE. Wake the accepter with a no-op
+        # connection before closing.
+        wake_host = self.address[0]
+        if wake_host in ("0.0.0.0", "::"):
+            wake_host = "127.0.0.1"
+        try:
+            with socket_mod.create_connection(
+                    (wake_host, self.address[1]), timeout=1.0):
+                pass
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        self._accept_thread.join(timeout=2.0)

@@ -345,3 +345,74 @@ def test_protocol_minor_negotiation_and_unknown_kind_probe(tcp_cluster):
         sock.close()
     finally:
         _kill_daemon(proc)
+
+
+def test_daemon_survives_head_restart(tmp_path):
+    """Head-restart tolerance (a slice of head fault tolerance;
+    reference: raylets reconnecting to a restarted GCS +
+    gcs_init_data.cc replay): a daemon with node_reconnect_s keeps
+    retrying after the head crashes, re-registers under its same node
+    id with the NEW head on the same address, and serves fresh work."""
+    import json
+    import socket as socket_mod
+    import subprocess
+    import sys
+
+    import ray_tpu
+
+    s = socket_mod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    rt = ray_tpu.init(num_cpus=1, head_port=port)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd()
+    env["RTPU_NODE_RECONNECT_S"] = "60"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+         "--address", f"127.0.0.1:{port}",
+         "--resources", json.dumps({"CPU": 1, "spot": 1.0})], env=env)
+    try:
+        deadline = time.time() + 30
+        while len(rt.nodes) < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        assert len(rt.nodes) == 2
+        daemon_node_id = next(n for n in rt.nodes
+                              if n != rt.head_node_id)
+
+        @ray_tpu.remote(resources={"spot": 0.1})
+        def mark(tag):
+            return tag
+
+        assert ray_tpu.get(mark.remote("before"), timeout=30) == "before"
+
+        # Simulate a head CRASH: stop accepting FIRST (or the daemon
+        # would reconnect to the dying head), then sever daemon links
+        # without the STOP frame a clean shutdown would send.
+        rt.head_server.stop()
+        for node in list(rt.nodes.values()):
+            if getattr(node, "is_remote", False):
+                rt.nodes.pop(node.node_id, None)
+                node.close()
+        ray_tpu.shutdown()
+        time.sleep(1.0)
+
+        # New head, same address: the daemon must rejoin by itself.
+        rt2 = ray_tpu.init(num_cpus=1, head_port=port)
+        deadline = time.time() + 40
+        while len(rt2.nodes) < 2 and time.time() < deadline:
+            time.sleep(0.2)
+        assert len(rt2.nodes) == 2, "daemon did not rejoin the new head"
+        assert daemon_node_id in rt2.nodes  # SAME node id re-adopted
+        assert proc.poll() is None  # daemon process never exited
+
+        @ray_tpu.remote(resources={"spot": 0.1})
+        def mark2(tag):
+            return tag
+
+        assert ray_tpu.get(mark2.remote("after"), timeout=40) == "after"
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        ray_tpu.shutdown()
