@@ -128,6 +128,8 @@ def bench_put_get_small(rt, n: int) -> dict:
     import ray_tpu
 
     value = {"k": 1, "v": "x" * 100}
+    for _ in range(100):  # warmup: shm arena + serializer hot
+        ray_tpu.get(ray_tpu.put(value))
     t0 = time.perf_counter()
     for _ in range(n):
         ray_tpu.get(ray_tpu.put(value))
@@ -142,6 +144,8 @@ def bench_put_get_1mb(rt, n: int) -> dict:
     import ray_tpu
 
     value = np.zeros(131_072, dtype=np.float64)  # 1 MiB
+    for _ in range(10):
+        ray_tpu.get(ray_tpu.put(value))
     t0 = time.perf_counter()
     for _ in range(n):
         ray_tpu.get(ray_tpu.put(value))
