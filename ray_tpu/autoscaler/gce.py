@@ -17,7 +17,9 @@ GCE metadata-server token (the standard auth path on TPU VMs).
 from __future__ import annotations
 
 import json
+import shlex
 import threading
+import urllib.parse
 import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -76,9 +78,9 @@ def _startup_script(head_address: str, node_type: NodeTypeConfig,
                  if k not in ("TPU",)}  # chips self-detected on-host
     return (
         "#!/bin/bash\n"
-        f"ray-tpu start --address {head_address} "
-        f"--labels '{json.dumps(labels)}' "
-        f"--resources '{json.dumps(resources)}'\n")
+        f"ray-tpu start --address {shlex.quote(head_address)} "
+        f"--labels {shlex.quote(json.dumps(labels))} "
+        f"--resources {shlex.quote(json.dumps(resources))}\n")
 
 
 class GceTpuSliceNodeProvider(NodeProvider):
@@ -145,22 +147,32 @@ class GceTpuSliceNodeProvider(NodeProvider):
             self._created.pop(provider_node_id, None)
 
     def non_terminated_nodes(self) -> Dict[str, str]:
-        status, resp = self._http("GET", f"{self._base}/nodes", None)
-        if status >= 300:
-            # API hiccup: fall back to the local view so one failed
-            # poll doesn't make the autoscaler relaunch everything.
-            with self._lock:
-                return dict(self._created)
         out: Dict[str, str] = {}
-        for node in resp.get("nodes", ()):
-            if node.get("state") in ("DELETING", "TERMINATED", "STOPPED"):
-                continue
-            name = node.get("name", "").rsplit("/", 1)[-1]
-            if not name.startswith(self._prefix):
-                continue
-            labels = node.get("labels", {})
-            node_type = labels.get("ray-tpu-node-type", "")
-            out[name] = node_type
+        page_token = None
+        while True:
+            url = f"{self._base}/nodes"
+            if page_token:
+                url += "?pageToken=" + urllib.parse.quote(
+                    page_token, safe="")
+            status, resp = self._http("GET", url, None)
+            if status >= 300:
+                # API hiccup: fall back to the local view so one failed
+                # poll doesn't make the autoscaler relaunch everything.
+                with self._lock:
+                    return dict(self._created)
+            for node in resp.get("nodes", ()):
+                if node.get("state") in ("DELETING", "TERMINATED",
+                                         "STOPPED"):
+                    continue
+                name = node.get("name", "").rsplit("/", 1)[-1]
+                if not name.startswith(self._prefix):
+                    continue
+                labels = node.get("labels", {})
+                node_type = labels.get("ray-tpu-node-type", "")
+                out[name] = node_type
+            page_token = resp.get("nextPageToken")
+            if not page_token:
+                break
         with self._lock:
             # adopt API truth; keep just-created entries the API may
             # not list yet (eventual consistency)

@@ -243,14 +243,29 @@ class Gcs:
             self.functions[function_id] = blob
 
     # --- nodes ---------------------------------------------------------
-    def register_node(self, record: NodeRecord) -> None:
+    def register_node(self, record: NodeRecord,
+                      publish: bool = True) -> None:
+        """``publish=False`` installs the record without the ALIVE
+        pubsub push — for callers that must install under a lock (push
+        is synchronous and a slow subscriber would wedge them) and
+        publish after release."""
         with self.lock:
             self.nodes[record.node_id] = record
-        self.pubsub.publish("node", ("ALIVE", record.node_id))
+        if publish:
+            self.pubsub.publish("node", ("ALIVE", record.node_id))
 
-    def mark_node_dead(self, node_id: NodeID) -> None:
+    def mark_node_dead(self, node_id: NodeID,
+                       expected_manager=None) -> None:
+        """``expected_manager`` pins the call to one node incarnation:
+        if a re-registration has already replaced the record (same id,
+        new node_manager), the death is stale — skip both the flip and
+        the DEAD publish so subscribers never see DEAD after the new
+        incarnation's ALIVE."""
         with self.lock:
             rec = self.nodes.get(node_id)
+            if (expected_manager is not None and rec is not None
+                    and rec.node_manager is not expected_manager):
+                return
             if rec:
                 rec.alive = False
         self.pubsub.publish("node", ("DEAD", node_id))

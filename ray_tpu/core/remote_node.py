@@ -130,7 +130,13 @@ class RemoteNode:
                           "spec": serialization.dumps_fast(spec)}):
             # Leave the spec tracked: the death sweep (take_inflight)
             # is what retries it.
-            self.runtime.on_remote_node_death(self.node_id)
+            self.runtime.on_remote_node_death(self.node_id, expected=self)
+            # Late-track race: if the death harvest already ran (we lost
+            # the mark_dead race, or the id was re-taken), the call above
+            # no-ops and the spec tracked above was missed — reap it.
+            leftovers = self.take_inflight()
+            if leftovers:
+                self.runtime.reap_node_specs(self, leftovers)
 
     def dispatch_to_actor(self, worker_id: WorkerID, spec: TaskSpec) -> bool:
         self.track(spec)
@@ -306,7 +312,8 @@ class HeadServer:
                 if (isinstance(node, RemoteNode) and node.alive
                         and now - node.last_heartbeat
                         > cfg.heartbeat_timeout_s):
-                    self.runtime.on_remote_node_death(node.node_id)
+                    self.runtime.on_remote_node_death(node.node_id,
+                                                      expected=node)
 
     def _reader_loop(self, conn: MessageConnection) -> None:
         # The first frame decides the peer's codec: C-API clients open
@@ -404,7 +411,11 @@ class HeadServer:
                 import traceback
                 traceback.print_exc()
         if node is not None:
-            self.runtime.on_remote_node_death(node.node_id)
+            # expected= pins the death to THIS connection's RemoteNode:
+            # with node_reconnect_s the daemon may have re-registered on
+            # a new connection before this (stale) one's EOF woke the
+            # reader, and a by-id kill would tear down the fresh record.
+            self.runtime.on_remote_node_death(node.node_id, expected=node)
         if client is not None:
             client.close()
 

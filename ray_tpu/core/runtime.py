@@ -117,6 +117,11 @@ class DriverRuntime:
         # streaming-task yields (reference: _raylet.pyx:299)
         self._streams: Dict[TaskID, StreamState] = {}
         self._streams_lock = threading.Lock()
+        # Serializes remote-node install/reap vs death observers so a
+        # stale connection's EOF can never tear down a re-registered
+        # node (RLock: register's reap path re-enters death). See
+        # register_remote_node / on_remote_node_death.
+        self._node_reg_lock = threading.RLock()
         # pubsub push routes per worker, removed at death
         self._worker_subs: Dict[tuple, list] = {}
         self._worker_subs_lock = threading.Lock()
@@ -245,14 +250,41 @@ class DriverRuntime:
         node_id = NodeID(msg["node_id"])
         resources = dict(msg["resources"])
         labels = dict(msg.get("labels") or {})
-        node = RemoteNode(self, conn, node_id, resources, labels,
-                          tuple(msg["object_addr"]),
-                          msg.get("address", ""))
-        self.nodes[node_id] = node
-        self.scheduler.add_node(node_id, resources, labels)
-        self.gcs.register_node(NodeRecord(
-            node_id=node_id, address=node.address,
-            resources_total=resources, labels=labels, node_manager=node))
+        with self._node_reg_lock:
+            stale = self.nodes.get(node_id)
+            reap_tail = None
+            if stale is not None and getattr(stale, "is_remote", False):
+                # The daemon re-registered (link blip on a live head)
+                # before the old connection's EOF woke its reader. Reap
+                # the old record exactly as a death would — the daemon
+                # dropped any completions during the outage, so its
+                # in-flight specs must be retried — then adopt the new
+                # connection. The lock makes reap-then-install atomic
+                # against death observers (stale EOF reader, heartbeat
+                # monitor), whose identity check then no-ops.
+                reap_tail = self._reap_remote_node_locked(node_id, stale)
+            node = RemoteNode(self, conn, node_id, resources, labels,
+                              tuple(msg["object_addr"]),
+                              msg.get("address", ""))
+            self.nodes[node_id] = node
+            self.scheduler.add_node(node_id, resources, labels)
+            # Install the GCS record under the lock so record ownership
+            # is ordered with self.nodes ownership (a delayed thread's
+            # stale register_node after a newer one would otherwise
+            # point the record at a superseded incarnation and suppress
+            # its real DEAD forever via the expected_manager guard).
+            self.gcs.register_node(NodeRecord(
+                node_id=node_id, address=node.address,
+                resources_total=resources, labels=labels,
+                node_manager=node), publish=False)
+        # Publishes and spec retries run OUTSIDE the lock (pubsub push
+        # is synchronous; a slow subscriber must not wedge the node
+        # control plane). The reap tail's DEAD-publish self-suppresses
+        # (expected_manager) now that the new record is installed, so
+        # subscribers see a plain ALIVE refresh for the re-taken id.
+        if reap_tail is not None:
+            reap_tail()
+        self.gcs.pubsub.publish("node", ("ALIVE", node_id))
         self.retry_pending_placement_groups()
         with self._sched_cond:
             self._schedulable.extend(self._infeasible)
@@ -260,26 +292,47 @@ class DriverRuntime:
             self._sched_cond.notify_all()
         return node
 
-    def on_remote_node_death(self, node_id: NodeID) -> None:
+    def on_remote_node_death(self, node_id: NodeID,
+                             expected=None) -> None:
         """A remote node's daemon stopped heartbeating or its connection
         dropped. Retry/fail its in-flight work exactly as worker crashes
         would, and promote object replicas where copies survive
         (reference: node death notifications in node_manager.proto +
-        gcs_health_check_manager.h:45)."""
+        gcs_health_check_manager.h:45). ``expected`` pins the call to a
+        specific RemoteNode object: if the id has since been re-taken by
+        a re-registration, the call no-ops instead of tearing down the
+        fresh node (lookup + reap are atomic under _node_reg_lock)."""
         if self._stopped.is_set():
             return
+        with self._node_reg_lock:
+            tail = self._reap_remote_node_locked(node_id, expected)
+        if tail is not None:
+            tail()
+
+    def _reap_remote_node_locked(self, node_id: NodeID, expected):
+        """In-memory surgery for a remote node's death. Caller holds
+        _node_reg_lock. Returns None if the death is stale (id re-taken,
+        or another thread won mark_dead), else a closure with the
+        publish/retry tail that the caller MUST run after releasing the
+        lock — pubsub push is synchronous, so a slow subscriber under
+        the lock would wedge registrations, heartbeat monitoring, and
+        every daemon EOF reader at once."""
         node = self.nodes.get(node_id)
         if node is None or not getattr(node, "is_remote", False):
-            return
+            return None
+        if expected is not None and node is not expected:
+            return None  # superseded: a newer registration owns this id
         if not node.mark_dead():
-            return  # another thread (EOF reader vs monitor) won the race
+            return None  # another thread (EOF reader vs monitor) won
         self.nodes.pop(node_id, None)
         self.scheduler.remove_node(node_id)
-        self.gcs.mark_node_dead(node_id)
         self._drop_worker_subscriptions(node_id)
-        node.close()
-        # Replica bookkeeping: drop copies on the dead node; objects whose
-        # primary lived there survive if any replica exists.
+        # Every by-id sweep stays under the lock: past it, a concurrent
+        # re-registration may have re-taken this id, and these would
+        # clobber the NEW node's records (drop its live replicas, kill
+        # its healthy actors). Replica bookkeeping: drop copies on the
+        # dead node; objects whose primary lived there survive if any
+        # replica exists.
         promote: List[Tuple[ObjectID, NodeID]] = []
         with self._replica_lock:
             for oid, reps in self._object_replicas.items():
@@ -288,13 +341,33 @@ class DriverRuntime:
                 if (reps and loc is not None and loc.kind == "shm"
                         and loc.node_id == node_id):
                     promote.append((oid, next(iter(reps))))
-        for oid, new_primary in promote:
-            self.task_manager.set_location(
-                oid, ObjectLocation("shm", new_primary))
-        # In-flight tasks the daemon can no longer report on.
-        specs = node.take_inflight()
+        # Snapshot the dead incarnation's actors under the lock; the
+        # per-actor death handling runs after release (it reschedules
+        # via _sched_cond) on this frozen, correctly-attributed set.
         actor_ids = {aid for aid, info in self.actors.items()
                      if info.node_id == node_id}
+
+        def tail():
+            # expected_manager keeps a late tail (death thread paused
+            # past the lock) from marking a re-registered record dead.
+            self.gcs.mark_node_dead(node_id, expected_manager=node)
+            node.close()
+            for oid, new_primary in promote:
+                self.task_manager.set_location(
+                    oid, ObjectLocation("shm", new_primary))
+            # In-flight tasks the daemon can no longer report on.
+            self.reap_node_specs(node, node.take_inflight(), actor_ids)
+
+        return tail
+
+    def reap_node_specs(self, node, specs, actor_ids=None) -> None:
+        """Retry-or-fail specs stranded on a dead RemoteNode object.
+
+        Called from the death harvest above, and from RemoteNode.dispatch
+        for the late-track race: a dispatch that tracked its spec AFTER
+        the harvest ran (scheduler read the node just before death) must
+        reap its own leftovers or the spec hangs forever."""
+        actor_ids = set(actor_ids or ())
         for spec in specs:
             # the node's whole resource accounting vanished with
             # remove_node — but a burst-grant marker left behind would
@@ -310,11 +383,11 @@ class DriverRuntime:
                 self._resubmit(retry)
                 continue
             err: Exception = WorkerCrashedError(
-                f"node {node_id.hex()[:8]} died while running "
+                f"node {node.node_id.hex()[:8]} died while running "
                 f"{spec.name or spec.function_id}")
             if spec.actor_id is not None:
                 err = ActorUnavailableError(spec.actor_id, str(err))
-            self._record_event(spec, "FAILED", node_id=node_id,
+            self._record_event(spec, "FAILED", node_id=node.node_id,
                                error=str(err))
             self._fail_task(spec, err)
         for aid in actor_ids:
@@ -359,7 +432,7 @@ class DriverRuntime:
         existing = self.nodes.get(node_id)
         if existing is not None and getattr(existing, "is_remote", False):
             existing.send({"kind": "STOP"})
-            self.on_remote_node_death(node_id)
+            self.on_remote_node_death(node_id, expected=existing)
             return
         node = self.nodes.pop(node_id, None)
         if node is None:
@@ -1152,13 +1225,22 @@ class DriverRuntime:
             self._handle_actor_death(aid, node)
         self._signal_scheduler()
 
-    def _release_actor_resources(self, info: ActorInfo) -> None:
+    def _release_actor_resources(self, info: ActorInfo,
+                                 dead_node=None) -> None:
         """Release the creation-task resources exactly once per incarnation
-        (covers kill(), crash during __init__, and death while ALIVE)."""
+        (covers kill(), crash during __init__, and death while ALIVE).
+        ``dead_node``: when releasing because that node died, the ledger
+        died with it (scheduler.remove_node) — and if the same node id
+        re-registered in the meantime, a by-id release would credit the
+        NEW incarnation's fresh ledger with capacity it never granted
+        (oversubscribing it), so release only onto the live object."""
         node_id = info.resources_node
         if node_id is None:
             return
         info.resources_node = None
+        if (dead_node is not None
+                and self.nodes.get(node_id) is not dead_node):
+            return
         self.scheduler.release(node_id,
                                self._spec_resources(info.creation_spec))
 
@@ -1167,7 +1249,8 @@ class DriverRuntime:
         info = self.actors.get(actor_id)
         if record is None or info is None:
             return
-        self._release_actor_resources(info)
+        dead_node = node if getattr(node, "is_remote", False) else None
+        self._release_actor_resources(info, dead_node=dead_node)
         if record.state == "DEAD":
             self._fail_actor_buffer(actor_id,
                                     ActorDiedError(actor_id, "actor killed"))

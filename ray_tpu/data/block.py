@@ -32,6 +32,33 @@ class BlockMetadata:
     exec_stats: Optional[dict] = None
 
 
+def tensor_column(arrs: List[np.ndarray], dtype=None,
+                  ndim: Optional[int] = None) -> pa.Array:
+    """Rows of equal-rank ndarrays -> typed nested-list arrow column.
+
+    Preserves the numpy dtype (e.g. uint8 images stay uint8 — the
+    reference's read_images semantics) instead of letting
+    ``pa.array(tolist())`` widen everything to int64/float64. Pass
+    ``dtype``/``ndim`` explicitly when ``arrs`` may be empty (a 0-row
+    shard still needs a typed column).
+    """
+    if dtype is None:
+        dtype, ndim = arrs[0].dtype, arrs[0].ndim
+    try:
+        typ = pa.from_numpy_dtype(dtype)
+    except (pa.ArrowNotImplementedError, TypeError, ValueError):
+        # object / unicode / other non-arrow dtypes: let arrow infer
+        return pa.array([a.tolist() for a in arrs])
+    for _ in range(ndim):
+        typ = pa.list_(typ)
+    return pa.array([a.tolist() for a in arrs], type=typ)
+
+
+def stacked_tensor_column(arr: np.ndarray) -> pa.Array:
+    """One stacked ndarray -> one column row per axis-0 slice."""
+    return tensor_column(list(arr), dtype=arr.dtype, ndim=arr.ndim - 1)
+
+
 def _normalize_rows(rows: Iterable[Any]) -> List[Dict[str, Any]]:
     out = []
     for r in rows:
@@ -67,10 +94,12 @@ class BlockAccessor:
         if isinstance(batch, pa.Table):
             return batch
         if isinstance(batch, dict):
-            return pa.table({
-                k: (pa.array(np.asarray(v).tolist())
-                    if np.asarray(v).ndim > 1 else pa.array(np.asarray(v)))
-                for k, v in batch.items()})
+            def _col(v):
+                arr = np.asarray(v)
+                if arr.ndim <= 1:
+                    return pa.array(arr)
+                return stacked_tensor_column(arr)
+            return pa.table({k: _col(v) for k, v in batch.items()})
         # pandas
         return pa.Table.from_pandas(batch, preserve_index=False)
 

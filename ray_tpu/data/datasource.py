@@ -14,7 +14,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 import pyarrow as pa
 
-from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.block import (BlockAccessor, stacked_tensor_column,
+                                tensor_column)
 from ray_tpu.data.context import DataContext
 
 
@@ -27,7 +28,8 @@ class _RangeRead:
         if self.tensor_shape is None:
             return pa.table({"id": pa.array(ids)})
         data = [np.full(self.tensor_shape, i, dtype=np.int64) for i in ids]
-        return pa.table({"data": pa.array([d.tolist() for d in data])})
+        return pa.table({"data": tensor_column(
+            data, dtype=np.int64, ndim=len(self.tensor_shape))})
 
 
 def make_range_read_tasks(n: int, parallelism: int,
@@ -110,7 +112,7 @@ class _ImageRead:
             # reference semantics: size=(height, width); PIL takes (w, h)
             img = img.resize((self.size[1], self.size[0]))
         arr = np.asarray(img)
-        cols = {"image": pa.array([arr.tolist()])}
+        cols = {"image": tensor_column([arr])}
         if self.include_paths:
             cols["path"] = pa.array([self.path], pa.string())
         return pa.table(cols)
@@ -126,7 +128,7 @@ class _NumpyRead:
         arr = np.load(self.path)
         if arr.ndim == 1:
             return pa.table({"data": pa.array(arr)})
-        return pa.table({"data": pa.array([a.tolist() for a in arr])})
+        return pa.table({"data": stacked_tensor_column(arr)})
 
 
 def expand_paths(paths) -> List[str]:

@@ -72,6 +72,7 @@ def from_pandas(dfs) -> Dataset:
 
 
 def from_numpy(arrays) -> Dataset:
+    from ray_tpu.data.block import stacked_tensor_column
     if isinstance(arrays, np.ndarray):
         arrays = [arrays]
     blocks = []
@@ -79,7 +80,8 @@ def from_numpy(arrays) -> Dataset:
         if arr.ndim == 1:
             blocks.append(pa.table({"data": pa.array(arr)}))
         else:
-            blocks.append(pa.table({"data": pa.array(arr.tolist())}))
+            blocks.append(pa.table(
+                {"data": stacked_tensor_column(arr)}))
     return from_blocks(blocks)
 
 

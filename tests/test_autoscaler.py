@@ -3,6 +3,7 @@ StandardAutoscaler.update with a fake provider,
 python/ray/tests/test_autoscaler.py + FakeMultiNodeProvider)."""
 
 import time
+import urllib.parse
 
 import pytest
 
@@ -164,6 +165,7 @@ class _FakeTpuApi:
         self.nodes = {}          # provider_id -> node_type name
         self.runtime_nodes = {}  # provider_id -> [NodeID]
         self.fail_next_list = False
+        self.page_size = 0       # >0: serve GETs in pages w/ tokens
 
     def __call__(self, method, url, body):
         from ray_tpu.autoscaler.gce import (
@@ -194,11 +196,26 @@ class _FakeTpuApi:
             if self.fail_next_list:
                 self.fail_next_list = False
                 return 503, {"error": "backend unavailable"}
-            return 200, {"nodes": [
+            entries = [
                 {"name": f"projects/p/locations/z/nodes/{pid}",
                  "state": "READY",
                  "labels": {"ray-tpu-node-type": t}}
-                for pid, t in self.nodes.items()]}
+                for pid, t in self.nodes.items()]
+            if not self.page_size:
+                return 200, {"nodes": entries}
+            # Paged listing: opaque token = start index (with reserved
+            # chars, so the client must URL-encode it).
+            start = 0
+            if "pageToken=" in url:
+                token = urllib.parse.unquote(
+                    url.rsplit("pageToken=", 1)[-1])
+                assert token.startswith("idx+&/")
+                start = int(token[len("idx+&/"):])
+            page = entries[start:start + self.page_size]
+            out = {"nodes": page}
+            if start + self.page_size < len(entries):
+                out["nextPageToken"] = f"idx+&/{start + self.page_size}"
+            return 200, out
         raise AssertionError(f"unexpected {method} {url}")
 
 
@@ -271,3 +288,39 @@ def test_gce_provider_api_shapes(small_head):
     provider.terminate_node(pid)
     assert provider.non_terminated_nodes() == {}
     assert provider.runtime_node_ids(pid) == []
+
+
+def test_gce_provider_paginated_listing(small_head):
+    """nodes.list pagination: all pages are accumulated (tokens with
+    reserved chars must be URL-encoded), and a mid-pagination failure
+    falls back to the full local view instead of a truncated page."""
+    from ray_tpu.autoscaler import GceTpuSliceNodeProvider
+
+    rt = small_head
+    fake_api = _FakeTpuApi(rt, hosts_per_slice=1)
+    fake_api.page_size = 2
+    provider = GceTpuSliceNodeProvider(
+        "proj", "us-central2-b", "head:6379", runtime=rt,
+        http_request=fake_api)
+    nt = NodeTypeConfig("v5p-host", {"CPU": 1.0, "TPU": 4.0},
+                        provider_params={"accelerator_type": "v5p-8"})
+    pids = {provider.create_node(nt) for _ in range(5)}
+
+    listed = provider.non_terminated_nodes()
+    assert set(listed) == pids          # pages 1-3 merged, none dropped
+    assert all(t == "v5p-host" for t in listed.values())
+    gets = [u for m, u in fake_api.requests if m == "GET"]
+    assert len(gets) == 3               # 2 + 2 + 1 rows
+    assert any("pageToken=idx%2B%26%2F" in u for u in gets)  # encoded
+
+    # Failure on page 2 of a later poll: full local view, not 2 rows.
+    def fail_second(method, url, body, _n=[0]):
+        if method == "GET":
+            _n[0] += 1
+            if _n[0] == 2:
+                return 503, {"error": "hiccup"}
+        return fake_api(method, url, body)
+
+    provider._http = fail_second
+    assert set(provider.non_terminated_nodes()) == pids
+    provider._http = fake_api

@@ -436,6 +436,29 @@ def test_read_numpy(ray_start_shared, tmp_path):
     np.testing.assert_allclose(rows[3]["data"], arr[3])
 
 
+def test_read_numpy_empty_shard(ray_start_shared, tmp_path):
+    # A 0-row .npy shard must produce a valid typed 0-row block.
+    from ray_tpu import data as rd
+    np.save(tmp_path / "e.npy", np.zeros((0, 5), dtype=np.float32))
+    assert rd.read_numpy(str(tmp_path / "e.npy")).take_all() == []
+
+
+def test_tensor_reads_preserve_dtype(ray_start_shared, tmp_path):
+    # uint8 images stay uint8 through arrow (reference read_images
+    # semantics) instead of widening to int64 nested lists.
+    import pyarrow as pa
+    from ray_tpu.data.datasource import _ImageRead, _NumpyRead
+    from PIL import Image
+
+    Image.new("RGB", (4, 3), (9, 8, 7)).save(tmp_path / "i.png")
+    t = _ImageRead(str(tmp_path / "i.png"))()
+    assert t.column("image").type == pa.list_(
+        pa.list_(pa.list_(pa.uint8())))
+    np.save(tmp_path / "h.npy", np.ones((2, 3), dtype=np.float16))
+    t = _NumpyRead(str(tmp_path / "h.npy"))()
+    assert t.column("data").type == pa.list_(pa.float16())
+
+
 def test_expressions_with_column_and_filter(ray_start_shared):
     from ray_tpu import data as rd
     from ray_tpu.data import col, lit

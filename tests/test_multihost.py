@@ -347,6 +347,55 @@ def test_protocol_minor_negotiation_and_unknown_kind_probe(tcp_cluster):
         _kill_daemon(proc)
 
 
+def test_reregister_reaps_stale_connection(tcp_cluster):
+    """A daemon re-registering the same node id on a NEW connection
+    (link blip on a live head) must reap the old record — old socket
+    closed, scheduler/GCS adopt the fresh one — and the stale reader's
+    late EOF must NOT tear down the new registration (identity guard in
+    the head serve loop; reference: raylet re-registration with a live
+    GCS, gcs_node_manager.h:47)."""
+    import socket as socket_mod
+
+    from ray_tpu.core import serialization
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.protocol import (PROTOCOL_VERSION, recv_frame,
+                                       send_frame)
+
+    rt = tcp_cluster.runtime
+    host, port = rt.head_address.split(":")
+    nid = NodeID.from_random()
+
+    def register():
+        sock = socket_mod.create_connection((host, int(port)), timeout=10)
+        send_frame(sock, serialization.dumps_fast({
+            "kind": "NODE_REGISTER", "proto_version": PROTOCOL_VERSION,
+            "node_id": nid.binary(), "resources": {"CPU": 0.0},
+            "labels": {}, "object_addr": ["127.0.0.1", 1],
+            "address": "blip:0"}))
+        reply = serialization.loads(recv_frame(sock))
+        assert reply["kind"] == "REGISTERED"
+        return sock
+
+    sock1 = register()
+    first = rt.nodes.get(nid)
+    assert first is not None
+    sock2 = register()  # same node id, old socket still open
+    # Reap: the head closed sock1; its recv sees EOF promptly.
+    sock1.settimeout(10)
+    assert recv_frame(sock1) is None
+    sock1.close()
+    # The NEW record must be installed and must survive the stale
+    # reader thread observing sock1's EOF.
+    deadline = time.time() + 5
+    while time.time() < deadline and rt.nodes.get(nid) is first:
+        time.sleep(0.05)
+    second = rt.nodes.get(nid)
+    assert second is not None and second is not first
+    time.sleep(0.5)  # give a buggy stale-death path time to misfire
+    assert rt.nodes.get(nid) is second
+    sock2.close()
+
+
 def test_daemon_survives_head_restart(tmp_path):
     """Head-restart tolerance (a slice of head fault tolerance;
     reference: raylets reconnecting to a restarted GCS +
