@@ -2,11 +2,14 @@
 python/ray/llm). The engine is in-tree and TPU-native (static-shape KV
 caches, jitted whole-batch decode) instead of wrapping vLLM."""
 
+from ray_tpu.llm.batch import (
+    Processor, ProcessorConfig, build_llm_processor, throughput_summary)
 from ray_tpu.llm.engine import (
     ContinuousBatchingEngine, EngineConfig, GenerationRequest)
 from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
 
 __all__ = [
     "ByteTokenizer", "ContinuousBatchingEngine", "EngineConfig",
-    "GenerationRequest", "get_tokenizer",
+    "GenerationRequest", "Processor", "ProcessorConfig",
+    "build_llm_processor", "get_tokenizer", "throughput_summary",
 ]

@@ -86,12 +86,20 @@ class LLMServer:
                 f"model vocab ({config.engine.model.vocab_size}); token "
                 "embedding lookups would silently clamp")
         self._wake = threading.Event()
+        self._stopped = False
         self._stepper = threading.Thread(target=self._step_loop,
                                          daemon=True)
         self._stepper.start()
 
+    def stop(self) -> None:
+        """Halt the stepper thread and fail in-flight requests — called
+        when a multiplex LRU evicts this model from a replica."""
+        self._stopped = True
+        self._wake.set()
+        self.engine.fail_all("model evicted from replica")
+
     def _step_loop(self) -> None:
-        while True:
+        while not self._stopped:
             try:
                 if self.engine.has_work():
                     self.engine.step()
@@ -192,6 +200,12 @@ class LLMServer:
             if self.tokenizer.eos_id is not None else ())
         self.engine.add_request(request)
         self._wake.set()
+        if self._stopped:
+            # Raced an LRU eviction: stop() set _stopped before its
+            # fail_all, so failing again here covers a request admitted
+            # after that sweep (it would otherwise never finish — no
+            # stepper is alive).
+            self.engine.fail_all("model evicted from replica")
         while not request.done:
             time.sleep(0.001)
         if request.error is not None:
@@ -228,6 +242,9 @@ class LLMServer:
             stream_queue=queue.Queue())
         self.engine.add_request(request)
         self._wake.set()
+        if self._stopped:
+            # see _generate: covers admission racing an LRU eviction
+            self.engine.fail_all("model evicted from replica")
         yield from stream_text_deltas(self.tokenizer, request)
 
     # -- OpenAI-compatible surface (routed by path) --------------------
@@ -373,6 +390,98 @@ class LLMServer:
         }
 
 
+class MultiplexLLMServer:
+    """One deployment serving MANY models: requests route by the OpenAI
+    ``model`` field to a per-replica LRU of resident LLMServer engines
+    via @serve.multiplexed; unknown ids get a 404 model_not_found and
+    per-model request/token counters feed /metrics (reference:
+    serve/llm/__init__.py:178 multi-model build_openai_app +
+    _internal/serve routing by model id)."""
+
+    def __init__(self, configs: List[LLMConfig],
+                 params_blobs: Optional[Dict[str, bytes]] = None,
+                 max_models_per_replica: int = 2):
+        from ray_tpu.util import metrics as metrics_mod
+        if not configs:
+            raise ValueError("MultiplexLLMServer needs >= 1 LLMConfig")
+        self._configs: Dict[str, LLMConfig] = {}
+        for c in configs:
+            if c.model_id in self._configs:
+                raise ValueError(f"duplicate model_id {c.model_id!r}")
+            self._configs[c.model_id] = c
+        self._params = dict(params_blobs or {})
+        # Wire the instance's LRU size through @serve.multiplexed at
+        # init time (the decorator binds max_num_models_per_replica at
+        # decoration; replicas construct this class locally, so the
+        # bound loader never needs to pickle).
+        loader = serve.multiplexed(
+            max_num_models_per_replica=max_models_per_replica)(
+                MultiplexLLMServer._load_model)
+        self._load = lambda mid: loader(self, mid)
+        self._requests = metrics_mod.Counter(
+            "serve_llm_requests", "LLM requests by model",
+            tag_keys=("model",))
+        self._tokens = metrics_mod.Counter(
+            "serve_llm_generated_tokens", "Generated tokens by model",
+            tag_keys=("model",))
+
+    def _load_model(self, model_id: str) -> LLMServer:
+        return LLMServer(self._configs[model_id],
+                         self._params.get(model_id))
+
+    def _resolve(self, body: Dict[str, Any]):
+        """model id -> resident LLMServer, or a 404 error dict."""
+        model = body.get("model")
+        if model is None and len(self._configs) == 1:
+            model = next(iter(self._configs))
+        if model not in self._configs:
+            return None, {
+                "__status__": 404,
+                "error": {
+                    "message": f"model {model!r} not found; serving "
+                               f"{sorted(self._configs)}",
+                    "type": "invalid_request_error",
+                    "code": "model_not_found"}}
+        self._requests.inc(tags={"model": model})
+        return self._load(model), None
+
+    def _count_tokens(self, model: str, result_or_n) -> None:
+        n = (result_or_n if isinstance(result_or_n, (int, float))
+             else result_or_n.get("completion_tokens", 0))
+        if n:
+            self._tokens.inc(n, tags={"model": model})
+
+    def __call__(self, request: Dict[str, Any]) -> Any:
+        path = request.get("__path__", "")
+        if path.endswith("/models"):
+            return {"object": "list",
+                    "data": [{"id": mid, "object": "model"}
+                             for mid in self._configs]}
+        server, err = self._resolve(request)
+        if err is not None:
+            return err
+        out = server(request)
+        # count completion tokens for non-streaming responses; the
+        # streaming paths count per-chunk inside the wrapped generator
+        if isinstance(out, dict):
+            usage = out.get("usage") or {}
+            self._count_tokens(request.get("model")
+                               or server.config.model_id,
+                               usage.get("completion_tokens", 0))
+            return out
+        if hasattr(out, "__iter__") and not isinstance(out, (str, bytes)):
+            model = request.get("model") or server.config.model_id
+
+            def counted():
+                n = 0
+                for chunk in out:
+                    n += 1
+                    yield chunk
+                self._count_tokens(model, n)
+            return counted()
+        return out
+
+
 def build_llm_deployment(config: LLMConfig, params=None,
                          name: Optional[str] = None):
     """An Application serving `config` (reference:
@@ -390,11 +499,34 @@ def build_llm_deployment(config: LLMConfig, params=None,
 
 
 def build_openai_app(llm_configs: List[LLMConfig] = None, *,
-                     config: LLMConfig = None, params=None):
-    """OpenAI-compatible app (reference: serve/llm build_openai_app).
-    Single-model per app in this round; multi-model routing via model
-    multiplexing is future work."""
-    if config is None:
-        configs = llm_configs or [LLMConfig()]
-        config = configs[0]
-    return build_llm_deployment(config, params=params)
+                     config: LLMConfig = None, params=None,
+                     params_by_model: Optional[Dict[str, Any]] = None,
+                     name: str = "openai-llm",
+                     max_models_per_replica: int = 2):
+    """OpenAI-compatible app (reference: serve/llm/__init__.py:178
+    build_openai_app serving many models per app with model-id routing).
+
+    One config -> a plain LLMServer deployment (no routing layer).
+    Many configs -> a MultiplexLLMServer whose replicas keep an LRU of
+    resident engines and route by the request ``model`` field; unknown
+    ids answer 404 model_not_found, /v1/models lists all ids, and
+    per-model request/token counters land in /metrics.
+    """
+    if config is not None:
+        return build_llm_deployment(config, params=params)
+    configs = llm_configs or [LLMConfig()]
+    if len(configs) == 1 and params_by_model is None:
+        return build_llm_deployment(configs[0], params=params)
+    if params is not None:
+        raise ValueError(
+            "multi-model apps take params_by_model={model_id: params}, "
+            "not params= (which model would it apply to?)")
+    from ray_tpu.core import serialization
+    blobs = {mid: serialization.dumps(p)
+             for mid, p in (params_by_model or {}).items()}
+    dep = serve.deployment(
+        MultiplexLLMServer, name=name,
+        num_replicas=max(c.num_replicas for c in configs),
+        max_ongoing_requests=max(c.max_ongoing_requests
+                                 for c in configs))
+    return dep.bind(configs, blobs, max_models_per_replica)

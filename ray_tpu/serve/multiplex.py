@@ -62,10 +62,30 @@ def _lookup(key, call, model_id, max_models):
             _current_model_id.value = model_id
             return cache[model_id]
     model = call(model_id)
+    evicted = []
     with _state_lock:
-        cache[model_id] = model
-        cache.move_to_end(model_id)
-        while len(cache) > max_models:
-            cache.popitem(last=False)
+        existing = cache.get(model_id)
+        if existing is not None:
+            # Concurrent miss: another thread loaded first — its model
+            # is canonical; release ours instead of silently replacing
+            # (the loser would leak its engine + stepper thread).
+            evicted.append(model)
+            model = existing
+            cache.move_to_end(model_id)
+        else:
+            cache[model_id] = model
+            cache.move_to_end(model_id)
+            while len(cache) > max_models:
+                evicted.append(cache.popitem(last=False)[1])
+    # Release evicted models' resources outside the lock (an LLM model
+    # holds an engine + stepper thread; reference: serve multiplex
+    # calls the model's __del__ on eviction).
+    for old in evicted:
+        stop = getattr(old, "stop", None)
+        if callable(stop):
+            try:
+                stop()
+            except Exception:  # noqa: BLE001 — eviction is best-effort
+                pass
     _current_model_id.value = model_id
     return model

@@ -102,7 +102,13 @@ def _make_handler(state: _ProxyState):
                     return
                 kind, value = first
                 if kind == "single":
-                    self._respond(200, value)
+                    # Reserved "__status__": handlers set the HTTP code
+                    # (e.g. 404 model_not_found on the OpenAI surface).
+                    code = 200
+                    if isinstance(value, dict) and "__status__" in value:
+                        value = dict(value)
+                        code = int(value.pop("__status__"))
+                    self._respond(code, value)
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
