@@ -197,6 +197,7 @@ class ActorClass:
             max_restarts=opts.get("max_restarts",
                                   cfg.actor_default_max_restarts),
             max_concurrency=opts.get("max_concurrency", 1),
+            actor_name=opts.get("name"),
             runtime_env=renv,
             runtime_env_hash=runtime_env_hash(renv) if renv else "",
         )

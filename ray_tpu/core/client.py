@@ -33,7 +33,8 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.protocol import (
     PROTOCOL_VERSION, MessageConnection, connect_tcp, parse_address)
 from ray_tpu.core.task_manager import ReferenceCounter
-from ray_tpu.exceptions import GetTimeoutError, ObjectLostError
+from ray_tpu.exceptions import (GetTimeoutError, HeadRestartedError,
+                                ObjectLostError)
 
 
 class _MemStore:
@@ -100,30 +101,20 @@ class ClientRuntime:
     is_client = True
 
     def __init__(self, address: str, namespace: str = ""):
-        host, port = parse_address(address)
         self.address = address
-        self.conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
-        from ray_tpu.core.config import get_config
-        token = get_config().auth_token
-        if token:
-            # plaintext auth frame BEFORE any pickled message (the head
-            # refuses to unpickle from unauthenticated peers)
-            from ray_tpu.core.protocol import send_frame
-            send_frame(self.conn.sock, b"AUTH" + token.encode("utf-8"))
-        from ray_tpu.core.protocol import PROTOCOL_MINOR
-        self.conn.send({"kind": "CLIENT_REGISTER",
-                        "proto_version": PROTOCOL_VERSION,
-                        "proto_minor": PROTOCOL_MINOR,
-                        "namespace": namespace})
-        reply = self.conn.recv()
-        if reply is None or reply.get("kind") != "REGISTERED":
-            reason = (reply or {}).get("reason", "connection closed")
-            raise ConnectionError(f"head rejected client: {reason}")
-        self.head_node_id = NodeID(reply["head_node_id"])
-        # Negotiated head features (additive minors; protocol.py policy)
-        self.head_proto_minor = reply.get("proto_minor", 0)
-        self.head_capabilities = frozenset(reply.get("capabilities", ()))
+        self.namespace = namespace
+        self.conn = self._connect()
         self._req_lock = threading.Lock()
+        # ObjectRefs minted before a head restart: the new head never
+        # owned them, so gets fail fast with HeadRestartedError
+        self._lost_oids: set = set()
+        self._connected = threading.Event()
+        self._connected.set()
+        # Bumped by the reader at every disconnect; request() compares
+        # it around send so a request that raced the inflight sweep
+        # (registered after the sweep, sent into a dead socket) fails
+        # typed instead of waiting forever for a reply.
+        self._conn_epoch = 0
         self._req_counter = 0
         self._replies: Dict[int, Tuple[threading.Event, list]] = {}
         self._pubsub_callbacks: Dict[str, list] = {}
@@ -143,15 +134,54 @@ class ClientRuntime:
         self._reader.start()
 
     # -- transport -------------------------------------------------------
+    def _connect(self) -> MessageConnection:
+        """Dial + AUTH + CLIENT_REGISTER handshake (used at init and by
+        the reconnect loop after a head restart)."""
+        host, port = parse_address(self.address)
+        conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
+        from ray_tpu.core.config import get_config
+        token = get_config().auth_token
+        if token:
+            # plaintext auth frame BEFORE any pickled message (the head
+            # refuses to unpickle from unauthenticated peers)
+            from ray_tpu.core.protocol import send_frame
+            send_frame(conn.sock, b"AUTH" + token.encode("utf-8"))
+        from ray_tpu.core.protocol import PROTOCOL_MINOR
+        conn.send({"kind": "CLIENT_REGISTER",
+                   "proto_version": PROTOCOL_VERSION,
+                   "proto_minor": PROTOCOL_MINOR,
+                   "namespace": self.namespace})
+        reply = conn.recv()
+        if reply is None or reply.get("kind") != "REGISTERED":
+            conn.close()
+            reason = (reply or {}).get("reason", "connection closed")
+            raise ConnectionError(f"head rejected client: {reason}")
+        self.head_node_id = NodeID(reply["head_node_id"])
+        # Negotiated head features (additive minors; protocol.py policy)
+        self.head_proto_minor = reply.get("proto_minor", 0)
+        self.head_capabilities = frozenset(reply.get("capabilities", ()))
+        return conn
+
     def _send(self, msg: dict) -> None:
         if self._closed.is_set():
             return
         try:
             self.conn.send(msg)
         except OSError:
-            self._closed.set()
+            pass  # the reader observes the drop and drives recovery
 
     def request(self, msg: dict, timeout: Optional[float] = None) -> Any:
+        from ray_tpu.core.config import get_config
+        if not self._connected.is_set():
+            # head link down: wait out an in-progress reconnect (bounded
+            # by the window) instead of failing a brand-new request
+            window = get_config().client_reconnect_s
+            wait = window if timeout is None else min(timeout, window)
+            if not self._connected.wait(wait) or self._closed.is_set():
+                raise HeadRestartedError(
+                    "connection to head lost (no reconnection within "
+                    f"client_reconnect_s={window})")
+        epoch = self._conn_epoch
         with self._req_lock:
             self._req_counter += 1
             rid = self._req_counter
@@ -160,13 +190,14 @@ class ClientRuntime:
             self._replies[rid] = (event, slot)
         msg["req_id"] = rid
         self._send(msg)
-        if self._closed.is_set():
-            # the reader already woke (only) the requests registered at
-            # disconnect time; a request registered after must not wait
-            # on a reply that can never arrive
+        if self._closed.is_set() or self._conn_epoch != epoch:
+            # the reader's sweep only wakes requests registered at
+            # disconnect time; one registered after (or sent into the
+            # dying socket) must not wait on a reply that can never
+            # arrive
             with self._req_lock:
                 self._replies.pop(rid, None)
-            raise ConnectionError("connection to head lost")
+            raise HeadRestartedError("connection to head lost")
         if not event.wait(timeout):
             with self._req_lock:
                 self._replies.pop(rid, None)
@@ -175,16 +206,72 @@ class ClientRuntime:
         with self._req_lock:
             self._replies.pop(rid, None)
         if slot[0] is None:
-            raise ConnectionError("connection to head lost")
+            raise HeadRestartedError(
+                "connection to head lost while waiting for a reply; "
+                "in-flight work does not survive a head restart")
         return slot[0]
+
+    def _fail_inflight(self) -> None:
+        """Wake every pending request with 'reply lost' (slot stays
+        None -> request() raises HeadRestartedError)."""
+        with self._req_lock:
+            entries = list(self._replies.values())
+            self._replies.clear()
+        for event, _slot in entries:
+            event.set()
+
+    def _try_reconnect(self) -> bool:
+        """Re-register within client_reconnect_s after losing the head
+        (head FT slice 2; reference: ray client reconnect_grace_period /
+        workers reconnecting to a restarted GCS). Pre-restart
+        ObjectRefs are recorded as lost — the new head never owned
+        them — then the session resumes for NEW work."""
+        import time as _time
+
+        from ray_tpu.core.config import get_config
+        window = get_config().client_reconnect_s
+        if window <= 0 or self._closed.is_set():
+            return False
+        deadline = _time.monotonic() + window
+        delay = 0.25
+        while not self._closed.is_set():
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return False
+            try:
+                conn = self._connect()
+            except (OSError, ConnectionError):
+                _time.sleep(min(delay, max(0.0, remaining)))
+                delay = min(delay * 2, 2.0)
+                continue
+            # every ref minted before the restart is gone for good
+            self._lost_oids.update(
+                self.reference_counter.live_object_ids())
+            self.conn = conn
+            # re-establish server-side pubsub routes for live
+            # subscriptions (the new head has no record of them)
+            with self._req_lock:
+                channels = [c for c, cbs in self._pubsub_callbacks.items()
+                            if cbs]
+            for channel in channels:
+                self._send({"kind": "SUBSCRIBE", "channel": channel})
+            self._connected.set()
+            return True
+        return False
 
     def _reader_loop(self) -> None:
         while not self._closed.is_set():
+            conn = self.conn
             try:
-                msg = self.conn.recv()
+                msg = conn.recv()
             except OSError:
                 msg = None
             if msg is None:
+                self._conn_epoch += 1
+                self._connected.clear()
+                self._fail_inflight()
+                if self._try_reconnect():
+                    continue
                 break
             kind = msg.get("kind")
             if kind == "PUBSUB_MSG":
@@ -203,12 +290,8 @@ class ClientRuntime:
                 slot[0] = msg
                 event.set()
         self._closed.set()
-        # unblock every pending request with a connection error
-        with self._req_lock:
-            entries = list(self._replies.values())
-            self._replies.clear()
-        for event, _slot in entries:
-            event.set()
+        self._connected.set()  # wake request() waiters to fail fast
+        self._fail_inflight()
 
     # -- object plane ----------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
@@ -230,6 +313,11 @@ class ClientRuntime:
         return ObjectRef(oid)
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float]):
+        if oid in self._lost_oids:
+            raise HeadRestartedError(
+                f"ObjectRef {oid.hex()[:16]} was created before a head "
+                "restart; objects do not survive one — resubmit the "
+                "work that produced it")
         reply = self.request({"kind": "GET_OBJECT",
                               "object_id": oid.binary()}, timeout=timeout)
         status = reply["status"]
@@ -274,8 +362,13 @@ class ClientRuntime:
         deadline = (None if timeout is None
                     else _time.monotonic() + timeout)
         pending = list(refs)
-        ready: List[ObjectRef] = []
-        while True:
+        # Pre-restart refs are permanently lost: count them READY (a
+        # get on one raises HeadRestartedError, matching failed-object
+        # wait semantics) instead of polling the new head forever.
+        ready: List[ObjectRef] = [r for r in pending
+                                  if r.id in self._lost_oids]
+        pending = [r for r in pending if r.id not in self._lost_oids]
+        while pending:
             ids = [r.id.binary() for r in pending]
             reply = self.request({"kind": "CHECK_READY",
                                   "object_ids": ids}, timeout=30.0)

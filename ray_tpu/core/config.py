@@ -99,6 +99,12 @@ class Config:
     # The daemon re-registers under its same node id; work in flight
     # across the outage is lost and re-driven by the new head's driver.
     node_reconnect_s: float = 0.0
+    # Seconds a CLIENT driver keeps retrying its head connection after
+    # losing it (head crash/restart). In-flight requests still fail
+    # with HeadRestartedError (pre-restart ObjectRefs are gone — the
+    # new head never owned them) but the session re-registers and new
+    # submissions work. 0 = fail permanently (legacy behavior).
+    client_reconnect_s: float = 0.0
     # Shared-secret authentication for cross-host connections
     # (reference: src/ray/rpc/authentication/ — cluster-wide token).
     # When set on the head (RTPU_AUTH_TOKEN), peers must open with a

@@ -202,10 +202,12 @@ class Pubsub:
 class Gcs:
     def __init__(self, store=None):
         """``store``: optional FileStoreClient for control-plane
-        durability — the KV store, job records, and the function store
-        are journaled and replayed on restart (reference: Redis-backed
-        GCS + gcs_init_data.cc replay). Node/actor tables are not:
-        their processes die with the head."""
+        durability — the KV store, job records, the function store, and
+        NAMED actor records are journaled and replayed on restart
+        (reference: Redis-backed GCS + gcs_init_data.cc replay). The
+        node table and anonymous actors are not: nodes re-register
+        themselves (reporting surviving actor workers for re-binding),
+        and anonymous actors die with their driver."""
         self.lock = threading.RLock()
         self.store = store
 
@@ -231,9 +233,9 @@ class Gcs:
     def _restore_from_store(self) -> None:
         """Replay the durability journal into the fresh tables
         (reference: gcs_init_data.cc loading all tables on GCS start).
-        Node/actor records are NOT restored — processes died with the
-        previous head; only control-plane state that outlives processes
-        (KV, jobs, functions) comes back."""
+        Node records are NOT restored — daemons re-register themselves
+        within node_reconnect_s; KV, jobs, functions, and named actors
+        come back."""
         for key, value in self.store.items("kv").items():
             namespace, k = key
             self.kv._data[(namespace, k)] = value
@@ -241,6 +243,17 @@ class Gcs:
             self.jobs[JobID(job_id_bin)] = record
         for function_id, blob in self.store.items("functions").items():
             self.functions[function_id] = blob
+        # Named actors come back ORPHANED: unreachable until their
+        # daemon re-registers and reports them live, at which point the
+        # runtime re-binds them and flips the state to ALIVE (head FT
+        # slice 2; reference: gcs_init_data.cc actor-table replay).
+        for aid_bin, record in self.store.items("actors").items():
+            record.state = "ORPHANED"
+            record.node_id = None
+            self.actors[record.actor_id] = record
+            if record.name:
+                self.named_actors[(record.namespace, record.name)] = (
+                    record.actor_id)
 
     # --- nodes ---------------------------------------------------------
     def register_node(self, record: NodeRecord,
@@ -298,13 +311,44 @@ class Gcs:
                 key = (record.namespace, record.name)
                 if key in self.named_actors:
                     existing = self.actors.get(self.named_actors[key])
-                    if existing and existing.state != "DEAD":
+                    if existing and existing.state == "ORPHANED":
+                        # Post-head-restart replay whose node has not
+                        # (and may never) re-register: the user
+                        # re-creating the name supersedes it. Mark the
+                        # orphan dead so a late node report won't adopt
+                        # it (the runtime kills the stray worker).
+                        existing.state = "DEAD"
+                        existing.death_cause = "superseded by re-creation"
+                        self._persist_actor(existing)
+                    elif existing and existing.state != "DEAD":
                         raise ValueError(
                             f"actor name {record.name!r} already taken in "
                             f"namespace {record.namespace!r}"
                         )
                 self.named_actors[key] = record.actor_id
             self.actors[record.actor_id] = record
+            self._persist_actor(record)
+
+    def _persist_actor(self, record: ActorRecord) -> None:
+        """Journal NAMED actors so a restarted head can re-attach them
+        to surviving daemon workers (head FT slice 2; reference:
+        gcs_actor_manager persistence + gcs_init_data.cc replay).
+        Anonymous actors die with their driver, so they are not kept.
+        Caller holds self.lock."""
+        if self.store is None or not record.name:
+            return
+        if record.state == "DEAD":
+            self.store.delete("actors", record.actor_id.binary())
+            return
+        try:
+            self.store.put("actors", record.actor_id.binary(), record)
+        except Exception:  # noqa: BLE001 — an unpicklable creation spec
+            # (e.g. closure-captured state) must not break the actor;
+            # persist the record without it (re-attach still works, a
+            # post-restart RESTART of the actor will not)
+            import dataclasses
+            self.store.put("actors", record.actor_id.binary(),
+                           dataclasses.replace(record, spec=None))
 
     def update_actor_state(self, actor_id: ActorID, state: str,
                            node_id: Optional[NodeID] = None,
@@ -328,6 +372,7 @@ class Gcs:
                     del self.named_actors[key]
                     self.kv.delete(rec.name.encode(),
                                    namespace="actor_handles")
+            self._persist_actor(rec)
         self.pubsub.publish("actor", (state, actor_id))
 
     def get_actor(self, actor_id: ActorID) -> Optional[ActorRecord]:

@@ -21,7 +21,7 @@ import tempfile
 import threading
 import time
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional
+from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config
@@ -789,6 +789,16 @@ class Node:
                     worker.proc.kill()
                 except ProcessLookupError:
                     pass
+
+    def live_actors(self) -> List[Tuple[bytes, bytes]]:
+        """(actor_id, worker_id) for every live actor worker — reported
+        in NODE_REGISTER so a restarted head re-binds surviving
+        detached/named actors (reference: gcs_init_data.cc replaying
+        actor ownership on GCS restart)."""
+        with self._lock:
+            return [(w.actor_id.binary(), w.worker_id.binary())
+                    for w in self._workers.values()
+                    if w.state == ACTOR and w.actor_id is not None]
 
     # --- shutdown ------------------------------------------------------
     def stop(self) -> None:

@@ -215,6 +215,9 @@ class NodeDaemon:
                 "object_addr": [self._advertise,
                                 self.object_server.address[1]],
                 "address": f"{socket.gethostname()}:{os.getpid()}",
+                # live actor workers, so a restarted head re-binds
+                # surviving detached/named actors (head FT slice 2)
+                "actors": self.node.live_actors(),
             })
             reply = conn.recv()
         finally:

@@ -285,6 +285,11 @@ class HeadServer:
         self._listener = listen_tcp(host, port)
         self.address: Tuple[str, int] = self._listener.getsockname()
         self._stopped = threading.Event()
+        # Every accepted connection, so stop() can sever them the way a
+        # real head crash would (clients/daemons then observe EOF and
+        # run their reconnect paths instead of waiting forever).
+        self._conns_lock = threading.Lock()
+        self._conns: set = set()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="head-accept", daemon=True)
         self._accept_thread.start()
@@ -298,8 +303,11 @@ class HeadServer:
                 sock, _ = self._listener.accept()
             except OSError:
                 return
+            conn = MessageConnection(sock)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._reader_loop,
-                             args=(MessageConnection(sock),),
+                             args=(conn,),
                              daemon=True).start()
 
     def _monitor_loop(self) -> None:
@@ -410,6 +418,8 @@ class HeadServer:
             except Exception:  # noqa: BLE001 — keep the daemon link alive
                 import traceback
                 traceback.print_exc()
+        with self._conns_lock:
+            self._conns.discard(conn)
         if node is not None:
             # expected= pins the death to THIS connection's RemoteNode:
             # with node_reconnect_s the daemon may have re-registered on
@@ -527,3 +537,14 @@ class HeadServer:
         except OSError:
             pass
         self._accept_thread.join(timeout=2.0)
+        # Sever every accepted connection, as a real crash would —
+        # remote peers (clients, daemons) observe EOF and run their
+        # reconnect logic instead of waiting on a half-dead head.
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -205,7 +205,29 @@ class DriverRuntime:
             self.head_server = HeadServer(self, cfg.head_host, cfg.head_port)
             self.head_address = (f"{self.head_server.address[0]}:"
                                  f"{self.head_server.address[1]}")
+        # Journal-replayed ORPHANED actors whose node never re-registers
+        # must not squat their names forever: reap any still orphaned
+        # after the reconnect window (name released, journal entry
+        # dropped, get_actor then fails cleanly).
+        orphans = [aid for aid, rec in self.gcs.actors.items()
+                   if rec.state == "ORPHANED"]
+        if orphans:
+            grace = max(cfg.node_reconnect_s, 60.0)
+            timer = threading.Timer(grace, self._reap_stale_orphans,
+                                    args=(orphans,))
+            timer.daemon = True
+            timer.start()
         self._sched_thread.start()
+
+    def _reap_stale_orphans(self, actor_ids) -> None:
+        if self._stopped.is_set():
+            return
+        for aid in actor_ids:
+            rec = self.gcs.get_actor(aid)
+            if rec is not None and rec.state == "ORPHANED":
+                self.gcs.update_actor_state(
+                    aid, "DEAD", death_cause="node never re-registered "
+                    "after the head restart")
 
     # --- cluster membership --------------------------------------------
     def add_node(self, resources: Optional[Dict[str, float]] = None,
@@ -285,12 +307,45 @@ class DriverRuntime:
         if reap_tail is not None:
             reap_tail()
         self.gcs.pubsub.publish("node", ("ALIVE", node_id))
+        self._adopt_surviving_actors(node, msg.get("actors") or ())
         self.retry_pending_placement_groups()
         with self._sched_cond:
             self._schedulable.extend(self._infeasible)
             self._infeasible.clear()
             self._sched_cond.notify_all()
         return node
+
+    def _adopt_surviving_actors(self, node, reported) -> None:
+        """Re-bind actors that survived a head restart on this node's
+        workers (head FT slice 2). The daemon reports (actor_id,
+        worker_id) pairs in NODE_REGISTER; any pair matching a
+        journal-replayed named-actor record becomes a live ActorInfo
+        again, so get_actor(name) handles dispatch straight to the
+        existing worker (reference: gcs_init_data.cc actor replay +
+        workers reconnecting to a restarted GCS)."""
+        for aid_bin, wid_bin in reported:
+            aid = ActorID(aid_bin)
+            record = self.gcs.get_actor(aid)
+            if record is None or record.state != "ORPHANED":
+                if record is None or record.state == "DEAD":
+                    # Stray: anonymous leftover, or an orphan the user
+                    # superseded/we reaped — reclaim the worker.
+                    node.kill_worker(WorkerID(wid_bin))
+                continue
+            if aid in self.actors:
+                continue  # already tracked (duplicate re-register)
+            info = ActorInfo(record.spec)
+            info.node_id = node.node_id
+            info.worker_id = WorkerID(wid_bin)
+            info.ready_for_dispatch = True
+            # Re-debit the creation resources so the fresh ledger
+            # reflects the worker the actor still occupies.
+            if record.spec is not None and self.scheduler.try_acquire(
+                    node.node_id, self._spec_resources(record.spec)):
+                info.resources_node = node.node_id
+            self.actors[aid] = info
+            self.gcs.update_actor_state(aid, "ALIVE",
+                                        node_id=node.node_id)
 
     def on_remote_node_death(self, node_id: NodeID,
                              expected=None) -> None:
@@ -996,9 +1051,21 @@ class DriverRuntime:
     # --- actor routing -------------------------------------------------
     def create_actor(self, spec: TaskSpec, name: Optional[str] = None) -> None:
         record = ActorRecord(
-            actor_id=spec.actor_id, name=name, namespace=self.namespace,
+            actor_id=spec.actor_id, name=name or spec.actor_name,
+            namespace=self.namespace,
             state="PENDING", spec=spec, max_restarts=spec.max_restarts)
-        self.gcs.register_actor(record)
+        try:
+            self.gcs.register_actor(record)
+        except ValueError as e:
+            if name is not None:
+                raise  # driver call sites expect the synchronous raise
+            # Duplicate name arriving via a client/worker SUBMIT (no
+            # reply channel): fail the creation task typed — the
+            # caller's handle then errors on use instead of the head
+            # reader swallowing a traceback.
+            self.task_manager.add_pending(spec)
+            self._fail_task(spec, e)
+            return
         self.actors[spec.actor_id] = ActorInfo(spec)
         self.submit_spec(spec)
 
@@ -1015,6 +1082,15 @@ class DriverRuntime:
         info = self.actors.get(spec.actor_id)
         record = self.gcs.get_actor(spec.actor_id)
         if info is None or record is None:
+            if record is not None and record.state == "ORPHANED":
+                # Journal-replayed named actor whose node has not
+                # re-registered (yet) after the head restart: fail as
+                # unavailable (retryable), not dead.
+                self._fail_task(spec, ActorUnavailableError(
+                    spec.actor_id,
+                    "actor orphaned by a head restart; awaiting its "
+                    "node's re-registration"))
+                return
             self._fail_task(spec,
                             ActorDiedError(spec.actor_id, "unknown actor"))
             return
@@ -1257,6 +1333,10 @@ class DriverRuntime:
             return
         can_restart = (record.max_restarts == -1
                        or record.num_restarts < record.max_restarts)
+        if info.creation_spec is None:
+            # Re-adopted after a head restart with an unjournalable
+            # creation spec: re-attach worked, restart cannot.
+            can_restart = False
         if can_restart:
             record.num_restarts += 1
             with info.lock:
@@ -1937,6 +2017,19 @@ class DriverRuntime:
             rec = gcs.nodes.get(NodeID(args[0]))
             return dict(rec.labels) if rec else {}
         if method == "kv_put":
+            if args[2] == "actor_handles":
+                # A named-actor handle may only be installed by the
+                # registration that actually OWNS the name: a client
+                # whose duplicate-name create_actor failed would
+                # otherwise overwrite the live actor's handle with one
+                # pointing at a never-registered actor id (the client
+                # sends kv_put after SUBMIT on the same ordered
+                # connection, so the record exists here by now).
+                handle = serialization.loads(args[1])
+                name = args[0].decode()
+                rec = gcs.get_named_actor(name, self.namespace)
+                if rec is None or rec.actor_id != handle._actor_id:
+                    return False
             gcs.kv.put(args[0], args[1], namespace=args[2])
             return True
         if method == "kv_get":

@@ -134,6 +134,12 @@ class ReferenceCounter:
             except Exception:
                 pass
 
+    def live_object_ids(self) -> List[ObjectID]:
+        """Every object id with a nonzero local count (the client's
+        reconnect path snapshots these as lost across a head restart)."""
+        with self._lock:
+            return [oid for oid, n in self._counts.items() if n > 0]
+
     def count(self, object_id: ObjectID) -> int:
         with self._lock:
             return self._counts.get(object_id, 0)

@@ -62,6 +62,10 @@ class TaskSpec:
     is_actor_creation: bool = False
     max_restarts: int = 0
     max_concurrency: int = 1
+    # user-facing actor name (named actors) — carried in the spec so
+    # actors created from clients/workers register under their name at
+    # the head (and get journaled for head-restart re-attach)
+    actor_name: Optional[str] = None
     # runtime environment (normalized dict; see ray_tpu/runtime_env/) —
     # workers are pooled per (hardware profile, runtime_env_hash)
     runtime_env: Optional[Dict[str, Any]] = None

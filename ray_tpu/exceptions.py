@@ -90,3 +90,19 @@ class NodeDiedError(RayTpuError):
 
 class PlacementGroupUnschedulableError(RayTpuError):
     """No feasible node assignment exists for the requested bundles."""
+
+
+class HeadRestartedError(RayTpuError, ConnectionError):
+    """The head (GCS) connection was lost, typically to a head crash or
+    restart. The user-visible contract across a head restart
+    (reference: workers reconnecting to a restarted Redis-backed GCS,
+    gcs_init_data.cc replay):
+
+    - In-flight ``get``/``wait``/requests fail with THIS error.
+    - ObjectRefs created before the restart do not survive it; getting
+      one raises this error immediately after reconnection.
+    - With ``client_reconnect_s > 0`` the client re-registers in the
+      background; new submissions after reconnection succeed.
+    - Detached/named actors on surviving nodes are re-attachable via
+      ``get_actor(name)`` once their daemon re-registers.
+    """
