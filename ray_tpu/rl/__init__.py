@@ -9,6 +9,7 @@ gradient allreduce across learner actors.
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
@@ -21,14 +22,15 @@ from ray_tpu.rl.env import (
 from ray_tpu.rl.env_runner import JaxEnvRunner, SingleAgentEnvRunner
 from ray_tpu.rl.learner import Learner, LearnerGroup, compute_gae
 from ray_tpu.rl.multi_agent import (
-    MultiAgentEnv, MultiAgentEnvRunner, RepeatedRockPaperScissors)
+    MultiAgentEnv, MultiAgentEnvRunner, RepeatedRockPaperScissors,
+    TicTacToe, TurnBasedEnvRunner)
 from ray_tpu.rl.rl_module import RLModuleSpec
 from ray_tpu.rl.sample_batch import SampleBatch, concat_samples
 from ray_tpu.rl import spaces
 
 __all__ = [
     "APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "CartPole",
-    "CartPoleJax", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
+    "CQL", "CQLConfig", "CartPoleJax", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
     "Env", "FrameStack", "IMPALA", "IMPALAConfig", "JaxEnv",
     "JaxEnvRunner", "Learner",
     "LearnerGroup", "MARWIL", "MARWILConfig", "MultiAgentEnv",
@@ -36,6 +38,7 @@ __all__ = [
     "OfflineData", "PPO", "PPOConfig", "Pendulum", "RLModuleSpec",
     "RepeatedRockPaperScissors", "RewardClip", "SAC", "SACConfig",
     "SampleBatch",
-    "SingleAgentEnvRunner", "collect_episodes", "compute_gae",
+    "SingleAgentEnvRunner", "TicTacToe", "TurnBasedEnvRunner",
+    "collect_episodes", "compute_gae",
     "concat_samples", "make_env", "register_env", "spaces",
 ]

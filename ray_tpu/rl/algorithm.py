@@ -227,6 +227,7 @@ class Algorithm:
         self.iteration = 0
         self._env_steps_lifetime = 0
         self._episode_returns: List[float] = []
+        self._episode_lens: List[int] = []
         if (config.evaluation_interval
                 and type(self).evaluate is Algorithm.evaluate):
             # Fail at build time, not at iteration N mid-job.
@@ -256,6 +257,7 @@ class Algorithm:
         elapsed = time.perf_counter() - start
         sampled = self._env_steps_lifetime - steps_before
         recent = self._episode_returns[-100:]
+        recent_lens = self._episode_lens[-100:]
         result = {
             "training_iteration": self.iteration,
             "num_env_steps_sampled": sampled,
@@ -264,6 +266,8 @@ class Algorithm:
             "time_this_iter_s": elapsed,
             "episode_return_mean": (float(np.mean(recent)) if recent
                                     else float("nan")),
+            "episode_len_mean": (float(np.mean(recent_lens))
+                                 if recent_lens else float("nan")),
             "episodes_total": len(self._episode_returns),
         }
         result.update(metrics)
@@ -278,8 +282,11 @@ class Algorithm:
         raise NotImplementedError(
             f"{type(self).__name__} does not implement evaluate()")
 
-    def record_episodes(self, returns: List[float]) -> None:
+    def record_episodes(self, returns: List[float],
+                        lens: Optional[List[int]] = None) -> None:
         self._episode_returns.extend(returns)
+        if lens:
+            self._episode_lens.extend(lens)
 
     # -- checkpointing (reference: rllib/utils/checkpoints.py
     #    Checkpointable.save_to_path / restore_from_path) ----------------
@@ -288,12 +295,14 @@ class Algorithm:
             "iteration": self.iteration,
             "env_steps_lifetime": self._env_steps_lifetime,
             "episode_returns": self._episode_returns[-1000:],
+            "episode_lens": self._episode_lens[-1000:],
         }
 
     def set_state(self, state: Dict[str, Any]) -> None:
         self.iteration = state["iteration"]
         self._env_steps_lifetime = state["env_steps_lifetime"]
         self._episode_returns = list(state["episode_returns"])
+        self._episode_lens = list(state.get("episode_lens", ()))
 
     def save_to_path(self, path: str) -> str:
         from ray_tpu.core import serialization

@@ -3,6 +3,8 @@ from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
 
 __all__ = ["APPO", "APPOConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
-           "SAC", "SACConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig"]
+           "SAC", "SACConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig",
+           "CQL", "CQLConfig"]

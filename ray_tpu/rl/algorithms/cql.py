@@ -1,0 +1,244 @@
+"""CQL — Conservative Q-Learning for offline RL (Kumar et al. 2020).
+
+Reference: rllib/algorithms/cql/cql.py (CQL built on SAC's torch
+policies + an offline reader). Here it rides the in-tree SAC machinery
+(`_SACNets` actor/critics) with the conservative penalty added to the
+critic loss:
+
+    L_CQL = alpha_cql * ( E_s[ logsumexp_a Q(s, a) ] - E_(s,a)~D[ Q ] )
+
+where the logsumexp is estimated with importance-corrected samples from
+the uniform distribution and the current policy at s and s' (the
+standard CQL(H) estimator). Training is purely offline (OfflineData
+minibatches); an env is used only for spaces and evaluation rollouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.algorithms.sac import SACConfig, _SACNets
+from ray_tpu.rl.offline import OfflineData
+from ray_tpu.rl.spaces import Box
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.cql_alpha = 5.0       # conservative penalty weight
+        self.cql_n_actions = 10    # sampled actions per logsumexp term
+        self.bc_iters = 0          # actor warmup: BC for first k updates
+        self.offline_data: Optional[OfflineData] = None
+        self.evaluation_episodes = 0
+
+    def offline(self, data: OfflineData) -> "CQLConfig":
+        self.offline_data = data
+        return self
+
+    def training(self, *, cql_alpha: Optional[float] = None,
+                 cql_n_actions: Optional[int] = None,
+                 bc_iters: Optional[int] = None, **kw) -> "CQLConfig":
+        super().training(**kw)
+        if cql_alpha is not None:
+            self.cql_alpha = cql_alpha
+        if cql_n_actions is not None:
+            self.cql_n_actions = int(cql_n_actions)
+        if bc_iters is not None:
+            self.bc_iters = int(bc_iters)
+        return self
+
+    def evaluation(self, *, evaluation_episodes: Optional[int] = None,
+                   **kw) -> "CQLConfig":
+        super().evaluation(**kw)  # validated explicit kwargs only
+        if evaluation_episodes is not None:
+            self.evaluation_episodes = int(evaluation_episodes)
+        return self
+
+
+class CQL(Algorithm):
+    def setup(self, config: CQLConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if config.offline_data is None:
+            raise ValueError(
+                "CQL is offline: config.offline(OfflineData(episodes))")
+        env0 = config.make_python_env()
+        if not isinstance(env0.action_space, Box):
+            raise ValueError("CQL (on SAC) requires a continuous action "
+                             "space")
+        obs_dim = int(np.prod(env0.observation_space.shape))
+        act_dim = int(np.prod(env0.action_space.shape))
+        low = np.broadcast_to(env0.action_space.low, (act_dim,)).astype(
+            np.float32)
+        high = np.broadcast_to(env0.action_space.high,
+                               (act_dim,)).astype(np.float32)
+        nets = self.nets = _SACNets(obs_dim, act_dim, config.hidden,
+                                    low, high)
+        self._eval_env = env0
+        self.data = config.offline_data
+        self._rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed)
+        self.params = nets.init(jax.random.PRNGKey(config.seed))
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt = optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._updates = 0
+
+        gamma, tau = config.gamma, config.tau
+        alpha = config.initial_alpha        # fixed entropy temperature
+        cql_alpha = config.cql_alpha
+        n_act = config.cql_n_actions
+        # log-density of the uniform proposal over the action box
+        log_u = -float(np.sum(np.log(high - low)))
+
+        def conservative_term(p, batch, key):
+            """CQL(H): E_s logsumexp_a [Q(s,a) - log q(a|s)] - E_D[Q]."""
+            B = batch["obs"].shape[0]
+            ku, kp, kp2 = jax.random.split(key, 3)
+            # uniform proposals [n, B, A]
+            a_u = jax.random.uniform(
+                ku, (n_act, B, act_dim), minval=low, maxval=high)
+            # policy proposals at s and s'
+            a_pi, logp_pi = nets.pi(
+                p, jnp.broadcast_to(batch["obs"],
+                                    (n_act,) + batch["obs"].shape), kp)
+            a_pi2, logp_pi2 = nets.pi(
+                p, jnp.broadcast_to(batch["next_obs"],
+                                    (n_act,) + batch["obs"].shape), kp2)
+
+            def q_all(which):
+                def q_one(a):
+                    return nets.q(p, which, batch["obs"], a)
+                q_u = jax.vmap(q_one)(a_u) - log_u
+                q_p = jax.vmap(q_one)(a_pi) - logp_pi
+                q_p2 = jax.vmap(q_one)(a_pi2) - logp_pi2
+                stacked = jnp.concatenate([q_u, q_p, q_p2], axis=0)
+                lse = jax.scipy.special.logsumexp(
+                    stacked, axis=0) - jnp.log(3.0 * n_act)
+                data_q = nets.q(p, which, batch["obs"], batch["actions"])
+                return jnp.mean(lse) - jnp.mean(data_q)
+            return q_all("q1") + q_all("q2")
+
+        def train_step(params, target_params, opt_state, batch, key,
+                       bc_mode):
+            k1, k2, k3 = jax.random.split(key, 3)
+            next_a, next_logp = nets.pi(params, batch["next_obs"], k1)
+            q_next = jnp.minimum(
+                nets.q(target_params, "q1", batch["next_obs"], next_a),
+                nets.q(target_params, "q2", batch["next_obs"], next_a))
+            y = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1.0 - batch["dones"])
+                * (q_next - alpha * next_logp))
+
+            def loss_fn(p):
+                q1 = nets.q(p, "q1", batch["obs"], batch["actions"])
+                q2 = nets.q(p, "q2", batch["obs"], batch["actions"])
+                critic = (jnp.mean((q1 - y) ** 2)
+                          + jnp.mean((q2 - y) ** 2))
+                penalty = conservative_term(p, batch, k3)
+                a, logp = nets.pi(p, batch["obs"], k2)
+                if bc_mode:
+                    # reference: bc_iters of behavior cloning before
+                    # switching the actor to max-Q (cql.py actor
+                    # warmup); mode-matching MSE stands in for logp of
+                    # the squashed-Gaussian at the data action
+                    actor = jnp.mean(
+                        (nets.pi_mode(p, batch["obs"])
+                         - batch["actions"]) ** 2)
+                else:
+                    q_pi = jnp.minimum(
+                        nets.q(jax.lax.stop_gradient(p), "q1",
+                               batch["obs"], a),
+                        nets.q(jax.lax.stop_gradient(p), "q2",
+                               batch["obs"], a))
+                    actor = jnp.mean(alpha * logp - q_pi)
+                total = critic + cql_alpha * penalty + actor
+                return total, (critic, penalty, actor)
+
+            (_, (critic_l, pen, actor_l)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state,
+                                                 params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.tree.map(
+                lambda t, p_: (1.0 - tau) * t + tau * p_,
+                target_params, params)
+            return params, target_params, opt_state, critic_l, pen, \
+                actor_l
+
+        self._train_step = jax.jit(train_step,
+                                   static_argnames=("bc_mode",))
+        self._act_mode = jax.jit(nets.pi_mode)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        cfg = self.config
+        critic_l = pen = actor_l = float("nan")
+        for _ in range(cfg.num_gradient_steps):
+            self._key, sub = jax.random.split(self._key)
+            batch = self.data.sample(cfg.train_batch_size, self._rng)
+            bc_mode = self._updates < cfg.bc_iters
+            (self.params, self.target_params, self.opt_state, critic_l,
+             pen, actor_l) = self._train_step(
+                self.params, self.target_params, self.opt_state,
+                dict(batch), sub, bc_mode)
+            self._updates += 1
+        if cfg.evaluation_episodes:
+            self.record_episodes(
+                self._evaluate(cfg.evaluation_episodes))
+        return {
+            "critic_loss": float(critic_l),
+            "cql_penalty": float(pen),
+            "actor_loss": float(actor_l),
+            "num_updates": self._updates,
+        }
+
+    def _evaluate(self, episodes: int):
+        env = self._eval_env
+        returns = []
+        for e in range(episodes):
+            obs, _ = env.reset(seed=20_000 + self.iteration * 100 + e)
+            total = 0.0
+            for _ in range(1000):
+                action = self.compute_single_action(obs)
+                obs, rew, term, trunc, _ = env.step(action)
+                total += rew
+                self._env_steps_lifetime += 1
+                if term or trunc:
+                    break
+            returns.append(total)
+        return returns
+
+    def compute_single_action(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._act_mode(self.params,
+                                         np.asarray(obs)[None]))[0]
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state.update(
+            params=self.params, target_params=self.target_params,
+            updates=self._updates,
+            # optimizer moments + PRNG streams: a restore must continue
+            # training, not silently restart with fresh Adam moments
+            # (same contract as SAC.get_state)
+            opt_state=self.opt_state, key=self._key,
+            np_rng=self._rng.bit_generator.state)
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self._updates = state["updates"]
+        if "opt_state" in state:
+            self.opt_state = state["opt_state"]
+            self._key = state["key"]
+            self._rng.bit_generator.state = state["np_rng"]
+
+
+CQLConfig.algo_class = CQL

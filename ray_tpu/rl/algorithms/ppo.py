@@ -194,7 +194,7 @@ class PPO(Algorithm):
     #    per-policy learners; policy_mapping_fn routes agent streams) ---
     def _setup_multi_agent(self, config: PPOConfig) -> None:
         from ray_tpu.rl.multi_agent import (
-            MultiAgentEnvRunner, infer_module_specs)
+            MultiAgentEnvRunner, TurnBasedEnvRunner, infer_module_specs)
         if (config.num_env_runners or config.num_learners > 1
                 or config.connector_factories):
             raise NotImplementedError(
@@ -231,7 +231,13 @@ class PPO(Algorithm):
         unknown = self._to_train - set(self.ma_specs)
         if unknown:
             raise ValueError(f"policies_to_train has unknown ids {unknown}")
-        self.ma_runner = MultiAgentEnvRunner(
+        # Envs declaring turn_based=True (acting set varies per step)
+        # get the stream-assembling runner; parallel envs keep the
+        # dense one.
+        runner_cls = (TurnBasedEnvRunner
+                      if getattr(env, "turn_based", False)
+                      else MultiAgentEnvRunner)
+        self.ma_runner = runner_cls(
             config.make_multi_agent_env, self.ma_specs,
             config.policy_mapping_fn,
             num_envs=config.num_envs_per_env_runner,
@@ -246,14 +252,18 @@ class PPO(Algorithm):
         batches = self.ma_runner.sample()
         metrics: Dict[str, Any] = {}
         runner_metrics = self.ma_runner.pop_metrics()
-        self.record_episodes(runner_metrics["episode_returns"])
+        self.record_episodes(runner_metrics["episode_returns"],
+                             runner_metrics.get("episode_lens"))
         for mid, vals in runner_metrics["module_returns"].items():
             if vals:
                 metrics[f"policy_reward_mean/{mid}"] = float(np.mean(vals))
         # env steps (not agent steps), once — matching the reference's
-        # num_env_steps_sampled accounting.
-        self._env_steps_lifetime += (self.ma_runner.rollout_len
-                                     * len(self.ma_runner.envs))
+        # num_env_steps_sampled accounting. Turn-based runners report
+        # the true count (it varies per sample); dense runners step
+        # exactly rollout_len per env.
+        self._env_steps_lifetime += getattr(
+            self.ma_runner, "env_steps_last_sample",
+            self.ma_runner.rollout_len * len(self.ma_runner.envs))
         for mid, cols in batches.items():
             if mid not in self._to_train:
                 continue  # frozen: skip GAE/value forward entirely
@@ -279,8 +289,7 @@ class PPO(Algorithm):
         cfg = self.config
         if self._eval_runner is None:
             if cfg.is_multi_agent:
-                from ray_tpu.rl.multi_agent import MultiAgentEnvRunner
-                self._eval_runner = MultiAgentEnvRunner(
+                self._eval_runner = type(self.ma_runner)(
                     cfg.make_multi_agent_env, self.ma_specs,
                     cfg.policy_mapping_fn,
                     num_envs=cfg.evaluation_num_envs,

@@ -6,13 +6,19 @@ rllib/core/rl_module/multi_rl_module.py:40 (module dict keyed by
 module_id), and the policy-mapping seam
 (AlgorithmConfig.multi_agent(policies=..., policy_mapping_fn=...)).
 
-Scope note: this runner targets PARALLEL multi-agent envs — every
-agent observes and acts at every step (the PettingZoo parallel-env
-shape). Turn-based envs (agents appearing/disappearing mid-episode)
-are out of scope for now; the reference supports them via episode
-bookkeeping this runner deliberately avoids so the per-module streams
-stay dense [T, S] columns that the single-agent GAE/learner path
-consumes unchanged.
+Two runners:
+- MultiAgentEnvRunner targets PARALLEL envs — every agent observes and
+  acts at every step (the PettingZoo parallel-env shape), so per-module
+  streams are dense [T, S] columns.
+- TurnBasedEnvRunner targets TURN-BASED envs — each step's obs dict
+  names exactly the agents that must act now (the reference's
+  MultiAgentEnv supports agents acting on different steps via episode
+  bookkeeping; rllib/env/multi_agent_env.py:33). Per-(env, agent)
+  transition streams are assembled with deferred reward credit (an
+  action's reward is everything the agent receives until its next
+  observation) and carried over between sample() calls so the emitted
+  columns are still dense [T, S] — the same GAE/learner path consumes
+  them unchanged.
 """
 
 from __future__ import annotations
@@ -109,16 +115,78 @@ class RepeatedRockPaperScissors(MultiAgentEnv):
                 {a: {} for a in self.agents})
 
 
-class MultiAgentEnvRunner:
-    """Vectorized sampler over parallel MultiAgentEnvs.
+class TicTacToe(MultiAgentEnv):
+    """Turn-based tic-tac-toe (reference: the turn-based MultiAgentEnv
+    pattern, e.g. rllib/examples/envs/classes/tic_tac_toe.py): only the
+    agent to move appears in the obs dict. Observation = 9 cells from
+    the mover's perspective (+1 mine, -1 theirs, 0 empty) + 9-dim legal
+    mask. Illegal moves lose immediately (standard rllib example
+    semantics). Win +1 / loss -1 for both sides at the terminal step."""
 
-    Experiences are grouped by module: ``policy_mapping_fn(agent_id)``
-    names the module an agent's stream feeds, and sample() returns
-    ``{module_id: [T, S] columns}`` where S = (num_envs x agents mapped
-    to that module) — the exact shape the single-agent learner path
-    already consumes (reference: multi-agent EnvRunner producing
-    MultiAgentBatch keyed by module_id).
-    """
+    agents = ["player_x", "player_o"]
+    turn_based = True
+    max_episode_steps = 9
+
+    def __init__(self):
+        obs_space = Box(-1.0, 1.0, (18,))
+        act_space = Discrete(9)
+        self.observation_spaces = {a: obs_space for a in self.agents}
+        self.action_spaces = {a: act_space for a in self.agents}
+
+    def _obs_for(self, agent: str) -> np.ndarray:
+        sign = 1 if agent == "player_x" else -1
+        cells = (self.board * sign).astype(np.float32)
+        legal = (self.board == 0).astype(np.float32)
+        return np.concatenate([cells, legal])
+
+    def reset(self, *, seed: Optional[int] = None):
+        # deterministic env: seed accepted for API uniformity only
+        self.board = np.zeros(9, dtype=np.int8)
+        self.to_move = 0  # X starts
+        return {self.agents[0]: self._obs_for(self.agents[0])}, {}
+
+    _LINES = [(0, 1, 2), (3, 4, 5), (6, 7, 8), (0, 3, 6), (1, 4, 7),
+              (2, 5, 8), (0, 4, 8), (2, 4, 6)]
+
+    def _winner(self) -> int:
+        for a, b, c in self._LINES:
+            s = self.board[a] + self.board[b] + self.board[c]
+            if s == 3:
+                return 1
+            if s == -3:
+                return -1
+        return 0
+
+    def step(self, action_dict: Dict[str, Any]):
+        mover = self.agents[self.to_move]
+        other = self.agents[1 - self.to_move]
+        action = int(action_dict[mover])
+        sign = 1 if mover == "player_x" else -1
+        if self.board[action] != 0:
+            # illegal: mover loses on the spot
+            rewards = {mover: -1.0, other: 1.0}
+            return ({}, rewards, {"__all__": True}, {"__all__": False},
+                    {})
+        self.board[action] = sign
+        win = self._winner()
+        if win != 0:
+            rewards = {mover: 1.0, other: -1.0}
+            return ({}, rewards, {"__all__": True}, {"__all__": False},
+                    {})
+        if not (self.board == 0).any():
+            return ({}, {mover: 0.0, other: 0.0}, {"__all__": True},
+                    {"__all__": False}, {})
+        self.to_move = 1 - self.to_move
+        nxt = self.agents[self.to_move]
+        return ({nxt: self._obs_for(nxt)}, {mover: 0.0, other: 0.0},
+                {"__all__": False}, {"__all__": False}, {})
+
+
+class _MultiAgentRunnerBase:
+    """Shared plumbing for the parallel and turn-based runners: env
+    fleet, module specs + policy mapping, per-module params and jitted
+    act fns, weight sync, and episode-metric bookkeeping (one contract,
+    two sampling disciplines — PPO swaps the subclasses freely)."""
 
     def __init__(self, env_creator: Callable[[], MultiAgentEnv],
                  module_specs: Dict[str, RLModuleSpec],
@@ -140,7 +208,7 @@ class MultiAgentEnvRunner:
             raise ValueError(
                 f"policy_mapping_fn maps to unknown module(s) {unknown}; "
                 f"configured modules: {sorted(module_specs)}")
-        # Dense streams: one per (env, agent), grouped by module.
+        # Streams: one per (env, agent), grouped by module.
         self.streams: Dict[str, List[Tuple[int, str]]] = {
             mid: [] for mid in module_specs}
         for i in range(num_envs):
@@ -171,11 +239,51 @@ class MultiAgentEnvRunner:
         self._act = {mid: make_act(spec)
                      for mid, spec in module_specs.items()}
 
-    # -- weights ---------------------------------------------------------
     def set_weights(self, params_by_module: Dict[str, Any]) -> None:
         import jax
         for mid, params in params_by_module.items():
             self.params[mid] = jax.tree.map(np.asarray, params)
+
+    def _reset_metrics(self) -> None:
+        for key in self._ep_return:
+            self._ep_return[key] = 0.0
+        self._ep_len[:] = 0
+        self._completed = []
+        self._completed_lens = []
+        self._completed_by_module = {mid: [] for mid in self.specs}
+
+    def reset_envs(self) -> None:
+        """Fresh episodes + cleared accumulators (see
+        SingleAgentEnvRunner.reset_envs)."""
+        self._obs = [env.reset()[0] for env in self.envs]
+        self._reset_metrics()
+
+    def pop_metrics(self) -> Dict[str, Any]:
+        out = {
+            "episode_returns": self._completed,
+            "episode_lens": self._completed_lens,
+            "module_returns": {mid: vals for mid, vals
+                               in self._completed_by_module.items()},
+        }
+        self._completed = []
+        self._completed_lens = []
+        self._completed_by_module = {mid: [] for mid in self.specs}
+        return out
+
+    def ping(self) -> bool:
+        return True
+
+
+class MultiAgentEnvRunner(_MultiAgentRunnerBase):
+    """Vectorized sampler over parallel MultiAgentEnvs.
+
+    Experiences are grouped by module: ``policy_mapping_fn(agent_id)``
+    names the module an agent's stream feeds, and sample() returns
+    ``{module_id: [T, S] columns}`` where S = (num_envs x agents mapped
+    to that module) — the exact shape the single-agent learner path
+    already consumes (reference: multi-agent EnvRunner producing
+    MultiAgentBatch keyed by module_id).
+    """
 
     # -- sampling --------------------------------------------------------
     def _stacked_obs(self, mid: str) -> np.ndarray:
@@ -266,31 +374,174 @@ class MultiAgentEnvRunner:
             out[mid] = batch
         return out
 
-    def reset_envs(self) -> None:
-        """Fresh episodes + cleared accumulators (see
-        SingleAgentEnvRunner.reset_envs)."""
-        self._obs = [env.reset()[0] for env in self.envs]
-        for key in self._ep_return:
-            self._ep_return[key] = 0.0
-        self._ep_len[:] = 0
-        self._completed = []
-        self._completed_lens = []
-        self._completed_by_module = {mid: [] for mid in self.specs}
 
-    def pop_metrics(self) -> Dict[str, Any]:
-        out = {
-            "episode_returns": self._completed,
-            "episode_lens": self._completed_lens,
-            "module_returns": {mid: vals for mid, vals
-                               in self._completed_by_module.items()},
-        }
-        self._completed = []
-        self._completed_lens = []
-        self._completed_by_module = {mid: [] for mid in self.specs}
+class TurnBasedEnvRunner(_MultiAgentRunnerBase):
+    """Sampler for turn-based MultiAgentEnvs (acting set varies per
+    step; reference: rllib's episode-based multi-agent bookkeeping).
+
+    Credit assignment: an agent's transition opens when it acts and
+    closes at its NEXT observation (or episode end), its reward being
+    everything received in between — the standard turn-based fold
+    (opponent replies count toward the action that provoked them).
+    sample() steps the envs until every (env, agent) stream holds
+    ``rollout_len`` closed transitions (surplus carries over to the
+    next call), so the emitted columns are dense [T, S] and the
+    single-agent GAE/learner path consumes them unchanged.
+
+    Note: the jitted per-module forward recompiles per distinct acting
+    batch size; for alternating-move games that size is constant
+    (#envs), so steady state is one compile per module.
+    """
+
+    def __init__(self, env_creator: Callable[[], MultiAgentEnv],
+                 module_specs: Dict[str, RLModuleSpec],
+                 policy_mapping_fn: Callable[[str], str], *,
+                 num_envs: int = 1, rollout_len: int = 64, seed: int = 0,
+                 explore: bool = True):
+        super().__init__(env_creator, module_specs, policy_mapping_fn,
+                         num_envs=num_envs, rollout_len=rollout_len,
+                         seed=seed, explore=explore)
+        # open transition per (env, agent): [obs, action, logp, vf,
+        # reward-so-far]; closed transitions buffer per (env, agent)
+        self._open: Dict[Tuple[int, str], Optional[list]] = {
+            (i, a): None for i in range(num_envs) for a in self.agents}
+        self._closed: Dict[Tuple[int, str], List[tuple]] = {
+            (i, a): [] for i in range(num_envs) for a in self.agents}
+        self.env_steps_last_sample = 0
+
+    def _close(self, key: Tuple[int, str], final_obs, done: bool,
+               trunc: bool) -> None:
+        open_t = self._open[key]
+        if open_t is None:
+            return
+        obs, action, logp, vf, reward = open_t
+        self._closed[key].append(
+            (obs, action, logp, vf, reward, done, trunc, final_obs))
+        self._open[key] = None
+
+    def _quota_met(self) -> bool:
+        return all(len(buf) >= self.rollout_len
+                   for buf in self._closed.values())
+
+    def sample(self) -> Dict[str, SampleBatch]:
+        import jax
+        self.env_steps_last_sample = 0
+        guard = 0
+        max_steps = (self.rollout_len * len(self.agents) + 64) * 64
+        while not self._quota_met():
+            guard += 1
+            if guard > max_steps:
+                raise RuntimeError(
+                    "turn-based sampling stalled: some agent never got "
+                    f"{self.rollout_len} turns in {max_steps} env steps "
+                    "(does every agent keep acting in this env?)")
+            # group acting agents by module across envs
+            acting: Dict[str, List[Tuple[int, str]]] = {
+                mid: [] for mid in self.specs}
+            for i in range(len(self.envs)):
+                for agent in self._obs[i]:
+                    acting[self.mapping[agent]].append((i, agent))
+            actions_by_env: List[Dict[str, Any]] = [
+                {} for _ in range(len(self.envs))]
+            for mid, streams in acting.items():
+                if not streams:
+                    continue
+                obs = np.stack([self._obs[i][agent]
+                                for i, agent in streams])
+                self._key, sub = jax.random.split(self._key)
+                action, logp, value = self._act[mid](
+                    self.params[mid], obs, sub)
+                action = np.asarray(action)
+                logp = np.asarray(logp)
+                value = np.asarray(value)
+                for s, (i, agent) in enumerate(streams):
+                    actions_by_env[i][agent] = action[s]
+                    # acting implies the previous open transition for
+                    # this agent was closed when this obs arrived
+                    self._open[(i, agent)] = [
+                        obs[s], action[s], logp[s], value[s], 0.0]
+
+            for i, env in enumerate(self.envs):
+                if not actions_by_env[i]:
+                    continue
+                self.env_steps_last_sample += 1
+                obs, rew, term, trunc, _ = env.step(actions_by_env[i])
+                done = bool(term.get("__all__")) or bool(
+                    trunc.get("__all__"))
+                self._ep_len[i] += 1
+                for agent in self.agents:
+                    r = float(rew.get(agent, 0.0))
+                    self._ep_return[(i, agent)] += r
+                    open_t = self._open[(i, agent)]
+                    if open_t is not None:
+                        open_t[4] += r
+                if done:
+                    all_trunc = bool(trunc.get("__all__")) and not bool(
+                        term.get("__all__"))
+                    for agent in self.agents:
+                        key = (i, agent)
+                        # terminal: close every open transition; final
+                        # obs only matters under truncation (bootstrap)
+                        fallback = (self._open[key][0]
+                                    if self._open[key] is not None
+                                    else None)
+                        final = obs.get(agent, fallback)
+                        agent_trunc = (bool(trunc.get(agent))
+                                       or all_trunc)
+                        self._close(key, final, True, agent_trunc)
+                        self._completed_by_module[
+                            self.mapping[agent]].append(
+                            float(self._ep_return[key]))
+                    ep_sum = sum(self._ep_return[(i, a)]
+                                 for a in self.agents)
+                    self._completed.append(float(ep_sum))
+                    self._completed_lens.append(int(self._ep_len[i]))
+                    for agent in self.agents:
+                        self._ep_return[(i, agent)] = 0.0
+                    self._ep_len[i] = 0
+                    obs, _ = env.reset()
+                else:
+                    # agents observing now close their previous turn
+                    for agent in obs:
+                        self._close((i, agent), obs[agent], False,
+                                    False)
+                self._obs[i] = obs
+
+        out: Dict[str, SampleBatch] = {}
+        T = self.rollout_len
+        for mid, streams in self.streams.items():
+            taken = []
+            for key in [  # keep stream order stable
+                    (i, a) for i, a in streams]:
+                taken.append(self._closed[key][:T])
+                self._closed[key] = self._closed[key][T:]
+            # [T, S] time-major stacking, column by column
+            def col(j, dtype=None):
+                arr = np.stack(
+                    [np.stack([taken[s][t][j] for s in
+                               range(len(streams))])
+                     for t in range(T)])
+                return arr.astype(dtype) if dtype is not None else arr
+            batch = SampleBatch({
+                OBS: col(0), ACTIONS: col(1), LOGP: col(2, np.float32),
+                VF_PREDS: col(3, np.float32),
+                REWARDS: col(4, np.float32), DONES: col(5, bool),
+                TRUNCATEDS: col(6, bool), FINAL_OBS: col(7)})
+            # per-stream bootstrap from the last taken final obs (GAE
+            # cuts it when the last transition ended an episode)
+            last_final = np.stack(
+                [taken[s][-1][7] for s in range(len(streams))])
+            batch["bootstrap_value"] = np.asarray(
+                self.specs[mid].compute_values(
+                    self.params[mid], last_final))
+            out[mid] = batch
         return out
 
-    def ping(self) -> bool:
-        return True
+    def reset_envs(self) -> None:
+        super().reset_envs()
+        for key in self._ep_return:
+            self._open[key] = None
+            self._closed[key] = []
 
 
 def infer_module_specs(env: MultiAgentEnv,

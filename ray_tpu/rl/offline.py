@@ -26,6 +26,7 @@ class OfflineData:
     def __init__(self, episodes: List[Dict[str, np.ndarray]], *,
                  gamma: float = 0.99):
         obs, actions, rewards, returns = [], [], [], []
+        next_obs, dones = [], []
         for ep in episodes:
             r = np.asarray(ep["rewards"], np.float32)
             g = np.zeros_like(r)
@@ -33,16 +34,32 @@ class OfflineData:
             for t in range(len(r) - 1, -1, -1):
                 acc = r[t] + gamma * acc
                 g[t] = acc
-            obs.append(np.asarray(ep["obs"], np.float32))
+            o = np.asarray(ep["obs"], np.float32)
+            obs.append(o)
             actions.append(np.asarray(ep["actions"]))
             rewards.append(r)
             returns.append(g)
+            # TD columns for one-step offline methods (CQL). The final
+            # transition's done comes from TERMINATION only — a
+            # time-limit truncation must keep its bootstrap (masking it
+            # teaches Q that value past the horizon is 0); its true
+            # next obs is the episode's recorded final_obs when
+            # available.
+            final = np.asarray(ep.get("final_obs", o[-1]),
+                               np.float32)[None]
+            nxt = np.concatenate([o[1:], final], axis=0)
+            d = np.zeros(len(r), np.float32)
+            d[-1] = 1.0 if ep.get("terminated", True) else 0.0
+            next_obs.append(nxt)
+            dones.append(d)
         if not episodes:
             raise ValueError("OfflineData needs at least one episode")
         self.obs = np.concatenate(obs)
         self.actions = np.concatenate(actions)
         self.rewards = np.concatenate(rewards)
         self.returns = np.concatenate(returns)
+        self.next_obs = np.concatenate(next_obs)
+        self.dones = np.concatenate(dones)
         self.num_episodes = len(episodes)
 
     def __len__(self) -> int:
@@ -54,6 +71,8 @@ class OfflineData:
             "obs": self.obs[idx],
             "actions": self.actions[idx],
             "rewards": self.rewards[idx],
+            "next_obs": self.next_obs[idx],
+            "dones": self.dones[idx],
             RETURNS: self.returns[idx],
         })
 
@@ -84,6 +103,7 @@ def collect_episodes(env_creator, policy_fn, *, num_episodes: int,
     for e in range(num_episodes):
         obs, _ = env.reset(seed=seed + e)
         ep: Dict[str, list] = {"obs": [], "actions": [], "rewards": []}
+        terminated = False
         for _ in range(max_steps):
             action = policy_fn(obs)
             ep["obs"].append(obs)
@@ -92,6 +112,12 @@ def collect_episodes(env_creator, policy_fn, *, num_episodes: int,
             ep["rewards"].append(rew)
             obs = nxt
             if term or trunc:
+                terminated = bool(term)
                 break
-        episodes.append({k: np.asarray(v) for k, v in ep.items()})
+        out = {k: np.asarray(v) for k, v in ep.items()}
+        # truncation vs termination + the true final obs, so TD methods
+        # (CQL) bootstrap correctly at time limits
+        out["terminated"] = terminated
+        out["final_obs"] = np.asarray(obs)
+        episodes.append(out)
     return episodes

@@ -668,6 +668,173 @@ def test_multi_agent_ppo_competitive_trains_and_evaluates():
     algo.stop()
 
 
+from ray_tpu.rl.env import Env as _RlEnv  # noqa: E402
+from ray_tpu.rl.spaces import Box as _Box  # noqa: E402
+
+
+class _Reach1D(_RlEnv):
+    """Continuous 1-D reach-the-origin env: obs = position, reward =
+    -|pos|, 20-step episodes. Random behavior data makes BC clone a
+    do-nothing policy while CQL's Q-learning stitches the go-to-zero
+    strategy — the canonical offline-RL separation."""
+
+    observation_space = _Box(np.array([-3.0], np.float32),
+                             np.array([3.0], np.float32))
+    action_space = _Box(np.array([-1.0], np.float32),
+                        np.array([1.0], np.float32))
+
+    def __init__(self):
+        self._rng = np.random.default_rng(0)
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self.pos = float(self._rng.choice([-1.5, 1.5]))
+        self.t = 0
+        return np.array([self.pos], np.float32), {}
+
+    def step(self, action):
+        self.pos = float(np.clip(self.pos + 0.25 * float(
+            np.asarray(action).reshape(-1)[0]), -3.0, 3.0))
+        self.t += 1
+        return (np.array([self.pos], np.float32), -abs(self.pos),
+                False, self.t >= 20, {})
+
+    def close(self):
+        pass
+
+
+def test_cql_beats_bc_on_offline_data():
+    """VERDICT r3 item 5 done-criterion (offline half): on a
+    random-behavior dataset, CQL's conservative Q-learning must beat
+    behavior cloning (reference: rllib/algorithms/cql/cql.py on SAC)."""
+    from ray_tpu.rl import CQLConfig, OfflineData, collect_episodes
+
+    rng = np.random.default_rng(0)
+    episodes = collect_episodes(
+        _Reach1D,
+        lambda obs: rng.uniform(-1.0, 1.0, size=(1,)).astype(np.float32),
+        num_episodes=80, seed=0, max_steps=20)
+    data = OfflineData(episodes, gamma=0.99)
+    assert data.next_obs.shape == data.obs.shape  # TD columns exist
+    # every episode ended by TIME LIMIT: truncation keeps its bootstrap
+    # (done=0), it is not a termination
+    assert data.dones.sum() == 0
+
+    def rollout_return(policy, episodes=10):
+        env = _Reach1D()
+        out = []
+        for e in range(episodes):
+            obs, _ = env.reset(seed=5_000 + e)
+            total = 0.0
+            for _ in range(20):
+                obs, rew, term, trunc, _ = env.step(policy(obs))
+                total += rew
+                if term or trunc:
+                    break
+            out.append(total)
+        return float(np.mean(out))
+
+    # BC baseline: clone the (uniform-random) behavior -> mean action
+    # ~0 -> the agent stays put at |pos|=1.5 -> return ~ -30.
+    from ray_tpu.rl import BCConfig
+    bc = (BCConfig().environment(_Reach1D)
+          .offline(OfflineData(episodes))
+          .training(lr=3e-3, num_gradient_steps=200,
+                    train_batch_size=256)
+          .debugging(seed=0)).build_algo()
+    for _ in range(5):
+        bc.train()
+    bc_return = rollout_return(bc.compute_single_action)
+
+    cql = (CQLConfig().environment(_Reach1D)
+           .offline(data)
+           .training(lr=3e-3, num_gradient_steps=200,
+                     train_batch_size=256, cql_alpha=1.0,
+                     cql_n_actions=4, initial_alpha=0.05)
+           .debugging(seed=0)).build_algo()
+    for _ in range(5):
+        result = cql.train()
+    assert np.isfinite(result["critic_loss"])
+    assert np.isfinite(result["cql_penalty"])
+    cql_return = rollout_return(cql.compute_single_action)
+
+    # CQL must clearly beat BC (moving toward 0 vs standing still)
+    assert cql_return > bc_return + 3.0, (cql_return, bc_return)
+    bc.stop()
+    cql.stop()
+
+
+def test_turn_based_runner_shapes_and_credit():
+    """TurnBasedEnvRunner (VERDICT r3 item 5): acting set varies per
+    step, per-(env, agent) streams come out dense [T, S], and reward
+    credit defers to the agent's next observation (opponent replies
+    count toward the action that provoked them)."""
+    from ray_tpu.rl.multi_agent import (
+        TicTacToe, TurnBasedEnvRunner, infer_module_specs)
+
+    env = TicTacToe()
+    assert env.turn_based
+    obs, _ = env.reset(seed=0)
+    assert set(obs) == {"player_x"}  # only the mover observes
+
+    mapping = {"player_x": "px", "player_o": "po"}
+    specs = infer_module_specs(env, mapping.__getitem__)
+    runner = TurnBasedEnvRunner(
+        TicTacToe, specs, mapping.__getitem__,
+        num_envs=3, rollout_len=6, seed=0)
+    out = runner.sample()
+    assert set(out) == {"px", "po"}
+    for batch in out.values():
+        assert batch["obs"].shape == (6, 3, 18)
+        assert batch["actions"].shape == (6, 3)
+        assert batch["rewards"].shape == (6, 3)
+        assert batch["bootstrap_value"].shape == (3,)
+    # zero-sum over full episodes: completed-episode sums are 0
+    metrics = runner.pop_metrics()
+    assert metrics["episode_returns"]
+    np.testing.assert_allclose(metrics["episode_returns"], 0.0)
+    # every episode ends with exactly one terminal per stream slice:
+    # each agent's last transition of an episode carries done=True
+    assert out["px"]["dones"].any()
+    # carry-over: a second sample still yields full dense batches
+    out2 = runner.sample()
+    assert out2["px"]["obs"].shape == (6, 3, 18)
+
+
+def test_turn_based_ppo_self_play_learns_legal_play():
+    """Self-play PPO on turn-based tic-tac-toe (shared module): random
+    play hits illegal moves early (short episodes); learning to play
+    legally is a strong, fast signal — mean episode length must rise
+    clearly above the random baseline."""
+    from ray_tpu.rl import PPOConfig
+    from ray_tpu.rl.multi_agent import TicTacToe
+
+    config = (
+        PPOConfig()
+        .environment(TicTacToe)
+        .multi_agent(policy_mapping_fn=lambda aid: "shared")
+        .env_runners(num_envs_per_env_runner=8,
+                     rollout_fragment_length=32)
+        .training(lr=0.01, num_epochs=4, minibatch_size=256,
+                  entropy_coeff=0.01)
+        .debugging(seed=0))
+    algo = config.build_algo()
+    early = None
+    late = None
+    for it in range(14):
+        result = algo.train()
+        mean_len = result.get("episode_len_mean")
+        if it == 0:
+            early = mean_len
+        late = mean_len
+    assert early is not None and late is not None
+    # random tic-tac-toe self-play with illegal-move-loses ends in ~2-4
+    # plies; legal play reaches >= 5 (wins) to 9 (draws)
+    assert late > max(4.0, early + 0.5), (early, late)
+    algo.stop()
+
+
 def test_single_agent_evaluation_split():
     """evaluate() runs on dedicated exploit-mode runners and train()
     folds it in under the 'evaluation' key at evaluation_interval."""
