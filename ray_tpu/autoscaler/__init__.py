@@ -5,11 +5,12 @@ bin-packing + pluggable NodeProviders, and the v2 instance manager)."""
 from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
 from ray_tpu.autoscaler.config import AutoscalerConfig, NodeTypeConfig
 from ray_tpu.autoscaler.gce import GceTpuSliceNodeProvider
+from ray_tpu.autoscaler.gke import GkeKubeRayNodeProvider
 from ray_tpu.autoscaler.node_provider import (
     FakeMultiNodeProvider, NodeProvider)
 
 __all__ = [
     "AutoscalerConfig", "FakeMultiNodeProvider",
-    "GceTpuSliceNodeProvider", "NodeProvider",
+    "GceTpuSliceNodeProvider", "GkeKubeRayNodeProvider", "NodeProvider",
     "NodeTypeConfig", "StandardAutoscaler",
 ]

@@ -1,0 +1,248 @@
+"""GKE provisioning via a KubeRay-style RayCluster custom resource.
+
+Reference: python/ray/autoscaler/_private/kuberay/node_provider.py —
+the reference autoscaler scales worker groups by PATCHing the
+RayCluster CR (``replicas`` up, ``replicas`` down + ``workersToDelete``)
+and identifies nodes by reading the pod list; multi-host TPU slices are
+worker-group replicas whose pods share a ``replicaIndex`` label (the
+GKE TPU webhook's convention). This provider does the same against the
+Kubernetes API server with an injectable HTTP seam (like
+``gce.py``), so the gang-provisioning path (queued placement groups →
+whole-slice launches) works identically on GKE.
+
+One LAUNCH UNIT = one worker-group replica = one TPU slice (``count``
+hosts = the group's ``numOfHosts``). Pod containers join the cluster by
+running ``ray-tpu start`` with the slice's provider id in their labels,
+exactly like the GCE startup script.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.autoscaler.config import NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.gce import PROVIDER_ID_LABEL
+
+# KubeRay CRD group/version and the labels its operator stamps on pods
+# (reference: kuberay node_provider.py KUBERAY_LABEL_KEY_TYPE /
+# replicaIndex).
+CRD_PATH = "/apis/ray.io/v1/namespaces/{ns}/rayclusters/{name}"
+PODS_PATH = "/api/v1/namespaces/{ns}/pods"
+GROUP_LABEL = "ray.io/group"
+CLUSTER_LABEL = "ray.io/cluster"
+REPLICA_INDEX_LABEL = "replicaIndex"
+
+HttpRequest = Callable[[str, str, Optional[dict]],
+                       Tuple[int, dict]]
+
+
+def default_http_request(method: str, path: str,
+                         body: Optional[dict]) -> Tuple[int, dict]:
+    """In-cluster Kubernetes API call with the service-account token
+    (reference: kuberay node_provider.py _get_http_headers +
+    KUBERNETES_SERVICE_HOST)."""
+    import json
+    import os
+    import ssl
+    import urllib.request
+
+    host = os.environ.get("KUBERNETES_SERVICE_HOST",
+                          "kubernetes.default")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT_HTTPS", "443")
+    token_path = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    ca_path = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+    headers = {"Content-Type": ("application/json-patch+json"
+                                if method == "PATCH"
+                                else "application/json")}
+    if os.path.exists(token_path):
+        with open(token_path) as f:
+            headers["Authorization"] = f"Bearer {f.read().strip()}"
+    ctx = (ssl.create_default_context(cafile=ca_path)
+           if os.path.exists(ca_path) else ssl.create_default_context())
+    req = urllib.request.Request(
+        f"https://{host}:{port}{path}",
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=headers, method=method)
+    try:
+        with urllib.request.urlopen(req, context=ctx,
+                                    timeout=60) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:  # type: ignore[attr-defined]
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except Exception:  # noqa: BLE001
+            payload = {}
+        return e.code, payload
+
+
+class GkeKubeRayNodeProvider(NodeProvider):
+    """Scale TPU slices as RayCluster worker-group replicas on GKE.
+
+    ``create_node`` bumps the group's ``replicas`` in the CR; the
+    provider id is ``{group}-{replicaIndex}`` (the index the new
+    replica will take — GKE assigns 0..replicas-1 densely).
+    ``terminate_node`` shrinks ``replicas`` and lists the replica's
+    pods in ``workersToDelete`` so the operator removes that exact
+    slice (reference: kuberay node_provider.py ScaleRequest +
+    workersToDelete).
+    """
+
+    def __init__(self, namespace: str, cluster_name: str,
+                 runtime=None,
+                 http_request: Optional[HttpRequest] = None):
+        from ray_tpu.core import runtime as runtime_mod
+        self.runtime = runtime or runtime_mod.get_runtime()
+        self._http = http_request or default_http_request
+        self.namespace = namespace
+        self.cluster_name = cluster_name
+        self._crd = CRD_PATH.format(ns=namespace, name=cluster_name)
+        self._lock = threading.Lock()
+        # slices created this session the pod list may not show yet
+        # (eventual consistency; same trick as gce.py _created)
+        self._created: Dict[str, str] = {}
+
+    # -- CR helpers ------------------------------------------------------
+    def _get_cluster(self) -> dict:
+        status, resp = self._http("GET", self._crd, None)
+        if status >= 300:
+            raise RuntimeError(
+                f"RayCluster GET failed ({status}): {resp}")
+        return resp
+
+    def _group_index(self, cluster: dict, group: str) -> Tuple[int, dict]:
+        specs = cluster["spec"].get("workerGroupSpecs", [])
+        for idx, spec in enumerate(specs):
+            if spec.get("groupName") == group:
+                return idx, spec
+        raise RuntimeError(
+            f"worker group {group!r} not in RayCluster "
+            f"{self.cluster_name!r} (has: "
+            f"{[s.get('groupName') for s in specs]})")
+
+    def _patch(self, ops: List[dict]) -> None:
+        status, resp = self._http("PATCH", self._crd, ops)
+        if status >= 300:
+            raise RuntimeError(
+                f"RayCluster PATCH failed ({status}): {resp}")
+
+    # -- NodeProvider ----------------------------------------------------
+    def create_node(self, node_type: NodeTypeConfig) -> str:
+        group = node_type.name
+        with self._lock:
+            cluster = self._get_cluster()
+            gidx, spec = self._group_index(cluster, group)
+            replicas = int(spec.get("replicas", 0))
+            # The new replica takes the LOWEST FREE index (the webhook
+            # assigns densely and reuses freed indices) — "replicas"
+            # itself collides with a live tail replica whenever a
+            # non-tail one was terminated earlier.
+            used = set()
+            try:
+                for pod in self._list_pods():
+                    labels = pod.get("metadata", {}).get("labels", {})
+                    if labels.get(GROUP_LABEL) == group:
+                        used.add(labels.get(REPLICA_INDEX_LABEL))
+            except RuntimeError:
+                pass  # fall back to the local view below
+            used.update(pid for pid, g in self._created.items()
+                        if g == group)
+            i = 0
+            while f"{group}-{i}" in used:
+                i += 1
+            self._patch([{
+                "op": "replace",
+                "path": f"/spec/workerGroupSpecs/{gidx}/replicas",
+                "value": replicas + 1,
+            }])
+            provider_id = f"{group}-{i}"
+            self._created[provider_id] = group
+        return provider_id
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        group, _, idx = provider_node_id.rpartition("-")
+        with self._lock:
+            cluster = self._get_cluster()
+            gidx, spec = self._group_index(cluster, group)
+            replicas = int(spec.get("replicas", 0))
+            pods = [p["metadata"]["name"]
+                    for p in self._list_pods()
+                    if p["metadata"].get("labels", {}).get(
+                        REPLICA_INDEX_LABEL)
+                    == provider_node_id]
+            if not pods:
+                # Eventual consistency: the replica's pods aren't
+                # listed yet. Scaling replicas down with an empty
+                # workersToDelete would make the operator remove an
+                # ARBITRARY replica — defer; once the pod list shows
+                # the replica, a later cull round deletes exactly it.
+                self._created.pop(provider_node_id, None)
+                return
+            scale = spec.get("scaleStrategy", {})
+            to_delete = list(scale.get("workersToDelete", ())) + pods
+            self._patch([
+                {"op": "replace",
+                 "path": f"/spec/workerGroupSpecs/{gidx}/replicas",
+                 "value": max(0, replicas - 1)},
+                {"op": "replace",
+                 "path": (f"/spec/workerGroupSpecs/{gidx}"
+                          "/scaleStrategy"),
+                 "value": {"workersToDelete": to_delete}},
+            ])
+            self._created.pop(provider_node_id, None)
+
+    def _list_pods(self) -> List[dict]:
+        selector = urllib.parse.quote(
+            f"{CLUSTER_LABEL}={self.cluster_name}", safe="=")
+        out: List[dict] = []
+        token = None
+        while True:
+            path = (PODS_PATH.format(ns=self.namespace)
+                    + f"?labelSelector={selector}")
+            if token:
+                path += "&continue=" + urllib.parse.quote(token, safe="")
+            status, resp = self._http("GET", path, None)
+            if status >= 300:
+                raise RuntimeError(f"pod list failed ({status}): {resp}")
+            out.extend(resp.get("items", ()))
+            token = resp.get("metadata", {}).get("continue")
+            if not token:
+                break
+        return out
+
+    def non_terminated_nodes(self) -> Dict[str, str]:
+        try:
+            pods = self._list_pods()
+        except RuntimeError:
+            # API hiccup: local view, so one failed poll doesn't make
+            # the autoscaler relaunch everything (gce.py semantics)
+            with self._lock:
+                return dict(self._created)
+        out: Dict[str, str] = {}
+        for pod in pods:
+            meta = pod.get("metadata", {})
+            labels = meta.get("labels", {})
+            group = labels.get(GROUP_LABEL)
+            rep = labels.get(REPLICA_INDEX_LABEL)
+            if not group or rep is None:
+                continue
+            phase = pod.get("status", {}).get("phase", "Pending")
+            if phase in ("Succeeded", "Failed"):
+                continue
+            out.setdefault(str(rep), group)
+        with self._lock:
+            for pid, group in self._created.items():
+                out.setdefault(pid, group)
+            self._created = dict(out)
+        return out
+
+    # -- runtime mapping -------------------------------------------------
+    def runtime_node_ids(self, provider_node_id: str) -> List:
+        out = []
+        for node_id, node in list(self.runtime.nodes.items()):
+            labels = getattr(node, "labels", None) or {}
+            if labels.get(PROVIDER_ID_LABEL) == provider_node_id:
+                out.append(node_id)
+        return out

@@ -103,13 +103,16 @@ class CQL(Algorithm):
             # uniform proposals [n, B, A]
             a_u = jax.random.uniform(
                 ku, (n_act, B, act_dim), minval=low, maxval=high)
-            # policy proposals at s and s'
-            a_pi, logp_pi = nets.pi(
+            # policy proposals at s and s' — PROPOSALS ONLY: the
+            # penalty must shape the critic, not push the actor toward
+            # low-Q actions (in the reference the penalty updates only
+            # critic params), so cut the gradient into the policy here
+            a_pi, logp_pi = jax.lax.stop_gradient(nets.pi(
                 p, jnp.broadcast_to(batch["obs"],
-                                    (n_act,) + batch["obs"].shape), kp)
-            a_pi2, logp_pi2 = nets.pi(
+                                    (n_act,) + batch["obs"].shape), kp))
+            a_pi2, logp_pi2 = jax.lax.stop_gradient(nets.pi(
                 p, jnp.broadcast_to(batch["next_obs"],
-                                    (n_act,) + batch["obs"].shape), kp2)
+                                    (n_act,) + batch["obs"].shape), kp2))
 
             def q_all(which):
                 def q_one(a):

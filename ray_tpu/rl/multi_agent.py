@@ -511,10 +511,14 @@ class TurnBasedEnvRunner(_MultiAgentRunnerBase):
         T = self.rollout_len
         for mid, streams in self.streams.items():
             taken = []
-            for key in [  # keep stream order stable
-                    (i, a) for i, a in streams]:
+            for key in streams:
                 taken.append(self._closed[key][:T])
-                self._closed[key] = self._closed[key][T:]
+                # Carry over the surplus, BOUNDED: with agents acting
+                # at very different rates the fast streams outpace the
+                # T-per-sample drain; keep the newest 4T (dropping
+                # oldest whole transitions trades a GAE seam at the
+                # drop point for bounded memory and fresher data).
+                self._closed[key] = self._closed[key][T:][-4 * T:]
             # [T, S] time-major stacking, column by column
             def col(j, dtype=None):
                 arr = np.stack(
