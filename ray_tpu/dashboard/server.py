@@ -154,6 +154,7 @@ class DashboardServer:
             return self._send(req, _client_html(), "text/html")
         if path == "/metrics":
             from ray_tpu.util.metrics import prometheus_text
+            self._record_core_metrics()
             return self._send(req, prometheus_text(),
                               "text/plain; version=0.0.4")
         if path == "/api/cluster":
@@ -197,6 +198,81 @@ class DashboardServer:
         if path == "/api/logs/tail":
             return self._tail_log(req, query)
         req.send_error(404, "unknown route")
+
+    def _record_core_metrics(self) -> None:
+        """Refresh runtime gauges on every /metrics scrape so the SPA's
+        time-series view (and any Prometheus scraper) sees live task
+        counters, per-node object-store bytes, and per-deployment
+        request totals (reference: dashboard/modules/metrics +
+        metrics_agent.py exporting core state)."""
+        from ray_tpu.util.metrics import Gauge
+        if not hasattr(self, "_core_gauges"):
+            self._core_gauges = {
+                "finished": Gauge("ray_tpu_tasks_finished_total",
+                                  "Lifetime finished tasks"),
+                "failed": Gauge("ray_tpu_tasks_failed_total",
+                                "Lifetime failed tasks"),
+                "pending": Gauge("ray_tpu_tasks_pending",
+                                 "Currently pending tasks"),
+                "store": Gauge("ray_tpu_object_store_used_bytes",
+                               "Object store bytes in use",
+                               tag_keys=("node",)),
+                "serve_total": Gauge(
+                    "ray_tpu_serve_requests_total",
+                    "Lifetime serve requests", tag_keys=("deployment",)),
+            }
+        from ray_tpu.util.metrics import remove_series
+        g = self._core_gauges
+        rt = self._runtime
+        tm = rt.task_manager
+        g["finished"].set(float(getattr(tm, "num_finished", 0)))
+        g["failed"].set(float(getattr(tm, "num_failed", 0)))
+        g["pending"].set(float(tm.num_pending()))
+        store_tags = set()
+        for node_id, node in list(rt.nodes.items()):
+            used = (node.store.used_bytes()
+                    if getattr(node, "store", None) is not None
+                    and hasattr(node.store, "used_bytes")
+                    else getattr(node, "store_used", 0))
+            tag = node_id.hex()[:12]
+            store_tags.add(tag)
+            g["store"].set(float(used or 0), tags={"node": tag})
+        # dead nodes' series must stop being exported (zombie charts)
+        for tag in getattr(self, "_prev_store_tags", set()) - store_tags:
+            remove_series("ray_tpu_object_store_used_bytes",
+                          {"node": tag})
+        self._prev_store_tags = store_tags
+        # Serve totals fan out to replica actors — cache briefly so
+        # overlapping scrapes (SPA poll + Prometheus) don't multiply
+        # the round trips, and keep the whole probe off this thread's
+        # critical path budget.
+        now = time.time()
+        cached = getattr(self, "_serve_totals_cache", None)
+        if cached is not None and now - cached[0] < 3.0:
+            totals = cached[1]
+        else:
+            totals = None
+            try:
+                import ray_tpu
+                from ray_tpu.serve.controller import CONTROLLER_NAME
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                totals = ray_tpu.get(
+                    controller.get_request_totals.remote(), timeout=10)
+            except Exception:  # noqa: BLE001 — serve not running
+                totals = {} if cached is None else None
+            if totals is not None:
+                self._serve_totals_cache = (now, totals)
+            else:
+                totals = cached[1]  # probe failed: keep last values
+        serve_tags = set()
+        for name, total in totals.items():
+            serve_tags.add(name)
+            g["serve_total"].set(total, tags={"deployment": name})
+        for name in (getattr(self, "_prev_serve_tags", set())
+                     - serve_tags):
+            remove_series("ray_tpu_serve_requests_total",
+                          {"deployment": name})
+        self._prev_serve_tags = serve_tags
 
     def _serve_status(self):
         """Deployment/replica status from the serve controller

@@ -141,6 +141,47 @@ class ServeController:
                 for name, st in self._deployments.items()
             }
 
+    def get_request_totals(self) -> Dict[str, float]:
+        """deployment -> lifetime request count summed over replicas
+        (feeds per-deployment QPS charts; reference:
+        dashboard/modules/metrics serve panels).
+
+        All replica probes are submitted up front and bounded by ONE
+        wait (no serial per-replica timeouts on the scrape path). A
+        deployment whose replicas ALL failed to answer is omitted —
+        publishing 0 for a nonzero lifetime counter would make the
+        series non-monotonic and chart a phantom QPS spike when it
+        recovers."""
+        import ray_tpu
+        with self._lock:
+            handles = {name: list(st.replicas.values())
+                       for name, st in self._deployments.items()}
+        probes = [(name, h.get_metrics.remote(2.0))
+                  for name, replicas in handles.items()
+                  for h in replicas]
+        if not probes:
+            return {name: 0.0 for name in handles}
+        ready, _ = ray_tpu.wait([ref for _, ref in probes],
+                                num_returns=len(probes), timeout=5)
+        ready_set = set(r.id for r in ready)
+        out: Dict[str, float] = {}
+        answered: Dict[str, int] = {}
+        for name, ref in probes:
+            if ref.id not in ready_set:
+                continue
+            try:
+                total = float(ray_tpu.get(ref, timeout=1)["total"])
+            except Exception:  # noqa: BLE001 — replica died mid-probe
+                continue
+            out[name] = out.get(name, 0.0) + total
+            answered[name] = answered.get(name, 0) + 1
+        for name, replicas in handles.items():
+            if not replicas:
+                out.setdefault(name, 0.0)  # zero replicas: honest zero
+            elif not answered.get(name):
+                out.pop(name, None)  # nobody answered: omit, not 0
+        return out
+
     def list_routes(self) -> Dict[str, str]:
         """route_prefix -> ingress deployment name (for the proxy)."""
         with self._lock:

@@ -54,7 +54,22 @@ class _Registry:
                 entry[3] += 1
 
 
+    def remove_series(self, name: str, tags: Tuple) -> None:
+        """Drop one labeled series (a gauge whose subject — node,
+        deployment — no longer exists must stop being exported, or
+        scrapers chart zombie series forever)."""
+        with self.lock:
+            key = (name, tags)
+            self.counters.pop(key, None)
+            self.gauges.pop(key, None)
+            self.histograms.pop(key, None)
+
+
 _registry = _Registry()
+
+
+def remove_series(name: str, tags: Dict[str, str]) -> None:
+    _registry.remove_series(name, tuple(sorted((tags or {}).items())))
 
 
 def _record(kind: str, name: str, tags: Dict[str, str], value: float,

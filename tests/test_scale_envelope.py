@@ -1,0 +1,139 @@
+"""Scale-envelope stress: the single-owner head at its DOCUMENTED
+envelope (PARITY.md "Scale envelope"): 64 nodes, 1,000 live actor
+records, 32 placement groups, 10k+ tasks/s on one node.
+
+The reference targets 2,000 nodes / 40k actors with a distributed
+control plane (release/benchmarks/README.md:11-14); this repo's head
+is deliberately a single owner (core/runtime.py design note), so the
+envelope is smaller and measured HERE — control-plane bookkeeping at
+envelope scale, without spawning a thousand OS processes (worker
+execution throughput has its own guards in test_task_throughput.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def envelope_head():
+    rt = ray_tpu.init(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _calibration_rate(n: int = 200_000) -> float:
+    t0 = time.perf_counter()
+    d = {}
+    out = []
+    for i in range(n):
+        d[i & 1023] = i
+        out.append((i, i + 1))
+        if len(out) > 1024:
+            out.clear()
+    return n / (time.perf_counter() - t0)
+
+
+def test_envelope_64_nodes_1k_actors_pgs(envelope_head):
+    rt = envelope_head
+    calib = _calibration_rate()
+
+    # --- 64 nodes join the control plane (ledger + GCS) -------------
+    # Stub registrations model what REMOTE nodes cost the head: a
+    # scheduler ledger row + a GCS record (a daemon's reader thread
+    # blocks idle in recv). Full in-process Node objects would instead
+    # saddle the one-core head with 64 nodes' worker/log machinery —
+    # load real deployments put on 64 separate hosts, not on the head.
+    from ray_tpu.core.gcs import NodeRecord
+    from ray_tpu.core.ids import NodeID
+    t0 = time.perf_counter()
+    node_ids = []
+    for i in range(64):
+        nid = NodeID.from_random()
+        rt.scheduler.add_node(
+            nid, {"CPU": 4.0, "TPU": 4.0, "envelope": 1.0}, {})
+        rt.gcs.register_node(NodeRecord(
+            node_id=nid, address=f"stub-host-{i}:0",
+            resources_total={"CPU": 4.0, "TPU": 4.0, "envelope": 1.0},
+            labels={}, node_manager=None))
+        node_ids.append(nid)
+    join_s = time.perf_counter() - t0
+    assert len(rt.scheduler.snapshot()) >= 65
+    assert join_s < 5.0, join_s  # pure bookkeeping, ~2ms quiet-box
+
+    # --- scheduler picks stay fast with 64 nodes in the ledger ------
+    from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
+    from ray_tpu.core.ids import TaskID
+    spec = TaskSpec(task_id=TaskID.from_random(), function_id="x",
+                    args=[], resources={"CPU": 1.0, "envelope": 0.01},
+                    strategy=SchedulingStrategy())
+    n_picks = 2_000
+    t0 = time.perf_counter()
+    for _ in range(n_picks):
+        nid = rt.scheduler.pick_node(spec)
+        assert nid is not None
+        assert rt.scheduler.try_acquire(nid, spec.resources)
+        rt.scheduler.release(nid, spec.resources)
+    pick_rate = n_picks / (time.perf_counter() - t0)
+    # quiet-box ~8.6k pick/acquire/release triples per second over 64
+    # nodes (~116us each); guard via the calibration ratio so box load
+    # doesn't flake it while a >=2x regression trips it
+    assert pick_rate > 0.0008 * calib, (pick_rate, calib)
+
+    # --- 1,000 live actor records + named lookups -------------------
+    from ray_tpu.core.gcs import ActorRecord
+    from ray_tpu.core.ids import ActorID
+    t0 = time.perf_counter()
+    aids = []
+    for i in range(1_000):
+        aid = ActorID.from_random()
+        rt.gcs.register_actor(ActorRecord(
+            actor_id=aid, name=f"envelope-{i}", namespace="",
+            state="ALIVE", node_id=node_ids[i % 64]))
+        aids.append(aid)
+    reg_s = time.perf_counter() - t0
+    # ~0.1ms/record quiet-box; scale the bound with current box speed
+    assert reg_s < 1_000 * 0.004 * (5e6 / max(calib, 1e5)), reg_s
+    # random named lookups stay fast at 1k actors
+    t0 = time.perf_counter()
+    for i in range(0, 1_000, 7):
+        rec = rt.gcs.get_named_actor(f"envelope-{i}")
+        assert rec is not None and rec.state == "ALIVE"
+    assert time.perf_counter() - t0 < 1.0
+
+    # --- 32 placement groups solve across the 64 nodes --------------
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+    pgs = [placement_group([{"TPU": 2.0}] * 2, strategy="SPREAD")
+           for _ in range(32)]
+    for pg in pgs:
+        assert pg.ready(timeout=30)
+    # bundles landed across the fleet, not piled on one node
+    spread = {nid.hex() for pg in pgs for nid in pg.bundle_node_ids()}
+    assert len(spread) >= 16
+    for pg in pgs:
+        remove_placement_group(pg)
+
+    # --- state surfaces stay responsive at envelope scale -----------
+    from ray_tpu.util import state as state_api
+    t0 = time.perf_counter()
+    nodes = state_api.list_nodes()
+    actors = state_api.list_actors(limit=2_000)
+    assert len(nodes) >= 65
+    assert len(actors) >= 1_000
+    assert time.perf_counter() - t0 < 5.0
+
+    # --- real execution still works with the big ledger -------------
+    # Pin to the head (stub nodes can't run work) via a marker
+    # resource; the scheduler still scans the 65-row ledger per pick.
+    rt.scheduler.add_node_resources(rt.head_node_id, {"head_only": 4.0})
+
+    @ray_tpu.remote(resources={"head_only": 0.1}, num_cpus=0)
+    def ping(x):
+        return x
+
+    assert ray_tpu.get([ping.remote(i) for i in range(100)],
+                       timeout=60) == list(range(100))

@@ -2,15 +2,43 @@
 release/benchmarks/README.md — 10k+ tasks/s, 1M queued per node without
 collapse; owner-push + lease-cache design normal_task_submitter.cc:499).
 
-Absolute rates swing +/-30% with box load, so the assertions are
-deliberately conservative floors plus a ratio-based non-collapse check;
-the honest numbers live in PERF.md (and `python -m ray_tpu.scripts.perf`
-reproduces them, including an opt-in 1M drain via --backlog 1000000).
+Absolute rates swing wildly with box load (the CI box is 1-core and
+shared), so the guards are RATIOS against a same-run calibration: a
+fixed pure-Python workload measures how fast this box runs Python right
+now, and task throughput must stay within a constant factor of it.
+Load slows both sides proportionally, so the ratio is stable where an
+absolute floor either flakes or goes blunt — quiet-box ratios are ~2.4x
+above these thresholds (PERF.md records the honest numbers;
+`python -m ray_tpu.scripts.perf` reproduces them, including an opt-in
+1M drain via --backlog 1000000). test_throughput_guard_has_teeth proves
+the thresholds catch a ~2x per-task regression.
 """
 
 import time
 
 import ray_tpu
+
+# Quiet-box measurements (2026-07-30): submit/calib 0.0047,
+# end-to-end/calib 0.0018 with calibration ~5-6M ops/s. Guards at
+# roughly HALF the observed ratio: a >=2x per-task regression trips
+# them on any box, ordinary load noise does not.
+CALIB_SUBMIT_RATIO = 0.0020
+CALIB_E2E_RATIO = 0.0008
+
+
+def _calibration_rate(n: int = 300_000) -> float:
+    """Fixed pure-Python workload (dict stores + tuple allocs + list
+    append/clear — the flavor of per-task bookkeeping) measuring the
+    box's current effective Python speed."""
+    t0 = time.perf_counter()
+    d = {}
+    out = []
+    for i in range(n):
+        d[i & 1023] = i
+        out.append((i, i + 1))
+        if len(out) > 1024:
+            out.clear()
+    return n / (time.perf_counter() - t0)
 
 
 def _rates(n: int) -> tuple:
@@ -34,19 +62,56 @@ def _rates(n: int) -> tuple:
 def test_deep_backlog_does_not_collapse(ray_start_regular):
     """Round-2 verdict: throughput fell 5x between 2k and 10k queued
     (2.9k/s -> 0.6k/s). Guard the fix: end-to-end rate with a 40k-deep
-    backlog must stay within 2.5x of the 4k-deep rate."""
+    backlog must stay within 2.5x of the 4k-deep rate, and clear the
+    calibration ratio."""
+    calib = _calibration_rate()
     _, shallow = _rates(4_000)
     _, deep = _rates(40_000)
     assert deep > shallow / 3.0, (
         f"deep-backlog collapse: {deep:.0f}/s at 40k vs "
         f"{shallow:.0f}/s at 4k queued")
-    # Conservative absolute floor (PERF.md records quiet-box numbers;
-    # the shared 1-core box swings hard when suites run concurrently).
-    assert deep > 1_500, f"deep end-to-end rate {deep:.0f}/s below floor"
+    assert deep > CALIB_E2E_RATIO * calib, (
+        f"deep end-to-end {deep:.0f}/s under {CALIB_E2E_RATIO} x "
+        f"calibration ({calib:.0f} ops/s)")
 
 
-def test_submit_rate_floor(ray_start_regular):
-    """Owner-side submission must stay well under 1ms/task (PERF.md
-    records ~50us/task quiet-box; floor set 6x looser for load)."""
-    submit, _ = _rates(20_000)
-    assert submit > 2_500, f"submit rate {submit:.0f}/s below floor"
+def test_submit_rate_calibrated(ray_start_regular):
+    """Owner-side submission keeps pace with the box's Python speed
+    (quiet-box ~50us/task at ~5M calib ops/s -> ratio ~0.0047; guard
+    at 0.002)."""
+    calib = _calibration_rate()
+    submit, e2e = _rates(20_000)
+    assert submit > CALIB_SUBMIT_RATIO * calib, (
+        f"submit {submit:.0f}/s under {CALIB_SUBMIT_RATIO} x "
+        f"calibration ({calib:.0f} ops/s)")
+    assert e2e > CALIB_E2E_RATIO * calib, (
+        f"end-to-end {e2e:.0f}/s under {CALIB_E2E_RATIO} x "
+        f"calibration ({calib:.0f} ops/s)")
+
+
+def test_throughput_guard_has_teeth(ray_start_regular):
+    """The calibrated guard must CATCH a real regression (VERDICT r3
+    item 7 done-criterion): inject ~2.5x the per-task submit budget as
+    fixed pure-Python work per task — the same currency as the
+    calibration, so this sabotage trips the guard on any box — and
+    assert the submit guard fails."""
+    from ray_tpu.core import runtime as runtime_mod
+
+    calib = _calibration_rate()
+    rt = runtime_mod.get_runtime()
+    orig = rt.submit_spec
+
+    def regressed_submit(spec):
+        i = 0
+        while i < 10_000:  # ~125us quiet-box; scales with load
+            i += 1
+        return orig(spec)
+
+    rt.submit_spec = regressed_submit
+    try:
+        submit, _ = _rates(8_000)
+    finally:
+        rt.submit_spec = orig
+    assert submit < CALIB_SUBMIT_RATIO * calib, (
+        f"guard is toothless: sabotaged submit {submit:.0f}/s still "
+        f"clears {CALIB_SUBMIT_RATIO} x calibration ({calib:.0f})")
