@@ -19,10 +19,10 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from ray_tpu.rl.algorithm import Algorithm
-from ray_tpu.rl.algorithms.sac import SACConfig, _SACNets
+from ray_tpu.rl.algorithms.offline_base import (
+    OfflineContinuousAlgorithm)
+from ray_tpu.rl.algorithms.sac import SACConfig
 from ray_tpu.rl.offline import OfflineData
-from ray_tpu.rl.spaces import Box
 
 
 class CQLConfig(SACConfig):
@@ -58,36 +58,17 @@ class CQLConfig(SACConfig):
         return self
 
 
-class CQL(Algorithm):
+class CQL(OfflineContinuousAlgorithm):
+    _eval_seed_base = 20_000
+
     def setup(self, config: CQLConfig) -> None:
         import jax
         import jax.numpy as jnp
-        import optax
 
-        if config.offline_data is None:
-            raise ValueError(
-                "CQL is offline: config.offline(OfflineData(episodes))")
-        env0 = config.make_python_env()
-        if not isinstance(env0.action_space, Box):
-            raise ValueError("CQL (on SAC) requires a continuous action "
-                             "space")
-        obs_dim = int(np.prod(env0.observation_space.shape))
-        act_dim = int(np.prod(env0.action_space.shape))
-        low = np.broadcast_to(env0.action_space.low, (act_dim,)).astype(
-            np.float32)
-        high = np.broadcast_to(env0.action_space.high,
-                               (act_dim,)).astype(np.float32)
-        nets = self.nets = _SACNets(obs_dim, act_dim, config.hidden,
-                                    low, high)
-        self._eval_env = env0
-        self.data = config.offline_data
-        self._rng = np.random.default_rng(config.seed)
-        self._key = jax.random.PRNGKey(config.seed)
-        self.params = nets.init(jax.random.PRNGKey(config.seed))
-        self.target_params = jax.tree.map(lambda x: x, self.params)
-        self.opt = optax.adam(config.lr)
-        self.opt_state = self.opt.init(self.params)
-        self._updates = 0
+        nets = self._setup_common(config)
+        self._finish_setup(config)
+        act_dim = self.act_dim
+        low, high = self.low, self.high
 
         gamma, tau = config.gamma, config.tau
         alpha = config.initial_alpha        # fixed entropy temperature
@@ -167,7 +148,7 @@ class CQL(Algorithm):
                 loss_fn, has_aux=True)(params)
             updates, opt_state = self.opt.update(grads, opt_state,
                                                  params)
-            params = optax.apply_updates(params, updates)
+            params = self._optax.apply_updates(params, updates)
             target_params = jax.tree.map(
                 lambda t, p_: (1.0 - tau) * t + tau * p_,
                 target_params, params)
@@ -176,7 +157,6 @@ class CQL(Algorithm):
 
         self._train_step = jax.jit(train_step,
                                    static_argnames=("bc_mode",))
-        self._act_mode = jax.jit(nets.pi_mode)
 
     def training_step(self) -> Dict[str, Any]:
         import jax
@@ -200,48 +180,6 @@ class CQL(Algorithm):
             "actor_loss": float(actor_l),
             "num_updates": self._updates,
         }
-
-    def _evaluate(self, episodes: int):
-        env = self._eval_env
-        returns = []
-        for e in range(episodes):
-            obs, _ = env.reset(seed=20_000 + self.iteration * 100 + e)
-            total = 0.0
-            for _ in range(1000):
-                action = self.compute_single_action(obs)
-                obs, rew, term, trunc, _ = env.step(action)
-                total += rew
-                self._env_steps_lifetime += 1
-                if term or trunc:
-                    break
-            returns.append(total)
-        return returns
-
-    def compute_single_action(self, obs: np.ndarray) -> np.ndarray:
-        return np.asarray(self._act_mode(self.params,
-                                         np.asarray(obs)[None]))[0]
-
-    def get_state(self) -> Dict[str, Any]:
-        state = super().get_state()
-        state.update(
-            params=self.params, target_params=self.target_params,
-            updates=self._updates,
-            # optimizer moments + PRNG streams: a restore must continue
-            # training, not silently restart with fresh Adam moments
-            # (same contract as SAC.get_state)
-            opt_state=self.opt_state, key=self._key,
-            np_rng=self._rng.bit_generator.state)
-        return state
-
-    def set_state(self, state: Dict[str, Any]) -> None:
-        super().set_state(state)
-        self.params = state["params"]
-        self.target_params = state["target_params"]
-        self._updates = state["updates"]
-        if "opt_state" in state:
-            self.opt_state = state["opt_state"]
-            self._key = state["key"]
-            self._rng.bit_generator.state = state["np_rng"]
 
 
 CQLConfig.algo_class = CQL

@@ -1,0 +1,159 @@
+"""IQL — Implicit Q-Learning for offline RL (Kostrikov et al. 2021).
+
+Reference: rllib/algorithms/iql/ (iql.py config on MARWIL, torch
+learner iql_torch_learner.py — expectile value regression + advantage
+weighted actor). Here it rides the in-tree SAC nets plus a state-value
+head:
+
+    L_V  = E[ rho_tau( min_i Qtgt_i(s, a) - V(s) ) ]     (expectile)
+    L_Q  = E[ ( Q(s, a) - (r + gamma (1-d) V(s')) )^2 ]
+    L_pi = -E[ exp(beta (Qtgt - V)) clipped * log pi(a|s) ]   (AWR)
+
+All three train from the fixed dataset; the policy never queries the
+env (evaluation rollouts only). The squashed-Gaussian log-prob of DATA
+actions uses the atanh inverse with edge clipping.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl.algorithms.cql import CQLConfig
+from ray_tpu.rl.algorithms.offline_base import (
+    OfflineContinuousAlgorithm)
+from ray_tpu.rl.rl_module import _dense_forward, _dense_init
+
+
+class IQLConfig(CQLConfig):
+    """Shares CQL's offline/evaluation plumbing; IQL-specific knobs
+    mirror the reference's (expectile tau, AWR beta)."""
+
+    def __init__(self):
+        super().__init__()
+        self.expectile = 0.8
+        self.beta = 3.0          # advantage temperature (reference beta)
+        self.adv_clip = 100.0    # exp-advantage clip (reference: 100)
+
+    def training(self, *, expectile: Optional[float] = None,
+                 beta: Optional[float] = None,
+                 adv_clip: Optional[float] = None, **kw) -> "IQLConfig":
+        super().training(**kw)
+        if expectile is not None:
+            self.expectile = float(expectile)
+        if beta is not None:
+            self.beta = float(beta)
+        if adv_clip is not None:
+            self.adv_clip = float(adv_clip)
+        return self
+
+
+class IQL(OfflineContinuousAlgorithm):
+    _eval_seed_base = 30_000
+
+    def setup(self, config: IQLConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        nets = self._setup_common(config)
+        # state-value head V(s) (reference: iql module's vf branch) —
+        # added BEFORE _finish_setup so the optimizer covers it
+        self.params["vf"] = _dense_init(
+            jax.random.PRNGKey(config.seed + 7),
+            [self.obs_dim, *config.hidden, 1])
+        self._finish_setup(config)
+        scale, center = nets.scale, nets.center
+
+        gamma, tau = config.gamma, config.tau
+        expectile = config.expectile
+        beta = config.beta
+        adv_clip = config.adv_clip
+
+        def v_of(p, obs):
+            return _dense_forward(p["vf"], obs).squeeze(-1)
+
+        def logp_data(p, obs, act):
+            """log pi(a_data|s) for the squashed Gaussian via atanh
+            inverse (edge-clipped; reference: torch TanhNormal)."""
+            out = _dense_forward(p["pi"], obs)
+            mean, log_std = jnp.split(out, 2, axis=-1)
+            from ray_tpu.rl.algorithms.sac import (_LOG_STD_MAX,
+                                                   _LOG_STD_MIN)
+            log_std = jnp.clip(log_std, _LOG_STD_MIN, _LOG_STD_MAX)
+            std = jnp.exp(log_std)
+            a = jnp.clip((act - center) / scale, -1.0 + 1e-6,
+                         1.0 - 1e-6)
+            u = jnp.arctanh(a)
+            logp_u = jnp.sum(
+                -0.5 * (((u - mean) / std) ** 2 + 2 * log_std
+                        + jnp.log(2 * jnp.pi)), axis=-1)
+            correction = jnp.sum(
+                2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)),
+                axis=-1)
+            return logp_u - correction
+
+        def train_step(params, target_params, opt_state, batch):
+            q_tgt = jnp.minimum(
+                nets.q(target_params, "q1", batch["obs"],
+                       batch["actions"]),
+                nets.q(target_params, "q2", batch["obs"],
+                       batch["actions"]))
+
+            def loss_fn(p):
+                # expectile value regression toward target-Q
+                v = v_of(p, batch["obs"])
+                diff = q_tgt - v
+                weight = jnp.where(diff > 0, expectile, 1 - expectile)
+                v_loss = jnp.mean(weight * diff ** 2)
+                # TD critics toward r + gamma V(s')
+                v_next = jax.lax.stop_gradient(
+                    v_of(p, batch["next_obs"]))
+                y = (batch["rewards"]
+                     + gamma * (1.0 - batch["dones"]) * v_next)
+                q1 = nets.q(p, "q1", batch["obs"], batch["actions"])
+                q2 = nets.q(p, "q2", batch["obs"], batch["actions"])
+                q_loss = (jnp.mean((q1 - y) ** 2)
+                          + jnp.mean((q2 - y) ** 2))
+                # advantage-weighted regression actor
+                adv = q_tgt - jax.lax.stop_gradient(v)
+                w = jnp.minimum(jnp.exp(beta * adv), adv_clip)
+                logp = logp_data(p, batch["obs"], batch["actions"])
+                pi_loss = -jnp.mean(jax.lax.stop_gradient(w) * logp)
+                total = v_loss + q_loss + pi_loss
+                return total, (v_loss, q_loss, pi_loss)
+
+            (_, (v_l, q_l, pi_l)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.opt.update(grads, opt_state,
+                                                 params)
+            params = self._optax.apply_updates(params, updates)
+            target_params = jax.tree.map(
+                lambda t, p_: (1.0 - tau) * t + tau * p_,
+                target_params, params)
+            return params, target_params, opt_state, v_l, q_l, pi_l
+
+        self._train_step = jax.jit(train_step)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        v_l = q_l = pi_l = float("nan")
+        for _ in range(cfg.num_gradient_steps):
+            batch = self.data.sample(cfg.train_batch_size, self._rng)
+            (self.params, self.target_params, self.opt_state, v_l, q_l,
+             pi_l) = self._train_step(
+                self.params, self.target_params, self.opt_state,
+                dict(batch))
+            self._updates += 1
+        if cfg.evaluation_episodes:
+            self.record_episodes(
+                self._evaluate(cfg.evaluation_episodes))
+        return {
+            "value_loss": float(v_l),
+            "critic_loss": float(q_l),
+            "actor_loss": float(pi_l),
+            "num_updates": self._updates,
+        }
+
+
+IQLConfig.algo_class = IQL

@@ -1,0 +1,111 @@
+"""Shared base for offline continuous-control algorithms (CQL, IQL).
+
+Both ride the SAC actor/critic nets over a fixed OfflineData set and
+only touch an env for spaces + evaluation rollouts; everything below
+(env/net bootstrap, deterministic evaluation, checkpoint state incl.
+optimizer moments and PRNG streams) is identical between them —
+reference analog: rllib's cql.py/iql.py both deriving their plumbing
+from SAC/MARWIL."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.algorithms.sac import _SACNets
+from ray_tpu.rl.spaces import Box
+
+
+class OfflineContinuousAlgorithm(Algorithm):
+    """Env/nets/optimizer bootstrap + evaluation + checkpoint state.
+
+    Subclasses implement ``setup`` (calling ``_setup_common`` first and
+    defining their jitted train step) and ``training_step``."""
+
+    # offset into the eval seed space so CQL/IQL rollouts never share
+    # episode seeds with training or each other
+    _eval_seed_base = 20_000
+
+    def _setup_common(self, config) -> _SACNets:
+        import jax
+        import optax
+
+        if config.offline_data is None:
+            raise ValueError(
+                f"{type(self).__name__} is offline: "
+                "config.offline(OfflineData(episodes))")
+        env0 = config.make_python_env()
+        if not isinstance(env0.action_space, Box):
+            raise ValueError(
+                f"{type(self).__name__} (on SAC nets) requires a "
+                "continuous action space")
+        self.obs_dim = int(np.prod(env0.observation_space.shape))
+        self.act_dim = int(np.prod(env0.action_space.shape))
+        self.low = np.broadcast_to(
+            env0.action_space.low, (self.act_dim,)).astype(np.float32)
+        self.high = np.broadcast_to(
+            env0.action_space.high, (self.act_dim,)).astype(np.float32)
+        nets = self.nets = _SACNets(self.obs_dim, self.act_dim,
+                                    config.hidden, self.low, self.high)
+        self._eval_env = env0
+        self.data = config.offline_data
+        self._rng = np.random.default_rng(config.seed)
+        self._key = jax.random.PRNGKey(config.seed)
+        self.params = nets.init(jax.random.PRNGKey(config.seed))
+        self._updates = 0
+        self._optax = optax
+        return nets
+
+    def _finish_setup(self, config) -> None:
+        """Target params + optimizer over whatever ``self.params``
+        holds after the subclass added its extra heads."""
+        import jax
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt = self._optax.adam(config.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._act_mode = jax.jit(self.nets.pi_mode)
+
+    def _evaluate(self, episodes: int):
+        env = self._eval_env
+        returns = []
+        for e in range(episodes):
+            obs, _ = env.reset(seed=self._eval_seed_base
+                               + self.iteration * 100 + e)
+            total = 0.0
+            for _ in range(1000):
+                action = self.compute_single_action(obs)
+                obs, rew, term, trunc, _ = env.step(action)
+                total += rew
+                self._env_steps_lifetime += 1
+                if term or trunc:
+                    break
+            returns.append(total)
+        return returns
+
+    def compute_single_action(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._act_mode(self.params,
+                                         np.asarray(obs)[None]))[0]
+
+    def get_state(self) -> Dict[str, Any]:
+        state = super().get_state()
+        state.update(
+            params=self.params, target_params=self.target_params,
+            updates=self._updates,
+            # optimizer moments + PRNG streams: a restore must continue
+            # training, not silently restart with fresh Adam moments
+            # (same contract as SAC.get_state)
+            opt_state=self.opt_state, key=self._key,
+            np_rng=self._rng.bit_generator.state)
+        return state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        super().set_state(state)
+        self.params = state["params"]
+        self.target_params = state["target_params"]
+        self._updates = state["updates"]
+        if "opt_state" in state:
+            self.opt_state = state["opt_state"]
+            self._key = state["key"]
+            self._rng.bit_generator.state = state["np_rng"]

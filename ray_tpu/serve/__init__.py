@@ -123,6 +123,7 @@ def shutdown() -> None:
     from ray_tpu.serve import handle as handle_mod
     with handle_mod._routers_lock:
         handle_mod._routers.clear()
+        handle_mod._routers_unresolved.clear()
 
 
 __all__ = [

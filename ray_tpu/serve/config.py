@@ -33,6 +33,10 @@ class DeploymentConfig:
     user_config: Optional[Dict[str, Any]] = None
     health_check_period_s: float = 1.0
     graceful_shutdown_timeout_s: float = 5.0
+    # routing policy: "pow2" (default) or "prefix_aware" (LLM
+    # prompt-prefix cache affinity; reference:
+    # llm/_internal/serve/routing_policies/prefix_aware/)
+    request_router: str = "pow2"
 
 
 @dataclass

@@ -141,6 +141,14 @@ class ServeController:
                 for name, st in self._deployments.items()
             }
 
+    def get_router_policy(self, deployment_name: str) -> str:
+        """Routing policy for driver-side router construction
+        ("pow2" | "prefix_aware")."""
+        with self._lock:
+            st = self._deployments.get(deployment_name)
+            return (st.config.request_router if st is not None
+                    else "pow2")
+
     def get_request_totals(self) -> Dict[str, float]:
         """deployment -> lifetime request count summed over replicas
         (feeds per-deployment QPS charts; reference:

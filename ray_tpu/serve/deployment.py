@@ -63,7 +63,7 @@ class Deployment:
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 100,
                autoscaling_config=None, ray_actor_options=None,
-               user_config=None):
+               user_config=None, request_router: str = "pow2"):
     """``@serve.deployment`` (reference: python/ray/serve/api.py)."""
 
     def make(target) -> Deployment:
@@ -71,7 +71,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
             ray_actor_options=dict(ray_actor_options or {}),
-            user_config=user_config)
+            user_config=user_config,
+            request_router=request_router)
         if autoscaling_config is not None:
             cfg.autoscaling_config = (
                 AutoscalingConfig(**autoscaling_config)

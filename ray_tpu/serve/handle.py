@@ -18,15 +18,51 @@ from ray_tpu.serve.replica import Rejected
 from ray_tpu.serve.router import Router
 
 _routers: Dict[str, Router] = {}
+# deployments whose routing policy could not be fetched yet (their
+# provisional pow-2 router is upgraded once the controller answers)
+_routers_unresolved: set = set()
 _routers_lock = threading.Lock()
 
 
 def _get_router(deployment_name: str, controller) -> Router:
     with _routers_lock:
         router = _routers.get(deployment_name)
-        if router is None:
-            router = Router(deployment_name, controller)
-            _routers[deployment_name] = router
+        needs_policy = (router is None
+                        or deployment_name in _routers_unresolved)
+    if not needs_policy:
+        return router
+    # Policy fetch happens OUTSIDE the lock (it is a controller RPC; a
+    # slow controller must not stall handle calls for every cached
+    # deployment). A failed fetch falls back to pow-2 but stays marked
+    # unresolved, so the next call retries instead of silently pinning
+    # the wrong policy forever.
+    import ray_tpu
+    policy = None
+    try:
+        policy = ray_tpu.get(
+            controller.get_router_policy.remote(deployment_name),
+            timeout=10)
+    except Exception:  # noqa: BLE001 — controller mid-restart
+        pass
+    with _routers_lock:
+        router = _routers.get(deployment_name)
+        if router is not None and deployment_name not in \
+                _routers_unresolved:
+            return router  # another thread resolved it meanwhile
+        if policy == "prefix_aware":
+            from ray_tpu.serve.prefix_router import PrefixAwareRouter
+            if not isinstance(router, PrefixAwareRouter):
+                router = PrefixAwareRouter(deployment_name, controller)
+            _routers_unresolved.discard(deployment_name)
+        elif policy is not None:
+            if router is None:
+                router = Router(deployment_name, controller)
+            _routers_unresolved.discard(deployment_name)
+        else:  # fetch failed: provisional pow-2, retry next call
+            if router is None:
+                router = Router(deployment_name, controller)
+            _routers_unresolved.add(deployment_name)
+        _routers[deployment_name] = router
         return router
 
 

@@ -34,6 +34,9 @@ class LLMConfig:
     engine: EngineConfig = field(default_factory=EngineConfig)
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    # route by prompt-prefix affinity (KV/prefix-cache locality;
+    # reference: llm/_internal/serve/routing_policies/prefix_aware/)
+    prefix_routing: bool = False
     # generation defaults
     max_tokens: int = 64
     temperature: float = 0.0
@@ -494,7 +497,9 @@ def build_llm_deployment(config: LLMConfig, params=None,
         LLMServer,
         name=name or config.model_id,
         num_replicas=config.num_replicas,
-        max_ongoing_requests=config.max_ongoing_requests)
+        max_ongoing_requests=config.max_ongoing_requests,
+        request_router=("prefix_aware" if config.prefix_routing
+                        else "pow2"))
     return dep.bind(config, params_blob)
 
 
@@ -528,5 +533,8 @@ def build_openai_app(llm_configs: List[LLMConfig] = None, *,
         MultiplexLLMServer, name=name,
         num_replicas=max(c.num_replicas for c in configs),
         max_ongoing_requests=max(c.max_ongoing_requests
-                                 for c in configs))
+                                 for c in configs),
+        request_router=("prefix_aware"
+                        if any(c.prefix_routing for c in configs)
+                        else "pow2"))
     return dep.bind(configs, blobs, max_models_per_replica)

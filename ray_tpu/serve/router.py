@@ -27,6 +27,11 @@ class Router:
         self._version = -1
         self._replicas: List[Tuple[str, Any]] = []
         self._qlen_cache: Dict[str, Tuple[float, int]] = {}
+        # replicas that just rejected a request sit out affinity-based
+        # selection for a beat (content routers consult this so a
+        # saturated cache-affine replica can't livelock retries while
+        # others idle); pow-2 probing ignores it.
+        self._reject_penalty: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._rng = random.Random()
 
@@ -54,8 +59,13 @@ class Router:
         self._qlen_cache[rid] = (now, qlen)
         return qlen
 
-    def choose(self) -> Tuple[str, Any]:
-        """Pick a replica: two random candidates, shorter queue wins."""
+    def choose(self, args_blob: Optional[bytes] = None
+               ) -> Tuple[str, Any]:
+        """Pick a replica: two random candidates, shorter queue wins.
+        ``args_blob`` (the serialized request) is ignored here but lets
+        policy subclasses route on request CONTENT (prefix_router.py);
+        retries re-enter choose() with the same blob, so content
+        policies re-apply on every attempt."""
         deadline = time.monotonic() + 30.0
         block = False
         while True:
@@ -77,7 +87,7 @@ class Router:
     def submit(self, method_name: str, args_blob: bytes):
         """Route once and return (replica_id, ObjectRef); rejection is
         surfaced at get() time and retried by DeploymentResponse."""
-        rid, handle = self.choose()
+        rid, handle = self.choose(args_blob)
         return rid, handle.handle_request.remote(method_name, args_blob)
 
     def stream(self, method_name: str, args_blob: bytes,
@@ -94,7 +104,7 @@ class Router:
                 raise TimeoutError(
                     f"streaming request to {self.deployment_name} not "
                     f"admitted after {attempts} rejected attempts")
-            rid, handle = self.choose()
+            rid, handle = self.choose(args_blob)
             it = handle.handle_request_streaming.options(
                 num_returns="streaming").remote(method_name, args_blob)
             try:
@@ -110,6 +120,7 @@ class Router:
             if kind == "rejected":
                 attempts += 1
                 self._qlen_cache.pop(rid, None)
+                self._reject_penalty[rid] = time.monotonic() + 1.0
                 time.sleep(min(0.05 * attempts, 0.5))
                 continue
             if kind == "single":
@@ -129,7 +140,7 @@ class Router:
         attempts = 0
         deadline = (time.monotonic() + timeout) if timeout else None
         while True:
-            rid, handle = self.choose()
+            rid, handle = self.choose(args_blob)
             ref = handle.handle_request.remote(method_name, args_blob)
             try:
                 remaining = (max(0.001, deadline - time.monotonic())
@@ -142,6 +153,7 @@ class Router:
                 return result
             attempts += 1
             self._qlen_cache.pop(rid, None)
+            self._reject_penalty[rid] = time.monotonic() + 1.0
             if deadline and time.monotonic() >= deadline:
                 raise TimeoutError(
                     f"request to {self.deployment_name} timed out "

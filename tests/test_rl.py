@@ -765,6 +765,68 @@ def test_cql_beats_bc_on_offline_data():
     cql.stop()
 
 
+def test_iql_learns_from_mixed_offline_data():
+    """IQL (reference: rllib/algorithms/iql/): expectile value
+    regression + AWR actor must recover a good policy from mixed
+    random+expert data — and, like CQL, clearly beat the BC clone of
+    the mixed behavior."""
+    from ray_tpu.rl import BCConfig, IQLConfig, OfflineData
+    from ray_tpu.rl import collect_episodes
+
+    rng = np.random.default_rng(1)
+
+    def random_policy(obs):
+        return rng.uniform(-1.0, 1.0, size=(1,)).astype(np.float32)
+
+    def expert_policy(obs):
+        # move toward the origin at full speed
+        return np.array([-np.sign(obs[0])], np.float32)
+
+    episodes = (collect_episodes(_Reach1D, random_policy,
+                                 num_episodes=60, seed=0, max_steps=20)
+                + collect_episodes(_Reach1D, expert_policy,
+                                   num_episodes=20, seed=100,
+                                   max_steps=20))
+    data = OfflineData(episodes, gamma=0.99)
+
+    def rollout_return(policy, episodes=10):
+        env = _Reach1D()
+        out = []
+        for e in range(episodes):
+            obs, _ = env.reset(seed=6_000 + e)
+            total = 0.0
+            for _ in range(20):
+                obs, rew, term, trunc, _ = env.step(policy(obs))
+                total += rew
+                if term or trunc:
+                    break
+            out.append(total)
+        return float(np.mean(out))
+
+    bc = (BCConfig().environment(_Reach1D)
+          .offline(OfflineData(episodes))
+          .training(lr=3e-3, num_gradient_steps=200,
+                    train_batch_size=256)
+          .debugging(seed=0)).build_algo()
+    for _ in range(5):
+        bc.train()
+    bc_return = rollout_return(bc.compute_single_action)
+
+    iql = (IQLConfig().environment(_Reach1D)
+           .offline(data)
+           .training(lr=3e-3, num_gradient_steps=200,
+                     train_batch_size=256, expectile=0.8, beta=3.0)
+           .debugging(seed=0)).build_algo()
+    for _ in range(5):
+        result = iql.train()
+    assert np.isfinite(result["value_loss"])
+    assert np.isfinite(result["critic_loss"])
+    iql_return = rollout_return(iql.compute_single_action)
+    assert iql_return > bc_return + 2.0, (iql_return, bc_return)
+    bc.stop()
+    iql.stop()
+
+
 def test_turn_based_runner_shapes_and_credit():
     """TurnBasedEnvRunner (VERDICT r3 item 5): acting set varies per
     step, per-(env, agent) streams come out dense [T, S], and reward

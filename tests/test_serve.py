@@ -427,3 +427,61 @@ def test_declarative_deploy_over_rest(ray_start_shared, tmp_path,
         dash.stop()
         serve.shutdown()
         _sys.modules.pop("myserveapp", None)
+
+
+# --- prefix-aware routing (reference: routing_policies/prefix_aware)
+
+
+def test_prefix_tree_match_insert_evict():
+    from ray_tpu.serve.prefix_router import PrefixTree
+
+    tree = PrefixTree(eviction_threshold_chars=10_000)
+    tree.insert("You are a helpful assistant. Question one", "r1")
+    tree.insert("You are a helpful assistant. Question two", "r2")
+    m = tree.match("You are a helpful assistant. Question three")
+    assert set(m) == {"r1", "r2"}
+    assert m["r1"] >= 32  # shared prefix matched deep
+    # unrelated text matches nothing
+    assert tree.match("completely different") == {}
+    # dead replicas are forgotten
+    tree.drop_replica("r1")
+    assert "r1" not in tree.match("You are a helpful assistant.")
+    # eviction bound: overflow resets instead of growing forever
+    small = PrefixTree(eviction_threshold_chars=100)
+    for i in range(50):
+        small.insert(f"prompt number {i} with padding text", "r")
+    assert small._chars <= 100 + 64
+
+
+def test_prefix_aware_routing_affinity(ray_start_shared):
+    """Balanced load + shared prompt prefix -> same replica every time
+    (cache locality); the tree records routed prompts (reference:
+    prefix_aware_router.py PrefixCacheAffinityRouter)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2, request_router="prefix_aware")
+    class Echo:
+        def __call__(self, request):
+            import os
+            return {"pid": os.getpid(),
+                    "prompt": request.get("prompt", "")}
+
+    try:
+        serve.run(Echo.bind(), name="prefixapp", route_prefix="/pfx")
+        handle = serve.get_deployment_handle("Echo",
+                                             app_name="prefixapp")
+        base = "System: you are terse. Document: " + "x" * 200
+        pids = {handle.remote({"prompt": base + f" q{i}"}
+                              ).result(timeout_s=60)["pid"]
+                for i in range(6)}
+        # after the first routing decision lands in the tree, every
+        # later shared-prefix request sticks to that replica
+        assert len(pids) <= 2
+        sticky = {handle.remote({"prompt": base + f" late{i}"}
+                                ).result(timeout_s=60)["pid"]
+                  for i in range(4)}
+        assert len(sticky) == 1
+        # unrelated prompts still spread by pow-2 (no crash, any pid)
+        handle.remote({"prompt": "zzz different"}).result(timeout_s=60)
+    finally:
+        serve.shutdown()
