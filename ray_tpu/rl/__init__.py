@@ -10,6 +10,7 @@ from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rl.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rl.algorithms.iql import IQL, IQLConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
@@ -31,7 +32,8 @@ from ray_tpu.rl import spaces
 
 __all__ = [
     "APPO", "APPOConfig", "Algorithm", "AlgorithmConfig", "BC", "BCConfig", "CartPole",
-    "CQL", "CQLConfig", "CartPoleJax", "Connector", "ConnectorPipeline", "DQN", "DQNConfig",
+    "CQL", "CQLConfig", "CartPoleJax", "Connector", "DreamerV3",
+    "DreamerV3Config", "ConnectorPipeline", "DQN", "DQNConfig",
     "Env", "FrameStack", "IMPALA", "IMPALAConfig", "IQL",
     "IQLConfig", "JaxEnv",
     "JaxEnvRunner", "Learner",

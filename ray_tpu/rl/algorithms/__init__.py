@@ -4,8 +4,10 @@ from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rl.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rl.algorithms.iql import IQL, IQLConfig
 
 __all__ = ["APPO", "APPOConfig", "PPO", "PPOConfig", "DQN", "DQNConfig",
            "SAC", "SACConfig", "BC", "BCConfig", "MARWIL", "MARWILConfig",
-           "CQL", "CQLConfig", "IQL", "IQLConfig"]
+           "CQL", "CQLConfig", "IQL", "IQLConfig", "DreamerV3",
+           "DreamerV3Config"]

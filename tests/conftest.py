@@ -65,25 +65,29 @@ def ray_start_cluster():
 
 
 @pytest.fixture(autouse=True)
-def _per_test_watchdog():
+def _per_test_watchdog(request):
     """Per-test timeout (pytest-timeout isn't in the image): SIGALRM in
     the main thread interrupts Python-level waits, so a flaky hang in a
     get()/wait() fails the one test instead of stalling the whole run
-    (reference: pytest.ini's 180 s default timeout)."""
+    (reference: pytest.ini's 180 s default timeout). Long-training
+    tests opt into a bigger budget with @pytest.mark.watchdog(N)."""
     import signal
 
     if threading.current_thread() is not threading.main_thread():
         yield
         return
 
+    marker = request.node.get_closest_marker("watchdog")
+    budget = int(marker.args[0]) if marker and marker.args else 150
+
     def _on_alarm(signum, frame):
         import faulthandler
         import sys
         faulthandler.dump_traceback(file=sys.stderr)
-        raise TimeoutError("test exceeded 150 s watchdog")
+        raise TimeoutError(f"test exceeded {budget} s watchdog")
 
     old = signal.signal(signal.SIGALRM, _on_alarm)
-    signal.alarm(150)
+    signal.alarm(budget)
     try:
         yield
     finally:

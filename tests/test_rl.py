@@ -827,6 +827,71 @@ def test_iql_learns_from_mixed_offline_data():
     iql.stop()
 
 
+@pytest.mark.watchdog(420)
+def test_dreamerv3_learns_cartpole():
+    """DreamerV3 (reference: rllib/algorithms/dreamerv3/): the world
+    model + imagination-trained actor-critic must clearly beat the
+    random baseline (~20) on CartPole within a small budget. Seeds 0/1
+    reach ~47/~55 by iteration 40/48 on this config; the bar is 40
+    with an early break."""
+    from ray_tpu.rl import DreamerV3Config
+
+    algo = (DreamerV3Config()
+            .environment("CartPole-v1")
+            .env_runners(num_envs_per_env_runner=4,
+                         rollout_fragment_length=50)
+            .training(batch_size_B=8, batch_length_T=16, horizon_H=8,
+                      training_ratio=128, learning_starts=400,
+                      deter_size=64, units=64, entropy_scale=1e-3)
+            .debugging(seed=0)
+            .build_algo())
+    best = 0.0
+    result = {}
+    for _ in range(48):
+        result = algo.train()
+        best = max(best, result.get("episode_return_mean") or 0.0)
+        if best > 40.0:
+            break
+    assert best > 40.0, best
+    # world-model heads are all training (finite, populated metrics)
+    for key in ("world_model_loss", "recon_loss", "reward_loss",
+                "kl_dyn", "critic_loss", "actor_loss"):
+        assert np.isfinite(result[key]), (key, result[key])
+    algo.stop()
+
+
+def test_dreamerv3_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rl import DreamerV3Config
+
+    def build():
+        return (DreamerV3Config()
+                .environment("CartPole-v1")
+                .env_runners(num_envs_per_env_runner=2,
+                             rollout_fragment_length=20)
+                .training(batch_size_B=4, batch_length_T=8,
+                          horizon_H=4, training_ratio=32,
+                          learning_starts=60, deter_size=16,
+                          units=16, stoch_classes=4, stoch_groups=4)
+                .debugging(seed=0)
+                .build_algo())
+
+    algo = build()
+    for _ in range(3):
+        algo.train()
+    path = algo.save_to_path(str(tmp_path / "dreamer"))
+    algo2 = build()
+    algo2.restore_from_path(path)
+    assert algo2.iteration == 3
+    np.testing.assert_allclose(
+        np.asarray(algo.params["actor"][0]["w"]),
+        np.asarray(algo2.params["actor"][0]["w"]))
+    # replay survives: no silent warmup restart from an empty buffer
+    assert algo2.buffer.size == algo.buffer.size > 0
+    algo2.train()  # resumes cleanly (optimizer + PRNG + buffer)
+    algo.stop()
+    algo2.stop()
+
+
 def test_turn_based_runner_shapes_and_credit():
     """TurnBasedEnvRunner (VERDICT r3 item 5): acting set varies per
     step, per-(env, agent) streams come out dense [T, S], and reward
