@@ -24,7 +24,7 @@ smoke test trains on CPU.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import numpy as np
 
@@ -174,13 +174,23 @@ class DreamerV3(Algorithm):
             }
 
         self.params = init_params(jax.random.PRNGKey(cfg.seed))
-        self.slow_critic = jax.tree.map(lambda x: x,
-                                        {"critic": self.params["critic"]})
+        # jax arrays are immutable: sharing the initial critic params
+        # with the slow critic is safe (updates replace, never mutate)
+        self.slow_critic = {"critic": self.params["critic"]}
         self.wm_opt = optax.chain(
             optax.clip_by_global_norm(1000.0), optax.adam(cfg.world_model_lr))
+        def _head_labels(params):
+            # label every leaf under "actor"/"critic" with its head
+            # name, so each trains at its own learning rate
+            return {k: jax.tree.map(lambda _, k=k: k, params[k])
+                    for k in params}
+
         self.ac_opt = optax.chain(
             optax.clip_by_global_norm(100.0),
-            optax.adam(cfg.actor_lr))
+            optax.multi_transform(
+                {"actor": optax.adam(cfg.actor_lr),
+                 "critic": optax.adam(cfg.critic_lr)},
+                _head_labels))
         wm_keys = ("embed", "gru_x", "gru_h", "gru_i", "prior", "post",
                    "decoder", "reward", "cont")
         self._wm_keys = wm_keys
@@ -502,6 +512,36 @@ class DreamerV3(Algorithm):
                      "imagined_return_mean")
             out.update({k: float(v) for k, v in zip(names, metrics)})
         return out
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy-ish rollouts with the recurrent policy (reference:
+        the evaluation-runner split; here a simple in-process loop —
+        DreamerV3 has one collection fleet, no separate eval
+        runners)."""
+        cfg = self.config
+        env = cfg.make_python_env()
+        returns = []
+        try:
+            for e in range(cfg.evaluation_duration):
+                self.reset_single_action_state()
+                obs, _ = env.reset(seed=40_000
+                                   + self.iteration * 100 + e)
+                total = 0.0
+                for _ in range(10_000):
+                    obs, rew, term, trunc, _ = env.step(
+                        self.compute_single_action(obs))
+                    total += rew
+                    if term or trunc:
+                        break
+                returns.append(total)
+        finally:
+            env.close()
+            self.reset_single_action_state()
+        return {
+            "episodes_this_eval": len(returns),
+            "episode_return_mean": float(np.mean(returns))
+            if returns else float("nan"),
+        }
 
     def reset_single_action_state(self) -> None:
         """Start a fresh episode for compute_single_action rollouts
