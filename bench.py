@@ -230,32 +230,40 @@ def inner():
             LlamaConfig.tiny(), 4, 64, 3, devices)))
         return
 
-    def model(ce_chunk):
-        # ~440M-param Llama: big enough that the MXU dominates, small
-        # enough for one 16 GB chip with fp32 Adam moments.
+    def model(dim, layers, heads, hidden, ce_chunk):
+        # Llama-architecture configs sized so the MXU dominates while
+        # params + fp32 Adam moments + remat activations fit one 16 GB
+        # chip. Wider models ran measurably higher MFU in the round-4
+        # on-chip sweep (PERF.md): dim 2560/L12 (1.1B) 0.4856,
+        # dim 2048/L12 (748M) 0.4751, dim 1536/L12 (440M) 0.4444.
         return LlamaConfig(
-            vocab_size=32000, dim=1536, n_layers=12, n_heads=12,
-            n_kv_heads=12, hidden_dim=4096, max_seq_len=2048,
+            vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
+            n_kv_heads=heads, hidden_dim=hidden, max_seq_len=2048,
             dtype=jnp.bfloat16, attention="flash", remat=True,
             ce_chunk_tokens=ce_chunk)
 
-    # Config sweep (largest batch first): chunked cross-entropy frees
-    # the [B, S, V] fp32 logits (~8 GB at batch 32), which round 1's
-    # batch-16 dense-CE config could not fit. Keep the best MFU inside
-    # the time budget; batch 16 dense is the round-1 known-good
-    # fallback. Sweep progress goes to stderr (stdout carries ONLY the
-    # final JSON line for the driver).
-    sweep = [(32, 4096), (24, 4096), (16, 4096), (16, 0)]
+    # Config sweep, best-measured first (each entry: model shape +
+    # batch). Chunked cross-entropy frees the [B, S, V] fp32 logits so
+    # the larger shapes fit. Keep the best MFU inside the time budget;
+    # the dim-1536 entries are the round-1/round-4 proven fallbacks.
+    # Sweep progress goes to stderr (stdout carries ONLY the final
+    # JSON line for the driver).
+    sweep = [
+        ((2560, 12, 20, 6912, 4096), 8),   # 1.1B, measured 0.4856
+        ((2048, 12, 16, 5632, 8192), 16),  # 748M, measured 0.4751
+        ((1536, 12, 12, 4096, 4096), 16),  # 440M, measured 0.4444
+        ((1536, 12, 12, 4096, 0), 16),     # round-1 known-good
+    ]
     if os.environ.get("RTPU_BENCH_KNOWN_GOOD_FIRST"):
-        # retry attempt after a timeout: lead with round-1's proven
+        # retry attempt after a timeout: lead with the longest-proven
         # config so a slow tunnel lands SOME number before the parent
         # watchdog fires
-        sweep = [(16, 0), (16, 4096), (24, 4096), (32, 4096)]
+        sweep = list(reversed(sweep))
     budget_s = float(os.environ.get("RTPU_BENCH_SWEEP_BUDGET_S", "420"))
     t_start = time.perf_counter()
     best = None
     last_config_s = 0.0
-    for batch, ce_chunk in sweep:
+    for shape, batch in sweep:
         # Pre-config budget check: never START a config that (judging
         # by the previous one) would run past the budget — finishing
         # mid-config under the parent's SIGKILL loses best-so-far.
@@ -266,17 +274,17 @@ def inner():
             break
         t_cfg = time.perf_counter()
         try:
-            result = _bench_config(model(ce_chunk), batch, 2048, 5,
+            result = _bench_config(model(*shape), batch, 2048, 5,
                                    devices)
         except Exception as e:  # noqa: BLE001 — OOM and friends
             sys.stderr.write(
-                f"[bench] config batch={batch} ce_chunk={ce_chunk} "
+                f"[bench] config shape={shape} batch={batch} "
                 f"failed: {str(e)[:300]}\n")
             last_config_s = time.perf_counter() - t_cfg
             continue
         last_config_s = time.perf_counter() - t_cfg
         sys.stderr.write(
-            f"[bench] batch={batch} ce_chunk={ce_chunk} "
+            f"[bench] shape={shape} batch={batch} "
             f"mfu={result['mfu']}\n")
         if best is None or result["mfu"] > best["mfu"]:
             best = result

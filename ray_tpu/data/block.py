@@ -59,6 +59,47 @@ def stacked_tensor_column(arr: np.ndarray) -> pa.Array:
     return tensor_column(list(arr), dtype=arr.dtype, ndim=arr.ndim - 1)
 
 
+def _tensor_column_to_numpy(col) -> Optional[np.ndarray]:
+    """Nested-list (tensor) column -> stacked [N, ...] ndarray with the
+    original numeric dtype, or None if the column isn't tensor-shaped
+    (not nested, ragged rows, nulls, or non-numeric values).
+
+    Fast path: when every list level has uniform offsets (uniform
+    shapes, no nulls), reshape the flat values buffer directly —
+    to_pylist() on an image column would build millions of Python
+    scalars on the iter_batches -> device-feed path."""
+    typ = col.type
+    depth = 0
+    while pa.types.is_list(typ) or pa.types.is_large_list(typ):
+        typ = typ.value_type
+        depth += 1
+    if depth < 2:  # rank-0/1 columns: the plain path handles them
+        return None
+    try:
+        dtype = np.dtype(typ.to_pandas_dtype())
+    except (NotImplementedError, TypeError):
+        return None
+    if not (np.issubdtype(dtype, np.number) or dtype == np.bool_):
+        return None
+    arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+    shape = [len(arr)]
+    level = arr
+    for _ in range(depth):
+        if level.null_count:
+            return None  # nulls: fall through to the generic path
+        offsets = level.offsets.to_numpy()
+        widths = np.diff(offsets)
+        if len(widths) != len(level) or len(widths) == 0 or \
+                not (widths == widths[0]).all():
+            return None  # ragged (or offsets not aligned to this slice)
+        shape.append(int(widths[0]))
+        level = level.flatten()
+    if level.null_count:  # nulls among the scalar values
+        return None
+    values = level.to_numpy(zero_copy_only=False)
+    return values.reshape(shape).astype(dtype, copy=False)
+
+
 def _normalize_rows(rows: Iterable[Any]) -> List[Dict[str, Any]]:
     out = []
     for r in rows:
@@ -87,7 +128,21 @@ class BlockAccessor:
         for r in rows:
             for k in cols:
                 cols[k].append(r.get(k))
-        return pa.table({k: pa.array(v) for k, v in cols.items()})
+
+        def _col(vals: list) -> pa.Array:
+            # ndarray-valued rows (images, token arrays, …) become
+            # typed nested-list columns; plain pa.array() raises on
+            # anything multi-dimensional. Rows may disagree on dtype
+            # (int rows mixed with float rows) — promote instead of
+            # letting arrow truncate to the first row's type.
+            if vals and all(isinstance(v, np.ndarray) and v.ndim >= 1
+                            for v in vals):
+                dtype = np.result_type(*[v.dtype for v in vals])
+                return tensor_column(vals, dtype=dtype,
+                                     ndim=vals[0].ndim)
+            return pa.array(vals)
+
+        return pa.table({k: _col(v) for k, v in cols.items()})
 
     @staticmethod
     def from_batch(batch: Batch) -> Block:
@@ -149,6 +204,10 @@ class BlockAccessor:
         out = {}
         for name in cols:
             col = self._table.column(name)
+            tensor = _tensor_column_to_numpy(col)
+            if tensor is not None:
+                out[name] = tensor
+                continue
             try:
                 arr = col.to_numpy(zero_copy_only=False)
             except (pa.ArrowInvalid, pa.ArrowNotImplementedError):

@@ -8,3 +8,17 @@ from ray_tpu.models.llama import (
     llama_sharding_rules,
 )
 from ray_tpu.models.mlp import MLPConfig, mlp_forward, mlp_init
+from ray_tpu.models.vit import (
+    CLIPConfig,
+    CLIPTextConfig,
+    ViTConfig,
+    clip_encode_image,
+    clip_encode_text,
+    clip_init,
+    clip_loss,
+    clip_sharding_rules,
+    vit_forward,
+    vit_init,
+    vit_loss,
+    vit_sharding_rules,
+)

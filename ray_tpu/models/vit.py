@@ -1,0 +1,430 @@
+"""Vision Transformer + CLIP dual-encoder, functional JAX.
+
+The vision model family for the Data→Train streaming path (the
+reference exercises this shape as release workloads — CLIP/SD-XL
+pretrain over Ray Data + Train, release/release_tests.yaml — with the
+model code living outside the repo; here the family is in-tree,
+TPU-first, like models/llama.py).
+
+Design mirrors the llama module so everything downstream (sharding
+rules, trainers, bench harnesses) composes identically:
+
+- Layers stacked on a leading axis and iterated with `lax.scan`; one
+  compiled block regardless of depth, `jax.checkpoint` per block when
+  `remat` is set.
+- Patchify is a reshape+transpose to [B, n_patches, patch_dim] followed
+  by one large [tokens, features] matmul — no conv needed, the MXU sees
+  the same GEMM either way and XLA fuses the layout shuffle.
+- Attention pluggable: "flash" (ray_tpu/ops/attention.py — uses the
+  Pallas kernel when seq/head_dim fit its 128-tiling, otherwise it
+  transparently falls back to the fused-jnp reference path; the stock
+  ViT-B/L and CLIP-text presets have head_dim 64, so they take the
+  fallback today) or "reference" (jnp), per config.
+- Sharding external: `vit_sharding_rules(mode)` / CLIP reuses the same
+  rule shapes (ddp/fsdp/tp/fsdp_tp) over its parameter tree.
+
+CLIP pairs the ViT image tower with a small causal text transformer
+(pre-LN, learned positions) and trains with the symmetric InfoNCE loss
+over in-batch negatives; `clip_loss` is jit/pjit-friendly (batch
+sharded on the data axis — the logits matrix [B, B] is tiny relative
+to the towers).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.ops.attention import _attention_reference, flash_attention
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    n_classes: int = 0       # >0 adds a classifier head on the pooled rep
+    pool: str = "mean"       # mean | cls
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"  # flash | reference
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.patch_size * self.patch_size
+
+    @property
+    def seq_len(self) -> int:
+        return self.n_patches + (1 if self.pool == "cls" else 0)
+
+    # --- presets -------------------------------------------------------
+    @staticmethod
+    def base(**kw) -> "ViTConfig":
+        return ViTConfig(**kw)  # ViT-B/16 defaults above
+
+    @staticmethod
+    def large(**kw) -> "ViTConfig":
+        defaults = dict(dim=1024, n_layers=24, n_heads=16,
+                        hidden_dim=4096)
+        defaults.update(kw)
+        return ViTConfig(**defaults)
+
+    @staticmethod
+    def tiny(**kw) -> "ViTConfig":
+        """Test-scale config that runs on the 8-device CPU mesh."""
+        defaults = dict(image_size=16, patch_size=4, dim=32, n_layers=2,
+                        n_heads=4, hidden_dim=64, dtype=jnp.float32,
+                        attention="reference", remat=False)
+        defaults.update(kw)
+        return ViTConfig(**defaults)
+
+    def num_params(self) -> int:
+        per_layer = (4 * self.dim * self.dim          # wq wk wv wo
+                     + 2 * self.dim * self.hidden_dim  # w1 w2
+                     + 4 * self.dim)                   # 2 LN scale+bias
+        n = (self.patch_dim * self.dim + self.dim      # patch embed + b
+             + self.seq_len * self.dim                 # pos embed
+             + self.n_layers * per_layer
+             + 2 * self.dim)                           # final LN
+        if self.pool == "cls":
+            n += self.dim
+        if self.n_classes:
+            n += self.dim * self.n_classes + self.n_classes
+        return n
+
+
+def layer_norm(x, scale, bias, eps: float):
+    """Standard LayerNorm in fp32, cast back to the input dtype (ViT
+    uses LN, not RMSNorm — keeping the family faithful)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def patchify(images, config: ViTConfig):
+    """[B, H, W, C] -> [B, n_patches, patch_dim] by pure reshapes."""
+    c = config
+    b, h, w, ch = images.shape
+    p = c.patch_size
+    x = images.reshape(b, h // p, p, w // p, p, ch)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, Hp, Wp, p, p, C]
+    return x.reshape(b, (h // p) * (w // p), p * p * ch)
+
+
+def _encoder_layers_init(keys, L: int, D: int, H: int, dtype):
+    """The stacked pre-LN transformer layer tree shared by the ViT and
+    CLIP-text towers (identical structure; only the attention mask and
+    the surrounding embeddings differ)."""
+    def init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "ln1_scale": jnp.ones((L, D), dtype),
+        "ln1_bias": jnp.zeros((L, D), dtype),
+        "wq": init(keys[0], (L, D, D), D),
+        "wk": init(keys[1], (L, D, D), D),
+        "wv": init(keys[2], (L, D, D), D),
+        "wo": init(keys[3], (L, D, D), D),
+        "ln2_scale": jnp.ones((L, D), dtype),
+        "ln2_bias": jnp.zeros((L, D), dtype),
+        "w1": init(keys[4], (L, D, H), D),
+        "w2": init(keys[5], (L, H, D), H),
+    }
+
+
+def _encoder_block(layer, x, *, n_heads: int, norm_eps: float,
+                   attention: str, causal: bool):
+    """One pre-LN block: LN → MHA → residual → LN → GELU MLP → residual.
+    `attention="flash"` uses the Pallas kernel when the shape fits its
+    tiling (ops/attention.py falls back to the fused-jnp reference path
+    otherwise — e.g. head_dim 64 ViT/CLIP presets)."""
+    b, s, d = x.shape
+    hd = d // n_heads
+    h = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"], norm_eps)
+    q = (h @ layer["wq"]).reshape(b, s, n_heads, hd)
+    k = (h @ layer["wk"]).reshape(b, s, n_heads, hd)
+    v = (h @ layer["wv"]).reshape(b, s, n_heads, hd)
+    if attention == "flash":
+        attn = flash_attention(q, k, v, causal=causal)
+    else:
+        attn = _attention_reference(q, k, v, causal)
+    x = x + attn.reshape(b, s, d).astype(x.dtype) @ layer["wo"]
+    h = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"], norm_eps)
+    y = jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    return x + y
+
+
+def _encoder_scan(layers, x, *, n_heads: int, norm_eps: float,
+                  attention: str, causal: bool, remat: bool):
+    block = functools.partial(_encoder_block, n_heads=n_heads,
+                              norm_eps=norm_eps, attention=attention,
+                              causal=causal)
+    if remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(layer, x), None
+
+    x, _ = jax.lax.scan(scan_body, x, layers)
+    return x
+
+
+def vit_init(rng, config: ViTConfig) -> Dict[str, Any]:
+    """Initialize the parameter pytree (layers stacked on axis 0)."""
+    c = config
+    keys = jax.random.split(rng, 8)
+    D = c.dim
+
+    def init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, dtype=jnp.float32)
+                * (fan_in ** -0.5)).astype(c.dtype)
+
+    params = {
+        "patch_embed": init(keys[0], (c.patch_dim, D), c.patch_dim),
+        "patch_bias": jnp.zeros((D,), c.dtype),
+        "pos_embed": (jax.random.normal(keys[1], (c.seq_len, D),
+                                        dtype=jnp.float32)
+                      * 0.02).astype(c.dtype),
+        "layers": _encoder_layers_init(keys[2:], c.n_layers, D,
+                                       c.hidden_dim, c.dtype),
+        "final_ln_scale": jnp.ones((D,), c.dtype),
+        "final_ln_bias": jnp.zeros((D,), c.dtype),
+    }
+    if c.pool == "cls":
+        params["cls_token"] = jnp.zeros((D,), c.dtype)
+    if c.n_classes:
+        params["head_w"] = init(jax.random.fold_in(rng, 99),
+                                (D, c.n_classes), D)
+        params["head_b"] = jnp.zeros((c.n_classes,), c.dtype)
+    return params
+
+
+def vit_forward(params, images, config: ViTConfig,
+                return_pooled: bool = False):
+    """images: [B, H, W, C] float -> logits [B, n_classes] (if a head
+    is configured) else the pooled representation [B, dim].
+    ``return_pooled`` forces the pooled rep even with a head (CLIP
+    tower usage)."""
+    c = config
+    x = patchify(images.astype(c.dtype), c) @ params["patch_embed"]
+    x = x + params["patch_bias"]
+    if c.pool == "cls":
+        cls = jnp.broadcast_to(params["cls_token"],
+                               (x.shape[0], 1, c.dim))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"]
+    x = _encoder_scan(params["layers"], x, n_heads=c.n_heads,
+                      norm_eps=c.norm_eps, attention=c.attention,
+                      causal=False, remat=c.remat)
+    x = layer_norm(x, params["final_ln_scale"], params["final_ln_bias"],
+                   c.norm_eps)
+    pooled = x[:, 0] if c.pool == "cls" else jnp.mean(x, axis=1)
+    if c.n_classes and not return_pooled:
+        return (pooled @ params["head_w"] + params["head_b"]
+                ).astype(jnp.float32)
+    return pooled
+
+
+def vit_loss(params, images, labels, config: ViTConfig):
+    """Softmax cross-entropy for supervised classification."""
+    logits = vit_forward(params, images, config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None],
+                                         axis=-1))
+
+
+# mode -> (layer in-projection, layer out-projection, embedding) specs;
+# shared by vit_sharding_rules and clip_sharding_rules so the two stay
+# in lockstep (layer specs have a leading None for the stacked axis).
+_MODE_SPECS = {
+    "fsdp": (P(None, "fsdp", None), P(None, None, "fsdp"),
+             P("fsdp", None)),
+    "tp": (P(None, None, "model"), P(None, "model", None),
+           P(None, "model")),
+    "fsdp_tp": (P(None, "fsdp", "model"), P(None, "model", "fsdp"),
+                P("fsdp", "model")),
+}
+
+
+def _mode_specs(mode: str):
+    if mode not in _MODE_SPECS:
+        raise ValueError(f"unknown sharding mode {mode}")
+    return _MODE_SPECS[mode]
+
+
+def vit_sharding_rules(mode: str = "fsdp") -> ShardingRules:
+    """ddp | fsdp | tp | fsdp_tp over the stacked-layer tree (leading
+    axis = layers, like llama_sharding_rules)."""
+    if mode == "ddp":
+        return ShardingRules(rules=[(r".*", P())])
+    spec_in, spec_out, embed = _mode_specs(mode)
+    return ShardingRules(rules=[
+        (r"patch_embed", embed),
+        (r"layers/(wq|wk|wv|w1)", spec_in),
+        (r"layers/(wo|w2)", spec_out),
+        (r"head_w", P(*embed[:1], None) if len(embed) else P()),
+        (r".*", P()),
+    ])
+
+
+# ---------------------------------------------------------------------------
+# CLIP dual-encoder
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    max_seq_len: int = 77
+    dim: int = 512
+    n_layers: int = 12
+    n_heads: int = 8
+    hidden_dim: int = 2048
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def tiny(**kw) -> "CLIPTextConfig":
+        defaults = dict(vocab_size=128, max_seq_len=16, dim=32,
+                        n_layers=2, n_heads=4, hidden_dim=64,
+                        dtype=jnp.float32, attention="reference",
+                        remat=False)
+        defaults.update(kw)
+        return CLIPTextConfig(**defaults)
+
+
+@dataclass(frozen=True)
+class CLIPConfig:
+    vision: ViTConfig = ViTConfig()
+    text: CLIPTextConfig = CLIPTextConfig()
+    embed_dim: int = 512
+    # learnable temperature, stored as log for positivity
+    logit_scale_init: float = 2.6592  # log(1/0.07), the CLIP paper value
+
+    @staticmethod
+    def tiny(**kw) -> "CLIPConfig":
+        defaults = dict(vision=ViTConfig.tiny(), text=CLIPTextConfig.tiny(),
+                        embed_dim=16)
+        defaults.update(kw)
+        return CLIPConfig(**defaults)
+
+
+def _text_init(rng, c: CLIPTextConfig) -> Dict[str, Any]:
+    keys = jax.random.split(rng, 8)
+    D = c.dim
+    return {
+        "embedding": (jax.random.normal(
+            keys[0], (c.vocab_size, D), dtype=jnp.float32) * 0.02
+            ).astype(c.dtype),
+        "pos_embed": (jax.random.normal(
+            keys[1], (c.max_seq_len, D), dtype=jnp.float32) * 0.01
+            ).astype(c.dtype),
+        "layers": _encoder_layers_init(keys[2:], c.n_layers, D,
+                                       c.hidden_dim, c.dtype),
+        "final_ln_scale": jnp.ones((D,), c.dtype),
+        "final_ln_bias": jnp.zeros((D,), c.dtype),
+    }
+
+
+def _text_forward(params, tokens, c: CLIPTextConfig):
+    """Causal text tower -> per-sequence rep at the final position
+    (callers place EOS last / pad left, the CLIP convention of pooling
+    at the EOS token)."""
+    s = tokens.shape[1]
+    x = params["embedding"][tokens].astype(c.dtype)
+    x = x + params["pos_embed"][:s]
+    x = _encoder_scan(params["layers"], x, n_heads=c.n_heads,
+                      norm_eps=c.norm_eps, attention=c.attention,
+                      causal=True, remat=c.remat)
+    x = layer_norm(x, params["final_ln_scale"], params["final_ln_bias"],
+                   c.norm_eps)
+    return x[:, -1]
+
+
+def clip_init(rng, config: CLIPConfig) -> Dict[str, Any]:
+    c = config
+    k_v, k_t, k_pv, k_pt = jax.random.split(rng, 4)
+    return {
+        "vision": vit_init(k_v, c.vision),
+        "text": _text_init(k_t, c.text),
+        "proj_v": (jax.random.normal(k_pv, (c.vision.dim, c.embed_dim),
+                                     dtype=jnp.float32)
+                   * (c.vision.dim ** -0.5)).astype(c.vision.dtype),
+        "proj_t": (jax.random.normal(k_pt, (c.text.dim, c.embed_dim),
+                                     dtype=jnp.float32)
+                   * (c.text.dim ** -0.5)).astype(c.text.dtype),
+        "logit_scale": jnp.asarray(c.logit_scale_init, jnp.float32),
+    }
+
+
+def clip_encode_image(params, images, config: CLIPConfig):
+    rep = vit_forward(params["vision"], images, config.vision,
+                      return_pooled=True)
+    emb = (rep @ params["proj_v"]).astype(jnp.float32)
+    return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+
+
+def clip_encode_text(params, tokens, config: CLIPConfig):
+    rep = _text_forward(params["text"], tokens, config.text)
+    emb = (rep @ params["proj_t"]).astype(jnp.float32)
+    return emb / jnp.linalg.norm(emb, axis=-1, keepdims=True)
+
+
+def clip_loss(params, images, tokens, config: CLIPConfig):
+    """Symmetric InfoNCE over in-batch negatives (the CLIP objective).
+    Under pjit with batch sharded on `data`, the two [B, embed] towers
+    compute locally and XLA all-gathers only the tiny embedding
+    matrices for the [B, B] logits."""
+    img = clip_encode_image(params, images, config)
+    txt = clip_encode_text(params, tokens, config)
+    scale = jnp.exp(jnp.clip(params["logit_scale"], -5.0, 4.6052))
+    logits = scale * (img @ txt.T)  # [B, B]
+    labels = jnp.arange(logits.shape[0])
+    li = -jnp.mean(jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), labels[:, None], 1))
+    lt = -jnp.mean(jnp.take_along_axis(
+        jax.nn.log_softmax(logits.T, axis=-1), labels[:, None], 1))
+    return 0.5 * (li + lt)
+
+
+def clip_sharding_rules(mode: str = "fsdp") -> ShardingRules:
+    """One rule set over the combined {vision, text, proj_*} tree —
+    the tower rules are path-prefixed copies of vit_sharding_rules."""
+    if mode == "ddp":
+        return ShardingRules(rules=[(r".*", P())])
+    in_s, out_s, emb = _mode_specs(mode)
+    return ShardingRules(rules=[
+        (r"(vision|text)/layers/(wq|wk|wv|w1)", in_s),
+        (r"(vision|text)/layers/(wo|w2)", out_s),
+        (r"vision/patch_embed", emb),
+        (r"text/embedding", emb),
+        (r"proj_(v|t)", emb),
+        (r".*", P()),
+    ])
