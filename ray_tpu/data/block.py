@@ -60,9 +60,11 @@ def stacked_tensor_column(arr: np.ndarray) -> pa.Array:
 
 
 def _tensor_column_to_numpy(col) -> Optional[np.ndarray]:
-    """Nested-list (tensor) column -> stacked [N, ...] ndarray with the
+    """List-typed (tensor) column -> stacked [N, ...] ndarray with the
     original numeric dtype, or None if the column isn't tensor-shaped
-    (not nested, ragged rows, nulls, or non-numeric values).
+    (not a list column, ragged rows, nulls, or non-numeric values).
+    Rank-1 rows (token ids) come back [N, width]; higher ranks
+    [N, d1, d2, ...].
 
     Fast path: when every list level has uniform offsets (uniform
     shapes, no nulls), reshape the flat values buffer directly —
@@ -73,7 +75,7 @@ def _tensor_column_to_numpy(col) -> Optional[np.ndarray]:
     while pa.types.is_list(typ) or pa.types.is_large_list(typ):
         typ = typ.value_type
         depth += 1
-    if depth < 2:  # rank-0/1 columns: the plain path handles them
+    if depth < 1:  # scalar columns: the plain path handles them
         return None
     try:
         dtype = np.dtype(typ.to_pandas_dtype())

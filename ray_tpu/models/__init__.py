@@ -7,6 +7,14 @@ from ray_tpu.models.llama import (
     llama_loss,
     llama_sharding_rules,
 )
+from ray_tpu.models.dit import (
+    DiTConfig,
+    dit_forward,
+    dit_init,
+    dit_loss,
+    dit_sample,
+    dit_sharding_rules,
+)
 from ray_tpu.models.mlp import MLPConfig, mlp_forward, mlp_init
 from ray_tpu.models.vit import (
     CLIPConfig,

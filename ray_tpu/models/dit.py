@@ -1,0 +1,305 @@
+"""DiT — diffusion transformer (adaLN-Zero), functional JAX.
+
+The diffusion model family for the Data→Train pretrain path (the
+reference runs SD-XL-class diffusion pretrain as release workloads over
+Ray Data + Train, release/release_tests.yaml, with the model code
+outside the repo; here the family is in-tree, TPU-first). Architecture
+follows the published DiT recipe (Peebles & Xie, arXiv 2212.09748):
+patchified inputs, transformer blocks whose LayerNorms are modulated by
+a conditioning vector (timestep + optional class label), zero-init
+modulation ("adaLN-Zero") so every block starts as the identity.
+
+TPU-first choices, matching models/llama.py and models/vit.py:
+- stacked layers + `lax.scan` (one compiled block), optional
+  `jax.checkpoint` per block;
+- all matmuls [tokens, features] × [features, out], bf16 with fp32
+  accumulation; the conditioning modulation is a [B, 6D] vector — tiny
+  next to the token matmuls, so XLA fuses it into the block;
+- attention via ops/attention.py (Pallas flash when shapes fit its
+  128-tiling, fused-jnp fallback otherwise — DiT presets have
+  head_dim 64/72 so they take the fallback today);
+- sharding external: `dit_sharding_rules(mode)` with the same
+  ddp/fsdp/tp/fsdp_tp modes as the other families.
+
+Training uses continuous-time epsilon prediction with the cosine
+schedule: x_t = cos(πt/2)·x0 + sin(πt/2)·ε, model predicts ε, MSE loss.
+`dit_sample` is a DDIM loop under `lax.fori_loop` (static step count —
+jit-friendly).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.models.vit import _mode_specs, fan_in_init, layer_norm
+from ray_tpu.ops.attention import _attention_reference, flash_attention
+from ray_tpu.parallel.sharding import ShardingRules
+
+
+@dataclass(frozen=True)
+class DiTConfig:
+    input_size: int = 32        # latent (or image) height = width
+    patch_size: int = 2
+    channels: int = 4           # 4 = VAE latent space, 3 = pixels
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    hidden_dim: int = 3072
+    n_classes: int = 0          # >0 = class-conditional (+ null class)
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    attention: str = "flash"    # flash | reference
+    remat: bool = True
+    time_freq_dim: int = 256    # sinusoidal timestep feature width
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def n_patches(self) -> int:
+        return (self.input_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.channels * self.patch_size * self.patch_size
+
+    # --- presets -------------------------------------------------------
+    @staticmethod
+    def b_2(**kw) -> "DiTConfig":
+        return DiTConfig(**kw)  # DiT-B/2 defaults above
+
+    @staticmethod
+    def xl_2(**kw) -> "DiTConfig":
+        defaults = dict(dim=1152, n_layers=28, n_heads=16,
+                        hidden_dim=4608)
+        defaults.update(kw)
+        return DiTConfig(**defaults)
+
+    @staticmethod
+    def tiny(**kw) -> "DiTConfig":
+        """Test-scale config that runs on the 8-device CPU mesh."""
+        defaults = dict(input_size=8, patch_size=2, channels=3, dim=32,
+                        n_layers=2, n_heads=4, hidden_dim=64,
+                        time_freq_dim=16, dtype=jnp.float32,
+                        attention="reference", remat=False)
+        defaults.update(kw)
+        return DiTConfig(**defaults)
+
+
+def _patchify(x, c: DiTConfig):
+    b, h, w, ch = x.shape
+    p = c.patch_size
+    x = x.reshape(b, h // p, p, w // p, p, ch)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, (h // p) * (w // p), p * p * ch)
+
+
+def _unpatchify(tokens, c: DiTConfig):
+    b = tokens.shape[0]
+    hp = c.input_size // c.patch_size
+    p = c.patch_size
+    x = tokens.reshape(b, hp, hp, p, p, c.channels)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, c.input_size, c.input_size, c.channels)
+
+
+def timestep_embedding(t, freq_dim: int):
+    """Sinusoidal features of continuous t in [0, 1] — [B, freq_dim]."""
+    half = freq_dim // 2
+    freqs = jnp.exp(-math.log(10000.0)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :] * 1000.0
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def dit_init(rng, config: DiTConfig) -> Dict[str, Any]:
+    """Parameter pytree (layers stacked on axis 0; modulation
+    projections zero-init per adaLN-Zero so blocks start as identity)."""
+    c = config
+    keys = jax.random.split(rng, 12)
+    D, H, L = c.dim, c.hidden_dim, c.n_layers
+
+    def init(key, shape, fan_in):
+        return fan_in_init(key, shape, fan_in, c.dtype)
+
+    params = {
+        "patch_embed": init(keys[0], (c.patch_dim, D), c.patch_dim),
+        "patch_bias": jnp.zeros((D,), c.dtype),
+        "pos_embed": (jax.random.normal(keys[1], (c.n_patches, D),
+                                        dtype=jnp.float32)
+                      * 0.02).astype(c.dtype),
+        "time_w1": init(keys[2], (c.time_freq_dim, D), c.time_freq_dim),
+        "time_b1": jnp.zeros((D,), c.dtype),
+        "time_w2": init(keys[3], (D, D), D),
+        "time_b2": jnp.zeros((D,), c.dtype),
+        "layers": {
+            "wq": init(keys[4], (L, D, D), D),
+            "wk": init(keys[5], (L, D, D), D),
+            "wv": init(keys[6], (L, D, D), D),
+            "wo": init(keys[7], (L, D, D), D),
+            "w1": init(keys[8], (L, D, H), D),
+            "w2": init(keys[9], (L, H, D), H),
+            # adaLN-Zero: 6 modulation vectors per block, zero-init
+            "mod_w": jnp.zeros((L, D, 6 * D), c.dtype),
+            "mod_b": jnp.zeros((L, 6 * D), c.dtype),
+        },
+        # final layer: adaLN (shift, scale) + zero-init output proj
+        "final_mod_w": jnp.zeros((D, 2 * D), c.dtype),
+        "final_mod_b": jnp.zeros((2 * D,), c.dtype),
+        "final_w": jnp.zeros((D, c.patch_dim), c.dtype),
+        "final_b": jnp.zeros((c.patch_dim,), c.dtype),
+    }
+    if c.n_classes:
+        # +1 slot: the "null" class for classifier-free guidance
+        params["label_embed"] = (jax.random.normal(
+            keys[10], (c.n_classes + 1, D), dtype=jnp.float32)
+            * 0.02).astype(c.dtype)
+    return params
+
+
+def _ada_ln(x, shift, scale, eps: float):
+    """Parameter-free LN modulated by per-sample (shift, scale)."""
+    ones = jnp.ones((x.shape[-1],), jnp.float32)
+    zeros = jnp.zeros((x.shape[-1],), jnp.float32)
+    h = layer_norm(x, ones, zeros, eps)
+    return h * (1.0 + scale[:, None, :]) + shift[:, None, :]
+
+
+def _dit_block(layer, x, cond, config: DiTConfig):
+    c = config
+    b, s, d = x.shape
+    mod = (cond @ layer["mod_w"] + layer["mod_b"]).astype(x.dtype)
+    (sh1, sc1, g1, sh2, sc2, g2) = jnp.split(mod, 6, axis=-1)
+
+    h = _ada_ln(x, sh1, sc1, c.norm_eps).astype(x.dtype)
+    q = (h @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
+    k = (h @ layer["wk"]).reshape(b, s, c.n_heads, c.head_dim)
+    v = (h @ layer["wv"]).reshape(b, s, c.n_heads, c.head_dim)
+    if c.attention == "flash":
+        attn = flash_attention(q, k, v, causal=False)
+    else:
+        attn = _attention_reference(q, k, v, False)
+    attn = attn.reshape(b, s, d).astype(x.dtype) @ layer["wo"]
+    x = x + g1[:, None, :] * attn
+
+    h = _ada_ln(x, sh2, sc2, c.norm_eps).astype(x.dtype)
+    y = jax.nn.gelu(h @ layer["w1"]) @ layer["w2"]
+    return x + g2[:, None, :] * y
+
+
+def dit_forward(params, x_t, t, config: DiTConfig, labels=None):
+    """x_t: [B, H, W, C] noised input, t: [B] in [0, 1],
+    labels: [B] int (n_classes = null/unconditional slot) → predicted
+    noise ε̂ [B, H, W, C]."""
+    c = config
+    x = _patchify(x_t.astype(c.dtype), c) @ params["patch_embed"]
+    x = x + params["patch_bias"] + params["pos_embed"]
+
+    temb = timestep_embedding(t, c.time_freq_dim).astype(c.dtype)
+    cond = jax.nn.silu(temb @ params["time_w1"] + params["time_b1"])
+    cond = cond @ params["time_w2"] + params["time_b2"]
+    if c.n_classes:
+        lab = (jnp.full((x.shape[0],), c.n_classes, jnp.int32)
+               if labels is None else labels)
+        cond = cond + params["label_embed"][lab]
+
+    block = functools.partial(_dit_block, config=c)
+    if c.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, layer):
+        return block(layer, x, cond), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+
+    fmod = (cond @ params["final_mod_w"] + params["final_mod_b"]
+            ).astype(x.dtype)
+    shift, scale = jnp.split(fmod, 2, axis=-1)
+    x = _ada_ln(x, shift, scale, c.norm_eps).astype(x.dtype)
+    out = x @ params["final_w"] + params["final_b"]
+    return _unpatchify(out.astype(jnp.float32), c)
+
+
+def cosine_alpha_sigma(t):
+    """Continuous cosine schedule: ᾱ, σ with ᾱ² + σ² = 1."""
+    angle = 0.5 * jnp.pi * t
+    return jnp.cos(angle), jnp.sin(angle)
+
+
+def dit_loss(params, rng, x0, config: DiTConfig, labels=None,
+             label_drop: float = 0.1):
+    """Continuous-time ε-prediction MSE. With labels, drops them to the
+    null class with prob `label_drop` (classifier-free guidance
+    training)."""
+    c = config
+    k_t, k_eps, k_drop = jax.random.split(rng, 3)
+    b = x0.shape[0]
+    t = jax.random.uniform(k_t, (b,), minval=1e-4, maxval=1.0 - 1e-4)
+    eps = jax.random.normal(k_eps, x0.shape, dtype=jnp.float32)
+    alpha, sigma = cosine_alpha_sigma(t)
+    x_t = (alpha[:, None, None, None] * x0.astype(jnp.float32)
+           + sigma[:, None, None, None] * eps)
+    if c.n_classes and labels is not None and label_drop > 0:
+        drop = jax.random.uniform(k_drop, (b,)) < label_drop
+        labels = jnp.where(drop, c.n_classes, labels)
+    pred = dit_forward(params, x_t, t, c, labels)
+    return jnp.mean((pred - eps) ** 2)
+
+
+def dit_sample(params, rng, config: DiTConfig, n: int, steps: int = 50,
+               labels=None, guidance_scale: float = 0.0,
+               x0_clip: float = 4.0):
+    """Deterministic DDIM sampler (static `steps`, lax.fori_loop).
+    guidance_scale > 0 runs conditional+null passes per step
+    (classifier-free guidance). ``x0_clip`` bounds the denoised
+    estimate each step ("clip denoised"): near t=1 the x0 form divides
+    by ᾱ→0, so an unclipped estimate amplifies model error by orders
+    of magnitude; the start time is also backed off to t=0.99 where
+    ᾱ≈0.016 (both standard diffusion-sampler stabilizations)."""
+    c = config
+    shape = (n, c.input_size, c.input_size, c.channels)
+    x = jax.random.normal(rng, shape, dtype=jnp.float32)
+    ts = jnp.linspace(0.99, 1e-4, steps + 1)
+
+    def eps_hat(x, t_vec):
+        if guidance_scale > 0 and c.n_classes and labels is not None:
+            e_c = dit_forward(params, x, t_vec, c, labels)
+            e_u = dit_forward(params, x, t_vec, c, None)
+            return e_u + (1.0 + guidance_scale) * (e_c - e_u)
+        return dit_forward(params, x, t_vec, c, labels)
+
+    def body(i, x):
+        t_now, t_next = ts[i], ts[i + 1]
+        t_vec = jnp.full((n,), t_now)
+        a_now, s_now = cosine_alpha_sigma(t_now)
+        a_next, s_next = cosine_alpha_sigma(t_next)
+        e = eps_hat(x, t_vec)
+        x0 = jnp.clip((x - s_now * e) / a_now, -x0_clip, x0_clip)
+        # re-derive ε from the clipped x0 so the update stays consistent
+        e = (x - a_now * x0) / jnp.maximum(s_now, 1e-6)
+        return a_next * x0 + s_next * e
+
+    return jax.lax.fori_loop(0, steps, body, x)
+
+
+def dit_sharding_rules(mode: str = "fsdp") -> ShardingRules:
+    """ddp | fsdp | tp | fsdp_tp — same mode table as the ViT/CLIP
+    family (leading axis = layers on the block weights)."""
+    if mode == "ddp":
+        return ShardingRules(rules=[(r".*", P())])
+    spec_in, spec_out, embed = _mode_specs(mode)
+    return ShardingRules(rules=[
+        (r"patch_embed", embed),
+        (r"layers/(wq|wk|wv|w1)", spec_in),
+        (r"layers/(wo|w2)", spec_out),
+        (r"layers/mod_w", spec_in),
+        (r".*", P()),
+    ])

@@ -132,13 +132,19 @@ def patchify(images, config: ViTConfig):
     return x.reshape(b, (h // p) * (w // p), p * p * ch)
 
 
+def fan_in_init(key, shape, fan_in, dtype):
+    """Normal(0, 1/fan_in) init in fp32, cast to the model dtype —
+    the one initializer every family in this package uses."""
+    return (jax.random.normal(key, shape, dtype=jnp.float32)
+            * (fan_in ** -0.5)).astype(dtype)
+
+
 def _encoder_layers_init(keys, L: int, D: int, H: int, dtype):
     """The stacked pre-LN transformer layer tree shared by the ViT and
     CLIP-text towers (identical structure; only the attention mask and
     the surrounding embeddings differ)."""
     def init(key, shape, fan_in):
-        return (jax.random.normal(key, shape, dtype=jnp.float32)
-                * (fan_in ** -0.5)).astype(dtype)
+        return fan_in_init(key, shape, fan_in, dtype)
 
     return {
         "ln1_scale": jnp.ones((L, D), dtype),
@@ -198,8 +204,7 @@ def vit_init(rng, config: ViTConfig) -> Dict[str, Any]:
     D = c.dim
 
     def init(key, shape, fan_in):
-        return (jax.random.normal(key, shape, dtype=jnp.float32)
-                * (fan_in ** -0.5)).astype(c.dtype)
+        return fan_in_init(key, shape, fan_in, c.dtype)
 
     params = {
         "patch_embed": init(keys[0], (c.patch_dim, D), c.patch_dim),
@@ -284,7 +289,9 @@ def vit_sharding_rules(mode: str = "fsdp") -> ShardingRules:
         (r"patch_embed", embed),
         (r"layers/(wq|wk|wv|w1)", spec_in),
         (r"layers/(wo|w2)", spec_out),
-        (r"head_w", P(*embed[:1], None) if len(embed) else P()),
+        # classifier head [D, n_classes]: same layout as the embedding
+        # (column-parallel under tp, like llama's lm_head)
+        (r"head_w", embed),
         (r".*", P()),
     ])
 

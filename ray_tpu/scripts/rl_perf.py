@@ -1,0 +1,63 @@
+"""RL throughput microbenchmark: PPO env-steps/sec.
+
+BASELINE.json names "RLlib PPO env-steps/sec" as a headline metric
+(reference analog: rllib release tests measure sampler+learner
+throughput on CartPole-class envs). This drives the in-tree PPO
+algorithm end-to-end — jitted env runners sampling a vectorized
+CartPole, device-resident learner update — and reports env-steps/sec
+over a fixed number of iterations.
+
+Run: python -m ray_tpu.scripts.rl_perf [--iters N] [--batch B]
+Prints one JSON line, PERF.md records the numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--num-envs", type=int, default=32)
+    ap.add_argument("--rollout", type=int, default=128)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    from ray_tpu.rl import PPO, PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0,
+                           num_envs_per_env_runner=args.num_envs,
+                           rollout_fragment_length=args.rollout)
+              .training(train_batch_size=args.num_envs * args.rollout,
+                        minibatch_size=args.num_envs * args.rollout // 4,
+                        num_epochs=2))
+    algo = PPO(config)
+    try:
+        for _ in range(args.warmup):  # compile + first-iter costs
+            algo.train()
+        start_steps = algo.train()["num_env_steps_sampled_lifetime"]
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            result = algo.train()
+        dt = time.perf_counter() - t0
+        steps = result["num_env_steps_sampled_lifetime"] - start_steps
+        print(json.dumps({
+            "metric": "ppo_env_steps_per_sec",
+            "value": round(steps / dt, 1),
+            "unit": "env-steps/s",
+            "iters": args.iters,
+            "num_envs": args.num_envs,
+            "rollout": args.rollout,
+            "mean_return": result.get("episode_return_mean"),
+        }))
+    finally:
+        algo.stop()
+
+
+if __name__ == "__main__":
+    main()
