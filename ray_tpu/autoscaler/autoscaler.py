@@ -115,6 +115,16 @@ class StandardAutoscaler:
             launched[nt.name] = launched.get(nt.name, 0) + 1
             counts[nt.name] = counts.get(nt.name, 0) + 1
 
+        # 3c. explicit requests (sdk.request_resources): scale the
+        # cluster so TOTAL capacity fits the requested shapes. Like
+        # gang demand, exempt from the upscaling_speed cap (reference:
+        # autoscaler/sdk request_resources bypasses normal rate
+        # limits and persists until replaced).
+        for nt in self._plan_requested_resources(counts, {**live, **created}):
+            self.provider.create_node(nt)
+            launched[nt.name] = launched.get(nt.name, 0) + 1
+            counts[nt.name] = counts.get(nt.name, 0) + 1
+
         # 4. min_workers floor
         for nt in self.config.node_types:
             while counts.get(nt.name, 0) < nt.min_workers:
@@ -187,6 +197,69 @@ class StandardAutoscaler:
                 launches.append(nt)
         return launches
 
+    def _plan_requested_resources(self, counts: Dict[str, int],
+                                  live: Dict[str, str],
+                                  exclude_hosts=frozenset()
+                                  ) -> List[NodeTypeConfig]:
+        """Launch units so total cluster capacity covers the shapes
+        posted via `sdk.request_resources` (in-use capacity counts —
+        these are target-size semantics, not load demand).
+
+        The capacity pool is built without double counting: each live
+        provider unit contributes its configured per-host resources
+        (whether or not its hosts have registered yet), and runtime
+        nodes NOT attributed to any provider unit (the head, manual
+        joins) contribute their ledger totals.
+        """
+        from ray_tpu.autoscaler.sdk import get_requested_resources
+        shapes = get_requested_resources(self.runtime.gcs)
+        if not shapes:
+            return []
+        provider_hosts = set()
+        pool: List[Dict[str, float]] = []
+        for pid, type_name in live.items():
+            nt = self.config.node_type(type_name)
+            if nt is None:
+                continue
+            provider_hosts.update(self.provider.runtime_node_ids(pid))
+            for _ in range(max(nt.count, 1)):
+                pool.append(dict(nt.resources))
+        for node_id, res in self.runtime.scheduler.snapshot().items():
+            if node_id not in provider_hosts and \
+                    node_id not in exclude_hosts:
+                pool.append(dict(res.total))
+
+        virtual: List[tuple] = []  # (remaining dict, node_type)
+        for need in sorted(shapes, key=lambda d: -sum(d.values())):
+            placed = False
+            for avail in pool:
+                if _fits(avail, need):
+                    _take(avail, need)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for avail, _ in virtual:
+                if _fits(avail, need):
+                    _take(avail, need)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for nt in self.config.node_types:
+                planned = (counts.get(nt.name, 0)
+                           + sum(1 for _, t in virtual
+                                 if t.name == nt.name))
+                if planned >= nt.max_workers:
+                    continue
+                if _fits(dict(nt.resources), need):
+                    avail = dict(nt.resources)
+                    _take(avail, need)
+                    virtual.append((avail, nt))
+                    break
+            # unplaceable on any type: permanently infeasible, skip
+        return [nt for _, nt in virtual]
+
     def _terminate_idle(self, counts: Dict[str, int]) -> None:
         now = time.monotonic()
         snapshot = self.runtime.scheduler.snapshot()
@@ -219,6 +292,19 @@ class StandardAutoscaler:
             floor = nt.min_workers if nt else 0
             if (now - first_idle >= self.config.idle_timeout_s
                     and counts.get(type_name, 0) > floor):
+                # An outstanding sdk.request_resources target holds
+                # capacity against scaledown: if culling this node
+                # would reopen a shortfall, the next round would just
+                # relaunch it — a permanent create/terminate thrash of
+                # real cloud nodes (reference: request_resources pins
+                # cluster size until cleared).
+                counts_minus = dict(counts)
+                counts_minus[type_name] = counts_minus.get(type_name, 1) - 1
+                live_minus = {p: t for p, t in live.items() if p != pid}
+                if self._plan_requested_resources(
+                        counts_minus, live_minus,
+                        exclude_hosts=frozenset(node_ids)):
+                    continue  # load-bearing for the requested target
                 self.provider.terminate_node(pid)
                 self._idle_since.pop(pid, None)
                 counts[type_name] = counts.get(type_name, 0) - 1

@@ -1,0 +1,58 @@
+"""Programmatic autoscaler requests.
+
+reference: python/ray/autoscaler/sdk/sdk.py `request_resources` —
+command the autoscaler to scale to accommodate a resource shape
+immediately, bypassing load-based demand and the upscaling-speed cap.
+The request persists (and is idempotently replaced by each call) until
+cleared with an empty request.
+
+Mechanism: the request is stored in the GCS KV
+(`autoscaler/requested_resources`), so it survives autoscaler restarts
+alongside the rest of the control-plane state; `StandardAutoscaler`
+reads it each round and launches whatever the *total* (not free)
+capacity of live+planned nodes cannot cover.
+"""
+import pickle
+from typing import Dict, List, Optional
+
+from ray_tpu.core import runtime as runtime_mod
+
+KV_NAMESPACE = "autoscaler"
+KV_KEY = b"requested_resources"
+
+__all__ = ["request_resources"]
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None
+                      ) -> None:
+    """Ask the autoscaler to scale the cluster up to fit the request.
+
+    Args:
+        num_cpus: shorthand for ``[{"CPU": 1}] * num_cpus``.
+        bundles: resource-shape list the cluster's TOTAL capacity must
+            accommodate (in-use capacity counts toward satisfaction,
+            matching the reference's target-size semantics).
+
+    Calling with neither argument clears any outstanding request.
+    """
+    shapes: List[Dict[str, float]] = []
+    if num_cpus:
+        shapes += [{"CPU": 1.0}] * int(num_cpus)
+    for b in bundles or []:
+        if not isinstance(b, dict) or not b:
+            raise ValueError(f"bundle must be a non-empty dict, got {b!r}")
+        shapes.append({k: float(v) for k, v in b.items()})
+    rt = runtime_mod.get_runtime()
+    rt.gcs.kv.put(KV_KEY, pickle.dumps(shapes), namespace=KV_NAMESPACE)
+
+
+def get_requested_resources(gcs) -> List[Dict[str, float]]:
+    """Read the outstanding request (autoscaler side)."""
+    raw = gcs.kv.get(KV_KEY, namespace=KV_NAMESPACE)
+    if not raw:
+        return []
+    try:
+        return pickle.loads(raw)
+    except Exception:  # corrupt request must not wedge reconciliation
+        return []

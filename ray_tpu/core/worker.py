@@ -465,9 +465,20 @@ def _pack_stream_reply(reply: dict, count: int) -> dict:
 
 def _pack_error(spec: TaskSpec, reply: dict) -> dict:
     tb = traceback.format_exc()
-    err = TaskError(spec.name or spec.function_id, tb, None)
+    # Ship the original exception as .cause when it pickles — callers
+    # can catch-and-unwrap domain errors (util.queue Full/Empty, user
+    # exception types) instead of string-matching the traceback
+    # (reference: RayTaskError.cause, exceptions.py).
+    import sys
+    exc = sys.exc_info()[1]
+    try:
+        err = TaskError(spec.name or spec.function_id, tb, exc)
+        blob = serialization.dumps(err)
+    except Exception:
+        err = TaskError(spec.name or spec.function_id, tb, None)
+        blob = serialization.dumps(err)
     reply["results"] = []
-    reply["error"] = serialization.dumps(err)
+    reply["error"] = blob
     reply["error_str"] = tb
     return reply
 

@@ -212,6 +212,109 @@ class Dataset:
             out.append(MaterializedDataset(plan, self._context, refs, metas))
         return out
 
+    def split_at_indices(self, indices: List[int]) -> List["MaterializedDataset"]:
+        """Split by global row indices into len(indices)+1 datasets
+        (reference: dataset.py split_at_indices)."""
+        if any(i < 0 for i in indices):
+            raise ValueError("indices must be nonnegative")
+        if sorted(indices) != list(indices):
+            raise ValueError("indices must be sorted in increasing order")
+        mat = self.materialize()
+        # Boundaries come from block METADATA — whole blocks keep their
+        # existing refs and only boundary-straddling blocks are sliced,
+        # remotely, so no block payload ever crosses the driver.
+        slicer = ray_tpu.remote(
+            lambda block, s, e: BlockAccessor(block).slice(s, e))
+        bounds = list(indices) + [float("inf")]
+        splits: List[List[Any]] = [[] for _ in bounds]  # (ref, meta)
+        si = 0
+        row_pos = 0
+        for ref, meta in zip(mat._refs, mat._metas):
+            n = meta.num_rows
+            off = 0
+            while off < n:
+                take = int(min(n - off, bounds[si] - row_pos))
+                if take <= 0:
+                    si += 1
+                    continue
+                if take == n and off == 0:
+                    splits[si].append((ref, meta))
+                else:
+                    pm = BlockMetadata(
+                        num_rows=take,
+                        size_bytes=max(1, meta.size_bytes * take
+                                       // max(n, 1)),
+                        schema=meta.schema)
+                    splits[si].append(
+                        (slicer.remote(ref, off, off + take), pm))
+                off += take
+                row_pos += take
+                if si < len(indices) and row_pos >= bounds[si]:
+                    si += 1
+        out = []
+        for pieces in splits:
+            refs = [r for r, _ in pieces]
+            metas = [m for _, m in pieces]
+            plan = L.LogicalPlan(L.InputData(refs, metas))
+            out.append(MaterializedDataset(plan, self._context, refs, metas))
+        return out
+
+    def split_proportionately(self, proportions: List[float]
+                              ) -> List["MaterializedDataset"]:
+        """Split by fractions; the remainder becomes the final split
+        (reference: dataset.py split_proportionately)."""
+        if not proportions:
+            raise ValueError("proportions must not be empty")
+        if any(p <= 0 for p in proportions):
+            raise ValueError("proportions must be positive")
+        if sum(proportions) >= 1.0:
+            raise ValueError("sum of proportions must be < 1")
+        mat = self.materialize()
+        n = mat.count()
+        indices = []
+        cum = 0.0
+        for p in proportions:
+            cum += p
+            indices.append(min(n, int(n * cum)))
+        return mat.split_at_indices(indices)
+
+    def train_test_split(self, test_size: Union[int, float], *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> List["MaterializedDataset"]:
+        """Return [train, test] (reference: dataset.py
+        train_test_split)."""
+        ds: Dataset = self
+        if shuffle:
+            ds = ds.random_shuffle(seed=seed)
+        if isinstance(test_size, float):
+            if not 0 < test_size < 1:
+                raise ValueError("test_size fraction must be in (0, 1)")
+            return ds.split_proportionately([1.0 - test_size])
+        if test_size <= 0:
+            raise ValueError("test_size must be positive")
+        # Materialize once: count comes from block metadata, and
+        # split_at_indices on the materialized set is a replay, not a
+        # second pipeline execution.
+        mat = ds.materialize()
+        n = mat.count()
+        if test_size >= n:
+            raise ValueError(f"test_size {test_size} >= dataset size {n}")
+        return mat.split_at_indices([n - test_size])
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "MaterializedDataset":
+        """Shuffle whole blocks without touching rows — the cheap
+        decorrelator before windowed iteration (reference: dataset.py
+        randomize_block_order)."""
+        mat = self.materialize()
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(mat._refs))
+        refs = [mat._refs[i] for i in order]
+        metas = [mat._metas[i] for i in order]
+        plan = L.LogicalPlan(L.InputData(refs, metas))
+        return MaterializedDataset(plan, self._context, refs, metas)
+
     def take(self, n: int = 20) -> List[dict]:
         out: List[dict] = []
         for row in self.iter_rows():
@@ -294,6 +397,65 @@ class Dataset:
     def to_arrow_refs(self) -> List[Any]:
         return [b.block_ref for b in self._execute_stream()]
 
+    def to_pandas_refs(self) -> List[Any]:
+        """One ObjectRef per block, each resolving to a DataFrame —
+        conversion runs remotely (reference: dataset.py
+        to_pandas_refs)."""
+        to_df = ray_tpu.remote(
+            lambda block: BlockAccessor(block).to_pandas())
+        return [to_df.remote(b.block_ref) for b in self._execute_stream()]
+
+    def to_numpy_refs(self, *, column: Optional[str] = None) -> List[Any]:
+        """One ObjectRef per block resolving to an ndarray (``column``
+        given) or a {column: ndarray} dict (reference: dataset.py
+        to_numpy_refs)."""
+        def conv(block, col=column):
+            arrs = BlockAccessor(block).to_numpy([col] if col else None)
+            return arrs[col] if col else arrs
+        to_np = ray_tpu.remote(conv)
+        return [to_np.remote(b.block_ref) for b in self._execute_stream()]
+
+    def input_files(self) -> List[str]:
+        """Source file paths feeding this dataset's Read leaves
+        (reference: dataset.py input_files)."""
+        files: List[str] = []
+        seen = set()
+        stack = [self._plan.dag]
+        while stack:
+            op = stack.pop()
+            for f in getattr(op, "input_files", []) or []:
+                if f not in seen:
+                    seen.add(f)
+                    files.append(f)
+            stack.extend(getattr(op, "inputs", []) or [])
+        return files
+
+    def names(self) -> Optional[List[str]]:
+        """Column names (reference: dataset.py names)."""
+        s = self.schema()
+        return list(s.names) if s is not None else None
+
+    def types(self) -> Optional[List[Any]]:
+        """Arrow column types, parallel to names() (reference:
+        dataset.py schema().types)."""
+        s = self.schema()
+        return list(s.types) if s is not None else None
+
+    # -- naming + plan introspection -----------------------------------
+    @property
+    def name(self) -> Optional[str]:
+        return getattr(self, "_name", None)
+
+    def set_name(self, name: Optional[str]) -> None:
+        self._name = name
+
+    def explain(self) -> str:
+        """Logical plan rendering; printed by the reference's
+        Dataset.explain, returned here for asserting in tests."""
+        text = self._plan.explain()
+        print(text)
+        return text
+
     def write_parquet(self, path: str) -> None:
         self._write(path, "parquet")
 
@@ -303,9 +465,24 @@ class Dataset:
     def write_json(self, path: str) -> None:
         self._write(path, "json")
 
-    def _write(self, path: str, fmt: str) -> None:
+    def write_numpy(self, path: str, *,
+                    column: Optional[str] = None) -> None:
+        """One .npy per block from ``column`` (default: the first
+        column) (reference: dataset.py write_numpy)."""
+        self._write(path, "numpy", column=column)
+
+    def write_images(self, path: str, column: str = "image",
+                     file_format: str = "png") -> None:
+        """One image file per row (reference: dataset.py
+        write_images)."""
+        if file_format not in ("png", "jpeg", "jpg", "bmp"):
+            raise ValueError(f"unsupported image format {file_format!r}")
+        self._write(path, file_format, column=column)
+
+    def _write(self, path: str, fmt: str, column=None) -> None:
         from ray_tpu.data.datasource import _FileWrite
-        ds = self._with_op(L.Write(self._plan.dag, _FileWrite(path, fmt),
+        ds = self._with_op(L.Write(self._plan.dag,
+                                   _FileWrite(path, fmt, column),
                                    name=f"Write[{fmt}]"))
         for _ in ds._execute_stream():
             pass

@@ -149,22 +149,50 @@ def expand_paths(paths) -> List[str]:
     return out
 
 
-def make_file_read_tasks(paths, fmt: str, columns=None) -> List[Callable]:
-    return [_FileRead(p, fmt, columns) for p in expand_paths(paths)]
+def make_file_read_tasks(paths, fmt: str, columns=None, *,
+                         expanded: bool = False) -> List[Callable]:
+    """``expanded=True`` means the caller already ran expand_paths —
+    re-expanding would re-glob literal filenames containing [?*
+    metacharacters and silently drop them."""
+    files = paths if expanded else expand_paths(paths)
+    return [_FileRead(p, fmt, columns) for p in files]
 
 
 class _FileWrite:
     """Writes one block to `<dir>/<uuid>-<i>.<ext>` (reference:
     datasource/parquet_datasink.py naming)."""
 
-    def __init__(self, path: str, fmt: str):
+    def __init__(self, path: str, fmt: str, column=None):
         self.path, self.fmt = path, fmt
+        self.column = column
 
     def __call__(self, block: pa.Table) -> str:
         import uuid
         os.makedirs(self.path, exist_ok=True)
-        name = f"{uuid.uuid4().hex[:12]}.{self.fmt}"
+        ext = {"numpy": "npy"}.get(self.fmt, self.fmt)
+        name = f"{uuid.uuid4().hex[:12]}.{ext}"
         full = os.path.join(self.path, name)
+        if self.fmt == "numpy":
+            acc = BlockAccessor(block)
+            arrs = acc.to_numpy([self.column] if self.column else None)
+            arr = arrs[self.column] if self.column \
+                else next(iter(arrs.values()))
+            np.save(full, arr)
+            return full
+        if self.fmt in ("png", "jpeg", "jpg", "bmp"):
+            # one image file per row (reference: write_images one file
+            # per image, image_datasink.py)
+            from PIL import Image
+            acc = BlockAccessor(block)
+            col = self.column or "image"
+            arrs = acc.to_numpy([col])[col]
+            stem = uuid.uuid4().hex[:12]
+            last = full
+            for i, arr in enumerate(arrs):
+                last = os.path.join(self.path,
+                                    f"{stem}-{i:06d}.{self.fmt}")
+                Image.fromarray(np.asarray(arr)).save(last)
+            return last
         if self.fmt == "parquet":
             import pyarrow.parquet as pq
             pq.write_table(block, full)

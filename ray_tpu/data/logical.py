@@ -26,9 +26,12 @@ class Read(LogicalOp):
     """Leaf: produces blocks from a datasource's read tasks."""
 
     def __init__(self, read_tasks: List[Callable[[], Any]],
-                 name: str = "Read"):
+                 name: str = "Read", input_files=None):
         super().__init__(name, [])
         self.read_tasks = read_tasks
+        # source file paths, when the datasource is file-backed
+        # (reference: dataset.py input_files from block metadata)
+        self.input_files = list(input_files or [])
 
 
 class InputData(LogicalOp):

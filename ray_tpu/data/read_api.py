@@ -86,35 +86,42 @@ def from_numpy(arrays) -> Dataset:
 
 
 def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    return _ds(L.Read(make_file_read_tasks(paths, "parquet", columns),
-                      name="ReadParquet"))
+    from ray_tpu.data.datasource import expand_paths
+    files = expand_paths(paths)
+    return _ds(L.Read(make_file_read_tasks(files, "parquet", columns, expanded=True),
+                      name="ReadParquet", input_files=files))
 
 
 def read_csv(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    return _ds(L.Read(make_file_read_tasks(paths, "csv", columns),
-                      name="ReadCSV"))
+    from ray_tpu.data.datasource import expand_paths
+    files = expand_paths(paths)
+    return _ds(L.Read(make_file_read_tasks(files, "csv", columns, expanded=True),
+                      name="ReadCSV", input_files=files))
 
 
 def read_json(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    return _ds(L.Read(make_file_read_tasks(paths, "json", columns),
-                      name="ReadJSON"))
+    from ray_tpu.data.datasource import expand_paths
+    files = expand_paths(paths)
+    return _ds(L.Read(make_file_read_tasks(files, "json", columns, expanded=True),
+                      name="ReadJSON", input_files=files))
 
 
 def read_text(paths) -> Dataset:
     """One row per line, column "text" (reference: read_api.py
     read_text)."""
     from ray_tpu.data.datasource import _TextRead, expand_paths
-    return _ds(L.Read([_TextRead(p) for p in expand_paths(paths)],
-                      name="ReadText"))
+    files = expand_paths(paths)
+    return _ds(L.Read([_TextRead(p) for p in files],
+                      name="ReadText", input_files=files))
 
 
 def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
     """One row per file, column "bytes" (reference: read_api.py
     read_binary_files)."""
     from ray_tpu.data.datasource import _BinaryRead, expand_paths
-    return _ds(L.Read([_BinaryRead(p, include_paths)
-                       for p in expand_paths(paths)],
-                      name="ReadBinary"))
+    files = expand_paths(paths)
+    return _ds(L.Read([_BinaryRead(p, include_paths) for p in files],
+                      name="ReadBinary", input_files=files))
 
 
 def read_images(paths, *, size=None, mode: Optional[str] = None,
@@ -123,9 +130,10 @@ def read_images(paths, *, size=None, mode: Optional[str] = None,
     (height, width) resize, ``mode`` a PIL mode like "RGB" (reference:
     read_api.py read_images / image_datasource.py)."""
     from ray_tpu.data.datasource import _ImageRead, expand_paths
+    files = expand_paths(paths)
     return _ds(L.Read([_ImageRead(p, size, mode, include_paths)
-                       for p in expand_paths(paths)],
-                      name="ReadImages"))
+                       for p in files],
+                      name="ReadImages", input_files=files))
 
 
 def read_numpy(paths) -> Dataset:

@@ -27,7 +27,21 @@ class TaskError(RayTpuError):
         super().__init__(f"task {function_name} failed:\n{traceback_str}")
 
     def __reduce__(self):
-        return (TaskError, (self.function_name, self.traceback_str, None))
+        # Keep .cause across the wire when it pickles (so callers can
+        # unwrap domain exceptions); degrade to None instead of failing
+        # the whole error delivery when it doesn't.
+        cause = self.cause
+        if cause is not None:
+            import pickle
+            try:
+                # Full round-trip, not just dumps: exceptions with
+                # custom __init__ signatures pickle fine but explode on
+                # LOAD (TypeError in the driver's reader thread would
+                # wedge error delivery and hang the caller's get()).
+                pickle.loads(pickle.dumps(cause))
+            except Exception:
+                cause = None
+        return (TaskError, (self.function_name, self.traceback_str, cause))
 
 
 class ActorError(RayTpuError):

@@ -89,6 +89,25 @@ class LlamaConfig:
         return LlamaConfig(**defaults)
 
     @staticmethod
+    def mixtral_8x7b(**kw) -> "LlamaConfig":
+        """Mixtral-8x7B shape: 8-expert top-2 SwiGLU MoE on a
+        Mistral-7B trunk (GQA 8 KV heads). Routed through
+        parallel/moe.py, expert-parallel over the "expert" axis."""
+        defaults = dict(vocab_size=32000, dim=4096, n_layers=32,
+                        n_heads=32, n_kv_heads=8, hidden_dim=14336,
+                        max_seq_len=32768, rope_theta=1e6,
+                        moe_experts=8, moe_top_k=2)
+        defaults.update(kw)
+        return LlamaConfig(**defaults)
+
+    @staticmethod
+    def tiny_moe(**kw) -> "LlamaConfig":
+        """Test-scale MoE config for the 8-device CPU mesh."""
+        defaults = dict(moe_experts=4, moe_top_k=2)
+        defaults.update(kw)
+        return LlamaConfig.tiny(**defaults)
+
+    @staticmethod
     def small_1b(**kw) -> "LlamaConfig":
         defaults = dict(vocab_size=32000, dim=2048, n_layers=16,
                         n_heads=16, n_kv_heads=16, hidden_dim=5504,

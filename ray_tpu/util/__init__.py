@@ -1,9 +1,17 @@
 """ray_tpu.util — orchestration + observability utilities
-(placement groups, state API, user metrics)."""
+(placement groups, scheduling strategies, actor pool, queue,
+multiprocessing shim, state API, user metrics)."""
 
 from ray_tpu.util import metrics, state
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     placement_group, remove_placement_group)
+from ray_tpu.util.queue import Queue
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy, NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy)
 
-__all__ = ["metrics", "placement_group", "remove_placement_group",
-           "state"]
+__all__ = ["ActorPool", "NodeAffinitySchedulingStrategy",
+           "NodeLabelSchedulingStrategy",
+           "PlacementGroupSchedulingStrategy", "Queue", "metrics",
+           "placement_group", "remove_placement_group", "state"]

@@ -1,0 +1,160 @@
+"""Fixed-pool work distribution over actor handles.
+
+reference: python/ray/util/actor_pool.py — same public API
+(`map`, `map_unordered`, `submit`, `get_next`, `get_next_unordered`,
+`has_next`, `has_free`, `pop_idle`, `push`); independent
+implementation over ray_tpu's wait/get primitives.
+"""
+from typing import Any, Callable, Iterator, List, Optional, TypeVar
+
+from ray_tpu import api
+from ray_tpu.core.object_ref import ObjectRef
+
+V = TypeVar("V")
+
+__all__ = ["ActorPool"]
+
+
+class ActorPool:
+    """Operate on a fixed pool of actors, keeping every actor busy.
+
+    ``fn`` receives ``(actor, value)`` and must return the ObjectRef of
+    the submitted call; the actor is considered busy until that ref
+    resolves.
+    """
+
+    def __init__(self, actors: list):
+        self._idle_actors: List[Any] = list(actors)
+        self._future_to_actor: dict = {}     # ref -> (index, actor)
+        self._index_to_future: dict = {}     # submit index -> ref
+        self._next_task_index = 0            # next index to hand out
+        self._next_return_index = 0          # next index get_next returns
+        self._pending_submits: list = []     # (fn, value) waiting for an actor
+
+    # -- bulk maps ----------------------------------------------------
+    def map(self, fn: Callable[[Any, V], ObjectRef],
+            values: List[V]) -> Iterator[Any]:
+        """Ordered iterator of fn results over values."""
+        # Defensive reset mirroring the reference: a half-consumed
+        # previous map must not leak its unreturned futures into ours.
+        self._reset_return_state()
+        for v in values:
+            self.submit(fn, v)
+
+        def result_iterator():
+            while self.has_next():
+                yield self.get_next()
+
+        return result_iterator()
+
+    def map_unordered(self, fn: Callable[[Any, V], ObjectRef],
+                      values: List[V]) -> Iterator[Any]:
+        """Completion-order iterator of fn results over values."""
+        self._reset_return_state()
+        for v in values:
+            self.submit(fn, v)
+
+        def result_iterator():
+            while self.has_next():
+                yield self.get_next_unordered()
+
+        return result_iterator()
+
+    def _reset_return_state(self) -> None:
+        # Drain (not just clear): actors still busy with an abandoned
+        # map's tasks must come back to the pool, or they leak and a
+        # 1-actor pool would silently yield zero results forever.
+        # (_return_actor may pump _pending_submits, so clear the maps
+        # before handing actors back.)
+        busy = [actor for _, actor in self._future_to_actor.values()]
+        self._future_to_actor.clear()
+        self._index_to_future.clear()
+        self._next_task_index = 0
+        self._next_return_index = 0
+        for actor in busy:
+            self._return_actor(actor)
+
+    # -- incremental submission ---------------------------------------
+    def submit(self, fn: Callable[[Any, V], ObjectRef], value: V) -> None:
+        """Run fn(actor, value) on an idle actor, or queue it."""
+        if self._idle_actors:
+            actor = self._idle_actors.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future)
+
+    def get_next(self, timeout: Optional[float] = None,
+                 ignore_if_timedout: bool = False) -> Any:
+        """Next result in submission order (blocks on that one task)."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        if self._next_return_index >= self._next_task_index:
+            raise ValueError("It is not allowed to call get_next() after "
+                             "get_next_unordered().")
+        future = self._index_to_future[self._next_return_index]
+        timeout_msg = "Timed out waiting for result"
+        raise_timeout_after_ignore = False
+        if timeout is not None:
+            done, _ = api.wait([future], timeout=timeout)
+            if not done:
+                if not ignore_if_timedout:
+                    raise TimeoutError(timeout_msg)
+                raise_timeout_after_ignore = True
+        # On an ignored timeout the task is skipped, not retained: drop
+        # its future, free the actor, and advance — otherwise the caller
+        # can never get past a hung task.
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(future)
+        self._return_actor(actor)
+        if raise_timeout_after_ignore:
+            raise TimeoutError(timeout_msg + ". The task has been "
+                               "ignored.")
+        return api.get(future)
+
+    def get_next_unordered(self, timeout: Optional[float] = None,
+                           ignore_if_timedout: bool = False) -> Any:
+        """Earliest-finished result regardless of submission order."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        done, _ = api.wait(list(self._future_to_actor), num_returns=1,
+                           timeout=timeout)
+        if done:
+            future = done[0]
+            i, actor = self._future_to_actor.pop(future)
+            self._return_actor(actor)
+            del self._index_to_future[i]
+            self._next_return_index = max(self._next_return_index, i + 1)
+            return api.get(future)
+        # unordered: no specific task to skip — nothing to ignore
+        raise TimeoutError("Timed out waiting for result")
+
+    def _return_actor(self, actor: Any) -> None:
+        self._idle_actors.append(actor)
+        while self._pending_submits and self._idle_actors:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    # -- pool membership ----------------------------------------------
+    def has_free(self) -> bool:
+        """True iff an actor is idle and nothing is queued."""
+        return bool(self._idle_actors) and not self._pending_submits
+
+    def pop_idle(self) -> Optional[Any]:
+        """Remove and return an idle actor (None if all busy)."""
+        if self.has_free():
+            return self._idle_actors.pop()
+        return None
+
+    def push(self, actor: Any) -> None:
+        """Add an actor to the pool."""
+        busy = {a for _, a in self._future_to_actor.values()}
+        if actor in self._idle_actors or actor in busy:
+            raise ValueError("Actor already belongs to current ActorPool")
+        self._return_actor(actor)

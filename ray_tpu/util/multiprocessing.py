@@ -1,0 +1,226 @@
+"""Drop-in ``multiprocessing.Pool`` over the cluster.
+
+reference: python/ray/util/multiprocessing/pool.py — same public
+surface (`Pool` with apply/apply_async/map/map_async/starmap/
+imap/imap_unordered/close/terminate/join, `AsyncResult`), built here
+as a thin layer over worker actors + `ActorPool` so ``initializer``
+runs once per worker exactly like a forked process pool.
+"""
+import itertools
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+from ray_tpu import api
+from ray_tpu.util.actor_pool import ActorPool
+
+__all__ = ["Pool", "AsyncResult", "TimeoutError"]
+
+TimeoutError = TimeoutError  # re-export for multiprocessing API parity
+
+
+class _PoolWorker:
+    """One pool slot; runs the initializer at construction like a
+    freshly forked worker process."""
+
+    def __init__(self, initializer=None, initargs=()):
+        if initializer is not None:
+            initializer(*initargs)
+
+    def run_batch(self, func: Callable, batch: List[Any], star: bool):
+        out = []
+        for item in batch:
+            out.append(func(*item) if star else func(item))
+        return out
+
+
+class AsyncResult:
+    """Handle for an in-flight map/apply (multiprocessing.AsyncResult
+    semantics: get/wait/ready/successful)."""
+
+    def __init__(self, refs: List[Any], single: bool = False):
+        self._refs = refs
+        self._single = single
+
+    def ready(self) -> bool:
+        done, _ = api.wait(list(self._refs),
+                           num_returns=len(self._refs), timeout=0)
+        return len(done) == len(self._refs)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        api.wait(list(self._refs), num_returns=len(self._refs),
+                 timeout=timeout)
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        done, not_done = api.wait(
+            list(self._refs), num_returns=len(self._refs),
+            timeout=timeout)
+        if not_done:
+            raise TimeoutError("Result not ready")
+        batches = api.get(list(self._refs))
+        flat = [x for b in batches for x in b]
+        return flat[0] if self._single else flat
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("Result is not ready")
+        try:
+            self.get()
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Actor-backed process-pool equivalent.
+
+    ``processes`` defaults to the cluster's total CPU count. Each
+    worker is an actor, so ``initializer(*initargs)`` runs once per
+    worker and module-level state persists across tasks on the same
+    worker — matching forked-pool semantics.
+    """
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: Tuple = (),
+                 maxtasksperchild: Optional[int] = None,
+                 actor_options: Optional[dict] = None):
+        if processes is None:
+            processes = max(1, int(api.cluster_resources().get("CPU", 1)))
+        if processes < 1:
+            raise ValueError("processes must be >= 1")
+        self._processes = processes
+        cls = api.remote(_PoolWorker)
+        if actor_options:
+            cls = cls.options(**actor_options)
+        self._actors = [cls.remote(initializer, tuple(initargs))
+                        for _ in range(processes)]
+        self._pool = ActorPool(self._actors)
+        self._closed = False
+
+    # -- helpers ------------------------------------------------------
+    def _check_running(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _chunk(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            # multiprocessing heuristic: ~4 waves across the pool
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], chunksize
+
+    def _submit_batches(self, func, batches, star) -> List[Any]:
+        # Round-robin over the actors directly (ordered refs, no
+        # pool-state consumption) so concurrent maps don't interleave.
+        refs = []
+        for actor, batch in zip(itertools.cycle(self._actors), batches):
+            refs.append(actor.run_batch.remote(func, batch, star))
+        return refs
+
+    # -- apply --------------------------------------------------------
+    def apply(self, func: Callable, args: Tuple = (), kwds: dict = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func: Callable, args: Tuple = (),
+                    kwds: dict = None, callback=None,
+                    error_callback=None) -> AsyncResult:
+        self._check_running()
+        kwds = kwds or {}
+        # run_batch passes the (placeholder) item as arg 1 — absorb it
+        call = (lambda _item, f=func, a=tuple(args), k=dict(kwds):
+                f(*a, **k))
+        refs = self._submit_batches(call, [[None]], star=False)
+        res = AsyncResult(refs, single=True)
+        _fire_callbacks(res, callback, error_callback)
+        return res
+
+    # -- map / starmap ------------------------------------------------
+    def map(self, func: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None, callback=None,
+                  error_callback=None) -> AsyncResult:
+        self._check_running()
+        batches, _ = self._chunk(iterable, chunksize)
+        res = AsyncResult(self._submit_batches(func, batches, star=False))
+        _fire_callbacks(res, callback, error_callback)
+        return res
+
+    def starmap(self, func: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func: Callable, iterable: Iterable,
+                      chunksize: Optional[int] = None, callback=None,
+                      error_callback=None) -> AsyncResult:
+        self._check_running()
+        batches, _ = self._chunk(iterable, chunksize)
+        res = AsyncResult(self._submit_batches(func, batches, star=True))
+        _fire_callbacks(res, callback, error_callback)
+        return res
+
+    # -- imap ---------------------------------------------------------
+    def imap(self, func: Callable, iterable: Iterable,
+             chunksize: int = 1):
+        """Ordered lazy iterator (results stream as chunks finish)."""
+        self._check_running()
+        batches, _ = self._chunk(iterable, chunksize)
+        refs = self._submit_batches(func, batches, star=False)
+        for ref in refs:
+            for item in api.get(ref):
+                yield item
+
+    def imap_unordered(self, func: Callable, iterable: Iterable,
+                       chunksize: int = 1):
+        """Completion-order lazy iterator."""
+        self._check_running()
+        batches, _ = self._chunk(iterable, chunksize)
+        pending = self._submit_batches(func, batches, star=False)
+        while pending:
+            done, pending = api.wait(pending, num_returns=1)
+            for item in api.get(done[0]):
+                yield item
+
+    # -- lifecycle ----------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for a in self._actors:
+            api.kill(a, no_restart=True)
+        self._actors = []
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        self._check_running()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()
+
+
+def _fire_callbacks(res: AsyncResult, callback, error_callback) -> None:
+    """Deliver multiprocessing-style callbacks from a background
+    thread once the result resolves (the reference fires these from
+    its dedicated result thread)."""
+    if callback is None and error_callback is None:
+        return
+
+    def waiter():
+        try:
+            value = res.get()
+        except Exception as e:  # noqa: BLE001 — goes to error_callback
+            if error_callback is not None:
+                error_callback(e)
+            return
+        if callback is not None:
+            callback(value)
+
+    import threading
+    threading.Thread(target=waiter, daemon=True).start()

@@ -1,0 +1,51 @@
+"""User-facing scheduling strategy classes.
+
+reference: python/ray/util/scheduling_strategies.py — the public names
+(`NodeAffinitySchedulingStrategy`, `NodeLabelSchedulingStrategy`,
+`PlacementGroupSchedulingStrategy`) users pass as
+``scheduling_strategy=...`` in ``.options()``. Here each is a thin
+constructor over the core `SchedulingStrategy` record that the
+scheduler already understands (`core/scheduler.py` NODE_AFFINITY /
+NODE_LABEL / PLACEMENT_GROUP branches).
+"""
+from typing import Dict, Union
+
+from ray_tpu.core.ids import NodeID
+from ray_tpu.core.task_spec import SchedulingStrategy
+from ray_tpu.util.placement_group import PlacementGroupSchedulingStrategy
+
+__all__ = [
+    "SchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "PlacementGroupSchedulingStrategy",
+]
+
+
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    """Pin a task/actor to one node (reference:
+    scheduling_strategies.py NodeAffinitySchedulingStrategy).
+
+    ``soft=True`` falls back to the default policy if the node is gone;
+    hard affinity fails the task if the node cannot host it.
+    """
+
+    def __init__(self, node_id: Union[str, NodeID], soft: bool = False):
+        if isinstance(node_id, str):
+            node_id = NodeID.from_hex(node_id)
+        super().__init__(kind="NODE_AFFINITY", node_id=node_id, soft=soft)
+
+
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    """Require exact-match node labels (reference:
+    scheduling_strategies.py NodeLabelSchedulingStrategy hard
+    requirements; soft/in-operator forms are not supported — stated
+    divergence: the scheduler's label branch is exact-match only).
+    """
+
+    def __init__(self, hard: Dict[str, str]):
+        if not isinstance(hard, dict) or not hard:
+            raise ValueError(
+                "NodeLabelSchedulingStrategy requires a non-empty dict "
+                "of {label: value} hard requirements")
+        super().__init__(kind="NODE_LABEL", labels=dict(hard))

@@ -1,0 +1,161 @@
+"""Dataset API breadth: splits, block-order shuffle, refs exports,
+write_numpy/write_images, input_files, names/types, explain
+(reference: python/ray/data/tests/test_split.py, test_numpy.py,
+test_image.py, test_consumption.py)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    rt = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield rt
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------- splits
+
+def test_split_at_indices():
+    parts = rd.range(10).split_at_indices([3, 7])
+    assert [p.count() for p in parts] == [3, 4, 3]
+    assert [r["id"] for r in parts[0].take_all()] == [0, 1, 2]
+    assert [r["id"] for r in parts[1].take_all()] == [3, 4, 5, 6]
+    assert [r["id"] for r in parts[2].take_all()] == [7, 8, 9]
+
+
+def test_split_at_indices_edges():
+    parts = rd.range(5).split_at_indices([0, 5])
+    assert [p.count() for p in parts] == [0, 5, 0]
+    with pytest.raises(ValueError):
+        rd.range(5).split_at_indices([3, 1])
+    with pytest.raises(ValueError):
+        rd.range(5).split_at_indices([-1])
+
+
+def test_split_proportionately():
+    parts = rd.range(10).split_proportionately([0.2, 0.3])
+    assert [p.count() for p in parts] == [2, 3, 5]
+    with pytest.raises(ValueError):
+        rd.range(10).split_proportionately([0.5, 0.6])
+    with pytest.raises(ValueError):
+        rd.range(10).split_proportionately([])
+
+
+def test_train_test_split_fraction_and_count():
+    train, test = rd.range(10).train_test_split(0.25)
+    assert train.count() == 7 and test.count() == 3
+    # int form: exact test rows off the tail
+    train, test = rd.range(10).train_test_split(4)
+    assert train.count() == 6 and test.count() == 4
+    assert [r["id"] for r in test.take_all()] == [6, 7, 8, 9]
+    # shuffled split keeps the partition sizes but mixes rows
+    train, test = rd.range(100).train_test_split(0.5, shuffle=True,
+                                                 seed=7)
+    assert train.count() == 50 and test.count() == 50
+    assert sorted(r["id"] for r in train.take_all()) != list(range(50))
+
+
+def test_randomize_block_order():
+    ds = rd.range(100, parallelism=10)
+    shuffled = ds.randomize_block_order(seed=3)
+    assert shuffled.count() == 100
+    # rows within blocks keep order; block order changes for some seed
+    ids = [r["id"] for r in shuffled.take_all()]
+    assert sorted(ids) == list(range(100))
+    assert ids != list(range(100))
+
+
+# -------------------------------------------------------- refs exports
+
+def test_to_pandas_refs():
+    refs = rd.range(20, parallelism=4).to_pandas_refs()
+    dfs = ray_tpu.get(refs)
+    assert sum(len(df) for df in dfs) == 20
+    assert all(list(df.columns) == ["id"] for df in dfs)
+
+
+def test_to_numpy_refs():
+    refs = rd.range(12, parallelism=3).to_numpy_refs(column="id")
+    arrs = ray_tpu.get(refs)
+    assert sorted(np.concatenate(arrs).tolist()) == list(range(12))
+    # dict form without a column
+    refs = rd.range(4, parallelism=1).to_numpy_refs()
+    (d,) = ray_tpu.get(refs)
+    assert set(d) == {"id"}
+
+
+# ------------------------------------------------- file sinks + sources
+
+def test_write_read_numpy(tmp_path):
+    path = str(tmp_path / "np_out")
+    rd.range_tensor(8, shape=(2, 2), parallelism=2).write_numpy(
+        path, column="data")
+    files = sorted(glob.glob(os.path.join(path, "*.npy")))
+    assert len(files) == 2
+    total = sum(np.load(f).shape[0] for f in files)
+    assert total == 8
+    assert np.load(files[0]).shape[1:] == (2, 2)
+
+
+def test_write_images_roundtrip(tmp_path):
+    path = str(tmp_path / "imgs")
+    imgs = np.random.randint(0, 255, size=(5, 8, 8, 3), dtype=np.uint8)
+    ds = rd.from_numpy(imgs)
+    ds.map_batches(lambda b: {"image": b["data"]},
+                   batch_format="numpy").write_images(path)
+    files = sorted(glob.glob(os.path.join(path, "*.png")))
+    assert len(files) == 5
+    back = rd.read_images(files).take_all()
+    assert len(back) == 5
+    first = np.asarray(back[0]["image"])
+    assert first.shape == (8, 8, 3)
+    # PNG is lossless: pixel payload must round-trip exactly. Row order
+    # across files is lexical (expand_paths sorts), but the write stem
+    # is random — compare as multisets of flattened images.
+    want = {imgs[i].tobytes() for i in range(5)}
+    got = {np.asarray(r["image"]).astype(np.uint8).tobytes()
+           for r in back}
+    assert got == want
+
+
+def test_input_files(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    for i in range(3):
+        pq.write_table(pa.table({"x": [i]}),
+                       str(tmp_path / f"part-{i}.parquet"))
+    ds = rd.read_parquet(str(tmp_path))
+    files = ds.input_files()
+    assert len(files) == 3
+    assert all(f.endswith(".parquet") for f in files)
+    # survives downstream transforms
+    assert len(ds.map(lambda r: r).input_files()) == 3
+    # non-file datasets report none
+    assert rd.range(3).input_files() == []
+
+
+# ----------------------------------------------------- schema + plan
+
+def test_names_types_and_name():
+    ds = rd.from_items([{"a": 1, "b": "x"}])
+    assert ds.names() == ["a", "b"]
+    types = ds.types()
+    assert len(types) == 2
+    assert ds.name is None
+    ds.set_name("my_ds")
+    assert ds.name == "my_ds"
+
+
+def test_explain_renders_plan(capsys):
+    ds = rd.range(10).map(lambda r: r).limit(5)
+    text = ds.explain()
+    out = capsys.readouterr().out
+    assert text in out
+    assert "Limit" in text or "limit" in text.lower()
