@@ -234,7 +234,7 @@ def inner():
         # Llama-architecture configs sized so the MXU dominates while
         # params + fp32 Adam moments + remat activations fit one 16 GB
         # chip. Wider models ran measurably higher MFU in the round-4
-        # on-chip sweep (PERF.md): dim 2560/L12 (1.1B) 0.4856,
+        # on-chip sweep (PERF.md): dim 2560/L12 (1.1B) 0.4896 at b10,
         # dim 2048/L12 (748M) 0.4751, dim 1536/L12 (440M) 0.4444.
         return LlamaConfig(
             vocab_size=32000, dim=dim, n_layers=layers, n_heads=heads,
@@ -249,6 +249,7 @@ def inner():
     # Sweep progress goes to stderr (stdout carries ONLY the final
     # JSON line for the driver).
     sweep = [
+        ((2560, 12, 20, 6912, 4096), 10),  # 1.1B, measured 0.4896
         ((2560, 12, 20, 6912, 4096), 8),   # 1.1B, measured 0.4856
         ((2048, 12, 16, 5632, 8192), 16),  # 748M, measured 0.4751
         ((1536, 12, 12, 4096, 4096), 16),  # 440M, measured 0.4444

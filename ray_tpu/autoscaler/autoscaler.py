@@ -308,6 +308,10 @@ class StandardAutoscaler:
                 self.provider.terminate_node(pid)
                 self._idle_since.pop(pid, None)
                 counts[type_name] = counts.get(type_name, 0) - 1
+                # Keep `live` truthful for later iterations' shortfall
+                # checks — a node culled above must not count as
+                # capacity when judging the next candidate.
+                live.pop(pid, None)
 
     # -- background loop ------------------------------------------------
     def start(self) -> None:

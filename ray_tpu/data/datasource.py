@@ -187,7 +187,7 @@ class _FileWrite:
             col = self.column or "image"
             arrs = acc.to_numpy([col])[col]
             stem = uuid.uuid4().hex[:12]
-            last = full
+            last = ""  # zero-row block: no file written, say so
             for i, arr in enumerate(arrs):
                 last = os.path.join(self.path,
                                     f"{stem}-{i:06d}.{self.fmt}")

@@ -63,10 +63,13 @@ class ActorPool:
     def _reset_return_state(self) -> None:
         # Drain (not just clear): actors still busy with an abandoned
         # map's tasks must come back to the pool, or they leak and a
-        # 1-actor pool would silently yield zero results forever.
-        # (_return_actor may pump _pending_submits, so clear the maps
-        # before handing actors back.)
+        # 1-actor pool would silently yield zero results forever. The
+        # abandoned map's not-yet-submitted values are dropped too —
+        # pumping them would splice stale results into the NEW map's
+        # output. Clear all state before handing actors back because
+        # _return_actor pumps _pending_submits.
         busy = [actor for _, actor in self._future_to_actor.values()]
+        self._pending_submits.clear()
         self._future_to_actor.clear()
         self._index_to_future.clear()
         self._next_task_index = 0

@@ -3,14 +3,14 @@
 reference: python/ray/util/multiprocessing/pool.py — same public
 surface (`Pool` with apply/apply_async/map/map_async/starmap/
 imap/imap_unordered/close/terminate/join, `AsyncResult`), built here
-as a thin layer over worker actors + `ActorPool` so ``initializer``
-runs once per worker exactly like a forked process pool.
+as a thin layer over worker actors (batches round-robin across them)
+so ``initializer`` runs once per worker exactly like a forked process
+pool.
 """
 import itertools
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
 from ray_tpu import api
-from ray_tpu.util.actor_pool import ActorPool
 
 __all__ = ["Pool", "AsyncResult", "TimeoutError"]
 
@@ -93,8 +93,8 @@ class Pool:
             cls = cls.options(**actor_options)
         self._actors = [cls.remote(initializer, tuple(initargs))
                         for _ in range(processes)]
-        self._pool = ActorPool(self._actors)
         self._closed = False
+        self._inflight: List[Any] = []  # refs join() must drain
 
     # -- helpers ------------------------------------------------------
     def _check_running(self):
@@ -111,10 +111,20 @@ class Pool:
 
     def _submit_batches(self, func, batches, star) -> List[Any]:
         # Round-robin over the actors directly (ordered refs, no
-        # pool-state consumption) so concurrent maps don't interleave.
+        # shared scheduling state) so concurrent maps don't interleave.
         refs = []
         for actor, batch in zip(itertools.cycle(self._actors), batches):
             refs.append(actor.run_batch.remote(func, batch, star))
+        # Track for join(); prune what has already finished so a
+        # long-lived pool doesn't pin every result it ever produced.
+        if self._inflight:
+            done, _ = api.wait(self._inflight,
+                               num_returns=len(self._inflight),
+                               timeout=0)
+            done_set = set(done)
+            self._inflight = [r for r in self._inflight
+                              if r not in done_set]
+        self._inflight.extend(refs)
         return refs
 
     # -- apply --------------------------------------------------------
@@ -192,10 +202,17 @@ class Pool:
         for a in self._actors:
             api.kill(a, no_restart=True)
         self._actors = []
+        self._inflight = []  # killed actors won't deliver these
 
     def join(self) -> None:
+        """Block until all submitted work has finished
+        (multiprocessing semantics: only legal after close/terminate).
+        """
         if not self._closed:
             raise ValueError("Pool is still running")
+        if self._inflight:
+            api.wait(self._inflight, num_returns=len(self._inflight))
+            self._inflight = []
 
     def __enter__(self) -> "Pool":
         self._check_running()

@@ -105,8 +105,12 @@ class Queue:
     def __init__(self, maxsize: int = 0,
                  actor_options: Optional[Dict] = None) -> None:
         actor_options = dict(actor_options or {})
-        # Blocking get + concurrent put need >=2 interleaved coroutines.
-        actor_options.setdefault("max_concurrency", 8)
+        # Effectively unlimited interleaving (reference: asyncio queue
+        # actor): every parked blocking put/get holds a concurrency
+        # slot for its whole blocked duration, so a small cap would
+        # deadlock once cap-many ops park — the drain call could never
+        # acquire a slot.
+        actor_options.setdefault("max_concurrency", 10_000)
         self.maxsize = maxsize
         self.actor = api.remote(_QueueActor) \
             .options(**actor_options).remote(maxsize)

@@ -77,6 +77,18 @@ def test_actor_pool_membership(ray_start_shared):
     assert out == [2, 4]
 
 
+def test_actor_pool_abandoned_map_does_not_pollute_next(ray_start_shared):
+    # Abandon a half-consumed map (1-actor pool, values still queued);
+    # the next map must return ONLY its own results and the busy actor
+    # must come back to the pool.
+    pool = ActorPool([_Doubler.remote()])
+    it = pool.map(lambda a, v: a.double.remote(v), [1, 2, 3])
+    assert next(it) == 2
+    out = list(pool.map(lambda a, v: a.double.remote(v), [10]))
+    assert out == [20]
+    assert pool.has_free()
+
+
 def test_actor_pool_queues_excess_submits(ray_start_shared):
     pool = ActorPool([_Doubler.remote()])
     for v in range(5):
@@ -149,6 +161,26 @@ def test_queue_blocking_put_unblocks_on_get(ray_start_shared):
     assert q.get() == "a"
     assert ray_tpu.get(ref, timeout=10) is True
     assert q.get(timeout=5) == "b"
+    q.shutdown()
+
+
+def test_queue_many_parked_puts_no_deadlock(ray_start_shared):
+    # 10 producers block on a full queue; the driver must still be
+    # able to drain (a small actor-concurrency cap would deadlock:
+    # every parked put holds a slot and get() could never run).
+    q = Queue(maxsize=1)
+    q.put("seed")
+
+    @ray_tpu.remote(num_cpus=0)
+    def producer(q, i):
+        q.put(i)
+        return i
+
+    refs = [producer.remote(q, i) for i in range(10)]
+    got = [q.get(timeout=30) for _ in range(11)]
+    assert got[0] == "seed"
+    assert sorted(got[1:]) == list(range(10))
+    assert sorted(ray_tpu.get(refs, timeout=30)) == list(range(10))
     q.shutdown()
 
 
@@ -244,6 +276,25 @@ def test_mp_pool_error_propagates(ray_start_shared):
         assert not res.successful()
     finally:
         p.terminate()
+
+
+def test_mp_pool_join_waits_for_inflight(ray_start_shared, tmp_path):
+    marker = str(tmp_path / "done.txt")
+
+    def slow_write(path):
+        import time as _t
+        _t.sleep(0.5)
+        with open(path, "w") as f:
+            f.write("done")
+        return path
+
+    p = Pool(processes=1)
+    p.map_async(slow_write, [marker])
+    p.close()
+    p.join()  # must block until the worker finished writing
+    import os
+    assert os.path.exists(marker)
+    p.terminate()
 
 
 def test_mp_pool_lifecycle(ray_start_shared):
