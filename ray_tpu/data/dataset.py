@@ -302,6 +302,16 @@ class Dataset:
             raise ValueError(f"test_size {test_size} >= dataset size {n}")
         return mat.split_at_indices([n - test_size])
 
+    def to_random_access_dataset(self, key: str, *,
+                                 num_workers: int = 4,
+                                 worker_options: Optional[dict] = None):
+        """Sort by ``key`` and pin the blocks across worker actors for
+        distributed point lookups (reference: dataset.py
+        to_random_access_dataset / random_access_dataset.py)."""
+        from ray_tpu.data.random_access import RandomAccessDataset
+        return RandomAccessDataset(self, key, num_workers=num_workers,
+                                   worker_options=worker_options)
+
     def randomize_block_order(self, *, seed: Optional[int] = None
                               ) -> "MaterializedDataset":
         """Shuffle whole blocks without touching rows — the cheap

@@ -1,0 +1,179 @@
+"""Distributed random access over a sorted Dataset.
+
+reference: python/ray/data/random_access_dataset.py — same public
+surface (`RandomAccessDataset` with get_async/multiget/stats, built by
+`Dataset.to_random_access_dataset`). Independent design: the dataset
+is sorted by the key column and materialized; worker actors each pin a
+contiguous run of blocks in memory; the driver keeps only the sorted
+per-block key ranges and routes each lookup with a binary search, so
+point reads cost one actor RPC + an O(log rows) searchsorted inside
+the block.
+"""
+from __future__ import annotations
+
+import bisect
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+__all__ = ["RandomAccessDataset"]
+
+
+class _RandomAccessWorker:
+    """Holds assigned blocks in memory, keyed by block index."""
+
+    def __init__(self, key_field: str):
+        self.key_field = key_field
+        self.blocks: Dict[int, Any] = {}
+        self.key_cols: Dict[int, np.ndarray] = {}
+        self.num_gets = 0
+        self.total_time = 0.0
+
+    def assign_blocks(self, block_ref_dict: Dict[int, Any]):
+        # dict-embedded ObjectRefs are NOT auto-resolved (top-level
+        # args only) — fetch here so the tables are pinned in actor
+        # memory and every later lookup is a local read. The key
+        # column is materialized to numpy ONCE per block so point gets
+        # are a true O(log rows) searchsorted, not an O(rows) copy.
+        for i, ref in block_ref_dict.items():
+            block = ray_tpu.get(ref)
+            self.blocks[i] = block
+            self.key_cols[i] = block.column(
+                self.key_field).to_numpy(zero_copy_only=False)
+        return len(self.blocks)
+
+    def _lookup(self, block_index: int, key: Any):
+        block = self.blocks.get(block_index)
+        if block is None:
+            return None
+        col = self.key_cols[block_index]
+        i = int(np.searchsorted(col, key))
+        if i >= len(col) or col[i] != key:
+            return None
+        return {name: block.column(name)[i].as_py()
+                for name in block.schema.names}
+
+    def get(self, block_index: int, key: Any):
+        t0 = time.perf_counter()
+        try:
+            return self._lookup(block_index, key)
+        finally:
+            self.num_gets += 1
+            self.total_time += time.perf_counter() - t0
+
+    def multiget(self, block_indices: List[int], keys: List[Any]):
+        t0 = time.perf_counter()
+        out = [self._lookup(b, k) for b, k in zip(block_indices, keys)]
+        self.num_gets += len(keys)
+        self.total_time += time.perf_counter() - t0
+        return out
+
+    def ping(self):
+        return "ok"
+
+    def stats(self) -> dict:
+        return {"blocks": len(self.blocks), "num_gets": self.num_gets,
+                "total_time": self.total_time}
+
+
+class RandomAccessDataset:
+    """Serve point lookups by key over a dataset.
+
+    Args:
+        ds: source Dataset (any order; it is sorted by ``key`` here).
+        key: column to index on (values must be orderable).
+        num_workers: actors holding the blocks (default 4).
+    """
+
+    def __init__(self, ds, key: str, *, num_workers: int = 4,
+                 worker_options: Optional[dict] = None):
+        self._key = key
+        mat = ds.sort(key).materialize()
+        refs = mat._refs
+
+        # per-block [lo, hi] key ranges, computed remotely
+        rng = ray_tpu.remote(
+            lambda block, col=key: (
+                (block.column(col)[0].as_py(),
+                 block.column(col)[block.num_rows - 1].as_py())
+                if block.num_rows else None))
+        ranges = ray_tpu.get([rng.remote(r) for r in refs])
+        keep = [i for i, r in enumerate(ranges) if r is not None]
+        refs = [refs[i] for i in keep]
+        self._ranges = [ranges[i] for i in keep]
+        self._los = [r[0] for r in self._ranges]
+
+        num_workers = max(1, min(num_workers, max(len(refs), 1)))
+        opts = dict(worker_options or {})
+        cls = ray_tpu.remote(_RandomAccessWorker)
+        if opts:
+            cls = cls.options(**opts)
+        self._workers = [cls.remote(key) for _ in range(num_workers)]
+
+        # contiguous runs of blocks per worker preserve range locality
+        self._block_to_worker: List[int] = []
+        assignments: List[Dict[int, Any]] = [
+            {} for _ in range(num_workers)]
+        for i, ref in enumerate(refs):
+            w = min(i * num_workers // max(len(refs), 1),
+                    num_workers - 1)
+            assignments[w][i] = ref
+            self._block_to_worker.append(w)
+        ray_tpu.get([w.assign_blocks.remote(a)
+                     for w, a in zip(self._workers, assignments)])
+        # shared miss result: one store entry for every missed key
+        self._none_ref = ray_tpu.put(None)
+
+    # -- routing -------------------------------------------------------
+    def _find_block(self, key: Any) -> Optional[int]:
+        """Rightmost block whose low key <= key (ranges are sorted and
+        disjoint after the global sort)."""
+        i = bisect.bisect_right(self._los, key) - 1
+        if i < 0:
+            return None
+        lo, hi = self._ranges[i]
+        return i if lo <= key <= hi else None
+
+    # -- reads ---------------------------------------------------------
+    def get_async(self, key: Any):
+        """ObjectRef resolving to the row dict, or None if absent."""
+        b = self._find_block(key)
+        if b is None:
+            return self._none_ref
+        worker = self._workers[self._block_to_worker[b]]
+        return worker.get.remote(b, key)
+
+    def multiget(self, keys: List[Any]) -> List[Optional[dict]]:
+        """Batched lookups: one RPC per involved worker."""
+        per_worker: Dict[int, List[int]] = {}
+        blocks: List[Optional[int]] = []
+        for pos, key in enumerate(keys):
+            b = self._find_block(key)
+            blocks.append(b)
+            if b is not None:
+                per_worker.setdefault(
+                    self._block_to_worker[b], []).append(pos)
+        results: List[Optional[dict]] = [None] * len(keys)
+        futures = []
+        for w, positions in per_worker.items():
+            futures.append((positions, self._workers[w].multiget.remote(
+                [blocks[p] for p in positions],
+                [keys[p] for p in positions])))
+        for positions, fut in futures:
+            for p, row in zip(positions, ray_tpu.get(fut)):
+                results[p] = row
+        return results
+
+    def stats(self) -> str:
+        lines = [f"RandomAccessDataset(key={self._key!r}, "
+                 f"blocks={len(self._ranges)}, "
+                 f"workers={len(self._workers)})"]
+        for i, s in enumerate(
+                ray_tpu.get([w.stats.remote() for w in self._workers])):
+            lines.append(f"  worker {i}: {s['blocks']} blocks, "
+                         f"{s['num_gets']} gets, "
+                         f"{s['total_time']:.4f}s")
+        return "\n".join(lines)

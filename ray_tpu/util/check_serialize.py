@@ -1,0 +1,113 @@
+"""Serialization debugging: find WHICH nested object can't pickle.
+
+reference: python/ray/util/check_serialize.py
+`inspect_serializability` — recursively tries cloudpickle on an
+object's closure/attributes and reports the offending leaves, instead
+of the opaque mid-pickle TypeError users otherwise get.
+"""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Any, Optional, Set, Tuple
+
+from ray_tpu.core import serialization
+
+__all__ = ["inspect_serializability", "FailTuple"]
+
+
+@dataclass(frozen=True)
+class FailTuple:
+    """One unserializable leaf: its name, string form, and the parent
+    object it was reached through."""
+    name: str
+    obj: str = field(compare=False)
+    parent: str = field(compare=False)
+
+    def __repr__(self):
+        return (f"FailTuple({self.name} [obj={self.obj!r}, "
+                f"parent={self.parent!r}])")
+
+
+def _serializable(obj: Any) -> bool:
+    try:
+        serialization.dumps(obj)
+        return True
+    except Exception:
+        return False
+
+
+def _inspect(obj: Any, name: str, depth: int,
+             parent: str, failures: Set[FailTuple],
+             seen: Set[int]) -> None:
+    if id(obj) in seen or depth < 0:
+        return
+    seen.add(id(obj))
+    if _serializable(obj):
+        return
+    if depth == 0:
+        failures.add(FailTuple(name, repr(obj)[:80], parent))
+        return
+
+    found_deeper = False
+    # closures: the usual culprits (locks, sockets, clients captured
+    # by a remote function)
+    if inspect.isfunction(obj) or inspect.ismethod(obj):
+        fn = obj.__func__ if inspect.ismethod(obj) else obj
+        closure = fn.__closure__ or ()
+        names = fn.__code__.co_freevars
+        for cname, cell in zip(names, closure):
+            try:
+                cv = cell.cell_contents
+            except ValueError:
+                continue
+            if not _serializable(cv):
+                found_deeper = True
+                _inspect(cv, cname, depth - 1, name, failures, seen)
+        for gname, gv in (fn.__globals__ or {}).items():
+            if gname in fn.__code__.co_names and not _serializable(gv):
+                found_deeper = True
+                _inspect(gv, gname, depth - 1, name, failures, seen)
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            if not _serializable(v):
+                found_deeper = True
+                _inspect(v, str(k), depth - 1, name, failures, seen)
+    elif isinstance(obj, (list, tuple, set, frozenset)):
+        for i, v in enumerate(obj):
+            if not _serializable(v):
+                found_deeper = True
+                _inspect(v, f"{name}[{i}]", depth - 1, name, failures,
+                         seen)
+    else:
+        attrs = getattr(obj, "__dict__", None)
+        if attrs:
+            for aname, av in attrs.items():
+                if not _serializable(av):
+                    found_deeper = True
+                    _inspect(av, aname, depth - 1, name, failures, seen)
+
+    if not found_deeper:
+        # this object itself is the leaf failure
+        failures.add(FailTuple(name, repr(obj)[:80], parent))
+
+
+def inspect_serializability(
+        obj: Any, name: Optional[str] = None,
+        depth: int = 3, print_info: bool = True
+) -> Tuple[bool, Set[FailTuple]]:
+    """Check whether ``obj`` cloudpickles; on failure, descend into
+    closures/attributes/containers up to ``depth`` levels and return
+    the offending leaves.
+
+    Returns (serializable, failures).
+    """
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    failures: Set[FailTuple] = set()
+    _inspect(obj, name, depth, "<root>", failures, set())
+    ok = not failures
+    if print_info and not ok:
+        print(f"{name!r} is not serializable. Offending objects:")
+        for f in sorted(failures, key=lambda f: f.name):
+            print(f"  - {f!r}")
+    return ok, failures

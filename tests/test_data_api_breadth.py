@@ -159,3 +159,36 @@ def test_explain_renders_plan(capsys):
     out = capsys.readouterr().out
     assert text in out
     assert "Limit" in text or "limit" in text.lower()
+
+
+# ------------------------------------------------- random access
+
+def test_random_access_dataset():
+    ds = rd.from_items([{"id": i * 2, "val": f"v{i}"} for i in range(50)],
+                       parallelism=5)
+    rad = ds.to_random_access_dataset("id", num_workers=2)
+    # hits
+    assert ray_tpu.get(rad.get_async(0))["val"] == "v0"
+    assert ray_tpu.get(rad.get_async(98))["val"] == "v49"
+    assert ray_tpu.get(rad.get_async(48))["val"] == "v24"
+    # misses: odd keys, out of range
+    assert ray_tpu.get(rad.get_async(49)) is None
+    assert ray_tpu.get(rad.get_async(-2)) is None
+    assert ray_tpu.get(rad.get_async(1000)) is None
+    # batched, order-preserving, with misses interleaved
+    got = rad.multiget([4, 5, 96, -1, 0])
+    assert [r["val"] if r else None for r in got] == \
+        ["v2", None, "v48", None, "v0"]
+    s = rad.stats()
+    assert "workers=2" in s and "gets" in s
+
+
+def test_random_access_unsorted_input():
+    # input arrives unsorted; the index must sort it first
+    import random
+    items = [{"k": i, "x": i * i} for i in range(30)]
+    random.Random(7).shuffle(items)
+    rad = rd.from_items(items, parallelism=4).to_random_access_dataset(
+        "k", num_workers=3)
+    assert ray_tpu.get(rad.get_async(17))["x"] == 289
+    assert rad.multiget([0, 29])[1]["x"] == 841

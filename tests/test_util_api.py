@@ -370,3 +370,33 @@ def test_request_resources_scales_to_fit(ray_start_shared):
         assert len(provider.non_terminated_nodes()) == 3
     finally:
         request_resources()  # don't leak the KV request to later tests
+
+
+# ------------------------------------------------- check_serialize
+
+def test_inspect_serializability():
+    import threading
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1,
+                                           print_info=False)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def captures_lock():
+        return lock
+
+    ok, failures = inspect_serializability(captures_lock,
+                                           print_info=False)
+    assert not ok
+    assert any(f.name == "lock" for f in failures)
+
+    class Holder:
+        def __init__(self):
+            self.fine = 42
+            self.bad = threading.Lock()
+
+    ok, failures = inspect_serializability(Holder(), print_info=False)
+    assert not ok
+    assert any(f.name == "bad" for f in failures)
