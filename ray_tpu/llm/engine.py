@@ -39,7 +39,7 @@ import numpy as np
 
 from ray_tpu.models.llama import (
     LlamaConfig, llama_decode_step, llama_init, llama_init_cache,
-    llama_prefill)
+    llama_prefill, llama_verify_step)
 
 
 @dataclass
@@ -60,6 +60,19 @@ class EngineConfig:
     # add_request, so the effective value is visible on the request).
     # top_k=0 samples the full vocab.
     max_top_k: int = 256
+    # Speculative decoding (reference: vLLM spec-decode): a small
+    # draft model greedily proposes spec_tokens-1 tokens per round and
+    # the target scores the whole chunk in ONE llama_verify_step
+    # forward — up to spec_tokens tokens emitted per target forward.
+    # Greedy (temperature<=0) requests get the speculative fast path;
+    # sampled requests fall back to one target-verified token per
+    # round (still correct, no speedup). None disables.
+    # Numerics: every emitted token is the argmax of TARGET logits
+    # computed by the chunked verify program; in bf16 that can break
+    # argmax ties differently than the single-token decode program
+    # (bitwise parity with the dense path holds in f32).
+    draft_model: Optional[LlamaConfig] = None
+    spec_tokens: int = 4
 
 
 @dataclass
@@ -102,7 +115,8 @@ class _Slot:
 
 
 class ContinuousBatchingEngine:
-    def __init__(self, config: EngineConfig, params=None):
+    def __init__(self, config: EngineConfig, params=None,
+                 draft_params=None):
         import jax
         import jax.numpy as jnp
 
@@ -114,6 +128,27 @@ class ContinuousBatchingEngine:
         self.params = params
         self.cache_k, self.cache_v = llama_init_cache(
             c, config.max_batch, config.max_seq)
+        # Speculative decoding: the last spec_tokens cache rows are a
+        # scratch region (inactive slots park their chunk writes
+        # there), so live requests stop spec_tokens earlier.
+        self._spec = config.draft_model is not None
+        if self._spec:
+            dc = config.draft_model
+            if dc.vocab_size != c.vocab_size:
+                raise ValueError(
+                    "draft_model vocab_size must match the target's")
+            if config.spec_tokens < 2:
+                raise ValueError("spec_tokens must be >= 2 (1 draft + "
+                                 "1 verified token minimum)")
+            if draft_params is None:
+                draft_params = llama_init(
+                    jax.random.PRNGKey(config.seed + 1), dc)
+            self.draft_params = draft_params
+            self.draft_cache_k, self.draft_cache_v = llama_init_cache(
+                dc, config.max_batch, config.max_seq)
+            self._pos_limit = config.max_seq - 1 - config.spec_tokens
+        else:
+            self._pos_limit = config.max_seq - 1
         self.slots = [_Slot(i) for i in range(config.max_batch)]
         self.waiting: List[GenerationRequest] = []
         # disaggregated requests: (request, ks, vs, prompt_len, token)
@@ -194,6 +229,57 @@ class ContinuousBatchingEngine:
         self._prefill = jax.jit(prefill)
         self._sample_one = jax.jit(sample_one)
         self._insert = jax.jit(insert, donate_argnums=(0, 1))
+
+        if self._spec:
+            dc = config.draft_model
+            n_draft = config.spec_tokens - 1
+
+            def draft_propose(dparams, ck, cv, token0, pos0):
+                """All greedy draft steps fused into ONE program
+                (lax.scan) — one device dispatch per round instead of
+                G-1, which matters when decode is dispatch-bound.
+
+                The scan runs G (not G-1) steps: the extra step's
+                OUTPUT is discarded, but it writes d_{G-1}'s K/V into
+                the draft cache — on full acceptance the next round
+                starts at pos+G, and without that row the draft would
+                attend a junk row forever after, silently collapsing
+                acceptance exactly in the high-acceptance regime."""
+                def body(carry, i):
+                    tok, ck, cv = carry
+                    logits, ck, cv = llama_decode_step(
+                        dparams, tok, ck, cv, pos0 + i, dc)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, ck, cv), nxt
+
+                (_, ck, cv), drafts = jax.lax.scan(
+                    body, (token0, ck, cv), jnp.arange(n_draft + 1))
+                return drafts[:n_draft], ck, cv   # drafts: [G-1, B]
+
+            def draft_sync(dparams, ck, cv, tokens, pos):
+                """Dense-path companion: write the fed tokens' K/V into
+                the draft cache (output discarded) so dense fallback
+                rounds don't leave gaps that desync the draft."""
+                _logits, ck, cv = llama_decode_step(
+                    dparams, tokens, ck, cv, pos, dc)
+                return ck, cv
+
+            def verify(tparams, ck, cv, chunk, pos, temp, topk,
+                       base_key, step):
+                logits, ck, cv = llama_verify_step(
+                    tparams, chunk, ck, cv, pos, c)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                key = jax.random.fold_in(base_key, step)
+                first = sample_tokens(logits[:, 0], temp, topk, key)
+                return greedy, first, ck, cv
+
+            self._draft_propose = jax.jit(draft_propose,
+                                          donate_argnums=(1, 2))
+            self._draft_sync = jax.jit(draft_sync, donate_argnums=(1, 2))
+            self._verify = jax.jit(verify, donate_argnums=(1, 2))
+            self._draft_prefill = jax.jit(
+                lambda p, t: llama_prefill(p, t, dc))
+
         self._jax = jax
         self._jnp = jnp
 
@@ -274,7 +360,7 @@ class ContinuousBatchingEngine:
         prefill-decode disagg deployments). Returns numpy
         (ks, vs, prompt_len, first_token): the KV block ships through
         the object plane to a decode engine's add_prefilled()."""
-        limit = self.config.max_seq - 1
+        limit = self._pos_limit
         ids = list(prompt_ids)[-limit:]
         if adapter is not None and adapter not in self._adapters:
             raise ValueError(f"unknown LoRA adapter {adapter!r}")
@@ -287,9 +373,12 @@ class ContinuousBatchingEngine:
         """DECODE side of disaggregation: adopt a request whose prefill
         ran elsewhere — the KV block is inserted into a free slot at the
         next admit, skipping local prefill entirely."""
-        if prompt_len > self.config.max_seq - 1:
+        if prompt_len > self._pos_limit:
+            # pos_limit, not max_seq-1: a speculative engine reserves
+            # its scratch rows, and admitting past the limit would
+            # end the request after exactly one token
             raise ValueError("prefilled prompt exceeds this engine's "
-                             "max_seq")
+                             "position limit")
         if ks.shape[2] > self.config.max_seq:
             raise ValueError(
                 f"prefilled KV bucket ({ks.shape[2]}) exceeds this "
@@ -305,7 +394,7 @@ class ContinuousBatchingEngine:
         return request
 
     def add_request(self, request: GenerationRequest) -> GenerationRequest:
-        limit = self.config.max_seq - 1
+        limit = self._pos_limit
         if len(request.prompt_ids) > limit:
             request.prompt_ids = request.prompt_ids[-limit:]
         if request.adapter is not None:
@@ -343,6 +432,12 @@ class ContinuousBatchingEngine:
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, jnp.asarray(ks),
                 jnp.asarray(vs), slot.index)
+            if self._spec:
+                # disagg ships only the TARGET KV; rebuild the draft's
+                # prefix locally (draft prefill is cheap)
+                self._draft_prefill_slot(
+                    list(request.prompt_ids)[-(self.config.max_seq - 1):],
+                    slot.index)
             slot.next_token = tok
             slot.pos = plen
             self._emit(slot, tok)
@@ -354,12 +449,7 @@ class ContinuousBatchingEngine:
         and prefill_only (disaggregation) call this — one copy, so the
         exact-parity guarantee between the two modes can't drift."""
         jnp = self._jnp
-        bucket = 1
-        while bucket < len(ids):
-            bucket *= 2
-        bucket = min(bucket, self.config.max_seq)
-        padded = np.zeros((1, bucket), dtype=np.int32)
-        padded[0, : len(ids)] = ids
+        padded = self._pad_bucket(ids)
         lora = self._adapter_prefill.get(adapter) if adapter else None
         logits, ks, vs = self._prefill(self.params, jnp.asarray(padded),
                                        lora)
@@ -368,6 +458,28 @@ class ContinuousBatchingEngine:
             logits[0, len(ids) - 1], float(temperature), int(top_k),
             self._jax.random.fold_in(self._base_key, self._step_counter))
         return ks, vs, int(token)
+
+    def _pad_bucket(self, ids: List[int]) -> np.ndarray:
+        """Power-of-two bucket/pad a prompt — ONE copy of the policy so
+        target and draft prefills can't drift apart (each distinct
+        bucket is its own XLA program)."""
+        bucket = 1
+        while bucket < len(ids):
+            bucket *= 2
+        bucket = min(bucket, self.config.max_seq)
+        padded = np.zeros((1, bucket), dtype=np.int32)
+        padded[0, : len(ids)] = ids
+        return padded
+
+    def _draft_prefill_slot(self, ids: List[int], slot_index: int) -> None:
+        """Prefill the DRAFT model's cache for a newly admitted prompt
+        so its proposals condition on the real prefix (cheap — the
+        draft is small by construction)."""
+        jnp = self._jnp
+        _logits, ks, vs = self._draft_prefill(
+            self.draft_params, jnp.asarray(self._pad_bucket(ids)))
+        self.draft_cache_k, self.draft_cache_v = self._insert(
+            self.draft_cache_k, self.draft_cache_v, ks, vs, slot_index)
 
     def _admit(self) -> None:
         """Prefill waiting requests into free slots."""
@@ -387,6 +499,8 @@ class ContinuousBatchingEngine:
                 ids, request.adapter, request.temperature, request.top_k)
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot.index)
+            if self._spec:
+                self._draft_prefill_slot(ids, slot.index)
             slot.next_token = token
             slot.pos = len(ids)
             self._emit(slot, slot.next_token)
@@ -399,12 +513,71 @@ class ContinuousBatchingEngine:
             request.finish_reason = "stop"
         elif len(request.output_ids) >= request.max_tokens:
             request.finish_reason = "length"
-        elif slot.pos >= self.config.max_seq - 1:
+        elif slot.pos >= self._pos_limit:
             request.finish_reason = "length"
         request.push_stream(token)
         if request.done:
             request.push_stream(None)
             slot.request = None
+
+    def _spec_step(self, active) -> int:
+        """One speculation round: G-1 batched draft decodes + ONE
+        target verify over the [B, G] chunk; each greedy slot emits
+        its accepted draft prefix plus the target's correction (1..G
+        tokens per round, every one of them exactly what greedy
+        target-only decoding would have produced)."""
+        jax, jnp = self._jax, self._jnp
+        n = self.config.max_batch
+        G = self.config.spec_tokens
+        park = self.config.max_seq - G  # scratch rows for idle slots
+        tokens = np.zeros(n, dtype=np.int32)
+        pos = np.full(n, park, dtype=np.int32)
+        temp = np.zeros(n, dtype=np.float32)
+        topk = np.zeros(n, dtype=np.int32)
+        for slot in active:
+            tokens[slot.index] = slot.next_token
+            pos[slot.index] = slot.pos
+            temp[slot.index] = slot.request.temperature
+            topk[slot.index] = slot.request.top_k
+        tokens_j = jnp.asarray(tokens)
+        pos_j = jnp.asarray(pos)
+
+        # draft proposals d_1..d_{G-1}: one fused dispatch
+        drafts_dev, self.draft_cache_k, self.draft_cache_v = \
+            self._draft_propose(self.draft_params, self.draft_cache_k,
+                                self.draft_cache_v, tokens_j, pos_j)
+
+        # one target forward scores the whole chunk
+        chunk = jnp.concatenate(
+            [tokens_j[:, None], drafts_dev.T], axis=1)       # [B, G]
+        self._step_counter += 1
+        greedy, first_sampled, self.cache_k, self.cache_v = \
+            self._verify(self.params, self.cache_k, self.cache_v,
+                         chunk, pos_j, jnp.asarray(temp),
+                         jnp.asarray(topk), self._base_key,
+                         self._step_counter)
+        greedy = np.asarray(greedy)                          # [B, G]
+        first_sampled = np.asarray(first_sampled)            # [B]
+        drafts_np = np.asarray(drafts_dev).T                 # [B, G-1]
+
+        for slot in active:
+            b = slot.index
+            if slot.request.temperature > 0.0:
+                # sampled request: one properly-sampled token from the
+                # target's first-position logits (no speculation)
+                emitted = [int(first_sampled[b])]
+            else:
+                m = 0  # accepted draft tokens
+                while m < G - 1 and drafts_np[b, m] == greedy[b, m]:
+                    m += 1
+                emitted = [int(greedy[b, i]) for i in range(m + 1)]
+            for token in emitted:
+                slot.pos += 1
+                slot.next_token = token
+                self._emit(slot, token)
+                if slot.request is None:  # finished mid-chunk
+                    break
+        return len(active)
 
     def step(self) -> int:
         """Admit + one whole-batch decode step (sampling fused on
@@ -413,6 +586,14 @@ class ContinuousBatchingEngine:
         active = [s for s in self.slots if s.request is not None]
         if not active:
             return 0
+        if self._spec and \
+                any(s.request.temperature <= 0.0 for s in active) and \
+                all(s.request.adapter is None for s in active) and \
+                all(s.pos + self.config.spec_tokens
+                    <= self.config.max_seq - 1 for s in active):
+            # (all-sampled batches skip speculation: a round would pay
+            # the draft scan + G-wide verify to emit 1 token/slot)
+            return self._spec_step(active)
         jnp = self._jnp
         n = self.config.max_batch
         tokens = np.zeros(n, dtype=np.int32)
@@ -434,6 +615,13 @@ class ContinuousBatchingEngine:
             jnp.asarray(temp), jnp.asarray(topk),
             self._base_key, self._step_counter,
             self.lora_bank, jnp.asarray(lora_idx))
+        if self._spec:
+            # keep the draft cache in lockstep through dense rounds,
+            # or the next _spec_step would condition on KV gaps
+            self.draft_cache_k, self.draft_cache_v = self._draft_sync(
+                self.draft_params, self.draft_cache_k,
+                self.draft_cache_v, jnp.asarray(tokens),
+                jnp.asarray(pos))
         sampled = np.asarray(sampled)
         for slot in active:
             slot.pos += 1
@@ -483,6 +671,10 @@ class ContinuousBatchingEngine:
             slot.next_token = 0
         self.cache_k, self.cache_v = llama_init_cache(
             self.config.model, self.config.max_batch, self.config.max_seq)
+        if self._spec:
+            self.draft_cache_k, self.draft_cache_v = llama_init_cache(
+                self.config.draft_model, self.config.max_batch,
+                self.config.max_seq)
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

@@ -568,3 +568,61 @@ def llama_decode_step(params, token, cache_k, cache_v, pos,
     x = rms_norm(x, params["final_norm"], c.norm_eps)
     logits = (x[:, 0] @ params["lm_head"]).astype(jnp.float32)
     return logits, new_k, new_v
+
+
+def llama_verify_step(params, tokens, cache_k, cache_v, pos,
+                      config: LlamaConfig):
+    """Score a G-token speculative chunk in ONE target forward.
+
+    tokens: [B, G] int32 — the current token followed by G-1 draft
+    proposals; pos: [B] int32 chunk start positions; cache_k/v:
+    [L, B, S, KVH, HD]. Returns (logits [B, G, vocab] f32, cache_k,
+    cache_v) with the chunk's K/V written at pos..pos+G-1 per slot.
+    logits[:, g] is the target's distribution for the token AFTER
+    chunk input g — the verifier for draft g+1 (speculative decoding,
+    Leviathan et al. 2023; reference analog: vLLM's spec-decode
+    scorer). G is static, so XLA sees one fixed-shape program per
+    chunk width.
+    """
+    c = config
+    n_layers, b, s, kvh, hd = cache_k.shape
+    g = tokens.shape[1]
+    n_rep = c.n_heads // c.n_kv_heads
+    x = params["embedding"][tokens].astype(c.dtype)           # [B,G,D]
+    cos, sin = rope_frequencies(hd, s, c.rope_theta)
+    positions = pos[:, None] + jnp.arange(g)[None, :]         # [B,G]
+    # chunk position i attends cache slot t iff t <= pos+i (the write
+    # below lands the chunk's own K/V inside that window)
+    visible = (jnp.arange(s)[None, None, :]
+               <= positions[:, :, None])                      # [B,G,S]
+
+    def body(x, layer):
+        layer_params, ck, cv = layer                # ck [B,S,KVH,HD]
+        h = rms_norm(x, layer_params["attn_norm"], c.norm_eps)
+        q = (h @ layer_params["wq"]).reshape(b, g, c.n_heads, hd)
+        k = (h @ layer_params["wk"]).reshape(b, g, kvh, hd)
+        v = (h @ layer_params["wv"]).reshape(b, g, kvh, hd)
+        q = apply_rope(q, cos, sin, positions=positions)
+        k = apply_rope(k, cos, sin, positions=positions)
+        write = jax.vmap(
+            lambda cache, new, p: jax.lax.dynamic_update_slice(
+                cache, new, (p, 0, 0)))
+        ck = write(ck, k, pos)
+        cv = write(cv, v, pos)
+        kk = jnp.repeat(ck, n_rep, axis=2) if n_rep > 1 else ck
+        vv = jnp.repeat(cv, n_rep, axis=2) if n_rep > 1 else cv
+        scores = jnp.einsum("bghd,bshd->bhgs", q, kk).astype(jnp.float32)
+        scores = scores * (hd ** -0.5)
+        scores = jnp.where(visible[:, None, :, :], scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1).astype(c.dtype)
+        attn = jnp.einsum("bhgs,bshd->bghd", weights, vv)
+        x = x + attn.reshape(b, g, c.n_heads * hd) @ layer_params["wo"]
+        h = rms_norm(x, layer_params["mlp_norm"], c.norm_eps)
+        y, _aux = _ffn(layer_params, h, c)
+        return x + y, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache_k, cache_v))
+    x = rms_norm(x, params["final_norm"], c.norm_eps)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, new_k, new_v

@@ -521,3 +521,144 @@ def test_disagg_token_streaming(ray_start_shared):
         assert final["kv_handoff_ms"] >= 0.0
     finally:
         serve.shutdown()
+
+
+# ----------------------------------------------------- speculative decoding
+
+def _spec_cfgs():
+    target = LlamaConfig.tiny(max_seq_len=64, attention="reference",
+                              remat=False)
+    draft = LlamaConfig.tiny(max_seq_len=64, attention="reference",
+                             remat=False, dim=32, n_layers=1, n_heads=2,
+                             n_kv_heads=1, hidden_dim=64)
+    return target, draft
+
+
+def test_speculative_matches_target_greedy():
+    """The speculative correctness invariant: greedy output must be
+    IDENTICAL to target-only greedy decoding, for any draft model."""
+    import jax
+    from ray_tpu.models.llama import llama_init
+
+    target, draft = _spec_cfgs()
+    params = llama_init(jax.random.PRNGKey(3), target)
+    base = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=2, max_seq=64),
+        params=params)
+    spec = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=2, max_seq=64,
+                     draft_model=draft, spec_tokens=4),
+        params=params)
+    prompts = [[1, 5, 9, 13], [2, 4, 6]]
+    want = base.generate(prompts, max_tokens=16)
+    got = spec.generate(prompts, max_tokens=16)
+    assert got == want
+    assert all(len(o) == 16 for o in got)
+
+
+def test_speculative_perfect_draft_skips_target_steps():
+    """With draft == target every proposal is accepted: the engine
+    must emit spec_tokens tokens per target forward, not one."""
+    import jax
+    from ray_tpu.models.llama import llama_init
+
+    target, _ = _spec_cfgs()
+    params = llama_init(jax.random.PRNGKey(5), target)
+    spec = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64,
+                     draft_model=target, spec_tokens=4),
+        params=params, draft_params=params)
+    [out] = spec.generate([[1, 2, 3]], max_tokens=13)
+    assert len(out) == 13
+    # prefill (+1 counter) + ceil(12 / 4) = 3 verify rounds
+    assert spec._step_counter <= 1 + 3
+    base = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64),
+        params=params)
+    [want] = base.generate([[1, 2, 3]], max_tokens=13)
+    assert out == want
+
+
+def test_speculative_sampled_requests_stay_correct():
+    """temperature>0 requests take the non-speculative fallback inside
+    the spec engine and still produce tokens."""
+    import jax
+    from ray_tpu.models.llama import llama_init
+
+    target, draft = _spec_cfgs()
+    params = llama_init(jax.random.PRNGKey(7), target)
+    spec = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=2, max_seq=64,
+                     draft_model=draft, spec_tokens=3),
+        params=params)
+    [a, b] = spec.generate([[1, 2], [3, 4]], max_tokens=8,
+                           temperature=0.8, top_k=20)
+    assert len(a) == 8 and len(b) == 8
+    assert all(0 <= t < 258 for t in a + b)
+
+
+def test_speculative_stop_mid_chunk():
+    """A stop token emitted inside an accepted chunk must end the
+    request there, not after the whole chunk."""
+    import jax
+    from ray_tpu.models.llama import llama_init
+
+    target, _ = _spec_cfgs()
+    params = llama_init(jax.random.PRNGKey(9), target)
+    base = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64),
+        params=params)
+    [full] = base.generate([[1, 2, 3]], max_tokens=12)
+    stop = full[5]  # force a stop on the 6th greedy token
+    spec = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64,
+                     draft_model=target, spec_tokens=4),
+        params=params, draft_params=params)
+    req = spec.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=12, stop_ids=(int(stop),)))
+    while not req.done:
+        spec.step()
+    assert req.finish_reason == "stop"
+    # ends at the FIRST occurrence of the stop token (the tiny random
+    # model may repeat it before index 5)
+    assert req.output_ids == full[:full.index(stop) + 1]
+
+
+def test_speculative_config_validation():
+    target, draft = _spec_cfgs()
+    import dataclasses
+    bad_draft = dataclasses.replace(draft, vocab_size=999)
+    with pytest.raises(ValueError, match="vocab_size"):
+        ContinuousBatchingEngine(EngineConfig(
+            model=target, draft_model=bad_draft))
+    with pytest.raises(ValueError, match="spec_tokens"):
+        ContinuousBatchingEngine(EngineConfig(
+            model=target, draft_model=draft, spec_tokens=1))
+
+
+def test_speculative_mixed_batch():
+    """Greedy and sampled requests share a speculation round: the
+    greedy slot speculates, the sampled slot gets one properly-sampled
+    target token per round."""
+    import jax
+    from ray_tpu.models.llama import llama_init
+
+    target, draft = _spec_cfgs()
+    params = llama_init(jax.random.PRNGKey(11), target)
+    base = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64),
+        params=params)
+    [want] = base.generate([[1, 2, 3]], max_tokens=10)
+    spec = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=2, max_seq=64,
+                     draft_model=draft, spec_tokens=3),
+        params=params)
+    r1 = spec.add_request(GenerationRequest(prompt_ids=[1, 2, 3],
+                                            max_tokens=10))
+    r2 = spec.add_request(GenerationRequest(prompt_ids=[4, 5],
+                                            max_tokens=10,
+                                            temperature=0.7, top_k=12))
+    while not (r1.done and r2.done):
+        spec.step()
+    assert r1.output_ids == want
+    assert len(r2.output_ids) == 10
