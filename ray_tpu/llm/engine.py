@@ -73,6 +73,16 @@ class EngineConfig:
     # (bitwise parity with the dense path holds in f32).
     draft_model: Optional[LlamaConfig] = None
     spec_tokens: int = 4
+    # Multi-step scheduling (reference: vLLM --num-scheduler-steps):
+    # fuse multi_step decode iterations into ONE device dispatch
+    # (lax.scan), amortizing host-device round trips when decode is
+    # dispatch-bound. Tokens a request cannot absorb (stop token or
+    # max_tokens hit mid-chunk) are discarded host-side: greedy
+    # outputs are identical to single-step decoding; sampled requests
+    # draw from the same distributions under a different RNG stream.
+    # Mutually exclusive with draft_model (the draft cache cannot be
+    # kept in sync through a fused chunk).
+    multi_step: int = 1
 
 
 @dataclass
@@ -112,6 +122,10 @@ class _Slot:
         self.request: Optional[GenerationRequest] = None
         self.pos = 0            # position of the NEXT token to decode
         self.next_token = 0
+        # False when the draft cache lacks this slot's prompt prefix
+        # (disagg adopt without usable prompt_ids) — speculation is
+        # skipped while such a slot is active
+        self.draft_ready = True
 
 
 class ContinuousBatchingEngine:
@@ -229,6 +243,35 @@ class ContinuousBatchingEngine:
         self._prefill = jax.jit(prefill)
         self._sample_one = jax.jit(sample_one)
         self._insert = jax.jit(insert, donate_argnums=(0, 1))
+
+        if config.multi_step > 1:
+            if self._spec:
+                raise ValueError(
+                    "multi_step and draft_model are mutually exclusive")
+            K = config.multi_step
+
+            def decode_multi(params, cache_k, cache_v, tokens, pos,
+                             temp, topk, base_key, step,
+                             lora_bank, lora_idx):
+                """K fused decode iterations — one dispatch for up to
+                K tokens per slot."""
+                round_key = jax.random.fold_in(base_key, step)
+
+                def body(carry, i):
+                    tok, ck, cv = carry
+                    logits, ck, cv = llama_decode_step(
+                        params, tok, ck, cv, pos + i, c,
+                        lora_bank=lora_bank, lora_idx=lora_idx)
+                    key = jax.random.fold_in(round_key, i)
+                    nxt = sample_tokens(logits, temp, topk, key)
+                    return (nxt, ck, cv), nxt
+
+                (_, ck, cv), toks = jax.lax.scan(
+                    body, (tokens, cache_k, cache_v), jnp.arange(K))
+                return toks, ck, cv              # toks: [K, B]
+
+            self._decode_multi = jax.jit(decode_multi,
+                                         donate_argnums=(1, 2))
 
         if self._spec:
             dc = config.draft_model
@@ -434,10 +477,18 @@ class ContinuousBatchingEngine:
                 jnp.asarray(vs), slot.index)
             if self._spec:
                 # disagg ships only the TARGET KV; rebuild the draft's
-                # prefix locally (draft prefill is cheap)
-                self._draft_prefill_slot(
-                    list(request.prompt_ids)[-(self.config.max_seq - 1):],
-                    slot.index)
+                # prefix locally (draft prefill is cheap). The draft
+                # must see EXACTLY the plen tokens the target KV was
+                # built from — the disagg protocol may adopt with
+                # empty/shorter ids ("KV already computed"), in which
+                # case this slot decodes dense (draft_ready=False)
+                # rather than speculating on a garbage prefix.
+                ids = list(request.prompt_ids)
+                if len(ids) >= plen:
+                    self._draft_prefill_slot(ids[-plen:], slot.index)
+                    slot.draft_ready = True
+                else:
+                    slot.draft_ready = False
             slot.next_token = tok
             slot.pos = plen
             self._emit(slot, tok)
@@ -501,6 +552,7 @@ class ContinuousBatchingEngine:
                 self.cache_k, self.cache_v, ks, vs, slot.index)
             if self._spec:
                 self._draft_prefill_slot(ids, slot.index)
+                slot.draft_ready = True
             slot.next_token = token
             slot.pos = len(ids)
             self._emit(slot, slot.next_token)
@@ -520,6 +572,26 @@ class ContinuousBatchingEngine:
             request.push_stream(None)
             slot.request = None
 
+    def _gather_batch(self, active, pos_fill: int = 0):
+        """Host-side per-slot input arrays for the jitted decode
+        programs — ONE copy shared by the dense, multi-step, and
+        speculative paths so a new per-request field cannot desync
+        them. ``pos_fill`` is where idle slots park their writes."""
+        n = self.config.max_batch
+        tokens = np.zeros(n, dtype=np.int32)
+        pos = np.full(n, pos_fill, dtype=np.int32)
+        temp = np.zeros(n, dtype=np.float32)
+        topk = np.zeros(n, dtype=np.int32)
+        lora_idx = np.zeros(n, dtype=np.int32)
+        for slot in active:
+            request = slot.request
+            tokens[slot.index] = slot.next_token
+            pos[slot.index] = slot.pos
+            temp[slot.index] = request.temperature
+            topk[slot.index] = request.top_k
+            lora_idx[slot.index] = self._adapter_index(request)
+        return tokens, pos, temp, topk, lora_idx
+
     def _spec_step(self, active) -> int:
         """One speculation round: G-1 batched draft decodes + ONE
         target verify over the [B, G] chunk; each greedy slot emits
@@ -527,18 +599,10 @@ class ContinuousBatchingEngine:
         tokens per round, every one of them exactly what greedy
         target-only decoding would have produced)."""
         jax, jnp = self._jax, self._jnp
-        n = self.config.max_batch
         G = self.config.spec_tokens
         park = self.config.max_seq - G  # scratch rows for idle slots
-        tokens = np.zeros(n, dtype=np.int32)
-        pos = np.full(n, park, dtype=np.int32)
-        temp = np.zeros(n, dtype=np.float32)
-        topk = np.zeros(n, dtype=np.int32)
-        for slot in active:
-            tokens[slot.index] = slot.next_token
-            pos[slot.index] = slot.pos
-            temp[slot.index] = slot.request.temperature
-            topk[slot.index] = slot.request.top_k
+        tokens, pos, temp, topk, _lora = self._gather_batch(
+            active, pos_fill=park)
         tokens_j = jnp.asarray(tokens)
         pos_j = jnp.asarray(pos)
 
@@ -579,6 +643,29 @@ class ContinuousBatchingEngine:
                     break
         return len(active)
 
+    def _multi_step(self, active, K: int) -> int:
+        """K fused decode iterations in one dispatch; per-slot tokens
+        past a stop/max_tokens finish are discarded host-side, so
+        outputs match single-step decoding exactly."""
+        jnp = self._jnp
+        tokens, pos, temp, topk, lora_idx = self._gather_batch(active)
+        self._step_counter += 1
+        toks, self.cache_k, self.cache_v = self._decode_multi(
+            self.params, self.cache_k, self.cache_v,
+            jnp.asarray(tokens), jnp.asarray(pos),
+            jnp.asarray(temp), jnp.asarray(topk),
+            self._base_key, self._step_counter,
+            self.lora_bank, jnp.asarray(lora_idx))
+        toks = np.asarray(toks)                          # [K, B]
+        for slot in active:
+            for k in range(K):
+                slot.pos += 1
+                slot.next_token = int(toks[k, slot.index])
+                self._emit(slot, slot.next_token)
+                if slot.request is None:  # finished mid-chunk:
+                    break                 # later tokens are discarded
+        return len(active)
+
     def step(self) -> int:
         """Admit + one whole-batch decode step (sampling fused on
         device — only [B] token ids come back). Returns #active slots."""
@@ -589,25 +676,18 @@ class ContinuousBatchingEngine:
         if self._spec and \
                 any(s.request.temperature <= 0.0 for s in active) and \
                 all(s.request.adapter is None for s in active) and \
+                all(s.draft_ready for s in active) and \
                 all(s.pos + self.config.spec_tokens
                     <= self.config.max_seq - 1 for s in active):
             # (all-sampled batches skip speculation: a round would pay
             # the draft scan + G-wide verify to emit 1 token/slot)
             return self._spec_step(active)
+        K = self.config.multi_step
+        if K > 1 and all(s.pos + K <= self.config.max_seq - 1
+                         for s in active):
+            return self._multi_step(active, K)
         jnp = self._jnp
-        n = self.config.max_batch
-        tokens = np.zeros(n, dtype=np.int32)
-        pos = np.zeros(n, dtype=np.int32)
-        temp = np.zeros(n, dtype=np.float32)
-        topk = np.zeros(n, dtype=np.int32)
-        lora_idx = np.zeros(n, dtype=np.int32)
-        for slot in active:
-            request = slot.request
-            tokens[slot.index] = slot.next_token
-            pos[slot.index] = slot.pos
-            temp[slot.index] = request.temperature
-            topk[slot.index] = request.top_k
-            lora_idx[slot.index] = self._adapter_index(request)
+        tokens, pos, temp, topk, lora_idx = self._gather_batch(active)
         self._step_counter += 1
         sampled, self.cache_k, self.cache_v = self._decode(
             self.params, self.cache_k, self.cache_v,
@@ -669,6 +749,7 @@ class ContinuousBatchingEngine:
             slot.request = None
             slot.pos = 0
             slot.next_token = 0
+            slot.draft_ready = True  # caches reset below
         self.cache_k, self.cache_v = llama_init_cache(
             self.config.model, self.config.max_batch, self.config.max_seq)
         if self._spec:

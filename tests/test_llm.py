@@ -662,3 +662,74 @@ def test_speculative_mixed_batch():
         spec.step()
     assert r1.output_ids == want
     assert len(r2.output_ids) == 10
+
+
+# ----------------------------------------------------- multi-step decoding
+
+def test_multi_step_matches_single_step_greedy():
+    """Fused K-step decoding must produce exactly the single-step
+    greedy outputs (discarding past a stop/max mid-chunk)."""
+    engine = tiny_engine(max_batch=2)
+    want = engine.generate([[1, 2, 3], [7, 8]], max_tokens=11)
+    multi = tiny_engine(max_batch=2, multi_step=4)
+    got = multi.generate([[1, 2, 3], [7, 8]], max_tokens=11)
+    assert got == want
+    # 11 tokens: 1 from prefill + ceil(10/4)=3 fused rounds
+    assert multi._step_counter <= 2 + 3  # 2 prefills + 3 rounds
+
+
+def test_multi_step_stop_token_truncates():
+    engine = tiny_engine(max_batch=1)
+    [full] = engine.generate([[1, 2, 3]], max_tokens=12)
+    stop = full[4]
+    multi = tiny_engine(max_batch=1, multi_step=4)
+    req = multi.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=12, stop_ids=(int(stop),)))
+    while not req.done:
+        multi.step()
+    assert req.finish_reason == "stop"
+    assert req.output_ids == full[:full.index(stop) + 1]
+
+
+def test_multi_step_sampled_and_overflow():
+    """Sampling works inside the fused chunk, and slot recycling
+    still drains more requests than slots."""
+    multi = tiny_engine(max_batch=2, multi_step=3)
+    outs = multi.generate([[1], [2, 3], [4], [5, 6]], max_tokens=7,
+                          temperature=0.9, top_k=40)
+    assert [len(o) for o in outs] == [7, 7, 7, 7]
+
+
+def test_multi_step_excludes_draft():
+    target, draft = _spec_cfgs()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ContinuousBatchingEngine(EngineConfig(
+            model=target, draft_model=draft, multi_step=4))
+
+
+def test_speculative_disagg_adopt_without_ids_stays_dense():
+    """A disagg-adopted request without prompt_ids cannot feed the
+    draft; the engine must decode it dense (correctly) instead of
+    speculating on a garbage prefix."""
+    import jax
+    from ray_tpu.models.llama import llama_init
+
+    target, draft = _spec_cfgs()
+    params = llama_init(jax.random.PRNGKey(13), target)
+    prefiller = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64),
+        params=params)
+    ks, vs, plen, tok = prefiller.prefill_only([1, 2, 3, 4])
+    spec = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64,
+                     draft_model=draft, spec_tokens=4),
+        params=params)
+    req = GenerationRequest(prompt_ids=[], max_tokens=10)
+    spec.add_prefilled(req, ks, vs, plen, tok)
+    while not req.done:
+        spec.step()
+    base = ContinuousBatchingEngine(
+        EngineConfig(model=target, max_batch=1, max_seq=64),
+        params=params)
+    [want] = base.generate([[1, 2, 3, 4]], max_tokens=10)
+    assert req.output_ids == want
