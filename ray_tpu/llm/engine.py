@@ -83,6 +83,17 @@ class EngineConfig:
     # Mutually exclusive with draft_model (the draft cache cannot be
     # kept in sync through a fused chunk).
     multi_step: int = 1
+    # Automatic prefix caching (reference: vLLM
+    # --enable-prefix-caching): completed prompt KV blocks are kept in
+    # an LRU keyed by the token prefix; a new prompt sharing a cached
+    # prefix prefills ONLY its suffix (one llama_verify_step chunk at
+    # the prefix boundary). Pays off when requests share a long
+    # system prompt. Entries hold device (HBM) KV blocks — size the
+    # LRU to the memory you can spare. LoRA prefills bypass the cache
+    # (adapter-specific KV must not leak across adapters).
+    enable_prefix_caching: bool = False
+    prefix_cache_entries: int = 16
+    prefix_cache_min_tokens: int = 8
 
 
 @dataclass
@@ -243,6 +254,38 @@ class ContinuousBatchingEngine:
         self._prefill = jax.jit(prefill)
         self._sample_one = jax.jit(sample_one)
         self._insert = jax.jit(insert, donate_argnums=(0, 1))
+
+        if config.enable_prefix_caching:
+            import collections
+            # token-tuple -> (ks, vs, prompt_len); LRU, device-resident
+            self._prefix_cache = collections.OrderedDict()
+            self.prefix_hits = 0
+            self.prefix_misses = 0
+
+            def suffix_prefill(tparams, cks, cvs, chunk, start, *,
+                               bucket):
+                """Seed-and-score in ONE program: pad/crop the cached
+                prefix KV to the target bucket and verify the suffix
+                chunk at the boundary. Fusing the seeding in keeps the
+                hit path at a single dispatch — separate zeros +
+                at[].set copies cost more than the full prefill they
+                replace."""
+                bp = cks.shape[2]
+                if bp < bucket:
+                    pad = ((0, 0), (0, 0), (0, bucket - bp),
+                           (0, 0), (0, 0))
+                    base_k = jnp.pad(cks, pad)
+                    base_v = jnp.pad(cvs, pad)
+                else:
+                    base_k = cks[:, :, :bucket]
+                    base_v = cvs[:, :, :bucket]
+                return llama_verify_step(tparams, chunk, base_k,
+                                         base_v, start, c)
+
+            self._suffix_prefill = jax.jit(suffix_prefill,
+                                           static_argnames=("bucket",))
+        else:
+            self._prefix_cache = None
 
         if config.multi_step > 1:
             if self._spec:
@@ -500,27 +543,109 @@ class ContinuousBatchingEngine:
         and prefill_only (disaggregation) call this — one copy, so the
         exact-parity guarantee between the two modes can't drift."""
         jnp = self._jnp
-        padded = self._pad_bucket(ids)
-        lora = self._adapter_prefill.get(adapter) if adapter else None
-        logits, ks, vs = self._prefill(self.params, jnp.asarray(padded),
-                                       lora)
+        use_cache = self._prefix_cache is not None and adapter is None
+        hit = self._match_prefix(ids) if use_cache else None
+        if hit is not None:
+            # suffix chunk must fit below max_seq alongside the prefix
+            plen_p = hit[2]
+            if plen_p + self._bucket_len(len(ids) - plen_p) > \
+                    self.config.max_seq:
+                hit = None
+        if hit is None:
+            if use_cache:
+                with self._lock:
+                    self.prefix_misses += 1
+            padded = self._pad_bucket(ids)
+            lora = self._adapter_prefill.get(adapter) if adapter else None
+            logits, ks, vs = self._prefill(
+                self.params, jnp.asarray(padded), lora)
+            last_logits = logits[0, len(ids) - 1]
+        else:
+            # suffix-only prefill: ONE fused program pads the cached
+            # prefix KV to the target bucket and scores the suffix
+            # chunk at the prefix boundary. Rows past the prefix in
+            # the donor entry are pad garbage, but they are only ever
+            # at positions a future decode writes before attending.
+            with self._lock:
+                self.prefix_hits += 1
+            cks, cvs, plen_p = hit
+            suffix = ids[plen_p:]
+            chunk_len = self._bucket_len(len(suffix))
+            bucket = self._bucket_len(plen_p + chunk_len)
+            chunk = np.zeros((1, chunk_len), dtype=np.int32)
+            chunk[0, : len(suffix)] = suffix
+            logits, ks, vs = self._suffix_prefill(
+                self.params, cks, cvs, jnp.asarray(chunk),
+                jnp.asarray([plen_p], dtype=jnp.int32), bucket=bucket)
+            last_logits = logits[0, len(suffix) - 1]
         self._step_counter += 1
         token = self._sample_one(
-            logits[0, len(ids) - 1], float(temperature), int(top_k),
+            last_logits, float(temperature), int(top_k),
             self._jax.random.fold_in(self._base_key, self._step_counter))
+        if use_cache:
+            self._store_prefix(ids, ks, vs)
         return ks, vs, int(token)
+
+    def _bucket_len(self, n: int) -> int:
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        return min(bucket, self.config.max_seq)
 
     def _pad_bucket(self, ids: List[int]) -> np.ndarray:
         """Power-of-two bucket/pad a prompt — ONE copy of the policy so
         target and draft prefills can't drift apart (each distinct
         bucket is its own XLA program)."""
-        bucket = 1
-        while bucket < len(ids):
-            bucket *= 2
-        bucket = min(bucket, self.config.max_seq)
+        bucket = self._bucket_len(len(ids))
         padded = np.zeros((1, bucket), dtype=np.int32)
         padded[0, : len(ids)] = ids
         return padded
+
+    # -- prefix caching -------------------------------------------------
+    def _match_prefix(self, ids: List[int]):
+        """Longest COMMON prefix between ids and any cached prompt.
+
+        Causal attention makes any prefix of a cached KV block valid
+        on its own, so two prompts sharing only a system prompt still
+        hit (the classic case: cached "A+B1" serves "A+B2" up to the
+        shared A). Capped at len(ids)-1 so at least one suffix token
+        remains to produce the first-token logits.
+
+        Runs under the engine lock — prefill_only is reachable from
+        concurrent replica request threads, and an unlocked
+        OrderedDict scan would race _store_prefix's insert/evict.
+        The token compare is vectorized (numpy mismatch scan), not a
+        Python loop — this sits on the TTFT-critical path.
+        """
+        ids_arr = np.asarray(ids, dtype=np.int64)
+        best_key, best_l = None, 0
+        with self._lock:
+            for key, (key_arr, _ks, _vs) in self._prefix_cache.items():
+                n = min(len(key_arr), len(ids_arr))
+                neq = np.nonzero(key_arr[:n] != ids_arr[:n])[0]
+                l = int(neq[0]) if neq.size else n
+                l = min(l, len(ids) - 1)
+                if l > best_l:
+                    best_key, best_l = key, l
+            if best_key is None or \
+                    best_l < self.config.prefix_cache_min_tokens:
+                return None
+            self._prefix_cache.move_to_end(best_key)
+            _key_arr, ks, vs = self._prefix_cache[best_key]
+            return ks, vs, best_l
+
+    def _store_prefix(self, ids: List[int], ks, vs) -> None:
+        key = tuple(ids)
+        if len(key) < self.config.prefix_cache_min_tokens:
+            return
+        with self._lock:
+            if key in self._prefix_cache:
+                return
+            self._prefix_cache[key] = (
+                np.asarray(ids, dtype=np.int64), ks, vs)
+            while len(self._prefix_cache) > \
+                    self.config.prefix_cache_entries:
+                self._prefix_cache.popitem(last=False)
 
     def _draft_prefill_slot(self, ids: List[int], slot_index: int) -> None:
         """Prefill the DRAFT model's cache for a newly admitted prompt
@@ -756,13 +881,23 @@ class ContinuousBatchingEngine:
             self.draft_cache_k, self.draft_cache_v = llama_init_cache(
                 self.config.draft_model, self.config.max_batch,
                 self.config.max_seq)
+        if self._prefix_cache is not None:
+            # a failed step may have consumed donated buffers that
+            # cache entries alias through sharing — drop them all
+            with self._lock:
+                self._prefix_cache.clear()
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:
-            return {
+            out = {
                 "waiting": len(self.waiting),
                 "active": sum(1 for s in self.slots
                               if s.request is not None),
                 "max_batch": self.config.max_batch,
                 "total_generated": self.total_generated,
             }
+            if self._prefix_cache is not None:
+                out["prefix_cache_entries"] = len(self._prefix_cache)
+                out["prefix_hits"] = self.prefix_hits
+                out["prefix_misses"] = self.prefix_misses
+            return out

@@ -733,3 +733,45 @@ def test_speculative_disagg_adopt_without_ids_stays_dense():
         params=params)
     [want] = base.generate([[1, 2, 3, 4]], max_tokens=10)
     assert req.output_ids == want
+
+
+# ----------------------------------------------------- prefix caching
+
+def test_prefix_cache_shared_system_prompt_exact_outputs():
+    """Two prompts sharing a long prefix: the second prefills only its
+    suffix, and greedy outputs are identical to an uncached engine."""
+    sysp = list(range(10, 26))  # 16-token shared "system prompt"
+    p1 = sysp + [1, 2, 3]
+    p2 = sysp + [7, 8]
+    plain = tiny_engine(max_batch=2)
+    want = plain.generate([p1, p2], max_tokens=9)
+    cached = tiny_engine(max_batch=2, enable_prefix_caching=True,
+                         prefix_cache_min_tokens=8)
+    got_1 = cached.generate([p1], max_tokens=9)
+    got_2 = cached.generate([p2], max_tokens=9)
+    assert got_1[0] == want[0]
+    assert got_2[0] == want[1]
+    s = cached.stats()
+    assert s["prefix_hits"] == 1 and s["prefix_misses"] == 1
+
+
+def test_prefix_cache_repeat_prompt_hits():
+    cached = tiny_engine(max_batch=1, enable_prefix_caching=True,
+                         prefix_cache_min_tokens=4)
+    prompt = [5, 6, 7, 8, 9, 10]
+    a = cached.generate([prompt], max_tokens=6)
+    b = cached.generate([prompt], max_tokens=6)
+    assert a == b
+    assert cached.stats()["prefix_hits"] == 1
+
+
+def test_prefix_cache_lru_and_min_tokens():
+    cached = tiny_engine(max_batch=1, enable_prefix_caching=True,
+                         prefix_cache_min_tokens=4,
+                         prefix_cache_entries=2)
+    cached.generate([[1, 2]], max_tokens=2)         # below min: not stored
+    assert cached.stats()["prefix_cache_entries"] == 0
+    for base in (10, 20, 30):
+        cached.generate([[base, base + 1, base + 2, base + 3]],
+                        max_tokens=2)
+    assert cached.stats()["prefix_cache_entries"] == 2  # LRU capped
