@@ -94,6 +94,16 @@ class EngineConfig:
     enable_prefix_caching: bool = False
     prefix_cache_entries: int = 16
     prefix_cache_min_tokens: int = 8
+    # Chunked prefill (reference: vLLM --enable-chunked-prefill):
+    # instead of admission running one whole-prompt prefill that
+    # stalls every decoding request for the prompt's full forward,
+    # prompts prefill in chunks of this many tokens, one chunk per
+    # step, interleaved with decode dispatches — bounding the
+    # inter-token latency hit of a long prompt joining the batch to
+    # ~one chunk forward. 0 disables. Mutually exclusive with
+    # draft_model and enable_prefix_caching; LoRA-adapter requests
+    # fall back to blocking prefill.
+    chunked_prefill_tokens: int = 0
 
 
 @dataclass
@@ -137,6 +147,10 @@ class _Slot:
         # (disagg adopt without usable prompt_ids) — speculation is
         # skipped while such a slot is active
         self.draft_ready = True
+        # chunked prefill: prompt tokens still being prefilled
+        self.prefilling = False
+        self.prefill_ids: Optional[List[int]] = None
+        self.prefill_pos = 0
 
 
 class ContinuousBatchingEngine:
@@ -153,9 +167,15 @@ class ContinuousBatchingEngine:
         self.params = params
         self.cache_k, self.cache_v = llama_init_cache(
             c, config.max_batch, config.max_seq)
-        # Speculative decoding: the last spec_tokens cache rows are a
-        # scratch region (inactive slots park their chunk writes
-        # there), so live requests stop spec_tokens earlier.
+        # Scratch region: every batched dispatch writes K/V rows for
+        # ALL slots, so slots not participating park their writes in
+        # the cache tail. Those rows must never hold live history —
+        # rows BELOW a slot's position are attended without being
+        # rewritten, so clobbering one corrupts generation (rows at or
+        # above the position are always written before they become
+        # visible). The region is sized for the widest parked write
+        # (spec chunk, prefill chunk, multi-step burst, or the 1-row
+        # dense step) and requests retire before reaching it.
         self._spec = config.draft_model is not None
         if self._spec:
             dc = config.draft_model
@@ -171,9 +191,25 @@ class ContinuousBatchingEngine:
             self.draft_params = draft_params
             self.draft_cache_k, self.draft_cache_v = llama_init_cache(
                 dc, config.max_batch, config.max_seq)
-            self._pos_limit = config.max_seq - 1 - config.spec_tokens
-        else:
-            self._pos_limit = config.max_seq - 1
+        scratch = 0
+        if self._spec:
+            scratch = max(scratch, config.spec_tokens)
+        if config.chunked_prefill_tokens > 0:
+            scratch = max(scratch, config.chunked_prefill_tokens)
+        if config.multi_step > 1:
+            scratch = max(scratch, config.multi_step)
+        self._pos_limit = config.max_seq - 1 - scratch
+        if self._pos_limit < 1:
+            raise ValueError(
+                f"max_seq={config.max_seq} leaves no usable positions "
+                f"after the {scratch}-row scratch region")
+        # Plain engines (scratch 0) park idle slots at row 0: idle
+        # slots hold no live rows and the next occupant's prefill
+        # insert overwrites row 0, so the legacy park keeps the full
+        # max_seq-1 context. With a scratch region, parking moves
+        # there because a PREFILLING slot's rows below its position
+        # are live history.
+        self._dense_park = config.max_seq - 1 if scratch else 0
         self.slots = [_Slot(i) for i in range(config.max_batch)]
         self.waiting: List[GenerationRequest] = []
         # disaggregated requests: (request, ks, vs, prompt_len, token)
@@ -286,6 +322,32 @@ class ContinuousBatchingEngine:
                                            static_argnames=("bucket",))
         else:
             self._prefix_cache = None
+
+        if config.chunked_prefill_tokens > 0:
+            C = config.chunked_prefill_tokens
+            if self._spec:
+                raise ValueError("chunked_prefill_tokens and "
+                                 "draft_model are mutually exclusive")
+            if config.enable_prefix_caching:
+                raise ValueError("chunked_prefill_tokens and "
+                                 "enable_prefix_caching are mutually "
+                                 "exclusive")
+            def chunk_prefill(tparams, ck, cv, chunk, pos, last_idx,
+                              temp, topk, base_key, step):
+                """One C-token prefill chunk for every prefilling slot
+                (idle/decoding slots park their writes); returns the
+                sampled first token per slot, used only for slots
+                whose prompt completed this round."""
+                logits, ck, cv = llama_verify_step(
+                    tparams, chunk, ck, cv, pos, c)
+                sel = jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)[:, 0]
+                key = jax.random.fold_in(base_key, step)
+                tok = sample_tokens(sel, temp, topk, key)
+                return tok, ck, cv
+
+            self._chunk_prefill = jax.jit(chunk_prefill,
+                                          donate_argnums=(1, 2))
 
         if config.multi_step > 1:
             if self._spec:
@@ -563,9 +625,13 @@ class ContinuousBatchingEngine:
         else:
             # suffix-only prefill: ONE fused program pads the cached
             # prefix KV to the target bucket and scores the suffix
-            # chunk at the prefix boundary. Rows past the prefix in
-            # the donor entry are pad garbage, but they are only ever
-            # at positions a future decode writes before attending.
+            # chunk at the prefix boundary. Donor rows past the match
+            # point may hold ANOTHER prompt's live KV (a longest-
+            # common-prefix hit copies the whole entry) — they never
+            # leak only because every row at or above plen_p is
+            # rewritten (by this suffix chunk or a later decode)
+            # before it becomes attendable. Do not weaken that
+            # invariant.
             with self._lock:
                 self.prefix_hits += 1
             cks, cvs, plen_p = hit
@@ -671,6 +737,20 @@ class ContinuousBatchingEngine:
                 slot = free[0]
                 slot.request = request
             ids = request.prompt_ids
+            C = self.config.chunked_prefill_tokens
+            if C > 0 and request.adapter is None:
+                # chunked admission: no blocking prefill — step() will
+                # advance this prompt one chunk at a time. Every chunk
+                # write stays in bounds because add_request truncated
+                # the prompt to _pos_limit = max_seq-1-scratch with
+                # scratch >= C. LoRA requests lack a chunk-program
+                # path and take the blocking prefill below.
+                slot.prefilling = True
+                slot.prefill_ids = list(ids)
+                slot.prefill_pos = 0
+                slot.pos = 0
+                slot.next_token = 0
+                continue
             ks, vs, token = self._run_prefill(
                 ids, request.adapter, request.temperature, request.top_k)
             self.cache_k, self.cache_v = self._insert(
@@ -773,7 +853,8 @@ class ContinuousBatchingEngine:
         past a stop/max_tokens finish are discarded host-side, so
         outputs match single-step decoding exactly."""
         jnp = self._jnp
-        tokens, pos, temp, topk, lora_idx = self._gather_batch(active)
+        tokens, pos, temp, topk, lora_idx = self._gather_batch(
+            active, pos_fill=self.config.max_seq - K)
         self._step_counter += 1
         toks, self.cache_k, self.cache_v = self._decode_multi(
             self.params, self.cache_k, self.cache_v,
@@ -791,13 +872,87 @@ class ContinuousBatchingEngine:
                     break                 # later tokens are discarded
         return len(active)
 
+    def _prefill_chunk_step(self, prefilling, decoding) -> None:
+        """ONE batched llama_verify_step dispatch advances every
+        prefilling slot by a chunk AND decodes every (non-LoRA)
+        decoding slot by one token — a decode is just a 1-token chunk
+        (vLLM's mixed prefill/decode batches). Fusing them matters:
+        separate chunk + decode dispatches doubled the inter-token gap
+        on dispatch-bound links, making chunked prefill slower than
+        the blocking admission it replaces."""
+        jnp = self._jnp
+        C = self.config.chunked_prefill_tokens
+        n = self.config.max_batch
+        park = self.config.max_seq - C  # scratch rows for idle slots
+        # sampling fields come from the shared gather (one copy across
+        # all paths); the chunk overlays its own tokens/positions
+        tokens, pos, temp, topk, _lora = self._gather_batch(
+            prefilling + decoding, pos_fill=park)
+        chunk = np.zeros((n, C), dtype=np.int32)
+        chunk[:, 0] = tokens  # decoding slots: 1-token "chunk"
+        last_idx = np.zeros(n, dtype=np.int32)
+        for slot in prefilling:
+            ids, p = slot.prefill_ids, slot.prefill_pos
+            part = ids[p: p + C]
+            row = np.zeros(C, dtype=np.int32)
+            row[: len(part)] = part
+            chunk[slot.index] = row
+            pos[slot.index] = p
+            last_idx[slot.index] = len(part) - 1
+        self._step_counter += 1
+        tok, self.cache_k, self.cache_v = self._chunk_prefill(
+            self.params, self.cache_k, self.cache_v,
+            jnp.asarray(chunk), jnp.asarray(pos),
+            jnp.asarray(last_idx), jnp.asarray(temp),
+            jnp.asarray(topk), self._base_key, self._step_counter)
+        tok = np.asarray(tok)
+        for slot in prefilling:
+            remaining = len(slot.prefill_ids) - slot.prefill_pos
+            slot.prefill_pos += min(C, remaining)
+            if slot.prefill_pos >= len(slot.prefill_ids):
+                slot.prefilling = False
+                slot.pos = len(slot.prefill_ids)
+                slot.prefill_ids = None
+                slot.next_token = int(tok[slot.index])
+                self._emit(slot, slot.next_token)
+        for slot in decoding:
+            slot.pos += 1
+            slot.next_token = int(tok[slot.index])
+            self._emit(slot, slot.next_token)
+
     def step(self) -> int:
         """Admit + one whole-batch decode step (sampling fused on
         device — only [B] token ids come back). Returns #active slots."""
         self._admit()
-        active = [s for s in self.slots if s.request is not None]
-        if not active:
-            return 0
+        handled = 0
+        if self.config.chunked_prefill_tokens > 0:
+            prefilling = [s for s in self.slots
+                          if s.request is not None and s.prefilling]
+            if prefilling:
+                # fused mixed batch: prefill chunks + 1-token decodes
+                # in one dispatch (LoRA decodes lack a chunk-program
+                # path and fall through to the dense step below)
+                fused_decodes = [
+                    s for s in self.slots
+                    if s.request is not None and not s.prefilling
+                    and s.request.adapter is None]
+                self._prefill_chunk_step(prefilling, fused_decodes)
+                handled = len(prefilling) + len(fused_decodes)
+                active = [s for s in self.slots
+                          if s.request is not None and not s.prefilling
+                          and s.request.adapter is not None]
+                if not active:
+                    return handled
+                # fall through: adapter decodes take the dense step
+            else:
+                active = [s for s in self.slots
+                          if s.request is not None]
+                if not active:
+                    return 0
+        else:
+            active = [s for s in self.slots if s.request is not None]
+            if not active:
+                return 0
         if self._spec and \
                 any(s.request.temperature <= 0.0 for s in active) and \
                 all(s.request.adapter is None for s in active) and \
@@ -810,9 +965,10 @@ class ContinuousBatchingEngine:
         K = self.config.multi_step
         if K > 1 and all(s.pos + K <= self.config.max_seq - 1
                          for s in active):
-            return self._multi_step(active, K)
+            return self._multi_step(active, K) + handled
         jnp = self._jnp
-        tokens, pos, temp, topk, lora_idx = self._gather_batch(active)
+        tokens, pos, temp, topk, lora_idx = self._gather_batch(
+            active, pos_fill=self._dense_park)
         self._step_counter += 1
         sampled, self.cache_k, self.cache_v = self._decode(
             self.params, self.cache_k, self.cache_v,
@@ -832,7 +988,7 @@ class ContinuousBatchingEngine:
             slot.pos += 1
             slot.next_token = int(sampled[slot.index])
             self._emit(slot, slot.next_token)
-        return len(active)
+        return len(active) + handled
 
     # ------------------------------------------------------------------
     def generate(self, prompts_ids: List[List[int]], *,
@@ -875,6 +1031,9 @@ class ContinuousBatchingEngine:
             slot.pos = 0
             slot.next_token = 0
             slot.draft_ready = True  # caches reset below
+            slot.prefilling = False
+            slot.prefill_ids = None
+            slot.prefill_pos = 0
         self.cache_k, self.cache_v = llama_init_cache(
             self.config.model, self.config.max_batch, self.config.max_seq)
         if self._spec:
@@ -893,6 +1052,9 @@ class ContinuousBatchingEngine:
                 "waiting": len(self.waiting),
                 "active": sum(1 for s in self.slots
                               if s.request is not None),
+                "prefilling": sum(1 for s in self.slots
+                                  if s.request is not None
+                                  and s.prefilling),
                 "max_batch": self.config.max_batch,
                 "total_generated": self.total_generated,
             }

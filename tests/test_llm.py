@@ -775,3 +775,61 @@ def test_prefix_cache_lru_and_min_tokens():
         cached.generate([[base, base + 1, base + 2, base + 3]],
                         max_tokens=2)
     assert cached.stats()["prefix_cache_entries"] == 2  # LRU capped
+
+
+# ----------------------------------------------------- chunked prefill
+
+def test_chunked_prefill_matches_blocking():
+    """Chunked prompt processing must produce the exact greedy outputs
+    of blocking whole-prompt prefill."""
+    plain = tiny_engine(max_batch=2)
+    prompts = [list(range(1, 21)), list(range(30, 37))]
+    want = plain.generate(prompts, max_tokens=9)
+    chunked = tiny_engine(max_batch=2, chunked_prefill_tokens=8)
+    got = chunked.generate(prompts, max_tokens=9)
+    assert got == want
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """A long prompt admitted mid-stream must NOT stall an ongoing
+    decode: the decoding request keeps emitting while the newcomer's
+    prompt advances chunk by chunk."""
+    engine = tiny_engine(max_batch=2, chunked_prefill_tokens=4)
+    r1 = engine.add_request(GenerationRequest(prompt_ids=[1, 2, 3],
+                                              max_tokens=30))
+    engine.step()  # r1 admitted (instant: 3 < chunk? still chunked path)
+    while not r1.output_ids:
+        engine.step()
+    baseline = len(r1.output_ids)
+    r2 = engine.add_request(GenerationRequest(
+        prompt_ids=list(range(1, 17)), max_tokens=4))  # 4 chunks
+    for _ in range(3):
+        engine.step()
+    # r1 kept decoding during r2's chunked prefill rounds
+    assert len(r1.output_ids) >= baseline + 3
+    while not (r1.done and r2.done):
+        engine.step()
+    assert len(r2.output_ids) == 4
+
+
+def test_chunked_prefill_overflow_and_sampling():
+    engine = tiny_engine(max_batch=2, chunked_prefill_tokens=4)
+    prompts = [list(range(1, 11)), [5, 6], list(range(20, 33)), [9]]
+    outs = engine.generate(prompts, max_tokens=6, temperature=0.8,
+                           top_k=30)
+    assert [len(o) for o in outs] == [6, 6, 6, 6]
+    assert engine.stats()["prefilling"] == 0
+
+
+def test_chunked_prefill_config_validation():
+    target, draft = _spec_cfgs()
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ContinuousBatchingEngine(EngineConfig(
+            model=target, draft_model=draft, chunked_prefill_tokens=8))
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ContinuousBatchingEngine(EngineConfig(
+            model=target, enable_prefix_caching=True,
+            chunked_prefill_tokens=8))
+    with pytest.raises(ValueError, match="max_seq"):
+        ContinuousBatchingEngine(EngineConfig(
+            model=target, max_seq=64, chunked_prefill_tokens=128))
