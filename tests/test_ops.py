@@ -4,6 +4,7 @@ kernel paths are exercised by bench.py on hardware)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ray_tpu.ops.attention import _attention_reference, flash_attention
 from ray_tpu.ops.rmsnorm import _rms_norm_reference, rms_norm
@@ -99,3 +100,33 @@ def test_flash_kernels_interpret_vs_reference():
                                            atol=5e-3)
     finally:
         att._INTERPRET = prev
+
+
+def test_int8_matmul_kernel_interpret_vs_reference():
+    # The weight-only int8 Pallas kernel in interpreter mode vs the
+    # dequantized jnp reference (same path hardware uses).
+    from ray_tpu.ops import quant_matmul as qm
+
+    prev = qm._INTERPRET
+    qm._INTERPRET = True
+    try:
+        key = jax.random.PRNGKey(0)
+        w = jax.random.normal(key, (1024, 1024), jnp.float32) * 0.05
+        x = jax.random.normal(key, (5, 1024), jnp.bfloat16)
+        w8, scale = qm.quantize_int8(w)
+        # quantization itself is sound
+        np.testing.assert_allclose(
+            np.asarray(w8.astype(jnp.float32) * scale[None, :]),
+            np.asarray(w), atol=float(np.max(np.abs(np.asarray(w)))) / 100)
+        got = qm.int8_matmul(x, w8, scale, block_n=512, block_k=512)
+        ref = x.astype(jnp.float32) @ (w8.astype(jnp.float32)
+                                       * scale[None, :])
+        rel = (np.max(np.abs(np.asarray(got, np.float32) - np.asarray(ref)))
+               / (np.max(np.abs(np.asarray(ref))) + 1e-9))
+        assert rel < 2e-2, rel
+        # odd batch row counts pad internally and slice back
+        assert qm.int8_matmul(x[:1], w8, scale).shape == (1, 1024)
+        with pytest.raises(ValueError, match="divide"):
+            qm.int8_matmul(x, w8[:, :1000], scale[:1000])
+    finally:
+        qm._INTERPRET = prev
