@@ -1046,6 +1046,35 @@ class ContinuousBatchingEngine:
             with self._lock:
                 self._prefix_cache.clear()
 
+    _embed_fn = None  # built lazily on first embed()
+
+    def embed(self, prompt_ids: List[int]) -> np.ndarray:
+        """Mean-pooled final-norm hidden state for a prompt — the
+        embedding surface (reference: serve/llm embeddings via vLLM
+        embedding models). Pure read of the params; safe to call
+        concurrently with the stepper thread."""
+        jax, jnp = self._jax, self._jnp
+        ids = list(prompt_ids)[-self.config.max_seq:]
+        if not ids:
+            raise ValueError("cannot embed an empty prompt")
+        if self._embed_fn is None:
+            c = self.config.model
+            from ray_tpu.models.llama import llama_forward
+
+            def emb(params, tokens, n):
+                h = llama_forward(params, tokens, c,
+                                  return_hidden=True)       # [1, S, D]
+                mask = (jnp.arange(tokens.shape[1])
+                        < n)[None, :, None].astype(h.dtype)
+                pooled = (jnp.sum(h * mask, axis=1)
+                          / jnp.maximum(n, 1).astype(h.dtype))
+                return pooled[0].astype(jnp.float32)
+
+            self._embed_fn = jax.jit(emb)
+        return np.asarray(self._embed_fn(
+            self.params, jnp.asarray(self._pad_bucket(ids)),
+            jnp.asarray(len(ids), jnp.int32)))
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             out = {

@@ -257,6 +257,8 @@ class LLMServer:
             return self.chat_completions(request)
         if path.endswith("/completions"):
             return self.completions(request)
+        if path.endswith("/embeddings"):
+            return self.embeddings(request)
         if path.endswith("/models"):
             return {"object": "list",
                     "data": [{"id": self.config.model_id,
@@ -264,6 +266,44 @@ class LLMServer:
         if path.endswith("/stats"):
             return self.engine.stats()
         return {"error": f"unknown route {path!r}"}
+
+    def embeddings(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI /v1/embeddings: mean-pooled final hidden states
+        (reference: serve/llm embedding model support via vLLM)."""
+        raw = body.get("input", "")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, (list, tuple)):
+            inputs = list(raw)
+        else:
+            return self._invalid_request(ValueError(
+                "input must be a string or a list of strings"))
+        if not inputs or not all(isinstance(t, str) and t
+                                 for t in inputs):
+            return self._invalid_request(ValueError(
+                "input must be a non-empty string or list of them"))
+        limit = self.config.engine.max_seq
+        data = []
+        total = 0
+        for i, text in enumerate(inputs):
+            ids = self.tokenizer.encode(text)
+            if len(ids) > limit:
+                # OpenAI returns a context-length error here; silent
+                # tail-truncation would hand back an embedding of the
+                # document's end labeled as the whole document
+                return self._invalid_request(ValueError(
+                    f"input {i} is {len(ids)} tokens; this model's "
+                    f"maximum context is {limit}"))
+            total += len(ids)
+            vec = self.engine.embed(ids)
+            data.append({"object": "embedding", "index": i,
+                         "embedding": [float(x) for x in vec]})
+        return {
+            "object": "list",
+            "model": body.get("model", self.config.model_id),
+            "data": data,
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        }
 
     def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         prompt = body.get("prompt", "")

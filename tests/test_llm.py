@@ -833,3 +833,72 @@ def test_chunked_prefill_config_validation():
     with pytest.raises(ValueError, match="max_seq"):
         ContinuousBatchingEngine(EngineConfig(
             model=target, max_seq=64, chunked_prefill_tokens=128))
+
+
+# ----------------------------------------------------- embeddings
+
+def test_engine_embed_shapes_and_determinism():
+    engine = tiny_engine()
+    v1 = engine.embed([1, 2, 3, 4])
+    v2 = engine.embed([1, 2, 3, 4])
+    v3 = engine.embed([9, 8])
+    dim = engine.config.model.dim
+    assert v1.shape == (dim,)
+    assert np.allclose(v1, v2)
+    assert not np.allclose(v1, v3)
+    with pytest.raises(ValueError):
+        engine.embed([])
+
+
+def test_openai_embeddings_endpoint(ray_start_shared):
+    from ray_tpu.serve.llm import LLMConfig, build_openai_app
+    config = LLMConfig(
+        model_id="embed-test",
+        engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=2, max_seq=64),
+        max_tokens=8)
+    serve.start(proxy=True, http_options=serve.HTTPOptions(port=0))
+    from ray_tpu import serve as serve_mod
+    port = serve_mod._proxy.port
+    serve.run(build_openai_app(config=config), name="emb_app",
+              route_prefix="/v1")
+    try:
+        body = json.dumps({"input": ["hello", "world"]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/embeddings", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            payload = json.loads(resp.read())
+        assert payload["object"] == "list"
+        assert [d["index"] for d in payload["data"]] == [0, 1]
+        dim = config.engine.model.dim
+        assert all(len(d["embedding"]) == dim for d in payload["data"])
+        assert payload["data"][0]["embedding"] != \
+            payload["data"][1]["embedding"]
+        assert payload["usage"]["prompt_tokens"] > 0
+    finally:
+        serve.shutdown()
+
+
+def test_embeddings_input_validation(ray_start_shared):
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    config = LLMConfig(
+        model_id="embed-val",
+        engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=1, max_seq=64),
+        max_tokens=4)
+    server = LLMServer(config)
+    try:
+        for bad in (123, None, [], [""], [1, 2]):
+            out = server.embeddings({"input": bad})
+            assert out["error"]["type"] == "invalid_request_error", bad
+        # over-length input: context error, not silent tail truncation
+        out = server.embeddings({"input": "x" * 500})
+        assert out["error"]["type"] == "invalid_request_error"
+        assert "maximum context" in out["error"]["message"]
+    finally:
+        server.stop()
