@@ -113,6 +113,12 @@ class GenerationRequest:
     temperature: float = 0.0
     top_k: int = 0
     stop_ids: tuple = ()
+    # OpenAI-style logit bias: {token_id: bias} added to the target
+    # logits before sampling, every step (values clamped to +-100).
+    # Applied in ALL decode paths; speculative drafts propose without
+    # it, so a bias that changes the argmax lowers draft acceptance
+    # but never affects outputs.
+    logit_bias: Optional[Dict[int, float]] = None
     # LoRA adapter name (must be register_adapter'd); None = base model
     adapter: Optional[str] = None
     request_id: int = field(default_factory=itertools.count().__next__)
@@ -167,6 +173,21 @@ class ContinuousBatchingEngine:
         self.params = params
         self.cache_k, self.cache_v = llama_init_cache(
             c, config.max_batch, config.max_seq)
+        # per-slot logit_bias rows, device-resident so the per-step
+        # cost is one [B, V] add — rows are (re)set at admission, so
+        # stale rows from finished requests are never read
+        self._bias = jnp.zeros((config.max_batch, c.vocab_size),
+                               jnp.float32)
+
+        def set_bias_row(bias, row, idx):
+            return jax.lax.dynamic_update_slice(
+                bias, row[None, :], (idx, 0))
+
+        # idx stays a traced operand: dynamic_update_slice takes
+        # dynamic starts, so ONE compile covers every slot (a static
+        # idx would compile per slot index)
+        self._set_bias = jax.jit(set_bias_row, donate_argnums=(0,))
+        self._zero_bias_row = jnp.zeros((c.vocab_size,), jnp.float32)
         # Scratch region: every batched dispatch writes K/V rows for
         # ALL slots, so slots not participating park their writes in
         # the cache tail. Those rows must never hold live history —
@@ -240,11 +261,13 @@ class ContinuousBatchingEngine:
 
         max_k = min(config.max_top_k, c.vocab_size)
 
-        def sample_tokens(logits, temp, topk, key):
+        def sample_tokens(logits, temp, topk, key, bias=None):
             """On-device sampling: greedy / temperature / top-k per
             slot, [B, V] logits -> [B] int32 — only the token ids cross
-            to the host."""
+            to the host. ``bias`` [B, V] is the per-slot logit_bias."""
             n_b = logits.shape[0]
+            if bias is not None:
+                logits = logits + bias
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
             keys = jax.random.split(key, n_b)
@@ -261,20 +284,21 @@ class ContinuousBatchingEngine:
             return jnp.where(temp <= 0.0, greedy, sampled)
 
         def decode(params, cache_k, cache_v, tokens, pos, temp, topk,
-                   base_key, step, lora_bank, lora_idx):
+                   base_key, step, lora_bank, lora_idx, bias):
             logits, ck, cv = llama_decode_step(
                 params, tokens, cache_k, cache_v, pos, c,
                 lora_bank=lora_bank, lora_idx=lora_idx)
             key = jax.random.fold_in(base_key, step)
-            return sample_tokens(logits, temp, topk, key), ck, cv
+            return sample_tokens(logits, temp, topk, key, bias), ck, cv
 
         def prefill(params, tokens, lora):
             return llama_prefill(params, tokens, c, lora=lora)
 
-        def sample_one(logits, temp, topk, key):
+        def sample_one(logits, temp, topk, key, bias_row):
             return sample_tokens(
                 logits[None, :], jnp.full((1,), temp),
-                jnp.full((1,), topk, dtype=jnp.int32), key)[0]
+                jnp.full((1,), topk, dtype=jnp.int32), key,
+                bias_row[None, :])[0]
 
         def insert(cache_k, cache_v, ks, vs, slot):
             # in-place (donated) slot write — no whole-cache copy.
@@ -333,7 +357,7 @@ class ContinuousBatchingEngine:
                                  "enable_prefix_caching are mutually "
                                  "exclusive")
             def chunk_prefill(tparams, ck, cv, chunk, pos, last_idx,
-                              temp, topk, base_key, step):
+                              temp, topk, base_key, step, bias):
                 """One C-token prefill chunk for every prefilling slot
                 (idle/decoding slots park their writes); returns the
                 sampled first token per slot, used only for slots
@@ -343,7 +367,7 @@ class ContinuousBatchingEngine:
                 sel = jnp.take_along_axis(
                     logits, last_idx[:, None, None], axis=1)[:, 0]
                 key = jax.random.fold_in(base_key, step)
-                tok = sample_tokens(sel, temp, topk, key)
+                tok = sample_tokens(sel, temp, topk, key, bias)
                 return tok, ck, cv
 
             self._chunk_prefill = jax.jit(chunk_prefill,
@@ -357,7 +381,7 @@ class ContinuousBatchingEngine:
 
             def decode_multi(params, cache_k, cache_v, tokens, pos,
                              temp, topk, base_key, step,
-                             lora_bank, lora_idx):
+                             lora_bank, lora_idx, bias):
                 """K fused decode iterations — one dispatch for up to
                 K tokens per slot."""
                 round_key = jax.random.fold_in(base_key, step)
@@ -368,7 +392,7 @@ class ContinuousBatchingEngine:
                         params, tok, ck, cv, pos + i, c,
                         lora_bank=lora_bank, lora_idx=lora_idx)
                     key = jax.random.fold_in(round_key, i)
-                    nxt = sample_tokens(logits, temp, topk, key)
+                    nxt = sample_tokens(logits, temp, topk, key, bias)
                     return (nxt, ck, cv), nxt
 
                 (_, ck, cv), toks = jax.lax.scan(
@@ -413,12 +437,14 @@ class ContinuousBatchingEngine:
                 return ck, cv
 
             def verify(tparams, ck, cv, chunk, pos, temp, topk,
-                       base_key, step):
+                       base_key, step, bias):
                 logits, ck, cv = llama_verify_step(
                     tparams, chunk, ck, cv, pos, c)
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                greedy = jnp.argmax(logits + bias[:, None, :],
+                                    axis=-1).astype(jnp.int32)
                 key = jax.random.fold_in(base_key, step)
-                first = sample_tokens(logits[:, 0], temp, topk, key)
+                first = sample_tokens(logits[:, 0], temp, topk, key,
+                                      bias)
                 return greedy, first, ck, cv
 
             self._draft_propose = jax.jit(draft_propose,
@@ -502,7 +528,8 @@ class ContinuousBatchingEngine:
 
     def prefill_only(self, prompt_ids: List[int], *,
                      temperature: float = 0.0, top_k: int = 0,
-                     adapter: Optional[str] = None):
+                     adapter: Optional[str] = None,
+                     logit_bias: Optional[Dict[int, float]] = None):
         """Prefill without occupying a decode slot — the PREFILL side of
         prefill/decode disaggregation (reference: serve/llm
         prefill-decode disagg deployments). Returns numpy
@@ -512,8 +539,13 @@ class ContinuousBatchingEngine:
         ids = list(prompt_ids)[-limit:]
         if adapter is not None and adapter not in self._adapters:
             raise ValueError(f"unknown LoRA adapter {adapter!r}")
+        bias_row = None
+        if logit_bias:
+            self._validate_logit_bias(logit_bias)
+            fake = GenerationRequest(prompt_ids=[], logit_bias=logit_bias)
+            bias_row = self._bias_row(fake)
         ks, vs, token = self._run_prefill(ids, adapter, temperature,
-                                          top_k)
+                                          top_k, bias_row=bias_row)
         return (np.asarray(ks), np.asarray(vs), len(ids), token)
 
     def add_prefilled(self, request: GenerationRequest, ks, vs,
@@ -531,6 +563,7 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prefilled KV bucket ({ks.shape[2]}) exceeds this "
                 f"engine's max_seq ({self.config.max_seq})")
+        self._validate_logit_bias(request.logit_bias)
         if request.adapter is not None:
             self._adapter_index(request)  # fail fast: an unknown
             # adapter raising inside step() would fail_all the replica
@@ -542,6 +575,7 @@ class ContinuousBatchingEngine:
         return request
 
     def add_request(self, request: GenerationRequest) -> GenerationRequest:
+        self._validate_logit_bias(request.logit_bias)
         limit = self._pos_limit
         if len(request.prompt_ids) > limit:
             request.prompt_ids = request.prompt_ids[-limit:]
@@ -577,6 +611,7 @@ class ContinuousBatchingEngine:
                 request, ks, vs, plen, tok = self._prefilled_waiting.pop(0)
                 slot = free[0]
                 slot.request = request
+            self._install_bias(request, slot.index)
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, jnp.asarray(ks),
                 jnp.asarray(vs), slot.index)
@@ -599,7 +634,8 @@ class ContinuousBatchingEngine:
             self._emit(slot, tok)
 
     def _run_prefill(self, ids: List[int], adapter: Optional[str],
-                     temperature: float, top_k: int):
+                     temperature: float, top_k: int,
+                     bias_row=None):
         """Shared prefill: bucket/pad the prompt, run the jitted
         prefill, sample the first token. Both the colocated admit path
         and prefill_only (disaggregation) call this — one copy, so the
@@ -645,12 +681,49 @@ class ContinuousBatchingEngine:
                 jnp.asarray([plen_p], dtype=jnp.int32), bucket=bucket)
             last_logits = logits[0, len(suffix) - 1]
         self._step_counter += 1
+        bias_dev = (self._zero_bias_row if bias_row is None
+                    else jnp.asarray(bias_row))
         token = self._sample_one(
             last_logits, float(temperature), int(top_k),
-            self._jax.random.fold_in(self._base_key, self._step_counter))
+            self._jax.random.fold_in(self._base_key, self._step_counter),
+            bias_dev)
         if use_cache:
             self._store_prefix(ids, ks, vs)
         return ks, vs, int(token)
+
+    def _validate_logit_bias(self, logit_bias) -> None:
+        """Reject out-of-vocab ids on the CALLER's thread — every
+        admission entry point (add_request, add_prefilled,
+        prefill_only) funnels through this, because a raise inside the
+        stepper's _admit would fail_all the whole replica, and a
+        negative id would silently wrap to the vocab tail in numpy
+        indexing."""
+        if not logit_bias:
+            return
+        vocab = self.config.model.vocab_size
+        for tid in logit_bias:
+            if not 0 <= int(tid) < vocab:
+                raise ValueError(
+                    f"logit_bias token id {tid} outside vocab "
+                    f"[0, {vocab})")
+
+    def _bias_row(self, request: GenerationRequest) -> np.ndarray:
+        """Dense [V] f32 bias row from the request's sparse
+        logit_bias (values clamped to the OpenAI +-100 range; ids
+        outside the vocab rejected at add_request)."""
+        row = np.zeros(self.config.model.vocab_size, dtype=np.float32)
+        for tid, val in (request.logit_bias or {}).items():
+            row[int(tid)] = float(np.clip(val, -100.0, 100.0))
+        return row
+
+    def _install_bias(self, request: GenerationRequest,
+                      slot_index: int) -> None:
+        if request.logit_bias:
+            row = self._jnp.asarray(self._bias_row(request))
+        else:
+            row = self._zero_bias_row  # no per-request host build/copy
+        self._bias = self._set_bias(self._bias, row,
+                                    self._jnp.asarray(slot_index))
 
     def _bucket_len(self, n: int) -> int:
         bucket = 1
@@ -737,6 +810,7 @@ class ContinuousBatchingEngine:
                 slot = free[0]
                 slot.request = request
             ids = request.prompt_ids
+            self._install_bias(request, slot.index)
             C = self.config.chunked_prefill_tokens
             if C > 0 and request.adapter is None:
                 # chunked admission: no blocking prefill — step() will
@@ -752,7 +826,8 @@ class ContinuousBatchingEngine:
                 slot.next_token = 0
                 continue
             ks, vs, token = self._run_prefill(
-                ids, request.adapter, request.temperature, request.top_k)
+                ids, request.adapter, request.temperature,
+                request.top_k, bias_row=self._bias_row(request))
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot.index)
             if self._spec:
@@ -824,7 +899,7 @@ class ContinuousBatchingEngine:
             self._verify(self.params, self.cache_k, self.cache_v,
                          chunk, pos_j, jnp.asarray(temp),
                          jnp.asarray(topk), self._base_key,
-                         self._step_counter)
+                         self._step_counter, self._bias)
         greedy = np.asarray(greedy)                          # [B, G]
         first_sampled = np.asarray(first_sampled)            # [B]
         drafts_np = np.asarray(drafts_dev).T                 # [B, G-1]
@@ -861,7 +936,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(tokens), jnp.asarray(pos),
             jnp.asarray(temp), jnp.asarray(topk),
             self._base_key, self._step_counter,
-            self.lora_bank, jnp.asarray(lora_idx))
+            self.lora_bank, jnp.asarray(lora_idx), self._bias)
         toks = np.asarray(toks)                          # [K, B]
         for slot in active:
             for k in range(K):
@@ -904,7 +979,8 @@ class ContinuousBatchingEngine:
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(chunk), jnp.asarray(pos),
             jnp.asarray(last_idx), jnp.asarray(temp),
-            jnp.asarray(topk), self._base_key, self._step_counter)
+            jnp.asarray(topk), self._base_key, self._step_counter,
+            self._bias)
         tok = np.asarray(tok)
         for slot in prefilling:
             remaining = len(slot.prefill_ids) - slot.prefill_pos
@@ -975,7 +1051,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(tokens), jnp.asarray(pos),
             jnp.asarray(temp), jnp.asarray(topk),
             self._base_key, self._step_counter,
-            self.lora_bank, jnp.asarray(lora_idx))
+            self.lora_bank, jnp.asarray(lora_idx), self._bias)
         if self._spec:
             # keep the draft cache in lockstep through dense rounds,
             # or the next _spec_step would condition on KV gaps

@@ -147,6 +147,29 @@ class LLMServer:
         # top-k width anyway, but a sane bound keeps intent clear
         out["top_k"] = min(top_k, self.config.engine.model.vocab_size)
         out["adapter"] = self._resolve_adapter(body.get("model"))
+        lb = body.get("logit_bias")
+        if lb is not None:
+            if not isinstance(lb, dict):
+                raise ValueError("logit_bias must be an object of "
+                                 "{token_id: bias}")
+            vocab = self.config.engine.model.vocab_size
+            clean = {}
+            for tid, val in lb.items():
+                try:
+                    t = int(tid)
+                except (TypeError, ValueError):
+                    raise ValueError(
+                        f"logit_bias key {tid!r} is not a token id")
+                if not 0 <= t < vocab:
+                    raise ValueError(
+                        f"logit_bias token id {t} outside vocab "
+                        f"[0, {vocab})")
+                if isinstance(val, bool) or \
+                        not isinstance(val, (int, float)):
+                    raise ValueError(
+                        f"logit_bias value for {t} must be a number")
+                clean[t] = float(val)
+            out["logit_bias"] = clean
         return out
 
     def register_adapter(self, name: str, lora_params) -> None:
@@ -190,7 +213,9 @@ class LLMServer:
     def _generate(self, prompt: str, *, max_tokens: Optional[int] = None,
                   temperature: Optional[float] = None,
                   top_k: int = 0,
-                  adapter: Optional[str] = None) -> Dict[str, Any]:
+                  adapter: Optional[str] = None,
+                  logit_bias: Optional[Dict[int, float]] = None
+                  ) -> Dict[str, Any]:
         ids = self.tokenizer.encode(prompt)
         request = GenerationRequest(
             prompt_ids=ids,
@@ -199,6 +224,7 @@ class LLMServer:
                          else temperature),
             top_k=top_k,
             adapter=adapter,
+            logit_bias=logit_bias,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else ())
         self.engine.add_request(request)
@@ -226,7 +252,8 @@ class LLMServer:
                          max_tokens: Optional[int] = None,
                          temperature: Optional[float] = None,
                          top_k: int = 0,
-                         adapter: Optional[str] = None):
+                         adapter: Optional[str] = None,
+                         logit_bias: Optional[Dict[int, float]] = None):
         """Yield decoded text per emitted token (reference: vLLM output
         streams behind serve token streaming). The engine's stepper
         pushes each token onto the request's queue as it decodes."""
@@ -240,6 +267,7 @@ class LLMServer:
                          else temperature),
             top_k=top_k,
             adapter=adapter,
+            logit_bias=logit_bias,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else (),
             stream_queue=queue.Queue())
@@ -320,7 +348,8 @@ class LLMServer:
             max_tokens=sampling.get("max_tokens"),
             temperature=sampling.get("temperature"),
             top_k=sampling["top_k"],
-            adapter=sampling.get("adapter"))
+            adapter=sampling.get("adapter"),
+            logit_bias=sampling.get("logit_bias"))
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -350,7 +379,8 @@ class LLMServer:
                 prompt, max_tokens=sampling.get("max_tokens"),
                 temperature=sampling.get("temperature"),
                 top_k=sampling["top_k"],
-            adapter=sampling.get("adapter")):
+                adapter=sampling.get("adapter"),
+                logit_bias=sampling.get("logit_bias")):
             chunk = {"id": cmpl_id, "object": "text_completion",
                      "model": model,
                      "choices": [{"index": 0, "text": text,
@@ -378,7 +408,8 @@ class LLMServer:
                 prompt, max_tokens=sampling.get("max_tokens"),
                 temperature=sampling.get("temperature"),
                 top_k=sampling["top_k"],
-            adapter=sampling.get("adapter")):
+                adapter=sampling.get("adapter"),
+                logit_bias=sampling.get("logit_bias")):
             chunk = {"id": chat_id, "object": "chat.completion.chunk",
                      "model": model,
                      "choices": [{"index": 0, "delta": {"content": text},
@@ -413,7 +444,8 @@ class LLMServer:
             max_tokens=sampling.get("max_tokens"),
             temperature=sampling.get("temperature"),
             top_k=sampling["top_k"],
-            adapter=sampling.get("adapter"))
+            adapter=sampling.get("adapter"),
+            logit_bias=sampling.get("logit_bias"))
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
