@@ -43,10 +43,13 @@ class PrefillServer:
 
     def prefill(self, prompt: str, *, temperature: float = 0.0,
                 top_k: int = 0,
-                adapter: Optional[str] = None) -> Dict[str, Any]:
+                adapter: Optional[str] = None,
+                logit_bias: Optional[Dict[int, float]] = None
+                ) -> Dict[str, Any]:
         ids = self.tokenizer.encode(prompt)
         ks, vs, prompt_len, first_token = self.engine.prefill_only(
-            ids, temperature=temperature, top_k=top_k, adapter=adapter)
+            ids, temperature=temperature, top_k=top_k, adapter=adapter,
+            logit_bias=logit_bias)
         return {"ks": ks, "vs": vs, "prompt_len": prompt_len,
                 "first_token": first_token, "prompt_tokens": len(ids)}
 
@@ -73,6 +76,7 @@ class DecodeServer(LLMServer):
     def _adopt_prefilled(self, prefill_out: Dict[str, Any], *,
                          max_tokens: int, temperature: float,
                          top_k: int, adapter: Optional[str],
+                         logit_bias: Optional[Dict[int, float]] = None,
                          stream_queue=None) -> GenerationRequest:
         request = GenerationRequest(
             prompt_ids=[],  # KV already computed; ids not needed
@@ -80,6 +84,7 @@ class DecodeServer(LLMServer):
             temperature=temperature,
             top_k=top_k,
             adapter=adapter,
+            logit_bias=logit_bias,
             stream_queue=stream_queue,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else ())
@@ -92,7 +97,9 @@ class DecodeServer(LLMServer):
     def decode_prefilled_stream(self, prefill_out: Any, *,
                                 max_tokens: int, temperature: float = 0.0,
                                 top_k: int = 0,
-                                adapter: Optional[str] = None):
+                                adapter: Optional[str] = None,
+                                logit_bias: Optional[Dict[int, float]]
+                                = None):
         """Streaming disagg decode: yields text deltas as tokens land,
         then one final dict carrying finish_reason + usage (reference:
         python/ray/serve/llm streaming surface over disaggregated
@@ -120,11 +127,13 @@ class DecodeServer(LLMServer):
     def decode_prefilled(self, prefill_out: Any, *,
                          max_tokens: int, temperature: float = 0.0,
                          top_k: int = 0,
-                         adapter: Optional[str] = None) -> Dict[str, Any]:
+                         adapter: Optional[str] = None,
+                         logit_bias: Optional[Dict[int, float]] = None
+                         ) -> Dict[str, Any]:
         prefill_out = self._materialize_prefill(prefill_out)
         request = self._adopt_prefilled(
             prefill_out, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, adapter=adapter)
+            top_k=top_k, adapter=adapter, logit_bias=logit_bias)
         while not request.done:
             time.sleep(0.001)
         if request.error is not None:
@@ -190,13 +199,24 @@ class DisaggRouter:
         temperature = sampling.get("temperature",
                                    self.config.temperature)
         top_k = sampling["top_k"]
+        if sampling.get("stop"):
+            # stop STRINGS need incremental text inspection on the
+            # router — not offered on the disagg surface yet; reject
+            # loudly instead of silently decoding through the stop
+            return {"error": {
+                "message": "stop strings are not supported on the "
+                           "disaggregated deployment; use stop token "
+                           "ids via the engine API",
+                "type": "invalid_request_error"}}
         decode_kwargs = dict(
             max_tokens=sampling.get("max_tokens", self.config.max_tokens),
             temperature=temperature, top_k=top_k,
-            adapter=sampling.get("adapter"))
+            adapter=sampling.get("adapter"),
+            logit_bias=sampling.get("logit_bias"))
         prefill_ref = self.prefill.prefill.remote(
             prompt, temperature=temperature, top_k=top_k,
-            adapter=sampling.get("adapter"))
+            adapter=sampling.get("adapter"),
+            logit_bias=sampling.get("logit_bias"))
         if body.get("stream"):
             return self._stream_completions(body, prefill_ref,
                                             decode_kwargs)

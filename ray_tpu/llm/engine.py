@@ -827,7 +827,9 @@ class ContinuousBatchingEngine:
                 continue
             ks, vs, token = self._run_prefill(
                 ids, request.adapter, request.temperature,
-                request.top_k, bias_row=self._bias_row(request))
+                request.top_k,
+                bias_row=(self._bias_row(request)
+                          if request.logit_bias else None))
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot.index)
             if self._spec:
@@ -839,6 +841,11 @@ class ContinuousBatchingEngine:
 
     def _emit(self, slot: _Slot, token: int) -> None:
         request = slot.request
+        if request.done:
+            # cancelled from another thread mid-step: discard the
+            # token and release the slot
+            slot.request = None
+            return
         request.output_ids.append(token)
         self.total_generated += 1
         if token in request.stop_ids:
@@ -1123,6 +1130,26 @@ class ContinuousBatchingEngine:
                 self._prefix_cache.clear()
 
     _embed_fn = None  # built lazily on first embed()
+
+    def cancel(self, request: GenerationRequest,
+               finish_reason: str = "abort") -> None:
+        """Finish a request early from ANY thread (serve stop-string
+        hit, client disconnect). Queued requests are withdrawn
+        immediately; an active request is marked done and its slot is
+        released by the stepper at the request's next emission — no
+        cross-thread slot mutation, so no race with a step in flight
+        (at most one more token is decoded and discarded)."""
+        with self._lock:
+            if request.done:
+                return
+            try:
+                self.waiting.remove(request)
+            except ValueError:
+                pass
+            self._prefilled_waiting[:] = [
+                e for e in self._prefilled_waiting if e[0] is not request]
+            request.finish_reason = finish_reason
+        request.push_stream(None)
 
     def embed(self, prompt_ids: List[int]) -> np.ndarray:
         """Mean-pooled final-norm hidden state for a prompt — the

@@ -165,12 +165,48 @@ class LLMServer:
                         f"logit_bias token id {t} outside vocab "
                         f"[0, {vocab})")
                 if isinstance(val, bool) or \
-                        not isinstance(val, (int, float)):
+                        not isinstance(val, (int, float)) or \
+                        not math.isfinite(float(val)):
                     raise ValueError(
-                        f"logit_bias value for {t} must be a number")
+                        f"logit_bias value for {t} must be a finite "
+                        "number")
                 clean[t] = float(val)
             out["logit_bias"] = clean
+        stop = body.get("stop")
+        if stop is not None:
+            if isinstance(stop, str):
+                stop = [stop]
+            if (not isinstance(stop, list) or not stop or len(stop) > 4
+                    or not all(isinstance(s, str) and s for s in stop)):
+                raise ValueError("stop must be a non-empty string or "
+                                 "a list of 1-4 non-empty strings")
+            out["stop"] = list(stop)
         return out
+
+    def _make_request(self, prompt: str, *, max_tokens, temperature,
+                      top_k, adapter, logit_bias, stream_queue=None):
+        """ONE construction + admission path for all generate
+        variants (non-stream, stop-string, stream) so a new sampling
+        field cannot desync them."""
+        ids = self.tokenizer.encode(prompt)
+        request = GenerationRequest(
+            prompt_ids=ids,
+            max_tokens=max_tokens or self.config.max_tokens,
+            temperature=(self.config.temperature if temperature is None
+                         else temperature),
+            top_k=top_k,
+            adapter=adapter,
+            logit_bias=logit_bias,
+            stop_ids=(self.tokenizer.eos_id,)
+            if self.tokenizer.eos_id is not None else (),
+            stream_queue=stream_queue)
+        self.engine.add_request(request)
+        self._wake.set()
+        if self._stopped:
+            # raced an LRU eviction: stop() set _stopped before its
+            # fail_all; covering a request admitted after that sweep
+            self.engine.fail_all("model evicted from replica")
+        return ids, request
 
     def register_adapter(self, name: str, lora_params) -> None:
         """Serve a LoRA adapter as an additional model id (reference:
@@ -214,27 +250,17 @@ class LLMServer:
                   temperature: Optional[float] = None,
                   top_k: int = 0,
                   adapter: Optional[str] = None,
-                  logit_bias: Optional[Dict[int, float]] = None
+                  logit_bias: Optional[Dict[int, float]] = None,
+                  stop: Optional[List[str]] = None
                   ) -> Dict[str, Any]:
-        ids = self.tokenizer.encode(prompt)
-        request = GenerationRequest(
-            prompt_ids=ids,
-            max_tokens=max_tokens or self.config.max_tokens,
-            temperature=(self.config.temperature if temperature is None
-                         else temperature),
-            top_k=top_k,
-            adapter=adapter,
-            logit_bias=logit_bias,
-            stop_ids=(self.tokenizer.eos_id,)
-            if self.tokenizer.eos_id is not None else ())
-        self.engine.add_request(request)
-        self._wake.set()
-        if self._stopped:
-            # Raced an LRU eviction: stop() set _stopped before its
-            # fail_all, so failing again here covers a request admitted
-            # after that sweep (it would otherwise never finish — no
-            # stepper is alive).
-            self.engine.fail_all("model evicted from replica")
+        if stop:
+            return self._generate_with_stop(
+                prompt, max_tokens=max_tokens, temperature=temperature,
+                top_k=top_k, adapter=adapter, logit_bias=logit_bias,
+                stop=stop)
+        ids, request = self._make_request(
+            prompt, max_tokens=max_tokens, temperature=temperature,
+            top_k=top_k, adapter=adapter, logit_bias=logit_bias)
         while not request.done:
             time.sleep(0.001)
         if request.error is not None:
@@ -248,35 +274,82 @@ class LLMServer:
             "finish_reason": request.finish_reason,
         }
 
+    def _generate_with_stop(self, prompt: str, *,
+                            max_tokens: Optional[int] = None,
+                            temperature: Optional[float] = None,
+                            top_k: int = 0,
+                            adapter: Optional[str] = None,
+                            logit_bias: Optional[Dict[int, float]] = None,
+                            stop: List[str] = ()) -> Dict[str, Any]:
+        """Non-streaming generation with OpenAI stop STRINGS: watch
+        the decoded text incrementally and cancel the engine request
+        at the first stop-sequence hit (the stop text itself is not
+        returned), instead of decoding to max_tokens and truncating
+        after the fact."""
+        import queue
+
+        ids, request = self._make_request(
+            prompt, max_tokens=max_tokens, temperature=temperature,
+            top_k=top_k, adapter=adapter, logit_bias=logit_bias,
+            stream_queue=queue.Queue())
+        text = ""
+        hit = False
+        for delta in stream_text_deltas(self.tokenizer, request):
+            text += delta
+            cuts = [text.find(s) for s in stop if s in text]
+            if cuts:
+                text = text[:min(cuts)]
+                hit = True
+                self.engine.cancel(request, "stop")
+                break
+        return {
+            "text": text,
+            "prompt_tokens": len(ids),
+            "completion_tokens": len(request.output_ids),
+            "finish_reason": "stop" if hit else request.finish_reason,
+        }
+
     def _generate_stream(self, prompt: str, *,
                          max_tokens: Optional[int] = None,
                          temperature: Optional[float] = None,
                          top_k: int = 0,
                          adapter: Optional[str] = None,
-                         logit_bias: Optional[Dict[int, float]] = None):
+                         logit_bias: Optional[Dict[int, float]] = None,
+                         stop: Optional[List[str]] = None):
         """Yield decoded text per emitted token (reference: vLLM output
         streams behind serve token streaming). The engine's stepper
-        pushes each token onto the request's queue as it decodes."""
+        pushes each token onto the request's queue as it decodes.
+        With ``stop`` strings, a possible stop-prefix tail is held
+        back so stop text is never streamed, and the engine request
+        is cancelled at the hit."""
         import queue
 
-        ids = self.tokenizer.encode(prompt)
-        request = GenerationRequest(
-            prompt_ids=ids,
-            max_tokens=max_tokens or self.config.max_tokens,
-            temperature=(self.config.temperature if temperature is None
-                         else temperature),
-            top_k=top_k,
-            adapter=adapter,
-            logit_bias=logit_bias,
-            stop_ids=(self.tokenizer.eos_id,)
-            if self.tokenizer.eos_id is not None else (),
+        _ids, request = self._make_request(
+            prompt, max_tokens=max_tokens, temperature=temperature,
+            top_k=top_k, adapter=adapter, logit_bias=logit_bias,
             stream_queue=queue.Queue())
-        self.engine.add_request(request)
-        self._wake.set()
-        if self._stopped:
-            # see _generate: covers admission racing an LRU eviction
-            self.engine.fail_all("model evicted from replica")
-        yield from stream_text_deltas(self.tokenizer, request)
+        deltas = stream_text_deltas(self.tokenizer, request)
+        if not stop:
+            yield from deltas
+            return
+        text = ""
+        emitted = 0
+        holdback = max(len(s) for s in stop) - 1
+        for delta in deltas:
+            text += delta
+            cuts = [text.find(s) for s in stop if s in text]
+            if cuts:
+                cut = min(cuts)
+                if cut > emitted:
+                    yield text[emitted:cut]
+                self.engine.cancel(request, "stop")
+                return
+            safe = len(text) - holdback
+            if safe > emitted:
+                yield text[emitted:safe]
+                emitted = safe
+        if len(text) > emitted:
+            yield text[emitted:]
 
     # -- OpenAI-compatible surface (routed by path) --------------------
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
@@ -349,7 +422,8 @@ class LLMServer:
             temperature=sampling.get("temperature"),
             top_k=sampling["top_k"],
             adapter=sampling.get("adapter"),
-            logit_bias=sampling.get("logit_bias"))
+            logit_bias=sampling.get("logit_bias"),
+            stop=sampling.get("stop"))
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
@@ -380,7 +454,8 @@ class LLMServer:
                 temperature=sampling.get("temperature"),
                 top_k=sampling["top_k"],
                 adapter=sampling.get("adapter"),
-                logit_bias=sampling.get("logit_bias")):
+                logit_bias=sampling.get("logit_bias"),
+                stop=sampling.get("stop")):
             chunk = {"id": cmpl_id, "object": "text_completion",
                      "model": model,
                      "choices": [{"index": 0, "text": text,
@@ -409,7 +484,8 @@ class LLMServer:
                 temperature=sampling.get("temperature"),
                 top_k=sampling["top_k"],
                 adapter=sampling.get("adapter"),
-                logit_bias=sampling.get("logit_bias")):
+                logit_bias=sampling.get("logit_bias"),
+                stop=sampling.get("stop")):
             chunk = {"id": chat_id, "object": "chat.completion.chunk",
                      "model": model,
                      "choices": [{"index": 0, "delta": {"content": text},
@@ -445,7 +521,8 @@ class LLMServer:
             temperature=sampling.get("temperature"),
             top_k=sampling["top_k"],
             adapter=sampling.get("adapter"),
-            logit_bias=sampling.get("logit_bias"))
+            logit_bias=sampling.get("logit_bias"),
+            stop=sampling.get("stop"))
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",

@@ -999,3 +999,79 @@ def test_logit_bias_chat_and_stream_paths():
             server.engine.prefill_only([1, 2], logit_bias={999: 1.0})
     finally:
         server.stop()
+
+
+# ----------------------------------------------------- stop strings
+
+def test_stop_strings_non_streaming():
+    from ray_tpu.llm.tokenizer import get_tokenizer
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    server = LLMServer(LLMConfig(
+        model_id="stops", engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=1, max_seq=64), max_tokens=12))
+    tok = get_tokenizer(None)
+    try:
+        base = server.completions({"prompt": "hi", "max_tokens": 12})
+        full = base["choices"][0]["text"]
+        assert len(full) >= 4
+        stop_s = full[2:4]  # a substring the model WILL produce
+        out = server.completions({"prompt": "hi", "max_tokens": 12,
+                                  "stop": stop_s})
+        assert out["choices"][0]["text"] == full[:full.find(stop_s)]
+        assert out["choices"][0]["finish_reason"] == "stop"
+        # fewer tokens decoded than the unstopped run (early cancel)
+        assert out["usage"]["completion_tokens"] <= \
+            base["usage"]["completion_tokens"]
+        # stop list + validation
+        bad = server.completions({"prompt": "x", "stop": ["a"] * 5})
+        assert bad["error"]["type"] == "invalid_request_error"
+        bad = server.completions({"prompt": "x", "stop": [""]})
+        assert bad["error"]["type"] == "invalid_request_error"
+    finally:
+        server.stop()
+
+
+def test_stop_strings_streaming_never_leak():
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    server = LLMServer(LLMConfig(
+        model_id="stops2", engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=1, max_seq=64), max_tokens=12))
+    try:
+        base = server.completions({"prompt": "hi", "max_tokens": 12})
+        full = base["choices"][0]["text"]
+        stop_s = full[3:5]
+        chunks = list(server.completions({
+            "prompt": "hi", "max_tokens": 12, "stream": True,
+            "stop": stop_s}))
+        import json as _json
+        text = "".join(
+            _json.loads(c[len("data: "):])["choices"][0]["text"]
+            for c in chunks if c.startswith("data: ")
+            and "[DONE]" not in c)
+        assert stop_s not in text
+        assert text == full[:full.find(stop_s)]
+    finally:
+        server.stop()
+
+
+def test_engine_cancel_releases_slot():
+    engine = tiny_engine(max_batch=1)
+    import queue as _q
+    r1 = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=50, stream_queue=_q.Queue()))
+    for _ in range(3):
+        engine.step()
+    engine.cancel(r1, "abort")
+    assert r1.finish_reason == "abort"
+    n_at_cancel = len(r1.output_ids)
+    # a queued request gets the slot and completes
+    r2 = engine.add_request(GenerationRequest(prompt_ids=[4, 5],
+                                              max_tokens=4))
+    while not r2.done:
+        engine.step()
+    assert len(r2.output_ids) == 4
+    assert len(r1.output_ids) == n_at_cancel  # no post-cancel tokens
