@@ -130,18 +130,30 @@ def test_worker_crash_no_retries_fails(ray_start_regular):
         ray_tpu.get(die.remote(), timeout=60)
 
 
-def test_node_removal_chaos(ray_start_cluster):
+def test_node_removal_chaos(ray_start_cluster, tmp_path):
+    import os
+
     cluster = ray_start_cluster
     doomed = cluster.add_node(num_cpus=2, resources={"DOOMED": 1})
+    marker = str(tmp_path / "started")
 
     @ray_tpu.remote(resources={"DOOMED": 0.1}, max_retries=0)
-    def trapped():
+    def trapped(path):
+        import pathlib
         import time
+        pathlib.Path(path).write_text("in")
         time.sleep(30)
         return 1
 
-    ref = trapped.remote()
-    time.sleep(0.8)  # let it get scheduled onto the doomed node
+    ref = trapped.remote(marker)
+    # wait for POSITIVE confirmation the task is running on the doomed
+    # node — a fixed sleep flakes under load: removing the node before
+    # dispatch leaves the task queued on a forever-infeasible resource
+    # and get() times out instead of raising the crash error
+    deadline = time.monotonic() + 30
+    while not os.path.exists(marker) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert os.path.exists(marker), "task never started on doomed node"
     cluster.remove_node(doomed)
     with pytest.raises((WorkerCrashedError, TaskError)):
         ray_tpu.get(ref, timeout=60)
