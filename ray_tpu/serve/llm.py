@@ -172,6 +172,12 @@ class LLMServer:
                         "number")
                 clean[t] = float(val)
             out["logit_bias"] = clean
+        n = body.get("n")
+        if n is not None:
+            if isinstance(n, bool) or not isinstance(n, int) or \
+                    not 1 <= n <= 8:
+                raise ValueError("n must be an integer in [1, 8]")
+            out["n"] = n
         stop = body.get("stop")
         if stop is not None:
             if isinstance(stop, str):
@@ -207,6 +213,53 @@ class LLMServer:
             # fail_all; covering a request admitted after that sweep
             self.engine.fail_all("model evicted from replica")
         return ids, request
+
+    def _generate_n(self, prompt: str,
+                    sampling: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """n independent samples of one prompt (OpenAI `n`). Plain
+        sampled requests are admitted together and co-batch in the
+        engine, waited by ONE loop — no per-choice polling threads;
+        stop-string requests need a stream consumer each, so n>1 with
+        stop keeps a small thread pool."""
+        n = sampling.get("n", 1)
+        temp = sampling.get("temperature", self.config.temperature)
+        if n > 1 and temp <= 0.0:
+            raise ValueError("n > 1 requires temperature > 0 (greedy "
+                             "choices would all be identical)")
+        kwargs = dict(
+            max_tokens=sampling.get("max_tokens"),
+            temperature=sampling.get("temperature"),
+            top_k=sampling["top_k"],
+            adapter=sampling.get("adapter"),
+            logit_bias=sampling.get("logit_bias"),
+            stop=sampling.get("stop"))
+        if n == 1:
+            return [self._generate(prompt, **kwargs)]
+        if kwargs.get("stop"):
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(max_workers=n) as pool:
+                return list(pool.map(
+                    lambda _: self._generate(prompt, **kwargs),
+                    range(n)))
+        admitted = [self._make_request(
+            prompt, max_tokens=kwargs["max_tokens"],
+            temperature=kwargs["temperature"], top_k=kwargs["top_k"],
+            adapter=kwargs["adapter"], logit_bias=kwargs["logit_bias"])
+            for _ in range(n)]
+        while not all(r.done for _, r in admitted):
+            time.sleep(0.001)
+        results = []
+        for ids, r in admitted:
+            if r.error is not None:
+                raise RuntimeError(r.error)
+            out_ids = [i for i in r.output_ids if i not in r.stop_ids]
+            results.append({
+                "text": self.tokenizer.decode(out_ids),
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(r.output_ids),
+                "finish_reason": r.finish_reason,
+            })
+        return results
 
     def register_adapter(self, name: str, lora_params) -> None:
         """Serve a LoRA adapter as an additional model id (reference:
@@ -415,29 +468,31 @@ class LLMServer:
         except ValueError as e:
             return self._invalid_request(e)
         if body.get("stream"):
+            if sampling.get("n", 1) > 1:
+                return self._invalid_request(ValueError(
+                    "n > 1 is not supported with stream=true"))
             return self._stream_completions(body, prompt, sampling)
-        result = self._generate(
-            prompt,
-            max_tokens=sampling.get("max_tokens"),
-            temperature=sampling.get("temperature"),
-            top_k=sampling["top_k"],
-            adapter=sampling.get("adapter"),
-            logit_bias=sampling.get("logit_bias"),
-            stop=sampling.get("stop"))
+        try:
+            results = self._generate_n(prompt, sampling)
+        except ValueError as e:
+            return self._invalid_request(e)
+        result = results[0]
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "model": body.get("model", self.config.model_id),
             "choices": [{
-                "index": 0,
-                "text": result["text"],
-                "finish_reason": result["finish_reason"],
-            }],
+                "index": i,
+                "text": r["text"],
+                "finish_reason": r["finish_reason"],
+            } for i, r in enumerate(results)],
             "usage": {
                 "prompt_tokens": result["prompt_tokens"],
-                "completion_tokens": result["completion_tokens"],
+                "completion_tokens": sum(r["completion_tokens"]
+                                         for r in results),
                 "total_tokens": (result["prompt_tokens"]
-                                 + result["completion_tokens"]),
+                                 + sum(r["completion_tokens"]
+                                       for r in results)),
             },
         }
 
@@ -514,30 +569,32 @@ class LLMServer:
             f"<|{m.get('role', 'user')}|>{content}"
             for m, content in zip(messages, contents)) + "<|assistant|>"
         if body.get("stream"):
+            if sampling.get("n", 1) > 1:
+                return self._invalid_request(ValueError(
+                    "n > 1 is not supported with stream=true"))
             return self._stream_chat(body, prompt, sampling)
-        result = self._generate(
-            prompt,
-            max_tokens=sampling.get("max_tokens"),
-            temperature=sampling.get("temperature"),
-            top_k=sampling["top_k"],
-            adapter=sampling.get("adapter"),
-            logit_bias=sampling.get("logit_bias"),
-            stop=sampling.get("stop"))
+        try:
+            results = self._generate_n(prompt, sampling)
+        except ValueError as e:
+            return self._invalid_request(e)
+        result = results[0]
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
             "model": body.get("model", self.config.model_id),
             "choices": [{
-                "index": 0,
+                "index": i,
                 "message": {"role": "assistant",
-                            "content": result["text"]},
-                "finish_reason": result["finish_reason"],
-            }],
+                            "content": r["text"]},
+                "finish_reason": r["finish_reason"],
+            } for i, r in enumerate(results)],
             "usage": {
                 "prompt_tokens": result["prompt_tokens"],
-                "completion_tokens": result["completion_tokens"],
+                "completion_tokens": sum(r["completion_tokens"]
+                                         for r in results),
                 "total_tokens": (result["prompt_tokens"]
-                                 + result["completion_tokens"]),
+                                 + sum(r["completion_tokens"]
+                                       for r in results)),
             },
         }
 

@@ -1075,3 +1075,37 @@ def test_engine_cancel_releases_slot():
         engine.step()
     assert len(r2.output_ids) == 4
     assert len(r1.output_ids) == n_at_cancel  # no post-cancel tokens
+
+
+def test_completions_n_choices():
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    server = LLMServer(LLMConfig(
+        model_id="nchoice", engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=4, max_seq=64), max_tokens=6))
+    try:
+        out = server.completions({"prompt": "hi", "max_tokens": 6,
+                                  "temperature": 0.9, "top_k": 50,
+                                  "n": 3})
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        # a sample may hit EOS early, so bound rather than pin counts
+        assert 3 <= out["usage"]["completion_tokens"] <= 18
+        assert all(isinstance(c["text"], str) for c in out["choices"])
+        # chat honors n too
+        chat = server.chat_completions({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "temperature": 0.9, "top_k": 50, "n": 2})
+        assert [c["index"] for c in chat["choices"]] == [0, 1]
+        # greedy n>1 is rejected (identical choices would be useless)
+        bad = server.completions({"prompt": "x", "n": 3})
+        assert bad["error"]["type"] == "invalid_request_error"
+        bad = server.completions({"prompt": "x", "n": 99,
+                                  "temperature": 0.9})
+        assert bad["error"]["type"] == "invalid_request_error"
+        # streaming + n>1 is rejected, not silently single-choice
+        bad = server.completions({"prompt": "x", "n": 2, "stream": True,
+                                  "temperature": 0.9})
+        assert bad["error"]["type"] == "invalid_request_error"
+    finally:
+        server.stop()
