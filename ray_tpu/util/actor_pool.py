@@ -1,10 +1,19 @@
 """Fixed-pool work distribution over actor handles.
 
-reference: python/ray/util/actor_pool.py — same public API
-(`map`, `map_unordered`, `submit`, `get_next`, `get_next_unordered`,
-`has_next`, `has_free`, `pop_idle`, `push`); independent
-implementation over ray_tpu's wait/get primitives.
+Public surface matches ``ray.util.ActorPool`` (reference:
+python/ray/util/actor_pool.py): ``map``, ``map_unordered``, ``submit``,
+``get_next``, ``get_next_unordered``, ``has_next``, ``has_free``,
+``pop_idle``, ``push``.
+
+Internals are a ticket ledger, not the reference's parallel index maps:
+every submission gets a monotonically increasing ticket; outstanding
+work lives in one ``{ticket: (ref, actor)}`` dict and deferred
+submissions in a backlog deque. Ordered consumption always yields the
+lowest outstanding ticket, so interleaving ``get_next`` with
+``get_next_unordered`` is well-defined here (the reference raises in
+some of those interleavings).
 """
+import collections
 from typing import Any, Callable, Iterator, List, Optional, TypeVar
 
 from ray_tpu import api
@@ -15,149 +24,152 @@ V = TypeVar("V")
 __all__ = ["ActorPool"]
 
 
-class ActorPool:
-    """Operate on a fixed pool of actors, keeping every actor busy.
+class _Ticket:
+    __slots__ = ("ref", "actor")
 
-    ``fn`` receives ``(actor, value)`` and must return the ObjectRef of
-    the submitted call; the actor is considered busy until that ref
-    resolves.
+    def __init__(self, ref: ObjectRef, actor: Any):
+        self.ref = ref
+        self.actor = actor
+
+
+class ActorPool:
+    """Keep a fixed set of actors saturated with submitted work.
+
+    ``fn`` is called as ``fn(actor, value)`` and must return the
+    ``ObjectRef`` of the dispatched actor call; the actor rejoins the
+    idle set once that ref is consumed via ``get_next*``.
     """
 
     def __init__(self, actors: list):
-        self._idle_actors: List[Any] = list(actors)
-        self._future_to_actor: dict = {}     # ref -> (index, actor)
-        self._index_to_future: dict = {}     # submit index -> ref
-        self._next_task_index = 0            # next index to hand out
-        self._next_return_index = 0          # next index get_next returns
-        self._pending_submits: list = []     # (fn, value) waiting for an actor
+        self._free: List[Any] = list(actors)
+        self._ledger: "dict[int, _Ticket]" = {}
+        self._backlog: "collections.deque" = collections.deque()
+        self._ticket = 0
 
     # -- bulk maps ----------------------------------------------------
     def map(self, fn: Callable[[Any, V], ObjectRef],
             values: List[V]) -> Iterator[Any]:
         """Ordered iterator of fn results over values."""
-        # Defensive reset mirroring the reference: a half-consumed
-        # previous map must not leak its unreturned futures into ours.
-        self._reset_return_state()
+        self._abandon_outstanding()
         for v in values:
             self.submit(fn, v)
 
-        def result_iterator():
+        def _drain():
             while self.has_next():
                 yield self.get_next()
 
-        return result_iterator()
+        return _drain()
 
     def map_unordered(self, fn: Callable[[Any, V], ObjectRef],
                       values: List[V]) -> Iterator[Any]:
         """Completion-order iterator of fn results over values."""
-        self._reset_return_state()
+        self._abandon_outstanding()
         for v in values:
             self.submit(fn, v)
 
-        def result_iterator():
+        def _drain():
             while self.has_next():
                 yield self.get_next_unordered()
 
-        return result_iterator()
+        return _drain()
 
-    def _reset_return_state(self) -> None:
-        # Drain (not just clear): actors still busy with an abandoned
-        # map's tasks must come back to the pool, or they leak and a
-        # 1-actor pool would silently yield zero results forever. The
-        # abandoned map's not-yet-submitted values are dropped too —
-        # pumping them would splice stale results into the NEW map's
-        # output. Clear all state before handing actors back because
-        # _return_actor pumps _pending_submits.
-        busy = [actor for _, actor in self._future_to_actor.values()]
-        self._pending_submits.clear()
-        self._future_to_actor.clear()
-        self._index_to_future.clear()
-        self._next_task_index = 0
-        self._next_return_index = 0
-        for actor in busy:
-            self._return_actor(actor)
+    def _abandon_outstanding(self) -> None:
+        """Forget any half-consumed previous map.
+
+        Results of in-flight tickets are discarded (never spliced into
+        a newer map's output) but their actors must rejoin the idle set
+        once the ledger is wiped — a 1-actor pool would otherwise starve
+        forever. The backlog is dropped outright: those values belong to
+        the abandoned map and were never dispatched.
+        """
+        stranded = [t.actor for t in self._ledger.values()]
+        self._backlog.clear()
+        self._ledger.clear()
+        self._ticket = 0
+        for actor in stranded:
+            self._reclaim(actor)
 
     # -- incremental submission ---------------------------------------
     def submit(self, fn: Callable[[Any, V], ObjectRef], value: V) -> None:
-        """Run fn(actor, value) on an idle actor, or queue it."""
-        if self._idle_actors:
-            actor = self._idle_actors.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = (self._next_task_index, actor)
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
+        """Dispatch fn(actor, value) on an idle actor, or defer it."""
+        if not self._free:
+            self._backlog.append((fn, value))
+            return
+        actor = self._free.pop()
+        self._ledger[self._ticket] = _Ticket(fn(actor, value), actor)
+        self._ticket += 1
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future)
+        return bool(self._ledger)
 
     def get_next(self, timeout: Optional[float] = None,
                  ignore_if_timedout: bool = False) -> Any:
-        """Next result in submission order (blocks on that one task)."""
-        if not self.has_next():
-            raise StopIteration("No more results to get")
-        if self._next_return_index >= self._next_task_index:
-            raise ValueError("It is not allowed to call get_next() after "
-                             "get_next_unordered().")
-        future = self._index_to_future[self._next_return_index]
-        timeout_msg = "Timed out waiting for result"
-        raise_timeout_after_ignore = False
+        """Result of the earliest outstanding submission (blocking).
+
+        On timeout raises ``TimeoutError``; with ``ignore_if_timedout``
+        the hung submission is additionally discarded (actor reclaimed)
+        so the caller can make progress past it.
+        """
+        if not self._ledger:
+            raise StopIteration("ActorPool has no outstanding results")
+        seq = min(self._ledger)
+        entry = self._ledger[seq]
         if timeout is not None:
-            done, _ = api.wait([future], timeout=timeout)
-            if not done:
-                if not ignore_if_timedout:
-                    raise TimeoutError(timeout_msg)
-                raise_timeout_after_ignore = True
-        # On an ignored timeout the task is skipped, not retained: drop
-        # its future, free the actor, and advance — otherwise the caller
-        # can never get past a hung task.
-        del self._index_to_future[self._next_return_index]
-        self._next_return_index += 1
-        _, actor = self._future_to_actor.pop(future)
-        self._return_actor(actor)
-        if raise_timeout_after_ignore:
-            raise TimeoutError(timeout_msg + ". The task has been "
-                               "ignored.")
-        return api.get(future)
+            ready, _ = api.wait([entry.ref], timeout=timeout)
+            if not ready:
+                if ignore_if_timedout:
+                    self._retire(seq)
+                    raise TimeoutError(
+                        f"result of submission {seq} not ready within "
+                        f"{timeout}s; the submission was discarded")
+                raise TimeoutError(
+                    f"result of submission {seq} not ready within "
+                    f"{timeout}s")
+        self._retire(seq)
+        return api.get(entry.ref)
 
     def get_next_unordered(self, timeout: Optional[float] = None,
                            ignore_if_timedout: bool = False) -> Any:
-        """Earliest-finished result regardless of submission order."""
-        if not self.has_next():
-            raise StopIteration("No more results to get")
-        done, _ = api.wait(list(self._future_to_actor), num_returns=1,
-                           timeout=timeout)
-        if done:
-            future = done[0]
-            i, actor = self._future_to_actor.pop(future)
-            self._return_actor(actor)
-            del self._index_to_future[i]
-            self._next_return_index = max(self._next_return_index, i + 1)
-            return api.get(future)
-        # unordered: no specific task to skip — nothing to ignore
-        raise TimeoutError("Timed out waiting for result")
+        """Result of whichever outstanding submission finishes first."""
+        if not self._ledger:
+            raise StopIteration("ActorPool has no outstanding results")
+        by_ref = {t.ref: seq for seq, t in self._ledger.items()}
+        ready, _ = api.wait(list(by_ref), num_returns=1, timeout=timeout)
+        if not ready:
+            # No single submission to blame, so none is discarded even
+            # under ignore_if_timedout.
+            raise TimeoutError(
+                f"no result ready within {timeout}s")
+        seq = by_ref[ready[0]]
+        ref = self._ledger[seq].ref
+        self._retire(seq)
+        return api.get(ref)
 
-    def _return_actor(self, actor: Any) -> None:
-        self._idle_actors.append(actor)
-        while self._pending_submits and self._idle_actors:
-            fn, value = self._pending_submits.pop(0)
+    def _retire(self, seq: int) -> None:
+        entry = self._ledger.pop(seq)
+        self._reclaim(entry.actor)
+
+    def _reclaim(self, actor: Any) -> None:
+        """Return an actor to the idle set, then pump the backlog."""
+        self._free.append(actor)
+        while self._backlog and self._free:
+            fn, value = self._backlog.popleft()
             self.submit(fn, value)
 
     # -- pool membership ----------------------------------------------
     def has_free(self) -> bool:
-        """True iff an actor is idle and nothing is queued."""
-        return bool(self._idle_actors) and not self._pending_submits
+        """True iff an actor is idle and the backlog is empty."""
+        return bool(self._free) and not self._backlog
 
     def pop_idle(self) -> Optional[Any]:
         """Remove and return an idle actor (None if all busy)."""
-        if self.has_free():
-            return self._idle_actors.pop()
-        return None
+        if not self.has_free():
+            return None
+        return self._free.pop()
 
     def push(self, actor: Any) -> None:
         """Add an actor to the pool."""
-        busy = {a for _, a in self._future_to_actor.values()}
-        if actor in self._idle_actors or actor in busy:
-            raise ValueError("Actor already belongs to current ActorPool")
-        self._return_actor(actor)
+        if actor in self._free or any(
+                t.actor is actor for t in self._ledger.values()):
+            raise ValueError("actor is already a member of this pool")
+        self._reclaim(actor)

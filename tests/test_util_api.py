@@ -89,11 +89,38 @@ def test_actor_pool_abandoned_map_does_not_pollute_next(ray_start_shared):
     assert pool.has_free()
 
 
+def test_actor_pool_ordered_after_unordered(ray_start_shared):
+    # Divergence from the reference (noted in PARITY.md): interleaving
+    # is well-defined here — get_next always yields the earliest
+    # outstanding submission instead of raising ValueError.
+    pool = ActorPool([_Doubler.remote()])
+    for v in (1, 2, 3):
+        pool.submit(lambda a, x: a.double.remote(x), v)
+    assert pool.get_next_unordered() in (2, 4, 6)
+    assert pool.get_next() in (2, 4, 6)
+    assert pool.get_next() in (2, 4, 6)
+    assert not pool.has_next()
+
+
+def test_actor_pool_ignore_if_timedout_discards_and_advances(
+        ray_start_shared):
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, x: a.slow_double.remote(x), 7)
+    pool.submit(lambda a, x: a.double.remote(x), 8)
+    with pytest.raises(TimeoutError, match="discarded"):
+        pool.get_next(timeout=0.01, ignore_if_timedout=True)
+    # The hung submission was dropped and its actor reclaimed: the next
+    # ordered result is the SECOND submission, and the pool drains free.
+    assert pool.get_next(timeout=10) == 16
+    assert not pool.has_next()
+    assert pool.has_free()
+
+
 def test_actor_pool_queues_excess_submits(ray_start_shared):
     pool = ActorPool([_Doubler.remote()])
     for v in range(5):
         pool.submit(lambda a, x: a.double.remote(x), v)
-    assert len(pool._pending_submits) == 4
+    assert len(pool._backlog) == 4
     got = [pool.get_next() for _ in range(5)]
     assert got == [0, 2, 4, 6, 8]
 
