@@ -291,7 +291,41 @@ def inner():
             best = result
     if best is None:
         raise RuntimeError("every TPU bench config failed")
+    if os.environ.get("RTPU_BENCH_INT8"):
+        try:
+            _bench_int8_row()
+        except Exception as e:  # noqa: BLE001 — optional row
+            sys.stderr.write(f"[bench] int8 row failed: {e!r}\n")
     print(json.dumps(best))
+
+
+def _bench_int8_row():
+    """Optional on-chip int8-vs-bf16 weight-matmul row (stderr only;
+    enable with RTPU_BENCH_INT8=1). Llama-7B FFN shape at decode batch
+    32 — the weight-bandwidth-bound case the kernel targets."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.quant_matmul import int8_matmul, quantize_int8
+
+    d, h, b = 4096, 11008, 32
+    w = jax.random.normal(jax.random.PRNGKey(0), (d, h), jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (b, d), jnp.bfloat16)
+    w8, s = quantize_int8(w)
+    f_bf = jax.jit(lambda x: jnp.sum(x @ w))
+    f_q8 = jax.jit(lambda x: jnp.sum(int8_matmul(x, w8, s)))
+    out = {}
+    for name, fn in (("bf16", f_bf), ("int8", f_q8)):
+        float(fn(x))  # compile + flush (axon: scalar sync barrier)
+        t0 = time.perf_counter()
+        acc = 0.0
+        for _ in range(20):
+            acc += float(fn(x))
+        out[name] = (time.perf_counter() - t0) / 20
+    sys.stderr.write(
+        f"[bench] int8 ffn-matmul [{b}x{d}]@[{d}x{h}]: "
+        f"bf16 {out['bf16']*1e3:.3f}ms int8 {out['int8']*1e3:.3f}ms "
+        f"speedup {out['bf16']/out['int8']:.2f}x\n")
 
 
 if __name__ == "__main__":

@@ -6,10 +6,15 @@ from ray_tpu.llm.batch import (
     Processor, ProcessorConfig, build_llm_processor, throughput_summary)
 from ray_tpu.llm.engine import (
     ContinuousBatchingEngine, EngineConfig, GenerationRequest)
+from ray_tpu.llm.guided import (
+    TokenConstraint, json_object_constraint, json_schema_constraint,
+    tool_call_constraint)
 from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
 
 __all__ = [
     "ByteTokenizer", "ContinuousBatchingEngine", "EngineConfig",
     "GenerationRequest", "Processor", "ProcessorConfig",
-    "build_llm_processor", "get_tokenizer", "throughput_summary",
+    "TokenConstraint", "build_llm_processor", "get_tokenizer",
+    "json_object_constraint", "json_schema_constraint",
+    "throughput_summary", "tool_call_constraint",
 ]

@@ -40,16 +40,30 @@ class PrefillServer:
         self.config = config
         self.engine = ContinuousBatchingEngine(config.engine, params)
         self.tokenizer = get_tokenizer(config.engine.tokenizer)
+        self._constraint_cache: Dict[Any, Any] = {}
+        self._token_strs = None
+
+    # guided decoding resolution borrowed from LLMServer (same
+    # validation + constraint cache, no engine stepper needed here)
+    _vocab_strings = LLMServer._vocab_strings
+    _cached_constraint = LLMServer._cached_constraint
+    _resolve_guided = LLMServer._resolve_guided
 
     def prefill(self, prompt: str, *, temperature: float = 0.0,
                 top_k: int = 0,
                 adapter: Optional[str] = None,
-                logit_bias: Optional[Dict[int, float]] = None
+                logit_bias: Optional[Dict[int, float]] = None,
+                response_format: Optional[Dict[str, Any]] = None
                 ) -> Dict[str, Any]:
+        guided = None
+        if response_format is not None:
+            guided = self._resolve_guided(
+                {"response_format": response_format},
+                allow_tools=False)["constraint"]
         ids = self.tokenizer.encode(prompt)
         ks, vs, prompt_len, first_token = self.engine.prefill_only(
             ids, temperature=temperature, top_k=top_k, adapter=adapter,
-            logit_bias=logit_bias)
+            logit_bias=logit_bias, guided=guided)
         return {"ks": ks, "vs": vs, "prompt_len": prompt_len,
                 "first_token": first_token, "prompt_tokens": len(ids)}
 
@@ -77,7 +91,16 @@ class DecodeServer(LLMServer):
                          max_tokens: int, temperature: float,
                          top_k: int, adapter: Optional[str],
                          logit_bias: Optional[Dict[int, float]] = None,
+                         response_format: Optional[Dict[str, Any]] = None,
                          stream_queue=None) -> GenerationRequest:
+        guided = None
+        if response_format is not None:
+            # decode-side rebuild of the prefill side's constraint: the
+            # engine re-walks the automaton from the start state when
+            # it adopts, so only the spec (not opaque state) ships
+            guided = self._resolve_guided(
+                {"response_format": response_format},
+                allow_tools=False)["constraint"]
         request = GenerationRequest(
             prompt_ids=[],  # KV already computed; ids not needed
             max_tokens=max_tokens,
@@ -85,6 +108,7 @@ class DecodeServer(LLMServer):
             top_k=top_k,
             adapter=adapter,
             logit_bias=logit_bias,
+            guided=guided,
             stream_queue=stream_queue,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else ())
@@ -99,6 +123,8 @@ class DecodeServer(LLMServer):
                                 top_k: int = 0,
                                 adapter: Optional[str] = None,
                                 logit_bias: Optional[Dict[int, float]]
+                                = None,
+                                response_format: Optional[Dict[str, Any]]
                                 = None):
         """Streaming disagg decode: yields text deltas as tokens land,
         then one final dict carrying finish_reason + usage (reference:
@@ -111,7 +137,9 @@ class DecodeServer(LLMServer):
         kv_handoff_s = time.perf_counter() - t_handoff0
         request = self._adopt_prefilled(
             prefill_out, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, adapter=adapter, stream_queue=queue.Queue())
+            top_k=top_k, adapter=adapter, logit_bias=logit_bias,
+            response_format=response_format,
+            stream_queue=queue.Queue())
         yield from stream_text_deltas(self.tokenizer, request)
         yield {
             "finish_reason": request.finish_reason,
@@ -128,12 +156,14 @@ class DecodeServer(LLMServer):
                          max_tokens: int, temperature: float = 0.0,
                          top_k: int = 0,
                          adapter: Optional[str] = None,
-                         logit_bias: Optional[Dict[int, float]] = None
+                         logit_bias: Optional[Dict[int, float]] = None,
+                         response_format: Optional[Dict[str, Any]] = None
                          ) -> Dict[str, Any]:
         prefill_out = self._materialize_prefill(prefill_out)
         request = self._adopt_prefilled(
             prefill_out, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, adapter=adapter, logit_bias=logit_bias)
+            top_k=top_k, adapter=adapter, logit_bias=logit_bias,
+            response_format=response_format)
         while not request.done:
             time.sleep(0.001)
         if request.error is not None:
@@ -161,6 +191,14 @@ class DisaggRouter:
         # reuse LLMServer's sampling validation without building an
         # engine: bind the unbound method to this router
         self._validate = LLMServer._validate_sampling
+        # guided (response_format) validation needs a vocab view
+        self.tokenizer = get_tokenizer(config.engine.tokenizer)
+        self._constraint_cache: Dict[Any, Any] = {}
+        self._token_strs = None
+
+    _vocab_strings = LLMServer._vocab_strings
+    _cached_constraint = LLMServer._cached_constraint
+    _resolve_guided = LLMServer._resolve_guided
 
     def _resolve_adapter(self, model):
         if model is None or model == self.config.model_id:
@@ -208,15 +246,28 @@ class DisaggRouter:
                            "disaggregated deployment; use stop token "
                            "ids via the engine API",
                 "type": "invalid_request_error"}}
+        rf = body.get("response_format")
+        if rf is not None:
+            # validate router-side — replica-side ValueErrors would
+            # surface as opaque TaskErrors; replicas rebuild the
+            # constraint from the spec against their own vocab
+            try:
+                self._resolve_guided({"response_format": rf},
+                                     allow_tools=False)
+            except ValueError as e:
+                return {"error": {"message": str(e),
+                                  "type": "invalid_request_error"}}
         decode_kwargs = dict(
             max_tokens=sampling.get("max_tokens", self.config.max_tokens),
             temperature=temperature, top_k=top_k,
             adapter=sampling.get("adapter"),
-            logit_bias=sampling.get("logit_bias"))
+            logit_bias=sampling.get("logit_bias"),
+            response_format=rf)
         prefill_ref = self.prefill.prefill.remote(
             prompt, temperature=temperature, top_k=top_k,
             adapter=sampling.get("adapter"),
-            logit_bias=sampling.get("logit_bias"))
+            logit_bias=sampling.get("logit_bias"),
+            response_format=rf)
         if body.get("stream"):
             return self._stream_completions(body, prefill_ref,
                                             decode_kwargs)

@@ -55,6 +55,12 @@ class EngineConfig:
     # 0 disables the LoRA path entirely (no bank in the decode program)
     max_loras: int = 0
     lora_rank: int = 8
+    # Weight-only quantization for serving (reference: vLLM
+    # quantization passthrough, vllm_models.py:214). "int8" quantizes
+    # the target model's FFN stacks on load (per-output-channel
+    # scales; Pallas in-register-dequant matmul on TPU — see
+    # ops/quant_matmul.py). None serves in the working dtype.
+    quantization: Optional[str] = None
     # Static top-k width for on-device sampling: XLA needs a fixed
     # lax.top_k width, so per-request top_k is CLAMPED to this (also at
     # add_request, so the effective value is visible on the request).
@@ -119,6 +125,16 @@ class GenerationRequest:
     # it, so a bias that changes the argmax lowers draft acceptance
     # but never affects outputs.
     logit_bias: Optional[Dict[int, float]] = None
+    # Guided decoding (reference: vLLM guided decoding behind
+    # response_format/tools): a ray_tpu.llm.guided.TokenConstraint.
+    # Its per-state token mask folds into the slot's device bias row
+    # (-1e9 on disallowed ids) so the constraint is enforced inside
+    # the on-device sampler; the engine advances guided_state per
+    # emitted token. Fast batch paths that cannot refresh masks
+    # mid-chunk (speculative, multi-step) fall back to dense stepping
+    # while any guided request is active.
+    guided: Optional[Any] = None
+    guided_state: Any = None
     # LoRA adapter name (must be register_adapter'd); None = base model
     adapter: Optional[str] = None
     request_id: int = field(default_factory=itertools.count().__next__)
@@ -157,6 +173,9 @@ class _Slot:
         self.prefilling = False
         self.prefill_ids: Optional[List[int]] = None
         self.prefill_pos = 0
+        # guided decoding: the slot's device bias row no longer matches
+        # the request's automaton state (refreshed at the next step)
+        self.bias_stale = False
 
 
 class ContinuousBatchingEngine:
@@ -170,6 +189,16 @@ class ContinuousBatchingEngine:
         if params is None:
             # random weights — real checkpoints load via orbax/train
             params = llama_init(jax.random.PRNGKey(config.seed), c)
+        if config.quantization is not None:
+            if config.quantization != "int8":
+                raise ValueError(
+                    f"unknown quantization {config.quantization!r} "
+                    "(supported: \"int8\")")
+            from ray_tpu.models.llama import quantize_llama_ffn
+            # pre-quantized checkpoints (w1_q8 already present) load
+            # as-is; float checkpoints quantize on load
+            if "w1_q8" not in params["layers"]:
+                params = quantize_llama_ffn(params, c)
         self.params = params
         self.cache_k, self.cache_v = llama_init_cache(
             c, config.max_batch, config.max_seq)
@@ -529,20 +558,29 @@ class ContinuousBatchingEngine:
     def prefill_only(self, prompt_ids: List[int], *,
                      temperature: float = 0.0, top_k: int = 0,
                      adapter: Optional[str] = None,
-                     logit_bias: Optional[Dict[int, float]] = None):
+                     logit_bias: Optional[Dict[int, float]] = None,
+                     guided: Optional[Any] = None):
         """Prefill without occupying a decode slot — the PREFILL side of
         prefill/decode disaggregation (reference: serve/llm
         prefill-decode disagg deployments). Returns numpy
         (ks, vs, prompt_len, first_token): the KV block ships through
-        the object plane to a decode engine's add_prefilled()."""
+        the object plane to a decode engine's add_prefilled().
+
+        ``guided``: a TokenConstraint — the FIRST token is sampled
+        under its start-state mask; the decode engine re-walks the
+        automaton from the start state when it adopts the request, so
+        prefill/decode stay consistent without shipping opaque state.
+        """
         limit = self._pos_limit
         ids = list(prompt_ids)[-limit:]
         if adapter is not None and adapter not in self._adapters:
             raise ValueError(f"unknown LoRA adapter {adapter!r}")
         bias_row = None
-        if logit_bias:
+        if logit_bias or guided is not None:
             self._validate_logit_bias(logit_bias)
-            fake = GenerationRequest(prompt_ids=[], logit_bias=logit_bias)
+            fake = GenerationRequest(prompt_ids=[], logit_bias=logit_bias,
+                                     guided=guided)
+            self._validate_guided(fake)
             bias_row = self._bias_row(fake)
         ks, vs, token = self._run_prefill(ids, adapter, temperature,
                                           top_k, bias_row=bias_row)
@@ -564,6 +602,7 @@ class ContinuousBatchingEngine:
                 f"prefilled KV bucket ({ks.shape[2]}) exceeds this "
                 f"engine's max_seq ({self.config.max_seq})")
         self._validate_logit_bias(request.logit_bias)
+        self._validate_guided(request)
         if request.adapter is not None:
             self._adapter_index(request)  # fail fast: an unknown
             # adapter raising inside step() would fail_all the replica
@@ -576,6 +615,7 @@ class ContinuousBatchingEngine:
 
     def add_request(self, request: GenerationRequest) -> GenerationRequest:
         self._validate_logit_bias(request.logit_bias)
+        self._validate_guided(request)
         limit = self._pos_limit
         if len(request.prompt_ids) > limit:
             request.prompt_ids = request.prompt_ids[-limit:]
@@ -707,18 +747,39 @@ class ContinuousBatchingEngine:
                     f"logit_bias token id {tid} outside vocab "
                     f"[0, {vocab})")
 
+    def _validate_guided(self, request: GenerationRequest) -> None:
+        """Caller-thread validation + state init for guided requests
+        (same fail-fast rationale as _validate_logit_bias)."""
+        if request.guided is None:
+            return
+        if request.guided.vocab_size > self.config.model.vocab_size:
+            raise ValueError(
+                f"guided constraint vocab ({request.guided.vocab_size}) "
+                f"exceeds model vocab ({self.config.model.vocab_size})")
+        if request.guided_state is None:
+            request.guided_state = request.guided.start_state()
+
     def _bias_row(self, request: GenerationRequest) -> np.ndarray:
         """Dense [V] f32 bias row from the request's sparse
         logit_bias (values clamped to the OpenAI +-100 range; ids
-        outside the vocab rejected at add_request)."""
-        row = np.zeros(self.config.model.vocab_size, dtype=np.float32)
+        outside the vocab rejected at add_request) combined with the
+        guided-decoding mask for the request's CURRENT automaton state
+        (-1e9 on disallowed ids — far below the +-100 clamp, so a
+        logit_bias push can never resurrect a grammar-banned token)."""
+        vocab = self.config.model.vocab_size
+        row = np.zeros(vocab, dtype=np.float32)
         for tid, val in (request.logit_bias or {}).items():
             row[int(tid)] = float(np.clip(val, -100.0, 100.0))
+        if request.guided is not None and request.guided_state is not None:
+            mask = request.guided.token_mask(request.guided_state)
+            penalty = np.full(vocab, -1e9, dtype=np.float32)
+            penalty[: mask.shape[0]][mask] = 0.0
+            row = row + penalty
         return row
 
     def _install_bias(self, request: GenerationRequest,
                       slot_index: int) -> None:
-        if request.logit_bias:
+        if request.logit_bias or request.guided is not None:
             row = self._jnp.asarray(self._bias_row(request))
         else:
             row = self._zero_bias_row  # no per-request host build/copy
@@ -829,7 +890,8 @@ class ContinuousBatchingEngine:
                 ids, request.adapter, request.temperature,
                 request.top_k,
                 bias_row=(self._bias_row(request)
-                          if request.logit_bias else None))
+                          if request.logit_bias
+                          or request.guided is not None else None))
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot.index)
             if self._spec:
@@ -848,7 +910,18 @@ class ContinuousBatchingEngine:
             return
         request.output_ids.append(token)
         self.total_generated += 1
-        if token in request.stop_ids:
+        grammar_done = False
+        if request.guided is not None and token not in request.stop_ids:
+            state = request.guided.advance(request.guided_state, token)
+            request.guided_state = state
+            # dead state is unreachable while masks are enforced (the
+            # sampler can't pick a -1e9 token); treat it as completion
+            # defensively rather than decoding garbage forever
+            grammar_done = (state is None
+                            or request.guided.is_exhausted(state))
+            if not grammar_done:
+                slot.bias_stale = True
+        if token in request.stop_ids or grammar_done:
             request.finish_reason = "stop"
         elif len(request.output_ids) >= request.max_tokens:
             request.finish_reason = "length"
@@ -1007,6 +1080,13 @@ class ContinuousBatchingEngine:
         """Admit + one whole-batch decode step (sampling fused on
         device — only [B] token ids come back). Returns #active slots."""
         self._admit()
+        # guided slots: re-sync device bias rows with automaton states
+        # advanced by the previous step's emissions (one [V] row upload
+        # per advanced guided slot — masks memoize per state)
+        for s in self.slots:
+            if s.request is not None and s.bias_stale:
+                self._install_bias(s.request, s.index)
+                s.bias_stale = False
         handled = 0
         if self.config.chunked_prefill_tokens > 0:
             prefilling = [s for s in self.slots
@@ -1039,6 +1119,7 @@ class ContinuousBatchingEngine:
         if self._spec and \
                 any(s.request.temperature <= 0.0 for s in active) and \
                 all(s.request.adapter is None for s in active) and \
+                all(s.request.guided is None for s in active) and \
                 all(s.draft_ready for s in active) and \
                 all(s.pos + self.config.spec_tokens
                     <= self.config.max_seq - 1 for s in active):
@@ -1047,7 +1128,10 @@ class ContinuousBatchingEngine:
             return self._spec_step(active)
         K = self.config.multi_step
         if K > 1 and all(s.pos + K <= self.config.max_seq - 1
-                         for s in active):
+                         for s in active) and \
+                all(s.request.guided is None for s in active):
+            # guided slots need a mask refresh between tokens, which a
+            # fused K-step scan cannot do — dense fallback while active
             return self._multi_step(active, K) + handled
         jnp = self._jnp
         tokens, pos, temp, topk, lora_idx = self._gather_batch(
@@ -1117,6 +1201,7 @@ class ContinuousBatchingEngine:
             slot.prefilling = False
             slot.prefill_ids = None
             slot.prefill_pos = 0
+            slot.bias_stale = False
         self.cache_k, self.cache_v = llama_init_cache(
             self.config.model, self.config.max_batch, self.config.max_seq)
         if self._spec:

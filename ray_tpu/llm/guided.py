@@ -1,0 +1,499 @@
+"""TPU-native guided decoding: grammar-constrained generation.
+
+Reference: the reference's chat surface inherits ``tools``,
+``tool_choice`` and ``response_format`` from vLLM's request models
+(python/ray/llm/_internal/serve/core/configs/openai_api_models.py:14-38)
+and vLLM's guided-decoding backends do the enforcement. Here it is
+in-tree and TPU-shaped: a grammar (JSON schema, generic JSON, or a
+tool-call grammar) compiles to a character-level NFA; each decode step
+the engine asks for the mask of vocabulary tokens whose FULL string
+survives the automaton from the current state and folds everything
+else into the slot's device-resident logit-bias row as -1e9 — so the
+constraint is enforced inside the jitted on-device sampler, never by
+post-hoc retries. The automaton walk itself is host-side (one state
+advance per emitted token); masks are memoized per automaton state, so
+steady-state cost is one [V] row upload per guided slot per step.
+
+Design notes:
+- Generic JSON (``response_format={"type": "json_object"}``) is not a
+  regular language; it is compiled with nesting unrolled to a bounded
+  depth (default 5). Deeper nesting is rejected by the mask — stated
+  divergence from vLLM's pushdown backends.
+- Schema objects follow OpenAI structured-output "strict" semantics:
+  properties listed in ``required`` are emitted in declaration order;
+  non-required properties are not generated.
+- Numbers cap at 15 integer / 15 fraction / 3 exponent digits so every
+  scalar sub-grammar is finite (greedy decoding cannot loop forever in
+  a digit run).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "TokenConstraint", "json_schema_constraint", "json_object_constraint",
+    "tool_call_constraint",
+]
+
+# JSON string content: anything except the quote, the backslash and
+# control characters (escapes handled separately).
+_STR_EXCLUDED = frozenset({'"', "\\"} | {chr(i) for i in range(0x20)})
+_HEX = frozenset("0123456789abcdefABCDEF")
+_DIGIT = frozenset("0123456789")
+_DIGIT19 = frozenset("123456789")
+
+_MAX_INT_DIGITS = 15
+_MAX_FRAC_DIGITS = 15
+_MAX_EXP_DIGITS = 3
+
+
+class _Grammar:
+    """Thompson-construction NFA builder over characters.
+
+    Fragments are (start_node, accept_node) pairs; every combinator
+    returns FRESH nodes, so a fragment is single-use — repetition
+    combinators take zero-arg factories and instantiate copies.
+    """
+
+    def __init__(self):
+        self.eps: List[List[int]] = []
+        # per node: list of (chars, negated, dst)
+        self.edges: List[List[Tuple[frozenset, bool, int]]] = []
+
+    def _node(self) -> int:
+        self.eps.append([])
+        self.edges.append([])
+        return len(self.eps) - 1
+
+    # -- combinators ---------------------------------------------------
+    def lit(self, s: str):
+        start = self._node()
+        cur = start
+        for ch in s:
+            nxt = self._node()
+            self.edges[cur].append((frozenset((ch,)), False, nxt))
+            cur = nxt
+        return (start, cur)
+
+    def cls(self, chars, negated: bool = False):
+        a, b = self._node(), self._node()
+        self.edges[a].append((frozenset(chars), negated, b))
+        return (a, b)
+
+    def seq(self, *frags):
+        if not frags:
+            a = self._node()
+            return (a, a)
+        for (_, acc), (nxt, _) in zip(frags, frags[1:]):
+            self.eps[acc].append(nxt)
+        return (frags[0][0], frags[-1][1])
+
+    def alt(self, *frags):
+        s, t = self._node(), self._node()
+        for a, b in frags:
+            self.eps[s].append(a)
+            self.eps[b].append(t)
+        return (s, t)
+
+    def opt(self, frag):
+        s, t = self._node(), self._node()
+        self.eps[s].append(frag[0])
+        self.eps[frag[1]].append(t)
+        self.eps[s].append(t)
+        return (s, t)
+
+    def star(self, frag):
+        s = self._node()
+        self.eps[s].append(frag[0])
+        self.eps[frag[1]].append(s)
+        return (s, s)
+
+    def rep(self, factory, lo: int, hi: Optional[int]):
+        """factory() repeated between lo and hi times (hi=None: *)."""
+        frags = [factory() for _ in range(lo)]
+        if hi is None:
+            frags.append(self.star(factory()))
+        else:
+            if hi < lo:
+                raise ValueError(f"repetition bounds {lo}..{hi} invalid")
+            tail = None
+            for _ in range(hi - lo):
+                piece = factory()
+                if tail is not None:
+                    piece = self.seq(piece, tail)
+                tail = self.opt(piece)
+            if tail is not None:
+                frags.append(tail)
+        return self.seq(*frags)
+
+    # -- JSON pieces ---------------------------------------------------
+    def _string_char(self):
+        escape = self.seq(
+            self.lit("\\"),
+            self.alt(self.cls('"\\/bfnrt'),
+                     self.seq(self.lit("u"),
+                              *[self.cls(_HEX) for _ in range(4)])))
+        return self.alt(self.cls(_STR_EXCLUDED, negated=True), escape)
+
+    def json_string(self, min_len: int = 0, max_len: Optional[int] = None):
+        return self.seq(self.lit('"'),
+                        self.rep(self._string_char, min_len, max_len),
+                        self.lit('"'))
+
+    def _int_body(self):
+        return self.alt(
+            self.lit("0"),
+            self.seq(self.cls(_DIGIT19),
+                     self.rep(lambda: self.cls(_DIGIT), 0,
+                              _MAX_INT_DIGITS - 1)))
+
+    def json_integer(self):
+        return self.seq(self.opt(self.lit("-")), self._int_body())
+
+    def json_number(self):
+        frac = self.seq(self.lit("."),
+                        self.rep(lambda: self.cls(_DIGIT), 1,
+                                 _MAX_FRAC_DIGITS))
+        expo = self.seq(self.cls("eE"), self.opt(self.cls("+-")),
+                        self.rep(lambda: self.cls(_DIGIT), 1,
+                                 _MAX_EXP_DIGITS))
+        return self.seq(self.opt(self.lit("-")), self._int_body(),
+                        self.opt(frac), self.opt(expo))
+
+    def json_value(self, depth: int):
+        """Any JSON value, nesting unrolled to ``depth`` levels."""
+        opts = [self.json_string(), self.json_number(),
+                self.lit("true"), self.lit("false"), self.lit("null")]
+        if depth > 0:
+            opts.append(self.any_object(depth - 1))
+            opts.append(self.any_array(depth - 1))
+        return self.alt(*opts)
+
+    def any_object(self, depth: int):
+        def member():
+            return self.seq(self.json_string(), self.lit(":"),
+                            self.json_value(depth))
+        body = self.seq(member(),
+                        self.star(self.seq(self.lit(","), member())))
+        return self.seq(self.lit("{"), self.opt(body), self.lit("}"))
+
+    def any_array(self, depth: int):
+        body = self.seq(self.json_value(depth),
+                        self.star(self.seq(self.lit(","),
+                                           self.json_value(depth))))
+        return self.seq(self.lit("["), self.opt(body), self.lit("]"))
+
+    # -- JSON Schema compiler ------------------------------------------
+    def schema(self, schema: Dict[str, Any], depth: int = 24):
+        """Compile a JSON-schema subset to a fragment.
+
+        Supported: object (properties + required, strict ordering),
+        array (items, minItems/maxItems), string (minLength/maxLength,
+        enum), integer, number, boolean, null, enum, const,
+        anyOf/oneOf, type lists. Unsupported keywords (pattern, $ref,
+        allOf, format-validation) raise ValueError so a request fails
+        loudly at validation time instead of silently ignoring its
+        schema.
+        """
+        if depth < 0:
+            raise ValueError("schema nesting exceeds compiler depth")
+        if schema is True or schema == {}:
+            return self.json_value(3)
+        if not isinstance(schema, dict):
+            raise ValueError("schema must be an object")
+        for bad in ("$ref", "allOf", "pattern", "patternProperties",
+                    "not", "if"):
+            if bad in schema:
+                raise ValueError(
+                    f"unsupported JSON-schema keyword {bad!r}")
+        if "enum" in schema:
+            return self.alt(*[
+                self.lit(json.dumps(v, separators=(",", ":"),
+                                    sort_keys=True))
+                for v in schema["enum"]])
+        if "const" in schema:
+            return self.lit(json.dumps(schema["const"],
+                                       separators=(",", ":"),
+                                       sort_keys=True))
+        for key in ("anyOf", "oneOf"):
+            if key in schema:
+                return self.alt(*[self.schema(s, depth - 1)
+                                  for s in schema[key]])
+        t = schema.get("type")
+        if isinstance(t, list):
+            return self.alt(*[self.schema({**schema, "type": one},
+                                          depth - 1) for one in t])
+        if t == "string":
+            return self.json_string(int(schema.get("minLength", 0)),
+                                    schema.get("maxLength"))
+        if t == "integer":
+            return self.json_integer()
+        if t == "number":
+            return self.json_number()
+        if t == "boolean":
+            return self.alt(self.lit("true"), self.lit("false"))
+        if t == "null":
+            return self.lit("null")
+        if t == "array":
+            items = schema.get("items", {})
+            lo = int(schema.get("minItems", 0))
+            hi = schema.get("maxItems")
+
+            def item():
+                return self.schema(items, depth - 1)
+
+            if lo == 0:
+                body = self.opt(self.seq(
+                    item(), self._rep_sep(item, 0, None if hi is None
+                                          else hi - 1)))
+                if hi == 0:
+                    body = self.seq()
+            else:
+                body = self.seq(item(), self._rep_sep(
+                    item, lo - 1, None if hi is None else hi - 1))
+            return self.seq(self.lit("["), body, self.lit("]"))
+        if t == "object" or (t is None and "properties" in schema):
+            props = schema.get("properties", {})
+            required = schema.get("required")
+            if required is not None:
+                unknown = [n for n in required if n not in props]
+                if unknown:
+                    raise ValueError(
+                        f"required names {unknown} not in properties")
+                names = [n for n in props if n in set(required)]
+            else:
+                names = list(props)
+            if not names:
+                return self.lit("{}")
+            parts = [self.lit("{")]
+            for i, name in enumerate(names):
+                if i:
+                    parts.append(self.lit(","))
+                parts.append(self.lit(json.dumps(name) + ":"))
+                parts.append(self.schema(props[name], depth - 1))
+            parts.append(self.lit("}"))
+            return self.seq(*parts)
+        if t is None:
+            return self.json_value(3)
+        raise ValueError(f"unsupported schema type {t!r}")
+
+    def _rep_sep(self, item, lo: int, hi: Optional[int]):
+        """(',' item) repeated lo..hi times."""
+        return self.rep(lambda: self.seq(self.lit(","), item()), lo, hi)
+
+
+class TokenConstraint:
+    """A compiled grammar bound to a vocabulary.
+
+    State is an opaque frozenset of NFA nodes — callers (the engine)
+    hold one state per request and thread it through:
+
+        state = c.start_state()
+        mask  = c.token_mask(state)        # np.bool_[vocab]
+        state = c.advance(state, token_id) # None once dead/complete
+
+    Instances are immutable and thread-safe (mask/step memoization
+    guarded by a lock), so one constraint can serve many concurrent
+    requests and its mask cache warms across them.
+    """
+
+    def __init__(self, grammar: _Grammar, frag, token_strs: List[Optional[str]],
+                 eos_id: Optional[int] = None):
+        self._eps = grammar.eps
+        self._edges = grammar.edges
+        self._accept = frag[1]
+        self._eos_id = eos_id
+        self._token_strs = token_strs
+        self._start = self._closure(frozenset((frag[0],)))
+        # vocabulary trie: shared prefixes walk the automaton once
+        root: Dict[str, Any] = {"kids": {}, "ids": []}
+        for tid, s in enumerate(token_strs):
+            if not s:  # None (special) or empty string: never allowed
+                continue
+            node = root
+            for ch in s:
+                node = node["kids"].setdefault(ch, {"kids": {}, "ids": []})
+            node["ids"].append(tid)
+        self._trie = root
+        self._mask_cache: Dict[frozenset, np.ndarray] = {}
+        self._step_cache: Dict[Tuple[frozenset, str], frozenset] = {}
+        self._lock = threading.Lock()
+
+    def __getstate__(self):
+        # constraints cross actor boundaries (disagg prefill→decode,
+        # batch-inference engine actors): drop the unpicklable lock,
+        # ship the memoized caches as-is
+        state = dict(self.__dict__)
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._token_strs)
+
+    def start_state(self) -> frozenset:
+        return self._start
+
+    def accepting(self, state: frozenset) -> bool:
+        return self._accept in state
+
+    def is_exhausted(self, state: frozenset) -> bool:
+        """No character can extend the match — generation must stop."""
+        return not any(self._edges[n] for n in state)
+
+    # -- automaton core ------------------------------------------------
+    def _closure(self, nodes: frozenset) -> frozenset:
+        seen = set(nodes)
+        stack = list(nodes)
+        while stack:
+            for nxt in self._eps[stack.pop()]:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return frozenset(seen)
+
+    def _step(self, state: frozenset, ch: str) -> frozenset:
+        key = (state, ch)
+        hit = self._step_cache.get(key)
+        if hit is not None:
+            return hit
+        targets = {dst for n in state
+                   for chars, negated, dst in self._edges[n]
+                   if (ch in chars) != negated}
+        out = self._closure(frozenset(targets)) if targets else frozenset()
+        with self._lock:
+            self._step_cache[key] = out
+        return out
+
+    def token_mask(self, state: frozenset) -> np.ndarray:
+        """Boolean [vocab] mask of tokens whose full string survives
+        the automaton from ``state`` (EOS allowed iff accepting)."""
+        cached = self._mask_cache.get(state)
+        if cached is not None:
+            return cached
+        mask = np.zeros(len(self._token_strs), dtype=bool)
+        stack = [(self._trie, state)]
+        while stack:
+            node, st = stack.pop()
+            for tid in node["ids"]:
+                mask[tid] = True
+            for ch, child in node["kids"].items():
+                nst = self._step(st, ch)
+                if nst:
+                    stack.append((child, nst))
+        if self._eos_id is not None and self.accepting(state):
+            mask[self._eos_id] = True
+        with self._lock:
+            self._mask_cache[state] = mask
+        return mask
+
+    def advance(self, state: frozenset, token_id: int) -> Optional[frozenset]:
+        """State after emitting ``token_id``; None when the automaton
+        dies (or the token is a special with no string form)."""
+        s = self._token_strs[token_id] if \
+            0 <= token_id < len(self._token_strs) else None
+        if not s:
+            return None
+        for ch in s:
+            state = self._step(state, ch)
+            if not state:
+                return None
+        return state
+
+    def matches(self, text: str) -> bool:
+        """Full-text acceptance check (used by tests and parsers)."""
+        state = self._start
+        for ch in text:
+            state = self._step(state, ch)
+            if not state:
+                return False
+        return self.accepting(state)
+
+    def valid_prefix(self, text: str) -> bool:
+        """True if ``text`` can still be extended to an accepted
+        string (length-truncated guided output satisfies this)."""
+        state = self._start
+        for ch in text:
+            state = self._step(state, ch)
+            if not state:
+                return False
+        return True
+
+
+# -- public constructors ----------------------------------------------
+
+def json_schema_constraint(schema: Dict[str, Any],
+                           token_strs: List[Optional[str]],
+                           eos_id: Optional[int] = None) -> TokenConstraint:
+    """Constraint enforcing a JSON-schema subset (OpenAI
+    ``response_format={"type": "json_schema", ...}``)."""
+    g = _Grammar()
+    return TokenConstraint(g, g.schema(schema), token_strs, eos_id)
+
+
+def json_object_constraint(token_strs: List[Optional[str]],
+                           eos_id: Optional[int] = None,
+                           max_depth: int = 5) -> TokenConstraint:
+    """Constraint enforcing any JSON object (OpenAI
+    ``response_format={"type": "json_object"}``), nesting bounded at
+    ``max_depth`` levels."""
+    g = _Grammar()
+    return TokenConstraint(g, g.any_object(max_depth), token_strs, eos_id)
+
+
+def tool_call_constraint(tools: List[Dict[str, Any]],
+                         token_strs: List[Optional[str]],
+                         eos_id: Optional[int] = None,
+                         forced_name: Optional[str] = None
+                         ) -> TokenConstraint:
+    """Constraint forcing a well-formed tool call
+    ``{"name":"<fn>","arguments":{...}}`` where the arguments object
+    obeys the named function's ``parameters`` schema (OpenAI ``tools``
+    with ``tool_choice="required"`` or a named function)."""
+    g = _Grammar()
+    alts = []
+    for tool in tools:
+        fn = tool.get("function") or {}
+        name = fn.get("name")
+        if forced_name is not None and name != forced_name:
+            continue
+        params = fn.get("parameters")
+        if params is None:
+            params = {"type": "object", "properties": {}}
+        alts.append(g.seq(
+            g.lit('{"name":' + json.dumps(name) + ',"arguments":'),
+            g.schema(params),
+            g.lit("}")))
+    if not alts:
+        raise ValueError(
+            f"tool_choice names {forced_name!r} but no such tool")
+    return TokenConstraint(g, g.alt(*alts), token_strs, eos_id)
+
+
+def parse_tool_call(text: str,
+                    tool_names: Optional[List[str]] = None
+                    ) -> Optional[Dict[str, Any]]:
+    """Parse ``{"name": ..., "arguments": {...}}`` out of generated
+    text; returns {"name", "arguments"(dict)} or None. Used both for
+    grammar-constrained output and for tool_choice="auto" detection."""
+    try:
+        obj = json.loads(text)
+    except (ValueError, TypeError):
+        return None
+    if not isinstance(obj, dict) or not isinstance(obj.get("name"), str):
+        return None
+    args = obj.get("arguments")
+    if not isinstance(args, dict):
+        return None
+    if tool_names is not None and obj["name"] not in tool_names:
+        return None
+    return {"name": obj["name"], "arguments": args}

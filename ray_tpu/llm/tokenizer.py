@@ -26,6 +26,12 @@ class ByteTokenizer:
         data = bytes(i for i in ids if 0 <= i < 256)
         return data.decode("utf-8", "replace")
 
+    def token_strings(self) -> List[Optional[str]]:
+        """Per-id string form for guided decoding (None = special,
+        never maskable). Bytes map 1:1 onto U+0000..U+00FF so the
+        grammar automaton runs over characters."""
+        return [chr(i) for i in range(256)] + [None, None]
+
 
 class HFTokenizer:
     def __init__(self, name: str):
@@ -42,6 +48,24 @@ class HFTokenizer:
 
     def decode(self, ids: List[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
+
+    def token_strings(self) -> List[Optional[str]]:
+        """Per-id decoded string for guided decoding (specials map to
+        None). Best-effort: byte-fallback pieces that don't round-trip
+        through convert_tokens_to_string decode as replacement chars
+        and simply never match a grammar."""
+        specials = set(self._tok.all_special_ids or ())
+        out: List[Optional[str]] = []
+        for tid in range(self.vocab_size):
+            if tid in specials:
+                out.append(None)
+                continue
+            piece = self._tok.convert_ids_to_tokens(tid)
+            try:
+                out.append(self._tok.convert_tokens_to_string([piece]))
+            except Exception:  # noqa: BLE001 — odd added tokens
+                out.append(None)
+        return out
 
 
 def get_tokenizer(name: Optional[str] = None):

@@ -210,6 +210,18 @@ def _attention(q, k, v, config: LlamaConfig, mesh):
     return _attention_reference(q, k, v, True)
 
 
+def _int8_mm(x2d, w8, scale):
+    """x2d @ dequant(w8, scale): the Pallas in-register-dequant kernel
+    on TPU (halved HBM weight traffic — ops/quant_matmul.py), an XLA
+    dequant matmul elsewhere (CPU tests; same math, so outputs agree
+    across backends up to accumulation order)."""
+    if jax.default_backend() == "tpu":
+        from ray_tpu.ops.quant_matmul import int8_matmul
+        return int8_matmul(x2d.astype(jnp.bfloat16), w8, scale) \
+            .astype(x2d.dtype)
+    return (x2d @ w8.astype(x2d.dtype)) * scale.astype(x2d.dtype)
+
+
 def _ffn(layer_params, h, config: LlamaConfig):
     """FFN output (pre-residual): dense SwiGLU or the MoE layer.
     Returns (y, aux) — aux is the MoE load-balancing loss (0 if dense)."""
@@ -220,9 +232,42 @@ def _ffn(layer_params, h, config: LlamaConfig):
                        layer_params["w3"], layer_params["w2"],
                        top_k=c.moe_top_k,
                        capacity_factor=c.moe_capacity_factor)
+    if "w1_q8" in layer_params:
+        # weight-only int8 serving path (quantize_llama_ffn)
+        b_t = h.shape[:-1]
+        h2 = h.reshape(-1, h.shape[-1])
+        gate = jax.nn.silu(_int8_mm(h2, layer_params["w1_q8"],
+                                    layer_params["w1_s"]))
+        up = _int8_mm(h2, layer_params["w3_q8"], layer_params["w3_s"])
+        y = _int8_mm((gate * up).astype(h.dtype),
+                     layer_params["w2_q8"], layer_params["w2_s"])
+        return (y.reshape(*b_t, -1).astype(h.dtype),
+                jnp.zeros((), jnp.float32))
     gate = jax.nn.silu(h @ layer_params["w1"])
     up = h @ layer_params["w3"]
     return (gate * up) @ layer_params["w2"], jnp.zeros((), jnp.float32)
+
+
+def quantize_llama_ffn(params, config: LlamaConfig):
+    """Weight-only int8 for the stacked FFN weights (w1/w3/w2 — ~2/3
+    of a dense Llama's parameters): replaces each [L, K, N] stack with
+    an int8 stack plus per-output-channel scales. Attention
+    projections and lm_head stay in the working dtype (their HBM
+    traffic is a minority and the KV cache dominates attention reads).
+    Reference analog: vLLM quantization passthrough
+    (llm/_internal/serve/engines/vllm/vllm_models.py:214)."""
+    if config.moe_experts:
+        raise ValueError("int8 quantization supports dense FFNs only "
+                         "(MoE expert stacks are not wired)")
+    from ray_tpu.ops.quant_matmul import quantize_int8
+    layers = dict(params["layers"])
+    for name in ("w1", "w3", "w2"):
+        if name not in layers:
+            raise ValueError(f"params missing FFN stack {name!r}")
+        w8, scale = jax.vmap(quantize_int8)(layers.pop(name))
+        layers[name + "_q8"] = w8
+        layers[name + "_s"] = scale
+    return {**params, "layers": layers}
 
 
 def _block(layer_params, x, cos, sin, config: LlamaConfig, mesh,

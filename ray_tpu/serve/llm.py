@@ -13,6 +13,8 @@ the continuous-batching path the reference gets from vLLM.
 
 from __future__ import annotations
 
+import json
+import re
 import threading
 import time
 import uuid
@@ -22,7 +24,14 @@ from typing import Any, Dict, List, Optional
 from ray_tpu import serve
 from ray_tpu.llm.engine import (
     ContinuousBatchingEngine, EngineConfig, GenerationRequest)
+from ray_tpu.llm.guided import (
+    json_object_constraint, json_schema_constraint, parse_tool_call,
+    tool_call_constraint)
 from ray_tpu.llm.tokenizer import get_tokenizer
+
+
+# cap on per-replica compiled guided-decoding constraints (LRU)
+_MAX_CONSTRAINTS = 32
 
 
 @dataclass
@@ -88,6 +97,10 @@ class LLMServer:
                 f"tokenizer vocab ({self.tokenizer.vocab_size}) exceeds "
                 f"model vocab ({config.engine.model.vocab_size}); token "
                 "embedding lookups would silently clamp")
+        # guided decoding: compiled constraints memoized per schema /
+        # tool set (mask caches inside them warm across requests)
+        self._constraint_cache: Dict[Any, Any] = {}
+        self._token_strs: Optional[List[Optional[str]]] = None
         self._wake = threading.Event()
         self._stopped = False
         self._stepper = threading.Thread(target=self._step_loop,
@@ -189,8 +202,258 @@ class LLMServer:
             out["stop"] = list(stop)
         return out
 
+    # -- guided decoding: tools / tool_choice / response_format --------
+    # (reference surface: openai_api_models.py:14-38 — vLLM's request
+    # models; enforcement here is the in-tree TPU-native grammar-mask
+    # path in ray_tpu.llm.guided)
+
+    def _vocab_strings(self) -> List[Optional[str]]:
+        if self._token_strs is None:
+            self._token_strs = self.tokenizer.token_strings()
+        return self._token_strs
+
+    def _cached_constraint(self, key, build):
+        # Bounded LRU: one compiled NFA + its per-state mask caches
+        # per distinct schema/tool-set — unbounded retention would let
+        # clients rotating unique schemas grow replica memory without
+        # limit. Module constant (not class attribute): this method is
+        # borrowed by PrefillServer/DisaggRouter in llm/disagg.py.
+        cache = self._constraint_cache
+        c = cache.get(key)
+        if c is None:
+            c = build()
+            cache[key] = c
+            while len(cache) > _MAX_CONSTRAINTS:
+                cache.pop(next(iter(cache)))
+        else:
+            # re-insert = recency bump (plain dict preserves order)
+            cache.pop(key)
+            cache[key] = c
+        return c
+
+    def _resolve_guided(self, body: Dict[str, Any],
+                        allow_tools: bool = True) -> Dict[str, Any]:
+        """Validate tools/tool_choice/response_format and build the
+        grammar constraint. Returns {"constraint", "kind",
+        "tool_mode" (None|"auto"|"forced"), "tool_names"}."""
+        tools = body.get("tools")
+        tool_choice = body.get("tool_choice")
+        rf = body.get("response_format")
+        out: Dict[str, Any] = {"constraint": None, "kind": None,
+                               "tool_mode": None, "tool_names": []}
+
+        rf_type = None
+        if rf is not None:
+            if not isinstance(rf, dict) or rf.get("type") not in (
+                    "text", "json_object", "json_schema"):
+                raise ValueError(
+                    'response_format.type must be "text", "json_object"'
+                    ' or "json_schema"')
+            rf_type = None if rf["type"] == "text" else rf["type"]
+
+        if tools is not None and not allow_tools:
+            raise ValueError(
+                "tools are only supported on /v1/chat/completions")
+        names: List[str] = []
+        if tools is not None:
+            if not isinstance(tools, list) or not tools:
+                raise ValueError("tools must be a non-empty list")
+            for t in tools:
+                fn = t.get("function") if isinstance(t, dict) else None
+                if (not isinstance(t, dict)
+                        or t.get("type") != "function"
+                        or not isinstance(fn, dict)
+                        or not isinstance(fn.get("name"), str)
+                        or not fn["name"]):
+                    raise ValueError(
+                        'each tool must be {"type": "function", '
+                        '"function": {"name": ...}}')
+                if fn.get("parameters") is not None and \
+                        not isinstance(fn["parameters"], dict):
+                    raise ValueError(
+                        "tool function.parameters must be an object")
+                names.append(fn["name"])
+            if len(set(names)) != len(names):
+                raise ValueError("duplicate tool function names")
+        out["tool_names"] = names
+
+        choice = tool_choice
+        if choice is None:
+            choice = "auto" if tools else "none"
+        forced_name = None
+        if isinstance(choice, dict):
+            fn = choice.get("function")
+            if choice.get("type") != "function" or \
+                    not isinstance(fn, dict) or \
+                    not isinstance(fn.get("name"), str):
+                raise ValueError(
+                    'tool_choice object must be {"type": "function", '
+                    '"function": {"name": ...}}')
+            forced_name = fn["name"]
+            if forced_name not in names:
+                raise ValueError(
+                    f"tool_choice names unknown function {forced_name!r}")
+        elif choice not in ("none", "auto", "required"):
+            raise ValueError(
+                'tool_choice must be "none", "auto", "required" or a '
+                "named function object")
+        if tool_choice is not None and tool_choice != "none" \
+                and not tools:
+            raise ValueError("tool_choice requires tools")
+
+        eos = self.tokenizer.eos_id
+        vocab = self._vocab_strings
+        constrained_tools = tools is not None and (
+            choice == "required" or forced_name is not None)
+        if constrained_tools:
+            if rf_type is not None:
+                raise ValueError(
+                    "response_format cannot be combined with a forced "
+                    "tool_choice")
+            key = ("tools", json.dumps(tools, sort_keys=True),
+                   forced_name)
+            out["constraint"] = self._cached_constraint(
+                key, lambda: tool_call_constraint(
+                    tools, vocab(), eos, forced_name=forced_name))
+            out["kind"] = "tools"
+            out["tool_mode"] = "forced"
+            return out
+        if tools is not None and choice == "auto" and rf_type is None:
+            out["tool_mode"] = "auto"
+        if rf_type == "json_object":
+            out["constraint"] = self._cached_constraint(
+                ("json_object",),
+                lambda: json_object_constraint(vocab(), eos))
+            out["kind"] = "json_object"
+        elif rf_type == "json_schema":
+            js = rf.get("json_schema")
+            if not isinstance(js, dict) or \
+                    not isinstance(js.get("schema"), dict):
+                raise ValueError(
+                    "response_format.json_schema.schema is required")
+            key = ("schema", json.dumps(js["schema"], sort_keys=True))
+            out["constraint"] = self._cached_constraint(
+                key, lambda: json_schema_constraint(
+                    js["schema"], vocab(), eos))
+            out["kind"] = "json_schema"
+        return out
+
+    def _chat_prompt(self, body: Dict[str, Any],
+                     messages: List[Dict[str, Any]]) -> str:
+        """Render the chat template: tool definitions (when given) as
+        a leading segment, then one segment per message; assistant
+        tool_calls and tool results render as JSON text."""
+        parts = []
+        tools = body.get("tools")
+        if tools:
+            parts.append("<|tools|>" + json.dumps(
+                tools, separators=(",", ":"), sort_keys=True))
+        for m in messages:
+            role = m.get("role", "user")
+            if m.get("tool_calls") is not None:
+                content = json.dumps(m["tool_calls"],
+                                     separators=(",", ":"),
+                                     sort_keys=True)
+            else:
+                content = self._flatten_content(m.get("content") or "")
+            parts.append(f"<|{role}|>{content}")
+        return "".join(parts) + "<|assistant|>"
+
+    def _chat_message(self, guided_info: Optional[Dict[str, Any]],
+                      result: Dict[str, Any]):
+        """(message, finish_reason) for one chat choice: tool-call
+        output parses into OpenAI tool_calls with finish_reason
+        "tool_calls"; everything else is assistant content."""
+        text = result["text"]
+        finish = result["finish_reason"]
+        if guided_info and guided_info["tool_mode"] is not None:
+            parsed = parse_tool_call(text, guided_info["tool_names"])
+            if parsed is not None:
+                call = {
+                    "id": f"call_{uuid.uuid4().hex[:24]}",
+                    "type": "function",
+                    "function": {
+                        "name": parsed["name"],
+                        "arguments": json.dumps(
+                            parsed["arguments"],
+                            separators=(",", ":"))}}
+                return ({"role": "assistant", "content": None,
+                         "tool_calls": [call]}, "tool_calls")
+        return {"role": "assistant", "content": text}, finish
+
+    # head of a grammar-shaped tool call; used to classify streams
+    _TOOL_HEAD = re.compile(r'^\{"name":("(?:[^"\\]|\\.)*"),"arguments":')
+
+    @staticmethod
+    def _tool_head_prefix_ok(buf: str) -> bool:
+        """Could ``buf`` still grow into a tool-call head? Decides how
+        long an auto-mode stream is buffered before being classified
+        as plain content."""
+        probe = '{"name":"'
+        if len(buf) <= len(probe):
+            return probe.startswith(buf)
+        if not buf.startswith(probe):
+            return False
+        i = len(probe)
+        while i < len(buf):
+            ch = buf[i]
+            if ch == "\\":
+                i += 2
+                continue
+            if ch == '"':
+                break
+            i += 1
+        else:
+            return True  # still inside the name string
+        rest = buf[i + 1:]  # after the name's closing quote
+        tail = ',"arguments":'
+        return tail.startswith(rest) or rest.startswith(tail)
+
+    def _stream_tool_events(self, deltas, tool_names: List[str]):
+        """Classify a token stream into ("content", text) /
+        ("tool_head", name) / ("tool_args", text) events. Tool-call
+        argument text streams incrementally with a 1-char holdback so
+        the grammar's closing wrapper brace is never emitted."""
+        buf = ""
+        decided = None
+        sent = 0
+        for delta in deltas:
+            buf += delta
+            if decided is None:
+                m = self._TOOL_HEAD.match(buf)
+                if m:
+                    name = json.loads(m.group(1))
+                    if not tool_names or name in tool_names:
+                        decided = "tool"
+                        sent = m.end()
+                        yield ("tool_head", name)
+                    else:
+                        decided = "content"
+                        yield ("content", buf)
+                        continue
+                elif self._tool_head_prefix_ok(buf):
+                    continue
+                else:
+                    decided = "content"
+                    yield ("content", buf)
+                    continue
+            if decided == "content":
+                yield ("content", delta)
+            else:
+                avail = len(buf) - 1  # hold back the wrapper brace
+                if avail > sent:
+                    yield ("tool_args", buf[sent:avail])
+                    sent = avail
+        if decided == "tool":
+            end = len(buf) - 1 if buf.endswith("}") else len(buf)
+            if end > sent:
+                yield ("tool_args", buf[sent:end])
+        elif decided is None and buf:
+            yield ("content", buf)
+
     def _make_request(self, prompt: str, *, max_tokens, temperature,
-                      top_k, adapter, logit_bias, stream_queue=None):
+                      top_k, adapter, logit_bias, guided=None,
+                      stream_queue=None):
         """ONE construction + admission path for all generate
         variants (non-stream, stop-string, stream) so a new sampling
         field cannot desync them."""
@@ -203,6 +466,7 @@ class LLMServer:
             top_k=top_k,
             adapter=adapter,
             logit_bias=logit_bias,
+            guided=guided,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else (),
             stream_queue=stream_queue)
@@ -232,6 +496,7 @@ class LLMServer:
             top_k=sampling["top_k"],
             adapter=sampling.get("adapter"),
             logit_bias=sampling.get("logit_bias"),
+            guided=sampling.get("guided"),
             stop=sampling.get("stop"))
         if n == 1:
             return [self._generate(prompt, **kwargs)]
@@ -244,7 +509,8 @@ class LLMServer:
         admitted = [self._make_request(
             prompt, max_tokens=kwargs["max_tokens"],
             temperature=kwargs["temperature"], top_k=kwargs["top_k"],
-            adapter=kwargs["adapter"], logit_bias=kwargs["logit_bias"])
+            adapter=kwargs["adapter"], logit_bias=kwargs["logit_bias"],
+            guided=kwargs["guided"])
             for _ in range(n)]
         while not all(r.done for _, r in admitted):
             time.sleep(0.001)
@@ -304,16 +570,18 @@ class LLMServer:
                   top_k: int = 0,
                   adapter: Optional[str] = None,
                   logit_bias: Optional[Dict[int, float]] = None,
+                  guided=None,
                   stop: Optional[List[str]] = None
                   ) -> Dict[str, Any]:
         if stop:
             return self._generate_with_stop(
                 prompt, max_tokens=max_tokens, temperature=temperature,
                 top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-                stop=stop)
+                guided=guided, stop=stop)
         ids, request = self._make_request(
             prompt, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, adapter=adapter, logit_bias=logit_bias)
+            top_k=top_k, adapter=adapter, logit_bias=logit_bias,
+            guided=guided)
         while not request.done:
             time.sleep(0.001)
         if request.error is not None:
@@ -333,6 +601,7 @@ class LLMServer:
                             top_k: int = 0,
                             adapter: Optional[str] = None,
                             logit_bias: Optional[Dict[int, float]] = None,
+                            guided=None,
                             stop: List[str] = ()) -> Dict[str, Any]:
         """Non-streaming generation with OpenAI stop STRINGS: watch
         the decoded text incrementally and cancel the engine request
@@ -344,7 +613,7 @@ class LLMServer:
         ids, request = self._make_request(
             prompt, max_tokens=max_tokens, temperature=temperature,
             top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-            stream_queue=queue.Queue())
+            guided=guided, stream_queue=queue.Queue())
         text = ""
         hit = False
         for delta in stream_text_deltas(self.tokenizer, request):
@@ -368,6 +637,7 @@ class LLMServer:
                          top_k: int = 0,
                          adapter: Optional[str] = None,
                          logit_bias: Optional[Dict[int, float]] = None,
+                         guided=None,
                          stop: Optional[List[str]] = None):
         """Yield decoded text per emitted token (reference: vLLM output
         streams behind serve token streaming). The engine's stepper
@@ -380,7 +650,7 @@ class LLMServer:
         _ids, request = self._make_request(
             prompt, max_tokens=max_tokens, temperature=temperature,
             top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-            stream_queue=queue.Queue())
+            guided=guided, stream_queue=queue.Queue())
         deltas = stream_text_deltas(self.tokenizer, request)
         if not stop:
             yield from deltas
@@ -413,6 +683,8 @@ class LLMServer:
             return self.completions(request)
         if path.endswith("/embeddings"):
             return self.embeddings(request)
+        if path.endswith("/score"):
+            return self.score(request)
         if path.endswith("/models"):
             return {"object": "list",
                     "data": [{"id": self.config.model_id,
@@ -459,14 +731,69 @@ class LLMServer:
             "usage": {"prompt_tokens": total, "total_tokens": total},
         }
 
+    def score(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """/v1/score: similarity of text_1 against each text_2
+        (reference surface: openai_api_models.py:123 ScoreRequest via
+        vLLM). Cross-encoder models are not in-tree, so the score is
+        the cosine similarity of the engine's pooled embeddings —
+        stated divergence; same request/response shape."""
+        t1 = body.get("text_1", body.get("query"))
+        t2 = body.get("text_2", body.get("documents"))
+        if not isinstance(t1, str) or not t1:
+            return self._invalid_request(ValueError(
+                "text_1 must be a non-empty string"))
+        if isinstance(t2, str):
+            texts = [t2]
+        elif isinstance(t2, (list, tuple)):
+            texts = list(t2)
+        else:
+            return self._invalid_request(ValueError(
+                "text_2 must be a string or a list of strings"))
+        if not texts or not all(isinstance(t, str) and t for t in texts):
+            return self._invalid_request(ValueError(
+                "text_2 must be a non-empty string or list of them"))
+        limit = self.config.engine.max_seq
+        ids1 = self.tokenizer.encode(t1)
+        if len(ids1) > limit:
+            return self._invalid_request(ValueError(
+                f"text_1 is {len(ids1)} tokens; this model's maximum "
+                f"context is {limit}"))
+        import numpy as _np
+        q = self.engine.embed(ids1)
+        qn = q / max(float(_np.linalg.norm(q)), 1e-12)
+        total = len(ids1)
+        data = []
+        for i, text in enumerate(texts):
+            ids = self.tokenizer.encode(text)
+            if len(ids) > limit:
+                return self._invalid_request(ValueError(
+                    f"text_2[{i}] is {len(ids)} tokens; this model's "
+                    f"maximum context is {limit}"))
+            total += len(ids)
+            d = self.engine.embed(ids)
+            dn = d / max(float(_np.linalg.norm(d)), 1e-12)
+            data.append({"object": "score", "index": i,
+                         "score": float(qn @ dn)})
+        return {
+            "object": "list",
+            "model": body.get("model", self.config.model_id),
+            "data": data,
+            "usage": {"prompt_tokens": total, "total_tokens": total},
+        }
+
     def completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         prompt = body.get("prompt", "")
         if not isinstance(prompt, str):
             return self._invalid_request(ValueError("prompt must be a string"))
         try:
             sampling = self._validate_sampling(body)
+            # response_format works on completions too (the reference's
+            # vLLM request models carry it on both surfaces); tools are
+            # chat-only
+            guided_info = self._resolve_guided(body, allow_tools=False)
         except ValueError as e:
             return self._invalid_request(e)
+        sampling["guided"] = guided_info["constraint"]
         if body.get("stream"):
             if sampling.get("n", 1) > 1:
                 return self._invalid_request(ValueError(
@@ -510,6 +837,7 @@ class LLMServer:
                 top_k=sampling["top_k"],
                 adapter=sampling.get("adapter"),
                 logit_bias=sampling.get("logit_bias"),
+                guided=sampling.get("guided"),
                 stop=sampling.get("stop")):
             chunk = {"id": cmpl_id, "object": "text_completion",
                      "model": model,
@@ -523,34 +851,54 @@ class LLMServer:
         yield "data: [DONE]\n\n"
 
     def _stream_chat(self, body: Dict[str, Any], prompt: str,
-                     sampling: Dict[str, Any]):
-        import json as _json
-
+                     sampling: Dict[str, Any],
+                     guided_info: Optional[Dict[str, Any]] = None):
         chat_id = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         model = body.get("model", self.config.model_id)
-        head = {"id": chat_id, "object": "chat.completion.chunk",
-                "model": model,
-                "choices": [{"index": 0,
-                             "delta": {"role": "assistant"},
-                             "finish_reason": None}]}
-        yield f"data: {_json.dumps(head)}\n\n"
-        for text in self._generate_stream(
-                prompt, max_tokens=sampling.get("max_tokens"),
-                temperature=sampling.get("temperature"),
-                top_k=sampling["top_k"],
-                adapter=sampling.get("adapter"),
-                logit_bias=sampling.get("logit_bias"),
-                stop=sampling.get("stop")):
-            chunk = {"id": chat_id, "object": "chat.completion.chunk",
-                     "model": model,
-                     "choices": [{"index": 0, "delta": {"content": text},
-                                  "finish_reason": None}]}
-            yield f"data: {_json.dumps(chunk)}\n\n"
-        final = {"id": chat_id, "object": "chat.completion.chunk",
-                 "model": model,
-                 "choices": [{"index": 0, "delta": {},
-                              "finish_reason": "stop"}]}
-        yield f"data: {_json.dumps(final)}\n\n"
+
+        def chunk(delta, finish=None):
+            payload = {"id": chat_id, "object": "chat.completion.chunk",
+                       "model": model,
+                       "choices": [{"index": 0, "delta": delta,
+                                    "finish_reason": finish}]}
+            return f"data: {json.dumps(payload)}\n\n"
+
+        yield chunk({"role": "assistant"})
+        deltas = self._generate_stream(
+            prompt, max_tokens=sampling.get("max_tokens"),
+            temperature=sampling.get("temperature"),
+            top_k=sampling["top_k"],
+            adapter=sampling.get("adapter"),
+            logit_bias=sampling.get("logit_bias"),
+            guided=sampling.get("guided"),
+            stop=sampling.get("stop"))
+        tools_live = guided_info and guided_info["tool_mode"] is not None
+        if not tools_live:
+            for text in deltas:
+                yield chunk({"content": text})
+            yield chunk({}, finish="stop")
+            yield "data: [DONE]\n\n"
+            return
+        # tool-call streaming (OpenAI delta.tool_calls): the first
+        # event carries id + function name; argument JSON streams
+        # incrementally as it decodes
+        made_tool = False
+        for kind, val in self._stream_tool_events(
+                deltas, guided_info["tool_names"]):
+            if kind == "content":
+                yield chunk({"content": val})
+            elif kind == "tool_head":
+                made_tool = True
+                yield chunk({"tool_calls": [{
+                    "index": 0,
+                    "id": f"call_{uuid.uuid4().hex[:24]}",
+                    "type": "function",
+                    "function": {"name": val, "arguments": ""}}]})
+            else:
+                yield chunk({"tool_calls": [{
+                    "index": 0,
+                    "function": {"arguments": val}}]})
+        yield chunk({}, finish="tool_calls" if made_tool else "stop")
         yield "data: [DONE]\n\n"
 
     def chat_completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
@@ -561,33 +909,31 @@ class LLMServer:
                 ValueError("messages must be a list of objects"))
         try:
             sampling = self._validate_sampling(body)
-            contents = [self._flatten_content(m.get("content", ""))
-                        for m in messages]
+            guided_info = self._resolve_guided(body)
+            prompt = self._chat_prompt(body, messages)
         except ValueError as e:
             return self._invalid_request(e)
-        prompt = "".join(
-            f"<|{m.get('role', 'user')}|>{content}"
-            for m, content in zip(messages, contents)) + "<|assistant|>"
+        sampling["guided"] = guided_info["constraint"]
         if body.get("stream"):
             if sampling.get("n", 1) > 1:
                 return self._invalid_request(ValueError(
                     "n > 1 is not supported with stream=true"))
-            return self._stream_chat(body, prompt, sampling)
+            return self._stream_chat(body, prompt, sampling, guided_info)
         try:
             results = self._generate_n(prompt, sampling)
         except ValueError as e:
             return self._invalid_request(e)
         result = results[0]
+        choices = []
+        for i, r in enumerate(results):
+            message, finish = self._chat_message(guided_info, r)
+            choices.append({"index": i, "message": message,
+                            "finish_reason": finish})
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
             "model": body.get("model", self.config.model_id),
-            "choices": [{
-                "index": i,
-                "message": {"role": "assistant",
-                            "content": r["text"]},
-                "finish_reason": r["finish_reason"],
-            } for i, r in enumerate(results)],
+            "choices": choices,
             "usage": {
                 "prompt_tokens": result["prompt_tokens"],
                 "completion_tokens": sum(r["completion_tokens"]
