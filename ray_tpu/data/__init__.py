@@ -20,6 +20,7 @@ from ray_tpu.data.dataset import Dataset, GroupedData, MaterializedDataset
 from ray_tpu.data.expressions import col, lit
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data import preprocessors
+from ray_tpu.data.datasource import Datasink, Datasource
 from ray_tpu.data.read_api import (
     from_arrow,
     from_blocks,
@@ -30,19 +31,25 @@ from ray_tpu.data.read_api import (
     range_tensor,
     read_binary_files,
     read_csv,
+    read_datasource,
     read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 
 __all__ = [
     "AggregateFn", "Block", "BlockAccessor", "BlockMetadata", "Count",
-    "DataContext", "DataIterator", "Dataset", "GroupedData", "Max",
-    "MaterializedDataset", "Mean", "Min", "Quantile", "Std", "Sum",
-    "col", "from_arrow", "from_blocks", "from_items", "from_numpy",
-    "from_pandas", "lit", "preprocessors", "range", "range_tensor",
-    "read_binary_files", "read_csv", "read_images", "read_json",
-    "read_numpy", "read_parquet", "read_text",
+    "DataContext", "DataIterator", "Datasink", "Dataset", "Datasource",
+    "GroupedData", "Max", "MaterializedDataset", "Mean", "Min",
+    "Quantile", "Std", "Sum", "col", "from_arrow", "from_blocks",
+    "from_items", "from_numpy", "from_pandas", "lit", "preprocessors",
+    "range", "range_tensor", "read_binary_files", "read_csv",
+    "read_datasource", "read_images", "read_json", "read_numpy",
+    "read_parquet", "read_sql", "read_text", "read_tfrecords",
+    "read_webdataset",
 ]

@@ -489,6 +489,36 @@ class Dataset:
             raise ValueError(f"unsupported image format {file_format!r}")
         self._write(path, file_format, column=column)
 
+    def write_datasink(self, sink) -> None:
+        """Write through a user-defined Datasink (reference:
+        dataset.py write_datasink over the public Datasink ABC):
+        ``sink.write`` runs once per block as a task, then
+        ``sink.on_write_complete`` runs here with the per-block
+        results."""
+        from ray_tpu.data.datasource import Datasink
+        if not isinstance(sink, Datasink):
+            raise ValueError("write_datasink takes a ray_tpu.data.Datasink")
+        ds = self._with_op(L.Write(self._plan.dag, sink.write,
+                                   name=f"Write[{type(sink).__name__}]"))
+        results = []
+        for bundle in ds._execute_stream():
+            acc = BlockAccessor(ray_tpu.get(bundle.block_ref))
+            results.extend(row.get("write_result")
+                           for row in acc.iter_rows())
+        sink.on_write_complete(results)
+
+    def write_tfrecords(self, path: str) -> None:
+        """One .tfrecords file of tf.train.Example records per block
+        (reference: dataset.py write_tfrecords)."""
+        from ray_tpu.data.datasource import TFRecordDatasink
+        self.write_datasink(TFRecordDatasink(path))
+
+    def write_sql(self, sql: str, connection_factory) -> None:
+        """executemany an INSERT per block over a DB-API connection
+        (reference: dataset.py write_sql)."""
+        from ray_tpu.data.datasource import SQLDatasink
+        self.write_datasink(SQLDatasink(sql, connection_factory))
+
     def _write(self, path: str, fmt: str, column=None) -> None:
         from ray_tpu.data.datasource import _FileWrite
         ds = self._with_op(L.Write(self._plan.dag,

@@ -218,3 +218,465 @@ def _jsonable(row: Dict[str, Any]) -> Dict[str, Any]:
             v = v.tolist()
         out[k] = v
     return out
+
+
+# ---------------------------------------------------------------------------
+# Public Datasource / Datasink seam
+# (reference: python/ray/data/datasource/datasource.py:32 Datasource ABC
+#  + read_api.py:360 read_datasource — user-pluggable sources)
+# ---------------------------------------------------------------------------
+
+
+class Datasource:
+    """User-pluggable read source: subclass, implement
+    ``get_read_tasks``, hand to ``ray_tpu.data.read_datasource``.
+
+    Each read task is a ZERO-ARG callable returning one pyarrow Table
+    block; tasks execute as ray_tpu tasks under the streaming executor,
+    so they must be picklable and self-contained."""
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        """Optional size hint for the executor's memory budget."""
+        return None
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class Datasink:
+    """User-pluggable write sink (reference:
+    datasource/datasink.py): ``write`` runs once per block as a task
+    (must be picklable); ``on_write_complete`` runs on the driver with
+    the per-block results (strings come back verbatim, other results
+    as 1)."""
+
+    def write(self, block: "pa.Table") -> Any:
+        raise NotImplementedError
+
+    def on_write_complete(self, write_results: List[Any]) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# TFRecord (reference: read_api.py:2078 read_tfrecords /
+# datasource/tfrecords_datasource.py — here without a tensorflow
+# dependency: in-tree tf.train.Example protobuf codec + crc32c framing)
+# ---------------------------------------------------------------------------
+
+_CRC32C_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli), table-driven — TFRecord framing checksums."""
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            table.append(c)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    rotated = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    return (rotated + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, i: int):
+    shift = v = 0
+    while True:
+        b = buf[i]
+        i += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, i
+        shift += 7
+
+
+def _encode_feature(values) -> bytes:
+    """tf.train.Feature: 1=BytesList 2=FloatList 3=Int64List."""
+    import struct
+    body = bytearray()
+    if all(isinstance(v, (bytes, str)) for v in values):
+        inner = bytearray()
+        for v in values:
+            b = v.encode() if isinstance(v, str) else v
+            inner.append(0x0A)  # field 1, wire 2
+            _write_varint(inner, len(b))
+            inner += b
+        tag = 0x0A  # Feature field 1 (BytesList), wire 2
+    elif all(isinstance(v, (int, np.integer)) for v in values):
+        inner = bytearray([0x0A])  # Int64List field 1 packed, wire 2
+        packed = bytearray()
+        for v in values:
+            _write_varint(packed, int(v) & 0xFFFFFFFFFFFFFFFF)
+        _write_varint(inner, len(packed))
+        inner += packed
+        tag = 0x1A  # Feature field 3, wire 2
+    else:
+        inner = bytearray([0x0A])  # FloatList field 1 packed, wire 2
+        packed = b"".join(struct.pack("<f", float(v)) for v in values)
+        _write_varint(inner, len(packed))
+        inner += packed
+        tag = 0x12  # Feature field 2, wire 2
+    body.append(tag)
+    _write_varint(body, len(inner))
+    body += inner
+    return bytes(body)
+
+
+def _decode_feature(buf: bytes):
+    """-> list of bytes/float/int from one Feature message."""
+    import struct
+    i = 0
+    out: List[Any] = []
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire != 2:
+            raise ValueError(f"unexpected wire type {wire} in Feature")
+        ln, i = _read_varint(buf, i)
+        inner = buf[i:i + ln]
+        i += ln
+        j = 0
+        while j < len(inner):
+            itag, j = _read_varint(inner, j)
+            ifield, iwire = itag >> 3, itag & 7
+            if field == 1:  # BytesList
+                bln, j = _read_varint(inner, j)
+                out.append(bytes(inner[j:j + bln]))
+                j += bln
+            elif field == 2:  # FloatList
+                if iwire == 2:  # packed
+                    bln, j = _read_varint(inner, j)
+                    out.extend(struct.unpack(
+                        f"<{bln // 4}f", inner[j:j + bln]))
+                    j += bln
+                else:  # fixed32
+                    out.append(struct.unpack("<f", inner[j:j + 4])[0])
+                    j += 4
+            elif field == 3:  # Int64List
+                if iwire == 2:  # packed varints
+                    bln, j = _read_varint(inner, j)
+                    end = j + bln
+                    while j < end:
+                        v, j = _read_varint(inner, j)
+                        out.append(v - (1 << 64) if v >= 1 << 63 else v)
+                else:
+                    v, j = _read_varint(inner, j)
+                    out.append(v - (1 << 64) if v >= 1 << 63 else v)
+            else:
+                raise ValueError(f"unknown Feature field {field}")
+    return out
+
+
+def encode_example(features: Dict[str, Any]) -> bytes:
+    """dict -> serialized tf.train.Example."""
+    feats = bytearray()
+    for key, values in features.items():
+        if isinstance(values, (bytes, str, int, float, np.generic)):
+            values = [values]
+        elif isinstance(values, np.ndarray):
+            values = values.tolist()
+        kb = key.encode()
+        entry = bytearray([0x0A])  # map key, field 1
+        _write_varint(entry, len(kb))
+        entry += kb
+        fv = _encode_feature(list(values))
+        entry.append(0x12)  # map value (Feature), field 2
+        _write_varint(entry, len(fv))
+        entry += fv
+        feats.append(0x0A)  # Features.feature map entry, field 1
+        _write_varint(feats, len(entry))
+        feats += entry
+    ex = bytearray([0x0A])  # Example.features, field 1
+    _write_varint(ex, len(feats))
+    ex += feats
+    return bytes(ex)
+
+
+def decode_example(buf: bytes) -> Dict[str, List[Any]]:
+    """serialized tf.train.Example -> {key: [values]}."""
+    i = 0
+    out: Dict[str, List[Any]] = {}
+    tag, i = _read_varint(buf, i)
+    if tag >> 3 != 1:
+        raise ValueError("not an Example message")
+    ln, i = _read_varint(buf, i)
+    feats = buf[i:i + ln]
+    i = 0
+    while i < len(feats):
+        tag, i = _read_varint(feats, i)
+        if tag >> 3 != 1 or tag & 7 != 2:
+            raise ValueError("bad Features map entry")
+        ln, i = _read_varint(feats, i)
+        entry = feats[i:i + ln]
+        i += ln
+        j = 0
+        key = None
+        values: List[Any] = []
+        while j < len(entry):
+            etag, j = _read_varint(entry, j)
+            eln, j = _read_varint(entry, j)
+            payload = entry[j:j + eln]
+            j += eln
+            if etag >> 3 == 1:
+                key = payload.decode()
+            else:
+                values = _decode_feature(payload)
+        if key is not None:
+            out[key] = values
+    return out
+
+
+def read_tfrecord_file(path: str) -> List[bytes]:
+    """Parse TFRecord framing: (len u64le, crc, data, crc) records."""
+    import struct
+    records = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                break
+            (length,) = struct.unpack("<Q", header[:8])
+            (lcrc,) = struct.unpack("<I", header[8:])
+            if lcrc != _masked_crc(header[:8]):
+                raise ValueError(f"corrupt TFRecord length crc in {path}")
+            data = f.read(length)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if dcrc != _masked_crc(data):
+                raise ValueError(f"corrupt TFRecord data crc in {path}")
+            records.append(data)
+    return records
+
+
+class _TFRecordRead:
+    def __init__(self, path: str):
+        self.path = path
+
+    def __call__(self) -> pa.Table:
+        rows = [decode_example(r) for r in read_tfrecord_file(self.path)]
+        if not rows:
+            return pa.table({})
+        # union of feature keys across ALL records (first-record-only
+        # would silently drop late-appearing features); a record
+        # missing a key yields null in that column
+        keys = {}
+        for r in rows:
+            for k in r:
+                keys[k] = True
+        cols = {}
+        for k in keys:
+            vals = [r.get(k) for r in rows]
+            if all(v is None or len(v) == 1 for v in vals):
+                cols[k] = pa.array(
+                    [v[0] if v else None for v in vals])
+            else:
+                cols[k] = pa.array(
+                    [list(v) if v is not None else None for v in vals])
+        return pa.table(cols)
+
+
+class TFRecordDatasource(Datasource):
+    """TFRecord files of tf.train.Example protos — the classic TPU
+    ingest format, parsed in-tree (no tensorflow import)."""
+
+    def __init__(self, paths):
+        self.paths = expand_paths(paths)
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        return [_TFRecordRead(p) for p in self.paths]
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return sum(os.path.getsize(p) for p in self.paths)
+
+
+class TFRecordDatasink(Datasink):
+    """One .tfrecords file per block under ``path``."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, block: pa.Table) -> str:
+        import struct
+        import uuid
+        os.makedirs(self.path, exist_ok=True)
+        full = os.path.join(self.path, f"{uuid.uuid4().hex[:12]}.tfrecords")
+        acc = BlockAccessor(block)
+        with open(full, "wb") as f:
+            for row in acc.iter_rows():
+                data = encode_example(row)
+                header = struct.pack("<Q", len(data))
+                f.write(header)
+                f.write(struct.pack("<I", _masked_crc(header)))
+                f.write(data)
+                f.write(struct.pack("<I", _masked_crc(data)))
+        return full
+
+
+# ---------------------------------------------------------------------------
+# WebDataset (reference: read_api.py:2418 read_webdataset /
+# datasource/webdataset_datasource.py — tar shards, samples grouped by
+# basename, one column per extension)
+# ---------------------------------------------------------------------------
+
+
+class _WebDatasetRead:
+    def __init__(self, path: str, decode: bool = True):
+        self.path = path
+        self.decode = decode
+
+    def _decode_entry(self, ext: str, data: bytes):
+        if not self.decode:
+            return data
+        if ext in ("txt", "text"):
+            return data.decode("utf-8", "replace")
+        if ext == "cls":
+            return int(data.decode().strip())
+        if ext == "json":
+            import json
+            return json.loads(data)
+        if ext in ("jpg", "jpeg", "png", "bmp"):
+            import io
+            from PIL import Image
+            return np.asarray(Image.open(io.BytesIO(data)))
+        if ext == "npy":
+            import io
+            return np.load(io.BytesIO(data))
+        return data
+
+    def __call__(self) -> pa.Table:
+        import tarfile
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(self.path) as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                dirpart, base = os.path.split(member.name)
+                if "." not in base:
+                    continue
+                # webdataset sample key = full path up to the FIRST dot
+                # of the basename — same-named files in different tar
+                # subdirectories are distinct samples
+                stem, ext = base.split(".", 1)
+                key = f"{dirpart}/{stem}" if dirpart else stem
+                data = tar.extractfile(member).read()
+                if key not in samples:
+                    samples[key] = {}
+                    order.append(key)
+                samples[key][ext] = self._decode_entry(ext.lower(), data)
+        rows = []
+        for key in order:
+            row = {"__key__": key}
+            row.update(samples[key])
+            rows.append(row)
+        if not rows:
+            return pa.table({"__key__": pa.array([], pa.string())})
+        return BlockAccessor.from_rows(rows)
+
+
+class WebDatasetDatasource(Datasource):
+    """WebDataset tar shards: one read task per shard, one row per
+    sample key, one column per extension (txt/cls/json/images/npy
+    decoded; everything else raw bytes)."""
+
+    def __init__(self, paths, *, decode: bool = True):
+        self.paths = expand_paths(paths)
+        self.decode = decode
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        return [_WebDatasetRead(p, self.decode) for p in self.paths]
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return sum(os.path.getsize(p) for p in self.paths)
+
+
+# ---------------------------------------------------------------------------
+# SQL (reference: read_api.py:2645 read_sql / datasource/sql_datasource.py
+# — DB-API 2.0 connection factory)
+# ---------------------------------------------------------------------------
+
+
+class _SQLRead:
+    def __init__(self, sql: str, connection_factory: Callable,
+                 params=None):
+        self.sql = sql
+        self.connection_factory = connection_factory
+        self.params = params
+
+    def __call__(self) -> pa.Table:
+        conn = self.connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(self.sql, self.params or ())
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        cols = {n: pa.array([r[i] for r in rows])
+                for i, n in enumerate(names)}
+        if not cols:
+            return pa.table({})
+        return pa.table(cols)
+
+
+class SQLDatasource(Datasource):
+    """One query = one read task; shard with ``shard_keys`` WHERE
+    clauses for parallel reads (the DB-API cursor is created inside the
+    task, so the factory must be picklable — e.g. a top-level function,
+    not a bound connection)."""
+
+    def __init__(self, sql: str, connection_factory: Callable, *,
+                 shards: Optional[List[Any]] = None):
+        self.sql = sql
+        self.connection_factory = connection_factory
+        self.shards = shards
+
+    def get_read_tasks(self, parallelism: int) -> List[Callable]:
+        if not self.shards:
+            return [_SQLRead(self.sql, self.connection_factory)]
+        return [_SQLRead(self.sql, self.connection_factory, params)
+                for params in self.shards]
+
+
+class SQLDatasink(Datasink):
+    """Per-block executemany of an INSERT statement."""
+
+    def __init__(self, sql: str, connection_factory: Callable):
+        self.sql = sql
+        self.connection_factory = connection_factory
+
+    def write(self, block: pa.Table) -> int:
+        rows = [tuple(row.values())
+                for row in BlockAccessor(block).iter_rows()]
+        conn = self.connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.executemany(self.sql, rows)
+            conn.commit()
+        finally:
+            conn.close()
+        return len(rows)

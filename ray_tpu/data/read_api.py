@@ -142,3 +142,44 @@ def read_numpy(paths) -> Dataset:
     from ray_tpu.data.datasource import _NumpyRead, expand_paths
     return _ds(L.Read([_NumpyRead(p) for p in expand_paths(paths)],
                       name="ReadNumpy"))
+
+
+def read_datasource(datasource, *, parallelism: int = -1) -> Dataset:
+    """Read from a user-defined Datasource (reference: read_api.py:360
+    read_datasource over the public Datasource ABC)."""
+    from ray_tpu.data.datasource import Datasource
+    if not isinstance(datasource, Datasource):
+        raise ValueError("read_datasource takes a ray_tpu.data.Datasource")
+    ctx = DataContext.get_current()
+    par = parallelism if parallelism > 0 else ctx.min_parallelism
+    tasks = list(datasource.get_read_tasks(par))
+    if not tasks:
+        raise ValueError(
+            f"{datasource.name}.get_read_tasks returned no read tasks")
+    return _ds(L.Read(tasks, name=datasource.name))
+
+
+def read_tfrecords(paths) -> Dataset:
+    """TFRecord files of tf.train.Example protos, one column per
+    feature key (reference: read_api.py:2078 read_tfrecords; protobuf
+    codec is in-tree — no tensorflow import)."""
+    from ray_tpu.data.datasource import TFRecordDatasource
+    return read_datasource(TFRecordDatasource(paths))
+
+
+def read_webdataset(paths, *, decode: bool = True) -> Dataset:
+    """WebDataset tar shards: one row per sample key, one column per
+    file extension plus "__key__" (reference: read_api.py:2418
+    read_webdataset)."""
+    from ray_tpu.data.datasource import WebDatasetDatasource
+    return read_datasource(WebDatasetDatasource(paths, decode=decode))
+
+
+def read_sql(sql: str, connection_factory, *,
+             shards=None) -> Dataset:
+    """DB-API query -> Dataset (reference: read_api.py:2645 read_sql).
+    ``shards`` is an optional list of parameter tuples; each runs the
+    query as its own read task for parallel partitioned reads."""
+    from ray_tpu.data.datasource import SQLDatasource
+    return read_datasource(SQLDatasource(sql, connection_factory,
+                                         shards=shards))
