@@ -59,9 +59,17 @@ def start(http_options: Optional[HTTPOptions] = None,
 
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking_ready: bool = True,
-        timeout_s: float = 60.0) -> DeploymentHandle:
+        timeout_s: float = 60.0, local_testing_mode: bool = False):
     """Deploy an application; returns the ingress handle
-    (reference: python/ray/serve/api.py serve.run:694)."""
+    (reference: python/ray/serve/api.py serve.run:694).
+
+    ``local_testing_mode=True`` instantiates the whole deployment
+    graph in-process — no controller, no cluster, no ray_tpu.init —
+    and returns a handle with DeploymentHandle semantics (reference:
+    serve/_private/local_testing_mode.py:49)."""
+    if local_testing_mode:
+        from ray_tpu.serve.local_mode import run_local
+        return run_local(app)
     controller = _get_or_start_controller()
     specs = flatten_application(app, name, route_prefix)
     ray_tpu.get(controller.deploy_application.remote(name, specs))

@@ -1,0 +1,106 @@
+"""Serve local testing mode: in-process deployments without a cluster
+(reference: serve/_private/local_testing_mode.py:49 — deployment unit
+tests must run with NO ray_tpu.init)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment
+class Doubler:
+    def __call__(self, v):
+        return 2 * v
+
+    def label(self, v):
+        return f"doubled:{v}"
+
+
+@serve.deployment
+class Ingress:
+    def __init__(self, inner, scale=1):
+        self.inner = inner
+        self.scale = scale
+
+    def __call__(self, v):
+        return self.scale * self.inner.remote(v).result()
+
+    def stream_squares(self, n):
+        for i in range(n):
+            yield i * i
+
+
+@serve.deployment
+def plain_fn(v):
+    return v + 100
+
+
+def test_local_mode_runs_without_cluster():
+    handle = serve.run(Doubler.bind(), local_testing_mode=True)
+    assert handle.remote(21).result() == 42
+    # no controller, no runtime were started
+    assert not ray_tpu.is_initialized()
+
+
+def test_local_mode_composition_and_methods():
+    app = Ingress.bind(Doubler.bind(), scale=10)
+    handle = serve.run(app, local_testing_mode=True)
+    assert handle.remote(3).result() == 60
+    # method-attribute handles route to the named method
+    inner = serve.run(Doubler.bind(), local_testing_mode=True)
+    assert inner.label.remote(5).result() == "doubled:5"
+
+
+def test_local_mode_streaming_and_functions():
+    handle = serve.run(Ingress.bind(Doubler.bind()),
+                       local_testing_mode=True)
+    out = list(handle.options(stream=True,
+                              method_name="stream_squares").remote(4))
+    assert out == [0, 1, 4, 9]
+    fn_handle = serve.run(plain_fn.bind(), local_testing_mode=True)
+    assert fn_handle.remote(1).result() == 101
+    with pytest.raises(AttributeError, match="function deployment"):
+        fn_handle.other.remote(1).result()
+
+
+def test_local_mode_errors_and_timeout():
+    @serve.deployment
+    class Boom:
+        def __call__(self):
+            raise RuntimeError("kapow")
+
+        def slow(self):
+            time.sleep(1.0)
+            return "late"
+
+    handle = serve.run(Boom.bind(), local_testing_mode=True)
+    with pytest.raises(RuntimeError, match="kapow"):
+        handle.remote().result()
+    with pytest.raises(TimeoutError):
+        handle.slow.remote().result(timeout_s=0.05)
+    # shared graph nodes instantiate exactly once
+    builds = []
+
+    @serve.deployment
+    class Counted:
+        def __init__(self):
+            builds.append(1)
+
+        def __call__(self):
+            return len(builds)
+
+    @serve.deployment
+    class Two:
+        def __init__(self, a, b):
+            self.a, self.b = a, b
+
+        def __call__(self):
+            return (self.a.remote().result(), self.b.remote().result())
+
+    shared = Counted.bind()
+    handle = serve.run(Two.bind(shared, shared), local_testing_mode=True)
+    assert handle.remote().result() == (1, 1)
+    assert len(builds) == 1
