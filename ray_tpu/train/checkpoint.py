@@ -106,7 +106,13 @@ def save_sharded_state(directory: str, rank: int, world_size: int,
         os.replace(tmp, meta_path)
 
     def write():
-        final = os.path.join(step_dir, f"shard_{rank:05d}.pkl")
+        # world size in the FILENAME: a zombie rank from a killed gang
+        # (kill delivery lags under load) writing its old-world shard
+        # into the same step dir must never satisfy the new gang's
+        # completeness check — caught live by
+        # tests/test_train_failures.py resize-up under full-suite load
+        final = os.path.join(step_dir,
+                             f"shard_{rank:05d}_of_{world_size:05d}.pkl")
         tmp = final + f".tmp{os.getpid()}"
         try:
             with open(tmp, "wb") as f:
@@ -147,8 +153,14 @@ def _complete_shard_set(step_dir: str) -> Optional[list]:
         return None
     with open(meta_path) as f:
         world_size = json.load(f)["world_size"]
-    paths = [os.path.join(step_dir, f"shard_{r:05d}.pkl")
+    paths = [os.path.join(step_dir,
+                          f"shard_{r:05d}_of_{world_size:05d}.pkl")
              for r in range(world_size)]
+    if not all(os.path.exists(p) for p in paths):
+        # pre-world-qualified layout (shard_NNNNN.pkl): loadable, else
+        # an upgrade would silently resume every older run from step 0
+        paths = [os.path.join(step_dir, f"shard_{r:05d}.pkl")
+                 for r in range(world_size)]
     if not all(os.path.exists(p) for p in paths):
         return None
     out = []

@@ -1,0 +1,180 @@
+"""Train failure matrix: worker death mid-step, resize-UP mid-run,
+report/checkpoint races (reference: train/v2/tests breadth — the
+failure policies exist in trainer.py; these pin their semantics)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+    ScalingPolicy,
+    load_sharded_state,
+)
+
+
+def test_worker_death_mid_step_resumes_from_checkpoint(
+        ray_start_regular, tmp_path):
+    """A rank dies MID-STEP (after training work, before that step's
+    report): the controller rebuilds the gang and the loop resumes
+    from the last PERSISTED checkpoint, not from scratch."""
+    storage = str(tmp_path / "run")
+    marker = str(tmp_path / "crashed-once")
+
+    def train_loop(config):
+        import tempfile
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        resume = train.get_checkpoint()
+        start = 0
+        if resume is not None:
+            with open(os.path.join(resume.path, "step.txt")) as f:
+                start = int(f.read())
+        for step in range(start, 6):
+            # "training work" for this step happens here...
+            if (step == 3 and ctx.get_world_rank() == 0
+                    and not os.path.exists(config["marker"])):
+                open(config["marker"], "w").write("x")
+                os._exit(1)  # ...and the rank dies before reporting it
+                # (rank 0 specifically: it is the checkpoint persister,
+                # so the latest persisted checkpoint is step 3's)
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "step.txt"), "w") as f:
+                    f.write(str(step + 1))
+                train.report({"step": step, "resumed_from": start},
+                             checkpoint=train.Checkpoint(d))
+
+    trainer = JaxTrainer(
+        train_loop, train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="midstep", storage_path=storage,
+                             failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert "RESTARTING" in trainer.state_history
+    assert result.metrics["step"] == 5
+    # the resumed attempt started from the persisted step-3 checkpoint
+    # (steps 0-2 reported before the crash), not from zero
+    assert result.metrics["resumed_from"] == 3
+    assert result.checkpoint is not None
+    with open(os.path.join(result.checkpoint.path, "step.txt")) as f:
+        assert int(f.read()) == 6
+
+
+class _GrowAfterFailure(ScalingPolicy):
+    """Resize-UP policy: capacity returned after the failure, so the
+    rebuilt gang is LARGER (the inverse of the elastic shrink path)."""
+
+    def __init__(self, cap):
+        self.cap = cap
+
+    def world_size_after_failure(self, current_world, runtime):
+        return min(current_world + 1, self.cap)
+
+
+def test_resize_up_mid_run_with_resharded_resume(
+        ray_start_regular, tmp_path):
+    """Gang of 2 crashes once; the scaling policy grows the rebuilt
+    gang to 3 and the per-rank sharded checkpoint reshards 2 -> 3."""
+    storage = str(tmp_path / "runup")
+    marker = str(tmp_path / "crashed-once-up")
+
+    def train_loop(config):
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        world = ctx.get_world_size()
+        rank = ctx.get_world_rank()
+        ckpt_dir = os.path.join(ctx.storage_path, "sharded")
+        full_dim = 12
+        states = train.load_sharded_state(ckpt_dir, timeout=1.0)
+        if states is not None:
+            start = states[0]["step"]
+            arrays = [{"w": s["w"]} for s in states]
+            mine = train.reshard_states(arrays, world)[rank]["w"]
+        else:
+            start = 0
+            mine = np.array_split(np.zeros(full_dim), world)[rank]
+        for step in range(start, 8):
+            mine = mine + 1.0
+            if (step == 4 and rank == 0 and world == 2
+                    and not os.path.exists(config["marker"])):
+                open(config["marker"], "w").write("x")
+                os._exit(1)
+            t = train.save_sharded_state(
+                ckpt_dir, rank, world, {"w": mine, "step": step + 1},
+                step=step + 1)
+            if t is not None:
+                t.join()
+            train.report({"step": step, "world": world})
+
+    trainer = JaxTrainer(
+        train_loop, train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(
+            num_workers=2, scaling_policy=_GrowAfterFailure(cap=3)),
+        run_config=RunConfig(name="resizeup", storage_path=storage,
+                             failure_config=FailureConfig(max_failures=2)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert "RESIZING" in trainer.state_history
+    finals = [reports[-1][0] for reports in result.all_reports]
+    assert len(finals) == 3  # the rebuilt gang really ran at world 3
+    assert all(m["world"] == 3 for m in finals)
+    states = load_sharded_state(os.path.join(result.path, "sharded"))
+    assert len(states) == 3
+    merged = np.concatenate([s["w"] for s in states])
+    assert merged.shape == (12,)
+    # every element accumulated all 8 "training" increments (the
+    # crashed step's work was redone from the step-4 checkpoint)
+    np.testing.assert_array_equal(merged, np.full(12, 8.0))
+
+
+def test_report_checkpoint_race_is_safe(ray_start_regular, tmp_path):
+    """Concurrent report(checkpoint=...) calls from one worker (the
+    report/checkpoint race): no report is lost, every checkpoint dir
+    persists, and the manager resumes from the newest one."""
+    storage = str(tmp_path / "race")
+
+    def train_loop(config):
+        import tempfile
+
+        import ray_tpu.train as train
+
+        def one(i):
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "tag.txt"), "w") as f:
+                    f.write(str(i))
+                train.report({"i": i}, checkpoint=train.Checkpoint(d))
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    trainer = JaxTrainer(
+        train_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="race", storage_path=storage))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # all 8 concurrent reports landed, each with its own persisted dir
+    assert sorted(m["i"] for m in result.metrics_history) == list(range(8))
+    dirs = {ckpt for _m, ckpt in result.all_reports[0] if ckpt}
+    assert len(dirs) == 8
+    for d in dirs:
+        assert os.path.exists(os.path.join(d, "tag.txt"))
+    # the registered checkpoint is one of the persisted dirs
+    assert result.checkpoint is not None
+    assert result.checkpoint.path in dirs
