@@ -16,6 +16,7 @@ from ray_tpu.tune.external import OptunaSearch
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Searcher,
+    BayesOptSearcher,
     TPESearcher,
     choice,
     grid_search,
@@ -35,6 +36,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 __all__ = [
     "ASHAScheduler", "BasicVariantGenerator", "FIFOScheduler",
     "OptunaSearch", "PopulationBasedTraining", "ResultGrid", "Searcher",
+    "BayesOptSearcher",
     "TPESearcher",
     "Trainable", "TrialScheduler", "TuneConfig", "Tuner", "choice",
     "get_checkpoint", "grid_search", "loguniform", "randint", "report",

@@ -306,3 +306,95 @@ class TPESearcher(Searcher):
         if cfg is None or result is None or self.metric not in result:
             return
         self._observed.append((cfg, float(result[self.metric])))
+
+
+class BayesOptSearcher(Searcher):
+    """Native Gaussian-process Bayesian optimization — the in-tree
+    equivalent of the reference's bayesopt searcher
+    (reference: python/ray/tune/search/bayesopt/bayesopt_search.py:41,
+    which wraps the external `bayesian-optimization` package; here the
+    GP is ~60 lines of numpy, so the common case needs no external
+    dependency — the OptunaSearch adapter seam remains for the rest).
+
+    All flat domains map to the unit cube (log/int/categorical via
+    Domain.to_unit); an RBF-kernel GP posterior over observed trials
+    scores random candidates by expected improvement. Nested dicts and
+    grid entries fall back to random sampling, like TPESearcher.
+    """
+
+    def __init__(self, num_samples: int = 32, n_startup: int = 6,
+                 n_candidates: int = 256, length_scale: float = 0.2,
+                 noise: float = 1e-4, xi: float = 0.01,
+                 seed: Optional[int] = None):
+        self.num_samples = num_samples
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self.length_scale = length_scale
+        self.noise = noise
+        self.xi = xi
+        self.rng = random.Random(seed)
+        self._suggested = 0
+        self._pending: Dict[str, Dict[str, Any]] = {}
+        self._observed: List[Tuple[Dict[str, Any], float]] = []
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        domains = flat_domains(self.param_space)
+        cfg = resolve_config(self.param_space, self.rng,
+                             random_grid_assignment(self.param_space,
+                                                    self.rng))
+        if len(self._observed) >= self.n_startup and domains:
+            u = self._acquire(domains)
+            for i, (key, dom) in enumerate(sorted(domains.items())):
+                cfg[key] = dom.from_unit(u[i])
+        self._pending[trial_id] = cfg
+        return cfg
+
+    def _acquire(self, domains: Dict[str, Domain]):
+        import numpy as np
+
+        keys = sorted(domains)
+        sign = 1.0 if self.mode == "max" else -1.0
+        xs, ys = [], []
+        for cfg, score in self._observed:
+            if not all(k in cfg for k in keys):
+                continue
+            xs.append([domains[k].to_unit(cfg[k]) for k in keys])
+            ys.append(sign * score)
+        X = np.asarray(xs, dtype=np.float64)        # [n, d]
+        y = np.asarray(ys, dtype=np.float64)
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        y = (y - y_mean) / y_std
+
+        def rbf(A, B):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+        K = rbf(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, y))
+        best = y.max()
+
+        cand = np.asarray(
+            [[self.rng.random() for _ in keys]
+             for _ in range(self.n_candidates)])           # [m, d]
+        Kc = rbf(cand, X)                                  # [m, n]
+        mu = Kc @ alpha
+        v = np.linalg.solve(L, Kc.T)                       # [n, m]
+        var = np.maximum(1.0 - (v ** 2).sum(0), 1e-12)
+        sigma = np.sqrt(var)
+        z = (mu - best - self.xi) / sigma
+        # standard-normal pdf/cdf without scipy
+        pdf = np.exp(-0.5 * z ** 2) / math.sqrt(2 * math.pi)
+        cdf = 0.5 * (1.0 + np.vectorize(math.erf)(z / math.sqrt(2)))
+        ei = (mu - best - self.xi) * cdf + sigma * pdf
+        return cand[int(np.argmax(ei))]
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]]) -> None:
+        cfg = self._pending.pop(trial_id, None)
+        if cfg is None or result is None or self.metric not in result:
+            return
+        self._observed.append((cfg, float(result[self.metric])))
