@@ -137,3 +137,61 @@ def test_envelope_64_nodes_1k_actors_pgs(envelope_head):
 
     assert ray_tpu.get([ping.remote(i) for i in range(100)],
                        timeout=60) == list(range(100))
+
+def test_envelope_8_real_daemon_processes(tmp_path):
+    """Anchor for the stub-based 64-node envelope: 8 REAL node-daemon
+    subprocesses join over TCP, tasks spread across all of them, and
+    the head survives the whole gang disconnecting at once. This is
+    the multi-process variant the stub test extrapolates from."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    rt = ray_tpu.init(num_cpus=1, head_port=0)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd()
+    procs = []
+    try:
+        for i in range(8):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+                 "--address", rt.head_address,
+                 "--resources", json.dumps({"CPU": 2, "envd": 1.0})],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL))
+        deadline = time.time() + 120
+        while len(rt.nodes) < 9 and time.time() < deadline:
+            time.sleep(0.2)
+        assert len(rt.nodes) == 9, f"only {len(rt.nodes)} nodes joined"
+
+        @ray_tpu.remote(resources={"envd": 0.05}, num_cpus=0)
+        def where():
+            import ray_tpu as rtpu
+            return rtpu.get_runtime_context().get_node_id()
+
+        hosts = set(ray_tpu.get(
+            [where.remote() for _ in range(64)], timeout=180))
+        assert len(hosts) >= 4, f"tasks landed on only {len(hosts)} nodes"
+
+        # whole-gang disconnect: the head notices and keeps serving
+        for p in procs:
+            p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=30)
+        deadline = time.time() + 90
+        while len(rt.nodes) > 1 and time.time() < deadline:
+            time.sleep(0.2)
+        assert len(rt.nodes) == 1
+
+        @ray_tpu.remote(num_cpus=1)
+        def local():
+            return "still-serving"
+
+        assert ray_tpu.get(local.remote(), timeout=60) == "still-serving"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        ray_tpu.shutdown()
