@@ -24,9 +24,11 @@ from ray_tpu.data.datasource import Datasink, Datasource
 from ray_tpu.data.read_api import (
     from_arrow,
     from_blocks,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,
     range_tensor,
     read_binary_files,
@@ -47,7 +49,7 @@ __all__ = [
     "DataContext", "DataIterator", "Datasink", "Dataset", "Datasource",
     "GroupedData", "Max", "MaterializedDataset", "Mean", "Min",
     "Quantile", "Std", "Sum", "col", "from_arrow", "from_blocks",
-    "from_items", "from_numpy", "from_pandas", "lit", "preprocessors",
+    "from_huggingface", "from_items", "from_numpy", "from_pandas", "from_torch", "lit", "preprocessors",
     "range", "range_tensor", "read_binary_files", "read_csv",
     "read_datasource", "read_images", "read_json", "read_numpy",
     "read_parquet", "read_sql", "read_text", "read_tfrecords",

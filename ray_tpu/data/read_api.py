@@ -183,3 +183,39 @@ def read_sql(sql: str, connection_factory, *,
     from ray_tpu.data.datasource import SQLDatasource
     return read_datasource(SQLDatasource(sql, connection_factory,
                                          shards=shards))
+
+
+def from_torch(dataset) -> Dataset:
+    """Materialize a torch map- or iterable-style Dataset as rows with
+    an "item" column (reference: read_api.py from_torch — same single
+    "item" column convention)."""
+    import builtins  # this module shadows range() with the Dataset ctor
+    try:
+        n = len(dataset)
+    except TypeError:
+        # iterable-style dataset (no __len__)
+        items = list(dataset)
+    else:
+        # map-style: a TypeError from __getitem__ here is a USER bug
+        # and must surface from its real call site, not trigger the
+        # iterable fallback
+        items = [dataset[i] for i in builtins.range(n)]
+    return from_items([{"item": it} for it in items])
+
+
+def from_huggingface(dataset) -> Dataset:
+    """A Hugging Face datasets.Dataset -> Dataset (reference:
+    read_api.py from_huggingface). Zero-copy when the HF dataset
+    exposes its arrow table; falls back to row iteration (covers
+    IterableDataset)."""
+    data = getattr(dataset, "data", None)
+    table = getattr(data, "table", None)
+    if isinstance(table, pa.Table):
+        return from_arrow(table.combine_chunks())
+    if isinstance(data, pa.Table):
+        return from_arrow(data)
+    rows = [dict(r) for r in dataset]
+    if not rows:
+        raise ValueError("cannot construct a Dataset from an empty "
+                         "huggingface dataset")
+    return from_items(rows)

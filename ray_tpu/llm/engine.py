@@ -125,6 +125,23 @@ class GenerationRequest:
     # it, so a bias that changes the argmax lowers draft acceptance
     # but never affects outputs.
     logit_bias: Optional[Dict[int, float]] = None
+    # OpenAI presence/frequency penalties: subtracted from the logits
+    # of already-generated tokens each step (presence once per distinct
+    # token, frequency per occurrence). Implemented on the SAME
+    # device-bias-row machinery as guided decoding: the row is
+    # recomputed host-side after each emission (bias_stale) — one [V]
+    # upload per penalized slot per step.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    # OpenAI logprobs: None = off; an int >= 0 = number of top
+    # alternatives to record per emitted token (0 still records the
+    # CHOSEN token's logprob with an empty top list, matching OpenAI's
+    # logprobs=0 / top_logprobs=0 semantics; clamped to the engine's
+    # static top-k width). Logprobs are log-softmax of the BIASED
+    # logits — exactly the distribution the sampler saw. Requests with
+    # logprobs take the dense decode path (the fused multi-token paths
+    # do not return per-step logprob tensors).
+    logprobs: Optional[int] = None
     # Guided decoding (reference: vLLM guided decoding behind
     # response_format/tools): a ray_tpu.llm.guided.TokenConstraint.
     # Its per-state token mask folds into the slot's device bias row
@@ -144,6 +161,9 @@ class GenerationRequest:
     stream_queue: Optional[Any] = None
     # filled by the engine
     output_ids: List[int] = field(default_factory=list)
+    # per emitted token (when logprobs > 0):
+    # {"id", "logprob", "top": [(id, logprob), ...]}
+    logprob_data: List[Dict[str, Any]] = field(default_factory=list)
     finish_reason: Optional[str] = None
     error: Optional[str] = None
 
@@ -176,6 +196,9 @@ class _Slot:
         # guided decoding: the slot's device bias row no longer matches
         # the request's automaton state (refreshed at the next step)
         self.bias_stale = False
+        # logprob rows (chosen_lp, top_vals, top_ids) for the token
+        # about to be emitted; consumed (and cleared) by _emit
+        self.pending_lp = None
 
 
 class ContinuousBatchingEngine:
@@ -289,6 +312,8 @@ class ContinuousBatchingEngine:
             self.lora_bank = None
 
         max_k = min(config.max_top_k, c.vocab_size)
+        lp_k = min(20, c.vocab_size)  # static top-logprobs width
+        self._lp_k = lp_k
 
         def sample_tokens(logits, temp, topk, key, bias=None):
             """On-device sampling: greedy / temperature / top-k per
@@ -318,16 +343,28 @@ class ContinuousBatchingEngine:
                 params, tokens, cache_k, cache_v, pos, c,
                 lora_bank=lora_bank, lora_idx=lora_idx)
             key = jax.random.fold_in(base_key, step)
-            return sample_tokens(logits, temp, topk, key, bias), ck, cv
+            tok = sample_tokens(logits, temp, topk, key, bias)
+            # logprobs of the biased distribution the sampler saw;
+            # [B] chosen + [B, lp_k] top alternatives — tiny transfers
+            lsm = jax.nn.log_softmax(
+                (logits + bias).astype(jnp.float32), axis=-1)
+            chosen = jnp.take_along_axis(lsm, tok[:, None], 1)[:, 0]
+            top_vals, top_ids = jax.lax.top_k(lsm, lp_k)
+            return tok, chosen, top_vals, top_ids, ck, cv
 
         def prefill(params, tokens, lora):
             return llama_prefill(params, tokens, c, lora=lora)
 
         def sample_one(logits, temp, topk, key, bias_row):
-            return sample_tokens(
+            tok = sample_tokens(
                 logits[None, :], jnp.full((1,), temp),
                 jnp.full((1,), topk, dtype=jnp.int32), key,
                 bias_row[None, :])[0]
+            lsm = jax.nn.log_softmax(
+                (logits + bias_row).astype(jnp.float32))
+            chosen = lsm[tok]
+            top_vals, top_ids = jax.lax.top_k(lsm, lp_k)
+            return tok, chosen, top_vals, top_ids
 
         def insert(cache_k, cache_v, ks, vs, slot):
             # in-place (donated) slot write — no whole-cache copy.
@@ -582,8 +619,8 @@ class ContinuousBatchingEngine:
                                      guided=guided)
             self._validate_guided(fake)
             bias_row = self._bias_row(fake)
-        ks, vs, token = self._run_prefill(ids, adapter, temperature,
-                                          top_k, bias_row=bias_row)
+        ks, vs, token, _lp = self._run_prefill(
+            ids, adapter, temperature, top_k, bias_row=bias_row)
         return (np.asarray(ks), np.asarray(vs), len(ids), token)
 
     def add_prefilled(self, request: GenerationRequest, ks, vs,
@@ -591,6 +628,11 @@ class ContinuousBatchingEngine:
         """DECODE side of disaggregation: adopt a request whose prefill
         ran elsewhere — the KV block is inserted into a free slot at the
         next admit, skipping local prefill entirely."""
+        if request.logprobs is not None:
+            raise ValueError(
+                "logprobs are not supported on the disaggregated "
+                "decode path (the first token's distribution lives on "
+                "the prefill engine)")
         if prompt_len > self._pos_limit:
             # pos_limit, not max_seq-1: a speculative engine reserves
             # its scratch rows, and admitting past the limit would
@@ -625,6 +667,9 @@ class ContinuousBatchingEngine:
             # the sampler's static width bounds per-request top-k; make
             # the effective value visible rather than silently narrower
             request.top_k = self.config.max_top_k
+        if request.logprobs is not None:
+            request.logprobs = min(max(int(request.logprobs), 0),
+                                   self._lp_k)
         with self._lock:
             self.waiting.append(request)
         return request
@@ -675,7 +720,7 @@ class ContinuousBatchingEngine:
 
     def _run_prefill(self, ids: List[int], adapter: Optional[str],
                      temperature: float, top_k: int,
-                     bias_row=None):
+                     bias_row=None, want_logprobs: bool = False):
         """Shared prefill: bucket/pad the prompt, run the jitted
         prefill, sample the first token. Both the colocated admit path
         and prefill_only (disaggregation) call this — one copy, so the
@@ -723,13 +768,17 @@ class ContinuousBatchingEngine:
         self._step_counter += 1
         bias_dev = (self._zero_bias_row if bias_row is None
                     else jnp.asarray(bias_row))
-        token = self._sample_one(
+        token, chosen, top_vals, top_ids = self._sample_one(
             last_logits, float(temperature), int(top_k),
             self._jax.random.fold_in(self._base_key, self._step_counter),
             bias_dev)
         if use_cache:
             self._store_prefix(ids, ks, vs)
-        return ks, vs, int(token)
+        # the logprob transfer is a host sync — skip it on the common
+        # (no-logprobs) path
+        first_lp = (float(chosen), np.asarray(top_vals),
+                    np.asarray(top_ids)) if want_logprobs else None
+        return ks, vs, int(token), first_lp
 
     def _validate_logit_bias(self, logit_bias) -> None:
         """Reject out-of-vocab ids on the CALLER's thread — every
@@ -759,17 +808,37 @@ class ContinuousBatchingEngine:
         if request.guided_state is None:
             request.guided_state = request.guided.start_state()
 
+    @staticmethod
+    def _has_dynamic_bias(request: GenerationRequest) -> bool:
+        """True when the slot's bias row depends on what has been
+        generated so far (guided mask / repetition penalties) and must
+        be refreshed between steps — such requests are excluded from
+        the fused multi-token fast paths."""
+        return (request.guided is not None
+                or request.presence_penalty != 0.0
+                or request.frequency_penalty != 0.0)
+
     def _bias_row(self, request: GenerationRequest) -> np.ndarray:
         """Dense [V] f32 bias row from the request's sparse
         logit_bias (values clamped to the OpenAI +-100 range; ids
-        outside the vocab rejected at add_request) combined with the
-        guided-decoding mask for the request's CURRENT automaton state
-        (-1e9 on disallowed ids — far below the +-100 clamp, so a
-        logit_bias push can never resurrect a grammar-banned token)."""
+        outside the vocab rejected at add_request), combined with
+        presence/frequency penalties over the tokens generated so far
+        and with the guided-decoding mask for the request's CURRENT
+        automaton state (-1e9 on disallowed ids — far below every
+        other term, so nothing resurrects a grammar-banned token)."""
         vocab = self.config.model.vocab_size
         row = np.zeros(vocab, dtype=np.float32)
         for tid, val in (request.logit_bias or {}).items():
             row[int(tid)] = float(np.clip(val, -100.0, 100.0))
+        if (request.presence_penalty or request.frequency_penalty) \
+                and request.output_ids:
+            ids, counts = np.unique(
+                np.asarray(request.output_ids, dtype=np.int64),
+                return_counts=True)
+            keep = (ids >= 0) & (ids < vocab)
+            ids, counts = ids[keep], counts[keep]
+            row[ids] -= (request.presence_penalty
+                         + request.frequency_penalty * counts)
         if request.guided is not None and request.guided_state is not None:
             mask = request.guided.token_mask(request.guided_state)
             penalty = np.full(vocab, -1e9, dtype=np.float32)
@@ -779,7 +848,7 @@ class ContinuousBatchingEngine:
 
     def _install_bias(self, request: GenerationRequest,
                       slot_index: int) -> None:
-        if request.logit_bias or request.guided is not None:
+        if request.logit_bias or self._has_dynamic_bias(request):
             row = self._jnp.asarray(self._bias_row(request))
         else:
             row = self._zero_bias_row  # no per-request host build/copy
@@ -873,7 +942,8 @@ class ContinuousBatchingEngine:
             ids = request.prompt_ids
             self._install_bias(request, slot.index)
             C = self.config.chunked_prefill_tokens
-            if C > 0 and request.adapter is None:
+            if C > 0 and request.adapter is None \
+                    and request.logprobs is None:
                 # chunked admission: no blocking prefill — step() will
                 # advance this prompt one chunk at a time. Every chunk
                 # write stays in bounds because add_request truncated
@@ -886,12 +956,15 @@ class ContinuousBatchingEngine:
                 slot.pos = 0
                 slot.next_token = 0
                 continue
-            ks, vs, token = self._run_prefill(
+            ks, vs, token, first_lp = self._run_prefill(
                 ids, request.adapter, request.temperature,
                 request.top_k,
                 bias_row=(self._bias_row(request)
                           if request.logit_bias
-                          or request.guided is not None else None))
+                          or self._has_dynamic_bias(request) else None),
+                want_logprobs=request.logprobs is not None)
+            if request.logprobs is not None:
+                slot.pending_lp = first_lp
             self.cache_k, self.cache_v = self._insert(
                 self.cache_k, self.cache_v, ks, vs, slot.index)
             if self._spec:
@@ -910,6 +983,17 @@ class ContinuousBatchingEngine:
             return
         request.output_ids.append(token)
         self.total_generated += 1
+        if request.logprobs is not None and slot.pending_lp is not None:
+            chosen, top_vals, top_ids = slot.pending_lp
+            k = min(request.logprobs, len(top_ids))
+            request.logprob_data.append({
+                "id": token, "logprob": float(chosen),
+                "top": [(int(top_ids[i]), float(top_vals[i]))
+                        for i in range(k)]})
+        slot.pending_lp = None
+        if (request.presence_penalty or request.frequency_penalty) \
+                and not request.done:
+            slot.bias_stale = True
         grammar_done = False
         if request.guided is not None and token not in request.stop_ids:
             state = request.guided.advance(request.guided_state, token)
@@ -1098,12 +1182,14 @@ class ContinuousBatchingEngine:
                 fused_decodes = [
                     s for s in self.slots
                     if s.request is not None and not s.prefilling
-                    and s.request.adapter is None]
+                    and s.request.adapter is None
+                    and s.request.logprobs is None]
                 self._prefill_chunk_step(prefilling, fused_decodes)
                 handled = len(prefilling) + len(fused_decodes)
                 active = [s for s in self.slots
                           if s.request is not None and not s.prefilling
-                          and s.request.adapter is not None]
+                          and (s.request.adapter is not None
+                               or s.request.logprobs is not None)]
                 if not active:
                     return handled
                 # fall through: adapter decodes take the dense step
@@ -1119,7 +1205,9 @@ class ContinuousBatchingEngine:
         if self._spec and \
                 any(s.request.temperature <= 0.0 for s in active) and \
                 all(s.request.adapter is None for s in active) and \
-                all(s.request.guided is None for s in active) and \
+                not any(self._has_dynamic_bias(s.request)
+                        or s.request.logprobs is not None
+                        for s in active) and \
                 all(s.draft_ready for s in active) and \
                 all(s.pos + self.config.spec_tokens
                     <= self.config.max_seq - 1 for s in active):
@@ -1129,20 +1217,24 @@ class ContinuousBatchingEngine:
         K = self.config.multi_step
         if K > 1 and all(s.pos + K <= self.config.max_seq - 1
                          for s in active) and \
-                all(s.request.guided is None for s in active):
-            # guided slots need a mask refresh between tokens, which a
-            # fused K-step scan cannot do — dense fallback while active
+                not any(self._has_dynamic_bias(s.request)
+                        or s.request.logprobs is not None
+                        for s in active):
+            # guided/penalized slots need a bias refresh between
+            # tokens, which a fused K-step scan cannot do — dense
+            # fallback while any such request is active
             return self._multi_step(active, K) + handled
         jnp = self._jnp
         tokens, pos, temp, topk, lora_idx = self._gather_batch(
             active, pos_fill=self._dense_park)
         self._step_counter += 1
-        sampled, self.cache_k, self.cache_v = self._decode(
-            self.params, self.cache_k, self.cache_v,
-            jnp.asarray(tokens), jnp.asarray(pos),
-            jnp.asarray(temp), jnp.asarray(topk),
-            self._base_key, self._step_counter,
-            self.lora_bank, jnp.asarray(lora_idx), self._bias)
+        sampled, chosen_lp, top_vals, top_ids, self.cache_k, \
+            self.cache_v = self._decode(
+                self.params, self.cache_k, self.cache_v,
+                jnp.asarray(tokens), jnp.asarray(pos),
+                jnp.asarray(temp), jnp.asarray(topk),
+                self._base_key, self._step_counter,
+                self.lora_bank, jnp.asarray(lora_idx), self._bias)
         if self._spec:
             # keep the draft cache in lockstep through dense rounds,
             # or the next _spec_step would condition on KV gaps
@@ -1151,6 +1243,16 @@ class ContinuousBatchingEngine:
                 self.draft_cache_v, jnp.asarray(tokens),
                 jnp.asarray(pos))
         sampled = np.asarray(sampled)
+        if any(s.request.logprobs is not None for s in active):
+            # only logprob requests pay the extra device-to-host syncs
+            chosen_lp = np.asarray(chosen_lp)
+            top_vals = np.asarray(top_vals)
+            top_ids = np.asarray(top_ids)
+            for slot in active:
+                if slot.request.logprobs is not None:
+                    slot.pending_lp = (chosen_lp[slot.index],
+                                       top_vals[slot.index],
+                                       top_ids[slot.index])
         for slot in active:
             slot.pos += 1
             slot.next_token = int(sampled[slot.index])
@@ -1202,6 +1304,7 @@ class ContinuousBatchingEngine:
             slot.prefill_ids = None
             slot.prefill_pos = 0
             slot.bias_stale = False
+            slot.pending_lp = None
         self.cache_k, self.cache_v = llama_init_cache(
             self.config.model, self.config.max_batch, self.config.max_seq)
         if self._spec:

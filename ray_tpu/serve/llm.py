@@ -160,6 +160,40 @@ class LLMServer:
         # top-k width anyway, but a sane bound keeps intent clear
         out["top_k"] = min(top_k, self.config.engine.model.vocab_size)
         out["adapter"] = self._resolve_adapter(body.get("model"))
+        for pen in ("presence_penalty", "frequency_penalty"):
+            val = body.get(pen)
+            if val is None:
+                continue
+            if isinstance(val, bool) or not isinstance(val, (int, float)) \
+                    or not math.isfinite(float(val)) \
+                    or not -2.0 <= float(val) <= 2.0:
+                raise ValueError(f"{pen} must be a number in [-2, 2]")
+            out[pen] = float(val)
+        lp = body.get("logprobs")
+        top_lp = body.get("top_logprobs")
+        if lp is not None or top_lp is not None:
+            if isinstance(lp, bool):
+                # chat shape: logprobs: true + top_logprobs: int
+                if top_lp is None:
+                    top_lp = 0
+                if isinstance(top_lp, bool) or \
+                        not isinstance(top_lp, int) or \
+                        not 0 <= top_lp <= 20:
+                    raise ValueError(
+                        "top_logprobs must be an integer in [0, 20]")
+                if not lp and body.get("top_logprobs") is not None:
+                    raise ValueError(
+                        "top_logprobs requires logprobs=true")
+                if lp:
+                    out["logprobs"] = top_lp
+            elif lp is not None:
+                # completions shape: logprobs: int (0 = chosen only)
+                if not isinstance(lp, int) or not 0 <= lp <= 5:
+                    raise ValueError(
+                        "logprobs must be an integer in [0, 5]")
+                out["logprobs"] = lp
+            else:
+                raise ValueError("top_logprobs requires logprobs")
         lb = body.get("logit_bias")
         if lb is not None:
             if not isinstance(lb, dict):
@@ -453,7 +487,8 @@ class LLMServer:
 
     def _make_request(self, prompt: str, *, max_tokens, temperature,
                       top_k, adapter, logit_bias, guided=None,
-                      stream_queue=None):
+                      presence_penalty=0.0, frequency_penalty=0.0,
+                      logprobs=None, stream_queue=None):
         """ONE construction + admission path for all generate
         variants (non-stream, stop-string, stream) so a new sampling
         field cannot desync them."""
@@ -467,6 +502,9 @@ class LLMServer:
             adapter=adapter,
             logit_bias=logit_bias,
             guided=guided,
+            presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty,
+            logprobs=logprobs,
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else (),
             stream_queue=stream_queue)
@@ -497,6 +535,9 @@ class LLMServer:
             adapter=sampling.get("adapter"),
             logit_bias=sampling.get("logit_bias"),
             guided=sampling.get("guided"),
+            presence_penalty=sampling.get("presence_penalty", 0.0),
+            frequency_penalty=sampling.get("frequency_penalty", 0.0),
+            logprobs=sampling.get("logprobs"),
             stop=sampling.get("stop"))
         if n == 1:
             return [self._generate(prompt, **kwargs)]
@@ -510,7 +551,10 @@ class LLMServer:
             prompt, max_tokens=kwargs["max_tokens"],
             temperature=kwargs["temperature"], top_k=kwargs["top_k"],
             adapter=kwargs["adapter"], logit_bias=kwargs["logit_bias"],
-            guided=kwargs["guided"])
+            guided=kwargs["guided"],
+            presence_penalty=kwargs["presence_penalty"],
+            frequency_penalty=kwargs["frequency_penalty"],
+            logprobs=kwargs["logprobs"])
             for _ in range(n)]
         while not all(r.done for _, r in admitted):
             time.sleep(0.001)
@@ -519,12 +563,17 @@ class LLMServer:
             if r.error is not None:
                 raise RuntimeError(r.error)
             out_ids = [i for i in r.output_ids if i not in r.stop_ids]
-            results.append({
+            result = {
                 "text": self.tokenizer.decode(out_ids),
                 "prompt_tokens": len(ids),
                 "completion_tokens": len(r.output_ids),
                 "finish_reason": r.finish_reason,
-            })
+            }
+            if r.logprobs is not None:
+                result["logprob_data"] = [
+                    e for i, e in zip(r.output_ids, r.logprob_data)
+                    if i not in r.stop_ids]
+            results.append(result)
         return results
 
     def register_adapter(self, name: str, lora_params) -> None:
@@ -571,29 +620,41 @@ class LLMServer:
                   adapter: Optional[str] = None,
                   logit_bias: Optional[Dict[int, float]] = None,
                   guided=None,
+                  presence_penalty: float = 0.0,
+                  frequency_penalty: float = 0.0,
+                  logprobs: Optional[int] = None,
                   stop: Optional[List[str]] = None
                   ) -> Dict[str, Any]:
         if stop:
             return self._generate_with_stop(
                 prompt, max_tokens=max_tokens, temperature=temperature,
                 top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-                guided=guided, stop=stop)
+                guided=guided, presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty, logprobs=logprobs,
+                stop=stop)
         ids, request = self._make_request(
             prompt, max_tokens=max_tokens, temperature=temperature,
             top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-            guided=guided)
+            guided=guided, presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty, logprobs=logprobs)
         while not request.done:
             time.sleep(0.001)
         if request.error is not None:
             raise RuntimeError(request.error)
         out_ids = [i for i in request.output_ids
                    if i not in request.stop_ids]
-        return {
+        result = {
             "text": self.tokenizer.decode(out_ids),
             "prompt_tokens": len(ids),
             "completion_tokens": len(request.output_ids),
             "finish_reason": request.finish_reason,
         }
+        if request.logprobs is not None:
+            result["logprob_data"] = [
+                e for i, e in zip(request.output_ids,
+                                  request.logprob_data)
+                if i not in request.stop_ids]
+        return result
 
     def _generate_with_stop(self, prompt: str, *,
                             max_tokens: Optional[int] = None,
@@ -602,6 +663,9 @@ class LLMServer:
                             adapter: Optional[str] = None,
                             logit_bias: Optional[Dict[int, float]] = None,
                             guided=None,
+                            presence_penalty: float = 0.0,
+                            frequency_penalty: float = 0.0,
+                            logprobs: Optional[int] = None,
                             stop: List[str] = ()) -> Dict[str, Any]:
         """Non-streaming generation with OpenAI stop STRINGS: watch
         the decoded text incrementally and cancel the engine request
@@ -613,7 +677,9 @@ class LLMServer:
         ids, request = self._make_request(
             prompt, max_tokens=max_tokens, temperature=temperature,
             top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-            guided=guided, stream_queue=queue.Queue())
+            guided=guided, presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty, logprobs=logprobs,
+            stream_queue=queue.Queue())
         text = ""
         hit = False
         for delta in stream_text_deltas(self.tokenizer, request):
@@ -624,12 +690,23 @@ class LLMServer:
                 hit = True
                 self.engine.cancel(request, "stop")
                 break
-        return {
+        result = {
             "text": text,
             "prompt_tokens": len(ids),
             "completion_tokens": len(request.output_ids),
             "finish_reason": "stop" if hit else request.finish_reason,
         }
+        if request.logprobs is not None:
+            kept, acc = [], []
+            for i, e in zip(request.output_ids, request.logprob_data):
+                if i in request.stop_ids:
+                    continue
+                acc.append(i)
+                kept.append(e)
+                if hit and len(self.tokenizer.decode(acc)) >= len(text):
+                    break  # logprobs stop where the returned text does
+            result["logprob_data"] = kept
+        return result
 
     def _generate_stream(self, prompt: str, *,
                          max_tokens: Optional[int] = None,
@@ -638,6 +715,9 @@ class LLMServer:
                          adapter: Optional[str] = None,
                          logit_bias: Optional[Dict[int, float]] = None,
                          guided=None,
+                         presence_penalty: float = 0.0,
+                         frequency_penalty: float = 0.0,
+                         logprobs: Optional[int] = None,
                          stop: Optional[List[str]] = None):
         """Yield decoded text per emitted token (reference: vLLM output
         streams behind serve token streaming). The engine's stepper
@@ -650,7 +730,9 @@ class LLMServer:
         _ids, request = self._make_request(
             prompt, max_tokens=max_tokens, temperature=temperature,
             top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-            guided=guided, stream_queue=queue.Queue())
+            guided=guided, presence_penalty=presence_penalty,
+            frequency_penalty=frequency_penalty, logprobs=logprobs,
+            stream_queue=queue.Queue())
         deltas = stream_text_deltas(self.tokenizer, request)
         if not stop:
             yield from deltas
@@ -731,6 +813,42 @@ class LLMServer:
             "usage": {"prompt_tokens": total, "total_tokens": total},
         }
 
+    def _token_str(self, tid: int) -> str:
+        return self.tokenizer.decode([tid])
+
+    def _completions_logprobs(self, r: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI completions logprobs object (tokens/token_logprobs/
+        top_logprobs/text_offset)."""
+        data = r["logprob_data"]
+        tokens, lps, tops, offsets = [], [], [], []
+        off = 0
+        for e in data:
+            ts = self._token_str(e["id"])
+            tokens.append(ts)
+            lps.append(e["logprob"])
+            tops.append({self._token_str(tid): lp
+                         for tid, lp in e["top"]})
+            offsets.append(off)
+            off += len(ts)
+        return {"tokens": tokens, "token_logprobs": lps,
+                "top_logprobs": tops, "text_offset": offsets}
+
+    def _chat_logprobs(self, r: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI chat logprobs object (content[].top_logprobs)."""
+        content = []
+        for e in r["logprob_data"]:
+            ts = self._token_str(e["id"])
+            content.append({
+                "token": ts,
+                "logprob": e["logprob"],
+                "bytes": list(ts.encode()),
+                "top_logprobs": [
+                    {"token": self._token_str(tid), "logprob": lp,
+                     "bytes": list(self._token_str(tid).encode())}
+                    for tid, lp in e["top"]],
+            })
+        return {"content": content}
+
     def score(self, body: Dict[str, Any]) -> Dict[str, Any]:
         """/v1/score: similarity of text_1 against each text_2
         (reference surface: openai_api_models.py:123 ScoreRequest via
@@ -798,21 +916,27 @@ class LLMServer:
             if sampling.get("n", 1) > 1:
                 return self._invalid_request(ValueError(
                     "n > 1 is not supported with stream=true"))
+            if sampling.get("logprobs") is not None:
+                return self._invalid_request(ValueError(
+                    "logprobs are not supported with stream=true"))
             return self._stream_completions(body, prompt, sampling)
         try:
             results = self._generate_n(prompt, sampling)
         except ValueError as e:
             return self._invalid_request(e)
         result = results[0]
+        choices = []
+        for i, r in enumerate(results):
+            choice = {"index": i, "text": r["text"],
+                      "finish_reason": r["finish_reason"]}
+            if r.get("logprob_data") is not None:
+                choice["logprobs"] = self._completions_logprobs(r)
+            choices.append(choice)
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "model": body.get("model", self.config.model_id),
-            "choices": [{
-                "index": i,
-                "text": r["text"],
-                "finish_reason": r["finish_reason"],
-            } for i, r in enumerate(results)],
+            "choices": choices,
             "usage": {
                 "prompt_tokens": result["prompt_tokens"],
                 "completion_tokens": sum(r["completion_tokens"]
@@ -838,6 +962,8 @@ class LLMServer:
                 adapter=sampling.get("adapter"),
                 logit_bias=sampling.get("logit_bias"),
                 guided=sampling.get("guided"),
+                presence_penalty=sampling.get("presence_penalty", 0.0),
+                frequency_penalty=sampling.get("frequency_penalty", 0.0),
                 stop=sampling.get("stop")):
             chunk = {"id": cmpl_id, "object": "text_completion",
                      "model": model,
@@ -871,6 +997,9 @@ class LLMServer:
             adapter=sampling.get("adapter"),
             logit_bias=sampling.get("logit_bias"),
             guided=sampling.get("guided"),
+            presence_penalty=sampling.get("presence_penalty", 0.0),
+            frequency_penalty=sampling.get("frequency_penalty", 0.0),
+            logprobs=sampling.get("logprobs"),
             stop=sampling.get("stop"))
         tools_live = guided_info and guided_info["tool_mode"] is not None
         if not tools_live:
@@ -918,6 +1047,9 @@ class LLMServer:
             if sampling.get("n", 1) > 1:
                 return self._invalid_request(ValueError(
                     "n > 1 is not supported with stream=true"))
+            if sampling.get("logprobs") is not None:
+                return self._invalid_request(ValueError(
+                    "logprobs are not supported with stream=true"))
             return self._stream_chat(body, prompt, sampling, guided_info)
         try:
             results = self._generate_n(prompt, sampling)
@@ -927,8 +1059,11 @@ class LLMServer:
         choices = []
         for i, r in enumerate(results):
             message, finish = self._chat_message(guided_info, r)
-            choices.append({"index": i, "message": message,
-                            "finish_reason": finish})
+            choice = {"index": i, "message": message,
+                      "finish_reason": finish}
+            if r.get("logprob_data") is not None:
+                choice["logprobs"] = self._chat_logprobs(r)
+            choices.append(choice)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",

@@ -694,3 +694,44 @@ def test_sql_roundtrip(ray_start_shared, tmp_path):
     ds2 = rd.read_sql("SELECT x, y FROM pts WHERE x >= ? AND x < ?",
                       factory, shards=[(0, 4), (4, 8)])
     assert sorted(r["x"] for r in ds2.take_all()) == list(range(8))
+
+
+def test_from_torch_and_from_huggingface(ray_start_shared):
+    import torch.utils.data as tud
+
+    class Squares(tud.Dataset):
+        def __len__(self):
+            return 5
+
+        def __getitem__(self, i):
+            return i * i
+
+    ds = rd.from_torch(Squares())
+    assert [r["item"] for r in ds.take_all()] == [0, 1, 4, 9, 16]
+
+    class Streamy(tud.IterableDataset):
+        def __iter__(self):
+            return iter(["a", "b"])
+
+    assert [r["item"] for r in rd.from_torch(Streamy()).take_all()] \
+        == ["a", "b"]
+
+    # huggingface duck-type: arrow-backed fast path + row fallback
+    class FakeData:
+        def __init__(self, table):
+            self.table = table
+
+    class FakeHF:
+        def __init__(self, table):
+            self.data = FakeData(table)
+
+    t = pa.table({"x": pa.array([1, 2, 3])})
+    out = rd.from_huggingface(FakeHF(t)).take_all()
+    assert [r["x"] for r in out] == [1, 2, 3]
+
+    class IterHF:
+        def __iter__(self):
+            return iter([{"x": 1}, {"x": 2}])
+
+    assert [r["x"] for r in rd.from_huggingface(IterHF()).take_all()] \
+        == [1, 2]

@@ -1666,3 +1666,187 @@ def test_llm_combined_saturation():
             assert stats["prefix_cache_entries"] <= 4
             assert stats["prefix_hits"] >= 1  # shared system prompt hit
         server.stop()
+
+
+# ------------------------------------- presence / frequency penalties
+
+def test_penalties_break_repetition_and_validate():
+    """frequency_penalty makes a greedily repeating token pay per
+    occurrence until another token wins (reference: OpenAI sampling
+    params via vLLM SamplingParams); implemented on the per-step
+    bias-row refresh machinery."""
+    engine = tiny_engine(max_batch=2)
+    forced = 7
+    # logit_bias pins greedy decoding to one token...
+    rep = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=8,
+        logit_bias={forced: 20.0}))
+    while not rep.done:
+        engine.step()
+    assert rep.output_ids == [forced] * 8
+    # ...and a frequency penalty overcomes the same bias after a few
+    # occurrences (engine level is unclamped; the serve layer enforces
+    # the OpenAI [-2, 2] range)
+    pen = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=8,
+        logit_bias={forced: 20.0}, frequency_penalty=6.0))
+    plain = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=8,
+        logit_bias={forced: 20.0}))
+    while not (pen.done and plain.done):
+        engine.step()
+    assert pen.output_ids != [forced] * 8
+    assert forced in pen.output_ids  # started repeating, then broke
+    assert plain.output_ids == [forced] * 8  # co-batched, no bleed
+    # presence penalty: one-shot, weaker than per-occurrence
+    pres = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=6,
+        logit_bias={forced: 1.0}, presence_penalty=2.0))
+    while not pres.done:
+        engine.step()
+    assert pres.output_ids[0] != pres.output_ids[1] or \
+        pres.output_ids.count(forced) <= 1
+
+
+def test_penalties_force_dense_fallback_and_serve_surface():
+    # multi_step engine: penalized requests take the dense path and
+    # still apply the penalty per token
+    engine = tiny_engine(max_batch=1, multi_step=4)
+    forced = 9
+    req = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=8,
+        logit_bias={forced: 20.0}, frequency_penalty=6.0))
+    while not req.done:
+        engine.step()
+    assert req.output_ids != [forced] * 8
+    # serve surface: accepted on completions + chat, validated
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    server = LLMServer(LLMConfig(
+        model_id="pen", engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=1, max_seq=64), max_tokens=8))
+    try:
+        out = server.completions({
+            "prompt": "hi", "max_tokens": 8,
+            "logit_bias": {"65": 5.0}, "frequency_penalty": 2.0})
+        assert "error" not in out
+        assert out["choices"][0]["text"] != "A" * 8
+        for bad in ("x", 3.0, -2.5, float("nan")):
+            out = server.completions({"prompt": "x",
+                                      "presence_penalty": bad})
+            assert out["error"]["type"] == "invalid_request_error", bad
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------- logprobs
+
+def test_engine_logprobs_greedy_consistency():
+    """Greedy decoding with logprobs: the chosen token is the top-1 of
+    the recorded distribution, every entry has the requested top-k,
+    and values are valid log-probabilities."""
+    engine = tiny_engine(max_batch=2)
+    req = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=6, logprobs=3))
+    plain = engine.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=6))
+    while not (req.done and plain.done):
+        engine.step()
+    # logprob requests produce identical greedy tokens
+    assert req.output_ids == plain.output_ids
+    assert len(req.logprob_data) == 6  # prefill token + 5 decodes
+    for e, tok in zip(req.logprob_data, req.output_ids):
+        assert e["id"] == tok
+        assert len(e["top"]) == 3
+        assert e["top"][0][0] == tok  # greedy = top-1
+        assert e["logprob"] == pytest.approx(e["top"][0][1], abs=1e-4)
+        assert e["logprob"] <= 1e-6  # log prob <= 0
+    assert plain.logprob_data == []
+    # fused paths fall back to dense while a logprob request is active
+    eng2 = tiny_engine(max_batch=1, multi_step=4)
+    r2 = eng2.add_request(GenerationRequest(
+        prompt_ids=[1, 2, 3], max_tokens=6, logprobs=2))
+    while not r2.done:
+        eng2.step()
+    assert r2.output_ids == req.output_ids
+    assert len(r2.logprob_data) == 6
+    # disagg decode path rejects logprobs loudly
+    with pytest.raises(ValueError, match="disagg"):
+        engine.add_prefilled(GenerationRequest(
+            prompt_ids=[1], logprobs=1), None, None, 1, 0)
+
+
+def test_openai_logprobs_surface():
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    server = LLMServer(LLMConfig(
+        model_id="lp", engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=2, max_seq=64), max_tokens=6))
+    try:
+        # completions shape: logprobs: int
+        out = server.completions({"prompt": "hi", "max_tokens": 4,
+                                  "logprobs": 2})
+        lp = out["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"])
+        assert all(len(t) <= 2 for t in lp["top_logprobs"])
+        assert lp["text_offset"][0] == 0
+        # chat shape: logprobs: true + top_logprobs
+        out = server.chat_completions({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "logprobs": True, "top_logprobs": 3})
+        content = out["choices"][0]["logprobs"]["content"]
+        assert content and all(len(e["top_logprobs"]) == 3
+                               for e in content)
+        assert all(isinstance(e["bytes"], list) for e in content)
+        # validation
+        for bad in ({"logprobs": 9},
+                    {"logprobs": True, "top_logprobs": 50},
+                    {"top_logprobs": 3}):
+            out = server.completions({"prompt": "x", **bad})
+            assert out["error"]["type"] == "invalid_request_error", bad
+        out = server.completions({"prompt": "x", "logprobs": 2,
+                                  "stream": True})
+        assert out["error"]["type"] == "invalid_request_error"
+    finally:
+        server.stop()
+
+
+def test_logprobs_zero_top_and_stop_truncation():
+    """OpenAI edge semantics: logprobs=0 / top_logprobs=0 record the
+    CHOSEN token's logprob with an empty top list, and with stop
+    strings the logprobs object covers exactly the returned text."""
+    from ray_tpu.llm.tokenizer import get_tokenizer
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    server = LLMServer(LLMConfig(
+        model_id="lp0", engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=1, max_seq=64), max_tokens=8))
+    try:
+        out = server.completions({"prompt": "hi", "max_tokens": 4,
+                                  "logprobs": 0})
+        lp = out["choices"][0]["logprobs"]
+        assert len(lp["token_logprobs"]) == 4
+        assert all(t == {} for t in lp["top_logprobs"])
+        out = server.chat_completions({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "logprobs": True, "top_logprobs": 0})
+        content = out["choices"][0]["logprobs"]["content"]
+        assert content and all(e["top_logprobs"] == [] for e in content)
+        # stop truncation: logprobs tokens rebuild exactly the text
+        tok = get_tokenizer(None)
+        base = server.completions({"prompt": "go", "max_tokens": 8})
+        text8 = base["choices"][0]["text"]
+        if len(text8) >= 3:
+            stop = text8[2]
+            out = server.completions({"prompt": "go", "max_tokens": 8,
+                                      "logprobs": 1, "stop": [stop]})
+            text = out["choices"][0]["text"]
+            lp = out["choices"][0]["logprobs"]
+            rebuilt = "".join(lp["tokens"])
+            assert rebuilt.startswith(text)
+            assert len(rebuilt) <= len(text) + 4  # no post-stop tail
+    finally:
+        server.stop()
