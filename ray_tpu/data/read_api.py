@@ -210,9 +210,13 @@ def from_huggingface(dataset) -> Dataset:
     IterableDataset)."""
     data = getattr(dataset, "data", None)
     table = getattr(data, "table", None)
-    if isinstance(table, pa.Table):
+    # HF applies select()/shuffle()/splits through an _indices
+    # indirection over the SAME arrow table — zero-copy is only valid
+    # when no indirection exists, else it returns the wrong rows
+    plain = getattr(dataset, "_indices", None) is None
+    if plain and isinstance(table, pa.Table):
         return from_arrow(table.combine_chunks())
-    if isinstance(data, pa.Table):
+    if plain and isinstance(data, pa.Table):
         return from_arrow(data)
     rows = [dict(r) for r in dataset]
     if not rows:

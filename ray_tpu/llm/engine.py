@@ -137,10 +137,14 @@ class GenerationRequest:
     # alternatives to record per emitted token (0 still records the
     # CHOSEN token's logprob with an empty top list, matching OpenAI's
     # logprobs=0 / top_logprobs=0 semantics; clamped to the engine's
-    # static top-k width). Logprobs are log-softmax of the BIASED
-    # logits — exactly the distribution the sampler saw. Requests with
-    # logprobs take the dense decode path (the fused multi-token paths
-    # do not return per-step logprob tensors).
+    # static top-k width). Logprobs are log-softmax of the BIASED but
+    # UN-temperature-scaled logits — the model's distribution after
+    # logit_bias/penalties/grammar masks, before sampling temperature
+    # and top-k truncation (the raw-logprobs convention; a sampled
+    # token's reported logprob is not its realized sampling
+    # probability at temperature != 1). Requests with logprobs take
+    # the dense decode path (the fused multi-token paths do not
+    # return per-step logprob tensors).
     logprobs: Optional[int] = None
     # Guided decoding (reference: vLLM guided decoding behind
     # response_format/tools): a ray_tpu.llm.guided.TokenConstraint.
@@ -338,13 +342,18 @@ class ContinuousBatchingEngine:
             return jnp.where(temp <= 0.0, greedy, sampled)
 
         def decode(params, cache_k, cache_v, tokens, pos, temp, topk,
-                   base_key, step, lora_bank, lora_idx, bias):
+                   base_key, step, lora_bank, lora_idx, bias,
+                   want_lp=False):
             logits, ck, cv = llama_decode_step(
                 params, tokens, cache_k, cache_v, pos, c,
                 lora_bank=lora_bank, lora_idx=lora_idx)
             key = jax.random.fold_in(base_key, step)
             tok = sample_tokens(logits, temp, topk, key, bias)
-            # logprobs of the biased distribution the sampler saw;
+            if not want_lp:
+                # static arg: the no-logprobs program carries none of
+                # the log_softmax/top_k work or output buffers
+                return tok, None, None, None, ck, cv
+            # logprobs of the biased (un-temperature-scaled) logits;
             # [B] chosen + [B, lp_k] top alternatives — tiny transfers
             lsm = jax.nn.log_softmax(
                 (logits + bias).astype(jnp.float32), axis=-1)
@@ -355,11 +364,14 @@ class ContinuousBatchingEngine:
         def prefill(params, tokens, lora):
             return llama_prefill(params, tokens, c, lora=lora)
 
-        def sample_one(logits, temp, topk, key, bias_row):
+        def sample_one(logits, temp, topk, key, bias_row,
+                       want_lp=False):
             tok = sample_tokens(
                 logits[None, :], jnp.full((1,), temp),
                 jnp.full((1,), topk, dtype=jnp.int32), key,
                 bias_row[None, :])[0]
+            if not want_lp:
+                return tok, None, None, None
             lsm = jax.nn.log_softmax(
                 (logits + bias_row).astype(jnp.float32))
             chosen = lsm[tok]
@@ -376,9 +388,10 @@ class ContinuousBatchingEngine:
             return ck, cv
 
         self._decode = jax.jit(decode, donate_argnums=(1, 2),
-                               static_argnames=())
+                               static_argnames=("want_lp",))
         self._prefill = jax.jit(prefill)
-        self._sample_one = jax.jit(sample_one)
+        self._sample_one = jax.jit(sample_one,
+                                   static_argnames=("want_lp",))
         self._insert = jax.jit(insert, donate_argnums=(0, 1))
 
         if config.enable_prefix_caching:
@@ -771,11 +784,9 @@ class ContinuousBatchingEngine:
         token, chosen, top_vals, top_ids = self._sample_one(
             last_logits, float(temperature), int(top_k),
             self._jax.random.fold_in(self._base_key, self._step_counter),
-            bias_dev)
+            bias_dev, want_lp=want_logprobs)
         if use_cache:
             self._store_prefix(ids, ks, vs)
-        # the logprob transfer is a host sync — skip it on the common
-        # (no-logprobs) path
         first_lp = (float(chosen), np.asarray(top_vals),
                     np.asarray(top_ids)) if want_logprobs else None
         return ks, vs, int(token), first_lp
@@ -1228,13 +1239,15 @@ class ContinuousBatchingEngine:
         tokens, pos, temp, topk, lora_idx = self._gather_batch(
             active, pos_fill=self._dense_park)
         self._step_counter += 1
+        want_lp = any(s.request.logprobs is not None for s in active)
         sampled, chosen_lp, top_vals, top_ids, self.cache_k, \
             self.cache_v = self._decode(
                 self.params, self.cache_k, self.cache_v,
                 jnp.asarray(tokens), jnp.asarray(pos),
                 jnp.asarray(temp), jnp.asarray(topk),
                 self._base_key, self._step_counter,
-                self.lora_bank, jnp.asarray(lora_idx), self._bias)
+                self.lora_bank, jnp.asarray(lora_idx), self._bias,
+                want_lp=want_lp)
         if self._spec:
             # keep the draft cache in lockstep through dense rounds,
             # or the next _spec_step would condition on KV gaps
@@ -1243,7 +1256,7 @@ class ContinuousBatchingEngine:
                 self.draft_cache_v, jnp.asarray(tokens),
                 jnp.asarray(pos))
         sampled = np.asarray(sampled)
-        if any(s.request.logprobs is not None for s in active):
+        if want_lp:
             # only logprob requests pay the extra device-to-host syncs
             chosen_lp = np.asarray(chosen_lp)
             top_vals = np.asarray(top_vals)

@@ -136,6 +136,9 @@ class LLMServer:
 
         out: Dict[str, Any] = {}
         max_tokens = body.get("max_tokens")
+        if max_tokens is None:
+            # newer OpenAI name (chat): max_completion_tokens
+            max_tokens = body.get("max_completion_tokens")
         if max_tokens is not None:
             if (isinstance(max_tokens, bool)
                     or not isinstance(max_tokens, int) or max_tokens < 1):
@@ -169,6 +172,15 @@ class LLMServer:
                     or not -2.0 <= float(val) <= 2.0:
                 raise ValueError(f"{pen} must be a number in [-2, 2]")
             out[pen] = float(val)
+        so = body.get("stream_options")
+        if so is not None:
+            if not body.get("stream"):
+                raise ValueError("stream_options requires stream=true")
+            if not isinstance(so, dict) or not isinstance(
+                    so.get("include_usage", False), bool):
+                raise ValueError(
+                    'stream_options must be {"include_usage": bool}')
+            out["include_usage"] = bool(so.get("include_usage"))
         lp = body.get("logprobs")
         top_lp = body.get("top_logprobs")
         if lp is not None or top_lp is not None:
@@ -718,7 +730,8 @@ class LLMServer:
                          presence_penalty: float = 0.0,
                          frequency_penalty: float = 0.0,
                          logprobs: Optional[int] = None,
-                         stop: Optional[List[str]] = None):
+                         stop: Optional[List[str]] = None,
+                         request_sink: Optional[Dict[str, Any]] = None):
         """Yield decoded text per emitted token (reference: vLLM output
         streams behind serve token streaming). The engine's stepper
         pushes each token onto the request's queue as it decodes.
@@ -733,6 +746,11 @@ class LLMServer:
             guided=guided, presence_penalty=presence_penalty,
             frequency_penalty=frequency_penalty, logprobs=logprobs,
             stream_queue=queue.Queue())
+        if request_sink is not None:
+            # exact usage for stream_options.include_usage: the caller
+            # reads output_ids after the stream drains
+            request_sink["request"] = request
+            request_sink["prompt_tokens"] = len(_ids)
         deltas = stream_text_deltas(self.tokenizer, request)
         if not stop:
             yield from deltas
@@ -955,6 +973,7 @@ class LLMServer:
 
         cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         model = body.get("model", self.config.model_id)
+        sink: Dict[str, Any] = {}
         for text in self._generate_stream(
                 prompt, max_tokens=sampling.get("max_tokens"),
                 temperature=sampling.get("temperature"),
@@ -964,6 +983,7 @@ class LLMServer:
                 guided=sampling.get("guided"),
                 presence_penalty=sampling.get("presence_penalty", 0.0),
                 frequency_penalty=sampling.get("frequency_penalty", 0.0),
+                request_sink=sink,
                 stop=sampling.get("stop")):
             chunk = {"id": cmpl_id, "object": "text_completion",
                      "model": model,
@@ -974,7 +994,24 @@ class LLMServer:
                  "choices": [{"index": 0, "text": "",
                               "finish_reason": "stop"}]}
         yield f"data: {_json.dumps(final)}\n\n"
+        if sampling.get("include_usage"):
+            yield self._usage_chunk(sink, cmpl_id, "text_completion",
+                                    model)
         yield "data: [DONE]\n\n"
+
+    @staticmethod
+    def _usage_chunk(sink: Dict[str, Any], oid: str, obj: str,
+                     model: str) -> str:
+        """stream_options.include_usage: the final usage-only SSE
+        chunk (choices: []) shared by both streaming endpoints."""
+        pt = sink.get("prompt_tokens", 0)
+        ct = len(sink["request"].output_ids) if "request" in sink else 0
+        payload = {"id": oid, "object": obj, "model": model,
+                   "choices": [],
+                   "usage": {"prompt_tokens": pt,
+                             "completion_tokens": ct,
+                             "total_tokens": pt + ct}}
+        return f"data: {json.dumps(payload)}\n\n"
 
     def _stream_chat(self, body: Dict[str, Any], prompt: str,
                      sampling: Dict[str, Any],
@@ -990,6 +1027,7 @@ class LLMServer:
             return f"data: {json.dumps(payload)}\n\n"
 
         yield chunk({"role": "assistant"})
+        sink: Dict[str, Any] = {}
         deltas = self._generate_stream(
             prompt, max_tokens=sampling.get("max_tokens"),
             temperature=sampling.get("temperature"),
@@ -1000,12 +1038,22 @@ class LLMServer:
             presence_penalty=sampling.get("presence_penalty", 0.0),
             frequency_penalty=sampling.get("frequency_penalty", 0.0),
             logprobs=sampling.get("logprobs"),
+            request_sink=sink,
             stop=sampling.get("stop"))
         tools_live = guided_info and guided_info["tool_mode"] is not None
+        def usage_chunk():
+            if not sampling.get("include_usage"):
+                return None
+            return self._usage_chunk(sink, chat_id,
+                                     "chat.completion.chunk", model)
+
         if not tools_live:
             for text in deltas:
                 yield chunk({"content": text})
             yield chunk({}, finish="stop")
+            uc = usage_chunk()
+            if uc:
+                yield uc
             yield "data: [DONE]\n\n"
             return
         # tool-call streaming (OpenAI delta.tool_calls): the first
@@ -1028,6 +1076,9 @@ class LLMServer:
                     "index": 0,
                     "function": {"arguments": val}}]})
         yield chunk({}, finish="tool_calls" if made_tool else "stop")
+        uc = usage_chunk()
+        if uc:
+            yield uc
         yield "data: [DONE]\n\n"
 
     def chat_completions(self, body: Dict[str, Any]) -> Dict[str, Any]:

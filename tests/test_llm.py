@@ -1850,3 +1850,45 @@ def test_logprobs_zero_top_and_stop_truncation():
             assert len(rebuilt) <= len(text) + 4  # no post-stop tail
     finally:
         server.stop()
+
+
+def test_max_completion_tokens_and_stream_usage():
+    """Newer OpenAI chat param names: max_completion_tokens aliases
+    max_tokens; stream_options.include_usage appends a usage-only
+    chunk (choices: []) before [DONE]."""
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    server = LLMServer(LLMConfig(
+        model_id="so", engine=EngineConfig(
+            model=LlamaConfig.tiny(vocab_size=258, max_seq_len=64,
+                                   attention="reference", remat=False),
+            max_batch=1, max_seq=64), max_tokens=16))
+    try:
+        out = server.chat_completions({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_completion_tokens": 3})
+        assert out["usage"]["completion_tokens"] == 3
+        chunks = list(server.chat_completions({
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "stream": True,
+            "stream_options": {"include_usage": True}}))
+        assert chunks[-1] == "data: [DONE]\n\n"
+        events = [json.loads(c[len("data: "):]) for c in chunks[:-1]
+                  if c.startswith("data: ")]
+        assert events[-1]["choices"] == []
+        u = events[-1]["usage"]
+        assert u["completion_tokens"] == 4
+        assert u["total_tokens"] == u["prompt_tokens"] + 4
+        # completions stream too
+        chunks = list(server.completions({
+            "prompt": "hi", "max_tokens": 3, "stream": True,
+            "stream_options": {"include_usage": True}}))
+        events = [json.loads(c[len("data: "):]) for c in chunks[:-1]
+                  if c.startswith("data: ")]
+        assert events[-1]["usage"]["completion_tokens"] == 3
+        # stream_options without stream is rejected
+        out = server.completions({"prompt": "x",
+                                  "stream_options": {
+                                      "include_usage": True}})
+        assert out["error"]["type"] == "invalid_request_error"
+    finally:
+        server.stop()
