@@ -207,8 +207,11 @@ def get_runtime_context() -> _RuntimeContext:
     return _RuntimeContext()
 
 
-def timeline(filename: Optional[str] = None):
+def timeline(filename: Optional[str] = None,
+             trace_id: Optional[str] = None):
     """Export the cluster task timeline as Chrome trace events
-    (reference: ``ray timeline``). See ray_tpu/util/timeline.py."""
+    (reference: ``ray timeline``). ``trace_id`` narrows the export to
+    one distributed trace (its serve/engine spans included on a
+    dedicated row). See ray_tpu/util/timeline.py."""
     from ray_tpu.util.timeline import timeline as _timeline
-    return _timeline(filename)
+    return _timeline(filename, trace_id=trace_id)

@@ -25,6 +25,7 @@ from ray_tpu.core.remote_function import (
     resources_from_options,
     strategy_from_options,
     submitting_task_id,
+    submitting_trace_context,
     value_to_arg,
 )
 from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
@@ -58,6 +59,7 @@ class ActorMethod:
         if num_returns == "streaming":
             # incremental yields (reference: _raylet.pyx:299)
             num_returns = -1
+        trace_id, parent_span_id = submitting_trace_context()
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id="",
@@ -71,6 +73,8 @@ class ActorMethod:
             method_name=self._method_name,
             seq_no=self._handle._next_seq(),
             parent_task_id=submitting_task_id(rt),
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)
@@ -201,6 +205,7 @@ class ActorClass:
             runtime_env=renv,
             runtime_env_hash=runtime_env_hash(renv) if renv else "",
         )
+        spec.trace_id, spec.parent_span_id = submitting_trace_context()
         handle = ActorHandle(actor_id, self._cls.__name__, self._method_names)
         name = opts.get("name")
         if rt.is_driver:

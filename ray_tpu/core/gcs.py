@@ -227,6 +227,11 @@ class Gcs:
         self.functions: Dict[str, bytes] = {}  # function/class store
         cfg = get_config()
         self.task_events: deque = deque(maxlen=cfg.task_events_buffer_size)
+        # Distributed-trace spans (proxy/router/replica/engine hops and
+        # user tracing.span() blocks) — tuple layout (trace_id, span_id,
+        # parent_span_id, name, component, t_start, duration, tags).
+        # Same bounded-ring discipline as task events.
+        self.trace_spans: deque = deque(maxlen=cfg.task_events_buffer_size)
         if store is not None:
             self._restore_from_store()
 
@@ -404,8 +409,8 @@ class Gcs:
     def add_task_event(self, event) -> None:
         """Append one task event — either a TaskEvent or the hot-path
         tuple layout (task_id, name, state, timestamp, node_id,
-        worker_id, error, duration, parent_task_id). Tuples avoid
-        dataclass construction on the submit/complete hot path (3
+        worker_id, error, duration, parent_task_id, trace_id). Tuples
+        avoid dataclass construction on the submit/complete hot path (3
         events/task; reference batches via task_event_buffer.h:297) and
         are materialized lazily in list_task_events."""
         if get_config().task_events_enabled:
@@ -425,11 +430,42 @@ class Gcs:
         for ev in raw:
             if type(ev) is tuple:
                 (task_id, name, state, ts, node_id, worker_id, error,
-                 duration, parent_task_id) = ev
+                 duration, parent_task_id, trace_id) = ev
                 ev = TaskEvent(task_id=task_id, name=name, state=state,
                                node_id=node_id, worker_id=worker_id,
                                error=error, duration=duration,
-                               parent_task_id=parent_task_id)
+                               parent_task_id=parent_task_id,
+                               trace_id=trace_id)
                 ev.timestamp = ts
             out.append(ev)
         return out
+
+    # --- distributed-trace spans ---------------------------------------
+    def add_trace_span(self, span) -> None:
+        """Append one finished span: (trace_id, span_id, parent_span_id,
+        name, component, t_start, duration, tags)."""
+        if get_config().task_events_enabled:
+            with self.lock:
+                self.trace_spans.append(span)
+
+    def spans_for_trace(self, trace_id: str) -> List[tuple]:
+        with self.lock:
+            return [s for s in self.trace_spans if s[0] == trace_id]
+
+    def events_for_trace(self, trace_id: str,
+                         limit: int = 100_000) -> List[TaskEvent]:
+        return [ev for ev in self.list_task_events(limit=limit)
+                if ev.trace_id == trace_id]
+
+    def recent_trace_ids(self, limit: int = 100) -> List[str]:
+        """Most-recent distinct trace ids seen in the span store,
+        newest first (the dashboard's trace index)."""
+        with self.lock:
+            spans = list(self.trace_spans)
+        seen: List[str] = []
+        for span in reversed(spans):
+            if span[0] not in seen:
+                seen.append(span[0])
+                if len(seen) >= limit:
+                    break
+        return seen

@@ -33,6 +33,19 @@ from ray_tpu.core.protocol import (
     recv_msg,
     send_msg,
 )
+from ray_tpu.util.metrics import Counter, Histogram
+
+# Object-plane transfer instrumentation (reference: object manager
+# stats — chunked transfer bytes/latency). ``transport`` distinguishes
+# inline completion-reply payloads (counted in runtime.on_task_done),
+# in-process store-to-store replication, and chunked TCP pulls.
+TRANSFER_BYTES = Counter(
+    "ray_tpu_object_transfer_bytes_total",
+    "Object bytes moved through the object plane", tag_keys=("transport",))
+TRANSFER_SECONDS = Histogram(
+    "ray_tpu_object_transfer_seconds",
+    "Wall time of one object transfer", tag_keys=("transport",),
+    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0])
 
 _LEN = struct.Struct("<I")
 
@@ -325,6 +338,7 @@ def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
         sock = connect_tcp(addr[0], addr[1], timeout=timeout)
     except OSError:
         return False
+    t0 = time.perf_counter()
     charged = 0
     created = False
     try:
@@ -384,6 +398,9 @@ def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
             dest_store.delete(object_id)
             return False
         dest_store.seal(object_id)
+        TRANSFER_BYTES.inc(float(size), tags={"transport": "tcp"})
+        TRANSFER_SECONDS.observe(time.perf_counter() - t0,
+                                 tags={"transport": "tcp"})
         return True
     except OSError:
         # Only roll back an entry THIS call created — a concurrent

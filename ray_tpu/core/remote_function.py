@@ -72,6 +72,18 @@ def submitting_task_id(rt):
     return getattr(local, "value", None) if local is not None else None
 
 
+def submitting_trace_context():
+    """(trace_id, parent_span_id) to stamp into a spec: the active
+    trace context if one exists (inside a traced task, serve hop, or a
+    user ``tracing.span()``), else a freshly minted root — every task
+    tree is retrievable by trace_id."""
+    from ray_tpu.util import tracing
+    ctx = tracing.get_trace_context()
+    if ctx is None:
+        return tracing.new_trace_id(), None
+    return ctx.trace_id, ctx.span_id
+
+
 def strategy_from_options(options: Dict[str, Any]) -> SchedulingStrategy:
     strategy = options.get("scheduling_strategy")
     if strategy is None:
@@ -196,6 +208,7 @@ class RemoteFunction:
         if num_returns == "streaming":
             num_returns = -1
         renv, renv_hash = self._resolve_runtime_env(rt)
+        trace_id, parent_span_id = submitting_trace_context()
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id=function_id,
@@ -210,6 +223,8 @@ class RemoteFunction:
             runtime_env=renv,
             runtime_env_hash=renv_hash,
             parent_task_id=submitting_task_id(rt),
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
         rt.submit_spec(spec)

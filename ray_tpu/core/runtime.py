@@ -964,6 +964,10 @@ class DriverRuntime:
                         node.dispatch(follower)
                         budget -= 1
             self._backlog_view = list(backlog)
+            from ray_tpu.core.scheduler import (INFEASIBLE_TASKS,
+                                                QUEUE_DEPTH)
+            QUEUE_DEPTH.set(float(len(backlog)))
+            INFEASIBLE_TASKS.set(float(len(self._infeasible)))
             if backlog and not made_progress:
                 # All blocked on capacity; wait for a release/completion
                 # (completions only notify while this flag is up, so the
@@ -1146,6 +1150,8 @@ class DriverRuntime:
 
     # --- completion callbacks (called from node reader threads) ---------
     def on_task_done(self, node: Node, worker, spec: TaskSpec, msg: dict) -> None:
+        pending = self.task_manager.get_pending(spec.task_id)
+        submitted_at = pending.submitted_at if pending is not None else None
         error_blob = msg.get("error")
         if error_blob is not None:
             err = serialization.loads(error_blob)
@@ -1164,7 +1170,8 @@ class DriverRuntime:
                 self._fail_actor_buffer(spec.actor_id, err)
             self._record_execution_events(spec, node, worker, msg,
                                           "FAILED",
-                                          error=msg.get("error_str"))
+                                          error=msg.get("error_str"),
+                                          submitted_at=submitted_at)
             self._fail_task(spec, err)
             self._release_task_resources(spec, node.node_id)
             self._signal_scheduler()
@@ -1177,6 +1184,9 @@ class DriverRuntime:
                 self._reconstruction_done(oid)
             self._pin_contained(oid, contained)
             if kind == "inline":
+                from ray_tpu.core.object_transfer import TRANSFER_BYTES
+                TRANSFER_BYTES.inc(float(len(data)),
+                                   tags={"transport": "inline"})
                 self.memory_store.put(oid, ("packed", bytes(data)))
                 self.task_manager.set_location_and_ready(
                     oid, ObjectLocation("memory"))
@@ -1216,7 +1226,8 @@ class DriverRuntime:
                 self._finish_stream(spec.task_id, None)
             self._record_lineage(spec)
             self._release_task_resources(spec, node.node_id)
-        self._record_execution_events(spec, node, worker, msg, "FINISHED")
+        self._record_execution_events(spec, node, worker, msg, "FINISHED",
+                                      submitted_at=submitted_at)
         self._signal_scheduler()
 
     def _consume_overcommit(self, task_id: TaskID) -> bool:
@@ -1900,6 +1911,9 @@ class DriverRuntime:
                 finally:
                     del dest
                 dst_node.store.seal(oid)
+                from ray_tpu.core.object_transfer import TRANSFER_BYTES
+                TRANSFER_BYTES.inc(float(len(buf)),
+                                   tags={"transport": "shm_copy"})
                 return True
             finally:
                 del buf
@@ -2069,6 +2083,13 @@ class DriverRuntime:
             _registry.apply(kind, name, tuple(tag_items), value,
                             boundaries)
             return True
+        if method == "metrics_apply_batch":
+            from ray_tpu.util.metrics import _registry
+            _registry.apply_batch(args[0])
+            return True
+        if method == "trace_add_span":
+            self.gcs.add_trace_span(args[0])
+            return True
         raise ValueError(f"unknown GCS method {method}")
 
     # --- misc api --------------------------------------------------------
@@ -2198,35 +2219,50 @@ class DriverRuntime:
         self.gcs.add_task_event((
             spec.task_id, name or spec.name or spec.function_id, state,
             time.time() if timestamp is None else timestamp,
-            node_id, worker_id, error, duration, spec.parent_task_id))
+            node_id, worker_id, error, duration, spec.parent_task_id,
+            spec.trace_id))
 
     def _record_execution_events(self, spec: TaskSpec, node: Node,
                                  worker, msg: dict, state: str,
-                                 error: Optional[str] = None) -> None:
+                                 error: Optional[str] = None,
+                                 submitted_at: Optional[float] = None
+                                 ) -> None:
         """Record worker-timed RUNNING + user PROFILE spans + the final
         state for one executed task (timestamps come from the worker so
         the timeline reflects true execution windows, reference:
         task_event_buffer.h:297 + profile_event.cc). All events for the
-        task are appended under one GCS lock acquisition."""
+        task are appended under one GCS lock acquisition. Also feeds the
+        built-in task latency histograms (queue / run / end-to-end)."""
+        t_start, t_end = msg.get("t_start"), msg.get("t_end")
+        if t_start is not None and t_end is not None:
+            from ray_tpu.core.task_manager import (
+                TASK_E2E_SECONDS, TASK_QUEUE_SECONDS, TASK_RUN_SECONDS)
+            TASK_RUN_SECONDS.observe(max(0.0, t_end - t_start))
+            if submitted_at is not None:
+                TASK_QUEUE_SECONDS.observe(
+                    max(0.0, t_start - submitted_at))
+                TASK_E2E_SECONDS.observe(max(0.0, t_end - submitted_at))
         if not get_config().task_events_enabled:
             return
         worker_id = worker.worker_id if worker is not None else None
-        t_start, t_end = msg.get("t_start"), msg.get("t_end")
         name = spec.name or spec.function_id
         node_id = node.node_id
         parent = spec.parent_task_id
+        trace_id = spec.trace_id
         events = []
         if t_start is not None:
             events.append((spec.task_id, name, "RUNNING", t_start,
                            node_id, worker_id, None,
-                           (t_end - t_start) if t_end else None, parent))
+                           (t_end - t_start) if t_end else None, parent,
+                           trace_id))
         for span in msg.get("profile", ()):
             span_name, s0, s1 = span
             events.append((spec.task_id, span_name, "PROFILE", s0,
-                           node_id, worker_id, None, s1 - s0, parent))
+                           node_id, worker_id, None, s1 - s0, parent,
+                           trace_id))
         events.append((spec.task_id, name, state,
                        time.time() if t_end is None else t_end,
-                       node_id, worker_id, error, None, parent))
+                       node_id, worker_id, error, None, parent, trace_id))
         self.gcs.add_task_events(events)
 
     def shutdown(self) -> None:

@@ -27,6 +27,23 @@ from ray_tpu.core.gcs import Bundle, Gcs, NodeRecord, PlacementGroupRecord
 from ray_tpu.core.ids import NodeID, PlacementGroupID
 from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
 from ray_tpu.exceptions import PlacementGroupUnschedulableError
+from ray_tpu.util.metrics import Gauge, Histogram
+
+# Built-in scheduler instrumentation (reference: the reference exports
+# scheduler stats through the metrics agent). Placement latency is
+# observed at dispatch (TaskManager.mark_dispatched — every dispatch
+# path funnels through it); queue depth is set once per scheduling pass.
+PLACEMENT_LATENCY = Histogram(
+    "ray_tpu_scheduler_placement_latency_seconds",
+    "Time from task submission to dispatch onto a node",
+    boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                30.0])
+QUEUE_DEPTH = Gauge(
+    "ray_tpu_scheduler_queue_depth",
+    "Tasks parked in the scheduler backlog waiting for capacity")
+INFEASIBLE_TASKS = Gauge(
+    "ray_tpu_scheduler_infeasible_tasks",
+    "Tasks whose resource request no node can ever satisfy")
 
 
 def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:

@@ -22,6 +22,24 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.ids import NodeID, ObjectID, TaskID
 from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.util.metrics import Counter, Histogram
+
+# Task lifecycle instrumentation (reference: task events + the
+# dashboard's task metrics): submit→start queueing, worker-measured run
+# time, and submit→finish end-to-end latency, observed on the
+# completion path in runtime._record_execution_events.
+TASK_QUEUE_SECONDS = Histogram(
+    "ray_tpu_task_queue_seconds",
+    "Time from task submission to execution start on a worker")
+TASK_RUN_SECONDS = Histogram(
+    "ray_tpu_task_run_seconds",
+    "Worker-measured task execution time")
+TASK_E2E_SECONDS = Histogram(
+    "ray_tpu_task_e2e_seconds",
+    "Time from task submission to completion reply")
+TASKS_FINISHED = Counter(
+    "ray_tpu_tasks_completed_total",
+    "Tasks completed, by terminal state", tag_keys=("state",))
 
 
 @dataclass
@@ -188,6 +206,15 @@ class TaskManager:
             task = self._pending.get(task_id)
             if task:
                 task.node_id = node_id
+                submitted_at = task.submitted_at
+            else:
+                submitted_at = None
+        if submitted_at is not None:
+            # every dispatch path (fast-dispatch, scheduling loop, burst
+            # grants) funnels through here — ONE observation site for
+            # submit→dispatch placement latency
+            from ray_tpu.core.scheduler import PLACEMENT_LATENCY
+            PLACEMENT_LATENCY.observe(max(0.0, time.time() - submitted_at))
 
     def get_pending(self, task_id: TaskID) -> Optional[PendingTask]:
         with self._lock:
@@ -211,8 +238,10 @@ class TaskManager:
         with self._lock:
             self._pending.pop(task_id, None)
             self.num_finished += 1
+        TASKS_FINISHED.inc(tags={"state": "FINISHED"})
 
     def fail(self, task_id: TaskID, error: Exception) -> None:
+        TASKS_FINISHED.inc(tags={"state": "FAILED"})
         with self._lock:
             task = self._pending.pop(task_id, None)
             self.num_failed += 1

@@ -74,6 +74,13 @@ class TaskSpec:
     # flow arrow in the timeline (reference: span context in TaskSpec,
     # util/tracing/tracing_helper.py)
     parent_task_id: Optional[TaskID] = None
+    # distributed trace context (reference: span context propagated in
+    # the task spec, tracing_helper.py): the submitting context's
+    # trace_id and span_id travel with the spec so the executing worker
+    # re-establishes the trace before user code runs — one trace_id
+    # follows a request through serve hops and nested submissions
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
         """Derived return ObjectIDs (cached — callers must not mutate).
@@ -118,3 +125,6 @@ class TaskEvent:
     # span context propagated in the task spec, tracing_helper.py)
     duration: Optional[float] = None
     parent_task_id: Optional[TaskID] = None
+    # distributed trace this task belongs to (None when submitted with
+    # no active trace context and task-level root minting disabled)
+    trace_id: Optional[str] = None

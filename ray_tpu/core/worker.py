@@ -483,9 +483,27 @@ def _pack_error(spec: TaskSpec, reply: dict) -> dict:
     return reply
 
 
+def _enter_trace(spec: TaskSpec):
+    """Re-establish the submitter's trace context for this task's
+    execution: the task itself is a span (id derived from the task id),
+    so nested ``.remote()`` calls and ``tracing.span()`` blocks inside
+    user code attach to the same trace. Returns the reset token."""
+    from ray_tpu.util import tracing
+    if spec.trace_id is None:
+        return tracing.set_trace_context(None)
+    return tracing.set_trace_context(tracing.TraceContext(
+        spec.trace_id, tracing.task_span_id(spec.task_id)))
+
+
+def _exit_trace(token) -> None:
+    from ray_tpu.util import tracing
+    tracing.reset_trace_context(token)
+
+
 def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     """Run one task/actor-task; returns the TASK_DONE message."""
     rt._current_task_id.value = spec.task_id
+    trace_token = _enter_trace(spec)
     reply: dict = {"kind": "TASK_DONE", "task_id": spec.task_id.binary(),
                    "spec_is_actor_creation": spec.is_actor_creation}
     if rt.setup_error is not None:
@@ -518,6 +536,7 @@ def _execute(rt: WorkerRuntime, spec: TaskSpec) -> dict:
         if spans:
             reply["profile"] = spans
         rt._current_task_id.value = None
+        _exit_trace(trace_token)
 
 
 async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
@@ -529,6 +548,7 @@ async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
     import inspect
 
     rt._current_task_id.value = spec.task_id
+    trace_token = _enter_trace(spec)
     reply: dict = {"kind": "TASK_DONE", "task_id": spec.task_id.binary(),
                    "spec_is_actor_creation": False}
     import time as _time
@@ -563,6 +583,7 @@ async def _execute_async(rt: WorkerRuntime, spec: TaskSpec) -> dict:
         if spans:
             reply["profile"] = spans
         rt._current_task_id.value = None
+        _exit_trace(trace_token)
 
 
 def _split_returns(result: Any, num_returns: int) -> List[Any]:

@@ -184,6 +184,11 @@ class DashboardServer:
             from ray_tpu.util.timeline import chrome_trace_events
             return self._send_json(
                 req, chrome_trace_events(self._runtime))
+        if path == "/api/traces":
+            return self._send_json(req, self._trace_index())
+        if path.startswith("/api/traces/"):
+            trace_id = path.rsplit("/", 1)[1]
+            return self._send_json(req, self._trace_detail(trace_id))
         if path == "/api/serve":
             return self._send_json(req, self._serve_status())
         if path == "/api/train":
@@ -273,6 +278,54 @@ class DashboardServer:
             remove_series("ray_tpu_serve_requests_total",
                           {"deployment": name})
         self._prev_serve_tags = serve_tags
+
+    def _trace_index(self):
+        """Recent trace ids with span counts (newest first)."""
+        gcs = self._runtime.gcs
+        out = []
+        for trace_id in gcs.recent_trace_ids(limit=100):
+            out.append({"trace_id": trace_id,
+                        "spans": len(gcs.spans_for_trace(trace_id))})
+        return out
+
+    def _trace_detail(self, trace_id: str):
+        """One distributed trace: recorded spans (proxy/router/replica/
+        engine hops, user tracing.span blocks) merged with the task
+        events carrying this trace_id — every ``.remote()`` made while
+        handling the traced request shows up here."""
+        gcs = self._runtime.gcs
+        spans = []
+        for (tid, span_id, parent_span_id, name, component, t_start,
+             duration, tags) in gcs.spans_for_trace(trace_id):
+            spans.append({
+                "span_id": span_id, "parent_span_id": parent_span_id,
+                "name": name, "component": component,
+                "start": t_start, "duration": duration,
+                "tags": tags or {},
+            })
+        task_events = []
+        from ray_tpu.util.tracing import task_span_id
+        for ev in gcs.events_for_trace(trace_id):
+            task_events.append({
+                "task_id": ev.task_id.hex(),
+                "span_id": task_span_id(ev.task_id),
+                "name": ev.name, "state": ev.state,
+                "timestamp": ev.timestamp, "duration": ev.duration,
+                "node_id": ev.node_id.hex() if ev.node_id else None,
+                "error": ev.error,
+            })
+            if ev.state == "RUNNING" and ev.duration is not None:
+                # a task's execution is a span of the trace too
+                spans.append({
+                    "span_id": task_span_id(ev.task_id),
+                    "parent_span_id": None,
+                    "name": ev.name, "component": "task",
+                    "start": ev.timestamp, "duration": ev.duration,
+                    "tags": {"task_id": ev.task_id.hex()},
+                })
+        spans.sort(key=lambda s: s["start"])
+        return {"trace_id": trace_id, "spans": spans,
+                "task_events": task_events}
 
     def _serve_status(self):
         """Deployment/replica status from the serve controller

@@ -40,6 +40,116 @@ import numpy as np
 from ray_tpu.models.llama import (
     LlamaConfig, llama_decode_step, llama_init, llama_init_cache,
     llama_prefill, llama_verify_step)
+from ray_tpu.util import metrics as _metrics
+
+# --- built-in engine metrics (reference: vLLM engine stats surfaced
+# through serve) ----------------------------------------------------
+# TTFT is observed per request (request-rate — direct record). Step
+# metrics are produced by the stepper hot loop, so they aggregate
+# locally in _MetricsBuffer and flush as ONE batched update per
+# interval — a per-step RPC from a replica worker would serialize the
+# decode loop on the control plane.
+_TTFT_BOUNDS = [0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0]
+_STEP_BOUNDS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 5.0]
+ENGINE_TTFT = _metrics.Histogram(
+    "ray_tpu_engine_ttft_seconds",
+    "Time from request admission to its first emitted token",
+    boundaries=_TTFT_BOUNDS)
+ENGINE_STEP_SECONDS = _metrics.Histogram(
+    "ray_tpu_engine_step_seconds",
+    "Engine step wall time, by phase (prefill-admitting vs pure decode)",
+    boundaries=_STEP_BOUNDS, tag_keys=("phase",))
+ENGINE_TOKEN_SECONDS = _metrics.Histogram(
+    "ray_tpu_engine_token_seconds",
+    "Per-token decode latency (step time per token emitted per slot)",
+    boundaries=_STEP_BOUNDS)
+ENGINE_TOKENS = _metrics.Counter(
+    "ray_tpu_engine_tokens_generated_total",
+    "Tokens emitted by the engine")
+ENGINE_TOKENS_PER_S = _metrics.Gauge(
+    "ray_tpu_engine_tokens_per_second",
+    "Decode throughput over the last metrics flush window")
+ENGINE_OCCUPANCY = _metrics.Gauge(
+    "ray_tpu_engine_batch_occupancy",
+    "Active decode slots (continuous-batching occupancy)")
+ENGINE_WAITING = _metrics.Gauge(
+    "ray_tpu_engine_waiting_requests",
+    "Requests queued for a free decode slot")
+
+
+class _MetricsBuffer:
+    """Local aggregation for stepper-loop metrics: bounded samples per
+    flush window, shipped via ONE metrics.record_batch call (one
+    control-plane RPC from a worker) instead of per-step updates."""
+
+    _SAMPLE_CAP = 64  # histogram samples kept per flush window
+
+    def __init__(self, flush_interval_s: float = 0.5):
+        self.flush_interval_s = flush_interval_s
+        self._last_flush = time.perf_counter()
+        self._step_samples: List[tuple] = []   # (phase, dt)
+        self._token_samples: List[float] = []
+        self._tokens = 0
+        # stats()/flush_metrics() run on request threads concurrently
+        # with the stepper's note_step — cheap uncontended lock
+        self._buf_lock = threading.Lock()
+
+    def note_step(self, phase: str, dt: float, tokens: int,
+                  active: int) -> None:
+        with self._buf_lock:
+            self._tokens += tokens
+            if len(self._step_samples) < self._SAMPLE_CAP:
+                self._step_samples.append((phase, dt))
+            if tokens > 0 and active > 0 \
+                    and len(self._token_samples) < self._SAMPLE_CAP:
+                # per-slot per-token latency: a dense step emits one
+                # token per active slot, so this is just dt; fused
+                # multi-token paths amortize
+                self._token_samples.append(dt * active / tokens)
+
+    def maybe_flush(self, engine, force: bool = False) -> None:
+        now = time.perf_counter()
+        with self._buf_lock:
+            elapsed = now - self._last_flush
+            if not force and elapsed < self.flush_interval_s:
+                return
+            step_samples = self._step_samples
+            token_samples = self._token_samples
+            tokens = self._tokens
+            self._step_samples = []
+            self._token_samples = []
+            self._tokens = 0
+            self._last_flush = now
+        if not step_samples and not tokens and not force:
+            return
+        items = [
+            ("histogram", "ray_tpu_engine_step_seconds", {"phase": ph},
+             dt, _STEP_BOUNDS)
+            for ph, dt in step_samples
+        ]
+        items += [
+            ("histogram", "ray_tpu_engine_token_seconds", {}, dt,
+             _STEP_BOUNDS)
+            for dt in token_samples
+        ]
+        if tokens:
+            items.append(("counter",
+                          "ray_tpu_engine_tokens_generated_total", {},
+                          float(tokens), None))
+        if elapsed > 0:
+            items.append(("gauge", "ray_tpu_engine_tokens_per_second",
+                          {}, tokens / elapsed, None))
+        active = sum(1 for s in engine.slots if s.request is not None)
+        items.append(("gauge", "ray_tpu_engine_batch_occupancy", {},
+                      float(active), None))
+        items.append(("gauge", "ray_tpu_engine_waiting_requests", {},
+                      float(len(engine.waiting)), None))
+        try:
+            _metrics.record_batch(items)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
 
 
 @dataclass
@@ -295,6 +405,8 @@ class ContinuousBatchingEngine:
         self.total_generated = 0
         self._base_key = jax.random.PRNGKey(config.seed)
         self._step_counter = 0
+        self._mbuf = _MetricsBuffer()
+        self._admitted_last_step = 0
         # multi-LoRA bank: slot 0 is the all-zero base adapter, so
         # "no adapter" needs no conditional in the decode program
         self._adapters: Dict[str, int] = {}
@@ -663,12 +775,14 @@ class ContinuousBatchingEngine:
             # adapter raising inside step() would fail_all the replica
         if request.top_k > self.config.max_top_k:
             request.top_k = self.config.max_top_k
+        request._t_submit = time.perf_counter()
         with self._lock:
             self._prefilled_waiting.append(
                 (request, ks, vs, prompt_len, first_token))
         return request
 
     def add_request(self, request: GenerationRequest) -> GenerationRequest:
+        request._t_submit = time.perf_counter()
         self._validate_logit_bias(request.logit_bias)
         self._validate_guided(request)
         limit = self._pos_limit
@@ -950,6 +1064,7 @@ class ContinuousBatchingEngine:
                 request = self.waiting.pop(0)
                 slot = free[0]
                 slot.request = request
+            self._admitted_last_step += 1
             ids = request.prompt_ids
             self._install_bias(request, slot.index)
             C = self.config.chunked_prefill_tokens
@@ -994,6 +1109,15 @@ class ContinuousBatchingEngine:
             return
         request.output_ids.append(token)
         self.total_generated += 1
+        if len(request.output_ids) == 1:
+            t_submit = getattr(request, "_t_submit", None)
+            if t_submit is not None:
+                # per-request, not per-step: direct record is fine
+                try:
+                    ENGINE_TTFT.observe(
+                        max(0.0, time.perf_counter() - t_submit))
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
         if request.logprobs is not None and slot.pending_lp is not None:
             chosen, top_vals, top_ids = slot.pending_lp
             k = min(request.logprobs, len(top_ids))
@@ -1173,7 +1297,30 @@ class ContinuousBatchingEngine:
 
     def step(self) -> int:
         """Admit + one whole-batch decode step (sampling fused on
-        device — only [B] token ids come back). Returns #active slots."""
+        device — only [B] token ids come back). Returns #active slots.
+
+        Instrumented wrapper: step wall time (phase-tagged prefill vs
+        decode), tokens/sec, and batch occupancy accumulate in the
+        local buffer and flush as one batched metrics update."""
+        t0 = time.perf_counter()
+        tokens_before = self.total_generated
+        self._admitted_last_step = 0
+        handled = self._step_impl()
+        dt = time.perf_counter() - t0
+        emitted = self.total_generated - tokens_before
+        phase = ("prefill" if self._admitted_last_step
+                 or any(s.request is not None and s.prefilling
+                        for s in self.slots)
+                 else "decode")
+        self._mbuf.note_step(phase, dt, emitted, handled)
+        self._mbuf.maybe_flush(self)
+        return handled
+
+    def flush_metrics(self) -> None:
+        """Force the buffered step metrics out (tests / shutdown)."""
+        self._mbuf.maybe_flush(self, force=True)
+
+    def _step_impl(self) -> int:
         self._admit()
         # guided slots: re-sync device bias rows with automaton states
         # advanced by the previous step's emissions (one [V] row upload
@@ -1380,6 +1527,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(len(ids), jnp.int32)))
 
     def stats(self) -> Dict[str, Any]:
+        self._mbuf.maybe_flush(self, force=True)
         with self._lock:
             out = {
                 "waiting": len(self.waiting),

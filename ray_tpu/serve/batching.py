@@ -16,6 +16,21 @@ import queue
 import threading
 from typing import Any, Callable, List, Optional
 
+from ray_tpu.util.metrics import Histogram
+
+# How full batches actually run (reference: serve batching metrics).
+# On TPU replicas this is the realized MXU batch width — the first
+# thing to check when throughput is below the roofline.
+BATCH_SIZE = Histogram(
+    "ray_tpu_serve_batch_size",
+    "Realized @serve.batch batch sizes", tag_keys=("fn",),
+    boundaries=[1, 2, 4, 8, 16, 32, 64, 128])
+BATCH_WAIT = Histogram(
+    "ray_tpu_serve_batch_wait_seconds",
+    "Time one batch spent accumulating before execution",
+    tag_keys=("fn",),
+    boundaries=[0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0])
+
 
 class _Pending:
     __slots__ = ("item", "event", "result", "error")
@@ -29,8 +44,10 @@ class _Pending:
 
 class _Batcher:
     def __init__(self, fn: Callable[[List[Any]], List[Any]],
-                 max_batch_size: int, batch_wait_timeout_s: float):
+                 max_batch_size: int, batch_wait_timeout_s: float,
+                 name: str = "batch"):
         self.fn = fn
+        self.name = name
         self.max_batch_size = max_batch_size
         self.timeout_s = batch_wait_timeout_s
         self.queue: "queue.Queue[_Pending]" = queue.Queue()
@@ -45,14 +62,19 @@ class _Batcher:
                 self._thread.start()
 
     def _loop(self) -> None:
+        import time
         while True:
             batch = [self.queue.get()]
+            t0 = time.perf_counter()
             # Give the batch a window to fill (the MXU wants width).
             while len(batch) < self.max_batch_size:
                 try:
                     batch.append(self.queue.get(timeout=self.timeout_s))
                 except queue.Empty:
                     break
+            BATCH_SIZE.observe(float(len(batch)), tags={"fn": self.name})
+            BATCH_WAIT.observe(time.perf_counter() - t0,
+                               tags={"fn": self.name})
             try:
                 results = self.fn([p.item for p in batch])
                 if results is None or len(results) != len(batch):
@@ -87,11 +109,13 @@ _state_lock = threading.Lock()
 _batchers: dict = {}  # (wrapper key, owner key) -> _Batcher
 
 
-def _submit(key, call, item, max_batch_size, batch_wait_timeout_s):
+def _submit(key, call, item, max_batch_size, batch_wait_timeout_s,
+            name="batch"):
     with _state_lock:
         b = _batchers.get(key)
         if b is None:
-            b = _Batcher(call, max_batch_size, batch_wait_timeout_s)
+            b = _Batcher(call, max_batch_size, batch_wait_timeout_s,
+                         name=name)
             _batchers[key] = b
     return b.submit(item)
 
@@ -118,7 +142,8 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                     "@serve.batch functions take exactly one request "
                     "argument")
             return _b._submit(key, call, item, max_batch_size,
-                              batch_wait_timeout_s)
+                              batch_wait_timeout_s,
+                              name=getattr(fn, "__qualname__", "batch"))
 
         return wrapper
 

@@ -72,22 +72,27 @@ class DeploymentResponse:
 
     def __init__(self, router: Router, method_name: str, args_blob: bytes,
                  replica_id: str, ref):
+        import time
         self._router = router
         self._method_name = method_name
         self._args_blob = args_blob
         self._replica_id = replica_id
         self._ref = ref
+        self._t_submit = time.monotonic()
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
+        import time
         try:
             value = ray_tpu.get(self._ref, timeout=timeout_s)
         except ray_tpu.exceptions.ActorError:
             return self._router.fetch(self._method_name, self._args_blob,
                                       timeout_s)
         if isinstance(value, Rejected):
-            # Chosen replica was saturated — re-route with backoff.
+            # Chosen replica was saturated — re-route with backoff
+            # (fetch records its own latency observation).
             return self._router.fetch(self._method_name, self._args_blob,
                                       timeout_s)
+        self._router.observe_latency(time.monotonic() - self._t_submit)
         return value
 
 

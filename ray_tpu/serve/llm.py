@@ -559,17 +559,22 @@ class LLMServer:
                 return list(pool.map(
                     lambda _: self._generate(prompt, **kwargs),
                     range(n)))
-        admitted = [self._make_request(
-            prompt, max_tokens=kwargs["max_tokens"],
-            temperature=kwargs["temperature"], top_k=kwargs["top_k"],
-            adapter=kwargs["adapter"], logit_bias=kwargs["logit_bias"],
-            guided=kwargs["guided"],
-            presence_penalty=kwargs["presence_penalty"],
-            frequency_penalty=kwargs["frequency_penalty"],
-            logprobs=kwargs["logprobs"])
-            for _ in range(n)]
-        while not all(r.done for _, r in admitted):
-            time.sleep(0.001)
+        from ray_tpu.util import tracing
+        with tracing.span("engine_generate_n", component="llm.engine",
+                          tags={"model": self.config.model_id,
+                                "n": str(n)}):
+            admitted = [self._make_request(
+                prompt, max_tokens=kwargs["max_tokens"],
+                temperature=kwargs["temperature"], top_k=kwargs["top_k"],
+                adapter=kwargs["adapter"],
+                logit_bias=kwargs["logit_bias"],
+                guided=kwargs["guided"],
+                presence_penalty=kwargs["presence_penalty"],
+                frequency_penalty=kwargs["frequency_penalty"],
+                logprobs=kwargs["logprobs"])
+                for _ in range(n)]
+            while not all(r.done for _, r in admitted):
+                time.sleep(0.001)
         results = []
         for ids, r in admitted:
             if r.error is not None:
@@ -644,13 +649,16 @@ class LLMServer:
                 guided=guided, presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty, logprobs=logprobs,
                 stop=stop)
-        ids, request = self._make_request(
-            prompt, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-            guided=guided, presence_penalty=presence_penalty,
-            frequency_penalty=frequency_penalty, logprobs=logprobs)
-        while not request.done:
-            time.sleep(0.001)
+        from ray_tpu.util import tracing
+        with tracing.span("engine_generate", component="llm.engine",
+                          tags={"model": self.config.model_id}):
+            ids, request = self._make_request(
+                prompt, max_tokens=max_tokens, temperature=temperature,
+                top_k=top_k, adapter=adapter, logit_bias=logit_bias,
+                guided=guided, presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty, logprobs=logprobs)
+            while not request.done:
+                time.sleep(0.001)
         if request.error is not None:
             raise RuntimeError(request.error)
         out_ids = [i for i in request.output_ids
@@ -686,22 +694,25 @@ class LLMServer:
         after the fact."""
         import queue
 
-        ids, request = self._make_request(
-            prompt, max_tokens=max_tokens, temperature=temperature,
-            top_k=top_k, adapter=adapter, logit_bias=logit_bias,
-            guided=guided, presence_penalty=presence_penalty,
-            frequency_penalty=frequency_penalty, logprobs=logprobs,
-            stream_queue=queue.Queue())
-        text = ""
-        hit = False
-        for delta in stream_text_deltas(self.tokenizer, request):
-            text += delta
-            cuts = [text.find(s) for s in stop if s in text]
-            if cuts:
-                text = text[:min(cuts)]
-                hit = True
-                self.engine.cancel(request, "stop")
-                break
+        from ray_tpu.util import tracing
+        with tracing.span("engine_generate", component="llm.engine",
+                          tags={"model": self.config.model_id}):
+            ids, request = self._make_request(
+                prompt, max_tokens=max_tokens, temperature=temperature,
+                top_k=top_k, adapter=adapter, logit_bias=logit_bias,
+                guided=guided, presence_penalty=presence_penalty,
+                frequency_penalty=frequency_penalty, logprobs=logprobs,
+                stream_queue=queue.Queue())
+            text = ""
+            hit = False
+            for delta in stream_text_deltas(self.tokenizer, request):
+                text += delta
+                cuts = [text.find(s) for s in stop if s in text]
+                if cuts:
+                    text = text[:min(cuts)]
+                    hit = True
+                    self.engine.cancel(request, "stop")
+                    break
         result = {
             "text": text,
             "prompt_tokens": len(ids),

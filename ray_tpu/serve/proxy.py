@@ -18,6 +18,17 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Counter, Histogram
+
+PROXY_REQUESTS = Counter(
+    "ray_tpu_serve_proxy_requests_total",
+    "HTTP requests through the serve proxy, by deployment and outcome",
+    tag_keys=("deployment", "outcome"))
+PROXY_LATENCY = Histogram(
+    "ray_tpu_serve_proxy_latency_seconds",
+    "Proxy-measured end-to-end HTTP request latency",
+    tag_keys=("deployment",))
 
 
 class _ProxyState:
@@ -60,16 +71,43 @@ def _make_handler(state: _ProxyState):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            self._send_traceparent()
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_traceparent(self) -> None:
+            # Echo the request's trace so clients can retrieve the
+            # distributed trace via /api/traces/<trace_id> — including
+            # traces the proxy minted for header-less requests.
+            ctx = getattr(self, "_trace_ctx", None)
+            if ctx is not None:
+                self.send_header("traceparent",
+                                 tracing.format_traceparent(ctx))
+
         def _handle(self, body: Optional[dict]) -> None:
+            # W3C trace context: continue the client's trace when a
+            # valid traceparent header arrives, else mint a fresh root.
+            # Everything downstream (router pick, replica execution,
+            # nested .remote() calls, engine work) rides this context.
+            parent_ctx = tracing.parse_traceparent(
+                self.headers.get("traceparent"))
+            with tracing.span("http_request", component="serve.proxy",
+                              tags={"path": self.path.split("?")[0]},
+                              parent=parent_ctx) as ctx:
+                self._trace_ctx = ctx
+                self._handle_traced(body)
+
+        def _handle_traced(self, body: Optional[dict]) -> None:
+            import time as _time
+            t0 = _time.perf_counter()
             parsed = urllib.parse.urlparse(self.path)
             match = state.match(parsed.path)
             if match is None:
                 state.refresh()
                 match = state.match(parsed.path)
             if match is None:
+                PROXY_REQUESTS.inc(tags={"deployment": "<no-route>",
+                                         "outcome": "404"})
                 self._respond(404, {"error": f"no route for {parsed.path}"})
                 return
             dep, rest = match
@@ -109,17 +147,28 @@ def _make_handler(state: _ProxyState):
                         value = dict(value)
                         code = int(value.pop("__status__"))
                     self._respond(code, value)
+                    PROXY_REQUESTS.inc(tags={"deployment": dep,
+                                             "outcome": str(code)})
+                    PROXY_LATENCY.observe(_time.perf_counter() - t0,
+                                          tags={"deployment": dep})
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Cache-Control", "no-cache")
                 self.send_header("Connection", "close")
+                self._send_traceparent()
                 self.end_headers()
                 streaming_started = True
                 self._write_chunk(value)
                 for _kind, chunk in gen:
                     self._write_chunk(chunk)
+                PROXY_REQUESTS.inc(tags={"deployment": dep,
+                                         "outcome": "200"})
+                PROXY_LATENCY.observe(_time.perf_counter() - t0,
+                                      tags={"deployment": dep})
             except Exception as e:  # noqa: BLE001 — surface as 500
+                PROXY_REQUESTS.inc(tags={"deployment": dep,
+                                         "outcome": "error"})
                 if streaming_started:
                     return  # headers sent: a clean close, never a second
                            # status line into the SSE body

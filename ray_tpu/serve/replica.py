@@ -15,6 +15,25 @@ import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.core import serialization
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# Replica-side instrumentation (reference: replica request metrics
+# consumed by autoscaling + the dashboard). Updates are forwarded
+# worker→driver through the control plane — request-rate, not hot-loop.
+REPLICA_REQUESTS = Counter(
+    "ray_tpu_serve_replica_requests_total",
+    "Requests executed on replicas, by deployment and outcome",
+    tag_keys=("deployment", "outcome"))
+REPLICA_LATENCY = Histogram(
+    "ray_tpu_serve_replica_request_seconds",
+    "Replica-measured request execution time", tag_keys=("deployment",),
+    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0])
+REPLICA_ONGOING = Gauge(
+    "ray_tpu_serve_replica_ongoing_requests",
+    "In-flight requests on one replica",
+    tag_keys=("deployment", "replica"))
 
 
 class Rejected:
@@ -56,24 +75,49 @@ class Replica:
     def handle_request(self, method_name: str, args_blob: bytes) -> Any:
         with self._lock:
             if self._ongoing >= self.max_ongoing:
+                REPLICA_REQUESTS.inc(
+                    tags={"deployment": self.deployment_name,
+                          "outcome": "rejected"})
                 return Rejected()
             self._ongoing += 1
             self._total += 1
+        t0 = time.perf_counter()
+        outcome = "ok"
         try:
-            args, kwargs = serialization.loads(args_blob)
-            fn = getattr(self.callable, method_name, self.callable)
-            result = fn(*args, **kwargs)
-            import inspect
-            if inspect.iscoroutine(result):
-                import asyncio
-                result = asyncio.run(result)
-            return result
+            with tracing.span("handle_request",
+                              component="serve.replica",
+                              tags={"deployment": self.deployment_name,
+                                    "replica": self.replica_id,
+                                    "method": method_name}):
+                args, kwargs = serialization.loads(args_blob)
+                fn = getattr(self.callable, method_name, self.callable)
+                result = fn(*args, **kwargs)
+                import inspect
+                if inspect.iscoroutine(result):
+                    import asyncio
+                    result = asyncio.run(result)
+                return result
+        except BaseException:
+            outcome = "error"
+            raise
         finally:
             with self._lock:
                 self._ongoing -= 1
+                ongoing = self._ongoing
                 self._metric_samples.append((time.monotonic(), self._ongoing))
                 if len(self._metric_samples) > 1000:
                     self._metric_samples = self._metric_samples[-500:]
+            self._report_request_metrics(outcome,
+                                         time.perf_counter() - t0,
+                                         ongoing)
+
+    def _report_request_metrics(self, outcome: str, seconds: float,
+                                ongoing: int) -> None:
+        tags = {"deployment": self.deployment_name}
+        REPLICA_REQUESTS.inc(tags={**tags, "outcome": outcome})
+        REPLICA_LATENCY.observe(seconds, tags=tags)
+        REPLICA_ONGOING.set(float(ongoing),
+                            tags={**tags, "replica": self.replica_id})
 
     def handle_request_streaming(self, method_name: str, args_blob: bytes):
         """Streaming request path (called with num_returns="streaming";
@@ -95,42 +139,59 @@ class Replica:
         if not admitted:
             # yield OUTSIDE the lock: a generator suspension while
             # holding it would block every other request thread.
+            REPLICA_REQUESTS.inc(
+                tags={"deployment": self.deployment_name,
+                      "outcome": "rejected"})
             yield {"type": "rejected"}
             return
+        t0 = time.perf_counter()
+        outcome = "ok"
         try:
-            args, kwargs = serialization.loads(args_blob)
-            fn = getattr(self.callable, method_name, self.callable)
-            result = fn(*args, **kwargs)
-            if inspect.iscoroutine(result):
-                import asyncio
-                result = asyncio.run(result)
-            if inspect.isgenerator(result):
-                yield {"type": "stream"}
-                for chunk in result:
-                    yield {"type": "chunk", "data": chunk}
-            elif inspect.isasyncgen(result):
-                import asyncio
-
-                yield {"type": "stream"}
-                loop = asyncio.new_event_loop()
-                try:
-                    while True:
-                        try:
-                            chunk = loop.run_until_complete(
-                                result.__anext__())
-                        except StopAsyncIteration:
-                            break
+            with tracing.span("handle_request_streaming",
+                              component="serve.replica",
+                              tags={"deployment": self.deployment_name,
+                                    "replica": self.replica_id,
+                                    "method": method_name}):
+                args, kwargs = serialization.loads(args_blob)
+                fn = getattr(self.callable, method_name, self.callable)
+                result = fn(*args, **kwargs)
+                if inspect.iscoroutine(result):
+                    import asyncio
+                    result = asyncio.run(result)
+                if inspect.isgenerator(result):
+                    yield {"type": "stream"}
+                    for chunk in result:
                         yield {"type": "chunk", "data": chunk}
-                finally:
-                    loop.close()
-            else:
-                yield {"type": "single", "data": result}
+                elif inspect.isasyncgen(result):
+                    import asyncio
+
+                    yield {"type": "stream"}
+                    loop = asyncio.new_event_loop()
+                    try:
+                        while True:
+                            try:
+                                chunk = loop.run_until_complete(
+                                    result.__anext__())
+                            except StopAsyncIteration:
+                                break
+                            yield {"type": "chunk", "data": chunk}
+                    finally:
+                        loop.close()
+                else:
+                    yield {"type": "single", "data": result}
+        except BaseException:
+            outcome = "error"
+            raise
         finally:
             with self._lock:
                 self._ongoing -= 1
+                ongoing = self._ongoing
                 self._metric_samples.append((time.monotonic(), self._ongoing))
                 if len(self._metric_samples) > 1000:
                     self._metric_samples = self._metric_samples[-500:]
+            self._report_request_metrics(outcome,
+                                         time.perf_counter() - t0,
+                                         ongoing)
 
     # -- router/controller probes --
 

@@ -16,8 +16,33 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu.serve.replica import Rejected
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Counter, Histogram
 
 _PROBE_CACHE_S = 0.1
+
+# Per-deployment router instrumentation (reference: serve request
+# metrics surfaced for autoscaling + dashboards). Queue wait is the
+# admission delay a request spends being rejected/re-routed before a
+# replica accepts it.
+ROUTER_REQUESTS = Counter(
+    "ray_tpu_serve_router_requests_total",
+    "Requests routed, by deployment", tag_keys=("deployment",))
+ROUTER_REJECTIONS = Counter(
+    "ray_tpu_serve_router_rejections_total",
+    "Replica rejections seen while routing", tag_keys=("deployment",))
+REQUEST_LATENCY = Histogram(
+    "ray_tpu_serve_request_latency_seconds",
+    "End-to-end request latency through the router",
+    tag_keys=("deployment",),
+    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0])
+QUEUE_WAIT = Histogram(
+    "ray_tpu_serve_queue_wait_seconds",
+    "Admission delay before a replica accepted the request",
+    tag_keys=("deployment",),
+    boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0])
 
 
 class Router:
@@ -87,8 +112,61 @@ class Router:
     def submit(self, method_name: str, args_blob: bytes):
         """Route once and return (replica_id, ObjectRef); rejection is
         surfaced at get() time and retried by DeploymentResponse."""
-        rid, handle = self.choose(args_blob)
-        return rid, handle.handle_request.remote(method_name, args_blob)
+        ROUTER_REQUESTS.inc(tags={"deployment": self.deployment_name})
+        with tracing.span("route", component="serve.router",
+                          tags={"deployment": self.deployment_name}):
+            rid, handle = self.choose(args_blob)
+            return rid, handle.handle_request.remote(method_name,
+                                                     args_blob)
+
+    def observe_latency(self, seconds: float) -> None:
+        """Record one finished request's latency (called by
+        DeploymentResponse.result, where the handle path's wait ends)."""
+        REQUEST_LATENCY.observe(seconds,
+                                tags={"deployment": self.deployment_name})
+
+    def _admit_stream(self, method_name: str, args_blob: bytes,
+                      item_timeout_s: Optional[float]):
+        """Route a streaming request until a replica admits it; returns
+        (kind, header, item_iterator). Runs under a routing span so the
+        replica's actor task attaches to the request's trace; metrics
+        cover admission (queue wait) and rejections."""
+        t0 = time.monotonic()
+        attempts = 0
+        deadline = t0 + 60.0
+        dep_tags = {"deployment": self.deployment_name}
+        ROUTER_REQUESTS.inc(tags=dep_tags)
+        with tracing.span("route", component="serve.router",
+                          tags=dep_tags):
+            while True:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"streaming request to {self.deployment_name} "
+                        f"not admitted after {attempts} rejected "
+                        "attempts")
+                rid, handle = self.choose(args_blob)
+                it = handle.handle_request_streaming.options(
+                    num_returns="streaming").remote(method_name,
+                                                    args_blob)
+                try:
+                    header = ray_tpu.get(it.next_ready(item_timeout_s),
+                                         timeout=item_timeout_s)
+                except StopIteration:
+                    self._refresh(block=False)
+                    continue
+                except ray_tpu.exceptions.ActorError:
+                    self._refresh(block=False)
+                    continue
+                kind = header.get("type")
+                if kind == "rejected":
+                    attempts += 1
+                    ROUTER_REJECTIONS.inc(tags=dep_tags)
+                    self._qlen_cache.pop(rid, None)
+                    self._reject_penalty[rid] = time.monotonic() + 1.0
+                    time.sleep(min(0.05 * attempts, 0.5))
+                    continue
+                QUEUE_WAIT.observe(time.monotonic() - t0, tags=dep_tags)
+                return t0, kind, header, it
 
     def stream(self, method_name: str, args_blob: bytes,
                item_timeout_s: Optional[float] = None):
@@ -97,35 +175,14 @@ class Router:
         items after the header: a single ("single", value) item, or
         ("chunk", value) items as the handler produces them. Re-routes
         on rejection/replica death before any chunk was consumed."""
-        attempts = 0
-        deadline = time.monotonic() + 60.0
-        while True:
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"streaming request to {self.deployment_name} not "
-                    f"admitted after {attempts} rejected attempts")
-            rid, handle = self.choose(args_blob)
-            it = handle.handle_request_streaming.options(
-                num_returns="streaming").remote(method_name, args_blob)
-            try:
-                header = ray_tpu.get(it.next_ready(item_timeout_s),
-                                     timeout=item_timeout_s)
-            except StopIteration:
-                self._refresh(block=False)
-                continue
-            except ray_tpu.exceptions.ActorError:
-                self._refresh(block=False)
-                continue
-            kind = header.get("type")
-            if kind == "rejected":
-                attempts += 1
-                self._qlen_cache.pop(rid, None)
-                self._reject_penalty[rid] = time.monotonic() + 1.0
-                time.sleep(min(0.05 * attempts, 0.5))
-                continue
-            if kind == "single":
-                yield "single", header.get("data")
-                return
+        t0, kind, header, it = self._admit_stream(
+            method_name, args_blob, item_timeout_s)
+        dep_tags = {"deployment": self.deployment_name}
+        if kind == "single":
+            REQUEST_LATENCY.observe(time.monotonic() - t0, tags=dep_tags)
+            yield "single", header.get("data")
+            return
+        try:
             while True:
                 try:
                     ref = it.next_ready(item_timeout_s)
@@ -133,29 +190,39 @@ class Router:
                     return
                 item = ray_tpu.get(ref, timeout=item_timeout_s)
                 yield "chunk", item.get("data")
+        finally:
+            REQUEST_LATENCY.observe(time.monotonic() - t0, tags=dep_tags)
 
     def fetch(self, method_name: str, args_blob: bytes,
               timeout: Optional[float]) -> Any:
         """Route + get with rejection retries (the blocking path)."""
+        t0 = time.monotonic()
         attempts = 0
-        deadline = (time.monotonic() + timeout) if timeout else None
-        while True:
-            rid, handle = self.choose(args_blob)
-            ref = handle.handle_request.remote(method_name, args_blob)
-            try:
-                remaining = (max(0.001, deadline - time.monotonic())
-                             if deadline else None)
-                result = ray_tpu.get(ref, timeout=remaining)
-            except ray_tpu.exceptions.ActorError:
-                self._refresh(block=False)  # replica died; new set
-                continue
-            if not isinstance(result, Rejected):
-                return result
-            attempts += 1
-            self._qlen_cache.pop(rid, None)
-            self._reject_penalty[rid] = time.monotonic() + 1.0
-            if deadline and time.monotonic() >= deadline:
-                raise TimeoutError(
-                    f"request to {self.deployment_name} timed out "
-                    f"after {attempts} rejected attempts")
-            time.sleep(min(0.05 * attempts, 0.5))
+        deadline = (t0 + timeout) if timeout else None
+        dep_tags = {"deployment": self.deployment_name}
+        ROUTER_REQUESTS.inc(tags=dep_tags)
+        with tracing.span("route", component="serve.router",
+                          tags=dep_tags):
+            while True:
+                rid, handle = self.choose(args_blob)
+                ref = handle.handle_request.remote(method_name, args_blob)
+                try:
+                    remaining = (max(0.001, deadline - time.monotonic())
+                                 if deadline else None)
+                    result = ray_tpu.get(ref, timeout=remaining)
+                except ray_tpu.exceptions.ActorError:
+                    self._refresh(block=False)  # replica died; new set
+                    continue
+                if not isinstance(result, Rejected):
+                    REQUEST_LATENCY.observe(time.monotonic() - t0,
+                                            tags=dep_tags)
+                    return result
+                attempts += 1
+                ROUTER_REJECTIONS.inc(tags=dep_tags)
+                self._qlen_cache.pop(rid, None)
+                self._reject_penalty[rid] = time.monotonic() + 1.0
+                if deadline and time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"request to {self.deployment_name} timed out "
+                        f"after {attempts} rejected attempts")
+                time.sleep(min(0.05 * attempts, 0.5))

@@ -13,6 +13,25 @@ import threading
 from typing import Any, Dict, Iterable, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.util.metrics import Gauge
+
+# Train-loop instrumentation (reference: Podracer-style TPU training
+# leans on step-time + duty-cycle visibility; PAPERS.md). Step time is
+# the interval between successive report() calls; MFU is estimated when
+# the loop reports its per-step flops (``flops_per_step``) and a peak
+# is known (``peak_flops_per_s`` in the report, or the
+# RTPU_PEAK_FLOPS_PER_S env var on the worker).
+TRAIN_STEP_SECONDS = Gauge(
+    "ray_tpu_train_step_seconds",
+    "Wall time between successive train.report() calls",
+    tag_keys=("run", "rank"))
+TRAIN_MFU = Gauge(
+    "ray_tpu_train_mfu_ratio",
+    "Estimated model flops utilization (0-1)",
+    tag_keys=("run", "rank"))
+TRAIN_REPORTED_STEPS = Gauge(
+    "ray_tpu_train_reported_steps",
+    "report() calls seen this run", tag_keys=("run", "rank"))
 
 
 class TrainContext:
@@ -89,6 +108,30 @@ def report(metrics: Dict[str, Any],
             pass
     with ctx._lock:
         ctx.reported.append((dict(metrics), persisted))
+        n_reports = len(ctx.reported)
+        prev = getattr(ctx, "_last_report_t", None)
+        now = time.perf_counter()
+        ctx._last_report_t = now
+    try:
+        tags = {"run": ctx.get_experiment_name(),
+                "rank": str(ctx.world_rank)}
+        TRAIN_REPORTED_STEPS.set(float(n_reports), tags=tags)
+        if prev is not None and now > prev:
+            step_s = now - prev
+            TRAIN_STEP_SECONDS.set(step_s, tags=tags)
+            # estimated MFU: either reported directly, or derived from
+            # flops_per_step against the hardware peak
+            mfu = metrics.get("mfu")
+            if mfu is None:
+                flops = metrics.get("flops_per_step")
+                peak = metrics.get("peak_flops_per_s") or float(
+                    os.environ.get("RTPU_PEAK_FLOPS_PER_S", 0) or 0)
+                if flops and peak:
+                    mfu = float(flops) / (step_s * float(peak))
+            if mfu is not None:
+                TRAIN_MFU.set(min(max(float(mfu), 0.0), 1.0), tags=tags)
+    except Exception:  # noqa: BLE001 — observability must not fail a run
+        pass
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

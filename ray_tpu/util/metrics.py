@@ -37,32 +37,52 @@ class _Registry:
     def apply(self, kind: str, name: str, tags: Tuple, value: float,
               boundaries: Optional[Sequence[float]] = None) -> None:
         with self.lock:
-            key = (name, tags)
-            if kind == "counter":
-                self.counters[key] = self.counters.get(key, 0.0) + value
-            elif kind == "gauge":
-                self.gauges[key] = value
-            elif kind == "histogram":
-                entry = self.histograms.get(key)
-                if entry is None:
-                    bounds = list(boundaries or _DEFAULT_BOUNDARIES)
-                    entry = [bounds, [0] * (len(bounds) + 1), 0.0, 0]
-                    self.histograms[key] = entry
-                bounds, buckets, _, _ = entry
-                buckets[bisect.bisect_left(bounds, value)] += 1
-                entry[2] += value
-                entry[3] += 1
+            self._apply_locked(kind, name, tags, value, boundaries)
 
+    def _apply_locked(self, kind: str, name: str, tags: Tuple,
+                      value: float,
+                      boundaries: Optional[Sequence[float]] = None) -> None:
+        key = (name, tags)
+        if kind == "counter":
+            self.counters[key] = self.counters.get(key, 0.0) + value
+        elif kind == "gauge":
+            self.gauges[key] = value
+        elif kind == "histogram":
+            entry = self.histograms.get(key)
+            if entry is None:
+                bounds = list(boundaries or _DEFAULT_BOUNDARIES)
+                entry = [bounds, [0] * (len(bounds) + 1), 0.0, 0]
+                self.histograms[key] = entry
+            bounds, buckets, _, _ = entry
+            buckets[bisect.bisect_left(bounds, value)] += 1
+            entry[2] += value
+            entry[3] += 1
+
+    def apply_batch(self, items) -> None:
+        """Apply many updates under ONE lock acquisition — the flush
+        path for hot-loop producers (e.g. the LLM engine stepper) that
+        aggregate locally instead of paying a lock/RPC per update."""
+        with self.lock:
+            for kind, name, tags, value, boundaries in items:
+                self._apply_locked(kind, name, tuple(tags), value,
+                                   boundaries)
 
     def remove_series(self, name: str, tags: Tuple) -> None:
         """Drop one labeled series (a gauge whose subject — node,
         deployment — no longer exists must stop being exported, or
-        scrapers chart zombie series forever)."""
+        scrapers chart zombie series forever). When the metric's last
+        series goes, its description goes too — a dangling entry would
+        keep exporting a header with no samples."""
         with self.lock:
             key = (name, tags)
             self.counters.pop(key, None)
             self.gauges.pop(key, None)
             self.histograms.pop(key, None)
+            if not any(k[0] == name for table in (self.counters,
+                                                  self.gauges,
+                                                  self.histograms)
+                       for k in table):
+                self.descriptions.pop(name, None)
 
 
 _registry = _Registry()
@@ -85,13 +105,38 @@ def _record(kind: str, name: str, tags: Dict[str, str], value: float,
     _registry.apply(kind, name, tag_items, value, boundaries)
 
 
+def record_batch(items) -> None:
+    """Apply a batch of metric updates in one shot. ``items``: iterable
+    of ``(kind, name, tags_dict, value, boundaries)``. On a worker the
+    whole batch rides ONE control-plane RPC instead of one per update —
+    the flush path for hot loops that aggregate locally."""
+    normalized = [
+        (kind, name, tuple(sorted((tags or {}).items())), value,
+         list(boundaries) if boundaries else None)
+        for kind, name, tags, value, boundaries in items]
+    if not normalized:
+        return
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime_or_none()
+    if rt is not None and not getattr(rt, "is_driver", False):
+        rt.gcs_call("metrics_apply_batch", normalized)
+        return
+    _registry.apply_batch(normalized)
+
+
 class Metric:
     def __init__(self, name: str, description: str = "",
                  tag_keys: Sequence[str] = ()):
         self._name = name
         self._tag_keys = tuple(tag_keys)
         self._default_tags: Dict[str, str] = {}
-        _registry.descriptions[name] = description
+        # Under the registry lock: metrics are defined from arbitrary
+        # threads (serve replicas, train workers) concurrently with
+        # prometheus_text() reads. Don't let a later blank-description
+        # re-registration of the same name clobber a real one.
+        with _registry.lock:
+            if description or name not in _registry.descriptions:
+                _registry.descriptions[name] = description
 
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)
@@ -142,29 +187,53 @@ def _fmt_tags(tags: Tuple, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _esc_help(text: str) -> str:
+    # HELP text escaping per the exposition format: backslash + newline.
+    return str(text).replace("\\", r"\\").replace("\n", r"\n")
+
+
 def prometheus_text() -> str:
-    """Prometheus exposition-format dump of every metric."""
+    """Prometheus exposition-format dump of every metric. ``# HELP`` /
+    ``# TYPE`` headers are emitted once per metric family (not per
+    labeled series — scrapers reject duplicate headers)."""
     reg = _registry
     lines: List[str] = []
+
+    def header(name: str, kind: str) -> None:
+        desc = reg.descriptions.get(name)
+        if desc:
+            lines.append(f"# HELP {name} {_esc_help(desc)}")
+        lines.append(f"# TYPE {name} {kind}")
+
     with reg.lock:
+        last = None
         for (name, tags), value in sorted(reg.counters.items()):
-            lines.append(f"# TYPE {name} counter")
+            if name != last:
+                header(name, "counter")
+                last = name
             lines.append(f"{name}{_fmt_tags(tags)} {value}")
+        last = None
         for (name, tags), value in sorted(reg.gauges.items()):
-            lines.append(f"# TYPE {name} gauge")
+            if name != last:
+                header(name, "gauge")
+                last = name
             lines.append(f"{name}{_fmt_tags(tags)} {value}")
+        last = None
         for (name, tags), (bounds, buckets, total, count) in sorted(
                 reg.histograms.items()):
-            lines.append(f"# TYPE {name} histogram")
+            if name != last:
+                header(name, "histogram")
+                last = name
             cumulative = 0
             for bound, n in zip(bounds, buckets):
                 cumulative += n
-                lines.append(f"{name}_bucket"
-                             f"{_fmt_tags(tags, f'le=\"{bound}\"')} "
+                le = 'le="%s"' % bound
+                lines.append(f"{name}_bucket{_fmt_tags(tags, le)} "
                              f"{cumulative}")
             cumulative += buckets[-1]
-            lines.append(f"{name}_bucket"
-                         f"{_fmt_tags(tags, 'le=\"+Inf\"')} {cumulative}")
+            le_inf = 'le="+Inf"'
+            lines.append(f"{name}_bucket{_fmt_tags(tags, le_inf)} "
+                         f"{cumulative}")
             lines.append(f"{name}_sum{_fmt_tags(tags)} {total}")
             lines.append(f"{name}_count{_fmt_tags(tags)} {count}")
     return "\n".join(lines) + "\n"

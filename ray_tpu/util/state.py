@@ -37,6 +37,7 @@ def list_tasks(limit: int = 1000,
             "node_id": ev.node_id.hex() if ev.node_id else None,
             "error": ev.error,
             "timestamp": ev.timestamp,
+            "trace_id": ev.trace_id,
         }
     rows = sorted(latest.values(), key=lambda r: -r["timestamp"])
     if filters:

@@ -17,13 +17,33 @@ import json
 from typing import Any, Dict, List, Optional
 
 
-def chrome_trace_events(runtime=None) -> List[Dict[str, Any]]:
-    """Build the Chrome trace-event list from the live GCS store."""
+def chrome_trace_events(runtime=None,
+                        trace_id: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
+    """Build the Chrome trace-event list from the live GCS store.
+
+    ``trace_id`` switches to the trace-grouped view: only that trace's
+    task slices are kept, and the distributed spans recorded for it
+    (serve proxy/router/replica hops, engine spans, user
+    ``tracing.span()`` blocks) render as an extra ``trace:<id>`` row —
+    one request's whole journey on one screen."""
     if runtime is None:
         from ray_tpu.core import runtime as runtime_mod
         runtime = runtime_mod.get_runtime()
     events = runtime.gcs.list_task_events(limit=1_000_000)
+    if trace_id is not None:
+        events = [ev for ev in events if ev.trace_id == trace_id]
     out: List[Dict[str, Any]] = []
+    if trace_id is not None:
+        row = f"trace:{trace_id[:8]}"
+        for (_tid, span_id, _parent, name, component, t_start,
+             duration, tags) in runtime.gcs.spans_for_trace(trace_id):
+            out.append({
+                "name": name, "cat": "span", "ph": "X",
+                "ts": t_start * 1e6, "dur": duration * 1e6,
+                "pid": row, "tid": component,
+                "args": {"span_id": span_id, **(tags or {})},
+            })
     # task hex → (RUNNING ts_us, pid, tid) for flow-arrow endpoints
     slices: Dict[str, tuple] = {}
     flow_id = 0
@@ -45,11 +65,14 @@ def chrome_trace_events(runtime=None) -> List[Dict[str, Any]]:
         pid, tid = track(ev)
         ts_us = ev.timestamp * 1e6
         if ev.state == "RUNNING" and ev.duration is not None:
+            args = {"task_id": ev.task_id.hex()}
+            if ev.trace_id is not None:
+                args["trace_id"] = ev.trace_id
             out.append({
                 "name": ev.name, "cat": "task", "ph": "X",
                 "ts": ts_us, "dur": ev.duration * 1e6,
                 "pid": pid, "tid": tid,
-                "args": {"task_id": ev.task_id.hex()},
+                "args": args,
             })
             if ev.parent_task_id is not None:
                 parent = slices.get(ev.parent_task_id.hex())
@@ -79,11 +102,14 @@ def chrome_trace_events(runtime=None) -> List[Dict[str, Any]]:
     return out
 
 
-def timeline(filename: Optional[str] = None, runtime=None):
+def timeline(filename: Optional[str] = None, runtime=None,
+             trace_id: Optional[str] = None):
     """Export the cluster timeline. Returns the event list, and writes
     Chrome trace JSON to ``filename`` when given (open in
-    chrome://tracing or https://ui.perfetto.dev)."""
-    events = chrome_trace_events(runtime)
+    chrome://tracing or https://ui.perfetto.dev). ``trace_id`` narrows
+    the export to one distributed trace, with its serve/engine spans on
+    a dedicated trace row."""
+    events = chrome_trace_events(runtime, trace_id=trace_id)
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

@@ -1,38 +1,194 @@
-"""User-facing tracing: profile spans inside tasks/actors.
+"""Tracing: profile spans, W3C-style trace context, cluster-wide spans.
 
-Capability parity with the reference's profiling hooks
+Capability parity with the reference's profiling + tracing hooks
 (reference: src/ray/core_worker/profile_event.cc ProfileEvent — user
 spans buffered in the TaskEventBuffer and surfaced in `ray timeline`;
-python/ray/util/tracing/tracing_helper.py span propagation).
+python/ray/util/tracing/tracing_helper.py span propagation across
+``.remote()`` boundaries).
+
+Two layers:
+
+1. ``profile(name)`` — a named span inside a task/actor. Spans ship
+   with the task's completion reply (zero extra RPCs), land in the GCS
+   task-event store, and nest on the worker's track in
+   ``ray_tpu.timeline()``. Durations are anchored on
+   ``time.perf_counter()`` (immune to NTP wall-clock steps); the start
+   timestamp stays wall-clock so timeline alignment across processes
+   holds.
+
+2. Distributed trace context — a W3C-traceparent-compatible
+   (``trace_id``, ``span_id``) pair carried in a contextvar. Every
+   ``.remote()`` stamps the active context into the TaskSpec (minting a
+   fresh root when none is active), workers re-establish it before user
+   code runs, and the Serve proxy parses/echoes ``traceparent`` headers
+   — so one ``trace_id`` follows a request across proxy → router →
+   replica → engine hops and any nested tasks. ``span()`` records
+   named spans into the GCS trace store, queryable via
+   ``/api/traces/<trace_id>`` on the dashboard.
 
 Usage inside any task or actor method::
 
-    from ray_tpu.util.tracing import profile
+    from ray_tpu.util.tracing import profile, span
     with profile("load_batch"):
         ...
-
-Spans ship with the task's completion reply (zero extra RPCs), land in
-the GCS task-event store, and appear as nested slices on the worker's
-track in ``ray_tpu.timeline()``.
+    with span("rank_candidates", component="app"):
+        ...
 """
 
 from __future__ import annotations
 
 import time
 from contextlib import contextmanager
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One hop of a distributed trace (W3C trace-context flavored):
+    ``trace_id`` identifies the whole request tree, ``span_id`` the
+    current operation within it."""
+    trace_id: str
+    span_id: str
+
+    def traceparent(self) -> str:
+        return format_traceparent(self)
+
+
+_trace_var: ContextVar[Optional[TraceContext]] = ContextVar(
+    "ray_tpu_trace_context", default=None)
+
+
+def new_trace_id() -> str:
+    """32 lowercase hex chars (W3C traceparent trace-id width)."""
+    import uuid
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    """16 lowercase hex chars (W3C traceparent parent-id width)."""
+    import uuid
+    return uuid.uuid4().hex[:16]
+
+
+def task_span_id(task_id) -> str:
+    """A task's execution IS a span; derive its span id from the task
+    id so task events and recorded spans correlate without an extra
+    field on the wire."""
+    return task_id.hex()[:16]
+
+
+def get_trace_context() -> Optional[TraceContext]:
+    return _trace_var.get()
+
+
+def set_trace_context(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as the current trace context; returns the token
+    for ``reset_trace_context``."""
+    return _trace_var.set(ctx)
+
+
+def reset_trace_context(token) -> None:
+    _trace_var.reset(token)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[TraceContext]:
+    """Parse a W3C ``traceparent`` header (``00-<trace>-<span>-<flags>``).
+    Returns None on absent/malformed input — a bad client header must
+    degrade to a fresh root trace, never a 500."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    _version, trace_id, span_id, _flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16)
+    except ValueError:
+        return None
+    if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None  # all-zero ids are invalid per the spec
+    return TraceContext(trace_id.lower(), span_id.lower())
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    return f"00-{ctx.trace_id}-{ctx.span_id}-01"
+
+
+_UNSET = object()
+
+
+def record_span(name: str, component: str, t_start: float,
+                duration: float, ctx: TraceContext,
+                parent_span_id: Optional[str] = None,
+                tags: Optional[Dict[str, Any]] = None) -> None:
+    """Ship one finished span to the GCS trace store (driver: direct
+    append; worker: one control-plane RPC). Best-effort — tracing must
+    never fail the traced operation."""
+    span_tuple = (ctx.trace_id, ctx.span_id, parent_span_id, str(name),
+                  str(component), t_start, duration,
+                  dict(tags) if tags else None)
+    try:
+        from ray_tpu.core import runtime as runtime_mod
+        rt = runtime_mod.get_runtime_or_none()
+        if rt is None:
+            return
+        if getattr(rt, "is_driver", False):
+            rt.gcs.add_trace_span(span_tuple)
+        else:
+            rt.gcs_call("trace_add_span", span_tuple)
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
+
+
+@contextmanager
+def span(name: str, component: str = "app",
+         tags: Optional[Dict[str, Any]] = None, parent=_UNSET):
+    """Record a named span under the active trace (minting a fresh root
+    trace when none is active). The span becomes the current context for
+    the with-block, so nested spans and ``.remote()`` calls made inside
+    attach as children. Yields the span's TraceContext.
+
+    ``parent``: explicit parent TraceContext (or None to force a new
+    root) — used by ingress points like the Serve proxy that carry the
+    parent in a ``traceparent`` header rather than a contextvar.
+    """
+    parent_ctx = _trace_var.get() if parent is _UNSET else parent
+    ctx = TraceContext(
+        parent_ctx.trace_id if parent_ctx is not None else new_trace_id(),
+        new_span_id())
+    token = _trace_var.set(ctx)
+    wall0 = time.time()
+    p0 = time.perf_counter()
+    try:
+        yield ctx
+    finally:
+        duration = time.perf_counter() - p0
+        _trace_var.reset(token)
+        record_span(name, component, wall0, duration, ctx,
+                    parent_span_id=(parent_ctx.span_id
+                                    if parent_ctx is not None else None),
+                    tags=tags)
 
 
 @contextmanager
 def profile(name: str):
     """Record a named span for the duration of the with-block. No-op
-    outside a worker task (e.g. on the driver)."""
+    outside a worker task (e.g. on the driver). Duration is measured on
+    the monotonic perf_counter clock — an NTP step mid-span shifts the
+    wall-clock anchor, never the duration."""
     from ray_tpu.core import runtime as runtime_mod
     rt = runtime_mod.get_runtime_or_none()
     spans = getattr(rt, "_profile_spans", None) if rt is not None else None
     items = spans.value if spans is not None else None
-    t0 = time.time()
+    wall0 = time.time()
+    p0 = time.perf_counter()
     try:
         yield
     finally:
         if items is not None:
-            items.append((str(name), t0, time.time()))
+            items.append((str(name), wall0,
+                          wall0 + (time.perf_counter() - p0)))

@@ -1,0 +1,205 @@
+"""Metrics registry + exposition tests (reference model:
+python/ray/util/metrics + the dashboard metrics agent's Prometheus
+exposition)."""
+
+import re
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    prometheus_text,
+    remove_series,
+)
+
+
+def _series(text):
+    """Parse exposition text into {series_line_key: float}."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or " " not in line:
+            continue
+        key, value = line.rsplit(" ", 1)
+        out[key] = float(value)
+    return out
+
+
+def test_histogram_bucket_math_and_headers():
+    h = Histogram("ray_tpu_test_hist_seconds",
+                  "A test histogram", boundaries=[0.1, 1.0, 10.0],
+                  tag_keys=("op",))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v, tags={"op": "x"})
+    text = prometheus_text()
+    assert "# HELP ray_tpu_test_hist_seconds A test histogram" in text
+    assert "# TYPE ray_tpu_test_hist_seconds histogram" in text
+    # headers once per family, not per series
+    assert text.count("# TYPE ray_tpu_test_hist_seconds histogram") == 1
+    s = _series(text)
+    name = "ray_tpu_test_hist_seconds"
+    # cumulative le buckets: 0.1 -> 1 | 1.0 -> 3 | 10.0 -> 4 | +Inf -> 5
+    assert s[f'{name}_bucket{{op="x",le="0.1"}}'] == 1
+    assert s[f'{name}_bucket{{op="x",le="1.0"}}'] == 3
+    assert s[f'{name}_bucket{{op="x",le="10.0"}}'] == 4
+    assert s[f'{name}_bucket{{op="x",le="+Inf"}}'] == 5
+    assert s[f'{name}_count{{op="x"}}'] == 5
+    assert s[f'{name}_sum{{op="x"}}'] == pytest.approx(56.05)
+    remove_series(name, {"op": "x"})
+
+
+def test_boundary_value_lands_in_its_bucket():
+    # Prometheus buckets are le (inclusive upper bound): an observation
+    # exactly on a boundary counts in that boundary's bucket.
+    h = Histogram("ray_tpu_test_edge_seconds", "edge",
+                  boundaries=[1.0, 2.0])
+    h.observe(1.0)
+    s = _series(prometheus_text())
+    assert s['ray_tpu_test_edge_seconds_bucket{le="1.0"}'] == 1
+    remove_series("ray_tpu_test_edge_seconds", {})
+
+
+def test_label_escaping():
+    g = Gauge("ray_tpu_test_escape", "escapes", tag_keys=("k",))
+    g.set(1.0, tags={"k": 'a\\b"c\nd'})
+    text = prometheus_text()
+    line = next(l for l in text.splitlines()
+                if l.startswith("ray_tpu_test_escape{"))
+    assert r'a\\b' in line and r'\"c' in line and r'\nd' in line
+    assert "\n" not in line  # the newline itself must be escaped away
+    remove_series("ray_tpu_test_escape", {"k": 'a\\b"c\nd'})
+
+
+def test_remove_series_drops_headers_with_last_series():
+    g = Gauge("ray_tpu_test_zombie", "zombie gauge", tag_keys=("node",))
+    g.set(1.0, tags={"node": "a"})
+    g.set(2.0, tags={"node": "b"})
+    remove_series("ray_tpu_test_zombie", {"node": "a"})
+    text = prometheus_text()
+    # one series left: headers stay
+    assert "# TYPE ray_tpu_test_zombie gauge" in text
+    assert 'ray_tpu_test_zombie{node="b"}' in text
+    remove_series("ray_tpu_test_zombie", {"node": "b"})
+    text = prometheus_text()
+    # last series gone: no dangling HELP/TYPE header
+    assert "ray_tpu_test_zombie" not in text
+
+
+def test_counter_accumulates_and_help_survives_blank_redefinition():
+    c = Counter("ray_tpu_test_counter_total", "counts things")
+    c.inc()
+    c.inc(2.5)
+    # a second definition with no description must not clobber the help
+    Counter("ray_tpu_test_counter_total")
+    text = prometheus_text()
+    assert "# HELP ray_tpu_test_counter_total counts things" in text
+    assert _series(text)["ray_tpu_test_counter_total"] == 3.5
+    remove_series("ray_tpu_test_counter_total", {})
+
+
+def test_worker_to_driver_forwarding(ray_start_regular):
+    @ray_tpu.remote
+    def bump():
+        from ray_tpu.util.metrics import Counter
+        Counter("ray_tpu_test_worker_total", "worker-side counter",
+                tag_keys=("who",)).inc(tags={"who": "w"})
+        return 1
+
+    assert sum(ray_tpu.get([bump.remote() for _ in range(3)])) == 3
+    s = _series(prometheus_text())
+    assert s['ray_tpu_test_worker_total{who="w"}'] == 3
+    remove_series("ray_tpu_test_worker_total", {"who": "w"})
+
+
+def test_record_batch_applies_all_kinds(ray_start_regular):
+    metrics_mod.record_batch([
+        ("counter", "ray_tpu_test_batch_total", {}, 2.0, None),
+        ("gauge", "ray_tpu_test_batch_gauge", {"g": "x"}, 7.0, None),
+        ("histogram", "ray_tpu_test_batch_hist", {}, 0.5, [1.0]),
+    ])
+
+    @ray_tpu.remote
+    def bump():
+        from ray_tpu.util import metrics
+        metrics.record_batch([
+            ("counter", "ray_tpu_test_batch_total", {}, 3.0, None)])
+        return 1
+
+    assert ray_tpu.get(bump.remote()) == 1
+    s = _series(prometheus_text())
+    assert s["ray_tpu_test_batch_total"] == 5.0
+    assert s['ray_tpu_test_batch_gauge{g="x"}'] == 7.0
+    assert s['ray_tpu_test_batch_hist_bucket{le="1.0"}'] == 1
+    for name, tags in (("ray_tpu_test_batch_total", {}),
+                       ("ray_tpu_test_batch_gauge", {"g": "x"}),
+                       ("ray_tpu_test_batch_hist", {})):
+        remove_series(name, tags)
+
+
+# --- instrumentation-drift check (tier-1 CI guard) ---------------------
+
+_NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
+
+# every module that defines built-in metrics at import time
+_INSTRUMENTED_MODULES = [
+    "ray_tpu.core.scheduler",
+    "ray_tpu.core.task_manager",
+    "ray_tpu.core.object_transfer",
+    "ray_tpu.serve.proxy",
+    "ray_tpu.serve.router",
+    "ray_tpu.serve.replica",
+    "ray_tpu.serve.batching",
+    "ray_tpu.train.context",
+    "ray_tpu.llm.engine",
+]
+
+
+def test_metric_naming_convention():
+    """Drift guard: every metric name registered at import time follows
+    the documented ``ray_tpu_``-prefixed snake_case convention — ad-hoc
+    names can't silently accumulate. Runs in a fresh interpreter so
+    user-defined metrics from other tests (which may use any name) do
+    not pollute the import-time registry being checked."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = (
+        "import json, importlib\n"
+        f"mods = {_INSTRUMENTED_MODULES!r}\n"
+        "for m in mods: importlib.import_module(m)\n"
+        "from ray_tpu.util.metrics import _registry\n"
+        "print(json.dumps(sorted(_registry.descriptions)))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    names = json.loads(out.stdout.strip().splitlines()[-1])
+    offenders = [n for n in names if not _NAME_RE.match(n)]
+    assert not offenders, (
+        f"metric names outside the ray_tpu_ convention: {offenders}")
+    # the documented built-ins are actually registered
+    for required in (
+            "ray_tpu_scheduler_placement_latency_seconds",
+            "ray_tpu_scheduler_queue_depth",
+            "ray_tpu_object_transfer_bytes_total",
+            "ray_tpu_task_queue_seconds",
+            "ray_tpu_task_run_seconds",
+            "ray_tpu_task_e2e_seconds",
+            "ray_tpu_serve_router_requests_total",
+            "ray_tpu_serve_request_latency_seconds",
+            "ray_tpu_serve_queue_wait_seconds",
+            "ray_tpu_serve_replica_request_seconds",
+            "ray_tpu_serve_batch_size",
+            "ray_tpu_engine_ttft_seconds",
+            "ray_tpu_engine_step_seconds",
+            "ray_tpu_engine_tokens_generated_total",
+            "ray_tpu_train_step_seconds",
+            "ray_tpu_train_mfu_ratio",
+    ):
+        assert required in names, f"built-in metric missing: {required}"

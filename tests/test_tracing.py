@@ -123,6 +123,92 @@ def test_async_actor_span_and_task_id_isolation(ray_start_regular):
     assert all(len(names) == 1 for names in by_task.values())
 
 
+def test_trace_propagation_across_processes(ray_start_regular):
+    """Driver → task → nested task share ONE trace_id: the context
+    minted (or established) at the driver crosses every .remote()
+    boundary, and the task events in the GCS store carry it."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def child():
+        return tracing.get_trace_context().trace_id
+
+    @ray_tpu.remote
+    def parent():
+        ctx = tracing.get_trace_context()
+        return ctx.trace_id, ray_tpu.get(child.remote())
+
+    with tracing.span("root", component="test") as root:
+        parent_tid, child_tid = ray_tpu.get(parent.remote())
+    assert parent_tid == root.trace_id
+    assert child_tid == root.trace_id
+
+    rt = ray_start_regular
+    events = rt.gcs.events_for_trace(root.trace_id)
+    names = {e.name for e in events if e.state == "RUNNING"}
+    assert any("parent" in n for n in names)
+    assert any("child" in n for n in names)
+    # the root span itself landed in the trace store
+    spans = rt.gcs.spans_for_trace(root.trace_id)
+    assert any(s[3] == "root" for s in spans)
+
+
+def test_tasks_mint_root_traces_by_default(ray_start_regular):
+    """With no active context every submission gets a fresh root
+    trace — nested tasks still join their submitter's trace."""
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def inner():
+        return tracing.get_trace_context().trace_id
+
+    @ray_tpu.remote
+    def outer():
+        return (tracing.get_trace_context().trace_id,
+                ray_tpu.get(inner.remote()))
+
+    assert tracing.get_trace_context() is None
+    a, b = ray_tpu.get(outer.remote())
+    assert a == b and len(a) == 32
+
+
+def test_traceparent_parse_and_format():
+    from ray_tpu.util.tracing import (TraceContext, format_traceparent,
+                                      parse_traceparent)
+
+    ctx = TraceContext("ab" * 16, "cd" * 8)
+    assert format_traceparent(ctx) == f"00-{'ab'*16}-{'cd'*8}-01"
+    assert parse_traceparent(format_traceparent(ctx)) == ctx
+    for bad in (None, "", "garbage", "00-short-span-01",
+                f"00-{'zz'*16}-{'cd'*8}-01",      # non-hex
+                f"00-{'00'*16}-{'cd'*8}-01"):     # all-zero trace id
+        assert parse_traceparent(bad) is None
+
+
+def test_profile_duration_is_monotonic_anchored(monkeypatch):
+    """profile() durations come from perf_counter: a wall-clock step
+    mid-span (NTP) must not corrupt the measured duration."""
+    import ray_tpu.util.tracing as tracing_mod
+
+    real_time = time.time
+    offset = [0.0]
+    monkeypatch.setattr(tracing_mod.time, "time",
+                        lambda: real_time() + offset[0])
+
+    class FakeRT:
+        class _S:
+            value = []
+        _profile_spans = _S()
+
+    monkeypatch.setattr("ray_tpu.core.runtime._runtime", FakeRT())
+    with tracing_mod.profile("stepped"):
+        time.sleep(0.02)
+        offset[0] = -3600.0  # wall clock jumps an hour backwards
+    (name, t0, t1), = FakeRT._profile_spans.value
+    assert name == "stepped"
+    assert 0.015 <= (t1 - t0) < 5.0  # perf_counter duration, not -3600
+
+
 def test_xla_step_profiler(tmp_path):
     import jax
     import jax.numpy as jnp
