@@ -186,6 +186,7 @@ class ActorClass:
         renv = merge_runtime_envs(
             getattr(rt, "current_runtime_env", None),
             self._normalized_env(rt))
+        trace_id, parent_span_id = submitting_trace_context()
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id=class_id,
@@ -204,8 +205,9 @@ class ActorClass:
             actor_name=opts.get("name"),
             runtime_env=renv,
             runtime_env_hash=runtime_env_hash(renv) if renv else "",
+            trace_id=trace_id,
+            parent_span_id=parent_span_id,
         )
-        spec.trace_id, spec.parent_span_id = submitting_trace_context()
         handle = ActorHandle(actor_id, self._cls.__name__, self._method_names)
         name = opts.get("name")
         if rt.is_driver:

@@ -24,7 +24,10 @@ message stream.
 
 from __future__ import annotations
 
+import logging
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
@@ -33,6 +36,8 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.protocol import (
     PROTOCOL_VERSION, MessageConnection, connect_tcp, parse_address)
 from ray_tpu.core.task_manager import ReferenceCounter
+logger = logging.getLogger(__name__)
+
 from ray_tpu.exceptions import (GetTimeoutError, HeadRestartedError,
                                 ObjectLostError)
 
@@ -44,7 +49,7 @@ class _MemStore:
     def __init__(self):
         self._bufs: Dict[ObjectID, bytearray] = {}
         self._sealed: Dict[ObjectID, threading.Event] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("core.client.buffers")
 
     def contains(self, oid: ObjectID) -> bool:
         with self._lock:
@@ -104,7 +109,7 @@ class ClientRuntime:
         self.address = address
         self.namespace = namespace
         self.conn = self._connect()
-        self._req_lock = threading.Lock()
+        self._req_lock = locktrace.traced_lock("core.client.req")
         # ObjectRefs minted before a head restart: the new head never
         # owned them, so gets fail fast with HeadRestartedError
         self._lost_oids: set = set()
@@ -241,11 +246,16 @@ class ClientRuntime:
             try:
                 conn = self._connect()
             except (OSError, ConnectionError):
-                _time.sleep(min(delay, max(0.0, remaining)))
+                # back off on the closed event (not time.sleep) so
+                # close() interrupts the reconnect wait immediately
+                if self._closed.wait(min(delay, max(0.0, remaining))):
+                    return False
                 delay = min(delay * 2, 2.0)
                 continue
-            # every ref minted before the restart is gone for good
-            self._lost_oids.update(
+            # every ref minted before the restart is gone for good.
+            # Single-writer: only the reader thread reconnects, and
+            # set.update is GIL-atomic for the racing readers.
+            self._lost_oids.update(  # graftlint: disable=GL001
                 self.reference_counter.live_object_ids())
             self.conn = conn
             # re-establish server-side pubsub routes for live
@@ -267,8 +277,9 @@ class ClientRuntime:
             except OSError:
                 msg = None
             if msg is None:
-                self._conn_epoch += 1
-                self._connected.clear()
+                # single-writer: the reader thread owns epoch bumps
+                self._conn_epoch += 1  # graftlint: disable=GL001
+                self._connected.clear()  # graftlint: disable=GL001
                 self._fail_inflight()
                 if self._try_reconnect():
                     continue
@@ -279,8 +290,9 @@ class ClientRuntime:
                         msg["channel"], ())):
                     try:
                         cb(serialization.loads(msg["data"]))
-                    except Exception:  # noqa: BLE001 — user callback
-                        pass
+                    except Exception:
+                        logger.exception("pubsub callback failed for "
+                                         "channel %r", msg["channel"])
                 continue
             rid = msg.get("req_id")
             with self._req_lock:
@@ -380,7 +392,11 @@ class ClientRuntime:
                 break
             if deadline is not None and _time.monotonic() >= deadline:
                 break
-            _time.sleep(0.005)
+            # readiness lives on the remote head, so this is a poll
+            # interval, not a local condition — but waiting on _closed
+            # keeps close() from blocking behind it
+            if self._closed.wait(0.005):
+                break
         done = ready[:num_returns]
         return done, ready[num_returns:] + pending
 

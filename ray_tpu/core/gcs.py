@@ -18,6 +18,7 @@ touches the GCS, matching the reference's separation.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import defaultdict, deque
@@ -28,6 +29,8 @@ from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu.core.task_spec import TaskEvent, TaskSpec
 from ray_tpu.exceptions import PlacementGroupUnschedulableError
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -106,8 +109,9 @@ class KVStore:
         for callback in waiters or ():
             try:
                 callback(value)
-            except Exception:  # noqa: BLE001 — one waiter can't break put
-                pass
+            except Exception:
+                # one waiter must not break put() for the others
+                logger.exception("kv waiter callback failed for %r", key)
 
     def add_waiter(self, key: bytes, namespace: str, callback):
         """Register ``callback(value)`` to fire on the next put of the
@@ -196,7 +200,8 @@ class Pubsub:
             try:
                 cb(message)
             except Exception:
-                pass
+                # one subscriber must not break publish for the rest
+                logger.exception("pubsub subscriber failed on %r", channel)
 
 
 class Gcs:

@@ -15,6 +15,8 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 
@@ -26,7 +28,7 @@ class FileStoreClient:
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("core.gcs_store")
         self._state: Dict[str, Dict[Any, Any]] = {}
         if os.path.exists(path):
             self._replay_into_state()
@@ -50,9 +52,10 @@ class FileStoreClient:
             self._append_locked(blob)
 
     def _append_locked(self, blob: bytes) -> None:
+        # caller holds self._lock (the _locked suffix is the contract)
         self._file.write(len(blob).to_bytes(4, "little") + blob)
         self._file.flush()
-        self._ops_since_compact += 1
+        self._ops_since_compact += 1  # graftlint: disable=GL001
         if self._ops_since_compact >= self.COMPACT_EVERY:
             self._compact_locked()
 
@@ -86,10 +89,13 @@ class FileStoreClient:
                     return
 
     def _replay_into_state(self) -> None:
+        # __init__-time replay: single-threaded, nothing else holds a
+        # reference to this store yet
         for record in self._iter_journal():
             op, table, key, value = record
             if op == "put":
-                self._state.setdefault(table, {})[key] = value
+                self._state.setdefault(  # graftlint: disable=GL001
+                    table, {})[key] = value
             elif op == "del":
                 self._state.get(table, {}).pop(key, None)
 

@@ -70,8 +70,8 @@ class ObjectRef:
             if rt is not None:
                 try:
                     rt.reference_counter.remove_local_reference(self._id)
-                except Exception:
-                    pass
+                except Exception:  # graftlint: disable=GL004
+                    pass  # __del__ during interpreter shutdown
 
     def future(self):
         """Return a concurrent.futures.Future resolving to the value."""

@@ -410,8 +410,8 @@ def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
         if created:
             try:
                 dest_store.delete(object_id)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # graftlint: disable=GL004
+                pass  # rollback of a failed pull is best-effort
         return False
     finally:
         if budget is not None and charged:

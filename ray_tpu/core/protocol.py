@@ -17,6 +17,8 @@ import os
 import socket
 import struct
 import threading
+
+from ray_tpu.devtools import locktrace
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -67,7 +69,7 @@ class _RpcChaos:
     def __init__(self, spec: str):
         self.delay_ms: Dict[str, float] = {}
         self.fail_left: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("core.protocol")
         for part in spec.split(";"):
             part = part.strip()
             if not part or "=" not in part:
@@ -260,7 +262,7 @@ class MessageConnection:
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
-        self._send_lock = threading.Lock()
+        self._send_lock = locktrace.traced_lock("core.protocol.send")
 
     def send(self, msg: dict) -> None:
         _maybe_chaos(msg.get("kind"))

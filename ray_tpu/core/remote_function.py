@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import hashlib
 import threading
+
+from ray_tpu.devtools import locktrace
 import weakref
 from typing import Any, Dict, List, Optional
 
@@ -128,7 +130,7 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._fn = fn
         self._options = dict(options or {})
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("core.remote_function")
         self._blob: Optional[bytes] = None
         self._function_id: Optional[str] = None
         self._registered_with = None  # weakref.ref to the runtime

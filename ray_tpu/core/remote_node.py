@@ -20,6 +20,8 @@ same runtime handler calls an in-process node would make.
 from __future__ import annotations
 
 import threading
+
+from ray_tpu.devtools import locktrace
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -78,11 +80,11 @@ class RemoteNode:
         self.idle_workers = 0
         self.store_used = 0
         self._alive = True
-        self._dead_lock = threading.Lock()
+        self._dead_lock = locktrace.traced_lock("core.remote_node.dead")
         # Tasks dispatched to this node and not yet completed; on node
         # death these are retried/failed exactly like worker crashes
         # (the daemon can no longer report them).
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = locktrace.traced_lock("core.remote_node.inflight")
         self._inflight: Dict[TaskID, TaskSpec] = {}
 
     # --- liveness ------------------------------------------------------
@@ -190,7 +192,7 @@ class ClientSession:
         self.node_id = NodeID.from_random()   # identity only; never
         self.worker_id = WorkerID.from_random()  # scheduled onto
         self.held_refs: set = set()
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("core.remote_node")
 
     def send(self, msg: dict) -> bool:
         try:
@@ -288,7 +290,7 @@ class HeadServer:
         # Every accepted connection, so stop() can sever them the way a
         # real head crash would (clients/daemons then observe EOF and
         # run their reconnect paths instead of waiting forever).
-        self._conns_lock = threading.Lock()
+        self._conns_lock = locktrace.traced_lock("core.remote_node.conns")
         self._conns: set = set()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="head-accept", daemon=True)

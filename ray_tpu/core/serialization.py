@@ -234,8 +234,8 @@ def unpack_pinned(src, on_release) -> Any:
             if remaining[0] == 0:
                 try:
                     on_release()
-                except Exception:  # noqa: BLE001 — GC context
-                    pass
+                except Exception:  # graftlint: disable=GL004
+                    pass  # __del__ from GC context
 
     buffers = []
     for size in sizes:
@@ -286,8 +286,8 @@ def _maybe_register_by_value(value: Any) -> None:
         return
     try:
         cloudpickle.register_pickle_by_value(mod)
-    except Exception:
-        pass
+    except Exception:  # graftlint: disable=GL004
+        pass  # optional optimization; plain by-reference pickling works
 
 
 def dumps(value: Any) -> bytes:

@@ -15,6 +15,7 @@ is where per-worker ownership would slot back in.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -23,6 +24,8 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 from ray_tpu.core.ids import NodeID, ObjectID, TaskID
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.util.metrics import Counter, Histogram
+
+logger = logging.getLogger(__name__)
 
 # Task lifecycle instrumentation (reference: task events + the
 # dashboard's task metrics): submit→start queueing, worker-measured run
@@ -94,7 +97,8 @@ class ReferenceCounter:
                 try:
                     self._on_first(object_id)
                 except Exception:
-                    pass
+                    logger.exception("on_first_reference callback "
+                                     "failed for %s", object_id)
 
     def remove_local_reference(self, object_id: ObjectID,
                                defer: Optional[tuple] = None) -> None:
@@ -115,7 +119,8 @@ class ReferenceCounter:
                 try:
                     deleter(object_id)
                 except Exception:
-                    pass
+                    logger.exception("deleter failed for %s; the "
+                                     "object may leak", object_id)
         if deleter is not None and defer is not None:
             delay, schedule = defer
             schedule(delay,
@@ -150,7 +155,8 @@ class ReferenceCounter:
             try:
                 deleter(object_id)
             except Exception:
-                pass
+                logger.exception("deferred deleter failed for %s; the "
+                                 "object may leak", object_id)
 
     def live_object_ids(self) -> List[ObjectID]:
         """Every object id with a nonzero local count (the client's
@@ -297,7 +303,8 @@ class TaskManager:
                 try:
                     cb()
                 except Exception:
-                    pass
+                    logger.exception("ready callback failed for %s",
+                                     object_id)
 
     def is_ready(self, object_id: ObjectID) -> bool:
         with self._lock:

@@ -361,7 +361,10 @@ class WorkerRuntime:
             if blob is None:
                 raise RuntimeError(f"function {function_id} not found in GCS")
             fn = serialization.loads(blob)
-            self._fn_cache[function_id] = fn
+            # benign race: concurrent misses both fetch; last write
+            # wins and both values are identical deserializations.
+            # Taking _req_lock here would serialize GCS fetches.
+            self._fn_cache[function_id] = fn  # graftlint: disable=GL001
         return fn
 
     def put_function(self, function_id: str, blob: bytes) -> None:

@@ -20,6 +20,8 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu.core import serialization
@@ -64,7 +66,7 @@ class TcpChannelListener:
         self.address: Tuple[str, int] = (host,
                                          self._sock.getsockname()[1])
         self._conn: Optional[socket.socket] = None
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("dag.tcp_channel")
 
     def _ensure_accepted(self, timeout: Optional[float]) -> socket.socket:
         with self._lock:
@@ -226,7 +228,7 @@ class TcpChannelWriter:
 # step, adopted by the compiled loop when it starts (both run in the
 # same actor process via __ray_call__)
 _listener_registry: Dict[str, TcpChannelListener] = {}
-_registry_lock = threading.Lock()
+_registry_lock = locktrace.traced_lock("dag.tcp_channel.registry")
 
 
 def create_listener(token: str) -> Tuple[str, int]:

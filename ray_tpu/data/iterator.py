@@ -11,6 +11,8 @@ yields jax arrays staged host->HBM with double buffering.
 from __future__ import annotations
 
 import threading
+
+from ray_tpu.devtools import locktrace
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -186,7 +188,7 @@ class _SplitCoordinator:
         self._make_stream = cloudpickle.loads(plan_blob)
         self.n = n
         self.equal = equal
-        self.lock = threading.Lock()
+        self.lock = locktrace.traced_lock("data.iterator")
         self.queues: List[deque] = [deque() for _ in range(n)]
         self.stream = None
         self.done = False

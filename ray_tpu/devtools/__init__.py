@@ -1,0 +1,7 @@
+"""Developer tooling: framework-aware static analysis (graftlint) and
+runtime concurrency diagnostics (locktrace).
+
+Nothing in this package imports jax or the runtime — it must stay cheap
+to import from CI guards and from production modules that only want a
+lock factory (``locktrace.traced_lock``).
+"""

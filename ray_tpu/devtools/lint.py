@@ -1,0 +1,758 @@
+"""graftlint: an AST rule engine for ray_tpu's thread-based control
+plane.
+
+The control plane guards its shared state with ~70 ``threading.Lock``
+sites; at production scale the bottleneck is silent races and
+deadlocks, not throughput (Podracer, arXiv:2104.06272; MPMD pipeline
+schedulers, arXiv:2412.14374). Generic linters can't see framework
+conventions — which classes own locks, what a TaskSpec must carry,
+what a metric must be named — so this engine ships framework-specific
+rules and grows with the codebase.
+
+Usage::
+
+    python -m ray_tpu.devtools.lint [paths...]
+    python -m ray_tpu.devtools.lint ray_tpu/ --write-baseline
+
+Findings are suppressed three ways:
+
+* per-line: a ``# graftlint: disable=GL004`` comment on the reported
+  line (comma-separate several ids; ``disable=all`` kills every rule);
+* baseline: a checked-in ``graftlint_baseline.json`` grandfathers
+  existing findings by (file, rule, enclosing scope) — line drift
+  does not invalidate it; NEW findings in a scope still fail;
+* ``--select``/``--ignore`` on the command line.
+
+Rules are plain classes in a registry; add one by subclassing
+``Rule`` and decorating with ``@register``.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence
+
+BASELINE_DEFAULT = "graftlint_baseline.json"
+
+# ---------------------------------------------------------------------
+# findings
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str   # posix-style, relative to the scan root when possible
+    line: int
+    col: int
+    message: str
+    scope: str  # enclosing "Class.method" qualname ("<module>" at top)
+
+    @property
+    def key(self) -> str:
+        """Baseline fingerprint: stable across line-number drift."""
+        return f"{self.path}::{self.rule}::{self.scope}"
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+
+# ---------------------------------------------------------------------
+# rule registry
+
+RULES: "Dict[str, Rule]" = {}
+
+
+def register(cls):
+    inst = cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+class Rule:
+    id: str = ""
+    name: str = ""
+    rationale: str = ""
+
+    def check(self, ctx: "FileContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------
+# per-file context: one parse + one annotation pass shared by all rules
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_EVENT_FACTORIES = {"Condition", "Event"}
+_LOCKISH_NAME = re.compile(r"(?:^|_)(?:lock|mutex|cv|cond)(?:$|_)|lock$")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:#|$)")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class FileContext:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = self._parse_suppressions()
+        self._annotate()
+
+    # -- suppression comments -----------------------------------------
+    def _parse_suppressions(self) -> Dict[int, set]:
+        out: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {s.strip().upper() for s in m.group(1).split(",")
+                       if s.strip()}
+                out[i] = ids
+        return out
+
+    def suppressed(self, finding: Finding) -> bool:
+        ids = self.suppressions.get(finding.line)
+        return bool(ids) and (finding.rule in ids or "ALL" in ids)
+
+    # -- annotation pass ----------------------------------------------
+    def _annotate(self) -> None:
+        """Attach to every node: ``_gl_scope`` (Class.method qualname),
+        ``_gl_func`` (innermost function name or None), ``_gl_class``
+        (innermost ClassDef node or None), ``_gl_lockdepth`` (number of
+        enclosing ``with <lock>`` blocks). ClassDef nodes additionally
+        get ``_gl_locks`` / ``_gl_events`` (self-attribute names bound
+        to Lock/RLock/Condition and Condition/Event factories)."""
+        for cls in (n for n in ast.walk(self.tree)
+                    if isinstance(n, ast.ClassDef)):
+            locks, events = set(), set()
+            for sub in ast.walk(cls):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                call = sub.value
+                if not isinstance(call, ast.Call):
+                    continue
+                factory = _dotted(call.func) or ""
+                leaf = factory.rsplit(".", 1)[-1]
+                for target in sub.targets:
+                    attr = _is_self_attr(target)
+                    if attr is None:
+                        continue
+                    if leaf in _LOCK_FACTORIES or \
+                            leaf in ("traced_lock", "traced_rlock"):
+                        locks.add(attr)
+                    if leaf in _EVENT_FACTORIES:
+                        events.add(attr)
+            cls._gl_locks = locks
+            cls._gl_events = events
+
+        def visit(node, scope, func, cls, lockdepth):
+            node._gl_scope = scope
+            node._gl_func = func
+            node._gl_class = cls
+            node._gl_lockdepth = lockdepth
+            if isinstance(node, ast.ClassDef):
+                scope = node.name if scope == "<module>" \
+                    else f"{scope}.{node.name}"
+                cls = node
+                func = None
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = node.name if scope == "<module>" \
+                    else f"{scope}.{node.name}"
+                func = node.name
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                if any(self.is_lock_expr(item.context_expr, cls)
+                       for item in node.items):
+                    lockdepth += 1
+            for child in ast.iter_child_nodes(node):
+                visit(child, scope, func, cls, lockdepth)
+
+        visit(self.tree, "<module>", None, None, 0)
+
+    def is_lock_expr(self, expr: ast.AST, cls) -> bool:
+        """Heuristic: does ``with <expr>:`` acquire a lock? True for
+        self-attributes the class binds to a Lock factory, and for any
+        name/attribute that *looks* like a lock (``_lock``, ``cv``,
+        ``mutex``...)."""
+        attr = _is_self_attr(expr)
+        if attr is not None:
+            if cls is not None and attr in getattr(cls, "_gl_locks", ()):
+                return True
+            return bool(_LOCKISH_NAME.search(attr))
+        if isinstance(expr, ast.Name):
+            return bool(_LOCKISH_NAME.search(expr.id))
+        if isinstance(expr, ast.Attribute):
+            return bool(_LOCKISH_NAME.search(expr.attr))
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=rule, path=self.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message,
+                       scope=getattr(node, "_gl_scope", "<module>"))
+
+
+# ---------------------------------------------------------------------
+# rules
+
+
+_MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "setdefault", "__setitem__",
+}
+
+
+@register
+class UnguardedSharedState(Rule):
+    id = "GL001"
+    name = "unguarded-shared-state"
+    rationale = ("a class that owns a lock mutates self._* state "
+                 "outside any `with <lock>` block — racy once a second "
+                 "thread touches the instance")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            cls = getattr(node, "_gl_class", None)
+            if cls is None or not cls._gl_locks:
+                continue
+            if node._gl_func == "__init__" or node._gl_lockdepth > 0:
+                continue
+            attr = self._mutated_attr(node, cls)
+            if attr is not None:
+                names = sorted(cls._gl_locks)
+                if len(names) > 3:
+                    names = names[:3] + [f"+{len(names) - 3} more"]
+                yield ctx.finding(
+                    self.id, node,
+                    f"mutation of self.{attr} outside the lock "
+                    f"({'/'.join(names)}) this class owns")
+
+    @staticmethod
+    def _mutated_attr(node: ast.AST, cls) -> Optional[str]:
+        def shared(target) -> Optional[str]:
+            attr = _is_self_attr(target)
+            if attr is not None and attr.startswith("_") \
+                    and not attr.startswith("__") \
+                    and attr not in cls._gl_locks:
+                return attr
+            return None
+
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            return shared(node.func.value)
+        if isinstance(node, (ast.Assign, ast.Delete)):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            # read-modify-write on a self attr is racy even for scalars
+            target = node.target
+            if isinstance(target, ast.Subscript):
+                return shared(target.value)
+            return shared(target)
+        else:
+            return None
+        for target in targets:
+            if isinstance(target, ast.Subscript):
+                attr = shared(target.value)
+                if attr is not None:
+                    return attr
+        return None
+
+
+_BLOCKING_EXACT = {"time.sleep", "ray_tpu.get", "subprocess.run",
+                   "subprocess.call", "subprocess.check_call",
+                   "subprocess.check_output", "subprocess.Popen",
+                   "socket.create_connection"}
+_BLOCKING_LEAF = {"sleep", "recv", "recv_into", "accept", "connect",
+                  "gcs_call", "wait_for_nodes"}
+
+
+@register
+class LockHeldAcrossBlockingCall(Rule):
+    id = "GL002"
+    name = "lock-held-across-blocking-call"
+    rationale = ("sleeping / socket IO / subprocess / RPC inside a "
+                 "`with <lock>` body stalls every thread contending "
+                 "for that lock")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or node._gl_lockdepth == 0:
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            leaf = dotted.rsplit(".", 1)[-1]
+            if dotted in _BLOCKING_EXACT or leaf in _BLOCKING_LEAF or \
+                    dotted.startswith("subprocess."):
+                yield ctx.finding(
+                    self.id, node,
+                    f"blocking call {dotted}() while holding a lock")
+
+
+@register
+class BusyWaitLoop(Rule):
+    id = "GL003"
+    name = "busy-wait-polling-loop"
+    rationale = ("`while ...: time.sleep(...)` polling in a class that "
+                 "already owns a Condition/Event — use a real wait "
+                 "instead of burning wakeups and adding latency")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While):
+                continue
+            cls = getattr(node, "_gl_class", None)
+            if cls is None or not cls._gl_events:
+                continue
+            sleeps, waits = False, False
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if dotted.endswith("time.sleep") or dotted == "sleep":
+                    sleeps = True
+                if leaf in ("wait", "wait_for", "get", "join"):
+                    waits = True
+            if sleeps and not waits:
+                yield ctx.finding(
+                    self.id, node,
+                    "busy-wait loop; this class owns "
+                    f"{'/'.join(sorted(cls._gl_events))} — wait on it "
+                    "instead of polling")
+
+
+_LOGGISH = re.compile(r"(?:^|\.)(?:log|logger|logging|warn|warning|"
+                      r"error|exception|debug|info|print_exc|print)")
+
+
+@register
+class SwallowedException(Rule):
+    id = "GL004"
+    name = "swallowed-exception"
+    rationale = ("a bare `except:` or `except Exception: pass` hides "
+                 "real failures; log it or justify the suppression")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if not self._handled(node):
+                    yield ctx.finding(
+                        self.id, node,
+                        "bare `except:` traps SystemExit/"
+                        "KeyboardInterrupt and hides failures")
+                continue
+            broad = isinstance(node.type, ast.Name) and \
+                node.type.id in ("Exception", "BaseException")
+            if broad and self._body_is_silent_pass(node) and \
+                    not self._handled(node):
+                yield ctx.finding(
+                    self.id, node,
+                    f"`except {node.type.id}: pass` swallows the "
+                    "error without logging")
+
+    @staticmethod
+    def _body_is_silent_pass(node: ast.ExceptHandler) -> bool:
+        return all(isinstance(stmt, ast.Pass) or
+                   (isinstance(stmt, ast.Expr) and
+                    isinstance(stmt.value, ast.Constant))
+                   for stmt in node.body)
+
+    @staticmethod
+    def _handled(node: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func)
+                if dotted and _LOGGISH.search(dotted):
+                    return True
+        return False
+
+
+_FORBIDDEN_IMPORTS = ("torch.cuda", "cupy", "nccl", "pynccl", "pycuda",
+                      "pynvml", "cuda")
+
+
+@register
+class ForbiddenBackendImport(Rule):
+    id = "GL005"
+    name = "forbidden-backend-import"
+    rationale = ("CUDA backends are compiled out of this TPU-native "
+                 "build (BASELINE.md); torch.cuda/nccl/cupy must not "
+                 "creep back in")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if self._forbidden(alias.name):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"import of CUDA backend {alias.name!r}")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if self._forbidden(mod):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"import from CUDA backend {mod!r}")
+                elif mod == "torch":
+                    for alias in node.names:
+                        if alias.name == "cuda":
+                            yield ctx.finding(
+                                self.id, node,
+                                "`from torch import cuda` — CUDA is "
+                                "compiled out")
+            elif isinstance(node, ast.Attribute):
+                if _dotted(node) == "torch.cuda":
+                    yield ctx.finding(self.id, node,
+                                      "use of torch.cuda attribute")
+
+    @staticmethod
+    def _forbidden(module: str) -> bool:
+        return any(module == root or module.startswith(root + ".")
+                   for root in _FORBIDDEN_IMPORTS)
+
+
+_METRIC_NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
+# Unit/kind suffixes accepted per metric type. Counters are cumulative
+# and must say so (_total); histograms measure a unit; gauges may also
+# be dimensionless levels (_depth, _ratio, _requests...).
+_METRIC_SUFFIXES = {
+    "Counter": ("_total",),
+    "Histogram": ("_seconds", "_bytes", "_size", "_tokens", "_ratio"),
+    "Gauge": ("_seconds", "_bytes", "_ratio", "_depth", "_requests",
+              "_tokens", "_total", "_size", "_count", "_percent",
+              "_occupancy", "_workers", "_nodes", "_replicas", "_mfu",
+              "_flag", "_info", "_actors", "_objects", "_tasks",
+              "_per_second", "_steps", "_pending"),
+}
+
+
+@register
+class MetricNamingConvention(Rule):
+    id = "GL006"
+    name = "metric-naming-convention"
+    rationale = ("every exported metric is `ray_tpu_`-prefixed "
+                 "snake_case with a unit/kind suffix (`_total` for "
+                 "counters) so dashboards and alerts survive refactors")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            kind = dotted.rsplit(".", 1)[-1]
+            if kind not in _METRIC_SUFFIXES:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            name = node.args[0].value
+            if not _METRIC_NAME_RE.match(name):
+                yield ctx.finding(
+                    self.id, node,
+                    f"metric {name!r} is outside the ray_tpu_ "
+                    "snake_case convention")
+            elif not name.endswith(_METRIC_SUFFIXES[kind]):
+                yield ctx.finding(
+                    self.id, node,
+                    f"{kind} {name!r} lacks a unit/kind suffix "
+                    f"(expected one of {_METRIC_SUFFIXES[kind]})")
+
+
+@register
+class TraceContextDrop(Rule):
+    id = "GL007"
+    name = "trace-context-drop"
+    rationale = ("a TaskSpec built without trace_id breaks the "
+                 "distributed trace at that hop (PR 1 wired trace "
+                 "context end-to-end; new call sites must keep it)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            if dotted.rsplit(".", 1)[-1] != "TaskSpec":
+                continue
+            kw_names = {k.arg for k in node.keywords}
+            if None in kw_names:  # **kwargs may carry it
+                continue
+            if "trace_id" not in kw_names:
+                yield ctx.finding(
+                    self.id, node,
+                    "TaskSpec(...) without trace_id= — this hop drops "
+                    "the request's trace context")
+
+
+@register
+class NonDaemonBackgroundThread(Rule):
+    id = "GL008"
+    name = "non-daemon-background-thread"
+    rationale = ("a non-daemon background thread with no shutdown path "
+                 "hangs interpreter exit (tests and drivers never "
+                 "terminate)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # collect `<target>.daemon = True` assignments per scope
+        daemonized: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is True:
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) and \
+                            target.attr == "daemon":
+                        base = _dotted(target.value) or ast.dump(
+                            target.value)
+                        daemonized.add((node._gl_scope, base))
+        assigned_to: Dict[int, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for target in node.targets:
+                    base = _dotted(target)
+                    if base:
+                        assigned_to[id(node.value)] = base
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            if dotted not in ("threading.Thread", "Thread"):
+                continue
+            kwargs = {k.arg: k.value for k in node.keywords}
+            daemon = kwargs.get("daemon")
+            if isinstance(daemon, ast.Constant) and daemon.value:
+                continue
+            if daemon is not None and not isinstance(daemon, ast.Constant):
+                continue  # computed daemon-ness: give it the benefit
+            target = assigned_to.get(id(node))
+            if target and (node._gl_scope, target) in daemonized:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "threading.Thread(...) without daemon=True or a "
+                "registered shutdown path")
+
+
+# ---------------------------------------------------------------------
+# engine
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def _rel(path: str) -> str:
+    try:
+        rel = os.path.relpath(path)
+    except ValueError:
+        rel = path
+    if rel.startswith(".." + os.sep):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def lint_file(path: str, source: Optional[str] = None,
+              select: Optional[Iterable[str]] = None,
+              ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        ctx = FileContext(_rel(path), source)
+    except SyntaxError as e:
+        return [Finding(rule="GL000", path=_rel(path),
+                        line=e.lineno or 1, col=e.offset or 0,
+                        message=f"syntax error: {e.msg}",
+                        scope="<module>")]
+    selected = set(select) if select else set(RULES)
+    if ignore:
+        selected -= set(ignore)
+    findings: List[Finding] = []
+    for rule_id in sorted(selected):
+        rule = RULES.get(rule_id)
+        if rule is None:
+            continue
+        for finding in rule.check(ctx):
+            if not ctx.suppressed(finding):
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Iterable[str]] = None,
+               ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        findings.extend(lint_file(path, select=select, ignore=ignore))
+    return findings
+
+
+# -- baseline ----------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != 1:
+        raise ValueError(f"unsupported baseline version in {path}")
+    return dict(data.get("baseline", {}))
+
+
+def write_baseline(findings: Iterable[Finding], path: str) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    payload = {
+        "version": 1,
+        "comment": ("grandfathered graftlint findings; regenerate with "
+                    "`python -m ray_tpu.devtools.lint <paths> "
+                    "--write-baseline`. New findings (even in a "
+                    "baselined scope) still fail once the scope's "
+                    "count is exceeded."),
+        "baseline": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[str, int]) -> List[Finding]:
+    """Drop up to baseline[key] findings per fingerprint (earliest
+    lines win); everything beyond the grandfathered count is new."""
+    budget = dict(baseline)
+    out: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def find_default_baseline(paths: Sequence[str]) -> Optional[str]:
+    """cwd first, then ancestors of each scanned path."""
+    candidates = [os.path.join(os.getcwd(), BASELINE_DEFAULT)]
+    for p in paths:
+        d = os.path.abspath(p if os.path.isdir(p) else os.path.dirname(p))
+        while True:
+            candidates.append(os.path.join(d, BASELINE_DEFAULT))
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    for c in candidates:
+        if os.path.isfile(c):
+            return c
+    return None
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="framework-aware static analysis for ray_tpu")
+    parser.add_argument("paths", nargs="*", default=["ray_tpu"])
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: "
+                             f"{BASELINE_DEFAULT} in cwd or scanned-"
+                             "path ancestors)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring baselines")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings as the baseline "
+                             "and exit 0")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run")
+    parser.add_argument("--ignore", default=None,
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            rule = RULES[rid]
+            print(f"{rid} {rule.name}: {rule.rationale}")
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    findings = lint_paths(args.paths, select=select, ignore=ignore)
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        baseline_path = find_default_baseline(args.paths)
+
+    if args.write_baseline:
+        out = baseline_path or BASELINE_DEFAULT
+        write_baseline(findings, out)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    if baseline_path and not args.no_baseline:
+        findings = apply_baseline(findings, load_baseline(baseline_path))
+
+    for f in findings:
+        print(f)
+    if findings:
+        by_rule: Dict[str, int] = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items()))
+        print(f"graftlint: {len(findings)} finding(s) ({summary})")
+        return 1
+    print("graftlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""locktrace: runtime lock-order / deadlock-risk detector.
+
+graftlint (static) can't see dynamic acquisition ORDER — the classic
+distributed-control-plane deadlock is thread 1 taking A then B while
+thread 2 takes B then A, each hop hidden behind a method call. This
+module wraps ``threading.Lock``/``RLock`` with an instrumented proxy
+that records, per thread, the stack of currently-held locks; every
+nested acquisition adds an edge to a global lock-order graph. A cycle
+in that graph is a potential deadlock even if the run never actually
+deadlocked. It also flags holds that exceed a threshold (a lock held
+across a blocking call — GL002's runtime twin).
+
+Zero-cost when off: production call sites use the factories
+
+    from ray_tpu.devtools import locktrace
+    self._lock = locktrace.traced_lock("serve.router")
+
+which return a *plain* ``threading.Lock`` unless ``RAY_TPU_LOCKTRACE=1``
+is set (tests set it, or construct ``TracedLock`` directly).
+
+Report shape (``locktrace.report()``)::
+
+    {"cycles":     [["serve.router", "serve.replica"], ...],
+     "long_holds": [{"lock", "held_s", "stack"}, ...],
+     "edges":      [["a", "b"], ...]}
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+_ENV_FLAG = "RAY_TPU_LOCKTRACE"
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV_FLAG, "").lower() in ("1", "true", "yes")
+
+
+class LockTracer:
+    """Global acquisition-order recorder. Thread-safe; its own internal
+    lock is a plain ``threading.Lock`` (never a TracedLock — the tracer
+    must not trace itself)."""
+
+    def __init__(self, hold_threshold_s: float = 0.5,
+                 stack_depth: int = 12):
+        self.hold_threshold_s = hold_threshold_s
+        self.stack_depth = stack_depth
+        self._mu = threading.Lock()
+        # (held_name, acquired_name) -> sample stack at the edge
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._long_holds: List[dict] = []
+        self._tls = threading.local()
+
+    # -- per-thread held stack ----------------------------------------
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _stack(self) -> str:
+        # drop the locktrace frames themselves; keep the callers
+        frames = traceback.format_stack(limit=self.stack_depth)[:-2]
+        return "".join(frames)
+
+    def on_acquired(self, lock: "TracedLock") -> None:
+        held = self._held()
+        if held:
+            stack = self._stack()
+            with self._mu:
+                for prev, _t0, _s in held:
+                    if prev is not lock and prev.name != lock.name:
+                        self._edges.setdefault(
+                            (prev.name, lock.name), stack)
+        held.append((lock, time.monotonic(), None))
+
+    def on_release(self, lock: "TracedLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                _, t0, _ = held.pop(i)
+                dur = time.monotonic() - t0
+                if dur >= self.hold_threshold_s:
+                    with self._mu:
+                        self._long_holds.append({
+                            "lock": lock.name,
+                            "held_s": dur,
+                            "stack": self._stack(),
+                        })
+                return
+        # release without a recorded acquire (e.g. tracing enabled
+        # mid-flight): ignore rather than corrupt the stack
+
+    # -- analysis ------------------------------------------------------
+    def edges(self) -> List[Tuple[str, str]]:
+        with self._mu:
+            return sorted(self._edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Cycles in the lock-order graph (Tarjan SCCs of size > 1,
+        plus self-loops). Each is a potential deadlock: some thread
+        ordering can make every participant wait on the next."""
+        with self._mu:
+            graph: Dict[str, set] = {}
+            for a, b in self._edges:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Dict[str, bool] = {}
+        stack: List[str] = []
+        counter = [0]
+        out: List[List[str]] = []
+
+        def strongconnect(v: str) -> None:
+            # iterative Tarjan (recursion depth is unbounded by user
+            # lock graphs)
+            work = [(v, iter(sorted(graph[v])))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack[v] = True
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack[w] = True
+                        work.append((w, iter(sorted(graph[w]))))
+                        advanced = True
+                        break
+                    if on_stack.get(w):
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack[w] = False
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1 or node in graph.get(node, ()):
+                        out.append(sorted(scc))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+    def edge_stack(self, a: str, b: str) -> Optional[str]:
+        with self._mu:
+            return self._edges.get((a, b))
+
+    def long_holds(self) -> List[dict]:
+        with self._mu:
+            return list(self._long_holds)
+
+    def report(self) -> dict:
+        return {"cycles": self.cycles(),
+                "long_holds": self.long_holds(),
+                "edges": self.edges()}
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self._long_holds.clear()
+
+
+_tracer: Optional[LockTracer] = None
+_tracer_mu = threading.Lock()
+
+
+def get_tracer() -> LockTracer:
+    global _tracer
+    with _tracer_mu:
+        if _tracer is None:
+            threshold = float(os.environ.get(
+                "RAY_TPU_LOCKTRACE_HOLD_S", "0.5"))
+            _tracer = LockTracer(hold_threshold_s=threshold)
+        return _tracer
+
+
+def report() -> dict:
+    return get_tracer().report()
+
+
+def reset() -> None:
+    get_tracer().reset()
+
+
+class TracedLock:
+    """Drop-in for ``threading.Lock``/``RLock`` that reports to a
+    LockTracer. Supports the full context-manager + acquire/release
+    protocol, so it also works as the lock behind a
+    ``threading.Condition``."""
+
+    def __init__(self, name: Optional[str] = None, *,
+                 reentrant: bool = False,
+                 tracer: Optional[LockTracer] = None):
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self.name = name or f"anon-{id(self):#x}"
+        self._tracer = tracer or get_tracer()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._tracer.on_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._tracer.on_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        locked_fn = getattr(self._inner, "locked", None)
+        return locked_fn() if locked_fn is not None else False
+
+    def __enter__(self) -> "TracedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<TracedLock {self.name!r} inner={self._inner!r}>"
+
+
+def traced_lock(name: str):
+    """``threading.Lock()`` normally; a TracedLock under
+    RAY_TPU_LOCKTRACE=1. The name is the node label in the lock-order
+    graph — use a stable dotted component name, not an instance id, so
+    orders observed across instances of the same class aggregate."""
+    return TracedLock(name) if enabled() else threading.Lock()
+
+
+def traced_rlock(name: str):
+    return TracedLock(name, reentrant=True) if enabled() \
+        else threading.RLock()

@@ -165,7 +165,7 @@ class DecodeServer(LLMServer):
             top_k=top_k, adapter=adapter, logit_bias=logit_bias,
             response_format=response_format)
         while not request.done:
-            time.sleep(0.001)
+            request.wait_done(timeout=1.0)
         if request.error is not None:
             raise RuntimeError(request.error)
         out_ids = [t for t in request.output_ids

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import itertools
 import threading
+
+from ray_tpu.devtools import locktrace
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -94,7 +96,7 @@ class _MetricsBuffer:
         self._tokens = 0
         # stats()/flush_metrics() run on request threads concurrently
         # with the stepper's note_step — cheap uncontended lock
-        self._buf_lock = threading.Lock()
+        self._buf_lock = locktrace.traced_lock("llm.engine.buf")
 
     def note_step(self, phase: str, dt: float, tokens: int,
                   active: int) -> None:
@@ -148,8 +150,8 @@ class _MetricsBuffer:
                       float(len(engine.waiting)), None))
         try:
             _metrics.record_batch(items)
-        except Exception:  # noqa: BLE001 — observability is best-effort
-            pass
+        except Exception:  # graftlint: disable=GL004
+            pass  # observability is best-effort
 
 
 @dataclass
@@ -280,17 +282,36 @@ class GenerationRequest:
     logprob_data: List[Dict[str, Any]] = field(default_factory=list)
     finish_reason: Optional[str] = None
     error: Optional[str] = None
+    # set when finish_reason lands; waiters block on this instead of
+    # polling `done` in a sleep loop (graftlint GL003)
+    done_event: threading.Event = field(default_factory=threading.Event,
+                                        repr=False, compare=False)
 
     @property
     def done(self) -> bool:
         return self.finish_reason is not None
 
+    def finish(self, reason: str, error: Optional[str] = None) -> None:
+        """Mark finished and wake waiters. The ONE completion path —
+        assigning finish_reason directly would leave done_event unset
+        and strand ``wait_done`` callers."""
+        if error is not None:
+            self.error = error
+        self.finish_reason = reason
+        self.done_event.set()
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        """Block until the engine finishes this request. Returns
+        ``done`` (False on timeout)."""
+        self.done_event.wait(timeout)
+        return self.done
+
     def push_stream(self, item) -> None:
         if self.stream_queue is not None:
             try:
                 self.stream_queue.put_nowait(item)
-            except Exception:  # noqa: BLE001 — consumer gone
-                pass
+            except Exception:  # graftlint: disable=GL004
+                pass  # stream consumer is gone; tokens just drop
 
 
 class _Slot:
@@ -401,7 +422,7 @@ class ContinuousBatchingEngine:
         self.waiting: List[GenerationRequest] = []
         # disaggregated requests: (request, ks, vs, prompt_len, token)
         self._prefilled_waiting: List[tuple] = []
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("llm.engine")
         self.total_generated = 0
         self._base_key = jax.random.PRNGKey(config.seed)
         self._step_counter = 0
@@ -892,7 +913,8 @@ class ContinuousBatchingEngine:
                 self.params, cks, cvs, jnp.asarray(chunk),
                 jnp.asarray([plen_p], dtype=jnp.int32), bucket=bucket)
             last_logits = logits[0, len(suffix) - 1]
-        self._step_counter += 1
+        # stepper-thread-only RNG state
+        self._step_counter += 1  # graftlint: disable=GL001
         bias_dev = (self._zero_bias_row if bias_row is None
                     else jnp.asarray(bias_row))
         token, chosen, top_vals, top_ids = self._sample_one(
@@ -1064,7 +1086,7 @@ class ContinuousBatchingEngine:
                 request = self.waiting.pop(0)
                 slot = free[0]
                 slot.request = request
-            self._admitted_last_step += 1
+            self._admitted_last_step += 1  # graftlint: disable=GL001  # stepper-thread-only
             ids = request.prompt_ids
             self._install_bias(request, slot.index)
             C = self.config.chunked_prefill_tokens
@@ -1116,8 +1138,8 @@ class ContinuousBatchingEngine:
                 try:
                     ENGINE_TTFT.observe(
                         max(0.0, time.perf_counter() - t_submit))
-                except Exception:  # noqa: BLE001 — best-effort
-                    pass
+                except Exception:  # graftlint: disable=GL004
+                    pass  # metric observe is best-effort
         if request.logprobs is not None and slot.pending_lp is not None:
             chosen, top_vals, top_ids = slot.pending_lp
             k = min(request.logprobs, len(top_ids))
@@ -1141,11 +1163,11 @@ class ContinuousBatchingEngine:
             if not grammar_done:
                 slot.bias_stale = True
         if token in request.stop_ids or grammar_done:
-            request.finish_reason = "stop"
+            request.finish("stop")
         elif len(request.output_ids) >= request.max_tokens:
-            request.finish_reason = "length"
+            request.finish("length")
         elif slot.pos >= self._pos_limit:
-            request.finish_reason = "length"
+            request.finish("length")
         request.push_stream(token)
         if request.done:
             request.push_stream(None)
@@ -1193,7 +1215,7 @@ class ContinuousBatchingEngine:
         # one target forward scores the whole chunk
         chunk = jnp.concatenate(
             [tokens_j[:, None], drafts_dev.T], axis=1)       # [B, G]
-        self._step_counter += 1
+        self._step_counter += 1  # graftlint: disable=GL001  # stepper-thread-only
         greedy, first_sampled, self.cache_k, self.cache_v = \
             self._verify(self.params, self.cache_k, self.cache_v,
                          chunk, pos_j, jnp.asarray(temp),
@@ -1229,7 +1251,7 @@ class ContinuousBatchingEngine:
         jnp = self._jnp
         tokens, pos, temp, topk, lora_idx = self._gather_batch(
             active, pos_fill=self.config.max_seq - K)
-        self._step_counter += 1
+        self._step_counter += 1  # graftlint: disable=GL001  # stepper-thread-only
         toks, self.cache_k, self.cache_v = self._decode_multi(
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(tokens), jnp.asarray(pos),
@@ -1273,7 +1295,7 @@ class ContinuousBatchingEngine:
             chunk[slot.index] = row
             pos[slot.index] = p
             last_idx[slot.index] = len(part) - 1
-        self._step_counter += 1
+        self._step_counter += 1  # graftlint: disable=GL001  # stepper-thread-only
         tok, self.cache_k, self.cache_v = self._chunk_prefill(
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(chunk), jnp.asarray(pos),
@@ -1385,7 +1407,7 @@ class ContinuousBatchingEngine:
         jnp = self._jnp
         tokens, pos, temp, topk, lora_idx = self._gather_batch(
             active, pos_fill=self._dense_park)
-        self._step_counter += 1
+        self._step_counter += 1  # graftlint: disable=GL001  # stepper-thread-only
         want_lp = any(s.request.logprobs is not None for s in active)
         sampled, chosen_lp, top_vals, top_ids, self.cache_k, \
             self.cache_v = self._decode(
@@ -1448,13 +1470,11 @@ class ContinuousBatchingEngine:
             pending += [entry[0] for entry in self._prefilled_waiting]
             self._prefilled_waiting.clear()
         for request in pending:
-            request.error = message
-            request.finish_reason = "error"
+            request.finish("error", error=message)
             request.push_stream(None)
         for slot in self.slots:
             if slot.request is not None:
-                slot.request.error = message
-                slot.request.finish_reason = "error"
+                slot.request.finish("error", error=message)
                 slot.request.push_stream(None)
             slot.request = None
             slot.pos = 0
@@ -1496,7 +1516,7 @@ class ContinuousBatchingEngine:
                 pass
             self._prefilled_waiting[:] = [
                 e for e in self._prefilled_waiting if e[0] is not request]
-            request.finish_reason = finish_reason
+            request.finish(finish_reason)
         request.push_stream(None)
 
     def embed(self, prompt_ids: List[int]) -> np.ndarray:

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -322,7 +324,7 @@ class TokenConstraint:
         self._trie = root
         self._mask_cache: Dict[frozenset, np.ndarray] = {}
         self._step_cache: Dict[Tuple[frozenset, str], frozenset] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("llm.guided.masks")
 
     def __getstate__(self):
         # constraints cross actor boundaries (disagg prefill→decode,
@@ -334,7 +336,7 @@ class TokenConstraint:
 
     def __setstate__(self, state):
         self.__dict__.update(state)
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("llm.guided")
 
     @property
     def vocab_size(self) -> int:

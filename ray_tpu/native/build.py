@@ -11,11 +11,13 @@ import os
 import subprocess
 import threading
 
+from ray_tpu.devtools import locktrace
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC_DIR = os.path.join(_DIR, "src")
 _BUILD_DIR = os.path.join(_DIR, "_build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libray_tpu_native.so")
-_lock = threading.Lock()
+_lock = locktrace.traced_lock("native.build")
 
 
 def _sources():

@@ -14,6 +14,8 @@ from __future__ import annotations
 import functools
 import queue
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Callable, List, Optional
 
 from ray_tpu.util.metrics import Histogram
@@ -52,7 +54,7 @@ class _Batcher:
         self.timeout_s = batch_wait_timeout_s
         self.queue: "queue.Queue[_Pending]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("serve.batching.queue")
 
     def _ensure_thread(self) -> None:
         with self._lock:
@@ -105,7 +107,7 @@ class _Batcher:
 # replica. The wrapper reaches this state through an in-body import —
 # a direct global reference would get pickled by value along with the
 # wrapper (whose __module__ is the user's, via functools.wraps).
-_state_lock = threading.Lock()
+_state_lock = locktrace.traced_lock("serve.batching.state")
 _batchers: dict = {}  # (wrapper key, owner key) -> _Batcher
 
 

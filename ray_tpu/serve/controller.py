@@ -12,12 +12,15 @@ replica-set changes through versioned polls (the long-poll equivalent).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
@@ -50,7 +53,7 @@ class ServeController:
         self._lock = threading.RLock()
         self._version = 0
         self._version_cv = threading.Condition(self._lock)
-        self._stopped = False
+        self._stop_event = threading.Event()
         self._interval = reconcile_interval_s
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True)
@@ -100,12 +103,14 @@ class ServeController:
                     self._remove_deployment_locked(name)
 
     def _remove_deployment_locked(self, name: str) -> None:
-        st = self._deployments.pop(name)
+        # caller holds self._lock (the _locked suffix is the contract)
+        st = self._deployments.pop(name)  # graftlint: disable=GL001
         for h in st.replicas.values():
             try:
                 ray_tpu.kill(h)
             except Exception:
-                pass
+                logger.exception("kill failed for a replica of %r "
+                                 "during deployment removal", name)
         self._bump_locked()
 
     def get_replicas(self, deployment_name: str) -> tuple:
@@ -122,7 +127,8 @@ class ServeController:
         known_version or timeout (reference: long_poll.py:228)."""
         deadline = time.monotonic() + timeout_s
         with self._version_cv:
-            while (self._version <= known_version and not self._stopped
+            while (self._version <= known_version
+                   and not self._stop_event.is_set()
                    and time.monotonic() < deadline):
                 self._version_cv.wait(timeout=max(
                     0.0, deadline - time.monotonic()))
@@ -201,7 +207,7 @@ class ServeController:
         with self._lock:
             for name in list(self._deployments):
                 self._remove_deployment_locked(name)
-            self._stopped = True
+            self._stop_event.set()
             self._version_cv.notify_all()
 
     def ping(self) -> str:
@@ -210,17 +216,19 @@ class ServeController:
     # -- reconcile --
 
     def _bump_locked(self) -> None:
-        self._version += 1
+        # caller holds self._lock (the _locked suffix is the contract)
+        self._version += 1  # graftlint: disable=GL001
         self._version_cv.notify_all()
 
     def _reconcile_loop(self) -> None:
-        while not self._stopped:
+        # Event.wait instead of time.sleep: shutdown() wakes the loop
+        # immediately instead of waiting out the reconcile interval
+        while not self._stop_event.is_set():
             try:
                 self._reconcile_once()
             except Exception:
-                import traceback
-                traceback.print_exc()
-            time.sleep(self._interval)
+                logger.exception("reconcile pass failed")
+            self._stop_event.wait(self._interval)
 
     def _reconcile_once(self) -> None:
         with self._lock:
@@ -241,8 +249,8 @@ class ServeController:
                     h.get_metrics.remote(cfg.look_back_period_s),
                     timeout=1.0)
                 totals.append(m["avg_ongoing"])
-            except Exception:
-                pass  # health check will deal with it
+            except Exception:  # graftlint: disable=GL004
+                pass  # replica unreachable: the health check owns that
         if not totals:
             return
         desired = max(cfg.min_replicas,
@@ -275,7 +283,8 @@ class ServeController:
                         try:
                             ray_tpu.kill(h)
                         except Exception:
-                            pass
+                            logger.exception(
+                                "kill failed for dead replica %s", rid)
                 self._bump_locked()
 
     def _scale_to_target(self, st: _DeploymentState) -> None:
@@ -303,10 +312,13 @@ class ServeController:
                 try:
                     ray_tpu.get(h.check_health.remote(), timeout=60.0)
                 except Exception:
+                    logger.exception(
+                        "replica %s failed construction health check; "
+                        "discarding it", rid)
                     try:
                         ray_tpu.kill(h)
-                    except Exception:
-                        pass
+                    except Exception:  # graftlint: disable=GL004
+                        pass  # best-effort: it never became healthy
                     new.pop(rid, None)
             with self._lock:
                 st.replicas.update(new)
@@ -324,7 +336,8 @@ class ServeController:
                     h.prepare_for_shutdown.remote()
                     ray_tpu.kill(h)
                 except Exception:
-                    pass
+                    logger.exception("downscale shutdown failed for a "
+                                     "replica of %r", st.name)
         else:
             with self._lock:
                 if st.status != "HEALTHY" and len(st.replicas) >= st.target:

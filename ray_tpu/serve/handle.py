@@ -10,6 +10,8 @@ deployments).
 from __future__ import annotations
 
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Dict, Optional
 
 import ray_tpu
@@ -21,7 +23,7 @@ _routers: Dict[str, Router] = {}
 # deployments whose routing policy could not be fetched yet (their
 # provisional pow-2 router is upgraded once the controller answers)
 _routers_unresolved: set = set()
-_routers_lock = threading.Lock()
+_routers_lock = locktrace.traced_lock("serve.handle.routers")
 
 
 def _get_router(deployment_name: str, controller) -> Router:
@@ -42,8 +44,8 @@ def _get_router(deployment_name: str, controller) -> Router:
         policy = ray_tpu.get(
             controller.get_router_policy.remote(deployment_name),
             timeout=10)
-    except Exception:  # noqa: BLE001 — controller mid-restart
-        pass
+    except Exception:  # graftlint: disable=GL004
+        pass  # controller mid-restart: fall back to the default policy
     with _routers_lock:
         router = _routers.get(deployment_name)
         if router is not None and deployment_name not in \

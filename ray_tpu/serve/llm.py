@@ -81,6 +81,41 @@ def stream_text_deltas(tokenizer, request):
         yield final[len(emitted):]
 
 
+def stream_token_deltas(tokenizer, request):
+    """Like :func:`stream_text_deltas`, but yields exactly ONE delta per
+    non-stop generated token — the contract the OpenAI SSE surface
+    advertises ("per-token chunks"). When a token lands mid-way through
+    a multi-byte character the decoded tail is U+FFFD; the text-delta
+    variant silently merges it into the next token's delta, shifting
+    chunk counts. Here the incomplete token yields ``""`` and the text
+    catches up on a later token, via one-token lookahead so the final
+    token's delta can absorb any held-back tail."""
+    out_ids: List[int] = []
+    emitted = ""
+    pending = False
+    while True:
+        token = request.stream_queue.get()
+        if token is None:
+            break
+        if token in request.stop_ids:
+            continue
+        if pending:
+            text = tokenizer.decode(out_ids)
+            if text.endswith("�"):
+                yield ""
+            else:
+                delta = text[len(emitted):]
+                emitted = text
+                yield delta
+        out_ids.append(token)
+        pending = True
+    if request.error is not None:
+        raise RuntimeError(request.error)
+    if pending:
+        final = tokenizer.decode(out_ids)
+        yield final[len(emitted):]
+
+
 class LLMServer:
     """Deployment class hosting one engine per replica."""
 
@@ -573,8 +608,9 @@ class LLMServer:
                 frequency_penalty=kwargs["frequency_penalty"],
                 logprobs=kwargs["logprobs"])
                 for _ in range(n)]
-            while not all(r.done for _, r in admitted):
-                time.sleep(0.001)
+            for _, r in admitted:
+                while not r.done:
+                    r.wait_done(timeout=1.0)
         results = []
         for ids, r in admitted:
             if r.error is not None:
@@ -658,7 +694,7 @@ class LLMServer:
                 guided=guided, presence_penalty=presence_penalty,
                 frequency_penalty=frequency_penalty, logprobs=logprobs)
             while not request.done:
-                time.sleep(0.001)
+                request.wait_done(timeout=1.0)
         if request.error is not None:
             raise RuntimeError(request.error)
         out_ids = [i for i in request.output_ids
@@ -762,7 +798,7 @@ class LLMServer:
             # reads output_ids after the stream drains
             request_sink["request"] = request
             request_sink["prompt_tokens"] = len(_ids)
-        deltas = stream_text_deltas(self.tokenizer, request)
+        deltas = stream_token_deltas(self.tokenizer, request)
         if not stop:
             yield from deltas
             return
@@ -1171,11 +1207,11 @@ class MultiplexLLMServer:
                 MultiplexLLMServer._load_model)
         self._load = lambda mid: loader(self, mid)
         self._requests = metrics_mod.Counter(
-            "serve_llm_requests", "LLM requests by model",
+            "ray_tpu_serve_llm_requests_total", "LLM requests by model",
             tag_keys=("model",))
         self._tokens = metrics_mod.Counter(
-            "serve_llm_generated_tokens", "Generated tokens by model",
-            tag_keys=("model",))
+            "ray_tpu_serve_llm_generated_tokens_total",
+            "Generated tokens by model", tag_keys=("model",))
 
     def _load_model(self, model_id: str) -> LLMServer:
         return LLMServer(self._configs[model_id],

@@ -11,6 +11,8 @@ from __future__ import annotations
 import collections
 import functools
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Callable, Optional
 
 _current_model_id = threading.local()
@@ -50,7 +52,7 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
 
 # Cache state lives outside wrapper closures, reached via in-body import,
 # so decorated classes stay picklable (see ray_tpu/serve/batching.py).
-_state_lock = threading.Lock()
+_state_lock = locktrace.traced_lock("serve.multiplex.state")
 _caches: dict = {}
 
 
@@ -85,7 +87,7 @@ def _lookup(key, call, model_id, max_models):
         if callable(stop):
             try:
                 stop()
-            except Exception:  # noqa: BLE001 — eviction is best-effort
-                pass
+            except Exception:  # graftlint: disable=GL004
+                pass  # eviction is best-effort; model is unreferenced
     _current_model_id.value = model_id
     return model

@@ -16,6 +16,8 @@ has one router per driver process — a stated simplification)."""
 from __future__ import annotations
 
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.serve.router import Router
@@ -36,7 +38,7 @@ class PrefixTree:
 
     def __init__(self, eviction_threshold_chars: int = 400_000):
         self._root: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("serve.prefix_router")
         self._chars = 0
         self._threshold = eviction_threshold_chars
 
@@ -136,8 +138,8 @@ class PrefixAwareRouter(Router):
                 args, _kwargs = serialization.loads(args_blob)
                 if args:
                     text = extract_prompt(args[0])
-            except Exception:  # noqa: BLE001 — unroutable: plain pow-2
-                pass
+            except Exception:  # graftlint: disable=GL004
+                pass  # unroutable request body: plain pow-2 applies
         rid, handle = self._choose_for_prompt(text)
         if text:
             self.tree.insert(text, rid)

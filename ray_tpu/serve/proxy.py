@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import json
 import threading
+
+from ray_tpu.devtools import locktrace
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
@@ -35,7 +37,7 @@ class _ProxyState:
     def __init__(self, controller):
         self.controller = controller
         self._routes: Dict[str, str] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("serve.proxy")
 
     def refresh(self) -> None:
         routes = ray_tpu.get(self.controller.list_routes.remote())

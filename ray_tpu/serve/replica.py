@@ -11,6 +11,8 @@ LRU).
 from __future__ import annotations
 
 import threading
+
+from ray_tpu.devtools import locktrace
 import time
 from typing import Any, Dict, Optional
 
@@ -62,7 +64,7 @@ class Replica:
         self.max_ongoing = max_ongoing_requests
         self._ongoing = 0
         self._total = 0
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("serve.replica")
         # sliding window of (t, ongoing) samples for autoscaling
         self._metric_samples = []
         self._multiplexed: "dict[str, Any]" = {}  # model_id -> model (LRU)
@@ -222,14 +224,19 @@ class Replica:
     # -- multiplexing (reference: serve/multiplex.py model LRU) --
 
     def load_multiplexed(self, model_id: str, loader_blob: bytes) -> None:
-        if model_id in self._multiplexed:
-            self._multiplexed[model_id] = self._multiplexed.pop(model_id)
-            return
+        with self._lock:
+            if model_id in self._multiplexed:
+                # LRU touch
+                self._multiplexed[model_id] = \
+                    self._multiplexed.pop(model_id)
+                return
         loader = serialization.loads(loader_blob)
-        if len(self._multiplexed) >= self._multiplex_max:
-            evict = next(iter(self._multiplexed))
-            del self._multiplexed[evict]
-        self._multiplexed[model_id] = loader(model_id)
+        model = loader(model_id)  # expensive load outside the lock
+        with self._lock:
+            if len(self._multiplexed) >= self._multiplex_max:
+                evict = next(iter(self._multiplexed))
+                del self._multiplexed[evict]
+            self._multiplexed[model_id] = model
 
     def get_multiplexed_model_ids(self) -> list:
         return list(self._multiplexed)

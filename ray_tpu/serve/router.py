@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import random
 import threading
+
+from ray_tpu.devtools import locktrace
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -57,7 +59,7 @@ class Router:
         # saturated cache-affine replica can't livelock retries while
         # others idle); pow-2 probing ignores it.
         self._reject_penalty: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("serve.router")
         self._rng = random.Random()
 
     def _refresh(self, block: bool) -> None:
@@ -81,7 +83,8 @@ class Router:
             qlen = ray_tpu.get(handle.get_queue_len.remote(), timeout=1.0)
         except Exception:
             qlen = 1 << 30  # unprobeable replica loses the comparison
-        self._qlen_cache[rid] = (now, qlen)
+        with self._lock:
+            self._qlen_cache[rid] = (now, qlen)
         return qlen
 
     def choose(self, args_blob: Optional[bytes] = None
@@ -161,8 +164,10 @@ class Router:
                 if kind == "rejected":
                     attempts += 1
                     ROUTER_REJECTIONS.inc(tags=dep_tags)
-                    self._qlen_cache.pop(rid, None)
-                    self._reject_penalty[rid] = time.monotonic() + 1.0
+                    with self._lock:
+                        self._qlen_cache.pop(rid, None)
+                        self._reject_penalty[rid] = \
+                            time.monotonic() + 1.0
                     time.sleep(min(0.05 * attempts, 0.5))
                     continue
                 QUEUE_WAIT.observe(time.monotonic() - t0, tags=dep_tags)
@@ -219,8 +224,9 @@ class Router:
                     return result
                 attempts += 1
                 ROUTER_REJECTIONS.inc(tags=dep_tags)
-                self._qlen_cache.pop(rid, None)
-                self._reject_penalty[rid] = time.monotonic() + 1.0
+                with self._lock:
+                    self._qlen_cache.pop(rid, None)
+                    self._reject_penalty[rid] = time.monotonic() + 1.0
                 if deadline and time.monotonic() >= deadline:
                     raise TimeoutError(
                         f"request to {self.deployment_name} timed out "

@@ -10,6 +10,8 @@ metrics for the controller and persists checkpoints rank-coordinated
 from __future__ import annotations
 
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Any, Dict, Iterable, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -47,7 +49,7 @@ class TrainContext:
         self.group_name = group_name
         self.reported: list = []
         self.pending_checkpoint_dirs: list = []
-        self._lock = threading.Lock()
+        self._lock = locktrace.traced_lock("train.context")
 
     # reference API surface
     def get_world_size(self) -> int:

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import bisect
 import threading
+
+from ray_tpu.devtools import locktrace
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _DEFAULT_BOUNDARIES = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
@@ -26,7 +28,7 @@ class _Registry:
     copy; workers forward updates to it)."""
 
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = locktrace.traced_lock("util.metrics")
         # (name, tag_items) -> value
         self.counters: Dict[Tuple, float] = {}
         self.gauges: Dict[Tuple, float] = {}

@@ -189,7 +189,7 @@ class Queue:
                 try:
                     api.wait([self.actor.qsize.remote()],
                              timeout=grace_period_s)
-                except Exception:
+                except Exception:  # graftlint: disable=GL004
                     pass  # actor already dying — proceed to the kill
             api.kill(self.actor, no_restart=True)
         self.actor = None

@@ -140,8 +140,8 @@ def record_span(name: str, component: str, t_start: float,
             rt.gcs.add_trace_span(span_tuple)
         else:
             rt.gcs_call("trace_add_span", span_tuple)
-    except Exception:  # noqa: BLE001 — observability is best-effort
-        pass
+    except Exception:  # graftlint: disable=GL004
+        pass  # span export is best-effort observability
 
 
 @contextmanager

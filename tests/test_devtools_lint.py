@@ -1,0 +1,367 @@
+"""graftlint rule engine: one firing + one non-firing fixture per rule,
+suppression comments, and baseline round-trip."""
+
+import json
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools import lint
+
+
+def run(source, select=None):
+    """Lint an in-memory fixture; returns the list of Findings."""
+    return lint.lint_file("fixture.py", source=textwrap.dedent(source),
+                          select=select)
+
+
+def rules_hit(source, select=None):
+    return {f.rule for f in run(source, select=select)}
+
+
+# -- GL001 unguarded shared state -------------------------------------
+
+GL001_POS = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+
+        def bump(self):
+            self._count += 1
+"""
+
+GL001_NEG = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0
+
+        def bump(self):
+            with self._lock:
+                self._count += 1
+"""
+
+
+def test_gl001_fires_on_unlocked_mutation():
+    findings = run(GL001_POS, select=["GL001"])
+    assert [f.rule for f in findings] == ["GL001"]
+    assert "_count" in findings[0].message
+
+
+def test_gl001_quiet_when_locked_or_lockless():
+    assert rules_hit(GL001_NEG, select=["GL001"]) == set()
+    # no lock on the class -> no shared-state contract to enforce
+    assert rules_hit("""
+        class Plain:
+            def bump(self):
+                self._count = 1
+    """, select=["GL001"]) == set()
+
+
+def test_gl001_exempts_init():
+    assert rules_hit("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._state = {}
+    """, select=["GL001"]) == set()
+
+
+# -- GL002 lock held across blocking call -----------------------------
+
+def test_gl002_fires_on_sleep_under_lock():
+    hit = rules_hit("""
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)
+    """, select=["GL002"])
+    assert hit == {"GL002"}
+
+
+def test_gl002_quiet_outside_lock():
+    assert rules_hit("""
+        import time
+
+        def slow():
+            time.sleep(1.0)
+    """, select=["GL002"]) == set()
+
+
+# -- GL003 busy-wait polling loop -------------------------------------
+
+def test_gl003_fires_on_sleep_poll_with_event_available():
+    hit = rules_hit("""
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._done = threading.Event()
+
+            def wait(self):
+                while not self.finished:
+                    time.sleep(0.01)
+    """, select=["GL003"])
+    assert hit == {"GL003"}
+
+
+def test_gl003_quiet_without_condition_or_event():
+    assert rules_hit("""
+        import time
+
+        class C:
+            def wait(self):
+                while not self.finished:
+                    time.sleep(0.01)
+    """, select=["GL003"]) == set()
+
+
+# -- GL004 swallowed exception ----------------------------------------
+
+def test_gl004_fires_on_silent_pass():
+    hit = rules_hit("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """, select=["GL004"])
+    assert hit == {"GL004"}
+    # bare except too
+    hit = rules_hit("""
+        def f():
+            try:
+                g()
+            except:
+                pass
+    """, select=["GL004"])
+    assert hit == {"GL004"}
+
+
+def test_gl004_quiet_when_logged_or_raised():
+    assert rules_hit("""
+        import logging
+        logger = logging.getLogger(__name__)
+
+        def f():
+            try:
+                g()
+            except Exception:
+                logger.exception("g failed")
+    """, select=["GL004"]) == set()
+    assert rules_hit("""
+        def f():
+            try:
+                g()
+            except:
+                raise
+    """, select=["GL004"]) == set()
+
+
+# -- GL005 forbidden backend import -----------------------------------
+
+def test_gl005_fires_on_cuda_backend_import():
+    assert rules_hit("import torch.cuda\n", select=["GL005"]) == {"GL005"}
+    assert rules_hit("from cupy import array\n",
+                     select=["GL005"]) == {"GL005"}
+
+
+def test_gl005_quiet_on_allowed_imports():
+    assert rules_hit("import jax\nimport numpy\n",
+                     select=["GL005"]) == set()
+
+
+# -- GL006 metric naming convention -----------------------------------
+
+def test_gl006_fires_on_bad_prefix_and_missing_suffix():
+    findings = run("""
+        from ray_tpu.util.metrics import Counter
+        BAD_PREFIX = Counter("serve_requests_total")
+        BAD_SUFFIX = Counter("ray_tpu_serve_requests")
+    """, select=["GL006"])
+    assert [f.rule for f in findings] == ["GL006", "GL006"]
+
+
+def test_gl006_quiet_on_conforming_names():
+    assert rules_hit("""
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
+        C = Counter("ray_tpu_serve_requests_total")
+        G = Gauge("ray_tpu_engine_batch_occupancy")
+        H = Histogram("ray_tpu_request_latency_seconds")
+    """, select=["GL006"]) == set()
+
+
+# -- GL007 trace-context drop -----------------------------------------
+
+def test_gl007_fires_on_tracelss_taskspec():
+    hit = rules_hit("""
+        from ray_tpu.core.task_spec import TaskSpec
+
+        def submit():
+            return TaskSpec(task_id=1, function_id="f", args=[])
+    """, select=["GL007"])
+    assert hit == {"GL007"}
+
+
+def test_gl007_quiet_with_trace_id():
+    assert rules_hit("""
+        from ray_tpu.core.task_spec import TaskSpec
+
+        def submit(tid):
+            return TaskSpec(task_id=1, function_id="f", args=[],
+                            trace_id=tid)
+    """, select=["GL007"]) == set()
+
+
+# -- GL008 non-daemon background thread -------------------------------
+
+def test_gl008_fires_on_non_daemon_thread():
+    hit = rules_hit("""
+        import threading
+
+        def start():
+            t = threading.Thread(target=loop)
+            t.start()
+    """, select=["GL008"])
+    assert hit == {"GL008"}
+
+
+def test_gl008_quiet_on_daemon_thread():
+    assert rules_hit("""
+        import threading
+
+        def start():
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+    """, select=["GL008"]) == set()
+    # daemon set via attribute before start()
+    assert rules_hit("""
+        import threading
+
+        def start():
+            t = threading.Thread(target=loop)
+            t.daemon = True
+            t.start()
+    """, select=["GL008"]) == set()
+
+
+# -- suppression comments ---------------------------------------------
+
+def test_per_line_suppression():
+    src = """
+        def f():
+            try:
+                g()
+            except Exception:  # graftlint: disable=GL004
+                pass  # justified: best-effort fixture
+    """
+    assert rules_hit(src, select=["GL004"]) == set()
+
+
+def test_suppression_is_rule_specific():
+    src = """
+        def f():
+            try:
+                g()
+            except Exception:  # graftlint: disable=GL001
+                pass
+    """
+    # wrong rule id on the comment -> GL004 still fires
+    assert rules_hit(src, select=["GL004"]) == {"GL004"}
+
+
+def test_disable_all_suppresses_everything():
+    src = """
+        def f():
+            try:
+                g()
+            except Exception:  # graftlint: disable=all
+                pass
+    """
+    assert rules_hit(src, select=["GL004"]) == set()
+
+
+# -- baseline round-trip ----------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    findings = run(GL001_POS, select=["GL001"])
+    assert findings
+    path = tmp_path / "baseline.json"
+    lint.write_baseline(findings, str(path))
+
+    loaded = lint.load_baseline(str(path))
+    assert loaded  # non-empty mapping of fingerprint -> count
+    payload = json.loads(path.read_text())
+    assert payload["version"] == 1
+
+    # grandfathered findings are filtered out...
+    assert lint.apply_baseline(findings, loaded) == []
+    # ...but a NEW finding beyond the baselined count still surfaces
+    doubled = findings + findings
+    fresh = lint.apply_baseline(doubled, loaded)
+    assert len(fresh) == len(findings)
+
+
+def test_baseline_key_is_line_drift_stable():
+    shifted = "\n\n\n" + textwrap.dedent(GL001_POS)
+    original = run(GL001_POS, select=["GL001"])
+    moved = lint.lint_file("fixture.py", source=shifted, select=["GL001"])
+    assert original[0].line != moved[0].line
+    assert original[0].key == moved[0].key
+
+
+# -- CLI surface -------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    assert lint.main([str(bad), "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "GL004" in out
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert lint.main([str(good), "--no-baseline"]) == 0
+
+
+def test_cli_write_then_check_baseline(tmp_path, monkeypatch, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    monkeypatch.chdir(tmp_path)
+    assert lint.main([str(bad), "--write-baseline"]) == 0
+    assert (tmp_path / lint.BASELINE_DEFAULT).is_file()
+    capsys.readouterr()
+    # same findings now grandfathered -> clean
+    assert lint.main([str(bad)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    findings = lint.lint_file("broken.py", source="def f(:\n")
+    assert [f.rule for f in findings] == ["GL000"]

@@ -1,0 +1,49 @@
+"""Tier-1 gate: graftlint over ray_tpu/ must be clean modulo the
+checked-in baseline.
+
+A failure here means a change introduced a NEW finding. Either fix it,
+add a justified `# graftlint: disable=RULE` on the flagged line, or —
+for a deliberate grandfather — regenerate the baseline with
+`python -m ray_tpu.devtools.lint ray_tpu/ --write-baseline` and commit
+the diff (reviewers see exactly what was grandfathered).
+"""
+
+import os
+
+from ray_tpu.devtools import lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_graftlint_clean_against_baseline():
+    package = os.path.join(REPO_ROOT, "ray_tpu")
+    baseline_path = os.path.join(REPO_ROOT, lint.BASELINE_DEFAULT)
+    assert os.path.isfile(baseline_path), (
+        f"missing {lint.BASELINE_DEFAULT} at the repo root")
+
+    findings = lint.lint_paths([package])
+    fresh = lint.apply_baseline(findings,
+                                lint.load_baseline(baseline_path))
+    assert not fresh, (
+        "new graftlint findings (fix, suppress with a justified "
+        "`# graftlint: disable=...`, or regenerate the baseline):\n"
+        + "\n".join(f"  {f}" for f in fresh))
+
+
+def test_baseline_has_no_stale_entries():
+    """Every baselined fingerprint still corresponds to a real finding;
+    fixing a grandfathered finding must shrink the baseline too, or the
+    budget silently covers future regressions in that scope."""
+    package = os.path.join(REPO_ROOT, "ray_tpu")
+    baseline_path = os.path.join(REPO_ROOT, lint.BASELINE_DEFAULT)
+    baseline = lint.load_baseline(baseline_path)
+
+    counts = {}
+    for f in lint.lint_paths([package]):
+        counts[f.key] = counts.get(f.key, 0) + 1
+    stale = {key: budget - counts.get(key, 0)
+             for key, budget in baseline.items()
+             if counts.get(key, 0) < budget}
+    assert not stale, (
+        "baseline entries with no matching finding (regenerate with "
+        f"--write-baseline to shrink the budget): {sorted(stale)}")

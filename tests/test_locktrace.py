@@ -1,0 +1,125 @@
+"""locktrace runtime lock-order detector: a real A->B / B->A inversion
+across two threads must produce a cycle in the lock-order graph."""
+
+import threading
+
+from ray_tpu.devtools import locktrace
+
+
+def fresh_tracer(**kwargs):
+    return locktrace.LockTracer(**kwargs)
+
+
+def test_inversion_across_two_threads_detected():
+    tracer = fresh_tracer()
+    a = locktrace.TracedLock("lock.a", tracer=tracer)
+    b = locktrace.TracedLock("lock.b", tracer=tracer)
+
+    # Serialize the two threads with events so both orders actually
+    # happen (no real deadlock: each thread fully releases before the
+    # other starts its nested acquisition).
+    t1_done = threading.Event()
+
+    def t1():  # acquires A then B
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():  # acquires B then A — the inversion
+        t1_done.wait(5.0)
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1, daemon=True)
+    th2 = threading.Thread(target=t2, daemon=True)
+    th1.start()
+    th2.start()
+    th1.join(5.0)
+    th2.join(5.0)
+
+    assert ("lock.a", "lock.b") in tracer.edges()
+    assert ("lock.b", "lock.a") in tracer.edges()
+    cycles = tracer.cycles()
+    assert cycles, "A->B / B->A inversion must be reported as a cycle"
+    assert sorted(cycles[0]) == ["lock.a", "lock.b"]
+
+    report = tracer.report()
+    assert report["cycles"] == cycles
+    # each edge carries a sample stack for the report
+    assert tracer.edge_stack("lock.a", "lock.b")
+
+
+def test_consistent_order_is_not_a_cycle():
+    tracer = fresh_tracer()
+    a = locktrace.TracedLock("lock.a", tracer=tracer)
+    b = locktrace.TracedLock("lock.b", tracer=tracer)
+
+    def worker():
+        with a:
+            with b:
+                pass
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5.0)
+
+    assert tracer.edges() == [("lock.a", "lock.b")]
+    assert tracer.cycles() == []
+
+
+def test_long_hold_reported():
+    tracer = fresh_tracer(hold_threshold_s=0.0)
+    lock = locktrace.TracedLock("lock.slow", tracer=tracer)
+    with lock:
+        pass
+    holds = tracer.long_holds()
+    assert holds and holds[0]["lock"] == "lock.slow"
+    assert holds[0]["held_s"] >= 0.0
+
+
+def test_reentrant_lock_supported():
+    tracer = fresh_tracer()
+    r = locktrace.TracedLock("lock.r", reentrant=True, tracer=tracer)
+    with r:
+        with r:  # same lock: must not self-edge
+            pass
+    assert tracer.edges() == []
+    assert tracer.cycles() == []
+
+
+def test_factories_are_plain_locks_when_disabled(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_LOCKTRACE", raising=False)
+    assert not locktrace.enabled()
+    lock = locktrace.traced_lock("x")
+    assert not isinstance(lock, locktrace.TracedLock)
+    rlock = locktrace.traced_rlock("x")
+    assert not isinstance(rlock, locktrace.TracedLock)
+
+
+def test_factories_trace_when_enabled(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKTRACE", "1")
+    assert locktrace.enabled()
+    lock = locktrace.traced_lock("traced.x")
+    assert isinstance(lock, locktrace.TracedLock)
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+def test_reset_clears_state():
+    tracer = fresh_tracer(hold_threshold_s=0.0)
+    a = locktrace.TracedLock("a", tracer=tracer)
+    b = locktrace.TracedLock("b", tracer=tracer)
+    with a:
+        with b:
+            pass
+    assert tracer.edges() and tracer.long_holds()
+    tracer.reset()
+    assert tracer.edges() == []
+    assert tracer.long_holds() == []
+    assert tracer.report()["cycles"] == []

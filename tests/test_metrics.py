@@ -2,8 +2,6 @@
 python/ray/util/metrics + the dashboard metrics agent's Prometheus
 exposition)."""
 
-import re
-
 import pytest
 
 import ray_tpu
@@ -139,67 +137,7 @@ def test_record_batch_applies_all_kinds(ray_start_regular):
         remove_series(name, tags)
 
 
-# --- instrumentation-drift check (tier-1 CI guard) ---------------------
-
-_NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
-
-# every module that defines built-in metrics at import time
-_INSTRUMENTED_MODULES = [
-    "ray_tpu.core.scheduler",
-    "ray_tpu.core.task_manager",
-    "ray_tpu.core.object_transfer",
-    "ray_tpu.serve.proxy",
-    "ray_tpu.serve.router",
-    "ray_tpu.serve.replica",
-    "ray_tpu.serve.batching",
-    "ray_tpu.train.context",
-    "ray_tpu.llm.engine",
-]
-
-
-def test_metric_naming_convention():
-    """Drift guard: every metric name registered at import time follows
-    the documented ``ray_tpu_``-prefixed snake_case convention — ad-hoc
-    names can't silently accumulate. Runs in a fresh interpreter so
-    user-defined metrics from other tests (which may use any name) do
-    not pollute the import-time registry being checked."""
-    import json
-    import os
-    import subprocess
-    import sys
-
-    script = (
-        "import json, importlib\n"
-        f"mods = {_INSTRUMENTED_MODULES!r}\n"
-        "for m in mods: importlib.import_module(m)\n"
-        "from ray_tpu.util.metrics import _registry\n"
-        "print(json.dumps(sorted(_registry.descriptions)))\n"
-    )
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    out = subprocess.run([sys.executable, "-c", script], env=env,
-                         capture_output=True, text=True, timeout=300)
-    assert out.returncode == 0, out.stderr[-2000:]
-    names = json.loads(out.stdout.strip().splitlines()[-1])
-    offenders = [n for n in names if not _NAME_RE.match(n)]
-    assert not offenders, (
-        f"metric names outside the ray_tpu_ convention: {offenders}")
-    # the documented built-ins are actually registered
-    for required in (
-            "ray_tpu_scheduler_placement_latency_seconds",
-            "ray_tpu_scheduler_queue_depth",
-            "ray_tpu_object_transfer_bytes_total",
-            "ray_tpu_task_queue_seconds",
-            "ray_tpu_task_run_seconds",
-            "ray_tpu_task_e2e_seconds",
-            "ray_tpu_serve_router_requests_total",
-            "ray_tpu_serve_request_latency_seconds",
-            "ray_tpu_serve_queue_wait_seconds",
-            "ray_tpu_serve_replica_request_seconds",
-            "ray_tpu_serve_batch_size",
-            "ray_tpu_engine_ttft_seconds",
-            "ray_tpu_engine_step_seconds",
-            "ray_tpu_engine_tokens_generated_total",
-            "ray_tpu_train_step_seconds",
-            "ray_tpu_train_mfu_ratio",
-    ):
-        assert required in names, f"built-in metric missing: {required}"
+# The metric-naming drift guard that used to live here (a fresh-
+# interpreter registry sweep) is now graftlint rule GL006, enforced by
+# tests/test_lint_clean.py over every source file — including metrics
+# defined in modules this list would have missed.
