@@ -8,9 +8,13 @@ from ray_tpu.autoscaler.gce import GceTpuSliceNodeProvider
 from ray_tpu.autoscaler.gke import GkeKubeRayNodeProvider
 from ray_tpu.autoscaler.node_provider import (
     FakeMultiNodeProvider, NodeProvider)
+from ray_tpu.autoscaler.policy import (
+    AutoscalingPolicy, ReplicaMetrics, SLOPolicy,
+    TargetOngoingRequestsPolicy, make_policy)
 
 __all__ = [
-    "AutoscalerConfig", "FakeMultiNodeProvider",
+    "AutoscalerConfig", "AutoscalingPolicy", "FakeMultiNodeProvider",
     "GceTpuSliceNodeProvider", "GkeKubeRayNodeProvider", "NodeProvider",
-    "NodeTypeConfig", "StandardAutoscaler",
+    "NodeTypeConfig", "ReplicaMetrics", "SLOPolicy",
+    "StandardAutoscaler", "TargetOngoingRequestsPolicy", "make_policy",
 ]

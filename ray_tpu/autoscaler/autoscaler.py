@@ -14,12 +14,15 @@ demand onto hypothetical nodes of each type). One update round:
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
 
 from ray_tpu.autoscaler.config import AutoscalerConfig, NodeTypeConfig
 from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
 
 
 def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
@@ -324,7 +327,8 @@ class StandardAutoscaler:
                 try:
                     self.update()
                 except Exception:  # noqa: BLE001 — keep scaling
-                    pass
+                    logger.exception("autoscaler update failed; "
+                                     "retrying next interval")
                 self._stop.wait(self.config.update_interval_s)
 
         self._thread = threading.Thread(target=loop, name="autoscaler",

@@ -14,10 +14,13 @@ the dashboard's ``/api/logs`` endpoints.
 
 from __future__ import annotations
 
+import logging
 import os
 import sys
 import threading
 from typing import Dict, List
+
+logger = logging.getLogger(__name__)
 
 
 class LogMonitor:
@@ -45,7 +48,7 @@ class LogMonitor:
             try:
                 self.poll_once()
             except Exception:  # noqa: BLE001 — monitoring must not die
-                pass
+                logger.exception("log monitor poll failed; retrying")
 
     def poll_once(self) -> None:
         for log_dir in list(self._log_dirs):

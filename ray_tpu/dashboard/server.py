@@ -69,8 +69,8 @@ class DashboardServer:
                 except Exception as exc:  # noqa: BLE001
                     try:
                         self.send_error(500, str(exc))
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except (OSError, ValueError):
+                        pass  # client already hung up
 
             def do_POST(self):
                 try:
@@ -80,8 +80,8 @@ class DashboardServer:
                 except Exception as exc:  # noqa: BLE001
                     try:
                         self.send_error(500, str(exc))
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except (OSError, ValueError):
+                        pass  # client already hung up
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._httpd.daemon_threads = True

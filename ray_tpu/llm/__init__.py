@@ -5,7 +5,8 @@ caches, jitted whole-batch decode) instead of wrapping vLLM."""
 from ray_tpu.llm.batch import (
     Processor, ProcessorConfig, build_llm_processor, throughput_summary)
 from ray_tpu.llm.engine import (
-    ContinuousBatchingEngine, EngineConfig, GenerationRequest)
+    ContinuousBatchingEngine, EngineConfig, EngineSaturatedError,
+    GenerationRequest)
 from ray_tpu.llm.guided import (
     TokenConstraint, json_object_constraint, json_schema_constraint,
     tool_call_constraint)
@@ -13,6 +14,7 @@ from ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
 
 __all__ = [
     "ByteTokenizer", "ContinuousBatchingEngine", "EngineConfig",
+    "EngineSaturatedError",
     "GenerationRequest", "Processor", "ProcessorConfig",
     "TokenConstraint", "build_llm_processor", "get_tokenizer",
     "json_object_constraint", "json_schema_constraint",

@@ -154,6 +154,20 @@ class _MetricsBuffer:
             pass  # observability is best-effort
 
 
+class EngineSaturatedError(RuntimeError):
+    """Raised by add_request when the waiting queue is at
+    EngineConfig.max_waiting_requests — the reject-before-enqueue
+    hook serve admission control builds on (the LLM server converts
+    this into a typed BackpressureError / HTTP 503)."""
+
+    def __init__(self, waiting: int, cap: int):
+        self.waiting = waiting
+        self.cap = cap
+        super().__init__(
+            f"engine waiting queue is full ({waiting}/{cap}); "
+            "retry after the batch drains")
+
+
 @dataclass
 class EngineConfig:
     # default vocab covers the ByteTokenizer's 258 ids (256 bytes + BOS/EOS)
@@ -222,6 +236,12 @@ class EngineConfig:
     # draft_model and enable_prefix_caching; LoRA-adapter requests
     # fall back to blocking prefill.
     chunked_prefill_tokens: int = 0
+    # Reject-before-enqueue backpressure (serve admission control):
+    # add_request raises EngineSaturatedError instead of appending
+    # once this many requests are already waiting — bounding the
+    # engine queue so the serve chain sheds instead of building an
+    # invisible in-engine backlog. 0 disables (unbounded waiting).
+    max_waiting_requests: int = 0
 
 
 @dataclass
@@ -819,7 +839,14 @@ class ContinuousBatchingEngine:
             request.logprobs = min(max(int(request.logprobs), 0),
                                    self._lp_k)
         with self._lock:
-            self.waiting.append(request)
+            cap = self.config.max_waiting_requests
+            if cap > 0 and len(self.waiting) >= cap:
+                waiting = len(self.waiting)
+            else:
+                waiting = None
+                self.waiting.append(request)
+        if waiting is not None:
+            raise EngineSaturatedError(waiting, cap)
         return request
 
     def has_work(self) -> bool:

@@ -11,6 +11,7 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.admission import BackpressureError
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
@@ -132,10 +133,13 @@ def shutdown() -> None:
     with handle_mod._routers_lock:
         handle_mod._routers.clear()
         handle_mod._routers_unresolved.clear()
+    from ray_tpu.serve.admission import reset_admission
+    reset_admission()
 
 
 __all__ = [
-    "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
+    "Application", "AutoscalingConfig", "BackpressureError",
+    "Deployment", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
     "delete", "deploy_config", "deploy_config_file", "deployment",
     "get_app_handle", "get_deployment_handle",

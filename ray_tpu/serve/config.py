@@ -22,6 +22,19 @@ class AutoscalingConfig:
     downscale_delay_s: float = 2.0
     # smoothed over this window of replica metric reports
     look_back_period_s: float = 2.0
+    # "ongoing" (target-ongoing-requests, the reference default) or
+    # "slo" (scale on router-reported queue depth + windowed p99
+    # latency; see ray_tpu/autoscaler/policy.py)
+    policy: str = "ongoing"
+    # -- slo policy knobs --
+    # sustained queue depth above this target is an SLO breach
+    target_queue_depth: float = 4.0
+    # sustained windowed p99 above this is a breach; <= 0 disables the
+    # latency term (queue depth alone drives scaling)
+    p99_latency_slo_s: float = 0.0
+    # router stats older than this are ignored (idle routers stop
+    # reporting; stale breach data must not pin the fleet scaled-up)
+    slo_stats_staleness_s: float = 3.0
 
 
 @dataclass
@@ -37,6 +50,16 @@ class DeploymentConfig:
     # prompt-prefix cache affinity; reference:
     # llm/_internal/serve/routing_policies/prefix_aware/)
     request_router: str = "pow2"
+    # -- admission control (ray_tpu/serve/admission.py) --
+    # requests allowed to wait beyond replica capacity
+    # (live_replicas * max_ongoing_requests) before new arrivals shed
+    # with 503/BackpressureError; < 0 disables the cap (legacy
+    # unbounded-queue behavior). 0 sheds the moment every replica slot
+    # is full; 1 lets exactly one request wait.
+    max_queued_requests: int = -1
+    # shed when the EWMA of observed queue wait exceeds this many
+    # seconds, even under the hard cap; <= 0 disables
+    shed_queue_wait_s: float = 0.0
 
 
 @dataclass

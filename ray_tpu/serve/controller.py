@@ -43,6 +43,12 @@ class _DeploymentState:
         self.last_scale_up = 0.0
         self.last_scale_down = 0.0
         self.status = "UPDATING"
+        # stateful autoscaling policy instance (ray_tpu/autoscaler/
+        # policy.py), created lazily per the config's policy name
+        self.policy = None
+        self.policy_name = None
+        # latest router-pushed admission stats: (recv_monotonic, dict)
+        self.slo_stats = None
 
 
 class ServeController:
@@ -155,6 +161,36 @@ class ServeController:
             return (st.config.request_router if st is not None
                     else "pow2")
 
+    def get_admission_config(self, deployment_name: str) -> dict:
+        """Admission-control knobs for the driver-side
+        AdmissionController (fetched on router refresh, so capacity
+        tracks the live replica count)."""
+        with self._lock:
+            st = self._deployments.get(deployment_name)
+            if st is None:
+                return {"max_queued_requests": -1,
+                        "max_ongoing_requests": 100,
+                        "shed_queue_wait_s": 0.0,
+                        "num_replicas": 0}
+            return {
+                "max_queued_requests": st.config.max_queued_requests,
+                "max_ongoing_requests": st.config.max_ongoing_requests,
+                "shed_queue_wait_s": st.config.shed_queue_wait_s,
+                "num_replicas": len(st.replicas),
+            }
+
+    def report_slo_stats(self, deployment_name: str,
+                         stats: Dict[str, float]) -> None:
+        """Routers push their admission snapshot (queue depth, windowed
+        p99, EWMA queue wait) here; the SLO autoscaling policy consumes
+        it on the next reconcile tick. The registry metrics these come
+        from live in the DRIVER process — the controller actor cannot
+        read them, so the router pushes."""
+        with self._lock:
+            st = self._deployments.get(deployment_name)
+            if st is not None:
+                st.slo_stats = (time.monotonic(), dict(stats))
+
     def get_request_totals(self) -> Dict[str, float]:
         """deployment -> lifetime request count summed over replicas
         (feeds per-deployment QPS charts; reference:
@@ -239,27 +275,54 @@ class ServeController:
             self._scale_to_target(st)
 
     def _autoscale(self, st: _DeploymentState) -> None:
+        from ray_tpu.autoscaler.policy import ReplicaMetrics, make_policy
         cfg: Optional[AutoscalingConfig] = st.config.autoscaling_config
         if cfg is None or not st.replicas:
             return
-        totals = []
-        for rid, h in list(st.replicas.items()):
-            try:
-                m = ray_tpu.get(
-                    h.get_metrics.remote(cfg.look_back_period_s),
-                    timeout=1.0)
-                totals.append(m["avg_ongoing"])
-            except Exception:  # graftlint: disable=GL004
-                pass  # replica unreachable: the health check owns that
-        if not totals:
-            return
-        desired = max(cfg.min_replicas,
-                      min(cfg.max_replicas,
-                          int(-(-sum(totals) // cfg.target_ongoing_requests))
-                          or cfg.min_replicas))
+        policy_name = getattr(cfg, "policy", "ongoing") or "ongoing"
+        if st.policy is None or st.policy_name != policy_name:
+            st.policy = make_policy(policy_name)
+            st.policy_name = policy_name
+        metrics = ReplicaMetrics(running_replicas=len(st.replicas))
+        if not st.policy.owns_hysteresis:
+            # replica probes feed the target-ongoing-requests policy;
+            # the SLO policy runs off router-pushed stats alone and
+            # skips this per-tick probe fan-out
+            totals = []
+            for rid, h in list(st.replicas.items()):
+                try:
+                    m = ray_tpu.get(
+                        h.get_metrics.remote(cfg.look_back_period_s),
+                        timeout=1.0)
+                    totals.append(m["avg_ongoing"])
+                except Exception:  # graftlint: disable=GL004
+                    pass  # replica unreachable: health check owns that
+            if not totals:
+                return
+            metrics.total_ongoing = sum(totals)
         now = time.monotonic()
         with self._lock:
-            if desired > st.target:
+            if st.slo_stats is not None:
+                t_recv, stats = st.slo_stats
+                metrics.stats_age_s = now - t_recv
+                metrics.queue_depth = float(
+                    stats.get("queue_depth", 0.0))
+                metrics.p99_latency_s = float(
+                    stats.get("p99_latency_s", 0.0))
+                metrics.ewma_queue_wait_s = float(
+                    stats.get("ewma_queue_wait_s", 0.0))
+        desired = st.policy.desired_replicas(metrics, cfg, st.target, now)
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        with self._lock:
+            if st.policy.owns_hysteresis:
+                # the policy already damped flapping (sustained-breach /
+                # sustained-calm windows); adopt its verdict directly
+                if desired > st.target:
+                    st.last_scale_up = now
+                elif desired < st.target:
+                    st.last_scale_down = now
+                st.target = desired
+            elif desired > st.target:
                 if now - st.last_scale_up >= cfg.upscale_delay_s:
                     st.target = desired
                     st.last_scale_up = now

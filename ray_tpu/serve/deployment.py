@@ -37,15 +37,22 @@ class Deployment:
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[int] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
+                shed_queue_wait_s: Optional[float] = None,
                 autoscaling_config: Optional[AutoscalingConfig] = None,
                 ray_actor_options: Optional[Dict[str, Any]] = None,
                 user_config: Optional[Dict[str, Any]] = None,
+                request_router: Optional[str] = None,
                 ) -> "Deployment":
         cfg = copy.deepcopy(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if shed_queue_wait_s is not None:
+            cfg.shed_queue_wait_s = shed_queue_wait_s
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -54,6 +61,8 @@ class Deployment:
             cfg.ray_actor_options = dict(ray_actor_options)
         if user_config is not None:
             cfg.user_config = dict(user_config)
+        if request_router is not None:
+            cfg.request_router = request_router
         return Deployment(self.func_or_class, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> Application:
@@ -62,6 +71,8 @@ class Deployment:
 
 def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 100,
+               max_queued_requests: int = -1,
+               shed_queue_wait_s: float = 0.0,
                autoscaling_config=None, ray_actor_options=None,
                user_config=None, request_router: str = "pow2"):
     """``@serve.deployment`` (reference: python/ray/serve/api.py)."""
@@ -70,6 +81,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
+            shed_queue_wait_s=shed_queue_wait_s,
             ray_actor_options=dict(ray_actor_options or {}),
             user_config=user_config,
             request_router=request_router)

@@ -27,6 +27,7 @@ import threading
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.serve.admission import BackpressureError
 from ray_tpu.serve.proxy import _ProxyState
 
 
@@ -95,6 +96,11 @@ class _GenericHandler:
                 # replica streamed into a unary method: collect
                 chunks = [value] + [chunk for _k, chunk in gen]
                 return _to_bytes(chunks)
+            except BackpressureError as exc:
+                context.set_trailing_metadata(
+                    (("retry-after-s", f"{exc.retry_after_s:.3f}"),))
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              str(exc))
             except ValueError as exc:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
             except Exception as exc:  # noqa: BLE001 — surface as error
@@ -117,6 +123,11 @@ class _GenericHandler:
                                               metadata)
                 for _kind, chunk in self._stream(dep, request):
                     yield _to_bytes(chunk)
+            except BackpressureError as exc:
+                context.set_trailing_metadata(
+                    (("retry-after-s", f"{exc.retry_after_s:.3f}"),))
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                              str(exc))
             except ValueError as exc:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(exc))
             except Exception as exc:  # noqa: BLE001

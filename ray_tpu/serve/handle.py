@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 from ray_tpu.core import serialization
+from ray_tpu.serve.admission import BackpressureError, Shed
 from ray_tpu.serve.replica import Rejected
 from ray_tpu.serve.router import Router
 
@@ -70,7 +71,12 @@ def _get_router(deployment_name: str, controller) -> Router:
 
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference:
-    serve/handle.py DeploymentResponse)."""
+    serve/handle.py DeploymentResponse).
+
+    The response owns the admission token its router.submit() call
+    acquired: result() (or garbage collection of an abandoned
+    response) releases it exactly once, so ``inflight`` in the
+    AdmissionController tracks truly outstanding requests."""
 
     def __init__(self, router: Router, method_name: str, args_blob: bytes,
                  replica_id: str, ref):
@@ -81,21 +87,51 @@ class DeploymentResponse:
         self._replica_id = replica_id
         self._ref = ref
         self._t_submit = time.monotonic()
+        self._released = False
+
+    def _release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._router.admission.release()
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:  # graftlint: disable=GL004  # interp teardown
+            pass
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import time
         try:
-            value = ray_tpu.get(self._ref, timeout=timeout_s)
-        except ray_tpu.exceptions.ActorError:
-            return self._router.fetch(self._method_name, self._args_blob,
-                                      timeout_s)
-        if isinstance(value, Rejected):
-            # Chosen replica was saturated — re-route with backoff
-            # (fetch records its own latency observation).
-            return self._router.fetch(self._method_name, self._args_blob,
-                                      timeout_s)
-        self._router.observe_latency(time.monotonic() - self._t_submit)
-        return value
+            try:
+                value = ray_tpu.get(self._ref, timeout=timeout_s)
+            except ray_tpu.exceptions.ActorError:
+                # pre_admitted: reuse THIS response's token (released
+                # in the outer finally) instead of acquiring a second
+                return self._router.fetch(self._method_name,
+                                          self._args_blob, timeout_s,
+                                          pre_admitted=True)
+            if isinstance(value, Rejected):
+                # Chosen replica was saturated — re-route with backoff
+                # (fetch records its own latency observation).
+                return self._router.fetch(self._method_name,
+                                          self._args_blob, timeout_s,
+                                          pre_admitted=True)
+            if isinstance(value, Shed):
+                # The handler itself shed (engine saturation): surface
+                # as typed, retryable backpressure — never retried
+                # automatically, never recorded as latency.
+                from ray_tpu.serve.admission import SHED_REQUESTS
+                SHED_REQUESTS.inc(tags={
+                    "deployment": self._router.deployment_name,
+                    "reason": value.reason})
+                raise BackpressureError(self._router.deployment_name,
+                                        value.retry_after_s,
+                                        value.reason)
+            self._router.observe_latency(time.monotonic() - self._t_submit)
+            return value
+        finally:
+            self._release()
 
 
 class DeploymentResponseGenerator:

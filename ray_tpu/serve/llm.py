@@ -23,7 +23,8 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu import serve
 from ray_tpu.llm.engine import (
-    ContinuousBatchingEngine, EngineConfig, GenerationRequest)
+    ContinuousBatchingEngine, EngineConfig, EngineSaturatedError,
+    GenerationRequest)
 from ray_tpu.llm.guided import (
     json_object_constraint, json_schema_constraint, parse_tool_call,
     tool_call_constraint)
@@ -555,7 +556,16 @@ class LLMServer:
             stop_ids=(self.tokenizer.eos_id,)
             if self.tokenizer.eos_id is not None else (),
             stream_queue=stream_queue)
-        self.engine.add_request(request)
+        try:
+            self.engine.add_request(request)
+        except EngineSaturatedError as exc:
+            # reject-before-enqueue: surface typed backpressure so the
+            # replica returns a Shed sentinel and the proxy answers
+            # 503 + Retry-After instead of queueing behind the batch
+            from ray_tpu.serve.admission import BackpressureError
+            retry_after = min(30.0, 0.5 + 0.1 * exc.waiting)
+            raise BackpressureError(self.config.model_id, retry_after,
+                                    "engine_saturated") from exc
         self._wake.set()
         if self._stopped:
             # raced an LRU eviction: stop() set _stopped before its

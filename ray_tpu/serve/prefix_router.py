@@ -158,7 +158,6 @@ class PrefixAwareRouter(Router):
 
     def _choose_for_prompt(self, text: Optional[str]
                            ) -> Tuple[str, Any]:
-        import time as _time
         if not text:
             return super().choose()
         with self._lock:
@@ -173,11 +172,12 @@ class PrefixAwareRouter(Router):
         if matches[best_rid] / max(len(text), 1) \
                 < self.match_rate_threshold:
             return super().choose()
-        # A replica that just rejected sits out affinity: without this,
-        # a saturated cache-affine replica whose queue gap never
+        # A replica that recently rejected sits out affinity: without
+        # this, a saturated cache-affine replica whose queue gap never
         # crosses imbalanced_threshold livelocks retries while the
-        # rest of the fleet idles.
-        if self._reject_penalty.get(best_rid, 0.0) > _time.monotonic():
+        # rest of the fleet idles. The penalty score decays toward
+        # zero, so a recovered replica regains its affinity traffic.
+        if self.rejection_penalty(best_rid) >= 1.0:
             return super().choose()
         # Balance check probes ONLY best + two sampled candidates (the
         # reference pow-2 discipline): probing every replica would put

@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.serve.admission import BackpressureError
 from ray_tpu.util import tracing
 from ray_tpu.util.metrics import Counter, Histogram
 
@@ -67,12 +68,16 @@ def _make_handler(state: _ProxyState):
         def log_message(self, fmt, *args):  # quiet
             pass
 
-        def _respond(self, code: int, payload: Any) -> None:
+        def _respond(self, code: int, payload: Any,
+                     extra_headers: Optional[Dict[str, str]] = None
+                     ) -> None:
             body = (payload if isinstance(payload, (bytes, bytearray))
                     else json.dumps(payload).encode())
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for key, value in (extra_headers or {}).items():
+                self.send_header(key, value)
             self._send_traceparent()
             self.end_headers()
             self.wfile.write(body)
@@ -168,6 +173,28 @@ def _make_handler(state: _ProxyState):
                                          "outcome": "200"})
                 PROXY_LATENCY.observe(_time.perf_counter() - t0,
                                       tags={"deployment": dep})
+            except BackpressureError as e:
+                # Admission control shed this request (queue cap or
+                # EWMA overload): 503 + Retry-After, the standard
+                # please-back-off contract. Not an error outcome — the
+                # system is doing exactly what it should under
+                # overload — and never a latency observation.
+                PROXY_REQUESTS.inc(tags={"deployment": dep,
+                                         "outcome": "503"})
+                if streaming_started:
+                    return
+                import math as _math
+                retry_after = max(1, int(_math.ceil(e.retry_after_s)))
+                try:
+                    self._respond(
+                        503,
+                        {"error": "deployment overloaded",
+                         "deployment": e.deployment,
+                         "reason": e.reason,
+                         "retry_after_s": e.retry_after_s},
+                        extra_headers={"Retry-After": str(retry_after)})
+                except (OSError, ValueError):
+                    pass
             except Exception as e:  # noqa: BLE001 — surface as 500
                 PROXY_REQUESTS.inc(tags={"deployment": dep,
                                          "outcome": "error"})

@@ -75,6 +75,7 @@ class Replica:
     # -- request path --
 
     def handle_request(self, method_name: str, args_blob: bytes) -> Any:
+        from ray_tpu.serve.admission import BackpressureError, Shed
         with self._lock:
             if self._ongoing >= self.max_ongoing:
                 REPLICA_REQUESTS.inc(
@@ -99,6 +100,13 @@ class Replica:
                     import asyncio
                     result = asyncio.run(result)
                 return result
+        except BackpressureError as exc:
+            # The handler itself shed (e.g. the LLM engine's reject-
+            # before-enqueue hook). A sentinel — not a raised error —
+            # so the router distinguishes "workload overloaded, tell
+            # the client" from a replica crash it should retry.
+            outcome = "shed"
+            return Shed(exc.retry_after_s, exc.reason)
         except BaseException:
             outcome = "error"
             raise
@@ -117,7 +125,11 @@ class Replica:
                                 ongoing: int) -> None:
         tags = {"deployment": self.deployment_name}
         REPLICA_REQUESTS.inc(tags={**tags, "outcome": outcome})
-        REPLICA_LATENCY.observe(seconds, tags=tags)
+        if outcome != "shed":
+            # shed requests never executed: their (near-zero) timings
+            # would drag p50/p99 down exactly when overload makes the
+            # latency series most load-bearing
+            REPLICA_LATENCY.observe(seconds, tags=tags)
         REPLICA_ONGOING.set(float(ongoing),
                             tags={**tags, "replica": self.replica_id})
 
@@ -129,9 +141,15 @@ class Replica:
           {"type": "single", "data": value}  — handler returned a value
           {"type": "stream"}                 — handler is a generator;
                                                chunks follow, one per item
-        Backpressure accounting covers the whole stream lifetime.
+        Backpressure accounting covers the whole stream lifetime. A
+        handler that raises BackpressureError (LLM engine saturation)
+        yields a {"type": "shed", "retry_after_s", "reason"} header —
+        the router forwards that verdict to the client instead of
+        retrying another replica.
         """
         import inspect
+
+        from ray_tpu.serve.admission import BackpressureError
 
         with self._lock:
             admitted = self._ongoing < self.max_ongoing
@@ -181,6 +199,10 @@ class Replica:
                         loop.close()
                 else:
                     yield {"type": "single", "data": result}
+        except BackpressureError as exc:
+            outcome = "shed"
+            yield {"type": "shed", "retry_after_s": exc.retry_after_s,
+                   "reason": exc.reason}
         except BaseException:
             outcome = "error"
             raise

@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 _DEPLOYMENT_FIELDS = ("name", "num_replicas", "max_ongoing_requests",
+                      "max_queued_requests", "shed_queue_wait_s",
                       "autoscaling_config", "ray_actor_options",
                       "user_config")
 _APP_FIELDS = ("name", "import_path", "route_prefix", "args",
@@ -36,6 +37,8 @@ class DeploymentSchema:
     name: str
     num_replicas: Optional[int] = None
     max_ongoing_requests: Optional[int] = None
+    max_queued_requests: Optional[int] = None
+    shed_queue_wait_s: Optional[float] = None
     autoscaling_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
     user_config: Optional[Dict[str, Any]] = None

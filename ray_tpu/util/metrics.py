@@ -174,6 +174,71 @@ class Histogram(Metric):
         _record("histogram", self._name, self._tags(tags), value,
                 self._boundaries)
 
+    def percentile(self, q: float,
+                   tags: Optional[Dict[str, str]] = None
+                   ) -> Optional[float]:
+        """Interpolated quantile (q in [0, 1]) of this histogram's
+        labeled series, read straight from the registry — admission
+        control and autoscaling policies use this instead of scraping
+        the /metrics exposition text. Driver-side only: workers forward
+        updates to the driver and hold no local counts. Returns None
+        when the series has no observations."""
+        return histogram_percentile(self._name, q, self._tags(tags))
+
+    def snapshot(self, tags: Optional[Dict[str, str]] = None
+                 ) -> Optional[tuple]:
+        """(boundaries, bucket_counts, sum, count) copy of one labeled
+        series, or None. Two snapshots' bucket-count difference feeds
+        percentile_from_counts() for WINDOWED quantiles (lifetime
+        histograms never forget a slow start; control loops need the
+        recent distribution)."""
+        return histogram_snapshot(self._name, self._tags(tags))
+
+
+def histogram_snapshot(name: str, tags: Optional[Dict[str, str]] = None
+                       ) -> Optional[tuple]:
+    key = (name, tuple(sorted((tags or {}).items())))
+    with _registry.lock:
+        entry = _registry.histograms.get(key)
+        if entry is None:
+            return None
+        bounds, buckets, total, count = entry
+        return list(bounds), list(buckets), float(total), int(count)
+
+
+def percentile_from_counts(bounds: Sequence[float],
+                           buckets: Sequence[float],
+                           q: float) -> Optional[float]:
+    """Interpolated quantile from histogram bucket counts. ``buckets``
+    has len(bounds)+1 entries (last = overflow). Linear interpolation
+    inside the containing bucket; the unbounded overflow bucket reports
+    the top boundary (the histogram can't resolve beyond it)."""
+    count = sum(buckets)
+    if count <= 0:
+        return None
+    q = min(1.0, max(0.0, q))
+    rank = q * count
+    cumulative = 0.0
+    for i, n in enumerate(buckets[:-1]):
+        prev = cumulative
+        cumulative += n
+        if cumulative >= rank and n > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - prev) / n
+            return lo + (hi - lo) * frac
+    return float(bounds[-1])
+
+
+def histogram_percentile(name: str, q: float,
+                         tags: Optional[Dict[str, str]] = None
+                         ) -> Optional[float]:
+    snap = histogram_snapshot(name, tags)
+    if snap is None:
+        return None
+    bounds, buckets, _total, _count = snap
+    return percentile_from_counts(bounds, buckets, q)
+
 
 def _esc_label(value) -> str:
     # Prometheus text-format label escaping: backslash, double-quote, and

@@ -1,0 +1,425 @@
+"""Admission control and backpressure: unit semantics of the
+AdmissionController (queue caps, EWMA overload, Retry-After), SLO
+autoscaling policy hysteresis, rejection-penalty decay, and the wired
+serve chain (503 + Retry-After through the proxy, BackpressureError on
+the handle path, sheds excluded from latency histograms).
+"""
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.admission import (
+    AdmissionController, BackpressureError, Shed, _Ewma,
+    get_admission_controller, reset_admission)
+
+
+@pytest.fixture
+def serve_instance(ray_start_shared):
+    yield ray_start_shared
+    serve.shutdown()
+
+
+# -- AdmissionController unit semantics -------------------------------------
+
+
+def test_cap_zero_sheds_only_when_slots_full():
+    ac = AdmissionController("d")
+    ac.configure(max_queued=0, capacity=2)
+    ac.try_acquire()
+    ac.try_acquire()  # both slots busy, no queue allowed ...
+    with pytest.raises(BackpressureError) as ei:
+        ac.try_acquire()
+    assert ei.value.reason == "queue_full"
+    assert ei.value.retryable is True
+    ac.release()
+    ac.try_acquire()  # a freed slot readmits
+    assert ac.queue_depth() == 0
+
+
+def test_cap_one_allows_one_waiter():
+    ac = AdmissionController("d")
+    ac.configure(max_queued=1, capacity=1)
+    ac.try_acquire()           # occupies the slot
+    ac.try_acquire()           # the one allowed waiter
+    assert ac.queue_depth() == 1
+    with pytest.raises(BackpressureError):
+        ac.try_acquire()
+
+
+def test_cap_negative_disables_shedding():
+    ac = AdmissionController("d")
+    ac.configure(max_queued=-1, capacity=1)
+    for _ in range(50):
+        ac.try_acquire()
+    assert ac.queue_depth() == 49
+
+
+def test_backpressure_error_pickles_with_fields():
+    import pickle
+    err = BackpressureError("dep", 2.5, "queue_wait_ewma")
+    back = pickle.loads(pickle.dumps(err))
+    assert back.deployment == "dep"
+    assert back.retry_after_s == 2.5
+    assert back.reason == "queue_wait_ewma"
+    assert back.retryable is True
+    shed = pickle.loads(pickle.dumps(Shed(1.5, "engine_saturated")))
+    assert shed.retry_after_s == 1.5 and shed.reason == "engine_saturated"
+
+
+def test_retry_after_bounded():
+    ac = AdmissionController("d")
+    ac.configure(max_queued=0, capacity=1)
+    ac.note_latency(10_000.0)  # absurd latency must not blow the bound
+    ac.try_acquire()
+    with pytest.raises(BackpressureError) as ei:
+        ac.try_acquire()
+    assert 0.1 <= ei.value.retry_after_s <= 30.0
+
+
+def test_ewma_queue_wait_sheds_then_recovers():
+    ac = AdmissionController("d")
+    ac.configure(max_queued=100, capacity=4, shed_queue_wait_s=0.05)
+    ac._queue_wait = _Ewma(halflife_s=0.05)  # fast decay for the test
+    ac.note_queue_wait(5.0)
+    with pytest.raises(BackpressureError) as ei:
+        ac.try_acquire()
+    assert ei.value.reason == "queue_wait_ewma"
+    # silence decays the EWMA toward zero -> admission recovers
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            ac.try_acquire()
+            break
+        except BackpressureError:
+            time.sleep(0.02)
+    else:
+        pytest.fail("EWMA never decayed below the shed threshold")
+
+
+def test_take_max_queue_depth_resets_peak():
+    ac = AdmissionController("d")
+    ac.configure(max_queued=10, capacity=1)
+    for _ in range(4):
+        ac.try_acquire()
+    assert ac.take_max_queue_depth() == 3
+    for _ in range(4):
+        ac.release()
+    # depth was still 3 when the last window was taken, so that is the
+    # (true) peak of the second window; the third window starts empty
+    assert ac.take_max_queue_depth() == 3
+    assert ac.take_max_queue_depth() == 0
+
+
+def test_registry_is_per_deployment():
+    reset_admission()
+    a = get_admission_controller("a")
+    b = get_admission_controller("b")
+    assert a is get_admission_controller("a")
+    assert a is not b
+    a.configure(max_queued=0, capacity=1)
+    a.try_acquire()
+    with pytest.raises(BackpressureError):
+        a.try_acquire()
+    b.try_acquire()  # b's cap is untouched by a's overload
+    reset_admission()
+
+
+# -- histogram percentile readout (util/metrics) ----------------------------
+
+
+def test_percentile_from_counts_interpolates():
+    from ray_tpu.util.metrics import percentile_from_counts
+    bounds = [1.0, 2.0, 4.0]
+    # 10 obs in (1, 2]: the median interpolates inside that bucket
+    assert percentile_from_counts(bounds, [0, 10, 0, 0], 0.5) == \
+        pytest.approx(1.5, abs=0.06)
+    # overflow bucket clamps to the top bound
+    assert percentile_from_counts(bounds, [0, 0, 0, 5], 0.99) == 4.0
+    assert percentile_from_counts(bounds, [0, 0, 0, 0], 0.5) is None
+
+
+def test_histogram_percentile_readout():
+    from ray_tpu.util.metrics import Histogram
+    h = Histogram("t_adm_pctl_seconds", "t", tag_keys=("k",),
+                  boundaries=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.5, 0.6, 5.0):
+        h.observe(v, tags={"k": "x"})
+    p50 = h.percentile(0.5, tags={"k": "x"})
+    assert 0.1 <= p50 <= 1.0
+    assert h.percentile(0.5, tags={"k": "missing"}) is None
+    bounds, buckets, total, count = h.snapshot(tags={"k": "x"})
+    assert count == 4 and len(buckets) == len(bounds) + 1
+
+
+# -- SLO autoscaling policy -------------------------------------------------
+
+
+def _slo_cfg(**kw):
+    from ray_tpu.serve.config import AutoscalingConfig
+    defaults = dict(policy="slo", min_replicas=1, max_replicas=4,
+                    target_queue_depth=4.0, upscale_delay_s=1.0,
+                    downscale_delay_s=2.0, slo_stats_staleness_s=3.0)
+    defaults.update(kw)
+    return AutoscalingConfig(**defaults)
+
+
+def test_slo_policy_upscale_needs_sustained_breach():
+    from ray_tpu.autoscaler.policy import ReplicaMetrics, make_policy
+    pol = make_policy("slo")
+    cfg = _slo_cfg()
+    m = ReplicaMetrics(running_replicas=1, queue_depth=12.0,
+                       stats_age_s=0.0)
+    # breach starts: no change before upscale_delay_s elapses
+    assert pol.desired_replicas(m, cfg, 1, now=100.0) == 1
+    assert pol.desired_replicas(m, cfg, 1, now=100.5) == 1
+    # sustained past the delay: proportional step (12/4 -> +2)
+    assert pol.desired_replicas(m, cfg, 1, now=101.2) == 3
+    # the next step needs its own sustained window (re-armed)
+    assert pol.desired_replicas(m, cfg, 3, now=101.3) == 3
+
+
+def test_slo_policy_downscale_hysteresis():
+    from ray_tpu.autoscaler.policy import ReplicaMetrics, make_policy
+    pol = make_policy("slo")
+    cfg = _slo_cfg()
+    calm = ReplicaMetrics(running_replicas=3, queue_depth=0.0,
+                          stats_age_s=0.0)
+    assert pol.desired_replicas(calm, cfg, 3, now=10.0) == 3
+    # a blip above half-threshold resets the calm window
+    busyish = ReplicaMetrics(running_replicas=3, queue_depth=3.0,
+                             stats_age_s=0.0)
+    assert pol.desired_replicas(busyish, cfg, 3, now=11.0) == 3
+    assert pol.desired_replicas(calm, cfg, 3, now=11.5) == 3
+    assert pol.desired_replicas(calm, cfg, 3, now=13.0) == 3
+    # sustained calm: one replica at a time, window re-armed
+    assert pol.desired_replicas(calm, cfg, 3, now=13.6) == 2
+    assert pol.desired_replicas(calm, cfg, 2, now=14.0) == 2
+    assert pol.desired_replicas(calm, cfg, 2, now=15.7) == 1
+    # never below min_replicas
+    assert pol.desired_replicas(calm, cfg, 1, now=30.0) == 1
+
+
+def test_slo_policy_stale_stats_never_upscale():
+    from ray_tpu.autoscaler.policy import ReplicaMetrics, make_policy
+    pol = make_policy("slo")
+    cfg = _slo_cfg()
+    stale = ReplicaMetrics(running_replicas=1, queue_depth=100.0,
+                           stats_age_s=60.0)
+    assert pol.desired_replicas(stale, cfg, 1, now=0.0) == 1
+    assert pol.desired_replicas(stale, cfg, 1, now=5.0) == 1
+
+
+def test_slo_policy_p99_term():
+    from ray_tpu.autoscaler.policy import ReplicaMetrics, make_policy
+    pol = make_policy("slo")
+    cfg = _slo_cfg(p99_latency_slo_s=0.5)
+    slow = ReplicaMetrics(running_replicas=1, queue_depth=0.0,
+                          p99_latency_s=2.0, stats_age_s=0.0)
+    assert pol.desired_replicas(slow, cfg, 1, now=0.0) == 1
+    assert pol.desired_replicas(slow, cfg, 1, now=1.5) == 2
+
+
+def test_make_policy_unknown_raises():
+    from ray_tpu.autoscaler import make_policy
+    with pytest.raises(ValueError):
+        make_policy("nope")
+
+
+# -- rejection-penalty decay (router) ---------------------------------------
+
+
+def test_rejection_penalty_decays_to_zero():
+    from ray_tpu.serve.router import Router
+    r = Router("t_penalty_dep", controller=None)
+    r.reject_penalty_tau_s = 0.05
+    with r._lock:
+        r._note_rejection_locked("a")
+        r._note_rejection_locked("a")
+    assert r.rejection_penalty("a") > 1.0  # gated from affinity
+    deadline = time.monotonic() + 5.0
+    while r.rejection_penalty("a") > 0.0:
+        if time.monotonic() > deadline:
+            pytest.fail("penalty never decayed to zero")
+        time.sleep(0.02)
+    assert "a" not in r._reject_penalty  # entry dropped at the floor
+
+
+def test_recovered_replica_regains_affinity_share():
+    """A cache-affine replica that rejected twice sits out prefix
+    routing while its penalty is hot, then wins the prompt again once
+    the penalty has decayed (recovery regains traffic share)."""
+    from ray_tpu.serve.prefix_router import PrefixAwareRouter
+
+    class _DeadHandle:
+        # _queue_len's probe fails fast -> both candidates tie
+        def __getattr__(self, name):
+            raise AttributeError(name)
+
+    r = PrefixAwareRouter("t_affinity_dep", controller=None)
+    r.reject_penalty_tau_s = 0.05
+    r._replicas = [("a", _DeadHandle()), ("b", _DeadHandle())]
+    prompt = "You are a helpful assistant. Question one" * 3
+    r.tree.insert(prompt, "a")
+    assert r._choose_for_prompt(prompt)[0] == "a"
+    with r._lock:
+        r._note_rejection_locked("a")
+        r._note_rejection_locked("a")
+    assert r.rejection_penalty("a") >= 1.0
+    # while hot, affinity is skipped: pow-2 over {a, b} (ties resolve
+    # arbitrarily, so only assert the penalty gate is active)
+    deadline = time.monotonic() + 5.0
+    while r.rejection_penalty("a") > 0.0:
+        if time.monotonic() > deadline:
+            pytest.fail("penalty never decayed")
+        time.sleep(0.02)
+    assert r._choose_for_prompt(prompt)[0] == "a"  # share regained
+
+
+# -- engine reject-before-enqueue -------------------------------------------
+
+
+def test_engine_sheds_before_enqueue():
+    from ray_tpu.llm import (
+        ContinuousBatchingEngine, EngineConfig, EngineSaturatedError,
+        GenerationRequest)
+    from ray_tpu.models.llama import LlamaConfig
+    eng = ContinuousBatchingEngine(EngineConfig(
+        model=LlamaConfig.tiny(max_seq_len=64, attention="reference",
+                               remat=False),
+        max_batch=2, max_seq=64, max_waiting_requests=1))
+    eng.add_request(GenerationRequest(
+        request_id="r1", prompt_ids=[1, 2, 3], max_tokens=1))
+    with pytest.raises(EngineSaturatedError) as ei:
+        eng.add_request(GenerationRequest(
+            request_id="r2", prompt_ids=[1, 2, 3], max_tokens=1))
+    assert ei.value.waiting == 1 and ei.value.cap == 1
+    assert len(eng.waiting) == 1  # the shed request was NOT enqueued
+
+
+# -- wired chain (cluster) --------------------------------------------------
+
+
+def test_handle_sheds_with_backpressure_and_recovers(serve_instance):
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class Slow:
+        def __call__(self, req):
+            time.sleep(req.get("sleep", 0))
+            return "done"
+
+    handle = serve.run(Slow.bind(), name="shed_app")
+    # warm-up: configures the admission controller from the deployment
+    # config (capacity = 1 replica * 1 ongoing, cap 0)
+    assert handle.remote({}).result(timeout_s=30) == "done"
+    blocker = handle.remote({"sleep": 1.5})
+    time.sleep(0.2)  # let the blocker occupy the only slot
+    with pytest.raises(BackpressureError) as ei:
+        handle.remote({})
+    assert ei.value.retryable is True
+    assert ei.value.retry_after_s > 0
+    assert ei.value.deployment == "Slow"
+    assert blocker.result(timeout_s=30) == "done"
+    # the slot freed: a retry after the shed now succeeds
+    assert handle.remote({}).result(timeout_s=30) == "done"
+
+
+def test_shed_excluded_from_latency_histogram(serve_instance):
+    from ray_tpu.util.metrics import histogram_snapshot
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class Slow2:
+        def __call__(self, req):
+            time.sleep(req.get("sleep", 0))
+            return "ok"
+
+    handle = serve.run(Slow2.bind(), name="shed_hist_app")
+    handle.remote({}).result(timeout_s=30)
+    tags = {"deployment": "Slow2"}
+
+    def latency_count():
+        snap = histogram_snapshot(
+            "ray_tpu_serve_request_latency_seconds", tags=tags)
+        return 0 if snap is None else snap[3]
+
+    before = latency_count()
+    blocker = handle.remote({"sleep": 1.0})
+    time.sleep(0.2)
+    for _ in range(5):
+        with pytest.raises(BackpressureError):
+            handle.remote({})
+    blocker.result(timeout_s=30)
+    # only the blocker's completion was observed; 5 sheds were not
+    assert latency_count() == before + 1
+
+
+def test_caps_are_per_deployment(serve_instance):
+    @serve.deployment(name="capped", max_ongoing_requests=1,
+                      max_queued_requests=0)
+    class Capped:
+        def __call__(self, req):
+            time.sleep(req.get("sleep", 0))
+            return "capped"
+
+    @serve.deployment(name="open")
+    class Open:
+        def __call__(self, req):
+            return "open"
+
+    capped = serve.run(Capped.bind(), name="cap_app",
+                       route_prefix="/capped")
+    opened = serve.run(Open.bind(), name="open_app",
+                       route_prefix="/open")
+    capped.remote({}).result(timeout_s=30)
+    blocker = capped.remote({"sleep": 1.0})
+    time.sleep(0.2)
+    with pytest.raises(BackpressureError):
+        capped.remote({})
+    # the other deployment's admission state is independent
+    assert opened.remote({}).result(timeout_s=30) == "open"
+    blocker.result(timeout_s=30)
+
+
+def test_http_503_with_retry_after(serve_instance):
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class SlowHttp:
+        def __call__(self, req):
+            time.sleep(float(req.get("sleep", 0)))
+            return {"ok": True}
+
+    serve.start(proxy=True, http_options=serve.HTTPOptions(port=0))
+    port = serve._proxy.port
+    serve.run(SlowHttp.bind(), name="http503_app", route_prefix="/s")
+
+    def post(payload, timeout=30):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/s",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    assert post({}) == {"ok": True}  # warm-up configures admission
+    blocker = threading.Thread(target=post, args=({"sleep": 1.5},))
+    blocker.start()
+    try:
+        time.sleep(0.4)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({})
+        err = ei.value
+        assert err.code == 503
+        retry_after = err.headers.get("Retry-After")
+        assert retry_after is not None and int(retry_after) >= 1
+        body = json.loads(err.read())
+        assert body["deployment"] == "SlowHttp"
+        assert body["reason"] == "queue_full"
+        assert body["retry_after_s"] > 0
+    finally:
+        blocker.join(timeout=30)
+    assert post({}) == {"ok": True}  # recovered after the blocker

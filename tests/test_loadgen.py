@@ -1,0 +1,203 @@
+"""Load harness: traffic model statistics (heavy-tailed arrivals,
+burst episodes, prefix mix), open-loop report accounting, and a
+marked-slow soak run against a real local deployment.
+"""
+
+import json
+import random
+
+import pytest
+
+from ray_tpu.serve.loadgen import (
+    LoadgenConfig, PromptMix, _build_report, _percentile, _Sample,
+    arrival_offsets, http_sender, run_load)
+
+
+def _gaps(cfg, n=4000):
+    rng = random.Random(7)
+    it = arrival_offsets(cfg, rng)
+    offs = [next(it) for _ in range(n)]
+    return [b - a for a, b in zip([0.0] + offs, offs)]
+
+
+@pytest.mark.parametrize("arrival", ["poisson", "lognormal", "pareto"])
+def test_arrival_mean_matches_rate(arrival):
+    cfg = LoadgenConfig(rate=100.0, arrival=arrival, sigma=1.0,
+                        pareto_alpha=2.0)
+    gaps = _gaps(cfg)
+    mean = sum(gaps) / len(gaps)
+    # E[gap] = 1/rate = 10ms for every distribution; generous bounds
+    # because pareto's sample mean converges slowly
+    assert 0.006 < mean < 0.016, (arrival, mean)
+    assert all(g >= 0.0 for g in gaps)
+
+
+def test_heavy_tail_is_heavier_than_poisson():
+    base = dict(rate=100.0, sigma=2.0, pareto_alpha=1.2)
+    pois = sorted(_gaps(LoadgenConfig(arrival="poisson", **base)))
+    logn = sorted(_gaps(LoadgenConfig(arrival="lognormal", **base)))
+    # same mean, but the lognormal's p99.9/median ratio dwarfs the
+    # exponential's — that's what "heavy-tailed" buys the harness
+    def tail_ratio(g):
+        return g[int(len(g) * 0.999)] / max(g[len(g) // 2], 1e-12)
+    assert tail_ratio(logn) > 2 * tail_ratio(pois)
+
+
+def test_unknown_arrival_raises():
+    with pytest.raises(ValueError):
+        _gaps(LoadgenConfig(arrival="bogus"), n=1)
+
+
+def test_burst_episodes_compress_gaps():
+    quiet = LoadgenConfig(rate=50.0, arrival="uniform")
+    burst = LoadgenConfig(rate=50.0, arrival="uniform",
+                          burst_factor=5.0, burst_every_s=1.0,
+                          burst_len_s=0.5)
+    n_quiet = sum(1 for _ in _bounded(quiet, 10.0))
+    n_burst = sum(1 for _ in _bounded(burst, 10.0))
+    # half the schedule runs at 5x: expect ~3x the arrivals
+    assert n_burst > 2 * n_quiet
+
+
+def _bounded(cfg, horizon_s):
+    rng = random.Random(3)
+    for off in arrival_offsets(cfg, rng):
+        if off > horizon_s:
+            return
+        yield off
+
+
+def test_prompt_mix_prefix_groups_and_models():
+    cfg = LoadgenConfig(prefix_groups=3, prefix_len=48, unique_len=6,
+                        model_ids=("m1", "m2"))
+    rng = random.Random(1)
+    mix = PromptMix(cfg, rng)
+    payloads = [mix.make(i, rng) for i in range(12)]
+    # prompts in the same group share a long prefix but differ overall
+    p0, p3 = payloads[0]["prompt"], payloads[3]["prompt"]
+    assert p0 != p3
+    assert p0.rsplit(" ", 1)[0] == p3.rsplit(" ", 1)[0]
+    # different groups have different prefixes
+    assert payloads[0]["prompt"].split(":")[0] != \
+        payloads[1]["prompt"].split(":")[0]
+    # model ids round-robin
+    assert [p["model"] for p in payloads[:4]] == ["m1", "m2", "m1", "m2"]
+
+
+def test_percentile_helper():
+    assert _percentile([], 0.5) is None
+    assert _percentile([4.0], 0.99) == 4.0
+    vals = [float(i) for i in range(1, 101)]
+    assert _percentile(vals, 0.5) == pytest.approx(50.5)
+    assert _percentile(vals, 0.99) == pytest.approx(99.01)
+
+
+def test_build_report_accounting():
+    cfg = LoadgenConfig(rate=10.0)
+    samples = ([_Sample("ok", latency_s=0.010, ttft_s=0.004)] * 8
+               + [_Sample("shed", retry_after_s=0.5)]
+               + [_Sample("error")])
+    r = _build_report(cfg, samples, offered=10, wall_s=2.0,
+                      peak_depth=3)
+    assert r.offered == 10 and r.ok == 8 and r.shed == 1
+    assert r.errors == 1
+    assert r.shed_rate == pytest.approx(0.1)
+    assert r.achieved_rps == pytest.approx(4.0)
+    assert r.p99_ms == pytest.approx(10.0)
+    assert r.ttft_p50_ms == pytest.approx(4.0)
+    assert r.retry_after_mean_s == pytest.approx(0.5)
+    assert r.max_queue_depth == 3
+    text = r.format()
+    assert "shed" in text and "p99" in text
+
+
+def test_run_load_open_loop_with_fake_sender():
+    """No cluster: a fake sender that sheds every third request.
+    The report's categories must sum to the offered count."""
+    import itertools
+    counter = itertools.count()
+
+    def sender(payload):
+        assert "seq" in payload
+        if next(counter) % 3 == 2:
+            return "shed", None, 0.25
+        return "ok", None, None
+
+    cfg = LoadgenConfig(rate=200.0, duration_s=0.5, arrival="uniform",
+                        concurrency=4, timeout_s=5.0)
+    r = run_load(cfg, sender)
+    assert r.offered == r.ok + r.shed + r.errors
+    assert r.offered >= 50
+    assert 0.2 < r.shed_rate < 0.45
+    assert r.errors == 0
+    assert r.p99_ms is not None and r.p99_ms >= 0.0
+
+
+def test_run_load_sender_exception_counts_as_error():
+    def sender(payload):
+        raise RuntimeError("boom")
+
+    cfg = LoadgenConfig(rate=100.0, duration_s=0.2, arrival="uniform",
+                        concurrency=2, timeout_s=2.0)
+    r = run_load(cfg, sender)
+    assert r.errors == r.offered > 0
+
+
+def test_http_sender_maps_503_to_shed():
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            if self.path == "/shed":
+                self.send_response(503)
+                self.send_header("Retry-After", "2")
+                self.end_headers()
+                self.wfile.write(b"{}")
+            else:
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(b"{\"ok\": true}")
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    try:
+        ok = http_sender(f"http://127.0.0.1:{port}/ok")({})
+        assert ok[0] == "ok" and ok[1] is not None
+        shed = http_sender(f"http://127.0.0.1:{port}/shed")({})
+        assert shed[0] == "shed" and shed[2] == 2.0
+    finally:
+        httpd.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.watchdog(300)
+def test_soak_self_deploy_writes_bench_json(tmp_path):
+    """Soak: the CLI end to end — self-deployed echo app, heavy-tailed
+    arrivals with bursts, prefix mix, BENCH_serve.json emission."""
+    from ray_tpu.serve.loadgen import main
+    out = tmp_path / "BENCH_serve.json"
+    rc = main(["--rate", "60", "--duration", "20",
+               "--arrival", "lognormal", "--sigma", "1.5",
+               "--burst-factor", "4", "--burst-every", "5",
+               "--burst-len", "1", "--prefix-groups", "4",
+               "--model-ids", "m1,m2", "--replicas", "2",
+               "--max-ongoing", "8", "--max-queued", "32",
+               "--work-ms", "5", "--json", str(out)])
+    assert rc == 0
+    rec = json.loads(out.read_text())
+    assert rec["bench"] == "serve_loadgen"
+    metrics = {p["metric"]: p["value"] for p in rec["parsed"]}
+    assert metrics["serve_req_per_s"] > 10
+    assert "serve_p99_latency" in metrics
+    assert 0.0 <= metrics["serve_shed_rate"] <= 1.0
+    report = rec["report"]
+    assert report["offered"] == (report["ok"] + report["shed"]
+                                 + report["errors"])
+    assert report["max_queue_depth"] <= 32

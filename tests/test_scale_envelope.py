@@ -195,3 +195,144 @@ def test_envelope_8_real_daemon_processes(tmp_path):
             if p.poll() is None:
                 p.kill()
         ray_tpu.shutdown()
+
+
+# -- serve envelope: load harness + admission + SLO autoscaling ----------
+# (ray_tpu/serve/loadgen.py drives the full chain; admission control
+# bounds queues; the "slo" policy scales replicas on sustained breach)
+
+@pytest.fixture
+def serve_envelope_head():
+    # 4 CPU slots: room for max_replicas=3 plus headroom, so the SLO
+    # autoscaler's scale-up is placeable (envelope_head's 2 are not)
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _echo_deployment(serve, **opts):
+    from ray_tpu.serve.loadgen import EchoServer
+    defaults = dict(name="envelope_echo", num_replicas=1,
+                    max_ongoing_requests=4, max_queued_requests=64)
+    defaults.update(opts)
+    return serve.deployment(**defaults)(EchoServer)
+
+
+def test_serve_envelope_stated_rate_bounded_p99(serve_envelope_head):
+    """At the stated rate (30 req/s, 5ms work, 4 slots) nothing
+    sheds, p99 stays bounded, and the queue never nears its cap."""
+    from ray_tpu import serve
+    from ray_tpu.serve.admission import get_admission_controller
+    from ray_tpu.serve.loadgen import (
+        LoadgenConfig, handle_sender, run_load)
+
+    dep = _echo_deployment(serve)
+    try:
+        handle = serve.run(dep.bind(5.0), name="envelope")
+        handle.remote({"seq": -1}).result(timeout_s=30)  # warm-up
+        report = run_load(
+            LoadgenConfig(rate=30.0, duration_s=3.0, concurrency=16,
+                          timeout_s=20.0),
+            handle_sender(handle, timeout_s=20.0),
+            admission=get_admission_controller("envelope_echo"))
+        assert report.ok > 0
+        assert report.shed == 0 and report.errors == 0
+        assert report.p99_ms is not None and report.p99_ms < 2_000.0
+        assert report.max_queue_depth < 64
+    finally:
+        serve.shutdown()
+
+
+def test_serve_envelope_10x_overload_sheds_bounded_queue(serve_envelope_head):
+    """At 10x the stated rate the chain sheds (typed BackpressureError
+    on the handle path) and the queue NEVER exceeds its cap."""
+    from ray_tpu import serve
+    from ray_tpu.serve.admission import get_admission_controller
+    from ray_tpu.serve.loadgen import (
+        LoadgenConfig, handle_sender, run_load)
+
+    cap = 4
+    dep = _echo_deployment(serve, max_ongoing_requests=2,
+                           max_queued_requests=cap)
+    try:
+        handle = serve.run(dep.bind(20.0), name="envelope")
+        handle.remote({"seq": -1}).result(timeout_s=30)  # warm-up
+        report = run_load(
+            LoadgenConfig(rate=300.0, duration_s=3.0, concurrency=32,
+                          timeout_s=20.0),
+            handle_sender(handle, timeout_s=20.0),
+            admission=get_admission_controller("envelope_echo"))
+        assert report.ok > 0            # still serving under overload
+        assert report.shed > 0          # overload WAS shed, not queued
+        assert report.errors == 0       # sheds are typed, not failures
+        assert report.max_queue_depth <= cap
+        # shed clients got a usable backoff hint
+        assert report.retry_after_mean_s is not None
+        assert report.retry_after_mean_s > 0
+    finally:
+        serve.shutdown()
+
+
+def test_serve_envelope_slo_autoscaler_up_then_down(serve_envelope_head):
+    """Sustained queue-depth breach scales replicas up; the calm after
+    the storm scales back down with hysteresis (one at a time)."""
+    import threading as _threading
+
+    from ray_tpu import serve
+    from ray_tpu.serve.admission import get_admission_controller
+    from ray_tpu.serve.loadgen import (
+        LoadgenConfig, handle_sender, run_load)
+
+    dep = _echo_deployment(
+        serve, max_ongoing_requests=2, max_queued_requests=200,
+        autoscaling_config=dict(
+            policy="slo", min_replicas=1, max_replicas=3,
+            target_queue_depth=2.0, upscale_delay_s=0.4,
+            downscale_delay_s=1.0, slo_stats_staleness_s=2.0))
+    try:
+        handle = serve.run(dep.bind(40.0), name="envelope")
+        handle.remote({"seq": -1}).result(timeout_s=30)  # warm-up
+
+        peak_running = [1]
+
+        def watch():
+            while not done.is_set():
+                st = serve.status().get("envelope_echo", {})
+                peak_running[0] = max(peak_running[0],
+                                      st.get("running_replicas", 0))
+                done.wait(0.2)
+
+        done = _threading.Event()
+        watcher = _threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        try:
+            report = run_load(
+                LoadgenConfig(rate=120.0, duration_s=5.0,
+                              concurrency=32, timeout_s=30.0),
+                handle_sender(handle, timeout_s=30.0),
+                admission=get_admission_controller("envelope_echo"))
+            # the breach was real: the queue sat past the target
+            assert report.max_queue_depth > 2
+            deadline = time.time() + 20
+            while peak_running[0] < 2 and time.time() < deadline:
+                time.sleep(0.2)
+        finally:
+            done.set()
+            watcher.join(timeout=5)
+        assert peak_running[0] >= 2, (
+            f"SLO policy never scaled up (peak {peak_running[0]})")
+
+        # idle: stats go stale -> sustained calm -> back down to min,
+        # one replica per downscale window
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = serve.status().get("envelope_echo", {})
+            if (st.get("target_replicas") == 1
+                    and st.get("running_replicas") == 1):
+                break
+            time.sleep(0.3)
+        st = serve.status().get("envelope_echo", {})
+        assert st.get("target_replicas") == 1, st
+        assert st.get("running_replicas") == 1, st
+    finally:
+        serve.shutdown()
