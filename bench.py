@@ -28,9 +28,21 @@ import time
 
 
 def _cpu_env() -> dict:
-    """A copy of the env forcing a clean CPU JAX backend."""
+    """A copy of the env forcing a clean CPU JAX backend. Default 1
+    device; RTPU_BENCH_CPU_DEVICES>1 builds a forced multi-device host
+    so the gradient-sync toggles exercise real collectives off-TPU."""
     from __graft_entry__ import cpu_mesh_env
-    return cpu_mesh_env(1)
+    return cpu_mesh_env(int(os.environ.get("RTPU_BENCH_CPU_DEVICES",
+                                           "1")))
+
+
+def _sync_toggles() -> tuple:
+    """(grad_compression, zero1) from the env — the round-7 gradient-
+    sync levers, recorded verbatim in the BENCH json."""
+    comp = os.environ.get("RTPU_BENCH_GRAD_COMPRESSION", "").strip()
+    comp = comp if comp in ("int8", "fp8") else None
+    zero1 = os.environ.get("RTPU_BENCH_ZERO1", "") not in ("", "0")
+    return comp, zero1
 
 
 def _run_child(args, env, timeout_s):
@@ -118,11 +130,13 @@ def main():
         print(json.dumps(parsed))
         return
     # Last resort: a parseable line that says exactly what went wrong.
+    comp, zero1 = _sync_toggles()
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
         "degraded": "no-backend",
         "tpu_error": tpu_error, "cpu_error": diag,
+        "grad_compression": comp, "zero1": zero1,
     }))
 
 
@@ -145,7 +159,8 @@ def peak_flops(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
-def _bench_config(cfg, batch, seq, steps, devices):
+def _bench_config(cfg, batch, seq, steps, devices,
+                  grad_compression=None, zero1=False):
     """One measured config -> metrics dict, or raises (e.g. OOM)."""
     import jax
     import numpy as np
@@ -157,31 +172,43 @@ def _bench_config(cfg, batch, seq, steps, devices):
     batch = batch * n_chips
     params = llama_init(jax.random.PRNGKey(0), cfg)
     opt = optax.adamw(3e-4, weight_decay=0.01)
-    opt_state = opt.init(params)
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0,
                                 cfg.vocab_size)
     targets = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0,
                                  cfg.vocab_size)
-    if n_chips > 1:
-        # Shard the batch over a data-axis mesh, so dividing throughput
-        # by n_chips below is honest on multi-chip hosts (an unsharded
-        # step would run on device 0 only).
+    use_shard_map = grad_compression is not None or zero1
+    if use_shard_map:
+        train_step, opt_state = _shard_map_step(
+            cfg, opt, params, devices, grad_compression, zero1)
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
         mesh = Mesh(np.asarray(devices), ("data",))
         data_sharding = NamedSharding(mesh, P("data"))
-        repl = NamedSharding(mesh, P())
         tokens = jax.device_put(tokens, data_sharding)
         targets = jax.device_put(targets, data_sharding)
-        params = jax.device_put(params, repl)
-        opt_state = jax.device_put(opt_state, repl)
+        params = jax.device_put(params, NamedSharding(mesh, P()))
+    else:
+        opt_state = opt.init(params)
+        if n_chips > 1:
+            # Shard the batch over a data-axis mesh, so dividing
+            # throughput by n_chips below is honest on multi-chip hosts
+            # (an unsharded step would run on device 0 only).
+            from jax.sharding import (Mesh, NamedSharding,
+                                      PartitionSpec as P)
+            mesh = Mesh(np.asarray(devices), ("data",))
+            data_sharding = NamedSharding(mesh, P("data"))
+            repl = NamedSharding(mesh, P())
+            tokens = jax.device_put(tokens, data_sharding)
+            targets = jax.device_put(targets, data_sharding)
+            params = jax.device_put(params, repl)
+            opt_state = jax.device_put(opt_state, repl)
 
-    @jax.jit
-    def train_step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(
-            lambda p: llama_loss(p, tokens, targets, cfg))(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
+        @jax.jit
+        def train_step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(
+                lambda p: llama_loss(p, tokens, targets, cfg))(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
 
     # Compile + warmup. NOTE: float(loss) is the sync barrier — it
     # transfers the scalar, which forces the full dependency chain
@@ -213,7 +240,124 @@ def _bench_config(cfg, batch, seq, steps, devices):
         "ce_chunk_tokens": cfg.ce_chunk_tokens,
         "device": str(getattr(dev, "device_kind", dev)),
         "final_loss": round(final_loss, 4),
+        "grad_compression": grad_compression,
+        "zero1": bool(zero1),
     }
+
+
+def _shard_map_step(cfg, opt, params, devices, grad_compression, zero1):
+    """Explicit-collective DDP/ZeRO-1 train step over a data mesh.
+
+    The plain bench path lets GSPMD insert the gradient sync; these
+    toggles need the collectives spelled out: quantized_psum /
+    quantized_reduce_scatter from ray_tpu.parallel.collective for the
+    wire-compression lever, and an explicitly sharded optimizer update
+    (reduce-scatter grads → adam on this device's 1/world flat shard of
+    params + moments → all-gather params) for ZeRO-1.
+    Returns (jitted step fn, initial optimizer state placed on the
+    mesh: flat and P("data")-sharded when zero1, replicated otherwise).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.models.llama import llama_loss
+    from ray_tpu.parallel.collective import (quantized_pmean,
+                                             quantized_reduce_scatter)
+
+    world = len(devices)
+    mesh = Mesh(np.asarray(devices), ("data",))
+    block = 256
+
+    def local_grads(p, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda q: llama_loss(q, tokens, targets, cfg))(p)
+        return jax.lax.pmean(loss, "data"), grads
+
+    if not zero1:
+        # replicated update, compressed gradient transport
+        opt_state = jax.device_put(opt.init(params),
+                                   NamedSharding(mesh, P()))
+
+        def step(p, state, tokens, targets):
+            loss, grads = local_grads(p, tokens, targets)
+            grads = jax.tree_util.tree_map(
+                lambda g: quantized_pmean(g, "data",
+                                          dtype=grad_compression),
+                grads)
+            updates, state = opt.update(grads, state, p)
+            p = optax.apply_updates(p, updates)
+            return p, state, loss
+
+        specs = (P(), P(), P("data"), P("data"))
+        out_specs = (P(), P(), P())
+        return jax.jit(shard_map(step, mesh=mesh, in_specs=specs,
+                                 out_specs=out_specs,
+                                 check_rep=False)), opt_state
+
+    # ZeRO-1: flat param vector padded to a (world * block) multiple so
+    # both psum_scatter and the quantized variant split it evenly; the
+    # adam moments live as flat P("data")-sharded arrays — each device
+    # materializes only its 1/world shard.
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s, dtype=np.int64)) if s else 1 for s in shapes]
+    n = int(sum(sizes))
+    padded_n = -(-n // (world * block)) * (world * block)
+    shard_n = padded_n // world
+
+    def flatten_tree(tree):
+        ls = jax.tree_util.tree_leaves(tree)
+        vec = jnp.concatenate(
+            [jnp.ravel(l).astype(jnp.float32) for l in ls])
+        return jnp.pad(vec, (0, padded_n - n))
+
+    def unflatten_vec(vec):
+        out = []
+        off = 0
+        for shape, size, leaf in zip(shapes, sizes, leaves):
+            out.append(vec[off:off + size].reshape(shape)
+                       .astype(leaf.dtype))
+            off += size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    opt_state = opt.init(jnp.zeros((padded_n,), jnp.float32))
+    state_specs = jax.tree_util.tree_map(
+        lambda x: P("data") if getattr(x, "ndim", 0) >= 1 else P(),
+        opt_state)
+    opt_state = jax.device_put(
+        opt_state,
+        jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), state_specs,
+            is_leaf=lambda x: isinstance(x, P)))
+
+    def step(p, state, tokens, targets):
+        loss, grads = local_grads(p, tokens, targets)
+        gvec = flatten_tree(grads)
+        if grad_compression is not None:
+            gshard = quantized_reduce_scatter(
+                gvec, "data", dtype=grad_compression) / world
+        else:
+            gshard = jax.lax.psum_scatter(gvec, "data",
+                                          scatter_dimension=0,
+                                          tiled=True) / world
+        pvec = flatten_tree(p)
+        idx = jax.lax.axis_index("data")
+        pshard = jax.lax.dynamic_slice_in_dim(pvec, idx * shard_n,
+                                              shard_n)
+        updates, state = opt.update(gshard, state, pshard)
+        new_shard = optax.apply_updates(pshard, updates)
+        new_vec = jax.lax.all_gather(new_shard, "data", tiled=True)
+        return unflatten_vec(new_vec), state, loss
+
+    specs = (P(), state_specs, P("data"), P("data"))
+    out_specs = (P(), state_specs, P())
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=specs,
+                             out_specs=out_specs,
+                             check_rep=False)), opt_state
 
 
 def inner():
@@ -224,10 +368,12 @@ def inner():
 
     devices = jax.devices()
     on_tpu = jax.default_backend() in ("tpu", "axon")
+    grad_compression, zero1 = _sync_toggles()
 
     if not on_tpu:
         print(json.dumps(_bench_config(
-            LlamaConfig.tiny(), 4, 64, 3, devices)))
+            LlamaConfig.tiny(), 4, 64, 3, devices,
+            grad_compression=grad_compression, zero1=zero1)))
         return
 
     def model(dim, layers, heads, hidden, ce_chunk):
@@ -276,7 +422,9 @@ def inner():
         t_cfg = time.perf_counter()
         try:
             result = _bench_config(model(*shape), batch, 2048, 5,
-                                   devices)
+                                   devices,
+                                   grad_compression=grad_compression,
+                                   zero1=zero1)
         except Exception as e:  # noqa: BLE001 — OOM and friends
             sys.stderr.write(
                 f"[bench] config shape={shape} batch={batch} "
@@ -329,6 +477,18 @@ def _bench_int8_row():
 
 
 if __name__ == "__main__":
+    # Toggle flags become env vars so the --inner children (and the CPU
+    # fallback child) inherit them:
+    #   python bench.py --grad-compression int8 --zero1
+    _argv = sys.argv[1:]
+    for _i, _a in enumerate(_argv):
+        if _a.startswith("--grad-compression="):
+            os.environ["RTPU_BENCH_GRAD_COMPRESSION"] = \
+                _a.split("=", 1)[1]
+        elif _a == "--grad-compression" and _i + 1 < len(_argv):
+            os.environ["RTPU_BENCH_GRAD_COMPRESSION"] = _argv[_i + 1]
+        elif _a == "--zero1":
+            os.environ["RTPU_BENCH_ZERO1"] = "1"
     if "--inner" in sys.argv:
         inner()
     else:

@@ -25,6 +25,7 @@ listeners created before loop install so connects can't race.
 from __future__ import annotations
 
 import copy
+import logging
 import os
 from typing import Any, Dict, List, Optional
 
@@ -32,6 +33,8 @@ from ray_tpu.dag.channel import ChannelReader, ChannelSpec, ChannelWriter
 from ray_tpu.dag.node import (
     ClassMethodNode, DAGNode, FunctionNode, InputAttributeNode, InputNode,
     MultiOutputNode)
+
+logger = logging.getLogger(__name__)
 
 
 class _Stop:
@@ -401,8 +404,9 @@ class CompiledDAG:
                     try:
                         handles[aid].__ray_call__.remote(_close_listener,
                                                          token)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # noqa: BLE001 — reclaim sweep
+                        logger.debug("listener reclaim failed on actor "
+                                     "%s", aid, exc_info=True)
                 raise
         # driver-read TCP outputs: local listeners, created pre-install
         self._driver_tcp_readers: Dict[int, Any] = {}
@@ -512,22 +516,25 @@ class CompiledDAG:
             self._input_writer.write(_STOP, self._next_seq)
         except Exception:  # noqa: BLE001 — a dead reader (lost node)
             # must not abort teardown: still join loops + close sockets
-            pass
+            logger.debug("stop token not delivered during DAG teardown",
+                         exc_info=True)
         try:
             ray_tpu.get(self._loop_refs, timeout=30.0)
         except Exception:  # noqa: BLE001 — teardown is best-effort
-            pass
+            logger.debug("DAG actor loops did not join cleanly",
+                         exc_info=True)
         for endpoint in ([self._input_writer]
                          + list(self._output_readers)):
             close = getattr(endpoint, "close", None)
             if close is not None:  # TCP endpoints hold sockets
                 try:
                     close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — socket already gone
+                    logger.debug("DAG channel close failed",
+                                 exc_info=True)
 
     def __del__(self):
         try:
             self.teardown()
-        except Exception:  # noqa: BLE001 — interpreter shutdown
+        except Exception:  # graftlint: disable=GL004  # interpreter shutdown: logging/runtime may already be torn down, nowhere safe to report
             pass

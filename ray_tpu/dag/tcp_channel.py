@@ -17,6 +17,7 @@ seq implicit, and the compiled loop consumes strictly sequentially.
 
 from __future__ import annotations
 
+import logging
 import socket
 import struct
 import threading
@@ -26,6 +27,8 @@ from typing import Any, Dict, Optional, Tuple
 
 from ray_tpu.core import serialization
 from ray_tpu.dag.channel import ChannelTimeoutError
+
+logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
 
@@ -69,16 +72,32 @@ class TcpChannelListener:
         self._lock = locktrace.traced_lock("dag.tcp_channel")
 
     def _ensure_accepted(self, timeout: Optional[float]) -> socket.socket:
+        # accept() can block for the full timeout — do it OUTSIDE the
+        # lock so close() (and locktrace) never stall behind a reader
+        # waiting for a writer that hasn't connected yet
+        with self._lock:
+            if self._conn is not None:
+                return self._conn
+            listening = self._sock
+        listening.settimeout(timeout)
+        try:
+            conn, _ = listening.accept()
+        except (socket.timeout, OSError):
+            raise ChannelTimeoutError(
+                "tcp channel writer never connected")
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         with self._lock:
             if self._conn is None:
-                self._sock.settimeout(timeout)
-                try:
-                    conn, _ = self._sock.accept()
-                except (socket.timeout, OSError):
-                    raise ChannelTimeoutError(
-                        "tcp channel writer never connected")
-                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._conn = conn
+                return conn
+        # lost the (single-writer, so improbable) accept race: keep the
+        # established connection, drop ours
+        try:
+            conn.close()
+        except OSError:
+            logger.debug("stray accepted connection close failed",
+                         exc_info=True)
+        with self._lock:
             return self._conn
 
     def close(self) -> None:

@@ -29,10 +29,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["quantize_int8", "int8_matmul"]
+__all__ = ["scale_from_amax", "quantize_int8", "int8_matmul"]
 
 # Run the kernel in interpreter mode (CPU testing); toggled by tests.
 _INTERPRET = False
+
+
+def scale_from_amax(amax, qmax: float = 127.0):
+    """Symmetric quantization scale from a per-group |max|: the one
+    piece of scale math shared by this kernel's weight quantization and
+    the quantized collectives (parallel/collective.quantized_psum).
+    ``qmax``: 127 for int8, 448 for fp8-e4m3."""
+    return jnp.maximum(jnp.asarray(amax, jnp.float32) / qmax, 1e-8)
 
 
 def quantize_int8(w, axis: int = 0):
@@ -43,7 +51,7 @@ def quantize_int8(w, axis: int = 0):
     """
     wf = jnp.asarray(w, jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
-    scale = jnp.maximum(amax / 127.0, 1e-8)
+    scale = scale_from_amax(amax)
     w8 = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return w8, scale.reshape(-1)
 

@@ -10,37 +10,48 @@ TPU-native stance (SURVEY.md §5.8): in-graph SPMD math should use
 `jax.lax.psum`/`all_gather` over a mesh — XLA emits ICI collective DMA
 and no framework code runs per step. This module covers the *out-of-band*
 cases the reference uses NCCL for: host tensors moving between actors
-(weight broadcast to env-runners, parameter servers, metric reduction).
-The backend rendezvouses through the GCS KV store and moves payloads
-through the shared-memory object plane — no NCCL, no CUDA, and on a
-TPU host no extra copies (the store is the staging buffer the device
-transfer reads from anyway).
+(weight broadcast to env-runners, parameter servers, metric reduction)
+plus the multi-process DDP gradient-sync path for dev boxes without a
+shared mesh.
 
 Ops must be called in the same order by every rank of a group (the
 standard collective contract).
 
-Design notes (round-2 rework):
+Design notes (round-2 rework + round-7 bandwidth work):
 - Rendezvous is EVENT-DRIVEN: ranks block on a GCS ``kv_wait`` (head
-  fires the reply when the key lands) instead of polling — no 2ms
-  busy-loops, no per-wait head load (reference analog: long-poll
-  subscribers, src/ray/pubsub/publisher.h:245).
+  fires the reply when the key lands). The wait re-arms with
+  exponentially growing chunks up to a HARD deadline so a dropped
+  waiter registration re-registers instead of hanging, and a timeout
+  names the missing rank.
 - Payloads above an inline threshold move through the OBJECT PLANE
   (put → ref in KV → peers get()), so tensor bytes travel shm/direct
   node-to-node transfer, not inline through the head's control socket.
-- ``allreduce`` is a binomial TREE (reduce up, broadcast down):
-  2·log2(world) p2p transfers instead of world² reads through one
-  process.
+- ``allreduce`` defaults to a bandwidth-optimal RING (reduce-scatter +
+  all-gather over 1/world chunks: each rank moves ~2·payload bytes
+  total regardless of world size); small payloads use the round-2
+  binomial TREE (2·log2(world) transfers — fewer sequential rendezvous
+  rounds when latency dominates).
+- Quantized transport (EQuARX-style, PAPERS.md): ``compression="int8"``
+  (or ``"fp8"`` where ml_dtypes provides e4m3) block-quantizes every
+  hop's payload — per-block scale/zero-point, dequantize-accumulate-
+  requantize at each ring hop — cutting wire bytes ~4x. With an
+  ``ef_key`` an ERROR-FEEDBACK residual per leaf persists across
+  rounds: every quantization error this rank introduces is added back
+  to its contribution next round, so repeated reductions converge
+  instead of accumulating bias.
 - Round keys are garbage-collected LAZILY one round behind: a rank
-  completing round S has read every round-S deposit, which proves all
-  ranks finished round S-1 — so S-1's keys and payload refs are
-  reclaimed then, with the remainder swept by destroy_collective_group.
+  completing round S has (transitively, through the ring/tree chain)
+  proven all ranks finished round S-1 — so S-1's keys and payload refs
+  are reclaimed then, with the remainder swept by
+  destroy_collective_group.
 """
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -48,9 +59,26 @@ from ray_tpu.core import runtime as runtime_mod
 from ray_tpu.core import serialization
 from ray_tpu.exceptions import GetTimeoutError
 
+logger = logging.getLogger(__name__)
+
 _DEFAULT_TIMEOUT = 60.0
 # payloads larger than this ride the object plane instead of the KV
 _INLINE_MAX = 32 * 1024
+# below this the tree's log2(world) rendezvous rounds beat the ring's
+# 2(world-1) rounds (latency-bound regime); above it bandwidth wins
+_RING_MIN_BYTES = 8 * 1024
+# quantization block: scale/zero-point granularity (256 f32 = 1 KB of
+# payload carries 8 B of block metadata → int8 moves ~3.9x fewer bytes)
+_QUANT_BLOCK = 256
+
+try:  # fp8-e4m3 is available wherever jax is (ml_dtypes is a jax dep),
+    # but gate it so a slim host install degrades to int8 cleanly
+    import ml_dtypes as _ml_dtypes
+    _FP8_DTYPE = np.dtype(_ml_dtypes.float8_e4m3fn)
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    _FP8_DTYPE = None
+
+_COMPRESSIONS = ("int8", "fp8")
 
 
 def _kv_put(key: str, value: bytes) -> None:
@@ -76,26 +104,48 @@ def _kv_del(key: str) -> None:
         rt.gcs_call("kv_del", key.encode(), "collective")
 
 
-def _kv_wait(key: str, timeout: float) -> bytes:
+# re-arm chunks for _kv_wait: event-driven inside each chunk, doubling
+# up to the cap so a lost waiter registration costs at most one chunk
+_WAIT_INITIAL_S = 0.25
+_WAIT_MAX_S = 4.0
+
+
+def _kv_wait(key: str, timeout: float, what: Optional[str] = None) -> bytes:
     """Block until the key exists — event-driven: the head wakes us via
-    the KV waiter hook (gcs.py KVStore.add_waiter), no polling."""
+    the KV waiter hook (gcs.py KVStore.add_waiter), no polling. The wait
+    is re-armed with exponentially growing chunks against a HARD
+    deadline: a waiter registration lost to a head hiccup re-registers
+    within one chunk instead of hanging forever, and expiry raises a
+    timeout that names the peer being waited on."""
     rt = runtime_mod.get_runtime()
-    if rt.is_driver:
-        value = rt.gcs.kv.wait(key.encode(), namespace="collective",
-                               timeout=timeout)
-    else:
-        value = rt.gcs_call("kv_wait", key.encode(), "collective", timeout,
-                            timeout=timeout + 10.0)
-    if value is None:
-        raise GetTimeoutError(f"collective rendezvous timed out on {key}")
-    return value
+    deadline = time.monotonic() + timeout
+    chunk = _WAIT_INITIAL_S
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            who = what or f"key {key!r}"
+            raise GetTimeoutError(
+                f"collective rendezvous timed out after {timeout:.1f}s "
+                f"waiting for {who}; that rank likely died or never "
+                f"entered the same collective round (key {key!r})")
+        slice_s = min(chunk, remaining)
+        if rt.is_driver:
+            value = rt.gcs.kv.wait(key.encode(), namespace="collective",
+                                   timeout=slice_s)
+        else:
+            value = rt.gcs_call("kv_wait", key.encode(), "collective",
+                                slice_s, timeout=slice_s + 10.0)
+        if value is not None:
+            return value
+        chunk = min(chunk * 2.0, _WAIT_MAX_S)
 
 
-def _pack_payload(value: Optional[np.ndarray], keepalive: List) -> bytes:
-    """Inline small tensors; large ones go through the object plane so
+def _pack_payload(value, keepalive: List) -> bytes:
+    """Inline small payloads; large ones go through the object plane so
     the bytes move node-to-node, not through the head's control socket.
-    The producer must keep ``keepalive`` refs until consumers have
-    certainly read (see the round-GC invariant in the module docstring)."""
+    ``value`` is a tensor or a quantized-chunk tuple. The producer must
+    keep ``keepalive`` refs until consumers have certainly read (see the
+    round-GC invariant in the module docstring)."""
     if value is None:
         return b""
     blob = serialization.pack(value)
@@ -107,7 +157,7 @@ def _pack_payload(value: Optional[np.ndarray], keepalive: List) -> bytes:
     return b"R" + serialization.dumps(ref)
 
 
-def _unpack_payload(blob: bytes) -> Optional[np.ndarray]:
+def _unpack_payload(blob: bytes):
     if not blob:
         return None
     tag, body = blob[:1], blob[1:]
@@ -115,6 +165,149 @@ def _unpack_payload(blob: bytes) -> Optional[np.ndarray]:
         return serialization.unpack(body)
     import ray_tpu
     return ray_tpu.get(serialization.loads(body))
+
+
+# --- block quantization codecs (EQuARX-style, PAPERS.md) ----------------
+# A quantized chunk travels as ("q8", n, q, scale, zp) / ("f8", n, q,
+# scale): per-_QUANT_BLOCK affine int8 (scale + zero-point per block) or
+# scaled fp8-e4m3. Host-side numpy mirror of the jit-side scale math in
+# ray_tpu/ops/quant_matmul.py.
+
+
+def _block_view(flat: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Pad a 1-D f32 array to a block multiple, viewed as [nblocks, B]."""
+    n = flat.size
+    pad = (-n) % _QUANT_BLOCK
+    if pad:
+        flat = np.concatenate(
+            [flat, np.zeros(pad, dtype=np.float32)])
+    return flat.reshape(-1, _QUANT_BLOCK), n
+
+
+def _quantize_chunk(chunk: np.ndarray, compression: str) -> tuple:
+    flat = np.ascontiguousarray(chunk, dtype=np.float32).ravel()
+    blocks, n = _block_view(flat)
+    if compression == "int8":
+        lo = blocks.min(axis=1, keepdims=True) if blocks.size else \
+            np.zeros((blocks.shape[0], 1), np.float32)
+        hi = blocks.max(axis=1, keepdims=True) if blocks.size else lo
+        zp = ((hi + lo) * 0.5).astype(np.float32)
+        scale = np.maximum((hi - lo) / 254.0, 1e-12).astype(np.float32)
+        q = np.clip(np.rint((blocks - zp) / scale), -127, 127).astype(
+            np.int8)
+        return ("q8", n, q, scale.ravel(), zp.ravel())
+    if compression == "fp8":
+        if _FP8_DTYPE is None:
+            raise RuntimeError(
+                "fp8 compression needs ml_dtypes (float8_e4m3fn); "
+                "use compression='int8' on this host")
+        amax = (np.max(np.abs(blocks), axis=1, keepdims=True)
+                if blocks.size else
+                np.zeros((blocks.shape[0], 1), np.float32))
+        scale = np.maximum(amax / 448.0, 1e-12).astype(np.float32)
+        q = (blocks / scale).astype(_FP8_DTYPE)
+        return ("f8", n, q, scale.ravel())
+    raise ValueError(f"unknown compression {compression!r}; "
+                     f"expected one of {_COMPRESSIONS}")
+
+
+def _dequantize_chunk(payload: tuple) -> np.ndarray:
+    tag = payload[0]
+    if tag == "q8":
+        _, n, q, scale, zp = payload
+        out = q.astype(np.float32) * scale[:, None] + zp[:, None]
+    elif tag == "f8":
+        _, n, q, scale = payload
+        out = q.astype(np.float32) * scale[:, None]
+    else:
+        raise ValueError(f"unknown quantized payload tag {tag!r}")
+    return out.ravel()[:n]
+
+
+def _is_quantized(payload) -> bool:
+    return isinstance(payload, tuple)
+
+
+def _decode_chunk(payload) -> np.ndarray:
+    if _is_quantized(payload):
+        return _dequantize_chunk(payload)
+    return payload
+
+
+def _payload_nbytes(payload) -> int:
+    """Actual tensor bytes this payload puts on the wire (framing and
+    pickle overhead excluded on both sides of the compression ratio)."""
+    if _is_quantized(payload):
+        return sum(int(p.nbytes) for p in payload if
+                   isinstance(p, np.ndarray))
+    return int(payload.nbytes)
+
+
+# --- error feedback -----------------------------------------------------
+# One persistent residual buffer per (group, leaf key). Every
+# quantization error a rank introduces — input quantization, per-hop
+# requantization, the final all-gather quantization — is added back to
+# that rank's contribution on the NEXT round. The reduction is a sum, so
+# compensating anywhere in the sum compensates globally: the
+# time-averaged reduced value converges to the true reduction at O(1/T)
+# instead of carrying a constant quantization bias.
+
+_ef_buffers: Dict[Tuple[str, str], np.ndarray] = {}
+
+
+def reset_error_feedback(group_name: Optional[str] = None) -> None:
+    """Drop persistent error-feedback residuals (all groups, or one)."""
+    if group_name is None:
+        _ef_buffers.clear()
+        return
+    for key in [k for k in _ef_buffers if k[0] == group_name]:
+        del _ef_buffers[key]
+
+
+def error_feedback_residual(group_name: str,
+                            ef_key: str) -> Optional[np.ndarray]:
+    """The current residual for a leaf (copy; None if never used)."""
+    buf = _ef_buffers.get((group_name, ef_key))
+    return None if buf is None else buf.copy()
+
+
+def _ef_buffer(group_name: str, ef_key: str, size: int) -> np.ndarray:
+    buf = _ef_buffers.get((group_name, ef_key))
+    if buf is None or buf.size != size:
+        buf = np.zeros(size, dtype=np.float32)
+        _ef_buffers[(group_name, ef_key)] = buf
+    return buf
+
+
+# --- collective transport metrics (GL006-compliant names) ---------------
+# Defined here so descriptions register; recorded through the BATCHED
+# metrics path (util.metrics.record_batch → one control-plane RPC per
+# collective op, not one per series).
+from ray_tpu.util.metrics import Counter as _MCounter, Gauge as _MGauge
+
+COLLECTIVE_BYTES = _MCounter(
+    "ray_tpu_train_collective_bytes_total",
+    "Tensor payload bytes this rank put on the wire in collective ops",
+    tag_keys=("op", "dtype"))
+COLLECTIVE_COMPRESSION = _MGauge(
+    "ray_tpu_train_collective_compression_ratio",
+    "Uncompressed-equivalent bytes / wire bytes of the last collective",
+    tag_keys=("op", "dtype"))
+
+
+def _note_bytes(op: str, dtype: str, wire: int, raw: int) -> None:
+    if wire <= 0:
+        return
+    try:
+        from ray_tpu.util.metrics import record_batch
+        record_batch([
+            ("counter", "ray_tpu_train_collective_bytes_total",
+             {"op": op, "dtype": dtype}, float(wire), None),
+            ("gauge", "ray_tpu_train_collective_compression_ratio",
+             {"op": op, "dtype": dtype}, float(raw) / float(wire), None),
+        ])
+    except Exception:
+        logger.debug("collective metrics flush failed", exc_info=True)
 
 
 @dataclass
@@ -162,6 +355,7 @@ def destroy_collective_group(group_name: str = "default",
         if seq < barrier_seq:
             _gc_round(group, seq)
     _kv_del(f"grp/{group.name}/{group.rank}")
+    reset_error_feedback(group_name)
 
 
 def _gc_round(group: GroupInfo, seq: int) -> None:
@@ -208,7 +402,8 @@ def _exchange(group: GroupInfo, tensor: Optional[np.ndarray],
     group.pending_gc[seq] = [[my_key], keepalive]
     out: List[Optional[np.ndarray]] = []
     for rank in range(group.world_size):
-        blob = _kv_wait(f"{prefix}/{rank}", timeout)
+        blob = _kv_wait(f"{prefix}/{rank}", timeout,
+                        what=f"rank {rank} of group {group.name!r}")
         out.append(_unpack_payload(blob))
     _gc_round(group, seq - 1)
     return out
@@ -222,25 +417,23 @@ _PAIR_OPS = {
 }
 
 
-def allreduce(tensor, op: str = "sum", group_name: str = "default",
-              timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
+def _tree_allreduce(group: GroupInfo, acc: np.ndarray, op: str,
+                    timeout: float) -> np.ndarray:
     """Binomial-tree allreduce: partial sums flow up the tree (log2
     rounds of p2p transfers), the root broadcasts the result back down —
     2·log2(world) payload movements total vs the naive world² reads of
     an all-to-all through one KV (reference analog: NCCL's tree
-    algorithms; here payloads ride the object plane between nodes)."""
-    group = _group(group_name)
+    algorithms). Best for SMALL payloads, where the ring's 2(world-1)
+    sequential rendezvous rounds cost more than the extra bytes."""
     world, rank = group.world_size, group.rank
     pair = _PAIR_OPS["sum" if op == "mean" else op]
-    acc = np.asarray(tensor)
-    if world == 1:
-        return acc / world if op == "mean" else acc.copy()
     seq = group.seq
     group.seq += 1
     prefix = f"col/{group.name}/{seq}"
     my_keys: List[str] = []
     keepalive: List = []
     group.pending_gc[seq] = [my_keys, keepalive]
+    wire = 0
 
     # reduce up: at level k, odd multiples of k send to even multiples
     k = 1
@@ -249,12 +442,15 @@ def allreduce(tensor, op: str = "sum", group_name: str = "default",
         if rank % (2 * k) == k:
             dst = rank - k
             key = f"{prefix}/up/{rank}"
+            wire += acc.nbytes
             _kv_put(key, _pack_payload(acc, keepalive))
             my_keys.append(key)
             sent_at = k
             break
         if rank % (2 * k) == 0 and rank + k < world:
-            blob = _kv_wait(f"{prefix}/up/{rank + k}", timeout)
+            blob = _kv_wait(f"{prefix}/up/{rank + k}", timeout,
+                            what=f"rank {rank + k} of group "
+                                 f"{group.name!r} (tree reduce)")
             acc = pair(acc, _unpack_payload(blob))
         k *= 2
 
@@ -265,15 +461,268 @@ def allreduce(tensor, op: str = "sum", group_name: str = "default",
     k = top // 2
     while k >= 1:
         if rank % (2 * k) == k and k == sent_at:
-            blob = _kv_wait(f"{prefix}/down/{rank}", timeout)
+            blob = _kv_wait(f"{prefix}/down/{rank}", timeout,
+                            what=f"rank {rank - k} of group "
+                                 f"{group.name!r} (tree broadcast)")
             acc = _unpack_payload(blob)
         elif rank % (2 * k) == 0 and rank + k < world:
             key = f"{prefix}/down/{rank + k}"
+            wire += acc.nbytes
             _kv_put(key, _pack_payload(acc, keepalive))
             my_keys.append(key)
         k //= 2
     _gc_round(group, seq - 1)
-    return acc / world if op == "mean" else acc
+    _note_bytes("allreduce", str(acc.dtype), wire, wire)
+    return acc
+
+
+def _chunk_bounds(n: int, world: int) -> List[int]:
+    """Start offsets (plus final n) of np.array_split's flat chunking."""
+    base, extra = divmod(n, world)
+    bounds = [0]
+    for i in range(world):
+        bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+    return bounds
+
+
+def _encode_chunk(chunk: np.ndarray, compression: Optional[str],
+                  residual: Optional[np.ndarray], offset: int,
+                  stats: Dict[str, int]):
+    """Encode one outgoing chunk; quantization error (value − dequant)
+    lands in this rank's residual slice for next-round compensation."""
+    if compression is None:
+        payload = np.ascontiguousarray(chunk)
+    else:
+        payload = _quantize_chunk(chunk, compression)
+        if residual is not None and chunk.size:
+            residual[offset:offset + chunk.size] += (
+                np.asarray(chunk, dtype=np.float32).ravel()
+                - _dequantize_chunk(payload))
+    stats["wire"] += _payload_nbytes(payload)
+    stats["raw"] += int(chunk.size) * 4 if compression else int(chunk.nbytes)
+    return payload
+
+
+def _ring_reduce_scatter_flat(group: GroupInfo, flat: np.ndarray, op: str,
+                              timeout: float, compression: Optional[str],
+                              residual: Optional[np.ndarray],
+                              stats: Dict[str, int]
+                              ) -> Tuple[np.ndarray, List[int]]:
+    """Ring reduce-scatter over flat chunks: world−1 hops, each sending
+    one 1/world chunk to the next rank. Quantized hops dequantize,
+    accumulate in f32, and requantize (EQuARX's in-network pattern);
+    every requantization error is error-fed via ``residual``. Returns
+    (this rank's fully reduced chunk — exact f32, never requantized —
+    and the chunk bounds). ``op`` must be sum/mean when compressed."""
+    world, rank = group.world_size, group.rank
+    pair = _PAIR_OPS["sum" if op == "mean" else op]
+    bounds = _chunk_bounds(flat.size, world)
+    acc: List[np.ndarray] = [
+        np.array(flat[bounds[i]:bounds[i + 1]],
+                 dtype=np.float32 if compression else flat.dtype)
+        for i in range(world)]
+    seq = group.seq
+    group.seq += 1
+    prefix = f"col/{group.name}/{seq}"
+    my_keys: List[str] = []
+    keepalive: List = []
+    group.pending_gc[seq] = [my_keys, keepalive]
+    prev = (rank - 1) % world
+    for s in range(world - 1):
+        send_idx = (rank - 1 - s) % world
+        recv_idx = (rank - 2 - s) % world
+        payload = _encode_chunk(acc[send_idx], compression, residual,
+                                bounds[send_idx], stats)
+        key = f"{prefix}/rs{s}/{rank}"
+        _kv_put(key, _pack_payload(payload, keepalive))
+        my_keys.append(key)
+        blob = _kv_wait(f"{prefix}/rs{s}/{prev}", timeout,
+                        what=f"rank {prev} of group {group.name!r} "
+                             f"(ring reduce-scatter step {s})")
+        acc[recv_idx] = pair(acc[recv_idx],
+                             _decode_chunk(_unpack_payload(blob)))
+    _gc_round(group, seq - 1)
+    return acc[rank], bounds
+
+
+def _ring_allgather_payloads(group: GroupInfo, my_payload, timeout: float,
+                             stats: Dict[str, int],
+                             raw_nbytes: int) -> List:
+    """Ring all-gather: each rank's payload travels around the ring,
+    forwarded VERBATIM at every hop (no requantization, so no further
+    error). Returns payloads indexed by owning rank."""
+    world, rank = group.world_size, group.rank
+    payloads: List = [None] * world
+    payloads[rank] = my_payload
+    seq = group.seq
+    group.seq += 1
+    prefix = f"col/{group.name}/{seq}"
+    my_keys: List[str] = []
+    keepalive: List = []
+    group.pending_gc[seq] = [my_keys, keepalive]
+    prev = (rank - 1) % world
+    carry = my_payload
+    carry_raw = raw_nbytes
+    for s in range(world - 1):
+        key = f"{prefix}/ag{s}/{rank}"
+        stats["wire"] += _payload_nbytes(carry)
+        stats["raw"] += carry_raw
+        _kv_put(key, _pack_payload(carry, keepalive))
+        my_keys.append(key)
+        blob = _kv_wait(f"{prefix}/ag{s}/{prev}", timeout,
+                        what=f"rank {prev} of group {group.name!r} "
+                             f"(ring all-gather step {s})")
+        carry = _unpack_payload(blob)
+        owner = (rank - 1 - s) % world
+        payloads[owner] = carry
+        carry_raw = (int(carry[1]) * 4 if _is_quantized(carry)
+                     else int(carry.nbytes))
+    _gc_round(group, seq - 1)
+    return payloads
+
+
+def _check_compression(compression: Optional[str], op: str,
+                       dtype) -> None:
+    if compression is None:
+        return
+    if compression not in _COMPRESSIONS:
+        raise ValueError(f"unknown compression {compression!r}; "
+                         f"expected one of {_COMPRESSIONS} or None")
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"compression={compression!r} only supports sum/mean "
+            f"(dequantize-accumulate is additive), not op={op!r}")
+    if not np.issubdtype(np.dtype(dtype), np.floating):
+        raise ValueError(
+            f"compression={compression!r} needs a float tensor, "
+            f"got dtype {dtype}")
+
+
+def allreduce(tensor, op: str = "sum", group_name: str = "default",
+              timeout: float = _DEFAULT_TIMEOUT,
+              compression: Optional[str] = None,
+              ef_key: Optional[str] = None,
+              algorithm: Optional[str] = None) -> np.ndarray:
+    """Allreduce across the group.
+
+    ``algorithm``: "ring" (reduce-scatter + all-gather over 1/world
+    chunks — bandwidth-optimal, the default for payloads ≥ 8 KB or
+    whenever compression is on) or "tree" (binomial; fewest rendezvous
+    rounds, default for small payloads). ``compression``: "int8"/"fp8"
+    block-quantizes every hop (sum/mean only). ``ef_key``: stable
+    per-leaf id enabling the persistent error-feedback residual — use
+    the same key for the same logical tensor every round.
+
+    All ranks return bitwise-identical results: with compression the
+    reduced chunks are quantized ONCE by their owning rank and every
+    rank (owner included) decodes the same wire bytes.
+    """
+    group = _group(group_name)
+    world = group.world_size
+    acc = np.asarray(tensor)
+    _check_compression(compression, op, acc.dtype)
+    if world == 1:
+        return acc / world if op == "mean" else acc.copy()
+    if algorithm is None:
+        algorithm = ("ring" if compression is not None
+                     or acc.nbytes >= _RING_MIN_BYTES else "tree")
+    if algorithm == "tree":
+        if compression is not None:
+            raise ValueError("compression requires algorithm='ring'")
+        out = _tree_allreduce(group, acc, op, timeout)
+        return out / world if op == "mean" else out
+    if algorithm != "ring":
+        raise ValueError(f"unknown algorithm {algorithm!r}")
+
+    orig_shape, orig_dtype = acc.shape, acc.dtype
+    flat = acc.ravel()
+    residual = None
+    if compression is not None:
+        flat = flat.astype(np.float32)
+        if ef_key is not None:
+            residual = _ef_buffer(group.name, ef_key, flat.size)
+            flat = flat + residual
+            residual[:] = 0.0  # re-filled with this round's errors
+    stats = {"wire": 0, "raw": 0}
+    own, bounds = _ring_reduce_scatter_flat(
+        group, flat, op, timeout, compression, residual, stats)
+    # stats deliberately excluded here: this encode is not itself a
+    # send — the all-gather below counts it when it first travels
+    own_payload = _encode_chunk(own, compression, residual,
+                                bounds[group.rank],
+                                {"wire": 0, "raw": 0})
+    # the all-gather moves each payload world-1 hops in total around the
+    # ring; this rank forwards whatever arrives, verbatim
+    payloads = _ring_allgather_payloads(
+        group, own_payload, timeout, stats,
+        int(own.size) * 4 if compression else int(own.nbytes))
+    parts = [_decode_chunk(p) for p in payloads]
+    out = (np.concatenate([np.asarray(p, dtype=np.float32 if compression
+                                      else orig_dtype)
+                           for p in parts])
+           if world > 1 else parts[0])
+    if op == "mean":
+        out = out / world
+    out = out.reshape(orig_shape)
+    if compression is not None and np.issubdtype(orig_dtype, np.floating):
+        out = out.astype(orig_dtype)
+    _note_bytes("allreduce", compression or str(orig_dtype),
+                stats["wire"], stats["raw"])
+    return out
+
+
+def reduce_scatter_flat(tensor, op: str = "sum",
+                        group_name: str = "default",
+                        timeout: float = _DEFAULT_TIMEOUT,
+                        compression: Optional[str] = None,
+                        ef_key: Optional[str] = None
+                        ) -> Tuple[np.ndarray, int]:
+    """Ring reduce-scatter of the FLATTENED tensor: returns (this rank's
+    reduced 1/world chunk in full precision, its flat offset). This is
+    the gradient half of a ZeRO-1 step — half the wire bytes of a full
+    allreduce, and the chunk a rank owns is exact f32 (hop errors are
+    error-fed by the ranks that introduced them when ``ef_key`` is
+    set)."""
+    group = _group(group_name)
+    world = group.world_size
+    flat = np.asarray(tensor).ravel()
+    _check_compression(compression, op, flat.dtype)
+    if world == 1:
+        out = flat.astype(np.float32) if compression else flat.copy()
+        return (out / world if op == "mean" else out), 0
+    residual = None
+    if compression is not None:
+        flat = flat.astype(np.float32)
+        if ef_key is not None:
+            residual = _ef_buffer(group.name, ef_key, flat.size)
+            flat = flat + residual
+            residual[:] = 0.0
+    stats = {"wire": 0, "raw": 0}
+    own, bounds = _ring_reduce_scatter_flat(
+        group, flat, op, timeout, compression, residual, stats)
+    if op == "mean":
+        own = own / world
+    _note_bytes("reduce_scatter", compression or str(flat.dtype),
+                stats["wire"], stats["raw"])
+    return own, bounds[group.rank]
+
+
+def allgather_flat(shard, group_name: str = "default",
+                   timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
+    """Ring all-gather of per-rank flat shards (sizes may differ by one
+    element — np.array_split chunking), concatenated in rank order. The
+    parameter half of a ZeRO-1 step: each rank contributes its updated
+    shard and receives the full parameter vector."""
+    group = _group(group_name)
+    shard = np.ascontiguousarray(np.asarray(shard).ravel())
+    if group.world_size == 1:
+        return shard.copy()
+    stats = {"wire": 0, "raw": 0}
+    payloads = _ring_allgather_payloads(group, shard, timeout, stats,
+                                        int(shard.nbytes))
+    _note_bytes("allgather", str(shard.dtype), stats["wire"],
+                stats["raw"])
+    return np.concatenate([np.asarray(p) for p in payloads])
 
 
 def allgather(tensor, group_name: str = "default",
@@ -285,7 +734,8 @@ def allgather(tensor, group_name: str = "default",
 def reducescatter(tensor, op: str = "sum", group_name: str = "default",
                   timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
     """Reduce across ranks, then each rank keeps its 1/world shard along
-    axis 0."""
+    axis 0 (reference-compatible shape semantics; for the flat ZeRO-1
+    chunking use reduce_scatter_flat)."""
     group = _group(group_name)
     reduced = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
     shards = np.array_split(reduced, group.world_size, axis=0)
@@ -317,7 +767,9 @@ def recv(src_rank: int, group_name: str = "default", tag: int = 0,
          timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
     group = _group(group_name)
     key = f"p2p/{group.name}/{src_rank}->{group.rank}/{tag}"
-    blob = _kv_wait(key, timeout)
+    blob = _kv_wait(key, timeout,
+                    what=f"rank {src_rank} of group {group.name!r} "
+                         f"(p2p send tag {tag})")
     _kv_del(key)
     return serialization.unpack(blob)
 
@@ -352,3 +804,112 @@ def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
     return jax.lax.psum_scatter(x, axis_name,
                                 scatter_dimension=scatter_dimension,
                                 tiled=True)
+
+
+def quantized_psum(x, axis_name: str, dtype: str = "int8",
+                   block: int = _QUANT_BLOCK, error=None):
+    """Bandwidth-cheap psum for the GSPMD gradient-sync path: block-
+    quantize with a SHARED scale (per-block |max| pmax'd across the
+    axis, so every replica quantizes onto the same grid), accumulate the
+    int8 payloads exactly in int32 (EQuARX's accumulate-in-wide-int),
+    dequantize once. ``dtype``: "int8" or "fp8" (e4m3; accumulated in
+    f32 — the int-accumulate trick has no fp8 analog). Scale math shared
+    with ray_tpu/ops/quant_matmul.py.
+
+    With ``error`` (the previous round's residual, same shape as ``x``)
+    returns ``(psum, new_error)`` — the error-feedback pair: callers
+    carry the residual across steps so quantization bias cancels over
+    time instead of accumulating into the optimizer state.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.quant_matmul import scale_from_amax
+
+    if dtype not in ("int8", "fp8"):
+        raise ValueError(f"dtype must be int8|fp8, got {dtype!r}")
+    orig_shape, orig_dtype = x.shape, x.dtype
+    n = x.size
+    flat = x.reshape(-1).astype(jnp.float32)
+    if error is not None:
+        flat = flat + error.reshape(-1).astype(jnp.float32)
+    pad = (-n) % block
+    if pad:
+        flat_p = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    else:
+        flat_p = flat
+    blocks = flat_p.reshape(-1, block)
+    amax = jax.lax.pmax(
+        jnp.max(jnp.abs(blocks), axis=1, keepdims=True), axis_name)
+    if dtype == "int8":
+        scale = scale_from_amax(amax, 127.0)
+        q = jnp.clip(jnp.round(blocks / scale), -127.0, 127.0)
+        deq_own = q * scale  # own contribution as the wire sees it
+        acc = jax.lax.psum(q.astype(jnp.int32), axis_name)
+        out_blocks = acc.astype(jnp.float32) * scale
+    else:
+        scale = scale_from_amax(amax, 448.0)
+        q = (blocks / scale).astype(jnp.float8_e4m3fn)
+        deq_own = q.astype(jnp.float32) * scale
+        out_blocks = jax.lax.psum(deq_own, axis_name)
+    out = out_blocks.reshape(-1)[:n].reshape(orig_shape)
+    if jnp.issubdtype(orig_dtype, jnp.floating):
+        out = out.astype(orig_dtype)
+    if error is None:
+        return out
+    new_error = (blocks - deq_own).reshape(-1)[:n].reshape(orig_shape)
+    return out, new_error
+
+
+def quantized_pmean(x, axis_name: str, dtype: str = "int8",
+                    block: int = _QUANT_BLOCK, error=None):
+    """quantized_psum / axis size — the DDP gradient-mean drop-in."""
+    import jax
+    world = jax.lax.psum(1, axis_name)
+    result = quantized_psum(x, axis_name, dtype=dtype, block=block,
+                            error=error)
+    if error is None:
+        return result / world
+    out, new_error = result
+    return out / world, new_error
+
+
+def quantized_reduce_scatter(x, axis_name: str, dtype: str = "int8",
+                             block: int = _QUANT_BLOCK):
+    """Quantized reduce-scatter of a flat vector: each device gets its
+    1/world shard of the sum, transported as shared-scale int8
+    accumulated in int32 via psum_scatter (fp8: f32-accumulated). The
+    ZeRO-1 gradient half inside jit: x must be 1-D with
+    ``x.size % (axis_size * block) == 0`` (pad at the call site)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.quant_matmul import scale_from_amax
+
+    if dtype not in ("int8", "fp8"):
+        raise ValueError(f"dtype must be int8|fp8, got {dtype!r}")
+    if x.ndim != 1:
+        raise ValueError(f"expected flat 1-D input, got shape {x.shape}")
+    if x.size % block:
+        raise ValueError(
+            f"x.size={x.size} must divide the quant block {block} "
+            "(pad at the call site)")
+    blocks = x.reshape(-1, block).astype(jnp.float32)
+    amax = jax.lax.pmax(
+        jnp.max(jnp.abs(blocks), axis=1, keepdims=True), axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    if dtype == "int8":
+        scale = scale_from_amax(amax, 127.0)
+        q = jnp.clip(jnp.round(blocks / scale), -127.0, 127.0)
+        shard = jax.lax.psum_scatter(q.astype(jnp.int32), axis_name,
+                                     scatter_dimension=0, tiled=True)
+        # the shared scales are replicated; slice this shard's rows
+        scale_shard = jax.lax.dynamic_slice_in_dim(
+            scale, idx * shard.shape[0], shard.shape[0], 0)
+        return (shard.astype(jnp.float32) * scale_shard).reshape(-1)
+    scale = scale_from_amax(amax, 448.0)
+    deq = (blocks / scale).astype(jnp.float8_e4m3fn).astype(
+        jnp.float32) * scale
+    shard = jax.lax.psum_scatter(deq, axis_name, scatter_dimension=0,
+                                 tiled=True)
+    return shard.reshape(-1)

@@ -4,11 +4,25 @@ reference: python/ray/train/collective/collectives.py:16,32 (barrier,
 broadcast_from_rank_zero via SynchronizationActor) — here implemented
 over the GCS-KV collective backend (ray_tpu/parallel/collective.py),
 scoped to the run's pre-initialized group.
+
+Round 7 adds the two gradient-sync cost levers (EQuARX + cross-replica
+weight-update sharding, PAPERS.md):
+
+* ``allreduce_gradients(..., compression="int8"|"fp8")`` — block-
+  quantized transport with a persistent per-leaf error-feedback
+  residual, ~4x fewer wire bytes;
+* ``Zero1Optimizer`` — reduce-scatter grads → local optimizer step on
+  this rank's 1/world_size flat shard → all-gather params, so
+  optimizer-state memory per replica is ~1/world_size of the model
+  (ZeRO-1 / "Automatic Cross-Replica Sharding of Weight Update").
+
+Both are selected by ``ScalingConfig(grad_compression=..., zero1=...)``
+and read off the TrainContext via ``make_optimizer``.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 
@@ -35,16 +49,163 @@ def broadcast_from_rank_zero(data: Any) -> Any:
     return serialization.unpack(out.tobytes())
 
 
-def allreduce_gradients(grads, op: str = "mean"):
+def allreduce_gradients(grads, op: str = "mean",
+                        compression: Optional[str] = None):
     """Host-side gradient allreduce for DDP loops whose math runs on a
     single local device per worker (the multi-process CPU/dev path).
-    On a pod, shard over the mesh instead — XLA's psum rides ICI."""
+    On a pod, shard over the mesh instead — XLA's psum rides ICI.
+
+    ``compression`` (default: the run's ``grad_compression`` flag):
+    "int8"/"fp8" block-quantizes every ring hop and keeps a persistent
+    error-feedback residual per leaf, so repeated rounds converge
+    instead of accumulating quantization bias."""
     ctx = get_context()
+    if compression is None:
+        compression = getattr(ctx, "grad_compression", None)
     import jax
     flat, treedef = jax.tree_util.tree_flatten(grads)
     reduced = [
         collective.allreduce(np.asarray(leaf), op=op,
-                             group_name=ctx.group_name)
-        for leaf in flat
+                             group_name=ctx.group_name,
+                             compression=compression,
+                             ef_key=f"grad/{i}" if compression else None)
+        for i, leaf in enumerate(flat)
     ]
     return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+def _flatten_to_vector(tree):
+    """Pytree → (flat f32 vector, treedef, leaf shapes, leaf dtypes)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.asarray(l) for l in leaves]
+    vec = (np.concatenate([a.ravel().astype(np.float32) for a in arrs])
+           if arrs else np.zeros(0, np.float32))
+    return vec, treedef, [a.shape for a in arrs], [a.dtype for a in arrs]
+
+
+def _unflatten_from_vector(vec, treedef, shapes, dtypes):
+    import jax
+    leaves = []
+    off = 0
+    for shape, dtype in zip(shapes, dtypes):
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        leaves.append(np.asarray(vec[off:off + n], dtype=np.float32)
+                      .reshape(shape).astype(dtype))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class DDPOptimizer:
+    """Replicated (plain DDP) optimizer step over the host collective:
+    allreduce-mean the gradients, then every rank runs the full optax
+    update. Same ``step(params, grads)`` surface as Zero1Optimizer so
+    the train loop toggles between them with one flag."""
+
+    def __init__(self, optimizer, params, *,
+                 grad_compression: Optional[str] = None,
+                 group_name: Optional[str] = None):
+        self.optimizer = optimizer
+        self.grad_compression = grad_compression
+        self.group_name = group_name or get_context().group_name
+        self._opt_state = optimizer.init(params)
+
+    def optimizer_state_bytes(self) -> int:
+        import jax
+        return sum(np.asarray(l).nbytes
+                   for l in jax.tree_util.tree_leaves(self._opt_state))
+
+    def step(self, params, grads):
+        import jax
+        import optax
+        flat, treedef = jax.tree_util.tree_flatten(grads)
+        reduced = [
+            collective.allreduce(
+                np.asarray(leaf), op="mean", group_name=self.group_name,
+                compression=self.grad_compression,
+                ef_key=f"ddp/{i}" if self.grad_compression else None)
+            for i, leaf in enumerate(flat)
+        ]
+        grads = jax.tree_util.tree_unflatten(treedef, reduced)
+        updates, self._opt_state = self.optimizer.update(
+            grads, self._opt_state, params)
+        return optax.apply_updates(params, updates)
+
+
+class Zero1Optimizer:
+    """ZeRO-1 cross-replica sharded weight update (PAPERS.md: "Automatic
+    Cross-Replica Sharding of Weight Update in Data-Parallel Training").
+
+    Each step: ring reduce-scatter the FLAT gradient vector (each rank
+    receives the exact f32 mean of its 1/world_size chunk — half the
+    wire bytes of a full allreduce, quantizable with error feedback),
+    run the optax update on that shard only, then ring all-gather the
+    updated parameter shards. Optimizer state (adam m/v) exists ONLY
+    for this rank's shard — per-replica optimizer memory is
+    ~1/world_size of the replicated DDP equivalent.
+
+    The update must be elementwise over the flat vector for shard-wise
+    ≡ full-tree equivalence (adam/adamw/sgd/lamb-without-layer-norms
+    qualify; anything needing per-leaf structure or cross-parameter
+    norms does not).
+    """
+
+    def __init__(self, optimizer, params, *,
+                 grad_compression: Optional[str] = None,
+                 group_name: Optional[str] = None):
+        self.optimizer = optimizer
+        self.grad_compression = grad_compression
+        self.group_name = group_name or get_context().group_name
+        self.world = collective.get_collective_group_size(self.group_name)
+        self.rank = collective.get_rank(self.group_name)
+        vec, _, _, _ = _flatten_to_vector(params)
+        bounds = collective._chunk_bounds(vec.size, self.world)
+        self._lo, self._hi = bounds[self.rank], bounds[self.rank + 1]
+        self._opt_state = optimizer.init(vec[self._lo:self._hi])
+
+    def optimizer_state_bytes(self) -> int:
+        import jax
+        return sum(np.asarray(l).nbytes
+                   for l in jax.tree_util.tree_leaves(self._opt_state))
+
+    def step(self, params, grads):
+        import optax
+        gvec, treedef, shapes, dtypes = _flatten_to_vector(grads)
+        grad_shard, off = collective.reduce_scatter_flat(
+            gvec, op="mean", group_name=self.group_name,
+            compression=self.grad_compression,
+            ef_key="zero1/grads" if self.grad_compression else None)
+        if off != self._lo or off + grad_shard.size != self._hi:
+            raise ValueError(
+                "gradient pytree size changed under Zero1Optimizer "
+                f"(shard [{off}, {off + grad_shard.size}) vs optimizer "
+                f"state for [{self._lo}, {self._hi}))")
+        pvec, _, _, _ = _flatten_to_vector(params)
+        pshard = pvec[self._lo:self._hi]
+        updates, self._opt_state = self.optimizer.update(
+            np.asarray(grad_shard, dtype=np.float32), self._opt_state,
+            pshard)
+        new_shard = optax.apply_updates(pshard, updates)
+        full = collective.allgather_flat(np.asarray(new_shard),
+                                         group_name=self.group_name)
+        return _unflatten_from_vector(full, treedef, shapes, dtypes)
+
+
+def make_optimizer(optimizer, params, *,
+                   zero1: Optional[bool] = None,
+                   grad_compression: Optional[str] = None,
+                   group_name: Optional[str] = None):
+    """Build the gradient-sync/update wrapper the run's flags ask for:
+    ``ScalingConfig(zero1=True)`` → Zero1Optimizer, else DDPOptimizer;
+    ``grad_compression`` defaults from the TrainContext the same way."""
+    if zero1 is None or grad_compression is None or group_name is None:
+        ctx = get_context()
+        if zero1 is None:
+            zero1 = getattr(ctx, "zero1", False)
+        if grad_compression is None:
+            grad_compression = getattr(ctx, "grad_compression", None)
+        if group_name is None:
+            group_name = ctx.group_name
+    cls = Zero1Optimizer if zero1 else DDPOptimizer
+    return cls(optimizer, params, grad_compression=grad_compression,
+               group_name=group_name)

@@ -36,6 +36,12 @@ class ScalingConfig:
     # train/v2/_internal/execution/controller/state.py:125).
     min_workers: Optional[int] = None
     scaling_policy: Optional[Any] = None
+    # Gradient-sync cost levers (see train/collective.py): block-
+    # quantized allreduce transport ("int8" | "fp8" | None) and the
+    # ZeRO-1 cross-replica sharded optimizer update. Read off the
+    # TrainContext by allreduce_gradients()/make_optimizer().
+    grad_compression: Optional[str] = None
+    zero1: bool = False
 
     def resolved_scaling_policy(self):
         if self.scaling_policy is not None:

@@ -11,11 +11,15 @@ from __future__ import annotations
 
 import threading
 
+import logging
+
 from ray_tpu.devtools import locktrace
 from typing import Any, Dict, Iterable, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.util.metrics import Gauge
+
+logger = logging.getLogger(__name__)
 
 # Train-loop instrumentation (reference: Podracer-style TPU training
 # leans on step-time + duty-cycle visibility; PAPERS.md). Step time is
@@ -40,13 +44,19 @@ class TrainContext:
     def __init__(self, world_size: int, world_rank: int,
                  storage_path: str, resume_checkpoint: Optional[Checkpoint],
                  datasets: Optional[Dict[str, Any]] = None,
-                 group_name: str = "train"):
+                 group_name: str = "train",
+                 grad_compression: Optional[str] = None,
+                 zero1: bool = False):
         self.world_size = world_size
         self.world_rank = world_rank
         self.storage_path = storage_path
         self.resume_checkpoint = resume_checkpoint
         self.datasets = datasets or {}
         self.group_name = group_name
+        # gradient-sync flags from ScalingConfig, read by
+        # train.collective.allreduce_gradients / make_optimizer
+        self.grad_compression = grad_compression
+        self.zero1 = zero1
         self.reported: list = []
         self.pending_checkpoint_dirs: list = []
         self._lock = locktrace.traced_lock("train.context")
@@ -133,7 +143,7 @@ def report(metrics: Dict[str, Any],
             if mfu is not None:
                 TRAIN_MFU.set(min(max(float(mfu), 0.0), 1.0), tags=tags)
     except Exception:  # noqa: BLE001 — observability must not fail a run
-        pass
+        logger.debug("train step gauges not recorded", exc_info=True)
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

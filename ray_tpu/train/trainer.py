@@ -18,6 +18,7 @@ allreduce/barrier (in-graph math should use the mesh instead).
 
 from __future__ import annotations
 
+import logging
 import os
 import time
 import traceback
@@ -31,17 +32,23 @@ from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import Result, RunConfig, ScalingConfig
 from ray_tpu.util.placement_group import placement_group, remove_placement_group
 
+logger = logging.getLogger(__name__)
+
 
 class _TrainWorker:
     """Actor hosting one rank of the gang (runs in a 'tpu'-profile
     worker process when TPU resources are requested)."""
 
     def __init__(self, rank: int, world_size: int, storage_path: str,
-                 group_name: str, jax_env: Optional[dict] = None):
+                 group_name: str, jax_env: Optional[dict] = None,
+                 grad_compression: Optional[str] = None,
+                 zero1: bool = False):
         self.rank = rank
         self.world_size = world_size
         self.storage_path = storage_path
         self.group_name = group_name
+        self.grad_compression = grad_compression
+        self.zero1 = zero1
         if jax_env:
             # Multi-host bootstrap (reference: _setup_jax_tpu_environment).
             # The coordinator must bind on RANK 0's host (on a pod that's
@@ -95,7 +102,8 @@ class _TrainWorker:
             world_size=self.world_size, world_rank=self.rank,
             storage_path=self.storage_path,
             resume_checkpoint=Checkpoint(resume_path) if resume_path else None,
-            datasets=datasets, group_name=self.group_name)
+            datasets=datasets, group_name=self.group_name,
+            grad_compression=self.grad_compression, zero1=self.zero1)
         ctx_mod.set_context(ctx)
         try:
             if loop_config is not None:
@@ -189,8 +197,9 @@ class JaxTrainer:
                             rt.gcs.kv.delete(k, namespace="train_runs")
             else:
                 rt.gcs_call("kv_put", key, record, "train_runs")
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — dashboard record is best-effort
+            logger.debug("train run-state record not published",
+                         exc_info=True)
 
     def fit(self) -> Result:
         if not ray_tpu.is_initialized():
@@ -237,8 +246,9 @@ class JaxTrainer:
                 for w in workers:
                     try:
                         ray_tpu.kill(w)
-                    except Exception:
-                        pass
+                    except Exception:  # noqa: BLE001 — already torn down
+                        logger.debug("train worker kill failed during "
+                                     "group teardown", exc_info=True)
                 if pg is not None:
                     remove_placement_group(pg)
                 if reservation is not None:
@@ -339,7 +349,9 @@ class JaxTrainer:
             workers.append(
                 WorkerActor.options(**opts).remote(
                     rank, num_workers, storage, group_name,
-                    jax_env=env))
+                    jax_env=env,
+                    grad_compression=scaling.grad_compression,
+                    zero1=scaling.zero1))
         # Fail fast if any worker can't construct — and release every
         # reservation on the way out, or the next (resized) attempt sees
         # the failed gang still holding the cluster's resources.
@@ -349,8 +361,9 @@ class JaxTrainer:
             for w in workers:
                 try:
                     ray_tpu.kill(w)
-                except Exception:
-                    pass
+                except Exception:  # noqa: BLE001 — fail-fast cleanup
+                    logger.debug("train worker kill failed during "
+                                 "fail-fast cleanup", exc_info=True)
             if pg is not None:
                 remove_placement_group(pg)
             if slice_reservation is not None:
