@@ -1,6 +1,8 @@
 // Test C++ worker: one function + one stateful actor, driven by
 // tests/test_cpp_api.py against a live head.
 
+#include <unistd.h>
+
 #include <cstdlib>
 #include <stdexcept>
 #include <string>
@@ -30,6 +32,12 @@ class Counter : public ray_tpu::Actor {
       return std::to_string(total_);
     }
     if (method == "get") return std::to_string(total_);
+    if (method == "slow") {
+      // Parks this worker so a kill-mid-flight test has a call that
+      // is deterministically still pending when the worker dies.
+      sleep(30);
+      return "slow-done";
+    }
     throw std::runtime_error("unknown method " + method);
   }
 

@@ -379,7 +379,9 @@ class CapiSession:
         if cached is None or cached[0] != digest:
             from ray_tpu.core.remote_function import RemoteFunction
             cached = (digest, RemoteFunction(serialization.loads(blob)))
-            self._fn_cache[name] = cached
+            # digest-keyed last-write-wins cache: concurrent writers
+            # store equivalent values, so lock-free is benign
+            self._fn_cache[name] = cached  # graftlint: disable=GL001
         rf = cached[1]
         # runs as an ordinary task on the cluster — scheduling,
         # retries, and observability all apply

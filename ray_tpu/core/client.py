@@ -108,7 +108,6 @@ class ClientRuntime:
     def __init__(self, address: str, namespace: str = ""):
         self.address = address
         self.namespace = namespace
-        self.conn = self._connect()
         self._req_lock = locktrace.traced_lock("core.client.req")
         # ObjectRefs minted before a head restart: the new head never
         # owned them, so gets fail fast with HeadRestartedError
@@ -134,11 +133,19 @@ class ClientRuntime:
         self.reference_counter.set_deleter(
             lambda oid: self._send({"kind": "REF_DROP",
                                     "object_id": oid.binary()}))
-        self._reader = threading.Thread(target=self._reader_loop,
-                                        name="client-reader", daemon=True)
-        self._reader.start()
+        # The blocking handshake runs on this thread; the registered
+        # connection is then serviced by the shared IO loop (replies
+        # and pubsub arrive via _on_msg — no dedicated reader thread).
+        self._register_conn(self._connect())
 
     # -- transport -------------------------------------------------------
+    def _register_conn(self, mconn: MessageConnection):
+        from ray_tpu.core.io_loop import get_io_loop
+        conn = get_io_loop().register_message_conn(
+            mconn.sock, self._on_msg, self._on_conn_closed,
+            label="client")
+        self.conn = conn
+        return conn
     def _connect(self) -> MessageConnection:
         """Dial + AUTH + CLIENT_REGISTER handshake (used at init and by
         the reconnect loop after a head restart)."""
@@ -257,7 +264,7 @@ class ClientRuntime:
             # set.update is GIL-atomic for the racing readers.
             self._lost_oids.update(  # graftlint: disable=GL001
                 self.reference_counter.live_object_ids())
-            self.conn = conn
+            self._register_conn(conn)
             # re-establish server-side pubsub routes for live
             # subscriptions (the new head has no record of them)
             with self._req_lock:
@@ -269,38 +276,49 @@ class ClientRuntime:
             return True
         return False
 
-    def _reader_loop(self) -> None:
-        while not self._closed.is_set():
-            conn = self.conn
-            try:
-                msg = conn.recv()
-            except OSError:
-                msg = None
-            if msg is None:
-                # single-writer: the reader thread owns epoch bumps
-                self._conn_epoch += 1  # graftlint: disable=GL001
-                self._connected.clear()  # graftlint: disable=GL001
-                self._fail_inflight()
-                if self._try_reconnect():
-                    continue
-                break
-            kind = msg.get("kind")
-            if kind == "PUBSUB_MSG":
-                for cb in list(self._pubsub_callbacks.get(
-                        msg["channel"], ())):
-                    try:
-                        cb(serialization.loads(msg["data"]))
-                    except Exception:
-                        logger.exception("pubsub callback failed for "
-                                         "channel %r", msg["channel"])
-                continue
-            rid = msg.get("req_id")
-            with self._req_lock:
-                entry = self._replies.get(rid)
-            if entry is not None:
-                event, slot = entry
-                slot[0] = msg
-                event.set()
+    def _on_msg(self, conn, msg: dict) -> None:
+        """IO-loop handler for every head->client message (pubsub
+        fanout + request/reply correlation)."""
+        kind = msg.get("kind")
+        if kind == "PUBSUB_MSG":
+            for cb in list(self._pubsub_callbacks.get(
+                    msg["channel"], ())):
+                try:
+                    cb(serialization.loads(msg["data"]))
+                except Exception:
+                    logger.exception("pubsub callback failed for "
+                                     "channel %r", msg["channel"])
+            return
+        rid = msg.get("req_id")
+        with self._req_lock:
+            entry = self._replies.get(rid)
+        if entry is not None:
+            event, slot = entry
+            slot[0] = msg
+            event.set()
+
+    def _on_conn_closed(self, conn) -> None:
+        """IO-loop teardown hook: fires exactly once per connection
+        (EOF, error, or explicit close). Recovery — which dials the
+        head with blocking IO — runs on a transient thread; the loop
+        thread must not block."""
+        current = getattr(self, "conn", None)
+        if current is not None and conn is not current:
+            return  # a stale pre-reconnect connection finished dying
+        # single-writer: teardown fires once per connection, and the
+        # replacement conn is only installed by the reconnect thread
+        self._conn_epoch += 1  # graftlint: disable=GL001
+        self._connected.clear()  # graftlint: disable=GL001
+        self._fail_inflight()
+        if self._closed.is_set():
+            self._connected.set()
+            return
+        threading.Thread(target=self._reconnect_or_finalize,
+                         name="client-reconnect", daemon=True).start()
+
+    def _reconnect_or_finalize(self) -> None:
+        if self._try_reconnect():
+            return
         self._closed.set()
         self._connected.set()  # wake request() waiters to fail fast
         self._fail_inflight()

@@ -79,6 +79,16 @@ class Config:
     # Grace period before a dead worker's in-flight tasks are failed.
     worker_death_grace_s: float = 0.5
 
+    # --- core IO loop ---
+    # Outbound queue bytes above which producer threads block (write
+    # backpressure) until the loop drains the connection below the
+    # low-water mark; bulk streams self-pace on the same marks
+    # (reference: client_connection.cc async write queue).
+    io_loop_high_water_bytes: int = 4 * 1024 * 1024
+    io_loop_low_water_bytes: int = 1024 * 1024
+    # Max seconds a backpressured sender waits before the send fails.
+    io_loop_send_timeout_s: float = 60.0
+
     # --- multi-host control plane ---
     # TCP port for the head's node-daemon listener: -1 disables the
     # listener (single-host mode), 0 picks a free port

@@ -117,20 +117,16 @@ class Node:
         self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self._listener.bind(self.socket_path)
         self._listener.listen(128)
-        self._listener.setblocking(False)
-        # ONE selector-driven IO thread handles every worker connection:
+        # Worker connections ride the process-wide selector IO loop:
         # thread-per-worker reader loops anti-scale under the GIL (the
         # reference's raylet is similarly a single asio event loop,
-        # src/ray/common/asio/). Sends from other threads use the
-        # non-blocking-aware _send_all.
-        import selectors
-        self._selector = selectors.DefaultSelector()
-        self._selector.register(self._listener, selectors.EVENT_READ,
-                                ("accept", None))
-        self._io_thread = threading.Thread(
-            target=self._io_loop, name=f"node-io-{node_id.hex()[:6]}",
-            daemon=True)
-        self._io_thread.start()
+        # src/ray/common/asio/), and one shared loop also covers the
+        # head/client/object-transfer sockets (io_loop.py).
+        from ray_tpu.core.io_loop import get_io_loop
+        self._io = get_io_loop()
+        self._listener_handle = self._io.register_listener(
+            self._listener, self._on_worker_accept,
+            label=f"node-{node_id.hex()[:6]}")
         self.prestart_workers(get_config().min_idle_workers)
 
     # --- worker pool ---------------------------------------------------
@@ -299,60 +295,27 @@ class Node:
             return f"{base}|re:{spec.runtime_env_hash}"
         return base
 
-    def _io_loop(self) -> None:
-        from ray_tpu.core.protocol import FrameReader
-        import selectors
-        while not self._stopped.is_set():
-            try:
-                events = self._selector.select(timeout=0.5)
-            except OSError:
-                return
-            for key, _mask in events:
-                kind, state = key.data
-                if kind == "accept":
-                    try:
-                        sock, _ = self._listener.accept()
-                    except OSError:
-                        continue
-                    sock.setblocking(False)
-                    self._selector.register(
-                        sock, selectors.EVENT_READ,
-                        ("conn", [MessageConnection(sock), FrameReader(),
-                                  None]))
-                    continue
-                try:
-                    self._service_conn(key.fileobj, state)
-                except Exception:  # noqa: BLE001 — one bad connection
-                    # (or death-handler error) must not kill the node's
-                    # only IO thread
-                    import traceback
-                    traceback.print_exc()
+    def _on_worker_accept(self, sock, _addr) -> None:
+        """Runs on the IO loop thread for each worker that dials the
+        node's unix socket. ``holder`` threads the WorkerHandle from
+        the REGISTER message into later frames and the close hook."""
+        holder = [None]
 
-    def _service_conn(self, sock, state) -> None:
-        conn, reader, handle = state
-        try:
-            data = sock.recv(262144)
-        except (BlockingIOError, InterruptedError):
-            return
-        except OSError:
-            data = b""
-        if not data:
+        def on_msg(conn, msg):
             try:
-                self._selector.unregister(sock)
-            except (KeyError, OSError):
-                pass
-            conn.close()
-            if handle is not None:
-                self._on_worker_death(handle)
-            return
-        for frame in reader.feed(data):
-            try:
-                msg = serialization.loads(frame)
-                new_handle = self._handle_worker_msg(conn, state[2], msg)
-                state[2] = new_handle
+                holder[0] = self._handle_worker_msg(conn, holder[0], msg)
             except Exception:  # noqa: BLE001 — keep the connection alive
                 import traceback
                 traceback.print_exc()
+
+        def on_close(conn):
+            # Post-stop EOFs are expected (workers exiting on SHUTDOWN);
+            # don't drive the death path during teardown.
+            if holder[0] is not None and not self._stopped.is_set():
+                self._on_worker_death(holder[0])
+
+        self._io.register_message_conn(sock, on_msg, on_close,
+                                       label="node-worker")
 
     def _handle_worker_msg(self, conn: MessageConnection,
                            handle: Optional[WorkerHandle],
@@ -816,14 +779,10 @@ class Node:
                 worker.proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
                 worker.proc.kill()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        try:
-            self._selector.close()
-        except (OSError, RuntimeError):
-            pass
+        self._listener_handle.close(wait=True)
+        for worker in workers:
+            if worker.conn is not None:
+                worker.conn.close()
         try:
             os.unlink(self.socket_path)
         except OSError:

@@ -22,15 +22,14 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional, Tuple
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.protocol import (
-    MessageConnection,
     connect_tcp,
     listen_tcp,
-    recv_msg,
     send_msg,
 )
 from ray_tpu.util.metrics import Counter, Histogram
@@ -198,13 +197,50 @@ def get_pull_manager() -> PullManager:
         return _pull_manager
 
 
+class _PullConn:
+    """One puller connection, driven by the shared IO loop (replaces
+    the thread-per-puller reader). Requests on a connection are
+    answered strictly in order — a connection's reply stream is
+    PULL_META followed by that object's chunk frames, so two admitted
+    pulls must never interleave on one socket."""
+
+    def __init__(self, server: "ObjectServer", sock: socket.socket):
+        self.server = server
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Loop-thread only (frames, stream completions, and retry
+        # timers all dispatch there) — no lock.
+        self.pending: deque = deque()
+        self.busy = False
+        self.conn = server._io.register_message_conn(
+            sock, self._on_msg, self._on_close, label="object-server")
+        server._conns.add(self.conn)
+
+    def _on_msg(self, conn, msg: dict) -> None:
+        if msg.get("kind") != "PULL":
+            conn.close()
+            return
+        self.pending.append(ObjectID(msg["object_id"]))
+        if not self.busy:
+            self.busy = True
+            self.server._admit(self)
+
+    def _on_close(self, conn) -> None:
+        self.server._conns.discard(conn)
+        self.pending.clear()
+
+
 class ObjectServer:
     """Serves chunked object reads from local shared-memory stores.
 
     ``resolve`` maps an ObjectID to a store holding it (the head serves
     every in-process simulated node from one server; a daemon serves its
-    single store). Admission control: at most
-    ``object_pull_concurrency`` concurrent outbound streams.
+    single store). Accepts and request parsing ride the shared IO loop —
+    no accept thread, no thread per puller; payload chunks go out
+    through the loop's streaming writer, which pulls from the chunk
+    generator only while the outbound queue is below the low-water
+    mark, so a 100 GiB object still moves with O(chunk) memory.
+    Admission control: at most ``object_pull_concurrency`` concurrent
+    outbound streams; excess pulls queue in arrival order.
     """
 
     def __init__(self, resolve: Callable[[ObjectID], Optional[object]],
@@ -212,91 +248,151 @@ class ObjectServer:
         self._resolve = resolve
         self._listener = listen_tcp(host, 0)
         self.address: Tuple[str, int] = self._listener.getsockname()
-        self._sem = threading.Semaphore(get_config().object_pull_concurrency)
         self._stopped = threading.Event()
-        self._thread = threading.Thread(
-            target=self._accept_loop, name="object-server", daemon=True)
-        self._thread.start()
+        from ray_tpu.core.io_loop import get_io_loop
+        self._io = get_io_loop()
+        # Admission state is loop-thread only — no lock.
+        self._max = get_config().object_pull_concurrency
+        self._active = 0
+        self._ready: deque = deque()  # _PullConns waiting for a slot
+        self._conns: set = set()
+        self._listener_handle = self._io.register_listener(
+            self._listener, self._on_accept, label="object-server")
 
-    def _accept_loop(self) -> None:
-        while not self._stopped.is_set():
-            try:
-                sock, _ = self._listener.accept()
-            except OSError:
-                return
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve, args=(sock,),
-                             daemon=True).start()
+    def _on_accept(self, sock: socket.socket, _addr) -> None:
+        _PullConn(self, sock)
 
-    def _serve(self, sock: socket.socket) -> None:
+    # --- admission (reference: pull_manager.h:50) ---------------------
+
+    def _admit(self, pc: _PullConn) -> None:
+        self._ready.append(pc)
+        self._pump()
+
+    def _pump(self) -> None:
+        while self._ready and self._active < self._max:
+            pc = self._ready.popleft()
+            if pc.conn.closed or not pc.pending:
+                pc.busy = False
+                continue
+            oid = pc.pending.popleft()
+            self._active += 1
+            if not self._start(pc, oid):
+                # Replied synchronously (PULL_ERR); the slot frees now.
+                self._active -= 1
+                if pc.pending:
+                    self._ready.append(pc)
+                else:
+                    pc.busy = False
+
+    def _finished(self, pc: _PullConn) -> None:
+        """A stream (or deferred attempt) released its slot."""
+        self._active -= 1
+        if not pc.conn.closed and pc.pending:
+            self._ready.append(pc)
+        else:
+            pc.busy = False
+        self._pump()
+
+    # --- one pull ------------------------------------------------------
+
+    def _start(self, pc: _PullConn, oid: ObjectID) -> bool:
+        """Begin serving ``oid``; True if a slot-holding continuation
+        (stream or retry timer) is now in flight."""
+        source = self._resolve(oid)
+        if source is None:
+            return self._err(pc, "object not found")
+        if isinstance(source, tuple) and source[0] == "file":
+            # spilled payload: stream straight off disk (reference:
+            # serving spilled objects back out of external storage)
+            return self._start_file(pc, source[1])
+        self._store_step(pc, source, oid, time.monotonic() + 2.0)
+        return True
+
+    def _err(self, pc: _PullConn, reason: str) -> bool:
+        try:
+            pc.conn.send({"kind": "PULL_ERR", "error": reason})
+        except OSError:
+            pass
+        return False
+
+    def _start_file(self, pc: _PullConn, path: str) -> bool:
+        import os
         chunk_size = get_config().object_chunk_size
         try:
-            while True:
-                msg = recv_msg(sock)
-                if msg is None or msg.get("kind") != "PULL":
-                    return
-                oid = ObjectID(msg["object_id"])
-                source = self._resolve(oid)
-                if source is None:
-                    send_msg(sock, {"kind": "PULL_ERR",
-                                    "error": "object not found"})
-                    continue
-                if isinstance(source, tuple) and source[0] == "file":
-                    # spilled payload: stream straight off disk
-                    # (reference: serving spilled objects back out of
-                    # external storage)
-                    self._serve_file(sock, source[1], chunk_size)
-                    continue
-                buf = source.get_buffer(oid, timeout_s=2.0)
-                if buf is None:
-                    send_msg(sock, {"kind": "PULL_ERR",
-                                    "error": "object not found"})
-                    continue
-                with self._sem:
-                    try:
-                        size = len(buf)
-                        send_msg(sock, {"kind": "PULL_META", "size": size})
-                        # Raw length-prefixed chunks — no pickling of
-                        # payload bytes on the hot path.
-                        for off in range(0, size, chunk_size):
-                            part = buf[off:off + chunk_size]
-                            sock.sendall(_LEN.pack(len(part)))
-                            sock.sendall(part)
-                    finally:
-                        del buf
-                        source.release(oid)
-        except OSError:
-            return
-        finally:
-            try:
-                sock.close()
-            except OSError:
-                pass
-
-    def _serve_file(self, sock: socket.socket, path: str,
-                    chunk_size: int) -> None:
-        import os
-        try:
             size = os.path.getsize(path)
+            f = open(path, "rb")
         except OSError:
-            send_msg(sock, {"kind": "PULL_ERR", "error": "spill file gone"})
-            return
-        with self._sem:
-            send_msg(sock, {"kind": "PULL_META", "size": size})
-            with open(path, "rb") as f:
+            return self._err(pc, "spill file gone")
+
+        def chunks():
+            try:
                 while True:
                     part = f.read(chunk_size)
                     if not part:
-                        break
-                    sock.sendall(_LEN.pack(len(part)))
-                    sock.sendall(part)
+                        return
+                    yield part
+            finally:
+                f.close()
+
+        try:
+            pc.conn.send({"kind": "PULL_META", "size": size})
+            pc.conn.send_stream(chunks(), lambda exc: self._finished(pc))
+        except OSError:
+            f.close()
+            return False
+        return True
+
+    def _store_step(self, pc: _PullConn, source, oid: ObjectID,
+                    deadline: float) -> None:
+        """One slot-holding attempt to stream ``oid`` out of ``source``.
+        An unsealed object (writer mid-put — the old reader thread
+        blocked in get_buffer for it) is polled via the loop timer."""
+        if pc.conn.closed:
+            self._finished(pc)
+            return
+        buf = source.get_buffer(oid, timeout_s=0.0)
+        if buf is None:
+            if time.monotonic() < deadline:
+                self._io.call_later(0.05, self._store_step,
+                                    pc, source, oid, deadline)
+                return
+            self._err(pc, "object not found")
+            self._finished(pc)
+            return
+        chunk_size = get_config().object_chunk_size
+        size = len(buf)
+        # The generator reaches the buffer through a holder the
+        # completion callback empties, so the shm pin is dropped before
+        # release() even though the (discarded) generator may linger.
+        holder = [buf]
+        del buf
+
+        def chunks():
+            for off in range(0, size, chunk_size):
+                yield bytes(holder[0][off:off + chunk_size])
+
+        def on_done(_exc):
+            holder.clear()
+            source.release(oid)
+            self._finished(pc)
+
+        try:
+            pc.conn.send({"kind": "PULL_META", "size": size})
+            pc.conn.send_stream(chunks(), on_done)
+        except OSError:
+            holder.clear()
+            source.release(oid)
+            self._finished(pc)
 
     def stop(self) -> None:
         self._stopped.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        self._listener_handle.close(wait=True)
+
+        def _sever():
+            for conn in list(self._conns):
+                conn.close()
+
+        self._io.call_soon(_sever)
 
 
 def _recv_exact_into(sock: socket.socket, view: memoryview) -> bool:

@@ -195,6 +195,11 @@ class FrameReader:
             del buf[:end]
         return out
 
+    def leftover(self) -> bytes:
+        """Unparsed buffered bytes (a partial frame tail) — consumed
+        when a connection is handed off to a different protocol."""
+        return bytes(self._buf)
+
 
 def recv_frame(sock: socket.socket) -> Optional[bytes]:
     """One raw length-prefixed frame (no deserialization) — used where
@@ -280,6 +285,26 @@ class MessageConnection:
         except OSError:
             pass
         self.sock.close()
+
+
+class _PrebufferedSocket:
+    """Socket wrapper that serves already-read bytes before touching
+    the wire — used when a connection leaves the IO loop for a
+    blocking protocol handler (C-API handoff) with bytes still sitting
+    in the loop-side decode buffer."""
+
+    def __init__(self, sock: socket.socket, pending: bytes):
+        self._sock = sock
+        self._pending = pending
+
+    def recv(self, n: int) -> bytes:
+        if self._pending:
+            out, self._pending = self._pending[:n], self._pending[n:]
+            return out
+        return self._sock.recv(n)
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
 
 
 # --- message kinds (node manager <-> worker) ---------------------------

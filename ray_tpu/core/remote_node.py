@@ -279,6 +279,165 @@ class ClientSession:
         self.runtime._drop_worker_subscriptions(self.node_id)
 
 
+class _HeadConn:
+    """Per-peer protocol state machine on the head, driven by the IO
+    loop (replaces the thread-per-connection reader). The first frame
+    decides the peer's codec: C-API clients open with the b"CAPI"
+    magic (binary TLV, any language — handed off to a dedicated
+    session thread since that protocol is blocking); everything else
+    is a pickled dict (nodes, Python clients) behind the AUTH gate."""
+
+    def __init__(self, server: "HeadServer", sock):
+        self.server = server
+        self.runtime = server.runtime
+        self.state = "first"
+        self.node: Optional[RemoteNode] = None
+        self.client: Optional["ClientSession"] = None
+        self.conn = server._io.register(sock, self._on_frames,
+                                        self._on_close,
+                                        label="head-peer")
+        with server._conns_lock:
+            server._conns.add(self.conn)
+        if server._stopped.is_set():
+            self.conn.close()
+
+    def _on_frames(self, conn, frames) -> None:
+        for idx, frame in enumerate(frames):
+            if self.state == "steady":
+                self._handle_frame(frame)
+                continue
+            action = self._handshake(frame)
+            if action == "capi":
+                self._handoff_capi(frame, frames[idx + 1:])
+                return
+            if action == "close":
+                conn.close()
+                return
+
+    def _handshake(self, frame: bytes) -> Optional[str]:
+        from ray_tpu.core.config import auth_token_matches, get_config
+        if self.state == "first":
+            if frame[:4] == b"CAPI":
+                # C-API peers authenticate inside their own (binary,
+                # never-unpickled) handshake.
+                return "capi"
+            self.state = "register"
+            # Auth gate BEFORE any unpickling: deserializing bytes
+            # from an unauthenticated peer would be arbitrary code
+            # execution (pickle). With a token configured, the first
+            # frame must be the plaintext b"AUTH" + token; only then
+            # is the next frame parsed (reference:
+            # rpc/authentication/ token middleware).
+            if get_config().auth_token:
+                if (frame[:4] != b"AUTH"
+                        or not auth_token_matches(frame[4:])):
+                    try:
+                        self.conn.send_frame(serialization.dumps_fast(
+                            {"kind": "REGISTER_REJECTED",
+                             "reason": "authentication failed"}))
+                    except OSError:
+                        pass
+                    return "close"
+                return None  # token consumed; next frame registers
+            if frame[:4] == b"AUTH":
+                # peer supplies a token the head doesn't require: accept
+                return None
+            return self._register(frame)
+        return self._register(frame)
+
+    def _register(self, frame: bytes) -> Optional[str]:
+        try:
+            msg = serialization.loads(frame)
+        except Exception:  # noqa: BLE001 — garbage frame (port probe,
+            # mis-pointed client): close instead of leaking the socket
+            return "close"
+        try:
+            from ray_tpu.core.protocol import (
+                CAPABILITIES, PROTOCOL_MINOR, PROTOCOL_VERSION)
+            kind = msg.get("kind")
+            peer_version = msg.get("proto_version", 0)
+            if kind not in ("NODE_REGISTER", "CLIENT_REGISTER"):
+                return "close"
+            # Major must match; minor may differ (additive-only
+            # evolution — see protocol.py policy).
+            if peer_version != PROTOCOL_VERSION:
+                self.conn.send({"kind": "REGISTER_REJECTED",
+                                "reason": "protocol version mismatch: "
+                                          f"head={PROTOCOL_VERSION} "
+                                          f"peer={peer_version}"})
+                return "close"
+            handshake_extra = {
+                "proto_version": PROTOCOL_VERSION,
+                "proto_minor": PROTOCOL_MINOR,
+                "capabilities": list(CAPABILITIES),
+            }
+            if kind == "CLIENT_REGISTER":
+                self.client = ClientSession(self.runtime, self.conn)
+                self.client.proto_minor = msg.get("proto_minor", 0)
+                self.conn.send({"kind": "REGISTERED",
+                                "head_node_id":
+                                    self.runtime.head_node_id.binary(),
+                                **handshake_extra})
+            else:
+                self.node = self.runtime.register_remote_node(self.conn,
+                                                              msg)
+                # negotiation is two-way: record the peer's minor so a
+                # newer head can gate additive kinds per node
+                self.node.proto_minor = msg.get("proto_minor", 0)
+                self.conn.send({"kind": "REGISTERED", **handshake_extra})
+            self.state = "steady"
+        except Exception:  # noqa: BLE001 — keep the daemon link alive
+            import traceback
+            traceback.print_exc()
+        return None
+
+    def _handle_frame(self, frame: bytes) -> None:
+        try:
+            msg = serialization.loads(frame)
+            if self.client is not None:
+                if not self.client.handle(msg):
+                    self.conn.close()
+            else:
+                self.server._handle(self.node, msg)
+        except Exception:  # noqa: BLE001 — keep the daemon link alive
+            import traceback
+            traceback.print_exc()
+
+    def _handoff_capi(self, first: bytes, rest) -> None:
+        # Re-frame frames the loop already decoded past the magic plus
+        # the partial tail, so the CAPI session sees every byte.
+        from ray_tpu.core.protocol import _LEN, _PrebufferedSocket
+        leftover = b"".join(_LEN.pack(len(f)) + f for f in rest)
+        leftover += self.conn._codec.leftover()
+        sock = self.server._io.detach(self.conn)
+        with self.server._conns_lock:
+            self.server._conns.discard(self.conn)
+        sock.setblocking(True)
+        if leftover:
+            sock = _PrebufferedSocket(sock, leftover)
+
+        def _serve():
+            from ray_tpu.capi import CapiSession
+            CapiSession(self.runtime, sock, first).serve()
+
+        threading.Thread(target=_serve, name="capi-session",
+                         daemon=True).start()
+
+    def _on_close(self, conn) -> None:
+        with self.server._conns_lock:
+            self.server._conns.discard(conn)
+        if self.node is not None:
+            # expected= pins the death to THIS connection's RemoteNode:
+            # with node_reconnect_s the daemon may have re-registered
+            # on a new connection before this (stale) one's EOF was
+            # observed, and a by-id kill would tear down the fresh
+            # record.
+            self.runtime.on_remote_node_death(self.node.node_id,
+                                              expected=self.node)
+        if self.client is not None:
+            self.client.close()
+
+
 class HeadServer:
     """The head's TCP listener for node daemons."""
 
@@ -292,25 +451,18 @@ class HeadServer:
         # run their reconnect paths instead of waiting forever).
         self._conns_lock = locktrace.traced_lock("core.remote_node.conns")
         self._conns: set = set()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="head-accept", daemon=True)
-        self._accept_thread.start()
+        # Accepts and per-peer reads ride the shared IO loop — no
+        # accept thread, no thread per peer (io_loop.py).
+        from ray_tpu.core.io_loop import get_io_loop
+        self._io = get_io_loop()
+        self._listener_handle = self._io.register_listener(
+            self._listener, self._on_accept, label="head")
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, name="head-monitor", daemon=True)
         self._monitor_thread.start()
 
-    def _accept_loop(self) -> None:
-        while not self._stopped.is_set():
-            try:
-                sock, _ = self._listener.accept()
-            except OSError:
-                return
-            conn = MessageConnection(sock)
-            with self._conns_lock:
-                self._conns.add(conn)
-            threading.Thread(target=self._reader_loop,
-                             args=(conn,),
-                             daemon=True).start()
+    def _on_accept(self, sock, _addr) -> None:
+        _HeadConn(self, sock)
 
     def _monitor_loop(self) -> None:
         """Declare remote nodes dead when heartbeats stop
@@ -324,112 +476,6 @@ class HeadServer:
                         > cfg.heartbeat_timeout_s):
                     self.runtime.on_remote_node_death(node.node_id,
                                                       expected=node)
-
-    def _reader_loop(self, conn: MessageConnection) -> None:
-        # The first frame decides the peer's codec: C-API clients open
-        # with the b"CAPI" magic (binary TLV, any language); everything
-        # else is a pickled dict (nodes, Python clients).
-        from ray_tpu.core.protocol import recv_frame, send_frame
-        first = recv_frame(conn.sock)
-        if first is None:
-            conn.close()
-            return
-        if first[:4] == b"CAPI":
-            # C-API peers authenticate inside their own (binary,
-            # never-unpickled) handshake.
-            from ray_tpu.capi import CapiSession
-            CapiSession(self.runtime, conn.sock, first).serve()
-            return
-        # Auth gate BEFORE any unpickling: deserializing bytes from an
-        # unauthenticated peer would be arbitrary code execution
-        # (pickle). With a token configured, the first frame must be
-        # the plaintext b"AUTH" + token; only then is the next frame
-        # parsed (reference: rpc/authentication/ token middleware).
-        from ray_tpu.core.config import auth_token_matches, get_config
-        if get_config().auth_token:
-            if first[:4] != b"AUTH" or not auth_token_matches(first[4:]):
-                try:
-                    send_frame(conn.sock, serialization.dumps_fast(
-                        {"kind": "REGISTER_REJECTED",
-                         "reason": "authentication failed"}))
-                except OSError:
-                    pass
-                conn.close()
-                return
-            first = recv_frame(conn.sock)
-            if first is None:
-                conn.close()
-                return
-        elif first[:4] == b"AUTH":
-            # peer supplies a token the head doesn't require: accept
-            first = recv_frame(conn.sock)
-            if first is None:
-                conn.close()
-                return
-        try:
-            pending = [serialization.loads(first)]
-        except Exception:  # noqa: BLE001 — garbage frame (port probe,
-            # mis-pointed client): close instead of leaking the socket
-            conn.close()
-            return
-        node: Optional[RemoteNode] = None
-        client: Optional["ClientSession"] = None
-        while True:
-            msg = pending.pop() if pending else conn.recv()
-            if msg is None:
-                break
-            try:
-                if node is None and client is None:
-                    from ray_tpu.core.protocol import (
-                        CAPABILITIES, PROTOCOL_MINOR, PROTOCOL_VERSION)
-                    kind = msg.get("kind")
-                    peer_version = msg.get("proto_version", 0)
-                    if kind not in ("NODE_REGISTER", "CLIENT_REGISTER"):
-                        break
-                    # Major must match; minor may differ (additive-only
-                    # evolution — see protocol.py policy).
-                    if peer_version != PROTOCOL_VERSION:
-                        conn.send({"kind": "REGISTER_REJECTED",
-                                   "reason": "protocol version mismatch: "
-                                             f"head={PROTOCOL_VERSION} "
-                                             f"peer={peer_version}"})
-                        break
-                    handshake_extra = {
-                        "proto_version": PROTOCOL_VERSION,
-                        "proto_minor": PROTOCOL_MINOR,
-                        "capabilities": list(CAPABILITIES),
-                    }
-                    if kind == "CLIENT_REGISTER":
-                        client = ClientSession(self.runtime, conn)
-                        client.proto_minor = msg.get("proto_minor", 0)
-                        conn.send({"kind": "REGISTERED",
-                                   "head_node_id":
-                                       self.runtime.head_node_id.binary(),
-                                   **handshake_extra})
-                        continue
-                    node = self.runtime.register_remote_node(conn, msg)
-                    # negotiation is two-way: record the peer's minor so
-                    # a newer head can gate additive kinds per node
-                    node.proto_minor = msg.get("proto_minor", 0)
-                    conn.send({"kind": "REGISTERED", **handshake_extra})
-                elif client is not None:
-                    if not client.handle(msg):
-                        break
-                else:
-                    self._handle(node, msg)
-            except Exception:  # noqa: BLE001 — keep the daemon link alive
-                import traceback
-                traceback.print_exc()
-        with self._conns_lock:
-            self._conns.discard(conn)
-        if node is not None:
-            # expected= pins the death to THIS connection's RemoteNode:
-            # with node_reconnect_s the daemon may have re-registered on
-            # a new connection before this (stale) one's EOF woke the
-            # reader, and a by-id kill would tear down the fresh record.
-            self.runtime.on_remote_node_death(node.node_id, expected=node)
-        if client is not None:
-            client.close()
 
     def _handle(self, node: RemoteNode, msg: dict) -> None:
         rt = self.runtime
@@ -518,27 +564,11 @@ class HeadServer:
                            "unsupported_kind": kind})
 
     def stop(self) -> None:
-        import socket as socket_mod
         self._stopped.set()
-        # A thread parked in accept() holds the underlying listen socket
-        # open PAST close() (Linux close doesn't wake accept), which
-        # keeps the port bound and makes a same-address head restart
-        # fail with EADDRINUSE. Wake the accepter with a no-op
-        # connection before closing.
-        wake_host = self.address[0]
-        if wake_host in ("0.0.0.0", "::"):
-            wake_host = "127.0.0.1"
-        try:
-            with socket_mod.create_connection(
-                    (wake_host, self.address[1]), timeout=1.0):
-                pass
-        except OSError:
-            pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        self._accept_thread.join(timeout=2.0)
+        # The loop's non-blocking listener closes synchronously — no
+        # wake-connection hack needed (the old accept-thread design
+        # had to dial itself to unblock accept() before closing).
+        self._listener_handle.close(wait=True)
         # Sever every accepted connection, as a real crash would —
         # remote peers (clients, daemons) observe EOF and run their
         # reconnect logic instead of waiting on a half-dead head.

@@ -12,6 +12,7 @@ shuffle stages with num_returns=N tasks.
 
 from __future__ import annotations
 
+import logging
 import threading
 from collections import deque
 from dataclasses import dataclass, field
@@ -24,6 +25,8 @@ import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.transforms import MapTransform, apply_transform_chain
+
+logger = logging.getLogger(__name__)
 
 # ---------------------------------------------------------------------------
 # Remote task bodies (module-level so they pickle by value once).
@@ -380,15 +383,15 @@ class _ActorPool:
                 self._idle_since.pop(i, None)
                 try:
                     ray_tpu.kill(actor)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — actor already dead
+                    logger.debug("scale-down kill failed", exc_info=True)
 
     def shutdown(self):
         for a in self.actors.values():
             try:
                 ray_tpu.kill(a)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — actor already dead
+                logger.debug("pool shutdown kill failed", exc_info=True)
 
 
 class _OpState:

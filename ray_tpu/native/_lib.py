@@ -4,9 +4,10 @@ from __future__ import annotations
 
 import ctypes
 
-from ray_tpu.native.build import ensure_built
+from ray_tpu.native.build import NativeBuildError, ensure_built
 
 _lib = None
+_load_error: Exception | None = None
 
 
 def load():
@@ -43,8 +44,48 @@ def load():
         lib.shm_num_objects.argtypes = [p]
         lib.shm_total_bytes.restype = i64
         lib.shm_total_bytes.argtypes = [p]
+        # --- wire codec (wire.cc) ---
+        pp = ctypes.POINTER(p)
+        lib.wire_decoder_new.restype = p
+        lib.wire_decoder_new.argtypes = []
+        lib.wire_decoder_free.restype = None
+        lib.wire_decoder_free.argtypes = [p]
+        lib.wire_decoder_read_fd.restype = i64
+        lib.wire_decoder_read_fd.argtypes = [p, ctypes.c_int]
+        lib.wire_decoder_feed.restype = i64
+        lib.wire_decoder_feed.argtypes = [p, ctypes.c_char_p, u64]
+        lib.wire_decoder_next.restype = i64
+        lib.wire_decoder_next.argtypes = [p, pp]
+        lib.wire_decoder_leftover.restype = i64
+        lib.wire_decoder_leftover.argtypes = [p, pp]
+        lib.wire_decoder_buffered.restype = i64
+        lib.wire_decoder_buffered.argtypes = [p]
+        lib.wire_writer_new.restype = p
+        lib.wire_writer_new.argtypes = []
+        lib.wire_writer_free.restype = None
+        lib.wire_writer_free.argtypes = [p]
+        lib.wire_writer_enqueue.restype = i64
+        lib.wire_writer_enqueue.argtypes = [p, ctypes.c_char_p, u64]
+        lib.wire_writer_flush_fd.restype = i64
+        lib.wire_writer_flush_fd.argtypes = [p, ctypes.c_int]
+        lib.wire_writer_queued.restype = i64
+        lib.wire_writer_queued.argtypes = [p]
         _lib = lib
     return _lib
+
+
+def try_load():
+    """load(), or None when the native toolchain/library is
+    unavailable (callers use their pure-Python fallback). The failure
+    is cached so this is cheap to call on hot setup paths."""
+    global _load_error
+    if _load_error is not None:
+        return None
+    try:
+        return load()
+    except (NativeBuildError, OSError) as exc:
+        _load_error = exc
+        return None
 
 
 OK = 0
@@ -54,3 +95,8 @@ FULL = -3
 TIMEOUT = -4
 CORRUPT = -5
 BAD_STATE = -6
+
+# wire codec status codes
+WIRE_EOF = -1
+WIRE_ERR = -2
+WIRE_PROTO = -3

@@ -3,21 +3,37 @@
 The .so is compiled once per machine into ray_tpu/native/_build/ and
 reused; rebuilt automatically when any source file is newer than the
 binary. Keeps the repo pip-install-free (no pybind11; plain ctypes ABI).
+
+Build failures (g++ missing, compile error) raise NativeBuildError and
+are cached: the first failure logs one warning, later calls fail fast
+instead of re-running the compiler on every import/call so callers can
+route onto their pure-Python fallbacks cheaply.
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import subprocess
 import threading
 
 from ray_tpu.devtools import locktrace
 
+logger = logging.getLogger(__name__)
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC_DIR = os.path.join(_DIR, "src")
 _BUILD_DIR = os.path.join(_DIR, "_build")
 _LIB_PATH = os.path.join(_BUILD_DIR, "libray_tpu_native.so")
 _lock = locktrace.traced_lock("native.build")
+# target key -> failure detail; guarded by _lock. A key present here
+# means "don't retry the compile this process".
+_build_failed: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    """Raised when the native toolchain is unavailable or the compile
+    fails; callers catch this and fall back to pure Python."""
 
 
 def _sources():
@@ -30,45 +46,90 @@ def _sources():
     )
 
 
+def _fresh(out: str, srcs) -> bool:
+    if not os.path.exists(out):
+        return False
+    out_mtime = os.path.getmtime(out)
+    return all(os.path.getmtime(s) <= out_mtime for s in srcs)
+
+
+def _compile(key: str, cmd, out: str) -> str:
+    """Run one g++ invocation OUTSIDE any lock (compiles take seconds;
+    holding a lock across them would serialize unrelated callers and
+    trip the blocking-under-lock lint). Concurrent duplicate compiles
+    are benign: each writes a unique tmp and os.replace is atomic."""
+    tmp = f"{out}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        proc = subprocess.run(cmd + ["-o", tmp], capture_output=True,
+                              text=True)
+    except OSError as exc:  # g++ not installed at all
+        _record_failure(key, f"toolchain unavailable: {exc}")
+        raise NativeBuildError(f"native build failed ({key}): {exc}") \
+            from exc
+    if proc.returncode != 0:
+        detail = (proc.stderr or proc.stdout or "").strip()[-2000:]
+        _record_failure(key, detail)
+        raise NativeBuildError(
+            f"native build failed ({key}, rc={proc.returncode}):\n{detail}")
+    os.replace(tmp, out)
+    return out
+
+
+def _record_failure(key: str, detail: str) -> None:
+    with _lock:
+        first = not _build_failed
+        _build_failed[key] = detail
+    if first:
+        logger.warning(
+            "native build failed (%s); using pure-Python fallbacks for "
+            "this process: %s", key, detail.splitlines()[-1] if detail
+            else detail)
+
+
+def _check_cached_failure(key: str) -> None:
+    with _lock:
+        detail = _build_failed.get(key)
+    if detail is not None:
+        raise NativeBuildError(
+            f"native build previously failed ({key}): {detail}")
+
+
 def ensure_built() -> str:
+    _check_cached_failure("lib")
     with _lock:
         srcs = _sources()
-        if os.path.exists(_LIB_PATH):
-            lib_mtime = os.path.getmtime(_LIB_PATH)
-            if all(os.path.getmtime(s) <= lib_mtime for s in srcs):
-                return _LIB_PATH
+        if _fresh(_LIB_PATH, srcs):
+            return _LIB_PATH
         os.makedirs(_BUILD_DIR, exist_ok=True)
-        cmd = [
-            "g++", "-O2", "-g", "-fPIC", "-shared", "-std=c++17",
-            "-Wall", "-pthread",
-            "-o", _LIB_PATH + ".tmp", *srcs,
-        ]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
-        return _LIB_PATH
+    cmd = ["g++", "-O2", "-g", "-fPIC", "-shared", "-std=c++17",
+           "-Wall", "-pthread", *srcs]
+    return _compile("lib", cmd, _LIB_PATH)
 
 
-def build_stress(sanitizer: str = "") -> str:
-    """Build the shm-store stress binary (ray_tpu/native/src/
-    stress_test_main.cc), optionally under ASan/TSan — the seam the
+def build_stress(sanitizer: str = "",
+                 main_src: str = "stress_test_main.cc") -> str:
+    """Build a stress binary from ``src/<main_src>`` linked against the
+    library sources, optionally under ASan/TSan — the seam the
     reference covers with its sanitizer bazel configs (SURVEY.md §5.2,
-    .bazelrc:112-132). Returns the binary path; raises
-    subprocess.CalledProcessError with compiler output on failure."""
+    .bazelrc:112-132). The default main is the shm-store harness; pass
+    ``wire_stress_main.cc`` for the wire-codec harness. Returns the
+    binary path; raises NativeBuildError with compiler output on
+    failure."""
     if sanitizer not in ("", "address", "thread"):
         raise ValueError(f"unknown sanitizer {sanitizer!r}")
+    stem = "shm_stress" if main_src == "stress_test_main.cc" \
+        else main_src[:-len("_main.cc")]
     suffix = f"-{sanitizer}" if sanitizer else ""
-    out = os.path.join(_BUILD_DIR, f"shm_stress{suffix}")
+    out = os.path.join(_BUILD_DIR, f"{stem}{suffix}")
+    key = f"{stem}{suffix}"
+    _check_cached_failure(key)
     with _lock:
-        srcs = _sources() + [os.path.join(_SRC_DIR, "stress_test_main.cc")]
-        if os.path.exists(out):
-            bin_mtime = os.path.getmtime(out)
-            if all(os.path.getmtime(s) <= bin_mtime for s in srcs):
-                return out
+        srcs = _sources() + [os.path.join(_SRC_DIR, main_src)]
+        if _fresh(out, srcs):
+            return out
         os.makedirs(_BUILD_DIR, exist_ok=True)
-        cmd = ["g++", "-O1", "-g", "-std=c++17", "-Wall", "-pthread"]
-        if sanitizer:
-            cmd += [f"-fsanitize={sanitizer}", "-fno-omit-frame-pointer"]
-        cmd += ["-o", out + ".tmp", *srcs]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(out + ".tmp", out)
-        return out
+    cmd = ["g++", "-O1", "-g", "-std=c++17", "-Wall", "-pthread"]
+    if sanitizer:
+        cmd += [f"-fsanitize={sanitizer}", "-fno-omit-frame-pointer"]
+    cmd += srcs
+    return _compile(key, cmd, out)

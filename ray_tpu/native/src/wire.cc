@@ -1,0 +1,255 @@
+// Native wire codec: length-prefix framing off the GIL.
+//
+// The Python control plane frames every message as a 4-byte
+// little-endian length + payload (protocol.py `_LEN`). This module
+// moves the per-byte work of that framing — recv into a growable
+// buffer, frame boundary parsing, outbound coalescing, and the
+// writev/recv syscalls themselves — into plain C++ reached over a
+// ctypes ABI (same pattern as shm_store.cc: extern "C", int64 status
+// codes, no pybind11). ctypes releases the GIL for the duration of
+// every call, so socket syscalls and memcpy no longer serialize
+// against Python bytecode on the hot path.
+//
+// Decoder: single-threaded (owned by the IO loop thread) — no lock.
+// Writer: internally locked — any Python thread may enqueue/flush
+// concurrently; writev only ever runs on non-blocking fds so holding
+// the mutex across the syscall never sleeps.
+
+#include <errno.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+// Frames above this are a protocol error (the u32 prefix caps at 4GB
+// anyway; control messages and 1MB object chunks sit far below).
+constexpr uint64_t kMaxFrame = 0xF0000000ULL;
+// Outbound frames are coalesced into blocks of roughly this size so a
+// flush sends one writev over many queued frames.
+constexpr size_t kBlock = 256 * 1024;
+constexpr int kMaxIov = 64;
+constexpr size_t kRecvChunk = 256 * 1024;
+
+constexpr int64_t kOk = 0;
+constexpr int64_t kEof = -1;       // clean peer shutdown
+constexpr int64_t kConnErr = -2;   // fatal socket error
+constexpr int64_t kProtoErr = -3;  // oversize / malformed frame
+
+struct Decoder {
+  std::vector<uint8_t> buf;
+  size_t start = 0;  // offset of first unconsumed byte
+  bool eof = false;
+  int64_t error = 0;  // sticky kConnErr / kProtoErr
+};
+
+struct Writer {
+  std::mutex mu;
+  std::deque<std::vector<uint8_t>> blocks;
+  size_t head_off = 0;  // bytes of blocks.front() already written
+  uint64_t queued = 0;
+};
+
+uint32_t read_le32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+void write_le32(uint8_t* p, uint32_t v) {
+  p[0] = (uint8_t)(v & 0xff);
+  p[1] = (uint8_t)((v >> 8) & 0xff);
+  p[2] = (uint8_t)((v >> 16) & 0xff);
+  p[3] = (uint8_t)((v >> 24) & 0xff);
+}
+
+void compact(Decoder* d) {
+  // Reclaim consumed prefix once it dominates the buffer; cheap
+  // amortized memmove instead of shifting on every frame.
+  if (d->start == d->buf.size()) {
+    d->buf.clear();
+    d->start = 0;
+  } else if (d->start > (1 << 20) && d->start > d->buf.size() / 2) {
+    d->buf.erase(d->buf.begin(), d->buf.begin() + (long)d->start);
+    d->start = 0;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wire_decoder_new() { return new Decoder(); }
+
+void wire_decoder_free(void* h) { delete static_cast<Decoder*>(h); }
+
+// Drain the (non-blocking) fd into the internal buffer. Returns bytes
+// newly buffered (>= 0; 0 means EAGAIN with nothing new), kEof once
+// the peer has shut down, kConnErr on a fatal socket error, kProtoErr
+// if a frame header announces an oversize frame. EOF/error are sticky
+// but complete frames already buffered stay retrievable via
+// wire_decoder_next.
+int64_t wire_decoder_read_fd(void* h, int fd) {
+  Decoder* d = static_cast<Decoder*>(h);
+  if (d->error) return d->error;
+  int64_t got = 0;
+  for (;;) {
+    size_t old = d->buf.size();
+    d->buf.resize(old + kRecvChunk);
+    ssize_t n = ::recv(fd, d->buf.data() + old, kRecvChunk, 0);
+    if (n > 0) {
+      d->buf.resize(old + (size_t)n);
+      got += n;
+      if ((size_t)n < kRecvChunk) break;  // drained the socket buffer
+      continue;
+    }
+    d->buf.resize(old);
+    if (n == 0) {
+      d->eof = true;
+      break;
+    }
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) break;
+    d->error = kConnErr;
+    return got > 0 ? got : kConnErr;
+  }
+  // Early oversize check so a poisoned header fails the connection
+  // before we buffer gigabytes chasing it.
+  if (d->buf.size() - d->start >= 4) {
+    uint32_t len = read_le32(d->buf.data() + d->start);
+    if ((uint64_t)len > kMaxFrame) {
+      d->error = kProtoErr;
+      return kProtoErr;
+    }
+  }
+  if (got == 0 && d->eof) return kEof;
+  return got;
+}
+
+// Test/handshake seam: inject bytes as if they had been read from the
+// socket (used to hand leftover handshake bytes to a fresh decoder).
+int64_t wire_decoder_feed(void* h, const uint8_t* data, uint64_t len) {
+  Decoder* d = static_cast<Decoder*>(h);
+  if (d->error) return d->error;
+  d->buf.insert(d->buf.end(), data, data + len);
+  return (int64_t)len;
+}
+
+// Pop the next complete frame: returns its length and points *out at
+// the payload (valid until the next decoder call — the caller copies
+// immediately). Returns kEof when no complete frame is buffered,
+// kProtoErr on an oversize header.
+int64_t wire_decoder_next(void* h, const uint8_t** out) {
+  Decoder* d = static_cast<Decoder*>(h);
+  size_t avail = d->buf.size() - d->start;
+  if (avail < 4) {
+    compact(d);
+    return kEof;
+  }
+  uint32_t len = read_le32(d->buf.data() + d->start);
+  if ((uint64_t)len > kMaxFrame) {
+    d->error = kProtoErr;
+    return kProtoErr;
+  }
+  if (avail < 4 + (uint64_t)len) {
+    compact(d);
+    return kEof;
+  }
+  *out = d->buf.data() + d->start + 4;
+  d->start += 4 + (size_t)len;
+  return (int64_t)len;
+}
+
+// Unconsumed raw bytes (partial frame tail) — used when a connection
+// is detached from the loop (CAPI handoff) so no bytes are lost.
+int64_t wire_decoder_leftover(void* h, const uint8_t** out) {
+  Decoder* d = static_cast<Decoder*>(h);
+  *out = d->buf.data() + d->start;
+  return (int64_t)(d->buf.size() - d->start);
+}
+
+int64_t wire_decoder_buffered(void* h) {
+  Decoder* d = static_cast<Decoder*>(h);
+  return (int64_t)(d->buf.size() - d->start);
+}
+
+void* wire_writer_new() { return new Writer(); }
+
+void wire_writer_free(void* h) { delete static_cast<Writer*>(h); }
+
+// Queue one frame (4-byte LE length prefix + payload) for sending.
+// Frames are coalesced into ~256KB blocks so one flush writev covers
+// many frames. Thread-safe. Returns total queued bytes after the
+// enqueue.
+int64_t wire_writer_enqueue(void* h, const uint8_t* data, uint64_t len) {
+  if (len > kMaxFrame) return kProtoErr;
+  Writer* w = static_cast<Writer*>(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  size_t need = 4 + (size_t)len;
+  bool fresh = w->blocks.empty() ||
+               w->blocks.back().size() + need > kBlock;
+  if (fresh) {
+    w->blocks.emplace_back();
+    w->blocks.back().reserve(need > kBlock ? need : kBlock);
+  }
+  std::vector<uint8_t>& blk = w->blocks.back();
+  size_t at = blk.size();
+  blk.resize(at + need);
+  write_le32(blk.data() + at, (uint32_t)len);
+  memcpy(blk.data() + at + 4, data, (size_t)len);
+  w->queued += need;
+  return (int64_t)w->queued;
+}
+
+// Flush queued blocks to the (non-blocking) fd via writev. Returns the
+// number of bytes still queued (0 = fully flushed) or kConnErr on a
+// fatal socket error. Safe to call from any thread; concurrent
+// flushers serialize on the internal mutex.
+int64_t wire_writer_flush_fd(void* h, int fd) {
+  Writer* w = static_cast<Writer*>(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  while (!w->blocks.empty()) {
+    struct iovec iov[kMaxIov];
+    int cnt = 0;
+    size_t off = w->head_off;
+    for (auto& blk : w->blocks) {
+      iov[cnt].iov_base = blk.data() + off;
+      iov[cnt].iov_len = blk.size() - off;
+      off = 0;
+      if (++cnt == kMaxIov) break;
+    }
+    ssize_t n = ::writev(fd, iov, cnt);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+        return (int64_t)w->queued;
+      return kConnErr;
+    }
+    w->queued -= (uint64_t)n;
+    size_t left = (size_t)n;
+    while (left > 0) {
+      std::vector<uint8_t>& front = w->blocks.front();
+      size_t remain = front.size() - w->head_off;
+      if (left >= remain) {
+        left -= remain;
+        w->head_off = 0;
+        w->blocks.pop_front();
+      } else {
+        w->head_off += left;
+        left = 0;
+      }
+    }
+  }
+  return (int64_t)w->queued;
+}
+
+int64_t wire_writer_queued(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  std::lock_guard<std::mutex> g(w->mu);
+  return (int64_t)w->queued;
+}
+
+}  // extern "C"

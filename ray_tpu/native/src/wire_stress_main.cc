@@ -1,0 +1,197 @@
+// Stress harness for the wire codec (wire.cc): concurrent producers
+// enqueue patterned frames into one Writer, a flusher pumps them over
+// a non-blocking socketpair, and a consumer Decoder verifies every
+// byte and per-producer sequence ordering on the far side. Built by
+// native/build.py (optionally under ASan/TSan) and run by the
+// slow-marked test in tests/test_native_stress.py — same protocol as
+// stress_test_main.cc: prints STRESS-OK on success, exit 2 on a
+// verification mismatch, exit 3 on watchdog timeout.
+//
+// Usage: wire_stress threads <workers> <iters_per_producer>
+
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* wire_decoder_new();
+void wire_decoder_free(void*);
+int64_t wire_decoder_read_fd(void*, int fd);
+int64_t wire_decoder_next(void*, const uint8_t** out);
+void* wire_writer_new();
+void wire_writer_free(void*);
+int64_t wire_writer_enqueue(void*, const uint8_t*, uint64_t);
+int64_t wire_writer_flush_fd(void*, int fd);
+int64_t wire_writer_queued(void*);
+}
+
+namespace {
+
+constexpr int kProducers = 2;
+
+uint8_t pattern_byte(uint32_t producer, uint32_t seq, uint32_t j) {
+  return (uint8_t)(seq * 131 + j * 29 + producer * 7);
+}
+
+void set_nonblocking(int fd) {
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+}
+
+// One worker: producers -> Writer -> flusher -> socketpair ->
+// Decoder -> verifier. Returns 0 on success, 2 on mismatch.
+int run_worker(int iters) {
+  int fds[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, fds) != 0) return 2;
+  set_nonblocking(fds[0]);
+  set_nonblocking(fds[1]);
+
+  void* writer = wire_writer_new();
+  std::atomic<int> producers_done{0};
+  std::atomic<bool> failed{false};
+
+  std::vector<std::thread> producers;
+  for (int p = 0; p < kProducers; p++) {
+    producers.emplace_back([&, p]() {
+      std::vector<uint8_t> frame;
+      for (int seq = 0; seq < iters; seq++) {
+        // Pseudorandom sizes spanning sub-block and multi-block frames.
+        uint32_t len = 13 + (uint32_t)((seq * 2654435761u + p * 97) %
+                                       8000);
+        frame.resize(12 + len);
+        memcpy(frame.data(), &p, 4);
+        memcpy(frame.data() + 4, &seq, 4);
+        memcpy(frame.data() + 8, &len, 4);
+        for (uint32_t j = 0; j < len; j++)
+          frame[12 + j] = pattern_byte((uint32_t)p, (uint32_t)seq, j);
+        if (wire_writer_enqueue(writer, frame.data(), frame.size()) < 0) {
+          failed = true;
+          return;
+        }
+        // Backpressure: don't let the queue grow without bound.
+        while (wire_writer_queued(writer) > (1 << 22))
+          std::this_thread::yield();
+      }
+      producers_done++;
+    });
+  }
+
+  std::thread flusher([&]() {
+    struct pollfd pfd = {fds[0], POLLOUT, 0};
+    for (;;) {
+      int64_t rc = wire_writer_flush_fd(writer, fds[0]);
+      if (rc < 0) {
+        failed = true;
+        break;
+      }
+      if (rc == 0) {
+        if (producers_done.load() == kProducers &&
+            wire_writer_queued(writer) == 0)
+          break;
+        std::this_thread::yield();
+        continue;
+      }
+      poll(&pfd, 1, 50);
+    }
+    shutdown(fds[0], SHUT_WR);
+  });
+
+  int rc = 0;
+  {
+    void* dec = wire_decoder_new();
+    std::vector<int> next_seq(kProducers, 0);
+    long long frames = 0;
+    struct pollfd pfd = {fds[1], POLLIN, 0};
+    bool done = false;
+    while (!done) {
+      int64_t st = wire_decoder_read_fd(dec, fds[1]);
+      if (st == -2 || st == -3) {
+        rc = 2;
+        break;
+      }
+      const uint8_t* ptr = nullptr;
+      int64_t n;
+      while ((n = wire_decoder_next(dec, &ptr)) >= 0) {
+        if (n < 12) {
+          rc = 2;
+          done = true;
+          break;
+        }
+        uint32_t producer, seq, len;
+        memcpy(&producer, ptr, 4);
+        memcpy(&seq, ptr + 4, 4);
+        memcpy(&len, ptr + 8, 4);
+        if (producer >= kProducers || (int64_t)len + 12 != n ||
+            (int)seq != next_seq[producer]) {
+          rc = 2;
+          done = true;
+          break;
+        }
+        next_seq[producer]++;
+        for (uint32_t j = 0; j < len; j++) {
+          if (ptr[12 + j] != pattern_byte(producer, seq, j)) {
+            rc = 2;
+            done = true;
+            break;
+          }
+        }
+        frames++;
+      }
+      if (done) break;
+      if (st == -1) done = true;  // EOF and buffer drained
+      else if (st == 0) poll(&pfd, 1, 50);
+    }
+    if (rc == 0 && frames != (long long)kProducers * iters) rc = 2;
+    wire_decoder_free(dec);
+  }
+
+  for (auto& t : producers) t.join();
+  flusher.join();
+  wire_writer_free(writer);
+  close(fds[0]);
+  close(fds[1]);
+  return failed.load() ? 2 : rc;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 4 || strcmp(argv[1], "threads") != 0) {
+    fprintf(stderr,
+            "usage: wire_stress threads <workers> <iters_per_producer>\n");
+    return 1;
+  }
+  int workers = atoi(argv[2]);
+  int iters = atoi(argv[3]);
+  if (workers <= 0 || iters <= 0) return 1;
+
+  // Watchdog: a deadlocked flush/consume pair must fail the run, not
+  // hang CI.
+  alarm(120);
+  signal(SIGALRM, [](int) { _exit(3); });
+
+  std::atomic<int> worst{0};
+  std::vector<std::thread> threads;
+  for (int i = 0; i < workers; i++) {
+    threads.emplace_back([&]() {
+      int rc = run_worker(iters);
+      int cur = worst.load();
+      while (rc > cur && !worst.compare_exchange_weak(cur, rc)) {
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+  if (worst.load() != 0) return worst.load();
+  printf("STRESS-OK workers=%d iters=%d\n", workers, iters);
+  return 0;
+}

@@ -108,8 +108,10 @@ class APPO(PPO):
                         # errors don't kill the process) — leaking it
                         # would pin its CPU forever
                         ray_tpu.kill(self.runners[idx])
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # noqa: BLE001 — already dead
+                        import logging
+                        logging.getLogger(__name__).debug(
+                            "runner kill failed", exc_info=True)
                     self.runners[idx] = self._runner_actor_cls.remote(
                         self._runner_blobs[idx])
                     self._runner_failures[idx] = 0

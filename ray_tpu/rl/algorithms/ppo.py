@@ -355,8 +355,10 @@ class PPO(Algorithm):
             for runner in self.runners:
                 try:
                     ray_tpu.kill(runner)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — runner already dead
+                    import logging
+                    logging.getLogger(__name__).debug(
+                        "runner kill failed", exc_info=True)
         group = getattr(self, "learner_group", None)
         if group is not None and hasattr(group, "shutdown"):
             group.shutdown()

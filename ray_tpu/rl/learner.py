@@ -17,6 +17,7 @@ TPU-native shape:
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -236,8 +237,9 @@ class LearnerGroup:
             for actor in self._actors:
                 try:
                     ray_tpu.kill(actor)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — actor already dead
+                    logging.getLogger(__name__).debug(
+                        "learner kill failed", exc_info=True)
             self._actors = None
 
     def update(self, batch) -> Dict[str, Any]:

@@ -63,6 +63,7 @@ def bench_deep_backlog(rt, n: int) -> dict:
     t2 = time.perf_counter()
     return {"bench": "deep_backlog", "n": n,
             "submit_per_second": _rate(n, t1 - t0),
+            "submit_us_per_task": round(1e6 * (t1 - t0) / n, 2),
             "per_second": _rate(n, t2 - t0)}
 
 
@@ -155,6 +156,83 @@ def bench_put_get_1mb(rt, n: int) -> dict:
             "per_second": _rate(n, dt), "GB_per_s": round(gbps, 2)}
 
 
+def bench_wire_submit(native: bool, n: int = 50_000,
+                      payload: bytes = b"x" * 700) -> dict:
+    """Frames/s through one LoopConnection for SUBMIT-sized frames —
+    the wire leg of remote task submission, isolated from scheduling.
+    ``native`` picks the C codec vs the pure-Python fallback."""
+    import socket
+    import threading
+
+    from ray_tpu.core.io_loop import IOLoop
+    from ray_tpu.core.protocol import FrameReader
+
+    loop = IOLoop(name="perf-io-loop")
+    a, b = socket.socketpair()
+    b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+    conn = loop.register(a, lambda c, f: None, label="perf",
+                         native=native)
+    done = threading.Event()
+
+    def drain():
+        reader, cnt = FrameReader(), 0
+        while cnt < n:
+            data = b.recv(1 << 20)
+            if not data:
+                return
+            cnt += len(reader.feed(data))
+        done.set()
+
+    threading.Thread(target=drain, daemon=True).start()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        conn.send_frame(payload)
+    assert done.wait(120), "wire drain never completed"
+    dt = time.perf_counter() - t0
+    conn.close()
+    loop.stop()
+    b.close()
+    return {"bench": "wire_submit_native" if native
+            else "wire_submit_fallback", "n": n,
+            "frame_bytes": len(payload), "seconds": round(dt, 3),
+            "per_second": _rate(n, dt),
+            "submit_us_per_frame": round(1e6 * dt / n, 2)}
+
+
+def bench_process_threads(rt) -> dict:
+    """Thread topology after a warm workload: with the selector IO
+    loop, socket service is ONE rtpu-io-loop thread regardless of
+    connection count (the old design paid a reader thread per peer)."""
+    import threading
+
+    names = sorted(t.name for t in threading.enumerate())
+    return {"bench": "process_threads", "count": len(names),
+            "io_loop_threads": names.count("rtpu-io-loop"),
+            "names": names}
+
+
+def _compare_wire(n: int) -> list:
+    """Interleaved best-of-3 A/B of the wire submit leg per codec."""
+    from ray_tpu.core import io_loop as io_loop_mod
+    from ray_tpu.native import _lib
+
+    if _lib.try_load() is None:
+        return [{"bench": "wire_compare",
+                 "skipped": "native codec unavailable"}]
+    best: dict = {}
+    for _ in range(3):
+        for mode in (False, True):
+            out = bench_wire_submit(mode, n)
+            prev = best.get(mode)
+            if prev is None or out["per_second"] > prev["per_second"]:
+                best[mode] = out
+    ratio = best[True]["per_second"] / best[False]["per_second"]
+    return [best[False], best[True],
+            {"bench": "wire_compare",
+             "native_over_fallback": round(ratio, 3),
+             "native_default": io_loop_mod.use_native_wire()}]
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--tasks", type=int, default=20000)
@@ -162,6 +240,12 @@ def main(argv=None) -> None:
     parser.add_argument("--sync-tasks", type=int, default=300)
     parser.add_argument("--actor-calls", type=int, default=2000)
     parser.add_argument("--puts", type=int, default=1000)
+    parser.add_argument("--wire-frames", type=int, default=50000)
+    parser.add_argument("--json", metavar="PATH", default=None,
+                        help="also write the full result list to PATH")
+    parser.add_argument("--compare-wire", action="store_true",
+                        help="A/B the native C wire codec against the "
+                             "pure-Python fallback (submit leg)")
     args = parser.parse_args(argv)
 
     import ray_tpu
@@ -180,8 +264,18 @@ def main(argv=None) -> None:
         out = fn(rt, n)
         results.append(out)
         print(json.dumps(out), flush=True)
-    summary = {r["bench"]: r["per_second"] for r in results}
+    results.append(bench_process_threads(rt))
+    print(json.dumps(results[-1]), flush=True)
+    if args.compare_wire:
+        for out in _compare_wire(args.wire_frames):
+            results.append(out)
+            print(json.dumps(out), flush=True)
+    summary = {r["bench"]: r["per_second"] for r in results
+               if "per_second" in r}
     print(json.dumps({"bench": "summary", **summary}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(results, f, indent=1)
     ray_tpu.shutdown()
 
 

@@ -10,6 +10,7 @@ concurrently and the controller multiplexes with `wait()`.
 
 from __future__ import annotations
 
+import logging
 import os
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Union
@@ -21,6 +22,8 @@ from ray_tpu.tune import experiment as exp_mod
 from ray_tpu.tune.experiment import ExperimentState, Trial
 from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
 from ray_tpu.tune.search import Searcher
+
+logger = logging.getLogger(__name__)
 
 
 class _TrialRunner:
@@ -134,8 +137,8 @@ class TuneController:
             try:
                 actor.stop.remote()
                 ray_tpu.kill(actor)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — trial actor already dead
+                logger.debug("trial teardown kill failed", exc_info=True)
         self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
         self.scheduler.on_trial_complete(self, trial, trial.last_result)
 
@@ -172,8 +175,8 @@ class TuneController:
             # Replace the actor (trainable can't reconfigure in place).
             try:
                 ray_tpu.kill(actor)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — trial actor already dead
+                logger.debug("exploit kill failed", exc_info=True)
             actor = self._make_actor(new_config)
             self._actors[trial.trial_id] = actor
         if donor_path:
@@ -220,8 +223,9 @@ class TuneController:
             if actor is not None:
                 try:
                     ray_tpu.kill(actor)
-                except Exception:
-                    pass
+                except Exception:  # noqa: BLE001 — actor already dead
+                    logger.debug("failed-trial kill failed",
+                                 exc_info=True)
             if trial.num_failures <= self.max_failures:
                 trial.status = exp_mod.PENDING  # restart from checkpoint
                 return
@@ -245,8 +249,8 @@ class TuneController:
             if actor is not None:
                 try:
                     ray_tpu.kill(actor)
-                except Exception:
-                    pass
+                except Exception:  # noqa: BLE001 — actor already dead
+                    logger.debug("pause kill failed", exc_info=True)
             trial.status = exp_mod.PAUSED
         else:
             self._submit_train(trial)

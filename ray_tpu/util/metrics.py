@@ -107,6 +107,17 @@ def _record(kind: str, name: str, tags: Dict[str, str], value: float,
     _registry.apply(kind, name, tag_items, value, boundaries)
 
 
+def record_local(kind: str, name: str, tags: Dict[str, str], value: float,
+                 boundaries=None) -> None:
+    """Apply one update to THIS process's registry, never the
+    worker->driver forwarding channel. For code running on an IO/event
+    thread (the core IO loop): forwarding is a synchronous
+    control-plane request whose reply only that same thread could
+    dispatch — a self-deadlock."""
+    _registry.apply(kind, name, tuple(sorted((tags or {}).items())),
+                    value, boundaries)
+
+
 def record_batch(items) -> None:
     """Apply a batch of metric updates in one shot. ``items``: iterable
     of ``(kind, name, tags_dict, value, boundaries)``. On a worker the

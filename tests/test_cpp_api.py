@@ -177,8 +177,11 @@ def test_cpp_worker_death_fails_inflight(tmp_path):
         _wait_worker_registered(rt)
         counter = capi.cpp_actor("Counter")
         assert ray_tpu.get(counter.call("incr", b"1"), timeout=30) == b"1"
-        # kill mid-flight: a pending call must fail, not hang
-        ref = counter.call("incr", b"1")
+        # kill mid-flight: a pending call must fail, not hang. "slow"
+        # parks the worker, so the call is deterministically still
+        # pending when the kill lands (an instant method could win the
+        # race and legitimately reply first).
+        ref = counter.call("slow", b"")
         worker.kill()
         worker.wait(timeout=10)
         time.sleep(0.5)  # let the head observe the EOF

@@ -61,3 +61,30 @@ def test_stress_tsan():
     # TSan only sees intra-process races: thread mode is the one that
     # matters (the store's mutex discipline is identical cross-process)
     _run(build_stress("thread"), "threads", workers=6, iters=120)
+
+
+# --- wire codec (wire.cc) stress: concurrent producers + flusher +
+#     decoder per worker over a non-blocking socketpair; every byte of
+#     every frame verified (wire_stress_main.cc) ----------------------
+
+def test_wire_stress_plain():
+    _run(build_stress(main_src="wire_stress_main.cc"),
+         "threads", workers=4, iters=2000)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _sanitizer_available("address"),
+                    reason="ASan unavailable")
+def test_wire_stress_asan():
+    _run(build_stress("address", main_src="wire_stress_main.cc"),
+         "threads", workers=4, iters=1200)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _sanitizer_available("thread"),
+                    reason="TSan unavailable")
+def test_wire_stress_tsan():
+    # the Writer's mutex discipline (any-thread enqueue vs loop flush)
+    # is exactly what TSan checks here
+    _run(build_stress("thread", main_src="wire_stress_main.cc"),
+         "threads", workers=4, iters=1200)

@@ -14,7 +14,11 @@ above these thresholds (PERF.md records the honest numbers;
 the thresholds catch a ~2x per-task regression.
 """
 
+import socket
+import threading
 import time
+
+import pytest
 
 import ray_tpu
 
@@ -115,3 +119,60 @@ def test_throughput_guard_has_teeth(ray_start_regular):
     assert submit < CALIB_SUBMIT_RATIO * calib, (
         f"guard is toothless: sabotaged submit {submit:.0f}/s still "
         f"clears {CALIB_SUBMIT_RATIO} x calibration ({calib:.0f})")
+
+
+def _wire_submit_rate(native: bool, n: int = 30_000,
+                      payload: bytes = b"x" * 700) -> float:
+    """Frames/s through a LoopConnection for SUBMIT-sized frames — the
+    wire leg of remote task submission (producer thread enqueues, the
+    loop flushes, a raw peer drains). Measures submit start to last
+    frame received."""
+    from ray_tpu.core.io_loop import IOLoop
+    from ray_tpu.core.protocol import FrameReader
+
+    loop = IOLoop(name="bench-io-loop")
+    a, b = socket.socketpair()
+    b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1 << 20)
+    conn = loop.register(a, lambda c, f: None, label="bench",
+                         native=native)
+    done = threading.Event()
+
+    def drain():
+        reader, cnt = FrameReader(), 0
+        while cnt < n:
+            data = b.recv(1 << 20)
+            if not data:
+                return
+            cnt += len(reader.feed(data))
+        done.set()
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        conn.send_frame(payload)
+    assert done.wait(60), "drain never completed"
+    dt = time.perf_counter() - t0
+    conn.close()
+    loop.stop()
+    b.close()
+    return n / dt
+
+
+def test_native_wire_not_slower_than_fallback():
+    """Same-run A/B of the wire submit leg: the native C codec must be
+    at least as fast as the pure-Python fallback (best-of-3 each,
+    interleaved so box-load drift hits both modes equally). Skips where
+    the C toolchain is unavailable (the fallback is then the only
+    codec, and there is nothing to compare)."""
+    from ray_tpu.native import _lib
+
+    if _lib.try_load() is None:
+        pytest.skip("native wire codec unavailable (no C toolchain)")
+    best = {True: 0.0, False: 0.0}
+    for _ in range(3):
+        for mode in (False, True):
+            best[mode] = max(best[mode], _wire_submit_rate(mode))
+    assert best[True] >= best[False], (
+        f"native wire slower than fallback on the submit leg: "
+        f"native {best[True]:.0f}/s vs fallback {best[False]:.0f}/s")
