@@ -27,7 +27,7 @@ from __future__ import annotations
 import logging
 import threading
 
-from ray_tpu.devtools import locktrace
+from ray_tpu.devtools import locktrace, threadguard
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
@@ -276,6 +276,7 @@ class ClientRuntime:
             return True
         return False
 
+    @threadguard.loop_only(loop_attr="conn._loop")
     def _on_msg(self, conn, msg: dict) -> None:
         """IO-loop handler for every head->client message (pubsub
         fanout + request/reply correlation)."""
@@ -297,6 +298,7 @@ class ClientRuntime:
             slot[0] = msg
             event.set()
 
+    @threadguard.loop_only(loop_attr="conn._loop")
     def _on_conn_closed(self, conn) -> None:
         """IO-loop teardown hook: fires exactly once per connection
         (EOF, error, or explicit close). Recovery — which dials the

@@ -46,9 +46,9 @@ import heapq
 
 from ray_tpu.core import protocol, serialization
 from ray_tpu.core.config import get_config
-from ray_tpu.devtools import locktrace
+from ray_tpu.devtools import locktrace, threadguard
 from ray_tpu.native import _lib
-from ray_tpu.util.metrics import Gauge, Histogram, record_local
+from ray_tpu.util.metrics import Gauge, Histogram
 
 logger = logging.getLogger(__name__)
 
@@ -246,6 +246,7 @@ class _PyCodec:
         return self._reader.leftover()
 
 
+@threadguard.loop_owned("_streams", "_mask", "_registered")
 class LoopConnection:
     """A framed connection serviced by the shared IO loop. Drop-in for
     protocol.MessageConnection on the send side (``send``/``close``/
@@ -391,6 +392,8 @@ class LoopListener:
             self._closed_evt.wait(2.0)
 
 
+@threadguard.loop_owned("_conns", "_listeners", "_peak_queued",
+                         "_dispatch_n", "_last_housekeep")
 class IOLoop:
     """The per-process selector loop. Use ``get_io_loop()`` for the
     shared singleton; tests may build private instances and stop()
@@ -418,6 +421,11 @@ class IOLoop:
                                 ("waker", None))
         self._thread = threading.Thread(target=self._run, name=name,
                                         daemon=True)
+        # Opt-in runtime enforcement (RAY_TPU_THREADGUARD=1): a stall
+        # watchdog samples this thread's stack when one dispatch pass
+        # exceeds RAY_TPU_THREADGUARD_STALL_S.
+        self._guard = (threadguard.LoopStallWatchdog(self._thread)
+                       if threadguard.enabled() else None)
         self._thread.start()
 
     # ------------------------------------------------------------- API
@@ -490,6 +498,7 @@ class IOLoop:
         except OSError:
             pass
 
+    @threadguard.loop_only
     def detach(self, conn: LoopConnection) -> socket.socket:
         """Loop-thread only: unregister without closing the socket
         (protocol handoff, e.g. CAPI sessions). The caller owns the
@@ -534,6 +543,7 @@ class IOLoop:
         else:
             self.call_soon(fn, *args)
 
+    @threadguard.loop_only
     def _do_register(self, conn: LoopConnection) -> None:
         if conn._torn or conn._closing:
             self._teardown_conn(conn)
@@ -550,6 +560,7 @@ class IOLoop:
         if conn._codec.queued() or conn._streams:
             self._flush_conn(conn)
 
+    @threadguard.loop_only
     def _do_register_listener(self, lst: LoopListener) -> None:
         if lst._torn:
             return
@@ -562,7 +573,11 @@ class IOLoop:
         self._listeners.add(lst)
         self._update_fd_gauge()
 
+    @threadguard.loop_only
     def _run(self) -> None:
+        guard = self._guard
+        if guard:
+            guard.enter()
         while not self._stopped.is_set():
             self._run_callbacks()
             timeout = 0.5
@@ -572,10 +587,15 @@ class IOLoop:
                               max(0.0, deadline - time.monotonic()))
             if self._callbacks:
                 timeout = 0.0
+            if guard:
+                guard.exit_busy()
             try:
                 events = self._selector.select(timeout)
             except OSError:
                 continue
+            finally:
+                if guard:
+                    guard.enter()
             for key, mask in events:
                 kind, obj = key.data
                 try:
@@ -595,6 +615,8 @@ class IOLoop:
                     logger.exception("io_loop: timer error")
             self._housekeep(now)
         self._finalize()
+        if guard:
+            guard.stop()
 
     def _run_callbacks(self) -> None:
         # Bounded drain: callbacks scheduled while running wait for
@@ -611,17 +633,20 @@ class IOLoop:
 
     def _drain_waker(self) -> None:
         try:
-            while self._waker_r.recv(4096):
+            # non-blocking socketpair: recv returns EAGAIN, never waits
+            while self._waker_r.recv(4096):  # graftlint: disable=GL009
                 pass
         except (BlockingIOError, InterruptedError):
             pass
         except OSError:
             pass
 
+    @threadguard.loop_only
     def _service_accept(self, lst: LoopListener) -> None:
         while True:
             try:
-                sock, addr = lst.sock.accept()
+                # listener is non-blocking: accept never waits
+                sock, addr = lst.sock.accept()  # graftlint: disable=GL009
             except (BlockingIOError, InterruptedError):
                 return
             except OSError:
@@ -637,6 +662,7 @@ class IOLoop:
                 except OSError:
                     pass
 
+    @threadguard.loop_only
     def _service_conn(self, conn: LoopConnection, mask: int) -> None:
         if conn._torn:
             return
@@ -651,6 +677,7 @@ class IOLoop:
             if status < 0:
                 self._teardown_conn(conn)
 
+    @threadguard.loop_only
     def _dispatch(self, conn: LoopConnection, frames) -> None:
         self._dispatch_n += 1
         timed = self._report_metrics and (self._dispatch_n & 63) == 0
@@ -661,12 +688,11 @@ class IOLoop:
             logger.exception("io_loop: frame handler error (%s)",
                              conn.label)
         if timed:
-            # record_local: a forwarding _record from the loop thread
+            # observe_local: a forwarding _record from the loop thread
             # would block on a reply only this thread can dispatch.
-            record_local("histogram", DISPATCH_SECONDS._name, {},
-                         time.perf_counter() - t0,
-                         DISPATCH_SECONDS._boundaries)
+            DISPATCH_SECONDS.observe_local(time.perf_counter() - t0)
 
+    @threadguard.loop_only
     def _flush_conn(self, conn: LoopConnection) -> None:
         if conn._torn:
             return
@@ -707,6 +733,7 @@ class IOLoop:
         self._set_write_interest(conn,
                                  remaining > 0 or bool(conn._streams))
 
+    @threadguard.loop_only
     def _set_write_interest(self, conn: LoopConnection,
                             want: bool) -> None:
         if not conn._registered or conn._torn:
@@ -721,6 +748,7 @@ class IOLoop:
         except (KeyError, ValueError, OSError):
             pass
 
+    @threadguard.loop_only
     def _teardown_conn(self, conn: LoopConnection) -> None:
         if conn._torn:
             return
@@ -759,6 +787,7 @@ class IOLoop:
                 logger.exception("io_loop: on_close error (%s)",
                                  conn.label)
 
+    @threadguard.loop_only
     def _teardown_listener(self, lst: LoopListener) -> None:
         if lst._torn:
             lst._closed_evt.set()
@@ -787,8 +816,8 @@ class IOLoop:
 
     def _update_fd_gauge(self) -> None:
         if self._report_metrics:
-            record_local("gauge", REGISTERED_FDS._name, {},
-                         float(len(self._conns) + len(self._listeners)))
+            REGISTERED_FDS.set_local(
+                float(len(self._conns) + len(self._listeners)))
 
     def _housekeep(self, now: float) -> None:
         if now - self._last_housekeep < 1.0:
@@ -799,12 +828,11 @@ class IOLoop:
         total = 0
         for conn in self._conns:
             total += conn._codec.queued()
-        record_local("gauge", QUEUE_DEPTH._name, {},
-                     float(max(total, self._peak_queued)))
+        QUEUE_DEPTH.set_local(float(max(total, self._peak_queued)))
         self._peak_queued = 0
-        record_local("gauge", PROCESS_THREADS._name, {},
-                     float(threading.active_count()))
+        PROCESS_THREADS.set_local(float(threading.active_count()))
 
+    @threadguard.loop_only
     def _finalize(self) -> None:
         for conn in list(self._conns):
             self._teardown_conn(conn)

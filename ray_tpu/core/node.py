@@ -29,6 +29,7 @@ from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
 from ray_tpu.core.protocol import MessageConnection
 from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.devtools import threadguard
 
 # Worker states
 STARTING = "STARTING"
@@ -251,8 +252,14 @@ class Node:
                 # requesting task (same path as pip failures) instead
                 # of stranding the spec in the dispatch queue.
                 env["RTPU_PIP_ERROR"] = repr(exc)
+        # Deliberate GL009 exception: worker spawn is reachable from
+        # loop-thread dispatch paths (_pump / _on_worker_death), but
+        # deferring it would break the synchronous _n_starting
+        # accounting that gates spawn decisions (two queued REGISTERs
+        # would both spawn). Popen is one bounded fork+exec; the
+        # threadguard stall watchdog flags it if it ever degrades.
         with open(log_path, "ab") as log_file:
-            proc = subprocess.Popen(
+            proc = subprocess.Popen(  # graftlint: disable=GL009
                 cmd,
                 env=env,
                 stdout=log_file,
@@ -295,6 +302,7 @@ class Node:
             return f"{base}|re:{spec.runtime_env_hash}"
         return base
 
+    @threadguard.loop_only
     def _on_worker_accept(self, sock, _addr) -> None:
         """Runs on the IO loop thread for each worker that dials the
         node's unix socket. ``holder`` threads the WorkerHandle from

@@ -27,6 +27,7 @@ from typing import Callable, Optional, Tuple
 
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.devtools import threadguard
 from ray_tpu.core.protocol import (
     connect_tcp,
     listen_tcp,
@@ -197,6 +198,7 @@ def get_pull_manager() -> PullManager:
         return _pull_manager
 
 
+@threadguard.loop_owned("pending", "busy")
 class _PullConn:
     """One puller connection, driven by the shared IO loop (replaces
     the thread-per-puller reader). Requests on a connection are
@@ -215,6 +217,7 @@ class _PullConn:
             sock, self._on_msg, self._on_close, label="object-server")
         server._conns.add(self.conn)
 
+    @threadguard.loop_only(loop_attr="server._io")
     def _on_msg(self, conn, msg: dict) -> None:
         if msg.get("kind") != "PULL":
             conn.close()
@@ -229,6 +232,7 @@ class _PullConn:
         self.pending.clear()
 
 
+@threadguard.loop_owned("_active", "_ready", "_conns")
 class ObjectServer:
     """Serves chunked object reads from local shared-memory stores.
 
@@ -264,10 +268,12 @@ class ObjectServer:
 
     # --- admission (reference: pull_manager.h:50) ---------------------
 
+    @threadguard.loop_only
     def _admit(self, pc: _PullConn) -> None:
         self._ready.append(pc)
         self._pump()
 
+    @threadguard.loop_only
     def _pump(self) -> None:
         while self._ready and self._active < self._max:
             pc = self._ready.popleft()
@@ -284,6 +290,7 @@ class ObjectServer:
                 else:
                     pc.busy = False
 
+    @threadguard.loop_only
     def _finished(self, pc: _PullConn) -> None:
         """A stream (or deferred attempt) released its slot."""
         self._active -= 1
@@ -342,6 +349,7 @@ class ObjectServer:
             return False
         return True
 
+    @threadguard.loop_only
     def _store_step(self, pc: _PullConn, source, oid: ObjectID,
                     deadline: float) -> None:
         """One slot-holding attempt to stream ``oid`` out of ``source``.

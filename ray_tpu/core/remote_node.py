@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import threading
 
-from ray_tpu.devtools import locktrace
+from ray_tpu.devtools import locktrace, threadguard
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -301,6 +301,7 @@ class _HeadConn:
         if server._stopped.is_set():
             self.conn.close()
 
+    @threadguard.loop_only(loop_attr="server._io")
     def _on_frames(self, conn, frames) -> None:
         for idx, frame in enumerate(frames):
             if self.state == "steady":

@@ -1,7 +1,11 @@
-"""Developer tooling: framework-aware static analysis (graftlint) and
-runtime concurrency diagnostics (locktrace).
+"""Developer tooling: framework-aware static analysis (graftlint,
+including the interprocedural GL009-GL012 loop-safety rules), runtime
+concurrency diagnostics (locktrace lock-order tracing, threadguard
+loop-affinity assertions + stall watchdog), and the one-shot
+``python -m ray_tpu.devtools.check`` gate.
 
 Nothing in this package imports jax or the runtime — it must stay cheap
 to import from CI guards and from production modules that only want a
-lock factory (``locktrace.traced_lock``).
+lock factory (``locktrace.traced_lock``) or an affinity decorator
+(``threadguard.loop_only``).
 """

@@ -1,0 +1,232 @@
+"""One-shot local quality gate: ``python -m ray_tpu.devtools.check``.
+
+Runs, in order, everything a reviewer would otherwise run by hand:
+
+1. **lint** — graftlint over ``ray_tpu/`` against the checked-in
+   baseline (``graftlint_baseline.json``).
+2. **locktrace** — a tiny end-to-end smoke run (init, tasks, put/get,
+   shutdown) in a subprocess with ``RAY_TPU_LOCKTRACE=1``; fails on
+   any detected lock-order cycle.
+3. **threadguard** — the same smoke run with ``RAY_TPU_THREADGUARD=1``
+   and an aggressive stall threshold; fails on any ``@loop_only``
+   affinity violation (raises in-run) or watchdog stall report.
+4. **stress** — the native shm stress binary, plain plus ASan/TSan
+   variants when the toolchain on this image can link them; each
+   missing sanitizer is a clean SKIP, not a failure.
+
+Every step prints ``ok`` / ``SKIP (reason)`` / ``FAIL`` and the
+command exits non-zero iff any step failed. ``--only STEP`` runs a
+single step (e.g. ``--only lint`` for the fast pre-commit path).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Callable, List, Optional, Tuple
+
+# The smoke driver exercised under locktrace/threadguard. Kept as a
+# string so it runs in a pristine subprocess: the instrumented env
+# vars must be set before ray_tpu (and its locks/loops) are imported.
+_SMOKE_SRC = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ray_tpu
+
+ray_tpu.init(num_cpus=2,
+             system_config={"task_max_retries": 0})
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+refs = [add.remote(i, i) for i in range(20)]
+assert ray_tpu.get(refs) == [2 * i for i in range(20)]
+blob = ray_tpu.put(b"x" * 100_000)
+assert len(ray_tpu.get(blob)) == 100_000
+ray_tpu.shutdown()
+
+mode = sys.argv[1]
+if mode == "locktrace":
+    from ray_tpu.devtools import locktrace
+    rep = locktrace.report()
+    if rep.get("cycles"):
+        print("CYCLES:", rep["cycles"])
+        sys.exit(3)
+elif mode == "threadguard":
+    from ray_tpu.devtools import threadguard
+    reports = threadguard.stall_reports()
+    if reports:
+        for r in reports:
+            print("STALL %.3fs on %s\n%s" %
+                  (r["stalled_s"], r["thread"], r["stack"]))
+        sys.exit(3)
+print("SMOKE-OK")
+"""
+
+
+def _run_smoke(mode: str, extra_env: dict) -> Tuple[bool, str]:
+    env = dict(os.environ)
+    env.update(extra_env)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # the smoke script lives in /tmp — make sure the repo providing
+    # this module stays importable from there
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix="_rtpu_smoke.py", delete=False) as f:
+        f.write(_SMOKE_SRC)
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, mode], env=env,
+            capture_output=True, text=True, timeout=180)
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    out = (proc.stdout or "") + (proc.stderr or "")
+    ok = proc.returncode == 0 and "SMOKE-OK" in proc.stdout
+    return ok, out
+
+
+# --- steps ---------------------------------------------------------------
+
+def step_lint() -> Tuple[str, str]:
+    """graftlint over ray_tpu/ against the default baseline."""
+    from ray_tpu.devtools import lint
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(lint.__file__))))
+    findings = lint.lint_paths([os.path.join(root, "ray_tpu")])
+    baseline_path = lint.find_default_baseline(
+        [os.path.join(root, "ray_tpu")])
+    if baseline_path:
+        baseline = lint.load_baseline(baseline_path)
+        findings = lint.apply_baseline(findings, baseline)
+    if findings:
+        lines = [f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}"
+                 for f in findings]
+        return "FAIL", "\n".join(lines)
+    return "ok", ""
+
+
+def step_locktrace() -> Tuple[str, str]:
+    """End-to-end smoke under RAY_TPU_LOCKTRACE=1; no lock cycles."""
+    ok, out = _run_smoke("locktrace", {"RAY_TPU_LOCKTRACE": "1"})
+    return ("ok", "") if ok else ("FAIL", out[-4000:])
+
+
+def step_threadguard() -> Tuple[str, str]:
+    """Smoke under RAY_TPU_THREADGUARD=1; no affinity errors/stalls."""
+    ok, out = _run_smoke("threadguard", {
+        "RAY_TPU_THREADGUARD": "1",
+        "RAY_TPU_THREADGUARD_STALL_S": "0.5",
+    })
+    return ("ok", "") if ok else ("FAIL", out[-4000:])
+
+
+def _gxx_probe(extra_flags: List[str]) -> bool:
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "probe.cc")
+        with open(src, "w") as f:
+            f.write("int main(){return 0;}\n")
+        try:
+            proc = subprocess.run(
+                ["g++", *extra_flags, "-o", os.path.join(d, "probe"),
+                 src], capture_output=True)
+        except OSError:
+            return False
+        return proc.returncode == 0
+
+
+def _sanitizer_available(kind: str) -> bool:
+    return _gxx_probe([f"-fsanitize={kind}"])
+
+
+def _run_stress(sanitize: Optional[str], mode: str, workers: int,
+                iters: int) -> Tuple[bool, str]:
+    from ray_tpu.native.build import build_stress
+    try:
+        binary = build_stress(sanitize) if sanitize else build_stress()
+    except Exception as exc:  # toolchain missing → caller SKIPs
+        return False, f"build failed: {exc}"
+    proc = subprocess.run(
+        [binary, mode, str(workers), str(iters)],
+        capture_output=True, text=True, timeout=300)
+    ok = proc.returncode == 0 and "STRESS-OK" in proc.stdout
+    detail = "" if ok else (
+        f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr[-3000:]}")
+    return ok, detail
+
+
+def step_stress() -> Tuple[str, str]:
+    """Native shm stress: plain always; ASan/TSan when linkable."""
+    try:
+        from ray_tpu.native.build import build_stress  # noqa: F401
+    except Exception as exc:
+        return "SKIP", f"native build unavailable: {exc}"
+    if not _gxx_probe([]):
+        return "SKIP", "no working g++ on this image"
+    ok, detail = _run_stress(None, "threads", workers=6, iters=150)
+    if not ok:
+        return "FAIL", detail
+    notes = []
+    for kind in ("address", "thread"):
+        if not _sanitizer_available(kind):
+            notes.append(f"{kind}: SKIP (sanitizer unavailable)")
+            continue
+        ok, detail = _run_stress(kind, "threads", workers=4, iters=80)
+        if not ok:
+            return "FAIL", f"[{kind}] {detail}"
+        notes.append(f"{kind}: ok")
+    return "ok", "; ".join(notes)
+
+
+_STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
+    ("lint", step_lint),
+    ("locktrace", step_locktrace),
+    ("threadguard", step_threadguard),
+    ("stress", step_stress),
+]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.check",
+        description="one-shot lint + runtime-instrumentation + "
+                    "sanitizer gate")
+    parser.add_argument("--only", choices=[n for n, _ in _STEPS],
+                        help="run a single step")
+    args = parser.parse_args(argv)
+
+    failed = False
+    for name, fn in _STEPS:
+        if args.only and name != args.only:
+            continue
+        t0 = time.monotonic()
+        try:
+            status, detail = fn()
+        except Exception as exc:
+            status, detail = "FAIL", f"step crashed: {exc!r}"
+        dt = time.monotonic() - t0
+        line = f"check: {name:<12} {status}  ({dt:.1f}s)"
+        if status == "SKIP" and detail:
+            line += f"  [{detail}]"
+        print(line)
+        if detail and status == "FAIL":
+            print(detail)
+            failed = True
+        elif status == "ok" and detail:
+            print(f"       {detail}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,61 @@
+"""graftlint: an AST rule engine for ray_tpu's thread-based control
+plane.
+
+The control plane guards its shared state with ~70 ``threading.Lock``
+sites; at production scale the bottleneck is silent races and
+deadlocks, not throughput (Podracer, arXiv:2104.06272; MPMD pipeline
+schedulers, arXiv:2412.14374). Generic linters can't see framework
+conventions — which classes own locks, what a TaskSpec must carry,
+what a metric must be named, which functions run on the single
+rtpu-io-loop thread — so this engine ships framework-specific rules
+and grows with the codebase.
+
+Usage::
+
+    python -m ray_tpu.devtools.lint [paths...]
+    python -m ray_tpu.devtools.lint ray_tpu/ --write-baseline
+    python -m ray_tpu.devtools.lint ray_tpu/ --format=github
+
+Findings are suppressed three ways:
+
+* per-line: a ``# graftlint: disable=GL004`` comment on the reported
+  line (comma-separate several ids; ``disable=all`` kills every rule);
+* baseline: a checked-in ``graftlint_baseline.json`` grandfathers
+  existing findings by (file, rule, enclosing scope) — line drift
+  does not invalidate it; NEW findings in a scope still fail;
+* ``--select``/``--ignore`` on the command line.
+
+Rules are plain classes in a registry; add one by subclassing
+``Rule`` and decorating with ``@register``. Per-file rules implement
+``check(ctx)``; interprocedural rules set ``project = True`` and
+implement ``check_project(project)`` against the call-graph
+``ProjectContext`` (see ``callgraph.py``).
+
+Package layout (was a single module through PR 8):
+
+* ``base.py``      — Finding, Rule, registry
+* ``annotate.py``  — FileContext: one parse + annotation pass
+* ``callgraph.py`` — interprocedural loop-context propagation
+* ``baseline.py``  — grandfathered-finding persistence
+* ``rules/``       — one module per rule family
+* ``engine.py``    — file walking, rule driving, CLI
+"""
+
+from ray_tpu.devtools.lint.annotate import (FileContext, _dotted,  # noqa: F401
+                                            _is_self_attr)
+from ray_tpu.devtools.lint.base import (BASELINE_DEFAULT, Finding,  # noqa: F401
+                                        RULES, Rule, register)
+from ray_tpu.devtools.lint.baseline import (apply_baseline,  # noqa: F401
+                                            find_default_baseline,
+                                            load_baseline,
+                                            write_baseline)
+from ray_tpu.devtools.lint.callgraph import ProjectContext  # noqa: F401
+from ray_tpu.devtools.lint.engine import (lint_file,  # noqa: F401
+                                          lint_paths, main)
+
+__all__ = [
+    "BASELINE_DEFAULT", "FileContext", "Finding", "ProjectContext",
+    "RULES", "Rule", "apply_baseline", "find_default_baseline",
+    "lint_file", "lint_paths", "load_baseline", "main", "register",
+    "write_baseline",
+]

@@ -1,0 +1,6 @@
+import sys
+
+from ray_tpu.devtools.lint.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Per-file parse + annotation pass shared by every rule.
+
+One ``FileContext`` per source file: parses once, records suppression
+comments, and attaches to every AST node its enclosing scope qualname,
+function, class, and lock depth — so individual rules stay small.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional
+
+from ray_tpu.devtools.lint.base import Finding
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_EVENT_FACTORIES = {"Condition", "Event"}
+_LOCKISH_NAME = re.compile(r"(?:^|_)(?:lock|mutex|cv|cond)(?:$|_)|lock$")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:#|$)")
+
+# container methods that mutate in place (shared by GL001/GL011)
+_MUTATORS = {
+    "append", "appendleft", "add", "insert", "extend", "update",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "setdefault", "__setitem__",
+}
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class FileContext:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = self._parse_suppressions()
+        self._annotate()
+
+    # -- suppression comments -----------------------------------------
+    def _parse_suppressions(self) -> Dict[int, set]:
+        out: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {s.strip().upper() for s in m.group(1).split(",")
+                       if s.strip()}
+                out[i] = ids
+        return out
+
+    def suppressed(self, finding: Finding) -> bool:
+        ids = self.suppressions.get(finding.line)
+        return bool(ids) and (finding.rule in ids or "ALL" in ids)
+
+    # -- annotation pass ----------------------------------------------
+    def _annotate(self) -> None:
+        """Attach to every node: ``_gl_scope`` (Class.method qualname),
+        ``_gl_func`` (innermost function name or None), ``_gl_class``
+        (innermost ClassDef node or None), ``_gl_lockdepth`` (number of
+        enclosing ``with <lock>`` blocks). ClassDef nodes additionally
+        get ``_gl_locks`` / ``_gl_events`` (self-attribute names bound
+        to Lock/RLock/Condition and Condition/Event factories)."""
+        for cls in (n for n in ast.walk(self.tree)
+                    if isinstance(n, ast.ClassDef)):
+            locks, events = set(), set()
+            for sub in ast.walk(cls):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                call = sub.value
+                if not isinstance(call, ast.Call):
+                    continue
+                factory = _dotted(call.func) or ""
+                leaf = factory.rsplit(".", 1)[-1]
+                for target in sub.targets:
+                    attr = _is_self_attr(target)
+                    if attr is None:
+                        continue
+                    if leaf in _LOCK_FACTORIES or \
+                            leaf in ("traced_lock", "traced_rlock"):
+                        locks.add(attr)
+                    if leaf in _EVENT_FACTORIES:
+                        events.add(attr)
+            cls._gl_locks = locks
+            cls._gl_events = events
+
+        def visit(node, scope, func, cls, lockdepth):
+            node._gl_scope = scope
+            node._gl_func = func
+            node._gl_class = cls
+            node._gl_lockdepth = lockdepth
+            if isinstance(node, ast.ClassDef):
+                scope = node.name if scope == "<module>" \
+                    else f"{scope}.{node.name}"
+                cls = node
+                func = None
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = node.name if scope == "<module>" \
+                    else f"{scope}.{node.name}"
+                func = node.name
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                if any(self.is_lock_expr(item.context_expr, cls)
+                       for item in node.items):
+                    lockdepth += 1
+            for child in ast.iter_child_nodes(node):
+                visit(child, scope, func, cls, lockdepth)
+
+        visit(self.tree, "<module>", None, None, 0)
+
+    def is_lock_expr(self, expr: ast.AST, cls) -> bool:
+        """Heuristic: does ``with <expr>:`` acquire a lock? True for
+        self-attributes the class binds to a Lock factory, and for any
+        name/attribute that *looks* like a lock (``_lock``, ``cv``,
+        ``mutex``...)."""
+        attr = _is_self_attr(expr)
+        if attr is not None:
+            if cls is not None and attr in getattr(cls, "_gl_locks", ()):
+                return True
+            return bool(_LOCKISH_NAME.search(attr))
+        if isinstance(expr, ast.Name):
+            return bool(_LOCKISH_NAME.search(expr.id))
+        if isinstance(expr, ast.Attribute):
+            return bool(_LOCKISH_NAME.search(expr.attr))
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=rule, path=self.path,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       message=message,
+                       scope=getattr(node, "_gl_scope", "<module>"))

@@ -1,0 +1,60 @@
+"""Finding/Rule primitives and the rule registry.
+
+Split out of the original single-module graftlint so rule modules can
+import the registry without pulling in the engine (CLI, file walking)
+and vice versa.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator
+
+BASELINE_DEFAULT = "graftlint_baseline.json"
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str   # posix-style, relative to the scan root when possible
+    line: int
+    col: int
+    message: str
+    scope: str  # enclosing "Class.method" qualname ("<module>" at top)
+
+    @property
+    def key(self) -> str:
+        """Baseline fingerprint: stable across line-number drift."""
+        return f"{self.path}::{self.rule}::{self.scope}"
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.message}")
+
+
+RULES: "Dict[str, Rule]" = {}
+
+
+def register(cls):
+    inst = cls()
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id}")
+    RULES[inst.id] = inst
+    return cls
+
+
+class Rule:
+    id: str = ""
+    name: str = ""
+    rationale: str = ""
+    #: project rules run once over the whole scanned set (with a call
+    #: graph) instead of once per file; they implement check_project.
+    project: bool = False
+
+    def check(self, ctx) -> Iterator[Finding]:
+        if self.project:
+            return iter(())
+        raise NotImplementedError
+
+    def check_project(self, project) -> Iterator[Finding]:
+        raise NotImplementedError

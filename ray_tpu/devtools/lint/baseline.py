@@ -1,0 +1,70 @@
+"""Baseline persistence: grandfathered findings by (file, rule, scope).
+
+File format is byte-compatible with the original single-module
+graftlint: ``{"version": 1, "comment": ..., "baseline": {key: count}}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from ray_tpu.devtools.lint.base import BASELINE_DEFAULT, Finding
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != 1:
+        raise ValueError(f"unsupported baseline version in {path}")
+    return dict(data.get("baseline", {}))
+
+
+def write_baseline(findings: Iterable[Finding], path: str) -> None:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    payload = {
+        "version": 1,
+        "comment": ("grandfathered graftlint findings; regenerate with "
+                    "`python -m ray_tpu.devtools.lint <paths> "
+                    "--write-baseline`. New findings (even in a "
+                    "baselined scope) still fail once the scope's "
+                    "count is exceeded."),
+        "baseline": dict(sorted(counts.items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[str, int]) -> List[Finding]:
+    """Drop up to baseline[key] findings per fingerprint (earliest
+    lines win); everything beyond the grandfathered count is new."""
+    budget = dict(baseline)
+    out: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def find_default_baseline(paths: Sequence[str]) -> Optional[str]:
+    """cwd first, then ancestors of each scanned path."""
+    candidates = [os.path.join(os.getcwd(), BASELINE_DEFAULT)]
+    for p in paths:
+        d = os.path.abspath(p if os.path.isdir(p) else os.path.dirname(p))
+        while True:
+            candidates.append(os.path.join(d, BASELINE_DEFAULT))
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    for c in candidates:
+        if os.path.isfile(c):
+            return c
+    return None

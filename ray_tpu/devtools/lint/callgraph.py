@@ -1,0 +1,412 @@
+"""Interprocedural call graph + loop-thread context propagation.
+
+The IO loop (``ray_tpu/core/io_loop.py``) runs every protocol callback
+on ONE ``rtpu-io-loop`` thread; code reachable from those callbacks
+must never block, must write metrics via ``record_local``, and owns
+its "loop-only" state exclusively. The threadguard rules (GL009-GL012)
+need to know *which* functions can run on that thread, which is a
+whole-program property — so this module builds a call graph over the
+scanned files, seeds "runs-on-loop-thread" contexts from the actual
+registration points, and propagates the context breadth-first.
+
+Seeds (a function becomes loop-context when it is):
+
+* passed as a callback to ``call_soon`` / ``call_later`` /
+  ``_exec_on_loop`` / ``register_message_conn`` / ``register_listener``
+  / ``send_stream`` / a loop-ish ``register`` (receiver mentioning
+  io/loop, so ``selector.register`` stays quiet);
+* decorated with ``@ray_tpu.devtools.threadguard.loop_only``.
+
+Call edges are resolved conservatively: nested defs in the enclosing
+scope chain, same-module functions, ``self.method`` in the enclosing
+class, imported module functions/constructors (absolute imports only),
+``ClassName.method``, and — as a pragmatic fallback — ``obj._name``
+attributes whose ``_name`` is defined exactly once across the scanned
+set. Unresolvable calls simply end the walk: the pass is
+intra-process, under-approximate by design (no getattr, no
+cross-process hops), and exists to catch the easy 95%.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from ray_tpu.devtools.lint.annotate import (FileContext, _dotted,
+                                            _is_self_attr)
+
+Key = Tuple[str, str]   # (path, qualname)
+
+# leaf callable name -> (positional callback args, callback kwargs)
+_SEED_SPECS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "call_soon": ((0,), ()),
+    "call_later": ((1,), ()),
+    "_exec_on_loop": ((0,), ()),
+    "register": ((1, 2), ("on_frames", "on_close")),
+    "register_message_conn": ((1, 2), ("on_msg", "on_close")),
+    "register_listener": ((1,), ("on_accept",)),
+    "send_stream": ((0, 1), ("on_done",)),
+}
+
+_METRIC_FACTORIES = {"Counter", "Gauge", "Histogram"}
+
+
+def _leaf(dotted: Optional[str]) -> str:
+    return (dotted or "").rsplit(".", 1)[-1]
+
+
+def _own_qualname(node: ast.AST) -> str:
+    scope = getattr(node, "_gl_scope", "<module>")
+    name = getattr(node, "name", None) or f"<lambda:{node.lineno}>"
+    return name if scope == "<module>" else f"{scope}.{name}"
+
+
+def _module_name(path: str) -> Optional[str]:
+    """Dotted module for a scanned file; anchored at the last
+    ``ray_tpu`` path segment so absolute and relative scan roots
+    agree."""
+    parts = path.replace("\\", "/").split("/")
+    if not parts:
+        return None
+    if parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    if "ray_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("ray_tpu")
+        parts = parts[idx:]
+    elif parts:
+        parts = parts[-1:]
+    return ".".join(parts) if parts else None
+
+
+def body_nodes(func_node: ast.AST) -> Iterator[ast.AST]:
+    """Nodes in a function's own body, not descending into nested
+    function/class definitions (those are separate graph nodes)."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class FuncInfo:
+    __slots__ = ("key", "ctx", "node", "qualname", "is_async")
+
+    def __init__(self, key: Key, ctx: FileContext, node: ast.AST):
+        self.key = key
+        self.ctx = ctx
+        self.node = node
+        self.qualname = key[1]
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+
+
+class ProjectContext:
+    """Whole-scan view consumed by project rules (GL009-GL012)."""
+
+    def __init__(self, ctxs: Sequence[FileContext]):
+        self.ctxs: Dict[str, FileContext] = {c.path: c for c in ctxs}
+        self.functions: Dict[Key, FuncInfo] = {}
+        self._module_funcs: Dict[str, Dict[str, Key]] = {}
+        self._methods: Dict[int, Dict[str, Key]] = {}
+        self._nested: Dict[Tuple[str, str], Dict[str, Key]] = {}
+        self._classes: Dict[str, Dict[str, ast.ClassDef]] = {}
+        self._imports: Dict[str, Dict[str, Tuple[str, Optional[str]]]] = {}
+        self._module_paths: Dict[str, str] = {}
+        self._lambda_keys: Dict[int, Key] = {}
+        self._underscore_index: Dict[str, List[Key]] = {}
+        #: names bound to Counter/Gauge/Histogram constructors
+        self.metric_globals: Set[str] = set()
+        self.metric_attrs: Set[Tuple[str, str]] = set()  # (class, attr)
+        #: id(ClassDef) -> attr names declared via @loop_owned
+        self.loop_owned: Dict[int, Set[str]] = {}
+        self.all_classes: List[Tuple[FileContext, ast.ClassDef]] = []
+        self.calls: Dict[Key, List[Tuple[Key, ast.Call]]] = {}
+        #: seed description per seeded function
+        self.seeds: Dict[Key, str] = {}
+        #: loop-context functions -> chain of quals from the seed
+        self.loop_ctx: Dict[Key, Tuple[str, ...]] = {}
+        #: (path, site node, qualname, reason) for GL012
+        self.async_registrations: List[Tuple[str, ast.AST, str, str]] = []
+        self._index()
+        self._collect_edges()
+        self._collect_seeds()
+        self._propagate()
+
+    # ------------------------------------------------------- indexing
+
+    def _index(self) -> None:
+        for path, ctx in self.ctxs.items():
+            mod = _module_name(path)
+            if mod:
+                self._module_paths.setdefault(mod, path)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    key = (path, _own_qualname(node))
+                    if key in self.functions:
+                        continue  # e.g. try/except redefinition
+                    self.functions[key] = FuncInfo(key, ctx, node)
+                    if isinstance(node, ast.Lambda):
+                        self._lambda_keys[id(node)] = key
+                        continue
+                    cls = getattr(node, "_gl_class", None)
+                    enclosing_fn = getattr(node, "_gl_func", None)
+                    if enclosing_fn is not None:
+                        self._nested.setdefault(
+                            (path, node._gl_scope), {})[node.name] = key
+                    elif cls is not None:
+                        self._methods.setdefault(
+                            id(cls), {})[node.name] = key
+                    else:
+                        self._module_funcs.setdefault(
+                            path, {})[node.name] = key
+                    if node.name.startswith("_") and \
+                            not node.name.startswith("__"):
+                        self._underscore_index.setdefault(
+                            node.name, []).append(key)
+                elif isinstance(node, ast.ClassDef):
+                    self.all_classes.append((ctx, node))
+                    if getattr(node, "_gl_func", None) is None and \
+                            getattr(node, "_gl_class", None) is None:
+                        self._classes.setdefault(
+                            path, {})[node.name] = node
+                    owned = self._owned_decl(node)
+                    if owned:
+                        self.loop_owned[id(node)] = owned
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        bound = alias.asname or alias.name.split(".")[0]
+                        target = alias.name if alias.asname \
+                            else alias.name.split(".")[0]
+                        self._imports.setdefault(
+                            path, {})[bound] = (target, None)
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:   # relative imports: out of scope
+                        continue
+                    for alias in node.names:
+                        self._imports.setdefault(path, {})[
+                            alias.asname or alias.name] = (
+                                node.module or "", alias.name)
+                elif isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    factory = _leaf(_dotted(node.value.func))
+                    if factory in _METRIC_FACTORIES:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                self.metric_globals.add(t.id)
+                            attr = _is_self_attr(t)
+                            cls = getattr(node, "_gl_class", None)
+                            if attr and cls is not None:
+                                self.metric_attrs.add((cls.name, attr))
+
+    @staticmethod
+    def _owned_decl(cls: ast.ClassDef) -> Set[str]:
+        owned: Set[str] = set()
+        for dec in cls.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            if _leaf(_dotted(dec.func)) != "loop_owned":
+                continue
+            for arg in dec.args:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    owned.add(arg.value)
+        return owned
+
+    # ----------------------------------------------------- resolution
+
+    def resolve(self, expr: ast.AST, path: str, scope: str,
+                cls: Optional[ast.ClassDef]) -> Optional[Key]:
+        """Best-effort: the graph key a callable expression refers to."""
+        if isinstance(expr, ast.Lambda):
+            return self._lambda_keys.get(id(expr))
+        if isinstance(expr, ast.Name):
+            return self._resolve_name(expr.id, path, scope)
+        if isinstance(expr, ast.Attribute):
+            return self._resolve_attr(expr, path, cls)
+        return None
+
+    def _resolve_name(self, name: str, path: str,
+                      scope: str) -> Optional[Key]:
+        s = scope
+        while True:
+            hit = self._nested.get((path, s), {}).get(name)
+            if hit:
+                return hit
+            if "." not in s:
+                break
+            s = s.rsplit(".", 1)[0]
+        hit = self._module_funcs.get(path, {}).get(name)
+        if hit:
+            return hit
+        imp = self._imports.get(path, {}).get(name)
+        if imp and imp[1] is not None:
+            tpath = self._module_paths.get(imp[0])
+            if tpath:
+                hit = self._module_funcs.get(tpath, {}).get(imp[1])
+                if hit:
+                    return hit
+                tcls = self._classes.get(tpath, {}).get(imp[1])
+                if tcls is not None:
+                    return self._methods.get(id(tcls), {}).get("__init__")
+        c = self._classes.get(path, {}).get(name)
+        if c is not None:
+            return self._methods.get(id(c), {}).get("__init__")
+        return None
+
+    def _resolve_attr(self, expr: ast.Attribute, path: str,
+                      cls: Optional[ast.ClassDef]) -> Optional[Key]:
+        attr = expr.attr
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and cls is not None:
+                hit = self._methods.get(id(cls), {}).get(attr)
+                if hit:
+                    return hit
+            imp = self._imports.get(path, {}).get(base.id)
+            if imp is not None:
+                mod = imp[0] if imp[1] is None else \
+                    (f"{imp[0]}.{imp[1]}" if imp[0] else imp[1])
+                tpath = self._module_paths.get(mod)
+                if tpath:
+                    hit = self._module_funcs.get(tpath, {}).get(attr)
+                    if hit:
+                        return hit
+                    tcls = self._classes.get(tpath, {}).get(attr)
+                    if tcls is not None:
+                        return self._methods.get(
+                            id(tcls), {}).get("__init__")
+            c = self._classes.get(path, {}).get(base.id)
+            if c is not None:
+                hit = self._methods.get(id(c), {}).get(attr)
+                if hit:
+                    return hit
+        # pragmatic fallback: a private name defined exactly once in
+        # the whole scan resolves to that definition (catches
+        # ``server._admit``, ``self._loop._flush_conn``...)
+        if attr.startswith("_") and not attr.startswith("__"):
+            cands = self._underscore_index.get(attr, [])
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    # ---------------------------------------------------------- edges
+
+    def body_calls(self, func_node: ast.AST) -> Iterator[ast.Call]:
+        for n in body_nodes(func_node):
+            if isinstance(n, ast.Call):
+                yield n
+
+    def _collect_edges(self) -> None:
+        for key, info in self.functions.items():
+            path = key[0]
+            cls = getattr(info.node, "_gl_class", None)
+            edges = self.calls.setdefault(key, [])
+            for call in self.body_calls(info.node):
+                callee = self.resolve(call.func, path, info.qualname, cls)
+                if callee is not None and callee != key:
+                    edges.append((callee, call))
+
+    # ---------------------------------------------------------- seeds
+
+    @staticmethod
+    def _loopish_receiver(func: ast.AST) -> bool:
+        if not isinstance(func, ast.Attribute):
+            return False
+        recv = func.value
+        if isinstance(recv, ast.Call):
+            return _leaf(_dotted(recv.func)) == "get_io_loop"
+        dotted = (_dotted(recv) or "").lower()
+        return "io" in dotted.split(".")[-1] or "loop" in dotted
+
+    def _collect_seeds(self) -> None:
+        # decorator seeds: @loop_only marks a function loop-context
+        for key, info in self.functions.items():
+            node = info.node
+            for dec in getattr(node, "decorator_list", ()):
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _leaf(_dotted(target)) == "loop_only":
+                    self.seeds.setdefault(key, "@loop_only")
+                    self._check_async(key, key[0], node,
+                                      "@loop_only-decorated")
+        # registration seeds
+        for path, ctx in self.ctxs.items():
+            for call in ast.walk(ctx.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                leaf = _leaf(_dotted(call.func))
+                spec = _SEED_SPECS.get(leaf)
+                if spec is None:
+                    continue
+                if leaf == "register" and \
+                        not self._loopish_receiver(call.func):
+                    continue
+                scope = getattr(call, "_gl_scope", "<module>")
+                cls = getattr(call, "_gl_class", None)
+                exprs = [call.args[i] for i in spec[0]
+                         if i < len(call.args)]
+                exprs += [kw.value for kw in call.keywords
+                          if kw.arg in spec[1]]
+                for expr in exprs:
+                    if isinstance(expr, ast.Call):
+                        # e.g. send_stream(chunks(), ...): the
+                        # generator body runs on the loop thread
+                        expr = expr.func
+                    key = self.resolve(expr, path, scope, cls)
+                    if key is None:
+                        continue
+                    desc = (f"{leaf}() @ {path}:"
+                            f"{getattr(call, 'lineno', 0)}")
+                    self.seeds.setdefault(key, desc)
+                    self._check_async(key, path, call,
+                                      f"registered via {leaf}()")
+
+    def _check_async(self, key: Key, site_path: str, site_node: ast.AST,
+                     how: str) -> None:
+        info = self.functions.get(key)
+        if info is None:
+            return
+        if info.is_async:
+            self.async_registrations.append(
+                (site_path, site_node, info.qualname,
+                 f"{how} callback {info.qualname}() is `async def`"))
+            return
+        # sync callback that returns an awaitable (return <async fn>())
+        cls = getattr(info.node, "_gl_class", None)
+        for n in body_nodes(info.node):
+            if isinstance(n, ast.Return) and isinstance(n.value, ast.Call):
+                tgt = self.resolve(n.value.func, key[0], info.qualname,
+                                   cls)
+                if tgt is not None and self.functions[tgt].is_async:
+                    self.async_registrations.append(
+                        (site_path, site_node, info.qualname,
+                         f"{how} callback {info.qualname}() returns an "
+                         f"awaitable ({self.functions[tgt].qualname}())"))
+                    return
+
+    # ---------------------------------------------------- propagation
+
+    def _propagate(self) -> None:
+        from collections import deque
+        q = deque()
+        for key, desc in self.seeds.items():
+            if key in self.functions:
+                self.loop_ctx[key] = (desc,)
+                q.append(key)
+        while q:
+            key = q.popleft()
+            chain = self.loop_ctx[key]
+            qual = self.functions[key].qualname
+            for callee, _site in self.calls.get(key, ()):
+                if callee not in self.loop_ctx:
+                    self.loop_ctx[callee] = chain + (qual,)
+                    q.append(callee)
+
+    def chain_str(self, key: Key) -> str:
+        chain = self.loop_ctx.get(key, ())
+        qual = self.functions[key].qualname
+        return " -> ".join(chain + (qual,))

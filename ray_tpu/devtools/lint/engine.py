@@ -1,0 +1,219 @@
+"""File walking, rule driving, and the CLI.
+
+Per-file rules run against each ``FileContext``; project rules
+(``Rule.project = True``) run once against a ``ProjectContext`` built
+over every file in the scan, which is how the interprocedural
+loop-affinity rules see cross-module call chains. ``lint_file`` wraps
+a single file in a one-file project so fixture tests exercise the
+project rules too.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ray_tpu.devtools.lint.annotate import FileContext
+from ray_tpu.devtools.lint.base import BASELINE_DEFAULT, Finding, RULES
+from ray_tpu.devtools.lint.baseline import (apply_baseline,
+                                            find_default_baseline,
+                                            load_baseline,
+                                            write_baseline)
+from ray_tpu.devtools.lint.callgraph import ProjectContext
+from ray_tpu.devtools.lint import rules as _rules  # noqa: F401  (registers)
+
+
+def _iter_py_files(paths: Sequence[str]):
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def _rel(path: str) -> str:
+    try:
+        rel = os.path.relpath(path)
+    except ValueError:
+        rel = path
+    if rel.startswith(".." + os.sep):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def _parse(path: str, source: Optional[str] = None
+           ) -> Tuple[Optional[FileContext], Optional[Finding]]:
+    if source is None:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+    try:
+        return FileContext(_rel(path), source), None
+    except SyntaxError as e:
+        return None, Finding(rule="GL000", path=_rel(path),
+                             line=e.lineno or 1, col=e.offset or 0,
+                             message=f"syntax error: {e.msg}",
+                             scope="<module>")
+
+
+def _selected_rules(select: Optional[Iterable[str]],
+                    ignore: Optional[Iterable[str]]) -> List[str]:
+    selected = set(select) if select else set(RULES)
+    if ignore:
+        selected -= set(ignore)
+    return sorted(selected)
+
+
+def _run_rules(ctxs: Sequence[FileContext],
+               errors: Sequence[Finding],
+               select: Optional[Iterable[str]],
+               ignore: Optional[Iterable[str]]) -> List[Finding]:
+    findings: List[Finding] = list(errors)
+    rule_ids = _selected_rules(select, ignore)
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    for ctx in ctxs:
+        for rule_id in rule_ids:
+            rule = RULES.get(rule_id)
+            if rule is None or rule.project:
+                continue
+            for finding in rule.check(ctx):
+                if not ctx.suppressed(finding):
+                    findings.append(finding)
+    project_rules = [RULES[r] for r in rule_ids
+                     if r in RULES and RULES[r].project]
+    if project_rules and ctxs:
+        project = ProjectContext(ctxs)
+        for rule in project_rules:
+            for finding in rule.check_project(project):
+                ctx = by_path.get(finding.path)
+                if ctx is None or not ctx.suppressed(finding):
+                    findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str, source: Optional[str] = None,
+              select: Optional[Iterable[str]] = None,
+              ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    ctx, err = _parse(path, source)
+    if ctx is None:
+        return [err]
+    return _run_rules([ctx], [], select, ignore)
+
+
+def lint_paths(paths: Sequence[str],
+               select: Optional[Iterable[str]] = None,
+               ignore: Optional[Iterable[str]] = None) -> List[Finding]:
+    ctxs: List[FileContext] = []
+    errors: List[Finding] = []
+    for path in _iter_py_files(paths):
+        ctx, err = _parse(path)
+        if ctx is not None:
+            ctxs.append(ctx)
+        else:
+            errors.append(err)
+    return _run_rules(ctxs, errors, select, ignore)
+
+
+# -- output formats ----------------------------------------------------
+
+
+def _emit_text(findings: Sequence[Finding]) -> None:
+    for f in findings:
+        print(f)
+    if findings:
+        by_rule: Dict[str, int] = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        summary = ", ".join(f"{k}: {v}" for k, v in sorted(by_rule.items()))
+        print(f"graftlint: {len(findings)} finding(s) ({summary})")
+    else:
+        print("graftlint: clean")
+
+
+def _emit_json(findings: Sequence[Finding]) -> None:
+    payload = [{"rule": f.rule, "path": f.path, "line": f.line,
+                "col": f.col, "scope": f.scope, "message": f.message}
+               for f in findings]
+    json.dump(payload, sys.stdout, indent=1, sort_keys=True)
+    sys.stdout.write("\n")
+
+
+def _emit_github(findings: Sequence[Finding]) -> None:
+    # GitHub workflow commands: rendered as inline PR annotations.
+    # https://docs.github.com/actions/reference/workflow-commands
+    for f in findings:
+        msg = f.message.replace("%", "%25").replace("\r", "%0D") \
+            .replace("\n", "%0A")
+        print(f"::error file={f.path},line={f.line},col={f.col + 1},"
+              f"title=graftlint {f.rule}::{msg}")
+    if not findings:
+        print("::notice::graftlint: clean")
+
+
+_FORMATS = {"text": _emit_text, "json": _emit_json,
+            "github": _emit_github}
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.devtools.lint",
+        description="framework-aware static analysis for ray_tpu")
+    parser.add_argument("paths", nargs="*", default=["ray_tpu"])
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: "
+                             f"{BASELINE_DEFAULT} in cwd or scanned-"
+                             "path ancestors)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring baselines")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings as the baseline "
+                             "and exit 0")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to run")
+    parser.add_argument("--ignore", default=None,
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--format", default="text",
+                        choices=sorted(_FORMATS),
+                        help="output format (default: text; github "
+                             "emits workflow-command annotations)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            rule = RULES[rid]
+            print(f"{rid} {rule.name}: {rule.rationale}")
+        return 0
+
+    select = args.select.split(",") if args.select else None
+    ignore = args.ignore.split(",") if args.ignore else None
+    findings = lint_paths(args.paths, select=select, ignore=ignore)
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        baseline_path = find_default_baseline(args.paths)
+
+    if args.write_baseline:
+        out = baseline_path or BASELINE_DEFAULT
+        write_baseline(findings, out)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    if baseline_path and not args.no_baseline:
+        findings = apply_baseline(findings, load_baseline(baseline_path))
+
+    _FORMATS[args.format](findings)
+    return 1 if findings else 0

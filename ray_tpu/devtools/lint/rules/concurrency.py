@@ -1,0 +1,181 @@
+"""Lock/thread discipline rules (GL001-GL003, GL008)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional
+
+from ray_tpu.devtools.lint.annotate import (FileContext, _MUTATORS,
+                                            _dotted, _is_self_attr)
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+
+
+@register
+class UnguardedSharedState(Rule):
+    id = "GL001"
+    name = "unguarded-shared-state"
+    rationale = ("a class that owns a lock mutates self._* state "
+                 "outside any `with <lock>` block — racy once a second "
+                 "thread touches the instance")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            cls = getattr(node, "_gl_class", None)
+            if cls is None or not cls._gl_locks:
+                continue
+            if node._gl_func == "__init__" or node._gl_lockdepth > 0:
+                continue
+            attr = self._mutated_attr(node, cls)
+            if attr is not None:
+                names = sorted(cls._gl_locks)
+                if len(names) > 3:
+                    names = names[:3] + [f"+{len(names) - 3} more"]
+                yield ctx.finding(
+                    self.id, node,
+                    f"mutation of self.{attr} outside the lock "
+                    f"({'/'.join(names)}) this class owns")
+
+    @staticmethod
+    def _mutated_attr(node: ast.AST, cls) -> Optional[str]:
+        def shared(target) -> Optional[str]:
+            attr = _is_self_attr(target)
+            if attr is not None and attr.startswith("_") \
+                    and not attr.startswith("__") \
+                    and attr not in cls._gl_locks:
+                return attr
+            return None
+
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            return shared(node.func.value)
+        if isinstance(node, (ast.Assign, ast.Delete)):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            # read-modify-write on a self attr is racy even for scalars
+            target = node.target
+            if isinstance(target, ast.Subscript):
+                return shared(target.value)
+            return shared(target)
+        else:
+            return None
+        for target in targets:
+            if isinstance(target, ast.Subscript):
+                attr = shared(target.value)
+                if attr is not None:
+                    return attr
+        return None
+
+
+_BLOCKING_EXACT = {"time.sleep", "ray_tpu.get", "subprocess.run",
+                   "subprocess.call", "subprocess.check_call",
+                   "subprocess.check_output", "subprocess.Popen",
+                   "socket.create_connection"}
+_BLOCKING_LEAF = {"sleep", "recv", "recv_into", "accept", "connect",
+                  "gcs_call", "wait_for_nodes"}
+
+
+@register
+class LockHeldAcrossBlockingCall(Rule):
+    id = "GL002"
+    name = "lock-held-across-blocking-call"
+    rationale = ("sleeping / socket IO / subprocess / RPC inside a "
+                 "`with <lock>` body stalls every thread contending "
+                 "for that lock")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or node._gl_lockdepth == 0:
+                continue
+            dotted = _dotted(node.func)
+            if dotted is None:
+                continue
+            leaf = dotted.rsplit(".", 1)[-1]
+            if dotted in _BLOCKING_EXACT or leaf in _BLOCKING_LEAF or \
+                    dotted.startswith("subprocess."):
+                yield ctx.finding(
+                    self.id, node,
+                    f"blocking call {dotted}() while holding a lock")
+
+
+@register
+class BusyWaitLoop(Rule):
+    id = "GL003"
+    name = "busy-wait-polling-loop"
+    rationale = ("`while ...: time.sleep(...)` polling in a class that "
+                 "already owns a Condition/Event — use a real wait "
+                 "instead of burning wakeups and adding latency")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While):
+                continue
+            cls = getattr(node, "_gl_class", None)
+            if cls is None or not cls._gl_events:
+                continue
+            sleeps, waits = False, False
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func) or ""
+                leaf = dotted.rsplit(".", 1)[-1]
+                if dotted.endswith("time.sleep") or dotted == "sleep":
+                    sleeps = True
+                if leaf in ("wait", "wait_for", "get", "join"):
+                    waits = True
+            if sleeps and not waits:
+                yield ctx.finding(
+                    self.id, node,
+                    "busy-wait loop; this class owns "
+                    f"{'/'.join(sorted(cls._gl_events))} — wait on it "
+                    "instead of polling")
+
+
+@register
+class NonDaemonBackgroundThread(Rule):
+    id = "GL008"
+    name = "non-daemon-background-thread"
+    rationale = ("a non-daemon background thread with no shutdown path "
+                 "hangs interpreter exit (tests and drivers never "
+                 "terminate)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # collect `<target>.daemon = True` assignments per scope
+        daemonized: set = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Constant) and \
+                    node.value.value is True:
+                for target in node.targets:
+                    if isinstance(target, ast.Attribute) and \
+                            target.attr == "daemon":
+                        base = _dotted(target.value) or ast.dump(
+                            target.value)
+                        daemonized.add((node._gl_scope, base))
+        assigned_to: Dict[int, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                for target in node.targets:
+                    base = _dotted(target)
+                    if base:
+                        assigned_to[id(node.value)] = base
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            if dotted not in ("threading.Thread", "Thread"):
+                continue
+            kwargs = {k.arg: k.value for k in node.keywords}
+            daemon = kwargs.get("daemon")
+            if isinstance(daemon, ast.Constant) and daemon.value:
+                continue
+            if daemon is not None and not isinstance(daemon, ast.Constant):
+                continue  # computed daemon-ness: give it the benefit
+            target = assigned_to.get(id(node))
+            if target and (node._gl_scope, target) in daemonized:
+                continue
+            yield ctx.finding(
+                self.id, node,
+                "threading.Thread(...) without daemon=True or a "
+                "registered shutdown path")

@@ -1,0 +1,81 @@
+"""Framework convention rules (GL006-GL007)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ray_tpu.devtools.lint.annotate import FileContext, _dotted
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+
+_METRIC_NAME_RE = re.compile(r"^ray_tpu_[a-z0-9_]+$")
+# Unit/kind suffixes accepted per metric type. Counters are cumulative
+# and must say so (_total); histograms measure a unit; gauges may also
+# be dimensionless levels (_depth, _ratio, _requests...).
+_METRIC_SUFFIXES = {
+    "Counter": ("_total",),
+    "Histogram": ("_seconds", "_bytes", "_size", "_tokens", "_ratio"),
+    "Gauge": ("_seconds", "_bytes", "_ratio", "_depth", "_requests",
+              "_tokens", "_total", "_size", "_count", "_percent",
+              "_occupancy", "_workers", "_nodes", "_replicas", "_mfu",
+              "_flag", "_info", "_actors", "_objects", "_tasks",
+              "_per_second", "_steps", "_pending", "_fds"),
+}
+
+
+@register
+class MetricNamingConvention(Rule):
+    id = "GL006"
+    name = "metric-naming-convention"
+    rationale = ("every exported metric is `ray_tpu_`-prefixed "
+                 "snake_case with a unit/kind suffix (`_total` for "
+                 "counters) so dashboards and alerts survive refactors")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            kind = dotted.rsplit(".", 1)[-1]
+            if kind not in _METRIC_SUFFIXES:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            name = node.args[0].value
+            if not _METRIC_NAME_RE.match(name):
+                yield ctx.finding(
+                    self.id, node,
+                    f"metric {name!r} is outside the ray_tpu_ "
+                    "snake_case convention")
+            elif not name.endswith(_METRIC_SUFFIXES[kind]):
+                yield ctx.finding(
+                    self.id, node,
+                    f"{kind} {name!r} lacks a unit/kind suffix "
+                    f"(expected one of {_METRIC_SUFFIXES[kind]})")
+
+
+@register
+class TraceContextDrop(Rule):
+    id = "GL007"
+    name = "trace-context-drop"
+    rationale = ("a TaskSpec built without trace_id breaks the "
+                 "distributed trace at that hop (PR 1 wired trace "
+                 "context end-to-end; new call sites must keep it)")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            if dotted.rsplit(".", 1)[-1] != "TaskSpec":
+                continue
+            kw_names = {k.arg for k in node.keywords}
+            if None in kw_names:  # **kwargs may carry it
+                continue
+            if "trace_id" not in kw_names:
+                yield ctx.finding(
+                    self.id, node,
+                    "TaskSpec(...) without trace_id= — this hop drops "
+                    "the request's trace context")

@@ -1,0 +1,103 @@
+"""Error-handling and dependency hygiene rules (GL004-GL005)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ray_tpu.devtools.lint.annotate import FileContext, _dotted
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+
+_LOGGISH = re.compile(r"(?:^|\.)(?:log|logger|logging|warn|warning|"
+                      r"error|exception|debug|info|print_exc|print)")
+
+
+@register
+class SwallowedException(Rule):
+    id = "GL004"
+    name = "swallowed-exception"
+    rationale = ("a bare `except:` or `except Exception: pass` hides "
+                 "real failures; log it or justify the suppression")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if not self._handled(node):
+                    yield ctx.finding(
+                        self.id, node,
+                        "bare `except:` traps SystemExit/"
+                        "KeyboardInterrupt and hides failures")
+                continue
+            broad = isinstance(node.type, ast.Name) and \
+                node.type.id in ("Exception", "BaseException")
+            if broad and self._body_is_silent_pass(node) and \
+                    not self._handled(node):
+                yield ctx.finding(
+                    self.id, node,
+                    f"`except {node.type.id}: pass` swallows the "
+                    "error without logging")
+
+    @staticmethod
+    def _body_is_silent_pass(node: ast.ExceptHandler) -> bool:
+        return all(isinstance(stmt, ast.Pass) or
+                   (isinstance(stmt, ast.Expr) and
+                    isinstance(stmt.value, ast.Constant))
+                   for stmt in node.body)
+
+    @staticmethod
+    def _handled(node: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return True
+            if isinstance(sub, ast.Call):
+                dotted = _dotted(sub.func)
+                if dotted and _LOGGISH.search(dotted):
+                    return True
+        return False
+
+
+_FORBIDDEN_IMPORTS = ("torch.cuda", "cupy", "nccl", "pynccl", "pycuda",
+                      "pynvml", "cuda")
+
+
+@register
+class ForbiddenBackendImport(Rule):
+    id = "GL005"
+    name = "forbidden-backend-import"
+    rationale = ("CUDA backends are compiled out of this TPU-native "
+                 "build (BASELINE.md); torch.cuda/nccl/cupy must not "
+                 "creep back in")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if self._forbidden(alias.name):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"import of CUDA backend {alias.name!r}")
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if self._forbidden(mod):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"import from CUDA backend {mod!r}")
+                elif mod == "torch":
+                    for alias in node.names:
+                        if alias.name == "cuda":
+                            yield ctx.finding(
+                                self.id, node,
+                                "`from torch import cuda` — CUDA is "
+                                "compiled out")
+            elif isinstance(node, ast.Attribute):
+                if _dotted(node) == "torch.cuda":
+                    yield ctx.finding(self.id, node,
+                                      "use of torch.cuda attribute")
+
+    @staticmethod
+    def _forbidden(module: str) -> bool:
+        return any(module == root or module.startswith(root + ".")
+                   for root in _FORBIDDEN_IMPORTS)

@@ -1,0 +1,208 @@
+"""Loop-thread affinity rules (GL009-GL012).
+
+These are *project* rules: they consume the interprocedural
+``ProjectContext`` (callgraph.py) instead of a single file, because
+"can this function run on the rtpu-io-loop thread?" is a whole-program
+property. The runtime half of the contract lives in
+``ray_tpu/devtools/threadguard.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ray_tpu.devtools.lint.annotate import (_MUTATORS, _dotted,
+                                            _is_self_attr)
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+from ray_tpu.devtools.lint.callgraph import ProjectContext, _leaf, \
+    body_nodes
+
+_SOCKET_LEAVES = {"recv", "recv_into", "recvfrom", "accept", "connect",
+                  "sendall", "create_connection"}
+_WAIT_LEAVES = {"wait", "wait_for", "join"}
+_RPC_LEAVES = {"gcs_call", "wait_for_nodes", "urlopen"}
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    leaf = _leaf(dotted)
+    if dotted == "sleep" or dotted.endswith(".sleep"):
+        return f"blocking call {dotted}()"
+    if dotted.startswith("subprocess.") or leaf == "Popen":
+        return f"subprocess call {dotted}()"
+    if leaf in _SOCKET_LEAVES:
+        return f"socket operation {dotted}()"
+    if leaf in _RPC_LEAVES:
+        return f"synchronous control-plane call {dotted}()"
+    if leaf in _WAIT_LEAVES:
+        if leaf == "join" and "path" in dotted:
+            return None     # os.path.join and friends
+        recv = call.func.value if isinstance(call.func, ast.Attribute) \
+            else None
+        if isinstance(recv, ast.Constant):
+            return None     # "sep".join(...)
+        return f"blocking wait {dotted}()"
+    if leaf == "acquire":
+        nonblocking = any(
+            kw.arg == "blocking" and isinstance(kw.value, ast.Constant)
+            and not kw.value.value for kw in call.keywords)
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and not call.args[0].value:
+            nonblocking = True
+        if not nonblocking:
+            return f"blocking lock acquire {dotted}()"
+    return None
+
+
+@register
+class LoopThreadBlockingCall(Rule):
+    id = "GL009"
+    name = "loop-thread-blocking-call"
+    project = True
+    rationale = ("a blocking primitive (sleep/socket/subprocess/"
+                 "Event.wait/lock.acquire/sync gcs_call) is reachable "
+                 "from an IO-loop callback — the single loop thread "
+                 "must never block")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        for key in sorted(project.loop_ctx):
+            info = project.functions[key]
+            for call in project.body_calls(info.node):
+                reason = _blocking_reason(call)
+                if reason is not None:
+                    yield info.ctx.finding(
+                        self.id, call,
+                        f"{reason} on a loop-thread path "
+                        f"({project.chain_str(key)}); defer it with "
+                        "call_soon/call_later or move it off-loop")
+
+
+@register
+class LoopThreadMetricRPC(Rule):
+    id = "GL010"
+    name = "loop-thread-metric-rpc"
+    project = True
+    rationale = ("Counter.inc/Gauge.set/Histogram.observe/record_batch "
+                 "forward worker->driver over a sync gcs_call; from "
+                 "the loop thread that reply can only be dispatched by "
+                 "the thread that is waiting for it — use record_local")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        for key in sorted(project.loop_ctx):
+            info = project.functions[key]
+            cls = getattr(info.node, "_gl_class", None)
+            for call in project.body_calls(info.node):
+                dotted = _dotted(call.func)
+                if dotted is None:
+                    continue
+                leaf = _leaf(dotted)
+                if leaf == "record_batch":
+                    hit = True
+                elif leaf in ("inc", "set", "observe") and \
+                        isinstance(call.func, ast.Attribute):
+                    base = call.func.value
+                    hit = (isinstance(base, ast.Name) and
+                           base.id in project.metric_globals)
+                    attr = _is_self_attr(base)
+                    if attr is not None and cls is not None and \
+                            (cls.name, attr) in project.metric_attrs:
+                        hit = True
+                else:
+                    hit = False
+                if hit:
+                    fix = "record_local()" if leaf == "record_batch" \
+                        else f"{leaf}_local()"
+                    yield info.ctx.finding(
+                        self.id, call,
+                        f"metric write {dotted}() can RPC the driver "
+                        f"from the loop thread "
+                        f"({project.chain_str(key)}); use {fix}")
+
+
+@register
+class OffLoopStateMutation(Rule):
+    id = "GL011"
+    name = "off-loop-state-mutation"
+    project = True
+    rationale = ("attributes declared @loop_owned (or _loop-prefixed "
+                 "on loop-registered classes) are loop-thread-only by "
+                 "contract; mutating them from other threads without "
+                 "call_soon/call_later is a data race")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        for ctx, cls in project.all_classes:
+            owned = project.loop_owned.get(id(cls), set())
+            registered = bool(owned)
+            members = [info for info in project.functions.values()
+                       if getattr(info.node, "_gl_class", None) is cls
+                       and info.ctx is ctx]
+            if not registered:
+                registered = any(m.key in project.loop_ctx
+                                 for m in members)
+            if not registered:
+                continue
+            for info in members:
+                if info.key in project.loop_ctx:
+                    continue
+                if info.qualname.endswith(".__init__") or \
+                        info.qualname == "__init__":
+                    continue
+                for node in body_nodes(info.node):
+                    attr = self._mutated_attr(node)
+                    if attr is None:
+                        continue
+                    if attr in owned or attr.startswith("_loop"):
+                        yield ctx.finding(
+                            self.id, node,
+                            f"loop-owned attribute self.{attr} mutated "
+                            f"in {info.qualname}(), which is not on a "
+                            "loop-thread path — route it through "
+                            "call_soon/call_later or a @loop_only "
+                            "method")
+
+    @staticmethod
+    def _mutated_attr(node: ast.AST) -> Optional[str]:
+        def direct(target) -> Optional[str]:
+            if isinstance(target, ast.Subscript):
+                return _is_self_attr(target.value)
+            return _is_self_attr(target)
+
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            return _is_self_attr(node.func.value)
+        if isinstance(node, (ast.Assign, ast.Delete)):
+            for target in node.targets:
+                attr = direct(target)
+                if attr is not None:
+                    return attr
+            return None
+        if isinstance(node, ast.AugAssign):
+            return direct(node.target)
+        return None
+
+
+@register
+class AsyncLoopCallback(Rule):
+    id = "GL012"
+    name = "async-loop-callback"
+    project = True
+    rationale = ("the IO loop calls its callbacks synchronously; an "
+                 "`async def` (or awaitable-returning) callback builds "
+                 "a coroutine nobody awaits and silently never runs")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        seen = set()
+        for path, node, qual, reason in project.async_registrations:
+            fp = (path, getattr(node, "lineno", 0), qual)
+            if fp in seen:
+                continue
+            seen.add(fp)
+            ctx = project.ctxs[path]
+            yield ctx.finding(
+                self.id, node,
+                f"{reason} — the loop never awaits it, so it silently "
+                "never runs")

@@ -166,11 +166,23 @@ class Counter(Metric):
             tags: Optional[Dict[str, str]] = None) -> None:
         _record("counter", self._name, self._tags(tags), value)
 
+    def inc_local(self, value: float = 1.0,
+                  tags: Optional[Dict[str, str]] = None) -> None:
+        """Loop-thread-safe inc: applies to this process's registry
+        with no worker->driver RPC (see record_local). Required on any
+        rtpu-io-loop code path (graftlint GL010)."""
+        record_local("counter", self._name, self._tags(tags), value)
+
 
 class Gauge(Metric):
     def set(self, value: float,
             tags: Optional[Dict[str, str]] = None) -> None:
         _record("gauge", self._name, self._tags(tags), value)
+
+    def set_local(self, value: float,
+                  tags: Optional[Dict[str, str]] = None) -> None:
+        """Loop-thread-safe set: no RPC (see record_local / GL010)."""
+        record_local("gauge", self._name, self._tags(tags), value)
 
 
 class Histogram(Metric):
@@ -184,6 +196,13 @@ class Histogram(Metric):
                 tags: Optional[Dict[str, str]] = None) -> None:
         _record("histogram", self._name, self._tags(tags), value,
                 self._boundaries)
+
+    def observe_local(self, value: float,
+                      tags: Optional[Dict[str, str]] = None) -> None:
+        """Loop-thread-safe observe: no RPC (see record_local /
+        GL010)."""
+        record_local("histogram", self._name, self._tags(tags), value,
+                     self._boundaries)
 
     def percentile(self, q: float,
                    tags: Optional[Dict[str, str]] = None

@@ -365,3 +365,299 @@ def test_cli_write_then_check_baseline(tmp_path, monkeypatch, capsys):
 def test_syntax_error_reported_not_raised(tmp_path):
     findings = lint.lint_file("broken.py", source="def f(:\n")
     assert [f.rule for f in findings] == ["GL000"]
+
+
+# -- GL009 loop-thread blocking call (interprocedural) -----------------
+
+GL009_POS = """
+    import time
+
+    def _helper():
+        time.sleep(0.5)
+
+    class Proto:
+        def __init__(self, io, sock):
+            self.conn = io.register_message_conn(
+                sock, self._on_msg, self._on_close)
+
+        def _on_msg(self, conn, msg):
+            _helper()
+
+        def _on_close(self, conn):
+            pass
+"""
+
+GL009_NEG = """
+    import time
+
+    def background_poll():
+        time.sleep(1.0)   # never reachable from a loop callback
+
+    class Proto:
+        def __init__(self, io, sock):
+            self.conn = io.register_message_conn(
+                sock, self._on_msg, self._on_close)
+
+        def _on_msg(self, conn, msg):
+            self.last = msg
+
+        def _on_close(self, conn):
+            pass
+"""
+
+
+def test_gl009_fires_two_hops_from_registration():
+    """loop callback -> module helper -> time.sleep: the finding lands
+    on the sleep with the full seed-to-sink chain in the message."""
+    findings = run(GL009_POS, select=["GL009"])
+    assert [f.rule for f in findings] == ["GL009"]
+    msg = findings[0].message
+    assert "time.sleep" in msg
+    assert "register_message_conn" in msg
+    assert "_on_msg" in msg and "_helper" in msg
+
+
+def test_gl009_quiet_off_loop_and_for_nonblocking_callbacks():
+    assert rules_hit(GL009_NEG, select=["GL009"]) == set()
+
+
+def test_gl009_fires_via_call_soon_and_loop_only():
+    assert rules_hit("""
+        import time
+
+        class Pump:
+            def kick(self, io):
+                io.call_soon(self._work)
+
+            def _work(self):
+                time.sleep(0.1)
+    """, select=["GL009"]) == {"GL009"}
+    assert rules_hit("""
+        import time
+        from ray_tpu.devtools.threadguard import loop_only
+
+        class Pump:
+            @loop_only
+            def _work(self):
+                self._lock.acquire()
+    """, select=["GL009"]) == {"GL009"}
+
+
+def test_gl009_nonblocking_acquire_and_path_join_exempt():
+    assert rules_hit("""
+        import os
+
+        class Pump:
+            def kick(self, io):
+                io.call_soon(self._work)
+
+            def _work(self):
+                if self._lock.acquire(blocking=False):
+                    self._p = os.path.join("a", "b")
+    """, select=["GL009"]) == set()
+
+
+# -- GL010 metric RPC from the loop thread -----------------------------
+
+GL010_POS = """
+    from ray_tpu.util.metrics import Counter
+
+    REQS = Counter("rtpu_proto_requests_total", "requests")
+
+    class Proto:
+        def __init__(self, io):
+            io.call_soon(self._tick)
+
+        def _tick(self):
+            REQS.inc()
+"""
+
+GL010_NEG = """
+    from ray_tpu.util.metrics import Counter
+
+    REQS = Counter("rtpu_proto_requests_total", "requests")
+
+    class Proto:
+        def __init__(self, io):
+            io.call_soon(self._tick)
+
+        def _tick(self):
+            REQS.inc_local()
+
+        def off_loop(self):
+            REQS.inc()   # fine: not a loop-thread path
+"""
+
+
+def test_gl010_fires_on_loop_path_metric_write():
+    findings = run(GL010_POS, select=["GL010"])
+    assert [f.rule for f in findings] == ["GL010"]
+    assert "inc_local()" in findings[0].message
+
+
+def test_gl010_quiet_for_record_local_and_off_loop():
+    assert rules_hit(GL010_NEG, select=["GL010"]) == set()
+
+
+# -- GL011 off-loop mutation of loop-owned state -----------------------
+
+GL011_POS = """
+    from ray_tpu.devtools.threadguard import loop_owned
+
+    @loop_owned("pending")
+    class Proto:
+        def __init__(self, io):
+            self._io = io
+            self.pending = []
+            io.call_soon(self._drain)
+
+        def _drain(self):
+            self.pending.clear()
+
+        def cancel(self):
+            self.pending.clear()
+"""
+
+GL011_NEG = """
+    from ray_tpu.devtools.threadguard import loop_owned
+
+    @loop_owned("pending")
+    class Proto:
+        def __init__(self, io):
+            self._io = io
+            self.pending = []
+            io.call_soon(self._drain)
+
+        def _drain(self):
+            self.pending.clear()
+
+        def cancel(self):
+            self._io.call_soon(self._do_cancel)
+
+        def _do_cancel(self):
+            self.pending.clear()
+"""
+
+
+def test_gl011_fires_on_off_loop_mutation():
+    findings = run(GL011_POS, select=["GL011"])
+    assert [f.rule for f in findings] == ["GL011"]
+    assert "pending" in findings[0].message
+    assert "cancel" in findings[0].message
+
+
+def test_gl011_quiet_when_routed_through_call_soon():
+    assert rules_hit(GL011_NEG, select=["GL011"]) == set()
+
+
+def test_gl011_loop_prefix_convention_on_registered_class():
+    assert rules_hit("""
+        class Proto:
+            def __init__(self, io, sock):
+                self._loop_queue = []
+                io.register_message_conn(sock, self._on_msg, None)
+
+            def _on_msg(self, conn, msg):
+                self._loop_queue.append(msg)
+
+            def drop(self):
+                self._loop_queue.clear()
+    """, select=["GL011"]) == {"GL011"}
+
+
+# -- GL012 async callback registered on the loop -----------------------
+
+GL012_POS = """
+    class Proto:
+        def __init__(self, io, sock):
+            self.conn = io.register_message_conn(
+                sock, self._on_msg, self._on_close)
+
+        async def _on_msg(self, conn, msg):
+            pass
+
+        def _on_close(self, conn):
+            pass
+"""
+
+
+def test_gl012_fires_on_async_callback():
+    findings = run(GL012_POS, select=["GL012"])
+    assert [f.rule for f in findings] == ["GL012"]
+    assert "async def" in findings[0].message
+    assert "_on_msg" in findings[0].message
+
+
+def test_gl012_fires_on_awaitable_returning_callback():
+    assert rules_hit("""
+        async def _pump():
+            pass
+
+        def on_msg(conn, msg):
+            return _pump()
+
+        def wire(io, sock):
+            io.register_message_conn(sock, on_msg, None)
+    """, select=["GL012"]) == {"GL012"}
+
+
+def test_gl012_quiet_on_sync_callbacks():
+    assert rules_hit(GL009_NEG, select=["GL012"]) == set()
+
+
+# -- project rules respect suppression & selection ---------------------
+
+def test_project_rule_respects_per_line_disable():
+    src = GL009_POS.replace(
+        "time.sleep(0.5)",
+        "time.sleep(0.5)  # graftlint: disable=GL009")
+    assert rules_hit(src, select=["GL009"]) == set()
+
+
+# -- output formats ----------------------------------------------------
+
+def test_cli_json_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    assert lint.main([str(bad), "--no-baseline",
+                      "--format=json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert isinstance(payload, list) and payload
+    rec = payload[0]
+    assert rec["rule"] == "GL004"
+    assert rec["path"] == str(bad)
+    assert {"line", "col", "message", "scope"} <= set(rec)
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert lint.main([str(good), "--no-baseline",
+                      "--format=json"]) == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+
+def test_cli_github_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n"
+                   "def f(io):\n"
+                   "    io.call_soon(g)\n"
+                   "def g():\n"
+                   "    time.sleep(1)\n")
+    assert lint.main([str(bad), "--no-baseline",
+                      "--format=github"]) == 1
+    out = capsys.readouterr().out
+    assert "::error file=" in out
+    assert "title=graftlint GL009" in out
+    # newlines in messages must be %0A-escaped per workflow-command rules
+    assert "\n::error" in out or out.startswith("::error")
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert lint.main([str(good), "--no-baseline",
+                      "--format=github"]) == 0
+    assert "::notice" in capsys.readouterr().out

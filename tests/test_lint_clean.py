@@ -47,3 +47,31 @@ def test_baseline_has_no_stale_entries():
     assert not stale, (
         "baseline entries with no matching finding (regenerate with "
         f"--write-baseline to shrink the budget): {sorted(stale)}")
+
+
+def test_threadguard_rules_registered():
+    """The interprocedural rule family must be loaded by the plain
+    package import (no side-door registration)."""
+    assert {"GL009", "GL010", "GL011", "GL012"} <= set(lint.RULES)
+
+
+def test_no_unbaselined_threadguard_findings():
+    """Acceptance gate: GL009-GL012 over ray_tpu/ produce zero findings
+    beyond the baseline — every loop-thread path either complies or
+    carries a justified per-line disable."""
+    package = os.path.join(REPO_ROOT, "ray_tpu")
+    findings = [f for f in lint.lint_paths(
+                    [package], select=["GL009", "GL010", "GL011", "GL012"])]
+    baseline = lint.load_baseline(
+        os.path.join(REPO_ROOT, lint.BASELINE_DEFAULT))
+    fresh = lint.apply_baseline(findings, baseline)
+    assert not fresh, (
+        "unbaselined loop-safety findings:\n"
+        + "\n".join(f"  {f}" for f in fresh))
+
+
+def test_devtools_check_lint_step():
+    """The one-shot gate's lint step agrees with this test module."""
+    from ray_tpu.devtools import check
+    status, detail = check.step_lint()
+    assert status == "ok", detail

@@ -1,0 +1,140 @@
+"""Runtime half of threadguard: @loop_only affinity assertion, the
+loop-stall watchdog, and the zero-overhead-when-disabled contract.
+
+The decorator checks RAY_TPU_THREADGUARD at *decoration* time, so the
+enabled-path tests set the env var first and then define their classes
+(and build private IOLoop instances, so the watchdog attaches).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.devtools import threadguard
+
+
+# -- disabled by default: plain functions ------------------------------
+
+def test_disabled_decorator_is_identity(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_THREADGUARD", raising=False)
+    assert not threadguard.enabled()
+
+    def fn(self):
+        return 42
+
+    assert threadguard.loop_only(fn) is fn
+    assert threadguard.loop_only(loop_attr="conn._loop")(fn) is fn
+    assert fn._tg_loop_only is True  # static marker still applied
+
+
+def test_loop_owned_is_declarative_and_merges_bases():
+    @threadguard.loop_owned("a", "b")
+    class Base:
+        pass
+
+    @threadguard.loop_owned("c")
+    class Child(Base):
+        pass
+
+    assert Base._tg_loop_owned == frozenset({"a", "b"})
+    assert Child._tg_loop_owned == frozenset({"a", "b", "c"})
+    # no runtime wrapping: attribute access stays plain
+    Child().a = 1
+
+
+# -- enabled: affinity enforcement -------------------------------------
+
+@pytest.fixture
+def private_loop(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_THREADGUARD", "1")
+    monkeypatch.setenv("RAY_TPU_THREADGUARD_STALL_S", "0.1")
+    threadguard.reset()
+    from ray_tpu.core.io_loop import IOLoop
+    loop = IOLoop(name="rtpu-io-loop-tgtest")
+    yield loop
+    loop.stop()
+    threadguard.reset()
+
+
+def test_loop_only_raises_off_thread_with_diagnostic(private_loop):
+    class Proto:
+        def __init__(self, loop):
+            self._io = loop
+            self.hits = []
+
+        @threadguard.loop_only
+        def _drain(self):
+            self.hits.append(threading.current_thread().name)
+
+    p = Proto(private_loop)
+    with pytest.raises(threadguard.LoopAffinityError) as exc:
+        p._drain()
+    msg = str(exc.value)
+    assert "Proto._drain" in msg
+    assert "rtpu-io-loop-tgtest" in msg           # owning loop thread
+    assert threading.current_thread().name in msg  # offending thread
+    assert "call_soon" in msg                      # remediation hint
+
+    # the same call routed through the loop is fine
+    done = threading.Event()
+    private_loop.call_soon(lambda: (p._drain(), done.set()))
+    assert done.wait(5.0)
+    assert p.hits == ["rtpu-io-loop-tgtest"]
+
+
+def test_loop_only_explicit_loop_attr_path(private_loop):
+    class Holder:
+        pass
+
+    class Proto:
+        def __init__(self, loop):
+            self.conn = Holder()
+            self.conn._loop = loop
+
+        @threadguard.loop_only(loop_attr="conn._loop")
+        def _on_msg(self):
+            return "ok"
+
+    p = Proto(private_loop)
+    with pytest.raises(threadguard.LoopAffinityError):
+        p._on_msg()
+
+    # unresolvable loop -> guard passes through rather than guessing
+    q = Proto(private_loop)
+    del q.conn._loop
+    assert q._on_msg() == "ok"
+
+
+# -- enabled: stall watchdog -------------------------------------------
+
+def test_watchdog_reports_blocking_frame(private_loop):
+    """A 300ms+ sleep inside a dispatched callback (vs the 0.1s
+    threshold) must produce a stall report naming the blocking frame."""
+
+    def _slow_handler():
+        time.sleep(0.35)
+
+    private_loop.call_soon(_slow_handler)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not threadguard.stall_reports():
+        time.sleep(0.02)
+    reports = threadguard.stall_reports()
+    assert reports, "watchdog produced no stall report"
+    rep = reports[0]
+    assert rep["thread"] == "rtpu-io-loop-tgtest"
+    assert rep["stalled_s"] >= 0.1
+    # the sampled stack names the blocking frame (the handler sitting
+    # in its sleep), not just the dispatch machinery
+    assert "_slow_handler" in rep["stack"]
+    assert "time.sleep(0.35)" in rep["stack"]
+
+
+def test_watchdog_quiet_for_fast_dispatches(private_loop):
+    done = threading.Event()
+    for _ in range(50):
+        private_loop.call_soon(lambda: None)
+    private_loop.call_soon(done.set)
+    assert done.wait(5.0)
+    time.sleep(0.3)  # several watchdog polls
+    assert threadguard.stall_reports() == []
