@@ -45,6 +45,94 @@ def _sync_toggles() -> tuple:
     return comp, zero1
 
 
+def _pipeline_toggles():
+    """(stages, microbatches, schedule) from the env, or None when the
+    pipeline row is off (stages <= 1). Flags: --pipeline-stages N
+    --microbatches M --schedule 1f1b|gpipe."""
+    stages = int(os.environ.get("RTPU_BENCH_PIPELINE_STAGES", "0") or 0)
+    if stages <= 1:
+        return None
+    microbatches = int(os.environ.get("RTPU_BENCH_MICROBATCHES", "4"))
+    schedule = os.environ.get("RTPU_BENCH_SCHEDULE", "1f1b")
+    return stages, microbatches, schedule
+
+
+def _bench_pipeline(stages, microbatches, schedule):
+    """Pipeline-parallel row: a small layered MLP driven through
+    PipelineRunner (shm activation channels), reporting the measured
+    per-stage bubble against the schedule's theoretical
+    (s-1)/(m+s-1) plus end-to-end rows/s. Runs inside the --inner
+    child so the backend env is already settled."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.train.pipeline import LayeredModel, PipelineRunner
+
+    def model_fns():
+        # closures: stage actors deserialize these by value, no
+        # dependency on the bench module being importable remotely
+        import jax.numpy as jnp
+
+        def apply_layer(p, h):
+            return jnp.tanh(h @ p["w"] + p["b"])
+
+        def loss_fn(out, tgt):
+            return jnp.mean((out - tgt) ** 2)
+
+        return apply_layer, loss_fn
+
+    dim = int(os.environ.get("RTPU_BENCH_PIPELINE_DIM", "64"))
+    steps = int(os.environ.get("RTPU_BENCH_PIPELINE_STEPS", "5"))
+    rng = np.random.RandomState(0)
+    layers = [{"w": rng.randn(dim, dim).astype(np.float32) * 0.3,
+               "b": np.zeros(dim, dtype=np.float32)}
+              for _ in range(max(2 * stages, 2))]
+    batch = 8 * microbatches
+    x = rng.randn(batch, dim).astype(np.float32)
+    y = rng.randn(batch, dim).astype(np.float32)
+
+    ray_tpu.init(num_cpus=max(4, stages + 1),
+                 system_config={"task_max_retries": 0})
+    apply_layer, loss_fn = model_fns()
+    runner = PipelineRunner(
+        LayeredModel(layers, apply_layer, loss_fn),
+        num_stages=stages, num_microbatches=microbatches,
+        schedule=schedule, recv_timeout_s=60.0)
+    try:
+        runner.step(x, y)  # warm: stage-side jit + channel setup
+        bubbles = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            bubbles.append(runner.step(x, y)["bubble"])
+        dt = time.perf_counter() - t0
+        return {
+            "pipeline_stages": stages,
+            "microbatches": microbatches,
+            "schedule": schedule,
+            "bubble_ratio": round(sum(bubbles) / len(bubbles), 4),
+            "theoretical_bubble": round(runner.theoretical_bubble, 4),
+            "tokens_per_sec": round(batch * steps / dt, 1),
+        }
+    finally:
+        runner.shutdown()
+        ray_tpu.shutdown()
+
+
+def _attach_pipeline_row(result: dict) -> None:
+    """Append the pipeline bench row to the JSON dict when the
+    --pipeline-stages toggle is on (never fails the headline bench)."""
+    pipe = _pipeline_toggles()
+    if pipe is None:
+        return
+    try:
+        result["pipeline"] = _bench_pipeline(*pipe)
+    except Exception as e:  # noqa: BLE001 — optional row
+        sys.stderr.write(f"[bench] pipeline row failed: {e!r}\n")
+        result["pipeline"] = {
+            "pipeline_stages": pipe[0], "microbatches": pipe[1],
+            "schedule": pipe[2], "error": str(e)[:300]}
+
+
 def _run_child(args, env, timeout_s):
     """Run a child, return (ok, parsed_json_or_None, diagnostic_str)."""
     try:
@@ -371,9 +459,11 @@ def inner():
     grad_compression, zero1 = _sync_toggles()
 
     if not on_tpu:
-        print(json.dumps(_bench_config(
+        result = _bench_config(
             LlamaConfig.tiny(), 4, 64, 3, devices,
-            grad_compression=grad_compression, zero1=zero1)))
+            grad_compression=grad_compression, zero1=zero1)
+        _attach_pipeline_row(result)
+        print(json.dumps(result))
         return
 
     def model(dim, layers, heads, hidden, ce_chunk):
@@ -444,6 +534,7 @@ def inner():
             _bench_int8_row()
         except Exception as e:  # noqa: BLE001 — optional row
             sys.stderr.write(f"[bench] int8 row failed: {e!r}\n")
+    _attach_pipeline_row(best)
     print(json.dumps(best))
 
 
@@ -480,6 +571,8 @@ if __name__ == "__main__":
     # Toggle flags become env vars so the --inner children (and the CPU
     # fallback child) inherit them:
     #   python bench.py --grad-compression int8 --zero1
+    #   python bench.py --pipeline-stages 3 --microbatches 8 \
+    #       --schedule 1f1b
     _argv = sys.argv[1:]
     for _i, _a in enumerate(_argv):
         if _a.startswith("--grad-compression="):
@@ -489,6 +582,19 @@ if __name__ == "__main__":
             os.environ["RTPU_BENCH_GRAD_COMPRESSION"] = _argv[_i + 1]
         elif _a == "--zero1":
             os.environ["RTPU_BENCH_ZERO1"] = "1"
+        elif _a.startswith("--pipeline-stages="):
+            os.environ["RTPU_BENCH_PIPELINE_STAGES"] = \
+                _a.split("=", 1)[1]
+        elif _a == "--pipeline-stages" and _i + 1 < len(_argv):
+            os.environ["RTPU_BENCH_PIPELINE_STAGES"] = _argv[_i + 1]
+        elif _a.startswith("--microbatches="):
+            os.environ["RTPU_BENCH_MICROBATCHES"] = _a.split("=", 1)[1]
+        elif _a == "--microbatches" and _i + 1 < len(_argv):
+            os.environ["RTPU_BENCH_MICROBATCHES"] = _argv[_i + 1]
+        elif _a.startswith("--schedule="):
+            os.environ["RTPU_BENCH_SCHEDULE"] = _a.split("=", 1)[1]
+        elif _a == "--schedule" and _i + 1 < len(_argv):
+            os.environ["RTPU_BENCH_SCHEDULE"] = _argv[_i + 1]
     if "--inner" in sys.argv:
         inner()
     else:

@@ -1,14 +1,29 @@
-"""Cross-node compiled-graph channels over pre-established TCP.
+"""Cross-node compiled-graph channels over the shared IO loop.
 
 Reference: python/ray/experimental/channel/nccl_group.py:21 — compiled
 DAGs move cross-GPU edges over pre-created NCCL P2P channels, no
 per-call RPC. The TPU-host analog for cross-NODE edges is a dedicated
 worker-to-worker TCP connection per (writer, reader) link, established
-once at compile time: frames are length-prefixed serialized values,
-and capacity semantics come from a credit loop (the reader returns one
-credit byte per consumed item; the writer blocks once ``capacity``
+once at compile time. Frames are length-prefixed serialized values and
+capacity semantics come from a credit loop (the reader returns one
+credit frame per consumed item; the writer blocks once ``capacity``
 items are unacknowledged — the same bounded-buffer backpressure the
 shm ring gives co-located actors).
+
+Both directions ride ``core.io_loop``: the reader's accepted socket
+and every writer connection are registered with the process IO loop,
+whose per-connection codec (native wire.cc, or the pure-Python
+FrameReader fallback when the C toolchain is absent or
+``RAY_TPU_NATIVE_WIRE=0``) parses frames on the loop thread and pushes
+them into the channel's seq-indexed buffer. Blocking stays in the
+CALLER (``read``/``write`` wait on a Condition); no per-connection
+reader thread exists, so an N-channel pipeline keeps the process
+thread topology O(1).
+
+Inbound frames are decoded by hand rather than via
+``register_message_conn``: a frame that fails to deserialize must
+poison the channel (seq assignment is positional — skipping a frame
+would silently shift every later value), not be logged and dropped.
 
 Interface-compatible with dag.channel.ChannelWriter/ChannelReader
 (write(value, seq) / read(seq) / ack(seq)): TCP ordering makes the
@@ -19,39 +34,30 @@ from __future__ import annotations
 
 import logging
 import socket
-import struct
 import threading
-
-from ray_tpu.devtools import locktrace
-from typing import Any, Dict, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
+from ray_tpu.core.io_loop import get_io_loop
 from ray_tpu.dag.channel import ChannelTimeoutError
+from ray_tpu.devtools import locktrace
 
 logger = logging.getLogger(__name__)
 
-_LEN = struct.Struct("<I")
-
-
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
-    chunks = []
-    while n:
-        try:
-            chunk = sock.recv(n)
-        except OSError:
-            return None
-        if not chunk:
-            return None
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+_CREDIT = b"\x01"  # one credit frame, reader -> writer, per ack
 
 
 class TcpChannelListener:
     """Reader-side endpoint, created BEFORE the writer connects.
 
-    One listener per (channel, reader); accept() runs lazily on first
-    read so install order can't deadlock."""
+    One listener per (channel, reader). The bound socket is registered
+    with the IO loop immediately, so the writer's connect is accepted
+    (and its frames buffered) even if the reader hasn't issued a read
+    yet — install order can't deadlock. The listener owns all receive
+    state; TcpChannelReader is a thin view over it, which lets
+    ``create_listener``/``adopt_listener`` split endpoint creation from
+    reader construction across __ray_call__ steps."""
 
     def __init__(self, host: Optional[str] = None):
         import os
@@ -68,179 +74,209 @@ class TcpChannelListener:
                     or socket.gethostbyname(socket.gethostname()))
         self.address: Tuple[str, int] = (host,
                                          self._sock.getsockname()[1])
-        self._conn: Optional[socket.socket] = None
-        self._lock = locktrace.traced_lock("dag.tcp_channel")
+        self._cond = threading.Condition()
+        self._values: Dict[int, Any] = {}
+        self._next_seq = 0
+        self._conn = None  # LoopConnection once the writer connects
+        self._error: Optional[str] = None
+        self._closed = False
+        self._loop_listener = get_io_loop().register_listener(
+            self._sock, self._on_accept,
+            label=f"dag.tcp_channel:{self.address[1]}")
 
-    def _ensure_accepted(self, timeout: Optional[float]) -> socket.socket:
-        # accept() can block for the full timeout — do it OUTSIDE the
-        # lock so close() (and locktrace) never stall behind a reader
-        # waiting for a writer that hasn't connected yet
-        with self._lock:
-            if self._conn is not None:
-                return self._conn
-            listening = self._sock
-        listening.settimeout(timeout)
+    # -------------------------------------------- loop-thread handlers
+
+    def _on_accept(self, sock: socket.socket, addr) -> None:
+        with self._cond:
+            stale = self._closed or self._conn is not None
+        if stale:
+            # single-writer channel: drop stray connections
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = get_io_loop().register(
+            sock, self._on_frames, self._on_close,
+            label=f"dag.tcp_channel.reader:{self.address[1]}")
+        with self._cond:
+            self._conn = conn
+            self._cond.notify_all()
+
+    def _on_frames(self, conn, frames) -> None:
+        with self._cond:
+            for frame in frames:
+                try:
+                    value = serialization.loads(frame)
+                except Exception:
+                    logger.exception(
+                        "tcp channel: undecodable frame at seq %d",
+                        self._next_seq)
+                    self._error = (f"tcp channel frame decode failed at "
+                                   f"seq {self._next_seq}")
+                    break
+                self._values[self._next_seq] = value
+                self._next_seq += 1
+            self._cond.notify_all()
+
+    def _on_close(self, conn) -> None:
+        with self._cond:
+            if self._error is None and not self._closed:
+                self._error = "tcp channel writer closed"
+            self._cond.notify_all()
+
+    # ------------------------------------------------------ caller API
+
+    def _read(self, seq: int, timeout: Optional[float]) -> Any:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._cond:
+            # values buffered before EOF/teardown stay readable: only
+            # consult the error state when the seq hasn't arrived
+            while seq not in self._values:
+                if self._error is not None:
+                    raise ChannelTimeoutError(self._error)
+                if self._closed:
+                    raise ChannelTimeoutError("tcp channel reader closed")
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise ChannelTimeoutError(
+                        f"tcp channel read timed out at seq {seq}")
+                self._cond.wait(remaining)
+            return self._values[seq]
+
+    def _ack(self, seq: int) -> None:
+        with self._cond:
+            self._values.pop(seq, None)
+            conn = self._conn
+        if conn is None or conn.closed:
+            return  # writer gone (teardown): nothing to backpressure
         try:
-            conn, _ = listening.accept()
-        except (socket.timeout, OSError):
-            raise ChannelTimeoutError(
-                "tcp channel writer never connected")
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        with self._lock:
-            if self._conn is None:
-                self._conn = conn
-                return conn
-        # lost the (single-writer, so improbable) accept race: keep the
-        # established connection, drop ours
-        try:
-            conn.close()
+            conn.send_frame(_CREDIT)
         except OSError:
-            logger.debug("stray accepted connection close failed",
-                         exc_info=True)
-        with self._lock:
-            return self._conn
+            pass
 
     def close(self) -> None:
-        with self._lock:
-            for s in (self._conn, self._sock):
-                if s is not None:
-                    try:
-                        s.close()
-                    except OSError:
-                        pass
-            self._conn = None
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            conn = self._conn
+            self._cond.notify_all()
+        if conn is not None:
+            conn.close()
+        self._loop_listener.close(wait=False)
 
 
 class TcpChannelReader:
     """read(seq)/ack(seq) over the accepted connection.
 
-    Frames arrive in the writer's seq order; a seq-indexed buffer makes
-    reads ADDRESSABLE like the shm ring: out-of-order ``get()``s return
-    the right execution's value, and a timed-out read leaves the seq
-    re-readable (incoming bytes accumulate across calls — a partial
-    frame is never lost to a timeout). ``ack`` drops the buffered value
-    and returns one credit."""
+    Frames arrive in the writer's seq order; the listener's seq-indexed
+    buffer makes reads ADDRESSABLE like the shm ring: out-of-order
+    ``get()``s return the right execution's value, and a timed-out read
+    leaves the seq re-readable (the loop keeps delivering frames while
+    the caller is away). ``ack`` drops the buffered value and returns
+    one credit frame."""
 
     owned_reads = True  # deserialization yields owned objects: the
     # compiled loop may skip its defensive copy
 
     def __init__(self, listener: TcpChannelListener):
         self._listener = listener
-        self._rx = bytearray()
-        self._values: Dict[int, Any] = {}
-        self._next_seq = 0
-
-    def _pump(self, conn, timeout: Optional[float]) -> bool:
-        """Receive once, parse any completed frames; False on timeout."""
-        conn.settimeout(timeout)
-        try:
-            chunk = conn.recv(1 << 20)
-        except socket.timeout:
-            return False
-        except OSError:
-            raise ChannelTimeoutError("tcp channel connection lost")
-        if not chunk:
-            raise ChannelTimeoutError("tcp channel writer closed")
-        self._rx += chunk
-        while len(self._rx) >= _LEN.size:
-            (length,) = _LEN.unpack_from(self._rx)
-            end = _LEN.size + length
-            if len(self._rx) < end:
-                break
-            payload = bytes(self._rx[_LEN.size:end])
-            del self._rx[:end]
-            self._values[self._next_seq] = serialization.loads(payload)
-            self._next_seq += 1
-        return True
 
     def read(self, seq: int, timeout: Optional[float] = 60.0) -> Any:
-        import time as _time
-        conn = self._listener._ensure_accepted(timeout)
-        deadline = (None if timeout is None
-                    else _time.monotonic() + timeout)
-        while seq not in self._values:
-            remaining = (None if deadline is None
-                         else deadline - _time.monotonic())
-            if remaining is not None and remaining <= 0:
-                raise ChannelTimeoutError(
-                    f"tcp channel read timed out at seq {seq}")
-            if not self._pump(conn, remaining):
-                raise ChannelTimeoutError(
-                    f"tcp channel read timed out at seq {seq}")
-        return self._values[seq]
+        return self._listener._read(seq, timeout)
 
     def ack(self, seq: int) -> None:
-        self._values.pop(seq, None)
-        conn = self._listener._ensure_accepted(None)
-        try:
-            conn.sendall(b"\x01")  # one credit back to the writer
-        except OSError:
-            pass  # writer gone (teardown): nothing to backpressure
+        self._listener._ack(seq)
 
     def close(self) -> None:
         self._listener.close()
 
 
+class _WriterLink:
+    """One writer->reader connection plus its credit window. Credits
+    are incremented by the loop thread (one per inbound frame) and
+    consumed by ``write`` under the shared writer Condition."""
+
+    __slots__ = ("conn", "credits", "closed")
+
+    def __init__(self, capacity: int):
+        self.conn = None
+        self.credits = capacity
+        self.closed = False
+
+
 class TcpChannelWriter:
-    """Writer-side fan-out: one connection per remote reader, with a
-    per-reader credit window of ``capacity``."""
+    """Writer-side fan-out: one loop-registered connection per remote
+    reader, with a per-reader credit window of ``capacity``."""
 
     def __init__(self, endpoints, capacity: int,
                  connect_timeout: float = 30.0):
-        self._conns = []
-        self._credits = []
         self._capacity = capacity
-        for host, port in endpoints:
+        self._cond = threading.Condition()
+        self._links: List[_WriterLink] = []
+        loop = get_io_loop()
+        for i, (host, port) in enumerate(endpoints):
             sock = socket.create_connection((host, port),
                                             timeout=connect_timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(sock)
-            self._credits.append(capacity)
+            link = _WriterLink(capacity)
+            link.conn = loop.register(
+                sock, self._on_credits(link), self._on_close(link),
+                label=f"dag.tcp_channel.writer:{host}:{port}")
+            self._links.append(link)
+
+    def _on_credits(self, link: _WriterLink):
+        def handler(conn, frames):
+            with self._cond:
+                link.credits += len(frames)
+                self._cond.notify_all()
+        return handler
+
+    def _on_close(self, link: _WriterLink):
+        def handler(conn):
+            with self._cond:
+                link.closed = True
+                self._cond.notify_all()
+        return handler
 
     def write(self, value: Any, seq: int,
               timeout: Optional[float] = 60.0) -> None:
         payload = serialization.dumps(value)
-        frame = _LEN.pack(len(payload)) + payload
-        for i, conn in enumerate(self._conns):
-            # consume acks to refill the credit window; block when empty
-            conn.settimeout(timeout)
-            while self._credits[i] <= 0:
-                try:
-                    acks = conn.recv(4096)
-                except socket.timeout:
-                    raise ChannelTimeoutError(
-                        f"tcp channel writer blocked at seq {seq}: "
-                        f"reader {i} not consuming")
-                except OSError:
-                    raise ChannelTimeoutError(
-                        f"tcp channel reader {i} disconnected")
-                if not acks:
+        for i, link in enumerate(self._links):
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            with self._cond:
+                # block until the reader returns a credit; a dead link
+                # must error promptly, not run out the timeout
+                while link.credits <= 0:
+                    if link.closed:
+                        raise ChannelTimeoutError(
+                            f"tcp channel reader {i} closed")
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise ChannelTimeoutError(
+                            f"tcp channel writer blocked at seq {seq}: "
+                            f"reader {i} not consuming")
+                    self._cond.wait(remaining)
+                if link.closed:
                     raise ChannelTimeoutError(
                         f"tcp channel reader {i} closed")
-                self._credits[i] += len(acks)
-            # drain any queued acks opportunistically (non-blocking)
-            conn.setblocking(False)
+                link.credits -= 1
             try:
-                acks = conn.recv(4096)
-                if acks:
-                    self._credits[i] += len(acks)
-            except (BlockingIOError, OSError):
-                pass
-            conn.setblocking(True)
-            conn.settimeout(timeout)
-            try:
-                conn.sendall(frame)
+                link.conn.send_frame(payload)
             except OSError:
                 raise ChannelTimeoutError(
                     f"tcp channel send failed to reader {i}")
-            self._credits[i] -= 1
 
     def close(self) -> None:
-        for conn in self._conns:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        for link in self._links:
+            if link.conn is not None:
+                link.conn.close()
 
 
 # process-global registry: listeners created during the pre-install

@@ -189,8 +189,43 @@ def step_stress() -> Tuple[str, str]:
     return "ok", "; ".join(notes)
 
 
+def step_pipeline() -> Tuple[str, str]:
+    """Pipeline-schedule smoke: golden-validate the static 1F1B/GPipe
+    instruction lists over a spread of (stages, microbatches) shapes.
+    Pure scheduler math — no actors, no channels, no jax."""
+    try:
+        from ray_tpu.train.pipeline import schedule as sched
+    except Exception as exc:
+        return "FAIL", f"pipeline schedule import failed: {exc!r}"
+    shapes = [(2, 2), (2, 8), (3, 4), (3, 8), (4, 4), (4, 16), (6, 6),
+              (8, 32)]
+    checked = 0
+    for stages, microbatches in shapes:
+        for name in sched.SCHEDULES:
+            try:
+                sched.validate_schedule(stages, microbatches, name)
+                # 1F1B must never hold more activations than warmup
+                # depth; GPipe holds all M during fill
+                bound = (sched.warmup_depth(0, stages, microbatches)
+                         if name == "1f1b" else microbatches)
+                worst = max(
+                    sched.max_in_flight(sched.stage_schedule(
+                        s, stages, microbatches, name))
+                    for s in range(stages))
+                if worst > bound:
+                    return "FAIL", (
+                        f"{name} (s={stages}, m={microbatches}): "
+                        f"max in-flight {worst} exceeds bound {bound}")
+            except Exception as exc:
+                return "FAIL", (f"{name} (s={stages}, "
+                                f"m={microbatches}): {exc!r}")
+            checked += 1
+    return "ok", f"{checked} schedule shapes validated"
+
+
 _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("lint", step_lint),
+    ("pipeline", step_pipeline),
     ("locktrace", step_locktrace),
     ("threadguard", step_threadguard),
     ("stress", step_stress),

@@ -1,0 +1,87 @@
+"""Version compatibility shims for the ``jax.shard_map`` entry point.
+
+``shard_map`` has moved twice across jax releases: it started life at
+``jax.experimental.shard_map.shard_map``, was promoted to
+``jax.sharding.shard_map`` and finally re-exported as
+``jax.shard_map``. Along the way the replication-checking kwarg was
+renamed ``check_rep`` → ``check_vma``. Importing from a fixed location
+therefore breaks test *collection* on whichever jax the image has.
+
+This module feature-detects the location once at import time and
+exposes:
+
+- ``shard_map(fn, *, mesh, in_specs, out_specs, check_vma=None)`` — a
+  thin wrapper that translates the checking kwarg to whatever the
+  resident jax spells it, or ``None`` when no jax on the path provides
+  a shard_map at all;
+- ``SHARD_MAP_AVAILABLE`` / ``SHARD_MAP_UNAVAILABLE_REASON`` — for
+  tests to ``pytest.mark.skipif`` with a reason instead of erroring at
+  collection.
+
+Callers inside ``ray_tpu`` should use :func:`require_shard_map` which
+raises a descriptive ``RuntimeError`` at *call* time (module import
+always succeeds).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Optional
+
+_raw_shard_map: Optional[Callable[..., Any]] = None
+SHARD_MAP_UNAVAILABLE_REASON = ""
+
+try:
+    from jax import shard_map as _raw_shard_map  # type: ignore[attr-defined]
+except ImportError:
+    try:
+        from jax.sharding import shard_map as _raw_shard_map  # type: ignore
+    except ImportError:
+        try:
+            from jax.experimental.shard_map import (  # type: ignore
+                shard_map as _raw_shard_map)
+        except ImportError as exc:
+            _raw_shard_map = None
+            SHARD_MAP_UNAVAILABLE_REASON = (
+                "no shard_map in this jax: tried jax.shard_map, "
+                f"jax.sharding.shard_map, jax.experimental.shard_map ({exc})")
+
+SHARD_MAP_AVAILABLE = _raw_shard_map is not None
+
+# kwarg rename: old spelling check_rep, new spelling check_vma.
+_CHECK_KWARG: Optional[str] = None
+if _raw_shard_map is not None:
+    try:
+        _params = inspect.signature(_raw_shard_map).parameters
+        if "check_vma" in _params:
+            _CHECK_KWARG = "check_vma"
+        elif "check_rep" in _params:
+            _CHECK_KWARG = "check_rep"
+    except (TypeError, ValueError):  # C-accelerated / no signature
+        _CHECK_KWARG = "check_rep"
+
+
+def shard_map(fn: Callable[..., Any], *, mesh: Any, in_specs: Any,
+              out_specs: Any,
+              check_vma: Optional[bool] = None) -> Callable[..., Any]:
+    """Portable ``shard_map`` across jax versions.
+
+    ``check_vma`` follows the newest spelling; it is translated to
+    ``check_rep`` on older jax. ``None`` omits the kwarg entirely.
+    """
+    if _raw_shard_map is None:
+        raise RuntimeError(
+            "shard_map is unavailable: " + SHARD_MAP_UNAVAILABLE_REASON)
+    kwargs: dict = {}
+    if check_vma is not None and _CHECK_KWARG is not None:
+        kwargs[_CHECK_KWARG] = check_vma
+    return _raw_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, **kwargs)
+
+
+def require_shard_map() -> None:
+    """Raise a descriptive error when shard_map is missing."""
+    if _raw_shard_map is None:
+        raise RuntimeError(
+            "this operation needs jax shard_map, which is unavailable: "
+            + SHARD_MAP_UNAVAILABLE_REASON)

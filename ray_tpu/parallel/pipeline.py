@@ -40,7 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ray_tpu.parallel._compat import require_shard_map, shard_map
 
 
 def _pipeline_local(params, x_local, *, fn, axis_name: str,
@@ -110,6 +111,7 @@ def pipeline(fn: Callable[[Any, jax.Array], jax.Array], stage_params: Any,
     strided input sharding is even). ``remat``: checkpoint the stage fn
     for training (backward recomputes within-stage activations).
     """
+    require_shard_map()
     n_stages = mesh.shape[axis_name]
     if x.shape[0] % num_microbatches:
         raise ValueError(

@@ -62,16 +62,23 @@ def allreduce_gradients(grads, op: str = "mean",
     ctx = get_context()
     if compression is None:
         compression = getattr(ctx, "grad_compression", None)
+    # under pipeline parallelism, sync within the per-stage group:
+    # only the replicas of THIS stage hold these parameters
+    group = _sync_group(ctx)
     import jax
     flat, treedef = jax.tree_util.tree_flatten(grads)
     reduced = [
         collective.allreduce(np.asarray(leaf), op=op,
-                             group_name=ctx.group_name,
+                             group_name=group,
                              compression=compression,
                              ef_key=f"grad/{i}" if compression else None)
         for i, leaf in enumerate(flat)
     ]
     return jax.tree_util.tree_unflatten(treedef, reduced)
+
+
+def _sync_group(ctx) -> str:
+    return getattr(ctx, "stage_group_name", None) or ctx.group_name
 
 
 def _flatten_to_vector(tree):
@@ -107,7 +114,7 @@ class DDPOptimizer:
                  group_name: Optional[str] = None):
         self.optimizer = optimizer
         self.grad_compression = grad_compression
-        self.group_name = group_name or get_context().group_name
+        self.group_name = group_name or _sync_group(get_context())
         self._opt_state = optimizer.init(params)
 
     def optimizer_state_bytes(self) -> int:
@@ -155,7 +162,7 @@ class Zero1Optimizer:
                  group_name: Optional[str] = None):
         self.optimizer = optimizer
         self.grad_compression = grad_compression
-        self.group_name = group_name or get_context().group_name
+        self.group_name = group_name or _sync_group(get_context())
         self.world = collective.get_collective_group_size(self.group_name)
         self.rank = collective.get_rank(self.group_name)
         vec, _, _, _ = _flatten_to_vector(params)
@@ -205,7 +212,7 @@ def make_optimizer(optimizer, params, *,
         if grad_compression is None:
             grad_compression = getattr(ctx, "grad_compression", None)
         if group_name is None:
-            group_name = ctx.group_name
+            group_name = _sync_group(ctx)
     cls = Zero1Optimizer if zero1 else DDPOptimizer
     return cls(optimizer, params, grad_compression=grad_compression,
                group_name=group_name)

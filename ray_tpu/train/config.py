@@ -42,6 +42,15 @@ class ScalingConfig:
     # TrainContext by allreduce_gradients()/make_optimizer().
     grad_compression: Optional[str] = None
     zero1: bool = False
+    # Pipeline parallelism (train/pipeline): stages per replica,
+    # microbatch count, and schedule ("1f1b" | "gpipe"). num_workers
+    # must be divisible by pipeline_stages; rank -> (stage = rank %
+    # pipeline_stages, replica = rank // pipeline_stages), and DDP /
+    # ZeRO-1 gradient sync runs within each stage's cross-replica
+    # group instead of the whole-world group.
+    pipeline_stages: int = 1
+    microbatches: int = 1
+    schedule: str = "1f1b"
 
     def resolved_scaling_policy(self):
         if self.scaling_policy is not None:

@@ -46,7 +46,10 @@ class TrainContext:
                  datasets: Optional[Dict[str, Any]] = None,
                  group_name: str = "train",
                  grad_compression: Optional[str] = None,
-                 zero1: bool = False):
+                 zero1: bool = False, pipeline_stages: int = 1,
+                 microbatches: int = 1, schedule: str = "1f1b",
+                 pipeline_stage: int = 0, pipeline_replica: int = 0,
+                 stage_group_name: Optional[str] = None):
         self.world_size = world_size
         self.world_rank = world_rank
         self.storage_path = storage_path
@@ -57,6 +60,15 @@ class TrainContext:
         # train.collective.allreduce_gradients / make_optimizer
         self.grad_compression = grad_compression
         self.zero1 = zero1
+        # pipeline topology (ScalingConfig.pipeline_stages > 1): this
+        # worker's stage/replica, plus the cross-replica per-stage
+        # collective group that gradient sync scopes itself to
+        self.pipeline_stages = pipeline_stages
+        self.microbatches = microbatches
+        self.schedule = schedule
+        self.pipeline_stage = pipeline_stage
+        self.pipeline_replica = pipeline_replica
+        self.stage_group_name = stage_group_name
         self.reported: list = []
         self.pending_checkpoint_dirs: list = []
         self._lock = locktrace.traced_lock("train.context")
@@ -70,6 +82,15 @@ class TrainContext:
 
     def get_local_rank(self) -> int:
         return self.world_rank  # one worker per host in this runtime
+
+    def get_pipeline_stage(self) -> int:
+        return self.pipeline_stage
+
+    def sync_group_name(self) -> str:
+        """The group gradient sync should run in: the per-stage
+        cross-replica group under pipeline parallelism (replicas of the
+        SAME stage hold the same parameters), the run group otherwise."""
+        return self.stage_group_name or self.group_name
 
     def get_experiment_name(self) -> str:
         return self.storage_path.rsplit("/", 1)[-1]

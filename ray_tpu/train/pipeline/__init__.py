@@ -1,0 +1,52 @@
+"""MPMD pipeline-parallel training over the compiled DAG.
+
+Grounding: "Scaling Deep Learning Training with MPMD Pipeline
+Parallelism" (arXiv 2412.14374) for the stage/schedule split, and
+"Memory-efficient array redistribution through portable collective
+communication" (arXiv 2112.01075) for the stage-boundary reshard.
+
+The subsystem has four layers:
+
+- :mod:`schedule` — static per-stage instruction lists (1F1B and the
+  GPipe fill-drain fallback) plus the bubble-fraction math; pure
+  Python, golden-testable without actors.
+- :mod:`partition` — splits a layered model into contiguous stage
+  slices balanced by parameter count, and builds each stage's
+  fwd/bwd closures.
+- :mod:`reshard` — the boundary all-gather→slice used when adjacent
+  stages disagree on intra-stage sharding, expressed over the host
+  collective primitives.
+- :mod:`executor` — stage actors and the driver-side
+  :class:`PipelineRunner` that compiles them into one DAG; forward
+  activations and backward grads stream stage-to-stage over
+  bounded-capacity channels (shm or TCP), providing backpressure.
+
+Selected from the trainer via ``ScalingConfig(pipeline_stages=N,
+microbatches=M, schedule="1f1b")`` — see ``train/trainer.py``.
+"""
+
+from ray_tpu.train.pipeline.schedule import (  # noqa: F401
+    Instruction,
+    bubble_fraction,
+    build_schedule,
+    stage_schedule,
+    validate_schedule,
+)
+from ray_tpu.train.pipeline.partition import (  # noqa: F401
+    LayeredModel,
+    StagePlan,
+    balanced_ranges,
+    partition_model,
+)
+from ray_tpu.train.pipeline.reshard import reshard_boundary  # noqa: F401
+from ray_tpu.train.pipeline.executor import (  # noqa: F401
+    PipelineRunner,
+    PipelineStage,
+)
+
+__all__ = [
+    "Instruction", "bubble_fraction", "build_schedule", "stage_schedule",
+    "validate_schedule", "LayeredModel", "StagePlan", "balanced_ranges",
+    "partition_model", "reshard_boundary", "PipelineRunner",
+    "PipelineStage",
+]

@@ -1,0 +1,507 @@
+"""Pipeline stage actors + the driver-side runner.
+
+Execution shape: every stage is one actor; the compiled DAG fans the
+step input out to all stages (``MultiOutputNode`` collects every
+stage's per-step report), so the per-actor resident loops run
+concurrently. Within a step, each stage replays its static
+instruction list (``schedule.py``); forward activations and backward
+grads do NOT ride the DAG edges — they stream stage-to-stage over
+dedicated bounded-capacity channels (``dag/channel.py`` shm rings on
+one node, ``dag/tcp_channel.py`` native-wire links across nodes), so
+channel capacity is the pipeline's backpressure bound.
+
+Failure semantics: a stage raising mid-step becomes an
+``_ErrorToken`` in its output channel; ``CompiledDAGRef.get()``
+raises ``DAGExecutionError`` whose message names the stage. Peers
+blocked on the dead stage's channels time out with a
+``PipelineStallError`` (also naming themselves), so the DAG never
+wedges silently.
+
+Data-parallel composition: replicas of the same stage form one
+collective group ("stage group"); at ``STEP`` the accumulated
+gradient is allreduce-averaged over that group (optionally block-
+quantized via ``grad_compression``) before the local update — the
+DDP×pipeline shape of the trainer's ``ScalingConfig``.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.dag.channel import ChannelSpec, ChannelTimeoutError
+from ray_tpu.train.pipeline import schedule as sched_mod
+from ray_tpu.train.pipeline.partition import (
+    LayeredModel, StagePlan, partition_model, stitch_params)
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+logger = logging.getLogger(__name__)
+
+_STEP_BOUNDS = [0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0]
+
+PIPELINE_BUBBLE = Gauge(
+    "ray_tpu_train_pipeline_bubble_ratio",
+    "Measured per-stage pipeline bubble (1 - compute/wall) for the "
+    "last step", tag_keys=("stage", "schedule"))
+STAGE_STEP_SECONDS = Histogram(
+    "ray_tpu_train_pipeline_stage_step_seconds",
+    "Per-instruction compute time by stage and schedule phase",
+    boundaries=_STEP_BOUNDS, tag_keys=("stage", "phase"))
+ACTIVATION_BYTES = Counter(
+    "ray_tpu_train_pipeline_activation_bytes_total",
+    "Bytes moved over pipeline stage-boundary channels",
+    tag_keys=("edge",))
+
+
+class PipelineStallError(RuntimeError):
+    """A stage timed out waiting on an adjacent stage's channel."""
+
+
+def _tree_add(a, b):
+    import jax
+    return jax.tree_util.tree_map(lambda x, y: x + y, a, b)
+
+
+def _tree_scale(t, s):
+    import jax
+    return jax.tree_util.tree_map(lambda x: x * s, t)
+
+
+class PipelineStage:
+    """One MPMD stage: owns its layer slice, optimizer state, and the
+    four channel endpoints (fwd in/out, grad in/out)."""
+
+    def __init__(self, config_blob: bytes):
+        from ray_tpu.core import serialization
+        cfg = serialization.loads(config_blob)
+        self.stage_id: int = cfg["stage_id"]
+        self.num_stages: int = cfg["num_stages"]
+        self.num_microbatches: int = cfg["num_microbatches"]
+        self.schedule_name: str = cfg["schedule"]
+        self.lr: float = cfg["lr"]
+        self.recv_timeout_s: float = cfg["recv_timeout_s"]
+        self.plan: StagePlan = cfg["plan"]
+        self._apply_layer = cfg["apply_layer"]
+        self._loss_fn = cfg["loss_fn"]
+        self.grad_compression: Optional[str] = cfg.get("grad_compression")
+        self._dp: Optional[Tuple[str, int, int]] = cfg.get("dp")
+        self._instrs = sched_mod.stage_schedule(
+            self.stage_id, self.num_stages, self.num_microbatches,
+            self.schedule_name)
+        import jax.numpy as jnp
+        self.params = [
+            __import__("jax").tree_util.tree_map(jnp.asarray, lp)
+            for lp in self.plan.layer_params]
+        # channel endpoints, bound in connect_channels()
+        self._fwd_in = self._fwd_out = None
+        self._grad_in = self._grad_out = None
+        self._adopt_tokens: Dict[str, str] = {}
+        self._step_idx = 0
+        self._fail_next = False
+        if self._dp is not None:
+            group_name, dp_world, dp_rank = self._dp
+            from ray_tpu.parallel import collective
+            collective.init_collective_group(dp_world, dp_rank,
+                                             group_name)
+
+    # -- channel wiring (driver-orchestrated, pre-compile) -------------
+    def pipe_create_listener(self, token: str):
+        """TCP transport: bind this stage's reader-side listener and
+        return its address (driver hands it to the writing peer)."""
+        from ray_tpu.dag.tcp_channel import create_listener
+        return create_listener(token)
+
+    def connect_channels(self, endpoints: Dict[str, Any]) -> bool:
+        """Bind channel endpoints. Each entry is either
+        ``("shm", ChannelSpec, reader_idx_or_None)`` or
+        ``("tcp_reader", token)`` / ``("tcp_writer", [addr], cap)``.
+        Keys: fwd_in, fwd_out, grad_in, grad_out (absent at the
+        pipeline's ends)."""
+        from ray_tpu.dag.channel import ChannelReader, ChannelWriter
+
+        def build(entry, reading: bool):
+            kind = entry[0]
+            if kind == "shm":
+                spec: ChannelSpec = entry[1]
+                return (ChannelReader(spec, entry[2]) if reading
+                        else ChannelWriter(spec))
+            if kind == "tcp_reader":
+                # defer adoption to the run loop: the listener was
+                # created in this process and adopt is process-local
+                self._adopt_tokens[entry[1]] = entry[1]
+                return ("tcp_pending", entry[1])
+            from ray_tpu.dag.tcp_channel import TcpChannelWriter
+            return TcpChannelWriter(list(entry[1]), entry[2])
+
+        self._fwd_in = (build(endpoints["fwd_in"], True)
+                        if "fwd_in" in endpoints else None)
+        self._fwd_out = (build(endpoints["fwd_out"], False)
+                         if "fwd_out" in endpoints else None)
+        self._grad_in = (build(endpoints["grad_in"], True)
+                         if "grad_in" in endpoints else None)
+        self._grad_out = (build(endpoints["grad_out"], False)
+                          if "grad_out" in endpoints else None)
+        return True
+
+    def _adopt(self, endpoint):
+        if (isinstance(endpoint, tuple)
+                and endpoint[0] == "tcp_pending"):
+            from ray_tpu.dag.tcp_channel import adopt_listener
+            return adopt_listener(endpoint[1])
+        return endpoint
+
+    # -- test hooks ----------------------------------------------------
+    def fail_next_step(self) -> bool:
+        """Inject a mid-step stage death on the next run_step."""
+        self._fail_next = True
+        return True
+
+    def get_params(self):
+        """Stage params as numpy trees (callable only while the actor
+        is NOT parked in a compiled-DAG loop — i.e. after teardown; the
+        in-band path is the runner's ``fetch_params``)."""
+        import jax
+        return [jax.tree_util.tree_map(np.asarray, lp)
+                for lp in self.params]
+
+    # -- the per-step instruction interpreter --------------------------
+    def run_step(self, batch):
+        """Execute this stage's full instruction list for one step.
+        ``batch`` = ("step", x, y): stage 0 consumes x, the last stage
+        y. The actor is parked inside the compiled-DAG resident loop,
+        so control-plane requests ride the same channel as steps:
+        ("fetch", None, None) returns this stage's params in-band.
+        Returns the stage's step report dict."""
+        import jax
+
+        cmd, x, y = batch
+        if cmd == "fetch":
+            return {"stage": self.stage_id, "params": self.get_params()}
+        if cmd == "fail":
+            # test hook riding the DAG: arm a mid-step death for the
+            # next ("step", ...) on the targeted stage
+            if int(x) == self.stage_id:
+                self._fail_next = True
+            return {"stage": self.stage_id, "armed": self._fail_next}
+        self._fwd_in = self._adopt(self._fwd_in)
+        self._grad_in = self._adopt(self._grad_in)
+        m = self.num_microbatches
+        sid = self.stage_id
+        x_mbs = (np.array_split(np.asarray(x), m, axis=0)
+                 if self.plan.is_first else [None] * m)
+        y_mbs = (np.array_split(np.asarray(y), m, axis=0)
+                 if self.plan.is_last else [None] * m)
+
+        recv_act: Dict[int, Any] = {}
+        recv_grad: Dict[int, Any] = {}
+        outputs: Dict[int, Any] = {}
+        pullbacks: Dict[int, Any] = {}
+        grads_accum = None
+        loss_sum = 0.0
+        live = peak_live = 0
+        compute_s = 0.0
+        edge_bytes: Dict[str, int] = {}
+        hist_items: List[tuple] = []
+        base = self._step_idx * m
+        t_wall0 = time.perf_counter()
+
+        def stage_forward(layer_list, h):
+            for lp in layer_list:
+                h = self._apply_layer(lp, h)
+            return h
+
+        def _read(endpoint, seq, what):
+            try:
+                value = endpoint.read(seq, timeout=self.recv_timeout_s)
+            except ChannelTimeoutError as exc:
+                raise PipelineStallError(
+                    f"pipeline stage {sid} stalled waiting for {what} "
+                    f"(seq {seq}); an adjacent stage likely died"
+                ) from exc
+            if not getattr(endpoint, "owned_reads", False):
+                value = np.array(value, copy=True)
+            endpoint.ack(seq)
+            return value
+
+        def _write(endpoint, value, seq, edge):
+            arr = np.asarray(value)
+            edge_bytes[edge] = edge_bytes.get(edge, 0) + arr.nbytes
+            try:
+                endpoint.write(arr, seq, timeout=self.recv_timeout_s)
+            except ChannelTimeoutError as exc:
+                raise PipelineStallError(
+                    f"pipeline stage {sid} stalled writing to edge "
+                    f"{edge} (seq {seq}); the peer stage likely died"
+                ) from exc
+
+        for ins in self._instrs:
+            if self._fail_next and ins.op == sched_mod.FWD:
+                self._fail_next = False
+                raise RuntimeError(
+                    f"pipeline stage {sid} died mid-step (injected "
+                    "failure)")
+            if ins.op == sched_mod.RECV:
+                if ins.kind == sched_mod.ACT:
+                    recv_act[ins.mb] = _read(
+                        self._fwd_in, base + ins.mb,
+                        f"activation mb {ins.mb} from stage {sid - 1}")
+                else:
+                    recv_grad[ins.mb] = _read(
+                        self._grad_in, base + ins.mb,
+                        f"gradient mb {ins.mb} from stage {sid + 1}")
+                continue
+            if ins.op == sched_mod.SEND:
+                if ins.kind == sched_mod.ACT:
+                    _write(self._fwd_out, outputs.pop(ins.mb),
+                           base + ins.mb, f"{sid}->{sid + 1}")
+                else:
+                    _write(self._grad_out, recv_grad.pop(ins.mb),
+                           base + ins.mb, f"{sid}->{sid - 1}")
+                continue
+
+            t0 = time.perf_counter()
+            if ins.op == sched_mod.FWD:
+                k = ins.mb
+                h_in = (x_mbs[k] if self.plan.is_first
+                        else recv_act.pop(k))
+                h_in = jax.numpy.asarray(h_in)
+                if self.plan.is_last:
+                    target = jax.numpy.asarray(y_mbs[k])
+                    loss, pull = jax.vjp(
+                        lambda p, h: self._loss_fn(
+                            stage_forward(p, h), target),
+                        self.params, h_in)
+                    loss_sum += float(loss)
+                else:
+                    out, pull = jax.vjp(stage_forward, self.params,
+                                        h_in)
+                    outputs[k] = out
+                pullbacks[k] = pull
+                live += 1
+                peak_live = max(peak_live, live)
+            elif ins.op == sched_mod.BWD:
+                k = ins.mb
+                seed = (1.0 if self.plan.is_last
+                        else jax.numpy.asarray(recv_grad[k]))
+                gp, gx = pullbacks.pop(k)(seed)
+                grads_accum = (gp if grads_accum is None
+                               else _tree_add(grads_accum, gp))
+                live -= 1
+                if not self.plan.is_first:
+                    # overwrite in place: SEND(grad, k) picks it up
+                    recv_grad[k] = gx
+                else:
+                    recv_grad.pop(k, None)
+            elif ins.op == sched_mod.STEP:
+                grads = _tree_scale(grads_accum, 1.0 / m)
+                if self._dp is not None:
+                    grads = self._dp_allreduce(grads)
+                self.params = jax.tree_util.tree_map(
+                    lambda p, g: p - self.lr * g, self.params, grads)
+            dt = time.perf_counter() - t0
+            compute_s += dt
+            hist_items.append((
+                "histogram", "ray_tpu_train_pipeline_stage_step_seconds",
+                {"stage": str(sid), "phase": ins.phase}, dt,
+                _STEP_BOUNDS))
+
+        wall_s = time.perf_counter() - t_wall0
+        bubble = max(0.0, 1.0 - compute_s / wall_s) if wall_s > 0 else 0.0
+        self._step_idx += 1
+        self._flush_metrics(bubble, edge_bytes, hist_items)
+        report = {
+            "stage": sid,
+            "wall_s": wall_s,
+            "compute_s": compute_s,
+            "bubble": bubble,
+            "max_live": peak_live,
+            "edge_bytes": edge_bytes,
+        }
+        if self.plan.is_last:
+            report["loss"] = loss_sum / m
+        return report
+
+    def _dp_allreduce(self, grads):
+        """Average the stage gradient across this stage's data-parallel
+        replica group (quantized when grad_compression is set)."""
+        import jax
+        group_name, _, _ = self._dp
+        from ray_tpu.parallel import collective
+        flat, treedef = jax.tree_util.tree_flatten(grads)
+        reduced = [
+            collective.allreduce(
+                np.asarray(leaf), op="mean", group_name=group_name,
+                compression=self.grad_compression,
+                ef_key=(f"pipe/{self.stage_id}/{i}"
+                        if self.grad_compression else None))
+            for i, leaf in enumerate(flat)
+        ]
+        return jax.tree_util.tree_unflatten(treedef, reduced)
+
+    def _flush_metrics(self, bubble: float, edge_bytes: Dict[str, int],
+                       hist_items: List[tuple]) -> None:
+        """One record_batch per step: gauge + per-instruction histogram
+        observations + edge byte counters (a worker-side batch rides a
+        single control-plane RPC)."""
+        from ray_tpu.util.metrics import record_batch
+        items = list(hist_items)
+        items.append((
+            "gauge", "ray_tpu_train_pipeline_bubble_ratio",
+            {"stage": str(self.stage_id),
+             "schedule": self.schedule_name}, bubble, None))
+        for edge, nbytes in edge_bytes.items():
+            items.append((
+                "counter",
+                "ray_tpu_train_pipeline_activation_bytes_total",
+                {"edge": edge}, float(nbytes), None))
+        try:
+            record_batch(items)
+        except Exception:  # noqa: BLE001 — observability must not
+            logger.debug("pipeline metrics not recorded",  # fail a step
+                         exc_info=True)
+
+
+class PipelineRunner:
+    """Driver handle: partitions the model, spawns stage actors, wires
+    the activation channels, compiles the fan-out DAG, and exposes
+    ``step()``/``fetch_params()``/``shutdown()``."""
+
+    def __init__(self, model: LayeredModel, *, num_stages: int,
+                 num_microbatches: int, schedule: str = "1f1b",
+                 transport: str = "shm", channel_capacity: int = 4,
+                 lr: float = 0.05, recv_timeout_s: float = 30.0,
+                 grad_compression: Optional[str] = None,
+                 dp_group: Optional[Tuple[str, int, int]] = None,
+                 actor_options: Optional[dict] = None):
+        import ray_tpu
+        from ray_tpu.core import serialization
+        from ray_tpu.dag import InputNode, MultiOutputNode
+
+        if transport not in ("shm", "tcp"):
+            raise ValueError(f"unknown transport {transport!r}")
+        sched_mod.validate_schedule(num_stages, num_microbatches,
+                                    schedule)
+        self.num_stages = num_stages
+        self.num_microbatches = num_microbatches
+        self.schedule = schedule
+        self.theoretical_bubble = sched_mod.bubble_fraction(
+            num_stages, num_microbatches, schedule)
+        plans = partition_model(model, num_stages)
+
+        StageActor = ray_tpu.remote(PipelineStage)
+        opts = dict(actor_options or {})
+        self._actors = []
+        for plan in plans:
+            cfg = {
+                "stage_id": plan.stage_id, "num_stages": num_stages,
+                "num_microbatches": num_microbatches,
+                "schedule": schedule, "lr": lr,
+                "recv_timeout_s": recv_timeout_s, "plan": plan,
+                "apply_layer": model.apply_layer,
+                "loss_fn": model.loss_fn,
+                "grad_compression": grad_compression,
+                "dp": (None if dp_group is None else
+                       (f"{dp_group[0]}/stage{plan.stage_id}",
+                        dp_group[1], dp_group[2])),
+            }
+            actor = (StageActor.options(**opts).remote(
+                serialization.dumps(cfg)) if opts
+                else StageActor.remote(serialization.dumps(cfg)))
+            self._actors.append(actor)
+
+        # --- wire the boundary channels (edge i: stage i <-> i+1) ----
+        endpoints: List[Dict[str, Any]] = [dict() for _ in plans]
+        if transport == "shm":
+            import os as _os
+            for i in range(num_stages - 1):
+                fwd = ChannelSpec(channel_id=_os.urandom(8),
+                                  num_readers=1,
+                                  capacity=channel_capacity)
+                bwd = ChannelSpec(channel_id=_os.urandom(8),
+                                  num_readers=1,
+                                  capacity=channel_capacity)
+                endpoints[i]["fwd_out"] = ("shm", fwd, None)
+                endpoints[i + 1]["fwd_in"] = ("shm", fwd, 0)
+                endpoints[i + 1]["grad_out"] = ("shm", bwd, None)
+                endpoints[i]["grad_in"] = ("shm", bwd, 0)
+        else:
+            # reader-side listeners first, so writer connects can't race
+            tokens = {}
+            listen_refs = []
+            for i in range(num_stages - 1):
+                t_fwd = f"pipe:{id(self)}:fwd:{i}"
+                t_bwd = f"pipe:{id(self)}:bwd:{i}"
+                tokens[i] = (t_fwd, t_bwd)
+                listen_refs.append(
+                    self._actors[i + 1].pipe_create_listener.remote(
+                        t_fwd))
+                listen_refs.append(
+                    self._actors[i].pipe_create_listener.remote(t_bwd))
+            addrs = ray_tpu.get(listen_refs)
+            for i in range(num_stages - 1):
+                t_fwd, t_bwd = tokens[i]
+                fwd_addr = tuple(addrs[2 * i])
+                bwd_addr = tuple(addrs[2 * i + 1])
+                endpoints[i]["fwd_out"] = ("tcp_writer", [fwd_addr],
+                                           channel_capacity)
+                endpoints[i + 1]["fwd_in"] = ("tcp_reader", t_fwd)
+                endpoints[i + 1]["grad_out"] = ("tcp_writer",
+                                                [bwd_addr],
+                                                channel_capacity)
+                endpoints[i]["grad_in"] = ("tcp_reader", t_bwd)
+        ray_tpu.get([a.connect_channels.remote(e)
+                     for a, e in zip(self._actors, endpoints)])
+
+        with InputNode() as inp:
+            outs = [a.run_step.bind(inp) for a in self._actors]
+            dag = MultiOutputNode(outs)
+        self._compiled = dag.experimental_compile(
+            buffer_capacity=channel_capacity)
+
+    # -- driving -------------------------------------------------------
+    def execute_async(self, x, y):
+        """Non-blocking: enqueue one step; ``ref.get()`` returns the
+        per-stage report list (last entry carries the loss)."""
+        return self._compiled.execute(
+            ("step", np.asarray(x), np.asarray(y)))
+
+    def step(self, x, y, timeout: Optional[float] = 120.0
+             ) -> Dict[str, Any]:
+        reports = self.execute_async(x, y).get(timeout)
+        out = {"loss": reports[-1].get("loss"),
+               "reports": reports,
+               "bubble": (sum(r["bubble"] for r in reports)
+                          / len(reports)),
+               "theoretical_bubble": self.theoretical_bubble}
+        return out
+
+    def inject_failure(self, stage_id: int) -> None:
+        """Test hook: arm a mid-step death on ``stage_id`` for the next
+        step. Rides the DAG input channel — the stage actors are parked
+        in their resident loops, so an out-of-band actor call would
+        never execute."""
+        self._compiled.execute(("fail", stage_id, None)).get(30.0)
+
+    def fetch_params(self) -> List[Any]:
+        """Current per-layer params, stitched back into model order.
+        Rides the DAG (the stage actors are parked in their resident
+        loops, so an out-of-band actor call would never run)."""
+        reports = self._compiled.execute(
+            ("fetch", None, None)).get(60.0)
+        return stitch_params([r["params"] for r in reports])
+
+    def shutdown(self) -> None:
+        import ray_tpu
+        try:
+            self._compiled.teardown()
+        finally:
+            for a in self._actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001 — already gone
+                    logger.debug("pipeline stage kill failed",
+                                 exc_info=True)

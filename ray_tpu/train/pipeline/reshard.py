@@ -1,0 +1,91 @@
+"""Stage-boundary activation resharding.
+
+When adjacent pipeline stages run different intra-stage sharding
+(stage i holds activations split over ``src_parts`` ranks, stage i+1
+expects ``dst_parts``), the boundary transfer must redistribute the
+batch dimension. Following "Memory-efficient array redistribution
+through portable collective communication" (arXiv 2112.01075), the
+redistribution is expressed over the portable host collectives in
+``parallel/collective.py`` — all-gather to materialize the boundary
+tensor, then slice this rank's destination shard — rather than a
+bespoke point-to-point exchange. (The all-gather→slice pair is the
+always-correct baseline of the paper's search space; with equal part
+counts it degenerates to the identity and is skipped entirely.)
+
+Two paths share the slicing math:
+
+- **collective**: inside a collective group (``group_name`` set), ring
+  all-gather the flat activation over the group, reassemble, slice.
+- **local**: given the full list of source shards (single-process
+  tests, or a stage actor that already holds them), pure numpy.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def _dst_bounds(total: int, dst_parts: int) -> List[int]:
+    """Batch-dim split points for the destination sharding; matches
+    collective._chunk_bounds semantics (remainder spread over the
+    first ranks)."""
+    base, rem = divmod(total, dst_parts)
+    bounds = [0]
+    for r in range(dst_parts):
+        bounds.append(bounds[-1] + base + (1 if r < rem else 0))
+    return bounds
+
+
+def reshard_slice(full: np.ndarray, dst_rank: int,
+                  dst_parts: int) -> np.ndarray:
+    """``dst_rank``'s shard of the assembled boundary tensor (batch
+    dim 0)."""
+    bounds = _dst_bounds(full.shape[0], dst_parts)
+    return full[bounds[dst_rank]:bounds[dst_rank + 1]]
+
+
+def reshard_boundary(shard: np.ndarray, *, src_parts: int,
+                     dst_parts: int, dst_rank: int,
+                     group_name: Optional[str] = None,
+                     all_shards: Optional[Sequence[np.ndarray]] = None
+                     ) -> np.ndarray:
+    """Redistribute a batch-sharded activation across the boundary.
+
+    ``shard``: this rank's piece under the source sharding (batch dim
+    0). With ``src_parts == dst_parts`` the boundary shardings agree
+    and the input is returned untouched (the degenerate identity). The
+    collective path rides ``allgather_flat`` over ``group_name``; the
+    local path assembles ``all_shards`` directly.
+    """
+    if src_parts < 1 or dst_parts < 1:
+        raise ValueError(
+            f"part counts must be >= 1 (src={src_parts}, "
+            f"dst={dst_parts})")
+    if not 0 <= dst_rank < dst_parts:
+        raise ValueError(
+            f"dst_rank {dst_rank} out of range for {dst_parts} parts")
+    shard = np.asarray(shard)
+    if src_parts == dst_parts:
+        return shard
+    if all_shards is not None:
+        full = np.concatenate([np.asarray(s) for s in all_shards],
+                              axis=0)
+        return reshard_slice(full, dst_rank, dst_parts)
+    if group_name is None:
+        raise ValueError(
+            "resharding across unequal part counts needs either a "
+            "collective group_name or the explicit all_shards list")
+    from ray_tpu.parallel import collective
+    # All-gather the flat payload over the stage group; shards may be
+    # unevenly sized (remainder batches), and allgather_flat
+    # concatenates in rank order, which is exactly batch order here.
+    flat = shard.astype(np.float32, copy=False).ravel()
+    full_flat = collective.allgather_flat(flat, group_name=group_name)
+    per_item = int(np.prod(shard.shape[1:], dtype=np.int64)) or 1
+    total_batch = full_flat.size // per_item
+    full = np.asarray(full_flat, dtype=np.float32).reshape(
+        (total_batch,) + tuple(shard.shape[1:]))
+    return reshard_slice(full, dst_rank, dst_parts).astype(
+        shard.dtype, copy=False)

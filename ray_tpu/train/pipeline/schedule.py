@@ -1,0 +1,233 @@
+"""Static microbatch schedules for MPMD pipeline stages.
+
+Each stage executes a fixed instruction list per optimizer step —
+compiled once, replayed every step by the stage actor inside the
+compiled-DAG loop. Two schedules (arXiv 2412.14374 §3):
+
+- ``"1f1b"`` — stage ``s`` of ``S`` runs ``min(S - s, M)`` warmup
+  forwards, then alternates one-backward-one-forward in steady state,
+  then drains the remaining backwards. In-flight activations per stage
+  are bounded by the warmup depth (≤ S), independent of M.
+- ``"gpipe"`` — fill-drain: all M forwards, then all M backwards.
+  Simpler, but all M activations are live at the fill/drain boundary,
+  so a bounded activation channel (capacity < M) stalls the upstream
+  stage — the measured bubble exceeds 1F1B's on the same config.
+
+Both end with one ``STEP`` (gradient apply). The theoretical bubble
+fraction for either fill-drain schedule is ``(S-1) / (M + S - 1)``;
+the executor additionally *measures* bubble as 1 - compute/wall per
+stage, which is where the schedules separate under finite channel
+capacity.
+
+Instruction ops (the DAG-loop ISA of the issue):
+
+- ``FWD k``  — run this stage's forward on microbatch ``k``
+- ``BWD k``  — run this stage's backward on microbatch ``k``
+- ``RECV k`` — block on the upstream/downstream channel (``kind`` says
+  whether an activation or a gradient arrives)
+- ``SEND k`` — write to the adjacent channel (``kind`` as above)
+- ``STEP``   — apply the accumulated gradient
+
+Pure Python, no jax/actors: golden tests and the devtools.check smoke
+step consume this module directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+SCHEDULES = ("1f1b", "gpipe")
+
+# instruction ops
+FWD = "FWD"
+BWD = "BWD"
+RECV = "RECV"
+SEND = "SEND"
+STEP = "STEP"
+
+# what a RECV/SEND carries
+ACT = "act"    # forward activation, flowing stage s -> s+1
+GRAD = "grad"  # backward gradient, flowing stage s+1 -> s
+
+
+@dataclass(frozen=True)
+class Instruction:
+    op: str          # FWD | BWD | RECV | SEND | STEP
+    mb: int = -1     # microbatch id (-1 for STEP)
+    kind: str = ""   # "act" | "grad" for RECV/SEND, else ""
+    phase: str = ""  # warmup | steady | drain | step
+
+    def __repr__(self) -> str:  # compact golden-test form
+        if self.op == STEP:
+            return "STEP"
+        if self.op in (RECV, SEND):
+            return f"{self.op}({self.kind},{self.mb})"
+        return f"{self.op}({self.mb})"
+
+
+def bubble_fraction(num_stages: int, num_microbatches: int,
+                    schedule: str = "1f1b") -> float:
+    """Theoretical pipeline-bubble fraction: idle ticks / total ticks.
+
+    With uniform per-microbatch stage time t for fwd and bwd, a step
+    spans (M + S - 1) fwd ticks + (M + S - 1) bwd ticks of which each
+    stage computes 2M — bubble = (S-1)/(M+S-1) for both fill-drain
+    schedules (1F1B's win over GPipe is activation memory and, under
+    bounded channels, the absence of fill-phase backpressure stalls).
+    """
+    _check_args(num_stages, num_microbatches, schedule)
+    s, m = num_stages, num_microbatches
+    return (s - 1) / (m + s - 1)
+
+
+def _check_args(num_stages: int, num_microbatches: int,
+                schedule: str) -> None:
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    if num_microbatches < 1:
+        raise ValueError(
+            f"num_microbatches must be >= 1, got {num_microbatches}")
+    if schedule not in SCHEDULES:
+        raise ValueError(
+            f"unknown schedule {schedule!r}; expected one of {SCHEDULES}")
+
+
+def warmup_depth(stage: int, num_stages: int,
+                 num_microbatches: int) -> int:
+    """1F1B warmup forwards for ``stage`` (0-indexed): ``S - stage``
+    capped at M — the last stage runs exactly one forward before its
+    first backward; stage 0 fills the whole pipeline."""
+    return min(num_stages - stage, num_microbatches)
+
+
+def _fwd_block(stage: int, num_stages: int, k: int,
+               phase: str) -> List[Instruction]:
+    out = []
+    if stage > 0:
+        out.append(Instruction(RECV, k, ACT, phase))
+    out.append(Instruction(FWD, k, "", phase))
+    if stage < num_stages - 1:
+        out.append(Instruction(SEND, k, ACT, phase))
+    return out
+
+
+def _bwd_block(stage: int, num_stages: int, k: int,
+               phase: str) -> List[Instruction]:
+    out = []
+    if stage < num_stages - 1:
+        out.append(Instruction(RECV, k, GRAD, phase))
+    out.append(Instruction(BWD, k, "", phase))
+    if stage > 0:
+        out.append(Instruction(SEND, k, GRAD, phase))
+    return out
+
+
+def stage_schedule(stage: int, num_stages: int, num_microbatches: int,
+                   schedule: str = "1f1b") -> List[Instruction]:
+    """The static instruction list stage ``stage`` replays every step."""
+    _check_args(num_stages, num_microbatches, schedule)
+    if not 0 <= stage < num_stages:
+        raise ValueError(
+            f"stage {stage} out of range for {num_stages} stages")
+    s, m = num_stages, num_microbatches
+    instrs: List[Instruction] = []
+    if schedule == "gpipe":
+        for k in range(m):
+            instrs += _fwd_block(stage, s, k, "warmup")
+        for k in range(m):
+            instrs += _bwd_block(stage, s, k, "drain")
+    else:  # 1f1b
+        warm = warmup_depth(stage, s, m)
+        for k in range(warm):
+            instrs += _fwd_block(stage, s, k, "warmup")
+        # steady state: BWD (k - warm) then FWD k, keeping exactly
+        # ``warm`` microbatches in flight on this stage
+        for k in range(warm, m):
+            instrs += _bwd_block(stage, s, k - warm, "steady")
+            instrs += _fwd_block(stage, s, k, "steady")
+        for k in range(m - warm, m):
+            instrs += _bwd_block(stage, s, k, "drain")
+    instrs.append(Instruction(STEP, -1, "", "step"))
+    return instrs
+
+
+def build_schedule(num_stages: int, num_microbatches: int,
+                   schedule: str = "1f1b") -> List[List[Instruction]]:
+    """Instruction lists for every stage, index = stage id."""
+    return [stage_schedule(s, num_stages, num_microbatches, schedule)
+            for s in range(num_stages)]
+
+
+def max_in_flight(instrs: List[Instruction]) -> int:
+    """Peak number of microbatches with a live forward (FWD seen, BWD
+    not yet) — the stage's activation-memory high-water mark."""
+    live = 0
+    peak = 0
+    for ins in instrs:
+        if ins.op == FWD:
+            live += 1
+            peak = max(peak, live)
+        elif ins.op == BWD:
+            live -= 1
+    return peak
+
+
+def validate_schedule(num_stages: int, num_microbatches: int,
+                      schedule: str = "1f1b") -> None:
+    """Structural invariants, used by golden tests and the
+    ``devtools.check`` pipeline smoke step. Raises AssertionError with
+    the violated property."""
+    per_stage = build_schedule(num_stages, num_microbatches, schedule)
+    s, m = num_stages, num_microbatches
+    for stage, instrs in enumerate(per_stage):
+        fwds = [i.mb for i in instrs if i.op == FWD]
+        bwds = [i.mb for i in instrs if i.op == BWD]
+        assert fwds == list(range(m)), \
+            f"stage {stage}: forwards {fwds} != 0..{m - 1} in order"
+        assert bwds == list(range(m)), \
+            f"stage {stage}: backwards {bwds} != 0..{m - 1} in order"
+        assert instrs[-1].op == STEP, f"stage {stage}: missing STEP"
+        assert sum(1 for i in instrs if i.op == STEP) == 1, \
+            f"stage {stage}: more than one STEP"
+        # every FWD on mb k precedes its BWD on mb k
+        for k in range(m):
+            fi = next(n for n, i in enumerate(instrs)
+                      if i.op == FWD and i.mb == k)
+            bi = next(n for n, i in enumerate(instrs)
+                      if i.op == BWD and i.mb == k)
+            assert fi < bi, f"stage {stage}: BWD {k} before FWD {k}"
+        if schedule == "1f1b":
+            warm = warmup_depth(stage, s, m)
+            # warmup depth: first `warm` compute ops are forwards
+            compute = [i for i in instrs if i.op in (FWD, BWD)]
+            head = [i.op for i in compute[:warm]]
+            assert head == [FWD] * warm, \
+                (f"stage {stage}: warmup depth {warm} violated "
+                 f"(head={head})")
+            # steady state: strict BWD/FWD alternation until the drain
+            steady = [i.op for i in compute[warm:warm + 2 * (m - warm)]]
+            assert steady == [BWD, FWD] * (m - warm), \
+                f"stage {stage}: steady-state alternation violated"
+            # drain: the rest are backwards
+            tail = [i.op for i in compute[warm + 2 * (m - warm):]]
+            assert tail == [BWD] * warm, \
+                f"stage {stage}: drain should be {warm} BWDs, got {tail}"
+            assert max_in_flight(instrs) == warm, \
+                (f"stage {stage}: in-flight {max_in_flight(instrs)} != "
+                 f"warmup depth {warm}")
+    # channel-order invariant: the SEND sequence on every edge matches
+    # the RECV sequence of its peer (channels are FIFO per edge)
+    for stage in range(s - 1):
+        sends = [i.mb for i in per_stage[stage]
+                 if i.op == SEND and i.kind == ACT]
+        recvs = [i.mb for i in per_stage[stage + 1]
+                 if i.op == RECV and i.kind == ACT]
+        assert sends == recvs, \
+            f"act edge {stage}->{stage + 1}: send/recv order mismatch"
+        sends = [i.mb for i in per_stage[stage + 1]
+                 if i.op == SEND and i.kind == GRAD]
+        recvs = [i.mb for i in per_stage[stage]
+                 if i.op == RECV and i.kind == GRAD]
+        assert sends == recvs, \
+            f"grad edge {stage + 1}->{stage}: send/recv order mismatch"

@@ -42,13 +42,22 @@ class _TrainWorker:
     def __init__(self, rank: int, world_size: int, storage_path: str,
                  group_name: str, jax_env: Optional[dict] = None,
                  grad_compression: Optional[str] = None,
-                 zero1: bool = False):
+                 zero1: bool = False, pipeline_stages: int = 1,
+                 microbatches: int = 1, schedule: str = "1f1b"):
         self.rank = rank
         self.world_size = world_size
         self.storage_path = storage_path
         self.group_name = group_name
         self.grad_compression = grad_compression
         self.zero1 = zero1
+        # pipeline topology: stage-major rank layout (adjacent ranks =
+        # adjacent stages of one replica), gradient sync per stage
+        self.pipeline_stages = max(1, pipeline_stages)
+        self.microbatches = microbatches
+        self.schedule = schedule
+        self.pipeline_stage = rank % self.pipeline_stages
+        self.pipeline_replica = rank // self.pipeline_stages
+        self.stage_group_name: Optional[str] = None
         if jax_env:
             # Multi-host bootstrap (reference: _setup_jax_tpu_environment).
             # The coordinator must bind on RANK 0's host (on a pod that's
@@ -64,6 +73,15 @@ class _TrainWorker:
             initialize_distributed(**jax_env)
         from ray_tpu.parallel import collective
         collective.init_collective_group(world_size, rank, group_name)
+        if self.pipeline_stages > 1:
+            # cross-replica group per stage: DDP/ZeRO-1 allreduce of a
+            # stage's grads only involves the replicas holding that
+            # stage's parameters
+            dp_world = world_size // self.pipeline_stages
+            self.stage_group_name = \
+                f"{group_name}/stage{self.pipeline_stage}"
+            collective.init_collective_group(
+                dp_world, self.pipeline_replica, self.stage_group_name)
 
     def _rendezvous_coordinator(self, process_id: int) -> str:
         import socket as _socket
@@ -103,7 +121,12 @@ class _TrainWorker:
             storage_path=self.storage_path,
             resume_checkpoint=Checkpoint(resume_path) if resume_path else None,
             datasets=datasets, group_name=self.group_name,
-            grad_compression=self.grad_compression, zero1=self.zero1)
+            grad_compression=self.grad_compression, zero1=self.zero1,
+            pipeline_stages=self.pipeline_stages,
+            microbatches=self.microbatches, schedule=self.schedule,
+            pipeline_stage=self.pipeline_stage,
+            pipeline_replica=self.pipeline_replica,
+            stage_group_name=self.stage_group_name)
         ctx_mod.set_context(ctx)
         try:
             if loop_config is not None:
@@ -272,6 +295,12 @@ class JaxTrainer:
             world, runtime_mod.get_runtime())
         if new_world is None or new_world < 1:
             return None
+        stages = max(1, self.scaling_config.pipeline_stages)
+        if stages > 1:
+            # elastic shrink must keep whole pipeline replicas
+            new_world -= new_world % stages
+            if new_world < stages:
+                return None
         if new_world != world:
             self._transition("RESIZING")
         return new_world
@@ -281,6 +310,22 @@ class JaxTrainer:
         scaling = self.scaling_config
         if num_workers is None:
             num_workers = scaling.num_workers
+        stages = max(1, scaling.pipeline_stages)
+        if stages > 1:
+            from ray_tpu.train.pipeline.schedule import SCHEDULES
+            if scaling.schedule not in SCHEDULES:
+                raise ValueError(
+                    f"unknown pipeline schedule {scaling.schedule!r}; "
+                    f"expected one of {SCHEDULES}")
+            if num_workers % stages:
+                raise ValueError(
+                    f"num_workers={num_workers} is not divisible by "
+                    f"pipeline_stages={stages}: every data-parallel "
+                    "replica needs a full set of stage workers")
+            if scaling.microbatches < 1:
+                raise ValueError(
+                    f"microbatches must be >= 1, got "
+                    f"{scaling.microbatches}")
         res = scaling.worker_resources()
         # Multi-host slice gang: reserve a whole slice via its head
         # resource, then pin every worker to that slice's hosts with the
@@ -351,7 +396,10 @@ class JaxTrainer:
                     rank, num_workers, storage, group_name,
                     jax_env=env,
                     grad_compression=scaling.grad_compression,
-                    zero1=scaling.zero1))
+                    zero1=scaling.zero1,
+                    pipeline_stages=stages,
+                    microbatches=scaling.microbatches,
+                    schedule=scaling.schedule))
         # Fail fast if any worker can't construct — and release every
         # reservation on the way out, or the next (resized) attempt sees
         # the failed gang still holding the cluster's resources.
