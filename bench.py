@@ -133,6 +133,148 @@ def _attach_pipeline_row(result: dict) -> None:
             "schedule": pipe[2], "error": str(e)[:300]}
 
 
+def _bench_data_pipeline():
+    """Data-plane bench (runs in the --data-pipeline-inner child):
+
+    1. streaming-shuffle throughput — rows/s and GB/s through the
+       pipelined map->reduce path, plus the streaming proof stats
+       (first output landed before the last map; bounded in-flight);
+    2. trainer-feed efficiency — the SAME jitted train step driven by
+       device-resident synthetic batches vs by the real pipeline
+       (read -> map_batches -> iter_device_batches double-buffering).
+       real_vs_synthetic ~ 1.0 means the data plane never starves the
+       step loop.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.models.llama import LlamaConfig, llama_init, llama_loss
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    result = {"metric": "data_pipeline"}
+    try:
+        # ---- leg 1: streaming shuffle ------------------------------
+        rows = int(os.environ.get("RTPU_BENCH_DATA_ROWS", "100000"))
+        vec = int(os.environ.get("RTPU_BENCH_DATA_VEC", "64"))
+        ds = rd.range_tensor(rows, shape=(vec,),
+                             parallelism=32).random_shuffle(seed=0)
+        t0 = time.perf_counter()
+        out_rows = sum(b.metadata.num_rows or 0
+                       for b in ds.iter_internal_ref_bundles())
+        dt = time.perf_counter() - t0
+        ss = list(ds._last_executor.shuffle_states.values())[0]
+        # bytes that crossed the shuffle: every block enters a map and
+        # leaves a reduce, so count both directions
+        moved = ss.bytes_map_in + ss.bytes_reduce_out
+        result["shuffle"] = {
+            "rows": out_rows,
+            "row_bytes": vec * 8,
+            "seconds": round(dt, 3),
+            "rows_per_sec": round(out_rows / dt, 1),
+            "gb_per_sec": round(moved / dt / 1e9, 4),
+            "first_output_maps_done": ss.first_output_maps_done,
+            "n_maps": ss.n_maps,
+            "peak_in_flight_blocks": ss.peak_in_flight_blocks,
+            "in_flight_window": ss.window,
+        }
+
+        # ---- leg 2: real-pipeline trainer vs synthetic batches -----
+        cfg = LlamaConfig.tiny()
+        batch = int(os.environ.get("RTPU_BENCH_DATA_BATCH", "8"))
+        seq = 64
+        steps = int(os.environ.get("RTPU_BENCH_DATA_STEPS", "20"))
+        opt = optax.adamw(3e-4)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def train_step(p, s, tokens, targets):
+            loss, grads = jax.value_and_grad(
+                lambda q: llama_loss(q, tokens, targets, cfg))(p)
+            updates, s = opt.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, loss
+
+        def tokenize(b):
+            t = ((b["data"] * 31 + np.arange(seq)) % cfg.vocab_size)
+            return {"tokens": t.astype(np.int32),
+                    "targets": np.roll(t, -1, axis=1).astype(np.int32)}
+
+        n_rows = batch * (steps + 2)
+        pipe_ds = rd.range_tensor(n_rows, shape=(seq,),
+                                  parallelism=8).map_batches(tokenize)
+
+        tok = jnp.zeros((batch, seq), jnp.int32)
+        p, s, loss = train_step(params, opt_state, tok, tok)
+        float(loss)  # compile + flush barrier
+
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, s, loss = train_step(p, s, tok, tok)
+        float(loss)
+        dt_syn = time.perf_counter() - t0
+
+        it = pipe_ds.iter_device_batches(batch_size=batch, prefetch=4,
+                                         dtypes=jnp.int32)
+        first = next(it)  # pipeline warmup batch, outside the window
+        p, s, loss = train_step(p, s, first["tokens"], first["targets"])
+        float(loss)
+        n_real = 0
+        t0 = time.perf_counter()
+        for b in it:
+            p, s, loss = train_step(p, s, b["tokens"], b["targets"])
+            n_real += 1
+        float(loss)
+        dt_real = time.perf_counter() - t0
+
+        ftok = cfg.flops_per_token()
+        peak = peak_flops(jax.devices()[0])
+        syn_tps = batch * seq * steps / dt_syn
+        real_tps = batch * seq * n_real / dt_real
+        result["trainer"] = {
+            "model_params": cfg.num_params(),
+            "batch": batch, "seq": seq, "steps": steps,
+            "synthetic_tokens_per_sec": round(syn_tps, 1),
+            "real_tokens_per_sec": round(real_tps, 1),
+            "synthetic_mfu": round(syn_tps * ftok / peak, 6),
+            "real_mfu": round(real_tps * ftok / peak, 6),
+            "real_vs_synthetic": round(real_tps / syn_tps, 4),
+            "prefetch_wait_seconds": round(it.wait_seconds_total, 4),
+        }
+        result["device"] = str(getattr(jax.devices()[0], "device_kind",
+                                       "cpu"))
+    finally:
+        ray_tpu.shutdown()
+    return result
+
+
+def data_pipeline_main():
+    """`bench.py --data-pipeline`: run the data-plane bench in a child,
+    write BENCH_data.json next to this script, echo the JSON line."""
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_data.json")
+    timeout_s = int(os.environ.get("RTPU_BENCH_DATA_TIMEOUT_S", "600"))
+    ok, parsed, diag = _run_child(["--data-pipeline-inner"],
+                                  os.environ.copy(), timeout_s)
+    if not ok or parsed is None:
+        sys.stderr.write(
+            f"[bench] data pipeline failed ({diag}); retrying on a "
+            "clean CPU env\n")
+        ok, parsed, diag = _run_child(["--data-pipeline-inner"],
+                                      _cpu_env(), timeout_s)
+        if ok and parsed is not None:
+            parsed["degraded"] = "cpu-fallback"
+    if not ok or parsed is None:
+        parsed = {"metric": "data_pipeline", "error": diag}
+    with open(out_path, "w") as f:
+        json.dump(parsed, f, indent=2)
+        f.write("\n")
+    print(json.dumps(parsed))
+
+
 def _run_child(args, env, timeout_s):
     """Run a child, return (ok, parsed_json_or_None, diagnostic_str)."""
     try:
@@ -595,7 +737,12 @@ if __name__ == "__main__":
             os.environ["RTPU_BENCH_SCHEDULE"] = _a.split("=", 1)[1]
         elif _a == "--schedule" and _i + 1 < len(_argv):
             os.environ["RTPU_BENCH_SCHEDULE"] = _argv[_i + 1]
-    if "--inner" in sys.argv:
+    if "--data-pipeline-inner" in sys.argv:
+        print(json.dumps(_bench_data_pipeline()))
+    elif "--data-pipeline" in sys.argv or \
+            os.environ.get("RTPU_BENCH_DATA_PIPELINE"):
+        data_pipeline_main()
+    elif "--inner" in sys.argv:
         inner()
     else:
         main()

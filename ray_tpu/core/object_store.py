@@ -58,6 +58,13 @@ class SharedMemoryStore:
     def _base_ptr(self) -> int:
         return ctypes.addressof(ctypes.c_char.from_buffer(self._shm.buf))
 
+    def arena_range(self) -> tuple:
+        """[base, base+size) of the mapped arena in THIS process. Lets
+        callers prove a deserialized buffer is a zero-copy view into
+        shared memory (its address lies inside the range) rather than a
+        heap copy."""
+        return (self._base, self._base + self._shm.size)
+
     # -- raw object ops -------------------------------------------------
     def create(self, object_id: ObjectID, size: int) -> memoryview:
         cfg = get_config()

@@ -341,9 +341,17 @@ class ObjectServer:
             finally:
                 f.close()
 
+        def on_done(exc):
+            if exc is None:
+                # serve-side accounting; runs on the IO loop, so the
+                # no-RPC local write is mandatory (GL010)
+                TRANSFER_BYTES.inc_local(
+                    float(size), tags={"transport": "tcp_out"})
+            self._finished(pc)
+
         try:
             pc.conn.send({"kind": "PULL_META", "size": size})
-            pc.conn.send_stream(chunks(), lambda exc: self._finished(pc))
+            pc.conn.send_stream(chunks(), on_done)
         except OSError:
             f.close()
             return False
@@ -379,9 +387,13 @@ class ObjectServer:
             for off in range(0, size, chunk_size):
                 yield bytes(holder[0][off:off + chunk_size])
 
-        def on_done(_exc):
+        def on_done(exc):
             holder.clear()
             source.release(oid)
+            if exc is None:
+                # loop-path metric write: *_local only (GL010)
+                TRANSFER_BYTES.inc_local(
+                    float(size), tags={"transport": "tcp_out"})
             self._finished(pc)
 
         try:

@@ -207,12 +207,38 @@ def unpack_pinned(src, on_release) -> Any:
         on_release()
         return value
     if sys.version_info < (3, 12):
-        # _PinnedBuffer needs PEP-688 (__buffer__ on Python classes,
-        # 3.12+). Fall back to plain views: the pin releases with the
-        # ObjectRef instead of the value (pre-round-2 semantics).
-        value = unpack(src)
-        on_release()
-        return value
+        # Python classes can't export the buffer protocol before
+        # PEP 688, but ctypes arrays can: hand pickle zero-copy ctypes
+        # views of each payload slice. A reconstructed array's .base
+        # chain keeps its ctypes view alive, so the store pin (released
+        # via the finalizers) outlives the VALUE, not just the
+        # ObjectRef — dropping the ref early must not let the arena
+        # slot be reused under a live view.
+        import ctypes
+        import weakref
+
+        remaining = [len(sizes)]
+
+        def _dec():
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                try:
+                    on_release()
+                except Exception:  # graftlint: disable=GL004
+                    pass  # finalizer may run at interpreter shutdown
+
+        buffers = []
+        for size in sizes:
+            offset = _align(offset)
+            ct = (ctypes.c_char * size).from_buffer(src[offset:offset + size])
+            weakref.finalize(ct, _dec)
+            buffers.append(ct)
+            offset += size
+        try:
+            return pickle.loads(data, buffers=buffers)
+        except BaseException:
+            del buffers  # fire on_release via the finalizers
+            raise
     remaining = [len(sizes)]
 
     class _PinnedBuffer:

@@ -24,6 +24,17 @@ class DataContext:
     # Global queued-bytes budget for one stream; sources pause above it
     # (None = half the object store; see execution.ResourceManager).
     memory_budget_bytes: Optional[int] = None
+    # Streaming shuffle: number of reduce partitions (None = min_parallelism),
+    # how many map shards one reduce wave consumes, and the cap on map shard
+    # sets held or being produced at once (clamped up to the fan-in so a
+    # wave can always assemble).
+    shuffle_num_reducers: Optional[int] = None
+    shuffle_reduce_fanin: int = 4
+    max_shuffle_blocks_in_flight: int = 16
+    # Host-side prefetch depth for iter_batches / device staging depth for
+    # iter_device_batches (both run a producer thread when > 0).
+    iterator_prefetch_batches: int = 2
+    device_prefetch_batches: int = 2
     # Defaults for map_batches.
     default_batch_format: str = "numpy"
     # Read parallelism when not specified.

@@ -170,6 +170,9 @@ class Dataset:
         DataContext._set_current(self._context)
         physical = Planner(self._context).plan(self._plan)
         executor = StreamingExecutor(physical, self._context)
+        # Kept for introspection (tests/bench read per-op streaming
+        # stats, e.g. shuffle peak in-flight blocks) — not an API.
+        self._last_executor = executor
         return executor.execute()
 
     def iter_internal_ref_bundles(self):
@@ -399,7 +402,8 @@ class Dataset:
     # -- output --------------------------------------------------------
     def to_pandas(self, limit: Optional[int] = None):
         ds = self.limit(limit) if limit else self
-        blocks = [ray_tpu.get(b.block_ref) for b in ds._execute_stream()]
+        refs = [b.block_ref for b in ds._execute_stream()]
+        blocks = ray_tpu.get(refs) if refs else []
         if not blocks:
             return pa.table({}).to_pandas()
         return BlockAccessor.concat(blocks).to_pandas()

@@ -25,8 +25,21 @@ import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.transforms import MapTransform, apply_transform_chain
+from ray_tpu.util import metrics
 
 logger = logging.getLogger(__name__)
+
+SHUFFLE_BYTES = metrics.Counter(
+    "ray_tpu_data_shuffle_bytes_total",
+    "Bytes entering shuffle map tasks / leaving reduce tasks",
+    tag_keys=("stage",))
+BLOCKS_IN_FLIGHT = metrics.Gauge(
+    "ray_tpu_data_blocks_in_flight",
+    "Blocks buffered or being produced by the streaming executor")
+
+# Flush the executor's locally-aggregated metric updates every this many
+# scheduling steps (one record_batch RPC instead of one per update).
+_METRIC_FLUSH_STEPS = 32
 
 # ---------------------------------------------------------------------------
 # Remote task bodies (module-level so they pickle by value once).
@@ -463,6 +476,60 @@ class ResourceManager:
         return cached < self.budget
 
 
+class _ShuffleState:
+    """Per-op state for the pipelined random_shuffle (reference analog:
+    push-based shuffle in data/_internal/planner/exchange/ — here map
+    outputs feed fixed fan-in reduce *waves* so reducers start while maps
+    are still running).
+
+    Reducer ``i``'s wave ``w`` consumes the shards scattered to it by map
+    inputs ``[w*fanin, (w+1)*fanin)`` (by bundle order, so wave
+    composition is deterministic no matter which tasks finish first) and
+    emits output order ``w * n_out + i`` — dense, which keeps the sink's
+    in-order hold-back working unchanged. A full-size wave can launch as
+    soon as its members' shards exist; the final partial wave waits until
+    the total input count is known."""
+
+    def __init__(self, op: "AllToAllPhysicalOp", ctx: DataContext):
+        self.n_out = max(1, op.num_outputs or ctx.shuffle_num_reducers
+                         or ctx.min_parallelism)
+        self.fanin = max(1, ctx.shuffle_reduce_fanin)
+        # Cap on map shard-sets buffered + being produced; clamped up to
+        # the fan-in so a wave can always assemble without deadlock.
+        self.window = max(ctx.max_shuffle_blocks_in_flight, self.fanin)
+        self.shards: Dict[int, Tuple] = {}  # map order j -> n_out shard refs
+        self.maps_in_flight = 0
+        self.maps_dispatched = 0
+        self.maps_done = 0
+        self.n_maps: Optional[int] = None  # known once input is exhausted
+        self.reduce_wave = 0    # next (wave, reducer) to launch
+        self.reduce_i = 0
+        self.reduces_in_flight = 0
+        self.outputs_emitted = 0
+        # Streaming proof + bound proof (read by tests/bench):
+        self.first_output_maps_done: Optional[int] = None
+        self.peak_in_flight_blocks = 0
+        self.bytes_map_in = 0
+        self.bytes_reduce_out = 0
+
+    def note_in_flight(self) -> int:
+        cur = (len(self.shards) + self.maps_in_flight
+               + self.reduces_in_flight)
+        if cur > self.peak_in_flight_blocks:
+            self.peak_in_flight_blocks = cur
+        return cur
+
+    def wave_span(self, w: int) -> Tuple[int, Optional[int]]:
+        lo = w * self.fanin
+        if self.n_maps is not None:
+            return lo, min(lo + self.fanin, self.n_maps)
+        return lo, lo + self.fanin  # full-size wave assumed until EOS
+
+    def all_waves_launched(self) -> bool:
+        return (self.n_maps is not None
+                and self.reduce_wave * self.fanin >= self.n_maps)
+
+
 class StreamingExecutor:
     """Executes a physical DAG, yielding output RefBundles as they become
     available. Pull-based: work only advances while the consumer iterates,
@@ -475,8 +542,26 @@ class StreamingExecutor:
         self.topo: List[PhysicalOp] = []
         self._build(dag)
         self.resource_manager = ResourceManager(self.states, self.ctx)
-        # pending task ref -> completion callback info
+        # pending task ref -> tagged completion tuple:
+        #   ("bundle", op, b_ref, actor_idx, order)   map/read/write
+        #   ("shuffle_map", op, order, shard_refs)    scatter task
+        #   ("shuffle_reduce", op, b_ref, order)      wave reduce
         self.pending: Dict[Any, Tuple] = {}
+        self.shuffle_states: Dict[int, _ShuffleState] = {}
+        self._metric_buf: List[Tuple] = []
+        self._steps = 0
+
+    # -- metrics ------------------------------------------------------
+    def _metric(self, kind: str, name: str, tags, value) -> None:
+        self._metric_buf.append((kind, name, tags, value, None))
+
+    def _flush_metrics(self) -> None:
+        in_flight = sum(st.in_flight for st in self.states.values())
+        in_flight += sum(len(ss.shards) for ss in self.shuffle_states.values())
+        self._metric(
+            "gauge", "ray_tpu_data_blocks_in_flight", None, in_flight)
+        buf, self._metric_buf = self._metric_buf, []
+        metrics.record_batch(buf)
 
     def _build(self, op: PhysicalOp):
         if id(op) in self.states:
@@ -502,7 +587,7 @@ class StreamingExecutor:
         if isinstance(op, ReadPhysicalOp):
             order, read_fn = st.pending_reads.popleft()
             b_ref, m_ref = ray_tpu.remote(num_returns=2)(_read_task).remote(read_fn)
-            self.pending[m_ref] = (op, b_ref, None, order)
+            self.pending[m_ref] = ("bundle", op, b_ref, None, order)
             st.in_flight += 1
         elif isinstance(op, MapPhysicalOp):
             bundle: RefBundle = st.inqueues[0].popleft()
@@ -513,17 +598,17 @@ class StreamingExecutor:
                 idx, actor = st.actor_pool.pick()
                 b_ref, m_ref = actor.map.options(num_returns=2).remote(
                     op.transforms, bundle.block_ref)
-                self.pending[m_ref] = (op, b_ref, idx, bundle.order)
+                self.pending[m_ref] = ("bundle", op, b_ref, idx, bundle.order)
             else:
                 b_ref, m_ref = self._remote_map(op).remote(
                     op.transforms, bundle.block_ref)
-                self.pending[m_ref] = (op, b_ref, None, bundle.order)
+                self.pending[m_ref] = ("bundle", op, b_ref, None, bundle.order)
             st.in_flight += 1
         elif isinstance(op, WritePhysicalOp):
             bundle = st.inqueues[0].popleft()
             b_ref, m_ref = ray_tpu.remote(num_returns=2)(_write_task).remote(
                 op.write_fn, bundle.block_ref)
-            self.pending[m_ref] = (op, b_ref, None, bundle.order)
+            self.pending[m_ref] = ("bundle", op, b_ref, None, bundle.order)
             st.in_flight += 1
 
     def _forward(self, op: PhysicalOp, bundle: RefBundle):
@@ -580,21 +665,6 @@ class StreamingExecutor:
                 b, m = ray_tpu.remote(num_returns=2)(_concat_task).remote(
                     *slices[i])
                 out.append((b, m))
-        elif op.kind == "random_shuffle":
-            shard_refs = []
-            for j, r in enumerate(refs):
-                seed_j = None if op.seed is None else op.seed + j
-                shards = ray_tpu.remote(num_returns=n_out)(
-                    _shuffle_map_task).remote(r, n_out, seed_j)
-                if n_out == 1:
-                    shards = [shards]
-                shard_refs.append(shards)
-            for i in range(n_out):
-                seed_i = None if op.seed is None else op.seed + 7919 * (i + 1)
-                b, m = ray_tpu.remote(num_returns=2)(
-                    _shuffle_reduce_task).remote(
-                        seed_i, *[shard_refs[j][i] for j in range(n_in)])
-                out.append((b, m))
         elif op.kind == "sort":
             keys = [op.key] if isinstance(op.key, str) else list(op.key)
             samples = ray_tpu.get([
@@ -639,8 +709,10 @@ class StreamingExecutor:
         else:
             raise ValueError(f"unknown all-to-all kind {op.kind!r}")
 
-        for i, (b_ref, m_ref) in enumerate(out):
-            meta = ray_tpu.get(m_ref)
+        # One batched fetch for every output's metadata: N sequential
+        # round-trips would serialize on the slowest reducer each time.
+        metas = ray_tpu.get([m_ref for _b, m_ref in out])
+        for i, ((b_ref, _m), meta) in enumerate(zip(out, metas)):
             st.outqueue.append(RefBundle(b_ref, meta, order=i))
 
     def _run_join(self, op: JoinPhysicalOp, st: _OpState):
@@ -687,6 +759,12 @@ class StreamingExecutor:
                 # schema-losing empty blocks downstream
             st.outqueue.append(RefBundle(b, meta, order=order))
             order += 1
+        if order == 0:
+            # Entirely-empty result: keep ONE empty partition so the
+            # joined schema survives — otherwise a downstream join sees
+            # a zero-bundle side and can only fail with the
+            # unknown-schema error above.
+            st.outqueue.append(RefBundle(pairs[0][0], metas[0], order=0))
 
     def _run_zip(self, op: ZipPhysicalOp, st: _OpState):
         left = sorted(st.inqueues[0], key=lambda b: b.order)
@@ -698,6 +776,7 @@ class StreamingExecutor:
         right_refs = [b.block_ref for b in right]
         rstarts = np.cumsum([0] + [b.metadata.num_rows for b in right])
         lstarts = np.cumsum([0] + lrows)
+        pairs = []
         for i in range(len(left)):
             lo, hi = int(lstarts[i]), int(lstarts[i + 1])
             parts = []
@@ -708,13 +787,107 @@ class StreamingExecutor:
                     parts.append(ray_tpu.remote(num_returns=2)(
                         _slice_task).remote(right_refs[j], s - blo, e - blo)[0])
             rblock = ray_tpu.remote(num_returns=2)(_concat_task).remote(*parts)[0]
-            b, m = ray_tpu.remote(num_returns=2)(_zip_task).remote(
-                left[i].block_ref, rblock)
-            st.outqueue.append(RefBundle(b, ray_tpu.get(m), order=i))
+            pairs.append(ray_tpu.remote(num_returns=2)(_zip_task).remote(
+                left[i].block_ref, rblock))
+        # launch everything, then ONE batched metadata fetch
+        metas = ray_tpu.get([m for _b, m in pairs])
+        for i, ((b, _m), meta) in enumerate(zip(pairs, metas)):
+            st.outqueue.append(RefBundle(b, meta, order=i))
+
+    # -- streaming shuffle --------------------------------------------
+    def _step_shuffle(self, op: AllToAllPhysicalOp, st: _OpState) -> bool:
+        """Advance one pipelined random_shuffle op: scatter queued input
+        bundles as map tasks (bounded window), launch reduce waves whose
+        member shards have all arrived, finish when drained. Unlike the
+        barrier all-to-alls, the first reduce launches after only
+        ``fanin`` maps complete and at most ``window`` map shard sets
+        ever exist at once."""
+        ss = self.shuffle_states.get(id(op))
+        if ss is None:
+            ss = self.shuffle_states[id(op)] = _ShuffleState(op, self.ctx)
+        progressed = False
+        q = st.inqueues[0]
+
+        # Scatter: dispatch eligible queued bundles. Eligibility is by
+        # order — only maps within `window` of the oldest unlaunched
+        # wave may start, so the shard buffer can't fill with late maps
+        # while an early wave still needs a slot.
+        if q:
+            eligible_max = ss.reduce_wave * ss.fanin + ss.window
+            for bundle in sorted(q, key=lambda b: b.order):
+                if (len(ss.shards) + ss.maps_in_flight >= ss.window
+                        or bundle.order >= eligible_max):
+                    break
+                q.remove(bundle)
+                size = bundle.metadata.size_bytes or 0
+                ss.bytes_map_in += size
+                self._metric("counter", "ray_tpu_data_shuffle_bytes_total",
+                             {"stage": "map"}, size)
+                seed_j = None if op.seed is None else op.seed + bundle.order
+                shards = ray_tpu.remote(num_returns=ss.n_out)(
+                    _shuffle_map_task).remote(bundle.block_ref, ss.n_out,
+                                              seed_j)
+                shards = (shards,) if ss.n_out == 1 else tuple(shards)
+                self.pending[shards[0]] = (
+                    "shuffle_map", op, bundle.order, shards)
+                ss.maps_in_flight += 1
+                ss.maps_dispatched += 1
+                st.in_flight += 1
+                ss.note_in_flight()
+                progressed = True
+
+        # The total map count becomes known once upstream finished and
+        # the inqueue fully drained into map tasks; that sizes the final
+        # (possibly partial) wave.
+        if st.inputs_done[0] and not q and ss.n_maps is None:
+            ss.n_maps = ss.maps_dispatched
+            progressed = True
+
+        # Reduce waves: launch (wave, reducer) pairs in order while the
+        # wave's member shards are all present. Pre-EOS only full-size
+        # waves qualify (a short span might still gain members).
+        while (ss.reduces_in_flight < self.ctx.max_tasks_in_flight_per_op
+               and len(st.outqueue) < self.ctx.max_blocks_in_op_output_queue):
+            lo, hi = ss.wave_span(ss.reduce_wave)
+            if ss.n_maps is not None and lo >= ss.n_maps:
+                break  # every wave launched
+            if not all(j in ss.shards for j in range(lo, hi)):
+                break
+            i, w = ss.reduce_i, ss.reduce_wave
+            seed = (None if op.seed is None
+                    else op.seed + 7919 * (i + 1) + 104729 * w)
+            b_ref, m_ref = ray_tpu.remote(num_returns=2)(
+                _shuffle_reduce_task).remote(
+                    seed, *[ss.shards[j][i] for j in range(lo, hi)])
+            self.pending[m_ref] = (
+                "shuffle_reduce", op, b_ref, w * ss.n_out + i)
+            ss.reduces_in_flight += 1
+            st.in_flight += 1
+            ss.reduce_i += 1
+            if ss.reduce_i >= ss.n_out:
+                for j in range(lo, hi):
+                    del ss.shards[j]  # wave fully launched; free slots
+                ss.reduce_i = 0
+                ss.reduce_wave += 1
+            ss.note_in_flight()
+            progressed = True
+
+        if (not st.finished and st.inputs_done[0] and not q
+                and ss.n_maps is not None and ss.maps_in_flight == 0
+                and ss.reduces_in_flight == 0 and ss.all_waves_launched()):
+            self._mark_finished(op)
+            progressed = True
+        return progressed
 
     # -- main loop ----------------------------------------------------
     def execute(self):
         """Generator of output RefBundles from the DAG's sink op."""
+        try:
+            yield from self._execute()
+        finally:
+            self._flush_metrics()
+
+    def _execute(self):
         sink = self.dag
         sink_state = self.states[id(sink)]
         # Seed InputData ops.
@@ -773,21 +946,56 @@ class StreamingExecutor:
 
     def _step(self) -> bool:
         progressed = False
+        self._steps += 1
+        if self._steps % _METRIC_FLUSH_STEPS == 0:
+            self._flush_metrics()
         self.resource_manager.refresh()
         for st in self.states.values():  # reap idle autoscaled actors
             if st.actor_pool is not None:
                 st.actor_pool.maybe_scale_down()
-        # 1. Completions.
+        # 1. Completions: block briefly for the first one, then sweep up
+        # everything else already finished and fetch ALL their metadata
+        # in one batched get (one round-trip per wave, not per bundle).
         if self.pending:
-            ready, _ = ray_tpu.wait(list(self.pending.keys()),
-                                    num_returns=1, timeout=0.02)
-            for m_ref in ready:
-                op, b_ref, actor_idx, order = self.pending.pop(m_ref)
+            refs = list(self.pending.keys())
+            ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.02)
+            if ready:
+                more, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                       timeout=0)
+                ready = more or ready
+            done = [(r, self.pending.pop(r)) for r in ready]
+            meta_refs = [r for r, ent in done if ent[0] != "shuffle_map"]
+            metas = dict(zip(meta_refs, ray_tpu.get(meta_refs))) \
+                if meta_refs else {}
+            for m_ref, ent in done:
+                kind, op = ent[0], ent[1]
                 st = self.states[id(op)]
+                if kind == "shuffle_map":
+                    _tag, _op, order, shard_refs = ent
+                    ss = self.shuffle_states[id(op)]
+                    ss.shards[order] = shard_refs
+                    ss.maps_in_flight -= 1
+                    ss.maps_done += 1
+                    st.in_flight -= 1
+                    progressed = True
+                    continue
+                meta = metas[m_ref]
+                if kind == "shuffle_reduce":
+                    _tag, _op, b_ref, order = ent
+                    ss = self.shuffle_states[id(op)]
+                    ss.reduces_in_flight -= 1
+                    ss.outputs_emitted += 1
+                    ss.bytes_reduce_out += meta.size_bytes or 0
+                    self._metric("counter", "ray_tpu_data_shuffle_bytes_total",
+                                 {"stage": "reduce"}, meta.size_bytes or 0)
+                    if ss.first_output_maps_done is None:
+                        ss.first_output_maps_done = ss.maps_done
+                    actor_idx = None
+                else:
+                    _tag, _op, b_ref, actor_idx, order = ent
                 st.in_flight -= 1
                 if actor_idx is not None and st.actor_pool is not None:
                     st.actor_pool.release(actor_idx)
-                meta = ray_tpu.get(m_ref)
                 bundle = RefBundle(b_ref, meta, order=order)
                 if op is self.dag:
                     st.outqueue.append(bundle)
@@ -817,7 +1025,12 @@ class StreamingExecutor:
             st = self.states[id(op)]
             if st.finished:
                 continue
-            if isinstance(op, AllToAllPhysicalOp) and st.inputs_done[0] \
+            if isinstance(op, AllToAllPhysicalOp) \
+                    and op.kind == "random_shuffle":
+                # Streamed, not a barrier — see _step_shuffle below.
+                if self._step_shuffle(op, st):
+                    progressed = True
+            elif isinstance(op, AllToAllPhysicalOp) and st.inputs_done[0] \
                     and st.in_flight == 0:
                 self._run_all_to_all(op, st)
                 for b in list(st.outqueue) if op is not self.dag else []:
@@ -864,10 +1077,19 @@ class StreamingExecutor:
                     bundle = match
                     remaining = op.limit - st.rows_emitted
                     if bundle.metadata.num_rows > remaining:
-                        b, m = ray_tpu.remote(num_returns=2)(
+                        b, _m = ray_tpu.remote(num_returns=2)(
                             _slice_task).remote(bundle.block_ref, 0, remaining)
-                        bundle = RefBundle(b, ray_tpu.get(m),
-                                           order=bundle.order)
+                        # Derive the sliced metadata locally instead of a
+                        # blocking get: row count is exact, bytes scale.
+                        old = bundle.metadata
+                        frac = remaining / max(old.num_rows, 1)
+                        meta = BlockMetadata(
+                            num_rows=remaining,
+                            size_bytes=int((old.size_bytes or 0) * frac),
+                            schema=old.schema,
+                            input_files=old.input_files,
+                            exec_stats=old.exec_stats)
+                        bundle = RefBundle(b, meta, order=bundle.order)
                     st.rows_emitted += bundle.metadata.num_rows
                     if op is self.dag:
                         st.outqueue.append(bundle)

@@ -10,7 +10,9 @@ yields jax arrays staged host->HBM with double buffering.
 
 from __future__ import annotations
 
+import queue as queue_mod
 import threading
+import time
 
 from ray_tpu.devtools import locktrace
 from collections import deque
@@ -21,6 +23,97 @@ import pyarrow as pa
 
 import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.context import DataContext
+from ray_tpu.util import metrics
+
+PREFETCH_WAIT = metrics.Histogram(
+    "ray_tpu_data_prefetch_wait_seconds",
+    "Time the consumer blocked waiting for the next prefetched batch "
+    "(non-trivial values mean the trainer stalled on data)")
+
+_SENTINEL = object()
+
+
+class _PrefetchingIter:
+    """Pulls `source` on a background daemon thread through a bounded
+    queue, so production (block fetch, batching, device staging) overlaps
+    the consumer's work. Depth bounds memory; consumer wait times are
+    flushed to the prefetch-wait histogram via one record_batch per
+    window (never one RPC per batch)."""
+
+    _FLUSH_EVERY = 32
+
+    def __init__(self, source: Iterator, depth: int):
+        self._queue: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._waits: List[float] = []
+        # Observability for tests/bench: when the producer finished, and
+        # total seconds the consumer spent blocked on the queue.
+        self.producer_done_time: Optional[float] = None
+        self.wait_seconds_total = 0.0
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._produce, args=(source,),
+            name="rtpu-data-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _produce(self, source: Iterator) -> None:
+        try:
+            for item in source:
+                if not self._put((item,)):
+                    return  # consumer went away
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._exc = e
+        finally:
+            self.producer_done_time = time.monotonic()
+            self._put(_SENTINEL)
+
+    def _flush_waits(self) -> None:
+        waits, self._waits = self._waits, []
+        if waits:
+            metrics.record_batch([
+                ("histogram", "ray_tpu_data_prefetch_wait_seconds", None,
+                 w, PREFETCH_WAIT._boundaries) for w in waits])
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        t0 = time.monotonic()
+        item = self._queue.get()
+        wait = time.monotonic() - t0
+        self.wait_seconds_total += wait
+        self._waits.append(wait)
+        if len(self._waits) >= self._FLUSH_EVERY or item is _SENTINEL:
+            self._flush_waits()
+        if item is _SENTINEL:
+            self._done = True
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            raise StopIteration
+        return item[0]
+
+    def close(self) -> None:
+        self._stop.set()
+        self._flush_waits()
+
+    def __del__(self):
+        # Abandoned mid-iteration (e.g. an early break): unblock the
+        # producer so its thread exits instead of spinning on put().
+        self._stop.set()
 
 
 def _slice_concat(blocks: deque, batch_size: int) -> Optional[Block]:
@@ -111,12 +204,21 @@ class DataIterator:
                      batch_format: str = "numpy", drop_last: bool = False,
                      local_shuffle_buffer_size: Optional[int] = None,
                      local_shuffle_seed: Optional[int] = None,
-                     prefetch_batches: int = 1):
-        return iter_batches_from_blocks(
+                     prefetch_batches: Optional[int] = None):
+        """Iterate host batches. With ``prefetch_batches`` > 0 (default:
+        DataContext.iterator_prefetch_batches) block fetch + batching run
+        on a background thread, `prefetch_batches` deep; 0 disables."""
+        batches = iter_batches_from_blocks(
             self._block_iter(), batch_size=batch_size,
             batch_format=batch_format, drop_last=drop_last,
             local_shuffle_buffer_size=local_shuffle_buffer_size,
             local_shuffle_seed=local_shuffle_seed)
+        if prefetch_batches is None:
+            prefetch_batches = \
+                DataContext.get_current().iterator_prefetch_batches
+        if prefetch_batches and prefetch_batches > 0:
+            return _PrefetchingIter(batches, prefetch_batches)
+        return batches
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            dtypes=None, device: str = "cpu", **kw):
@@ -133,12 +235,19 @@ class DataIterator:
 
     def iter_device_batches(self, *, batch_size: Optional[int] = 256,
                             sharding=None, dtypes=None, drop_last: bool = True,
-                            prefetch: int = 2, **kw):
-        """Yield batches as jax.Arrays on device, with a small host-side
-        prefetch queue so host->HBM transfer overlaps compute
-        (TPU-native equivalent of iter_torch_batches+pin_memory)."""
+                            prefetch: Optional[int] = None, **kw):
+        """Yield batches as jax.Arrays on device, double-buffered: a
+        producer thread runs batching AND the `device_put` dispatch, so
+        host->HBM transfer of batch n+1..n+prefetch overlaps the
+        consumer's compute on batch n (TPU-native equivalent of
+        iter_torch_batches+pin_memory). The old implementation dispatched
+        device_put on the consumer's critical path."""
         import jax
         import jax.numpy as jnp
+
+        if prefetch is None:
+            prefetch = DataContext.get_current().device_prefetch_batches
+        prefetch = max(1, prefetch)
 
         def to_device(batch: Dict[str, np.ndarray]):
             out = {}
@@ -150,15 +259,15 @@ class DataIterator:
                 out[k] = arr
             return out
 
-        queue: deque = deque()
+        # Host batching stays synchronous HERE (prefetch_batches=0) —
+        # the device-staging thread below is the producer; stacking a
+        # second queue between them would only add latency.
         it = self.iter_batches(batch_size=batch_size, batch_format="numpy",
-                               drop_last=drop_last, **kw)
-        for batch in it:
-            queue.append(to_device(batch))  # async dispatch
-            if len(queue) > prefetch:
-                yield queue.popleft()
-        while queue:
-            yield queue.popleft()
+                               drop_last=drop_last,
+                               prefetch_batches=0, **kw)
+        staged = _PrefetchingIter((to_device(b) for b in it), prefetch)
+        self._last_device_iter = staged  # overlap stats for tests/bench
+        return staged
 
     def materialize_blocks(self) -> List[Block]:
         return list(self._block_iter())
@@ -226,6 +335,19 @@ class _SplitCoordinator:
                 return self.queues[split_idx].popleft()
             return None
 
+    def get_next_many(self, split_idx: int, k: int):
+        """Up to ``k`` block refs in one RPC (empty list = exhausted) —
+        halves the per-block round-trips of the get_next protocol."""
+        out = []
+        with self.lock:
+            while len(out) < k:
+                while not self.queues[split_idx] and not self.done:
+                    self._pump()
+                if not self.queues[split_idx]:
+                    break
+                out.append(self.queues[split_idx].popleft())
+        return out
+
 
 class _SplitIterator(DataIterator):
     def __init__(self, coordinator, split_idx: int, n: int):
@@ -234,14 +356,20 @@ class _SplitIterator(DataIterator):
         self._n = n
         self._epoch = 0
 
+    _FETCH_BATCH = 4
+
     def _block_iter(self):
         ray_tpu.get(self._coord.start_epoch.remote(self._epoch))
         self._epoch += 1
         while True:
-            ref = ray_tpu.get(self._coord.get_next.remote(self._idx))
-            if ref is None:
+            refs = ray_tpu.get(
+                self._coord.get_next_many.remote(self._idx,
+                                                 self._FETCH_BATCH))
+            if not refs:
                 return
-            yield ray_tpu.get(ref)
+            # one batched fetch for the whole window of blocks
+            for block in ray_tpu.get(list(refs)):
+                yield block
 
 
 def make_streaming_split(dataset, n: int, equal: bool) -> List[DataIterator]:

@@ -20,7 +20,7 @@ _METRIC_SUFFIXES = {
               "_tokens", "_total", "_size", "_count", "_percent",
               "_occupancy", "_workers", "_nodes", "_replicas", "_mfu",
               "_flag", "_info", "_actors", "_objects", "_tasks",
-              "_per_second", "_steps", "_pending", "_fds"),
+              "_per_second", "_steps", "_pending", "_fds", "_in_flight"),
 }
 
 

@@ -178,6 +178,12 @@ def get_dataset_shard(name: str = "train"):
     ds = ctx.datasets.get(name)
     if ds is None:
         return None
+    from ray_tpu.data.iterator import DataIterator
+    if isinstance(ds, DataIterator):
+        # Already this rank's split — the trainer splits once
+        # driver-side; splitting again here would execute the whole
+        # dataset once per worker.
+        return ds
     if hasattr(ds, "streaming_split"):
         return ds.streaming_split(ctx.world_size)[ctx.world_rank]
     return ds

@@ -232,8 +232,6 @@ class JaxTrainer:
             storage, self.run_config.checkpoint_config.num_to_keep)
         max_failures = self.run_config.failure_config.max_failures
         loop_blob = serialization.dumps(self.train_loop)
-        datasets_blob = (serialization.dumps(self.datasets)
-                        if self.datasets else None)
         last_error: Optional[Exception] = None
 
         policy = self.scaling_config.resolved_scaling_policy()
@@ -253,11 +251,17 @@ class JaxTrainer:
             resume = manager.latest()
             try:
                 self._transition("RUNNING")
+                # Split streaming datasets ONCE here and ship each rank
+                # its own iterator: n workers each calling
+                # streaming_split would spin up n coordinators, each
+                # executing the whole dataset. Rebuilt per attempt so an
+                # elastic resize re-splits at the new world size.
+                datasets_blobs = self._rank_datasets_blobs(len(workers))
                 refs = [
                     w.run.remote(loop_blob, self.train_loop_config,
                                  resume.path if resume else None,
-                                 datasets_blob)
-                    for w in workers
+                                 datasets_blobs[rank])
+                    for rank, w in enumerate(workers)
                 ]
                 all_reports = ray_tpu.get(refs)
                 self._transition("FINISHED")
@@ -304,6 +308,23 @@ class JaxTrainer:
         if new_world != world:
             self._transition("RESIZING")
         return new_world
+
+    def _rank_datasets_blobs(self, world: int) -> List[Optional[bytes]]:
+        """Per-rank serialized datasets dicts: streaming datasets are
+        split once driver-side into per-rank iterators sharing ONE
+        coordinator/execution; non-splittable values ship whole."""
+        if not self.datasets:
+            return [None] * world
+        per_rank: List[Dict[str, Any]] = [{} for _ in range(world)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                shards = ds.streaming_split(world)
+                for rank in range(world):
+                    per_rank[rank][name] = shards[rank]
+            else:
+                for rank in range(world):
+                    per_rank[rank][name] = ds
+        return [serialization.dumps(d) for d in per_rank]
 
     def _create_worker_group(self, storage: str,
                              num_workers: Optional[int] = None):
