@@ -16,6 +16,7 @@ from ray_tpu.api import (
     available_resources,
     cancel,
     cluster_resources,
+    flight_journal,
     get,
     get_actor,
     get_runtime_context,
@@ -28,6 +29,7 @@ from ray_tpu.api import (
     shutdown,
     timeline,
     wait,
+    whereis,
 )
 from ray_tpu.core.generator import ObjectRefGenerator
 from ray_tpu.core.object_ref import ObjectRef
@@ -40,6 +42,7 @@ __all__ = [
     "cancel",
     "cluster_resources",
     "exceptions",
+    "flight_journal",
     "get",
     "get_actor",
     "get_runtime_context",
@@ -55,4 +58,5 @@ __all__ = [
     "shutdown",
     "timeline",
     "wait",
+    "whereis",
 ]

@@ -215,3 +215,28 @@ def timeline(filename: Optional[str] = None,
     dedicated row). See ray_tpu/util/timeline.py."""
     from ray_tpu.util.timeline import timeline as _timeline
     return _timeline(filename, trace_id=trace_id)
+
+
+def whereis(journal_file: Optional[str] = None, render: bool = True):
+    """Step-time attribution from the flight-recorder journal: folds
+    the merged per-process journals into compute / comms / data-wait /
+    pipeline-bubble / idle fractions per step and compares the measured
+    bubble against the schedule's theoretical one. Reads the live
+    journal store by default, or a ``flight_journal()`` dump when
+    ``journal_file`` is given. Returns the report dict (and prints the
+    rendered table unless ``render=False``)."""
+    from ray_tpu.devtools import whereis as _whereis
+    journals = (_whereis._load_journals(journal_file)
+                if journal_file else None)
+    report = _whereis.attribution(journals)
+    if render:
+        print(_whereis.render(report))
+    return report
+
+
+def flight_journal(filename: Optional[str] = None):
+    """Dump the merged (clock-aligned) flight-recorder journals — the
+    raw per-process event streams behind ``timeline()``/``whereis()``.
+    Writes JSON when ``filename`` is given; returns the payload dict."""
+    from ray_tpu.util import flight_recorder
+    return flight_recorder.dump_journals(filename)

@@ -150,6 +150,22 @@ class Config:
     task_events_enabled: bool = True
     task_events_buffer_size: int = 100_000
     log_to_driver: bool = True
+    # Distinct traces retained in the GCS trace store — LRU-evicted by
+    # last-span arrival time so a loadgen run can't grow the store
+    # without bound. Spans per trace are bounded separately.
+    trace_store_max_traces: int = 512
+    trace_store_max_spans: int = 4096
+
+    # --- flight recorder (util/flight_recorder.py) ---
+    # Per-process ring-buffer event journal + driver-side collector;
+    # off by default — when off the instrumentation hot paths cost two
+    # loads and a compare.
+    flight_recorder_enabled: bool = False
+    # Event slots preallocated per process (ring wraps, newest wins).
+    flight_recorder_capacity: int = 4096
+    # Cadence of the worker flusher thread (clock ping-pong + journal
+    # increment push over the control channel).
+    flight_flush_interval_s: float = 0.2
 
     # --- rpc chaos (fault injection; reference: rpc_chaos.h) ---
     # JSON map of "method" -> failure probability in [0,1].

@@ -21,7 +21,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -235,8 +235,15 @@ class Gcs:
         # Distributed-trace spans (proxy/router/replica/engine hops and
         # user tracing.span() blocks) — tuple layout (trace_id, span_id,
         # parent_span_id, name, component, t_start, duration, tags).
-        # Same bounded-ring discipline as task events.
-        self.trace_spans: deque = deque(maxlen=cfg.task_events_buffer_size)
+        # Grouped per trace in an OrderedDict ordered by last-span
+        # arrival: append moves the trace to the end, and traces past
+        # trace_store_max_traces are LRU-evicted from the front (a
+        # loadgen run mints a fresh trace per request — unbounded, the
+        # store ate the heap). Spans within one trace are a bounded
+        # ring too (trace_store_max_spans).
+        self.trace_spans: "OrderedDict[str, deque]" = OrderedDict()
+        self._trace_cap = max(1, cfg.trace_store_max_traces)
+        self._trace_span_cap = max(16, cfg.trace_store_max_spans)
         if store is not None:
             self._restore_from_store()
 
@@ -448,14 +455,24 @@ class Gcs:
     # --- distributed-trace spans ---------------------------------------
     def add_trace_span(self, span) -> None:
         """Append one finished span: (trace_id, span_id, parent_span_id,
-        name, component, t_start, duration, tags)."""
+        name, component, t_start, duration, tags). Touching a trace
+        moves it to the LRU tail; the coldest trace is evicted once the
+        store holds more than trace_store_max_traces traces."""
         if get_config().task_events_enabled:
             with self.lock:
-                self.trace_spans.append(span)
+                entry = self.trace_spans.get(span[0])
+                if entry is None:
+                    entry = deque(maxlen=self._trace_span_cap)
+                    self.trace_spans[span[0]] = entry
+                else:
+                    self.trace_spans.move_to_end(span[0])
+                entry.append(span)
+                while len(self.trace_spans) > self._trace_cap:
+                    self.trace_spans.popitem(last=False)
 
     def spans_for_trace(self, trace_id: str) -> List[tuple]:
         with self.lock:
-            return [s for s in self.trace_spans if s[0] == trace_id]
+            return list(self.trace_spans.get(trace_id, ()))
 
     def events_for_trace(self, trace_id: str,
                          limit: int = 100_000) -> List[TaskEvent]:
@@ -463,14 +480,8 @@ class Gcs:
                 if ev.trace_id == trace_id]
 
     def recent_trace_ids(self, limit: int = 100) -> List[str]:
-        """Most-recent distinct trace ids seen in the span store,
-        newest first (the dashboard's trace index)."""
+        """Most-recently-touched trace ids, newest first (the
+        dashboard's trace index) — the LRU order read backwards."""
         with self.lock:
-            spans = list(self.trace_spans)
-        seen: List[str] = []
-        for span in reversed(spans):
-            if span[0] not in seen:
-                seen.append(span[0])
-                if len(seen) >= limit:
-                    break
-        return seen
+            ids = list(self.trace_spans)
+        return ids[::-1][:limit]

@@ -48,6 +48,7 @@ from ray_tpu.core import protocol, serialization
 from ray_tpu.core.config import get_config
 from ray_tpu.devtools import locktrace, threadguard
 from ray_tpu.native import _lib
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util.metrics import Gauge, Histogram
 
 logger = logging.getLogger(__name__)
@@ -681,12 +682,17 @@ class IOLoop:
     def _dispatch(self, conn: LoopConnection, frames) -> None:
         self._dispatch_n += 1
         timed = self._report_metrics and (self._dispatch_n & 63) == 0
+        rec = _flight.RECORDER  # lock-free journal; no RPC (GL013)
         t0 = time.perf_counter() if timed else 0.0
+        t0_ns = rec.clock() if rec is not None else 0
         try:
             conn._on_frames(conn, frames)
         except Exception:
             logger.exception("io_loop: frame handler error (%s)",
                              conn.label)
+        if rec is not None:
+            rec.record("io", "dispatch", t0_ns, rec.clock() - t0_ns,
+                       {"conn": conn.label, "frames": len(frames)})
         if timed:
             # observe_local: a forwarding _record from the loop thread
             # would block on a reply only this thread can dispatch.
@@ -703,8 +709,10 @@ class IOLoop:
             return
         # Pull stream chunks while there's room: the stream never
         # outruns the socket by more than ~low_water bytes.
+        rec = _flight.RECORDER  # lock-free journal; no RPC (GL013)
         while conn._streams and remaining < conn._low_water:
             gen, on_done = conn._streams[0]
+            t0_ns = rec.clock() if rec is not None else 0
             try:
                 chunk = next(gen)
             except StopIteration:
@@ -715,6 +723,10 @@ class IOLoop:
                 conn._streams.popleft()
                 self._stream_done(on_done, exc)
                 continue
+            if rec is not None:
+                rec.record("io", "stream_chunk", t0_ns,
+                           rec.clock() - t0_ns,
+                           {"conn": conn.label, "bytes": len(chunk)})
             try:
                 conn._codec.enqueue(bytes(chunk))
             except OSError as exc:

@@ -33,6 +33,7 @@ from ray_tpu.core.protocol import (
     listen_tcp,
     send_msg,
 )
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util.metrics import Counter, Histogram
 
 # Object-plane transfer instrumentation (reference: object manager
@@ -347,6 +348,9 @@ class ObjectServer:
                 # no-RPC local write is mandatory (GL010)
                 TRANSFER_BYTES.inc_local(
                     float(size), tags={"transport": "tcp_out"})
+                # loop-path journal write: lock-free local api (GL013)
+                _flight.instant("object", "serve_spill_out",
+                                {"bytes": size})
             self._finished(pc)
 
         try:
@@ -394,6 +398,9 @@ class ObjectServer:
                 # loop-path metric write: *_local only (GL010)
                 TRANSFER_BYTES.inc_local(
                     float(size), tags={"transport": "tcp_out"})
+                # loop-path journal write: lock-free local api (GL013)
+                _flight.instant("object", "serve_out",
+                                {"oid": oid.hex()[:12], "bytes": size})
             self._finished(pc)
 
         try:
@@ -517,6 +524,11 @@ def pull_object(addr: Tuple[str, int], object_id: ObjectID, dest_store,
         TRANSFER_BYTES.inc(float(size), tags={"transport": "tcp"})
         TRANSFER_SECONDS.observe(time.perf_counter() - t0,
                                  tags={"transport": "tcp"})
+        rec = _flight.RECORDER
+        if rec is not None:
+            dur_ns = int((time.perf_counter() - t0) * 1e9)
+            rec.record("object", "pull", rec.clock() - dur_ns, dur_ns,
+                       {"oid": object_id.hex()[:12], "bytes": size})
         return True
     except OSError:
         # Only roll back an entry THIS call created — a concurrent

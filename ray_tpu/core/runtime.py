@@ -108,6 +108,11 @@ class DriverRuntime:
             from ray_tpu.core.gcs_store import FileStoreClient
             store = FileStoreClient(cfg.gcs_persistence_path)
         self.gcs = Gcs(store=store)
+        # Fresh flight-recorder collector per session; enables the
+        # driver's own journal (and the env flags workers inherit)
+        # when cfg.flight_recorder_enabled.
+        from ray_tpu.util import flight_recorder
+        flight_recorder.init_driver()
         self.scheduler = ClusterScheduler(self.gcs)
         self.task_manager = TaskManager()
         self.reference_counter = ReferenceCounter()
@@ -1307,9 +1312,14 @@ class DriverRuntime:
             elif spec.is_actor_creation:
                 pass  # handled by actor restart below
             else:
-                err: Exception = WorkerCrashedError(
-                    f"worker {worker.worker_id.hex()[:8]} died while running "
-                    f"{spec.name or spec.function_id}")
+                msg = (f"worker {worker.worker_id.hex()[:8]} died while "
+                       f"running {spec.name or spec.function_id}")
+                # post-mortem: the collector still holds the dead
+                # process's last-flushed journal
+                from ray_tpu.util import flight_recorder
+                msg += flight_recorder.store_tail_text(
+                    f"worker:{worker.worker_id.hex()[:12]}")
+                err: Exception = WorkerCrashedError(msg)
                 if spec.actor_id is not None:
                     err = ActorUnavailableError(spec.actor_id, str(err))
                 self._record_event(spec, "FAILED", node_id=node.node_id,
@@ -1345,6 +1355,8 @@ class DriverRuntime:
         info = self.actors.get(actor_id)
         if record is None or info is None:
             return
+        with info.lock:  # captured before the restart path clears it
+            dead_worker = info.worker_id
         dead_node = node if getattr(node, "is_remote", False) else None
         self._release_actor_resources(info, dead_node=dead_node)
         if record.state == "DEAD":
@@ -1389,8 +1401,16 @@ class DriverRuntime:
         else:
             self.gcs.update_actor_state(actor_id, "DEAD",
                                         death_cause="worker died")
+            msg = "actor worker died"
+            if dead_worker is not None:
+                # post-mortem: the collector still holds the dead
+                # process's last-flushed journal — name what it was
+                # doing in its final moments
+                from ray_tpu.util import flight_recorder
+                msg += flight_recorder.store_tail_text(
+                    f"worker:{dead_worker.hex()[:12]}")
             self._fail_actor_buffer(
-                actor_id, ActorDiedError(actor_id, "actor worker died"))
+                actor_id, ActorDiedError(actor_id, msg))
 
     def _fail_actor_buffer(self, actor_id: ActorID, err: Exception) -> None:
         info = self.actors.get(actor_id)
@@ -2105,6 +2125,17 @@ class DriverRuntime:
             return True
         if method == "trace_add_span":
             self.gcs.add_trace_span(args[0])
+            return True
+        if method == "flight_sync":
+            # clock ping-pong: the worker brackets this call with its
+            # own clock reads and derives its offset into our domain
+            from ray_tpu.util import flight_recorder
+            return flight_recorder.clock_ns()
+        if method == "flight_push":
+            # journal increment from a worker flusher; brief/lock-only
+            # (this may run on the head's IO-loop thread)
+            from ray_tpu.util import flight_recorder
+            flight_recorder.store_push(args[0], args[1], args[2])
             return True
         raise ValueError(f"unknown GCS method {method}")
 

@@ -34,6 +34,7 @@ from ray_tpu.core.protocol import MessageConnection
 from ray_tpu.core.task_manager import ReferenceCounter
 from ray_tpu.core.task_spec import Arg, TaskSpec
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError, TaskError
+from ray_tpu.util import flight_recorder as _flight
 
 
 class _ContextValue:
@@ -168,9 +169,15 @@ class WorkerRuntime:
         # ref + PUT_META, so determinism buys nothing).
         oid = ObjectID.from_random()
         sizes = [b.nbytes for b in buffers]
+        nbytes = serialization.packed_size(data, sizes)
+        rec = _flight.RECORDER
+        t0_ns = rec.clock() if rec is not None else 0
         self._store_with_spill(
             lambda: self.store.put_parts(oid, data, buffers, sizes),
-            serialization.packed_size(data, sizes))
+            nbytes)
+        if rec is not None:
+            rec.record("object", "put", t0_ns, rec.clock() - t0_ns,
+                       {"oid": oid.hex()[:12], "bytes": nbytes})
         self.conn.send({"kind": "PUT_META", "object_id": oid.binary(),
                         "contained": list(contained)})
         return ObjectRef(oid)
@@ -218,12 +225,18 @@ class WorkerRuntime:
         # arriving while blocked bounce straight back (enter/exit).
         if self.on_block is not None:
             self.on_block(True)
+        rec = _flight.RECORDER
+        t0_ns = rec.clock() if rec is not None else 0
         try:
             reply = self.request(
                 {"kind": "GET_OBJECT", "object_id": oid.binary()},
                 timeout=timeout if timeout is not None else None,
             )
         finally:
+            if rec is not None:
+                rec.record("object", "get_wait", t0_ns,
+                           rec.clock() - t0_ns,
+                           {"oid": oid.hex()[:12]})
             if self.on_block is not None:
                 self.on_block(False)
         status = reply["status"]
@@ -615,6 +628,11 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
 
     from ray_tpu.core import runtime as runtime_mod
     runtime_mod.set_runtime(rt)
+
+    # Flight recorder: enable + start the journal flusher when the
+    # driver turned it on (flag rides the inherited environment).
+    from ray_tpu.util import flight_recorder
+    flight_recorder.init_worker(rt, worker_id)
 
     from ray_tpu.core.protocol import PROTOCOL_VERSION
     conn.send({"kind": "REGISTER", "worker_id": worker_id.binary(),

@@ -199,9 +199,15 @@ class CompiledDAGRef:
             self._value = self._dag._read_output(self._seq, timeout)
             self._fetched = True
         if isinstance(self._value, _ErrorToken):
+            from ray_tpu.util import flight_recorder
+            # post-mortem: the failing node attached its flight-
+            # recorder tail at raise time (it rode the pickled
+            # exception's __dict__) — surface what the stage was doing
             raise DAGExecutionError(
                 f"node {self._value.node_name!r} failed: "
-                f"{self._value.error!r}") from self._value.error
+                f"{self._value.error!r}"
+                + flight_recorder.tail_text(self._value.error)
+            ) from self._value.error
         return self._value
 
 

@@ -23,6 +23,7 @@ import pyarrow as pa
 
 import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.data.context import DataContext
 from ray_tpu.data.transforms import MapTransform, apply_transform_chain
 from ray_tpu.util import metrics
@@ -511,6 +512,8 @@ class _ShuffleState:
         self.peak_in_flight_blocks = 0
         self.bytes_map_in = 0
         self.bytes_reduce_out = 0
+        # flight-recorder launch stamps: ("map"|"reduce", order) -> ns
+        self.flight_t0: Dict[Tuple[str, int], int] = {}
 
     def note_in_flight(self) -> int:
         cur = (len(self.shards) + self.maps_in_flight
@@ -828,6 +831,9 @@ class StreamingExecutor:
                     _shuffle_map_task).remote(bundle.block_ref, ss.n_out,
                                               seed_j)
                 shards = (shards,) if ss.n_out == 1 else tuple(shards)
+                rec = _flight.RECORDER
+                if rec is not None:
+                    ss.flight_t0[("map", bundle.order)] = rec.clock()
                 self.pending[shards[0]] = (
                     "shuffle_map", op, bundle.order, shards)
                 ss.maps_in_flight += 1
@@ -859,6 +865,9 @@ class StreamingExecutor:
             b_ref, m_ref = ray_tpu.remote(num_returns=2)(
                 _shuffle_reduce_task).remote(
                     seed, *[ss.shards[j][i] for j in range(lo, hi)])
+            rec = _flight.RECORDER
+            if rec is not None:
+                ss.flight_t0[("reduce", w * ss.n_out + i)] = rec.clock()
             self.pending[m_ref] = (
                 "shuffle_reduce", op, b_ref, w * ss.n_out + i)
             ss.reduces_in_flight += 1
@@ -977,6 +986,13 @@ class StreamingExecutor:
                     ss.maps_in_flight -= 1
                     ss.maps_done += 1
                     st.in_flight -= 1
+                    rec = _flight.RECORDER
+                    if rec is not None:
+                        t0 = ss.flight_t0.pop(("map", order), None)
+                        if t0 is not None:
+                            rec.record("shuffle", "map_wave", t0,
+                                       rec.clock() - t0,
+                                       {"order": order})
                     progressed = True
                     continue
                 meta = metas[m_ref]
@@ -990,6 +1006,16 @@ class StreamingExecutor:
                                  {"stage": "reduce"}, meta.size_bytes or 0)
                     if ss.first_output_maps_done is None:
                         ss.first_output_maps_done = ss.maps_done
+                    rec = _flight.RECORDER
+                    if rec is not None:
+                        t0 = ss.flight_t0.pop(("reduce", order), None)
+                        if t0 is not None:
+                            rec.record(
+                                "shuffle", "reduce_wave", t0,
+                                rec.clock() - t0,
+                                {"order": order,
+                                 "wave": order // ss.n_out,
+                                 "bytes": meta.size_bytes or 0})
                     actor_idx = None
                 else:
                     _tag, _op, b_ref, actor_idx, order = ent

@@ -24,6 +24,7 @@ import pyarrow as pa
 import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.context import DataContext
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util import metrics
 
 PREFETCH_WAIT = metrics.Histogram(
@@ -59,11 +60,19 @@ class _PrefetchingIter:
         self._thread.start()
 
     def _put(self, item) -> bool:
+        rec = _flight.RECORDER
+        t0_ns = rec.clock() if rec is not None else 0
+        blocked = False
         while not self._stop.is_set():
             try:
                 self._queue.put(item, timeout=0.1)
+                if blocked and rec is not None:
+                    # producer outran the consumer: queue-full stall
+                    rec.record("prefetch", "producer_wait", t0_ns,
+                               rec.clock() - t0_ns, None)
                 return True
             except queue_mod.Full:
+                blocked = True
                 continue
         return False
 
@@ -91,9 +100,14 @@ class _PrefetchingIter:
     def __next__(self):
         if self._done:
             raise StopIteration
+        rec = _flight.RECORDER
+        t0_ns = rec.clock() if rec is not None else 0
         t0 = time.monotonic()
         item = self._queue.get()
         wait = time.monotonic() - t0
+        if rec is not None:
+            rec.record("prefetch", "consumer_wait", t0_ns,
+                       rec.clock() - t0_ns, None)
         self.wait_seconds_total += wait
         self._waits.append(wait)
         if len(self._waits) >= self._FLUSH_EVERY or item is _SENTINEL:

@@ -223,9 +223,44 @@ def step_pipeline() -> Tuple[str, str]:
     return "ok", f"{checked} schedule shapes validated"
 
 
+def step_recorder() -> Tuple[str, str]:
+    """Flight-recorder smoke, fully in-process: record events into a
+    fresh ring, push a fake worker journal with a known clock offset,
+    merge, export Chrome-trace events, and parse the JSON round-trip."""
+    import json as _json
+    from ray_tpu.util import flight_recorder as fr
+    saved = (fr.RECORDER, fr._STORE)
+    try:
+        fr._STORE = fr.FlightStore()
+        rec = fr.enable("driver:check", capacity=64)
+        for i in range(8):
+            t0 = fr.clock_ns()
+            rec.record("io", "dispatch", t0, 1_000, {"i": i})
+        # a fake worker whose clock runs 5ms behind the driver's
+        fr.store_push("worker:check", [(0, fr.clock_ns() - 5_000_000,
+                                        2_000, "pipeline", "FWD",
+                                        {"stage": 0})], 5_000_000)
+        merged = fr.merged_journals()
+        if set(merged) != {"driver:check", "worker:check"}:
+            return "FAIL", f"merge lost a journal: {sorted(merged)}"
+        events = fr.chrome_events()
+        payload = _json.loads(_json.dumps(events))
+        if len(payload) != 9:
+            return "FAIL", f"expected 9 trace events, got {len(payload)}"
+        for ev in payload:
+            if not {"name", "ph", "ts", "pid", "tid"} <= set(ev):
+                return "FAIL", f"malformed trace event: {ev}"
+            if ev["ph"] == "X" and not isinstance(ev["dur"], (int, float)):
+                return "FAIL", f"X event without numeric dur: {ev}"
+        return "ok", f"{len(payload)} events merged across 2 journals"
+    finally:
+        fr.RECORDER, fr._STORE = saved
+
+
 _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("lint", step_lint),
     ("pipeline", step_pipeline),
+    ("recorder", step_recorder),
     ("locktrace", step_locktrace),
     ("threadguard", step_threadguard),
     ("stress", step_stress),

@@ -1,4 +1,4 @@
-"""Loop-thread affinity rules (GL009-GL012).
+"""Loop-thread affinity rules (GL009-GL013).
 
 These are *project* rules: they consume the interprocedural
 ``ProjectContext`` (callgraph.py) instead of a single file, because
@@ -120,6 +120,60 @@ class LoopThreadMetricRPC(Rule):
                         f"metric write {dotted}() can RPC the driver "
                         f"from the loop thread "
                         f"({project.chain_str(key)}); use {fix}")
+
+
+@register
+class LoopThreadTracingRPC(Rule):
+    id = "GL013"
+    name = "loop-thread-tracing-rpc"
+    project = True
+    rationale = ("tracing.span()/record_span() ship the finished span "
+                 "over a sync gcs_call on workers; from the loop thread "
+                 "that reply can only be dispatched by the thread that "
+                 "is waiting for it — instrument loop-reachable paths "
+                 "with the lock-free flight_recorder.record() journal "
+                 "instead")
+
+    #: emitters in ray_tpu.util.tracing that end in a sync control-plane
+    #: RPC off-driver (profile() is excluded: it appends to a local list)
+    _RPC_EMITTERS = {"span", "record_span"}
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        for key in sorted(project.loop_ctx):
+            info = project.functions[key]
+            path = info.ctx.path
+            for call in project.body_calls(info.node):
+                dotted = _dotted(call.func)
+                if dotted is None:
+                    continue
+                leaf = _leaf(dotted)
+                if leaf not in self._RPC_EMITTERS:
+                    continue
+                if not self._is_tracing_emitter(project, path, dotted):
+                    continue
+                yield info.ctx.finding(
+                    self.id, call,
+                    f"span emission {dotted}() can RPC the driver from "
+                    f"the loop thread ({project.chain_str(key)}); "
+                    "record into the flight_recorder journal instead "
+                    "(lock-free, no RPC)")
+
+    @staticmethod
+    def _is_tracing_emitter(project: ProjectContext, path: str,
+                            dotted: str) -> bool:
+        """True when ``dotted`` resolves (via this file's absolute
+        imports) to ray_tpu.util.tracing.span/record_span."""
+        imports = project._imports.get(path, {})
+        base = dotted.split(".", 1)[0]
+        imp = imports.get(base)
+        if imp is None:
+            return False
+        module, orig = imp
+        resolved = f"{module}.{orig}" if orig else module
+        if "." in dotted:
+            resolved = resolved + "." + dotted.split(".", 1)[1]
+        return resolved in ("ray_tpu.util.tracing.span",
+                            "ray_tpu.util.tracing.record_span")
 
 
 @register

@@ -1,0 +1,211 @@
+"""whereis: step-time attribution from the flight-recorder journal.
+
+Answers "where did my step time go" by folding the merged (clock-
+aligned) journals into per-step fractions:
+
+* **compute** — FWD/BWD/STEP instruction time on pipeline stages,
+* **comms**   — SEND/RECV channel time plus collective-hop time,
+* **data_wait** — prefetch consumer stalls (the trainer starving on
+  input) measured on the consuming process,
+* **bubble**  — ``1 - compute/wall`` per stage, the SAME formula the
+  live pipeline report uses (so the measured number here must agree
+  with ``PipelineRunner.step()``'s within noise),
+* **idle**    — whatever the named categories don't cover.
+
+Usage::
+
+    ray_tpu.whereis()                      # live, after some steps ran
+    ray_tpu.flight_journal("run.json")     # dump for offline analysis
+    python -m ray_tpu.devtools.whereis run.json
+
+The theoretical bubble is recomputed from the schedule parameters the
+stage spans carry (``(S-1)/(M+S-1)`` for 1F1B/GPipe) and printed next
+to the measured one — the gap is what schedule tuning can recover.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+_COMPUTE_OPS = ("FWD", "BWD", "STEP")
+_COMMS_OPS = ("SEND", "RECV")
+
+
+def attribution(journals: Optional[Dict[str, List[tuple]]] = None
+                ) -> Dict[str, Any]:
+    """Fold journals (label -> aligned event tuples) into the
+    attribution report. With no argument, reads the live merged
+    journals from the flight recorder."""
+    if journals is None:
+        from ray_tpu.util import flight_recorder
+        journals = flight_recorder.merged_journals()
+
+    # per (stage, step): wall/compute from the stage_step envelope,
+    # comms summed from SEND/RECV instruction spans
+    per: Dict[tuple, Dict[str, float]] = {}
+    sched_params = None  # (schedule, S, M) off any stage_step span
+    data_wait_ns = 0
+    coll_count = 0
+    coll_wire = 0
+    coll_ratios: List[float] = []
+    t_lo: Optional[int] = None
+    t_hi: Optional[int] = None
+
+    for label, events in journals.items():
+        for seq, t0, dur, cat, name, args in events:
+            t_lo = t0 if t_lo is None else min(t_lo, t0)
+            t_hi = (t0 + dur) if t_hi is None else max(t_hi, t0 + dur)
+            if cat == "pipeline":
+                a = args or {}
+                key = (a.get("stage"), a.get("step"))
+                entry = per.setdefault(
+                    key, {"wall_s": 0.0, "compute_s": 0.0,
+                          "comms_s": 0.0})
+                if name == "stage_step":
+                    entry["wall_s"] = float(a.get("wall_s",
+                                                  dur / 1e9))
+                    entry["compute_s"] = float(a.get("compute_s", 0.0))
+                    if a.get("schedule") is not None:
+                        sched_params = (a.get("schedule"), a.get("S"),
+                                        a.get("m"))
+                elif name in _COMMS_OPS:
+                    entry["comms_s"] += dur / 1e9
+            elif cat == "prefetch" and name == "consumer_wait":
+                data_wait_ns += dur
+            elif cat == "collective":
+                coll_count += 1
+                a = args or {}
+                coll_wire += int(a.get("wire", 0))
+                if "ratio" in (a or {}):
+                    coll_ratios.append(float(a["ratio"]))
+
+    steps = {k: v for k, v in per.items() if v["wall_s"] > 0}
+    wall = sum(v["wall_s"] for v in steps.values())
+    compute = sum(v["compute_s"] for v in steps.values())
+    comms = sum(v["comms_s"] for v in steps.values())
+    window_s = ((t_hi - t_lo) / 1e9 if t_hi is not None else 0.0)
+    data_wait_s = data_wait_ns / 1e9
+
+    # per-stage rollup (bubble = 1 - compute/wall, the live formula)
+    per_stage: Dict[Any, Dict[str, float]] = {}
+    for (stage, _step), v in steps.items():
+        agg = per_stage.setdefault(
+            stage, {"steps": 0, "wall_s": 0.0, "compute_s": 0.0,
+                    "comms_s": 0.0})
+        agg["steps"] += 1
+        agg["wall_s"] += v["wall_s"]
+        agg["compute_s"] += v["compute_s"]
+        agg["comms_s"] += v["comms_s"]
+    for agg in per_stage.values():
+        agg["bubble"] = (max(0.0, 1.0 - agg["compute_s"]
+                             / agg["wall_s"])
+                         if agg["wall_s"] > 0 else 0.0)
+
+    measured_bubble = (sum(a["bubble"] for a in per_stage.values())
+                       / len(per_stage)) if per_stage else None
+
+    theoretical = None
+    if sched_params and sched_params[1] and sched_params[2]:
+        try:
+            from ray_tpu.train.pipeline import schedule as sched_mod
+            theoretical = sched_mod.bubble_fraction(
+                int(sched_params[1]), int(sched_params[2]),
+                sched_params[0])
+        except Exception:  # noqa: BLE001 — old dump, unknown schedule
+            theoretical = None
+
+    frac = {}
+    if wall > 0:
+        c = compute / wall
+        m = comms / wall
+        d = min(1.0, data_wait_s / window_s) if window_s > 0 else 0.0
+        frac = {"compute": round(c, 4), "comms": round(m, 4),
+                "data_wait": round(d, 4),
+                "bubble": round(max(0.0, 1.0 - c), 4),
+                "idle": round(max(0.0, 1.0 - c - m), 4)}
+
+    return {
+        "steps": len({k[1] for k in steps}),
+        "stages": len(per_stage),
+        "window_s": round(window_s, 6),
+        "fractions": frac,
+        "per_stage": {str(k): {kk: (round(vv, 6)
+                                    if isinstance(vv, float) else vv)
+                               for kk, vv in v.items()}
+                      for k, v in sorted(per_stage.items(),
+                                         key=lambda kv: str(kv[0]))},
+        "measured_bubble": (round(measured_bubble, 4)
+                            if measured_bubble is not None else None),
+        "theoretical_bubble": (round(theoretical, 4)
+                               if theoretical is not None else None),
+        "data_wait_s": round(data_wait_s, 6),
+        "collectives": {"count": coll_count, "wire_bytes": coll_wire,
+                        "mean_compression_ratio": (
+                            round(sum(coll_ratios) / len(coll_ratios),
+                                  3) if coll_ratios else None)},
+    }
+
+
+def render(report: Dict[str, Any]) -> str:
+    lines = ["step-time attribution (flight recorder)"]
+    lines.append(f"  pipeline stages: {report['stages']}  "
+                 f"steps: {report['steps']}  "
+                 f"window: {report['window_s'] * 1e3:.1f}ms")
+    frac = report.get("fractions") or {}
+    if frac:
+        lines.append(
+            "  compute %5.1f%%  comms %5.1f%%  data-wait %5.1f%%  "
+            "bubble %5.1f%%  idle %5.1f%%" % (
+                frac["compute"] * 100, frac["comms"] * 100,
+                frac["data_wait"] * 100, frac["bubble"] * 100,
+                frac["idle"] * 100))
+    mb, tb = report["measured_bubble"], report["theoretical_bubble"]
+    if mb is not None:
+        line = f"  measured bubble: {mb:.3f}"
+        if tb is not None:
+            line += f"  theoretical: {tb:.3f}  gap: {mb - tb:+.3f}"
+        lines.append(line)
+    for stage, agg in report["per_stage"].items():
+        lines.append(
+            f"  stage {stage}: steps={agg['steps']} "
+            f"wall={agg['wall_s'] * 1e3:.1f}ms "
+            f"compute={agg['compute_s'] * 1e3:.1f}ms "
+            f"comms={agg['comms_s'] * 1e3:.1f}ms "
+            f"bubble={agg['bubble']:.3f}")
+    coll = report["collectives"]
+    if coll["count"]:
+        lines.append(
+            f"  collectives: {coll['count']} hops, "
+            f"{coll['wire_bytes']} wire bytes, "
+            f"ratio={coll['mean_compression_ratio']}")
+    if report["data_wait_s"]:
+        lines.append(
+            f"  data wait: {report['data_wait_s'] * 1e3:.1f}ms")
+    return "\n".join(lines)
+
+
+def _load_journals(path: str) -> Dict[str, List[tuple]]:
+    with open(path) as f:
+        payload = json.load(f)
+    journals = payload.get("journals", payload)
+    return {label: [tuple(ev) for ev in events]
+            for label, events in journals.items()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m ray_tpu.devtools.whereis "
+              "<journal.json>\n(write one with "
+              "ray_tpu.flight_journal('journal.json'))",
+              file=sys.stderr)
+        return 2
+    report = attribution(_load_journals(argv[0]))
+    print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,6 +42,7 @@ import numpy as np
 from ray_tpu.models.llama import (
     LlamaConfig, llama_decode_step, llama_init, llama_init_cache,
     llama_prefill, llama_verify_step)
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util import metrics as _metrics
 
 # --- built-in engine metrics (reference: vLLM engine stats surfaced
@@ -1352,6 +1353,8 @@ class ContinuousBatchingEngine:
         decode), tokens/sec, and batch occupancy accumulate in the
         local buffer and flush as one batched metrics update."""
         t0 = time.perf_counter()
+        rec = _flight.RECORDER
+        t0_ns = rec.clock() if rec is not None else 0
         tokens_before = self.total_generated
         self._admitted_last_step = 0
         handled = self._step_impl()
@@ -1361,6 +1364,11 @@ class ContinuousBatchingEngine:
                  or any(s.request is not None and s.prefilling
                         for s in self.slots)
                  else "decode")
+        if rec is not None and handled:
+            rec.record("serve", "engine_step", t0_ns,
+                       rec.clock() - t0_ns,
+                       {"phase": phase, "slots": handled,
+                        "tokens": emitted})
         self._mbuf.note_step(phase, dt, emitted, handled)
         self._mbuf.maybe_flush(self)
         return handled

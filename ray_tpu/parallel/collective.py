@@ -58,6 +58,7 @@ import numpy as np
 from ray_tpu.core import runtime as runtime_mod
 from ray_tpu.core import serialization
 from ray_tpu.exceptions import GetTimeoutError
+from ray_tpu.util import flight_recorder as _flight
 
 logger = logging.getLogger(__name__)
 
@@ -295,7 +296,16 @@ COLLECTIVE_COMPRESSION = _MGauge(
     tag_keys=("op", "dtype"))
 
 
-def _note_bytes(op: str, dtype: str, wire: int, raw: int) -> None:
+def _note_bytes(op: str, dtype: str, wire: int, raw: int,
+                t0_ns: Optional[int] = None) -> None:
+    rec = _flight.RECORDER
+    if rec is not None and t0_ns:
+        # one journal span per collective hop, carrying the achieved
+        # compression ratio (raw/wire) — the EQuARX-style attribution
+        rec.record("collective", op, t0_ns, rec.clock() - t0_ns,
+                   {"dtype": dtype, "wire": int(wire),
+                    "ratio": (round(float(raw) / float(wire), 3)
+                              if wire > 0 else 1.0)})
     if wire <= 0:
         return
     try:
@@ -623,6 +633,8 @@ def allreduce(tensor, op: str = "sum", group_name: str = "default",
     _check_compression(compression, op, acc.dtype)
     if world == 1:
         return acc / world if op == "mean" else acc.copy()
+    _rec = _flight.RECORDER
+    flight_t0 = _rec.clock() if _rec is not None else None
     if algorithm is None:
         algorithm = ("ring" if compression is not None
                      or acc.nbytes >= _RING_MIN_BYTES else "tree")
@@ -630,6 +642,11 @@ def allreduce(tensor, op: str = "sum", group_name: str = "default",
         if compression is not None:
             raise ValueError("compression requires algorithm='ring'")
         out = _tree_allreduce(group, acc, op, timeout)
+        if _rec is not None:
+            _rec.record("collective", "allreduce", flight_t0,
+                        _rec.clock() - flight_t0,
+                        {"algorithm": "tree", "dtype": str(acc.dtype),
+                         "ratio": 1.0})
         return out / world if op == "mean" else out
     if algorithm != "ring":
         raise ValueError(f"unknown algorithm {algorithm!r}")
@@ -667,7 +684,7 @@ def allreduce(tensor, op: str = "sum", group_name: str = "default",
     if compression is not None and np.issubdtype(orig_dtype, np.floating):
         out = out.astype(orig_dtype)
     _note_bytes("allreduce", compression or str(orig_dtype),
-                stats["wire"], stats["raw"])
+                stats["wire"], stats["raw"], t0_ns=flight_t0)
     return out
 
 
@@ -691,6 +708,8 @@ def reduce_scatter_flat(tensor, op: str = "sum",
         out = flat.astype(np.float32) if compression else flat.copy()
         return (out / world if op == "mean" else out), 0
     residual = None
+    _rec = _flight.RECORDER
+    flight_t0 = _rec.clock() if _rec is not None else None
     if compression is not None:
         flat = flat.astype(np.float32)
         if ef_key is not None:
@@ -703,7 +722,7 @@ def reduce_scatter_flat(tensor, op: str = "sum",
     if op == "mean":
         own = own / world
     _note_bytes("reduce_scatter", compression or str(flat.dtype),
-                stats["wire"], stats["raw"])
+                stats["wire"], stats["raw"], t0_ns=flight_t0)
     return own, bounds[group.rank]
 
 
@@ -718,10 +737,12 @@ def allgather_flat(shard, group_name: str = "default",
     if group.world_size == 1:
         return shard.copy()
     stats = {"wire": 0, "raw": 0}
+    _rec = _flight.RECORDER
+    flight_t0 = _rec.clock() if _rec is not None else None
     payloads = _ring_allgather_payloads(group, shard, timeout, stats,
                                         int(shard.nbytes))
     _note_bytes("allgather", str(shard.dtype), stats["wire"],
-                stats["raw"])
+                stats["raw"], t0_ns=flight_t0)
     return np.concatenate([np.asarray(p) for p in payloads])
 
 

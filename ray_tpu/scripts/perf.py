@@ -199,6 +199,39 @@ def bench_wire_submit(native: bool, n: int = 50_000,
             "submit_us_per_frame": round(1e6 * dt / n, 2)}
 
 
+def bench_recorder_overhead(rt, n: int) -> dict:
+    """Flight-recorder cost on the tight trivial-task loop: the same
+    submit-then-drain run with the journal disabled, then enabled on
+    the driver (the record() hot path is identical on workers). The
+    committed guard bound lives in tests/test_flight_recorder.py; this
+    row is the measured ratio for PERF.md."""
+    import ray_tpu
+    from ray_tpu.util import flight_recorder as fr
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(1000)])
+    saved = fr.RECORDER
+    try:
+        fr.disable()
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        dt_off = time.perf_counter() - t0
+        fr.enable("driver:bench")
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        dt_on = time.perf_counter() - t0
+    finally:
+        fr.RECORDER = saved
+    return {"bench": "recorder_overhead", "n": n,
+            "seconds_disabled": round(dt_off, 3),
+            "seconds_enabled": round(dt_on, 3),
+            "enabled_over_disabled": round(dt_on / dt_off, 3)
+            if dt_off > 0 else 1.0}
+
+
 def bench_process_threads(rt) -> dict:
     """Thread topology after a warm workload: with the selector IO
     loop, socket service is ONE rtpu-io-loop thread regardless of
@@ -246,6 +279,9 @@ def main(argv=None) -> None:
     parser.add_argument("--compare-wire", action="store_true",
                         help="A/B the native C wire codec against the "
                              "pure-Python fallback (submit leg)")
+    parser.add_argument("--recorder", action="store_true",
+                        help="measure flight-recorder overhead on the "
+                             "trivial-task loop (enabled vs disabled)")
     args = parser.parse_args(argv)
 
     import ray_tpu
@@ -266,6 +302,10 @@ def main(argv=None) -> None:
         print(json.dumps(out), flush=True)
     results.append(bench_process_threads(rt))
     print(json.dumps(results[-1]), flush=True)
+    if args.recorder:
+        out = bench_recorder_overhead(rt, args.tasks)
+        results.append(out)
+        print(json.dumps(out), flush=True)
     if args.compare_wire:
         for out in _compare_wire(args.wire_frames):
             results.append(out)

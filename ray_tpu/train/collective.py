@@ -29,6 +29,7 @@ import numpy as np
 from ray_tpu.core import serialization
 from ray_tpu.parallel import collective
 from ray_tpu.train.context import get_context
+from ray_tpu.util import flight_recorder as _flight
 
 
 def barrier() -> None:
@@ -67,6 +68,8 @@ def allreduce_gradients(grads, op: str = "mean",
     group = _sync_group(ctx)
     import jax
     flat, treedef = jax.tree_util.tree_flatten(grads)
+    rec = _flight.RECORDER
+    t0_ns = rec.clock() if rec is not None else 0
     reduced = [
         collective.allreduce(np.asarray(leaf), op=op,
                              group_name=group,
@@ -74,6 +77,13 @@ def allreduce_gradients(grads, op: str = "mean",
                              ef_key=f"grad/{i}" if compression else None)
         for i, leaf in enumerate(flat)
     ]
+    if rec is not None:
+        # envelope over the whole gradient sync (per-leaf hop spans are
+        # recorded inside collective.allreduce)
+        rec.record("collective", "allreduce_gradients", t0_ns,
+                   rec.clock() - t0_ns,
+                   {"leaves": len(flat),
+                    "compression": compression or "none"})
     return jax.tree_util.tree_unflatten(treedef, reduced)
 
 

@@ -36,6 +36,7 @@ from ray_tpu.dag.channel import ChannelSpec, ChannelTimeoutError
 from ray_tpu.train.pipeline import schedule as sched_mod
 from ray_tpu.train.pipeline.partition import (
     LayeredModel, StagePlan, partition_model, stitch_params)
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 logger = logging.getLogger(__name__)
@@ -208,6 +209,8 @@ class PipelineStage:
         hist_items: List[tuple] = []
         base = self._step_idx * m
         t_wall0 = time.perf_counter()
+        rec = _flight.RECORDER
+        step_t0_ns = rec.clock() if rec is not None else 0
 
         def stage_forward(layer_list, h):
             for lp in layer_list:
@@ -218,10 +221,13 @@ class PipelineStage:
             try:
                 value = endpoint.read(seq, timeout=self.recv_timeout_s)
             except ChannelTimeoutError as exc:
-                raise PipelineStallError(
+                err = PipelineStallError(
                     f"pipeline stage {sid} stalled waiting for {what} "
-                    f"(seq {seq}); an adjacent stage likely died"
-                ) from exc
+                    f"(seq {seq}); an adjacent stage likely died")
+                # post-mortem: ship this stage's final moments with the
+                # error (rides the pickled exception to the driver)
+                _flight.attach_tail(err)
+                raise err from exc
             if not getattr(endpoint, "owned_reads", False):
                 value = np.array(value, copy=True)
             endpoint.ack(seq)
@@ -233,17 +239,21 @@ class PipelineStage:
             try:
                 endpoint.write(arr, seq, timeout=self.recv_timeout_s)
             except ChannelTimeoutError as exc:
-                raise PipelineStallError(
+                err = PipelineStallError(
                     f"pipeline stage {sid} stalled writing to edge "
-                    f"{edge} (seq {seq}); the peer stage likely died"
-                ) from exc
+                    f"{edge} (seq {seq}); the peer stage likely died")
+                _flight.attach_tail(err)
+                raise err from exc
 
         for ins in self._instrs:
             if self._fail_next and ins.op == sched_mod.FWD:
                 self._fail_next = False
-                raise RuntimeError(
+                err = RuntimeError(
                     f"pipeline stage {sid} died mid-step (injected "
                     "failure)")
+                _flight.attach_tail(err)  # post-mortem journal tail
+                raise err
+            ins_t0_ns = rec.clock() if rec is not None else 0
             if ins.op == sched_mod.RECV:
                 if ins.kind == sched_mod.ACT:
                     recv_act[ins.mb] = _read(
@@ -253,6 +263,12 @@ class PipelineStage:
                     recv_grad[ins.mb] = _read(
                         self._grad_in, base + ins.mb,
                         f"gradient mb {ins.mb} from stage {sid + 1}")
+                if rec is not None:
+                    rec.record("pipeline", ins.op, ins_t0_ns,
+                               rec.clock() - ins_t0_ns,
+                               {"stage": sid, "step": self._step_idx,
+                                "mb": ins.mb, "kind": ins.kind,
+                                "phase": ins.phase})
                 continue
             if ins.op == sched_mod.SEND:
                 if ins.kind == sched_mod.ACT:
@@ -261,6 +277,12 @@ class PipelineStage:
                 else:
                     _write(self._grad_out, recv_grad.pop(ins.mb),
                            base + ins.mb, f"{sid}->{sid - 1}")
+                if rec is not None:
+                    rec.record("pipeline", ins.op, ins_t0_ns,
+                               rec.clock() - ins_t0_ns,
+                               {"stage": sid, "step": self._step_idx,
+                                "mb": ins.mb, "kind": ins.kind,
+                                "phase": ins.phase})
                 continue
 
             t0 = time.perf_counter()
@@ -304,6 +326,11 @@ class PipelineStage:
                     lambda p, g: p - self.lr * g, self.params, grads)
             dt = time.perf_counter() - t0
             compute_s += dt
+            if rec is not None:
+                rec.record("pipeline", ins.op, ins_t0_ns,
+                           rec.clock() - ins_t0_ns,
+                           {"stage": sid, "step": self._step_idx,
+                            "mb": ins.mb, "phase": ins.phase})
             hist_items.append((
                 "histogram", "ray_tpu_train_pipeline_stage_step_seconds",
                 {"stage": str(sid), "phase": ins.phase}, dt,
@@ -311,6 +338,18 @@ class PipelineStage:
 
         wall_s = time.perf_counter() - t_wall0
         bubble = max(0.0, 1.0 - compute_s / wall_s) if wall_s > 0 else 0.0
+        if rec is not None:
+            # the per-step envelope span: whereis derives measured
+            # bubble from (1 - compute/wall) of exactly these numbers —
+            # the same formula the live report uses
+            rec.record("pipeline", "stage_step", step_t0_ns,
+                       rec.clock() - step_t0_ns,
+                       {"stage": sid, "step": self._step_idx,
+                        "schedule": self.schedule_name,
+                        "S": self.num_stages,
+                        "m": self.num_microbatches,
+                        "wall_s": round(wall_s, 6),
+                        "compute_s": round(compute_s, 6)})
         self._step_idx += 1
         self._flush_metrics(bubble, edge_bytes, hist_items)
         report = {

@@ -99,6 +99,16 @@ def chrome_trace_events(runtime=None,
                 "ts": ts_us, "pid": pid, "tid": tid, "s": "t",
                 "args": {"error": (ev.error or "")[:500]},
             })
+    # Flight-recorder journals (when recording): clock-aligned
+    # per-process tracks merged into the same export — IO-loop
+    # dispatch, pipeline instructions, shuffle waves, prefetch waits,
+    # collective hops, serve engine steps.
+    from ray_tpu.util import flight_recorder
+    flight = flight_recorder.chrome_events()
+    if trace_id is not None:
+        flight = [ev for ev in flight
+                  if ev.get("args", {}).get("trace_id") == trace_id]
+    out.extend(flight)
     return out
 
 

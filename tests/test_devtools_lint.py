@@ -605,6 +605,72 @@ def test_gl012_quiet_on_sync_callbacks():
     assert rules_hit(GL009_NEG, select=["GL012"]) == set()
 
 
+# -- GL013 tracing-span RPC from the loop thread -----------------------
+
+GL013_POS = """
+    from ray_tpu.util import tracing
+
+    class Proto:
+        def __init__(self, io):
+            io.call_soon(self._tick)
+
+        def _tick(self):
+            with tracing.span("dispatch", component="io"):
+                pass
+"""
+
+GL013_NEG = """
+    from ray_tpu.util import tracing
+    from ray_tpu.util import flight_recorder as _flight
+
+    class Proto:
+        def __init__(self, io):
+            io.call_soon(self._tick)
+
+        def _tick(self):
+            rec = _flight.RECORDER
+            if rec is not None:
+                rec.record("io", "tick", rec.clock(), 0, None)
+
+        def off_loop(self):
+            with tracing.span("ok"):   # fine: not a loop-thread path
+                pass
+"""
+
+
+def test_gl013_fires_on_loop_path_span_emission():
+    findings = run(GL013_POS, select=["GL013"])
+    assert [f.rule for f in findings] == ["GL013"]
+    assert "flight_recorder" in findings[0].message
+
+
+def test_gl013_fires_on_direct_record_span():
+    assert rules_hit("""
+        from ray_tpu.util.tracing import record_span
+
+        def on_msg(conn, msg):
+            record_span("dispatch", "io", 0.0, 0.0, None)
+
+        def wire(io, sock):
+            io.register_message_conn(sock, on_msg, None)
+    """, select=["GL013"]) == {"GL013"}
+
+
+def test_gl013_quiet_for_flight_recorder_and_off_loop():
+    assert rules_hit(GL013_NEG, select=["GL013"]) == set()
+    # an unrelated local span() helper is not the tracing emitter
+    assert rules_hit("""
+        def span(name):
+            return name
+
+        def on_msg(conn, msg):
+            span("dispatch")
+
+        def wire(io, sock):
+            io.register_message_conn(sock, on_msg, None)
+    """, select=["GL013"]) == set()
+
+
 # -- project rules respect suppression & selection ---------------------
 
 def test_project_rule_respects_per_line_disable():
