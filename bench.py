@@ -275,6 +275,97 @@ def data_pipeline_main():
     print(json.dumps(parsed))
 
 
+def _bench_rl_inner():
+    """`bench.py --rl-inner` (child): one podracer arch, JSON line out.
+    Arch picked by RTPU_BENCH_RL_ARCH (anakin | sebulba)."""
+    arch = os.environ.get("RTPU_BENCH_RL_ARCH", "anakin")
+    warmup = int(os.environ.get("RTPU_BENCH_RL_WARMUP", "2"))
+    if arch == "anakin":
+        import jax
+        from ray_tpu.rl.podracer import Anakin, AnakinConfig
+        updates = int(os.environ.get("RTPU_BENCH_RL_UPDATES", "20"))
+        cfg = AnakinConfig(num_envs_per_device=16, rollout_len=16,
+                           hidden=(64, 64))
+        trainer = Anakin(cfg)
+        trainer.train(warmup)  # compile + first-touch outside the clock
+        out = trainer.train(updates)
+        return {
+            "arch": "anakin",
+            "num_devices": out["num_devices"],
+            "num_updates": updates,
+            "env_steps": updates * out["num_devices"]
+            * cfg.num_envs_per_device * cfg.rollout_len,
+            "env_steps_per_sec": round(out["env_steps_per_sec"], 1),
+            "backend": jax.default_backend(),
+        }
+    # sebulba: the full actor–learner constellation on the local node
+    import ray_tpu
+    from ray_tpu.rl.podracer import Sebulba, SebulbaConfig
+    from ray_tpu.rl.podracer.inference import MAX_BATCH_SIZE
+    learner_steps = int(os.environ.get("RTPU_BENCH_RL_UPDATES", "12"))
+    ray_tpu.init(system_config={"task_max_retries": 0})
+    try:
+        cfg = SebulbaConfig(num_actors=2, num_envs_per_actor=4,
+                            rollout_len=16, hidden=(64, 64),
+                            fragments_per_step=2,
+                            weight_push_interval=1, max_staleness=50)
+        trainer = Sebulba(cfg)
+        try:
+            out = trainer.train(learner_steps, step_timeout_s=120.0)
+        finally:
+            trainer.shutdown()
+    finally:
+        from ray_tpu import serve
+        serve.shutdown()
+        ray_tpu.shutdown()
+    learner = out["learner"]
+    max_rows = cfg.num_actors * cfg.num_envs_per_actor
+    return {
+        "arch": "sebulba",
+        "num_actors": cfg.num_actors,
+        "learner_updates": learner["num_updates"],
+        "env_steps": out["env_steps_sampled"],
+        "env_steps_per_sec": round(out["env_steps_per_sec"], 1),
+        "inference_batch_rows_mean": round(out["mean_batch_rows"], 2),
+        "inference_batch_occupancy": round(
+            out["mean_batch_rows"] / min(max_rows, MAX_BATCH_SIZE), 4),
+        "weight_pushes": learner["weight_pushes"],
+        "weight_push_ms": round(learner["last_push_ms"], 3),
+        "version_lag_mean": round(learner["version_lag_mean"], 2),
+        "version_lag_max": learner["version_lag_max"],
+        "stale_dropped": learner["stale_dropped"],
+        "replay": out["replay"],
+    }
+
+
+def rl_main():
+    """`bench.py --rl [anakin|sebulba|both]`: run the podracer RL
+    benches in children, write BENCH_rl.json, echo the JSON line."""
+    arch = os.environ.get("RTPU_BENCH_RL_ARCH", "both")
+    timeout_s = int(os.environ.get("RTPU_BENCH_RL_TIMEOUT_S", "420"))
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_rl.json")
+    from __graft_entry__ import cpu_mesh_env
+    result = {"metric": "podracer_rl"}
+    archs = ["anakin", "sebulba"] if arch == "both" else [arch]
+    for a in archs:
+        if a == "anakin":
+            # Anakin wants a multi-device shard view: force a 4-device
+            # host platform in the child (same trick as the sweeps)
+            env = cpu_mesh_env(int(os.environ.get(
+                "RTPU_BENCH_RL_DEVICES", "4")))
+        else:
+            env = _cpu_env()
+        env["RTPU_BENCH_RL_ARCH"] = a
+        ok, parsed, diag = _run_child(["--rl-inner"], env, timeout_s)
+        result[a] = parsed if (ok and parsed is not None) \
+            else {"error": diag}
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result))
+
+
 def _run_child(args, env, timeout_s):
     """Run a child, return (ok, parsed_json_or_None, diagnostic_str)."""
     try:
@@ -737,7 +828,18 @@ if __name__ == "__main__":
             os.environ["RTPU_BENCH_SCHEDULE"] = _a.split("=", 1)[1]
         elif _a == "--schedule" and _i + 1 < len(_argv):
             os.environ["RTPU_BENCH_SCHEDULE"] = _argv[_i + 1]
-    if "--data-pipeline-inner" in sys.argv:
+        elif _a.startswith("--rl="):
+            os.environ["RTPU_BENCH_RL_ARCH"] = _a.split("=", 1)[1]
+        elif _a == "--rl":
+            nxt = _argv[_i + 1] if _i + 1 < len(_argv) else ""
+            os.environ["RTPU_BENCH_RL_ARCH"] = (
+                nxt if nxt in ("anakin", "sebulba", "both") else "both")
+    if "--rl-inner" in sys.argv:
+        print(json.dumps(_bench_rl_inner()))
+    elif "--rl" in sys.argv or any(
+            _a.startswith("--rl=") for _a in _argv):
+        rl_main()
+    elif "--data-pipeline-inner" in sys.argv:
         print(json.dumps(_bench_data_pipeline()))
     elif "--data-pipeline" in sys.argv or \
             os.environ.get("RTPU_BENCH_DATA_PIPELINE"):

@@ -275,7 +275,7 @@ def unpack_pinned(src, on_release) -> Any:
         raise
 
 
-def _maybe_register_by_value(value: Any) -> None:
+def _maybe_register_by_value(value: Any, _depth: int = 0) -> None:
     """Ship user-module code by value.
 
     Workers can import installed packages but not the driver's ad-hoc
@@ -283,9 +283,22 @@ def _maybe_register_by_value(value: Any) -> None:
     ships such code via runtime_env working_dir (reference:
     python/ray/_private/runtime_env/working_dir.py); the single-machine
     equivalent is pickling user-module classes/functions by value.
+
+    Shallow containers are walked (bounded) so a callable tucked inside
+    a kwargs dict — the standard actor-init blob shape — ships the same
+    way a bare callable does.
     """
     import sys
     import sysconfig
+
+    if _depth < 2 and isinstance(value, (list, tuple, set, frozenset,
+                                         dict)):
+        items = value.values() if isinstance(value, dict) else value
+        for i, v in enumerate(items):
+            if i >= 64:
+                break
+            _maybe_register_by_value(v, _depth + 1)
+        return
 
     target = value if isinstance(value, type) or callable(value) else type(value)
     mod_name = getattr(target, "__module__", None)

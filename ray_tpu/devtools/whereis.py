@@ -12,6 +12,13 @@ aligned) journals into per-step fractions:
   with ``PipelineRunner.step()``'s within noise),
 * **idle**    — whatever the named categories don't cover.
 
+Podracer RL runs (category ``rl``) get their own rollup: time is
+attributed into **acting** (env-runner rollouts), **inference-wait**
+(batched policy forwards the actors block on), **learning** (learner
+updates) and **weight-sync** (quantized weight broadcasts), plus the
+learner's replay-queue wait — the Sebulba version of "where did my
+step time go".
+
 Usage::
 
     ray_tpu.whereis()                      # live, after some steps ran
@@ -50,6 +57,12 @@ def attribution(journals: Optional[Dict[str, List[tuple]]] = None
     coll_count = 0
     coll_wire = 0
     coll_ratios: List[float] = []
+    # Podracer RL spans → acting / inference-wait / learning /
+    # weight-sync (plus the learner's replay wait)
+    rl_ns = {"acting": 0, "inference_wait": 0, "learning": 0,
+             "weight_sync": 0, "replay_wait": 0}
+    rl_env_steps = 0
+    rl_seen = False
     t_lo: Optional[int] = None
     t_hi: Optional[int] = None
 
@@ -80,6 +93,24 @@ def attribution(journals: Optional[Dict[str, List[tuple]]] = None
                 coll_wire += int(a.get("wire", 0))
                 if "ratio" in (a or {}):
                     coll_ratios.append(float(a["ratio"]))
+            elif cat == "rl":
+                rl_seen = True
+                a = args or {}
+                if name == "rollout":
+                    rl_ns["acting"] += dur
+                    rl_env_steps += int(a.get("env_steps", 0))
+                elif name == "infer_batch":
+                    rl_ns["inference_wait"] += dur
+                elif name == "learn_step":
+                    rl_ns["learning"] += dur
+                    # Anakin has no rollout spans: the fused step IS
+                    # the rollout, so its env steps ride learn_step
+                    if a.get("arch") == "anakin":
+                        rl_env_steps += int(a.get("env_steps", 0))
+                elif name == "weight_push":
+                    rl_ns["weight_sync"] += dur
+                elif name == "replay_wait":
+                    rl_ns["replay_wait"] += dur
 
     steps = {k: v for k, v in per.items() if v["wall_s"] > 0}
     wall = sum(v["wall_s"] for v in steps.values())
@@ -126,6 +157,23 @@ def attribution(journals: Optional[Dict[str, List[tuple]]] = None
                 "bubble": round(max(0.0, 1.0 - c), 4),
                 "idle": round(max(0.0, 1.0 - c - m), 4)}
 
+    rl_report = None
+    if rl_seen:
+        total_ns = sum(rl_ns[k] for k in
+                       ("acting", "inference_wait", "learning",
+                        "weight_sync"))
+        rl_report = {k + "_s": round(v / 1e9, 6)
+                     for k, v in rl_ns.items()}
+        rl_report["env_steps"] = rl_env_steps
+        if window_s > 0 and rl_env_steps:
+            rl_report["env_steps_per_sec"] = round(
+                rl_env_steps / window_s, 1)
+        if total_ns > 0:
+            rl_report["fractions"] = {
+                k: round(rl_ns[k] / total_ns, 4)
+                for k in ("acting", "inference_wait", "learning",
+                          "weight_sync")}
+
     return {
         "steps": len({k[1] for k in steps}),
         "stages": len(per_stage),
@@ -145,6 +193,7 @@ def attribution(journals: Optional[Dict[str, List[tuple]]] = None
                         "mean_compression_ratio": (
                             round(sum(coll_ratios) / len(coll_ratios),
                                   3) if coll_ratios else None)},
+        "rl": rl_report,
     }
 
 
@@ -183,6 +232,20 @@ def render(report: Dict[str, Any]) -> str:
     if report["data_wait_s"]:
         lines.append(
             f"  data wait: {report['data_wait_s'] * 1e3:.1f}ms")
+    rl = report.get("rl")
+    if rl:
+        rf = rl.get("fractions") or {}
+        if rf:
+            lines.append(
+                "  rl: acting %5.1f%%  inference-wait %5.1f%%  "
+                "learning %5.1f%%  weight-sync %5.1f%%" % (
+                    rf["acting"] * 100, rf["inference_wait"] * 100,
+                    rf["learning"] * 100, rf["weight_sync"] * 100))
+        line = (f"  rl: env steps {rl['env_steps']}  "
+                f"replay wait {rl['replay_wait_s'] * 1e3:.1f}ms")
+        if "env_steps_per_sec" in rl:
+            line += f"  ({rl['env_steps_per_sec']:.0f} steps/s)"
+        lines.append(line)
     return "\n".join(lines)
 
 

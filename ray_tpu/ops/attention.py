@@ -33,6 +33,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.parallel import _compat
+
 NEG_INF = -1e30
 
 # Default tile sizes; shrunk to fit when seq is smaller. 128-multiples
@@ -193,7 +195,7 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int):
             pltpu.VMEM((block_q, 128), jnp.float32),   # running sum
             pltpu.VMEM((block_q, d), jnp.float32),     # output accum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,
@@ -325,7 +327,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
         out_specs=pl.BlockSpec((1, 1, block_q, d), q_idx),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,
@@ -364,7 +366,7 @@ def _flash_backward(q, k, v, o, lse, do, causal: bool, block_q: int,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compat.pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,

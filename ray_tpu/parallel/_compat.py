@@ -1,4 +1,8 @@
-"""Version compatibility shims for the ``jax.shard_map`` entry point.
+"""Version compatibility shims for moving jax entry points.
+
+Currently covers three drift sites: the ``shard_map`` entry point, the
+pallas-TPU compiler-params class, and the gloo CPU collectives needed
+for multiprocess CPU gangs (see the section comments below).
 
 ``shard_map`` has moved twice across jax releases: it started life at
 ``jax.experimental.shard_map.shard_map``, was promoted to
@@ -85,3 +89,78 @@ def require_shard_map() -> None:
         raise RuntimeError(
             "this operation needs jax shard_map, which is unavailable: "
             + SHARD_MAP_UNAVAILABLE_REASON)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU compiler params: ``pltpu.CompilerParams`` on new jax,
+# ``pltpu.TPUCompilerParams`` on 0.4.x. Resolved lazily because pallas
+# itself is only imported inside kernel builders (it drags in mosaic).
+# ---------------------------------------------------------------------------
+
+_PALLAS_PARAMS_CLS: Any = None
+PALLAS_COMPILER_PARAMS_UNAVAILABLE_REASON = ""
+
+
+def pallas_tpu_compiler_params(**kwargs: Any) -> Any:
+    """Build a pallas-TPU compiler-params object under either spelling.
+
+    Raises ``RuntimeError`` with a skip-worthy reason when no pallas TPU
+    backend is importable at all.
+    """
+    global _PALLAS_PARAMS_CLS, PALLAS_COMPILER_PARAMS_UNAVAILABLE_REASON
+    if _PALLAS_PARAMS_CLS is None:
+        try:
+            from jax.experimental.pallas import tpu as pltpu
+        except ImportError as exc:
+            PALLAS_COMPILER_PARAMS_UNAVAILABLE_REASON = (
+                f"jax.experimental.pallas.tpu not importable: {exc}")
+            raise RuntimeError(
+                PALLAS_COMPILER_PARAMS_UNAVAILABLE_REASON) from exc
+        cls = (getattr(pltpu, "CompilerParams", None)
+               or getattr(pltpu, "TPUCompilerParams", None))
+        if cls is None:
+            PALLAS_COMPILER_PARAMS_UNAVAILABLE_REASON = (
+                "pallas tpu module has neither CompilerParams nor "
+                "TPUCompilerParams")
+            raise RuntimeError(PALLAS_COMPILER_PARAMS_UNAVAILABLE_REASON)
+        _PALLAS_PARAMS_CLS = cls
+    return _PALLAS_PARAMS_CLS(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# CPU multiprocess collectives: the stock CPU client cannot run cross-
+# process computations ("Multiprocess computations aren't implemented on
+# the CPU backend") unless jaxlib ships the gloo TCP collectives and the
+# ``jax_cpu_collectives_implementation`` config selects them BEFORE
+# ``jax.distributed.initialize``. Feature-detect so gang tests skip with
+# a reason on jaxlibs built without gloo.
+# ---------------------------------------------------------------------------
+
+CPU_COLLECTIVES_AVAILABLE = False
+CPU_COLLECTIVES_UNAVAILABLE_REASON = ""
+try:
+    from jax._src.lib import xla_extension as _xla_ext  # type: ignore
+    if hasattr(_xla_ext, "make_gloo_tcp_collectives"):
+        CPU_COLLECTIVES_AVAILABLE = True
+    else:
+        CPU_COLLECTIVES_UNAVAILABLE_REASON = (
+            "jaxlib built without gloo TCP collectives")
+except Exception as _exc:  # noqa: BLE001 — jaxlib layout drift
+    CPU_COLLECTIVES_UNAVAILABLE_REASON = (
+        f"cannot probe jaxlib for gloo collectives: {_exc}")
+
+
+def enable_cpu_collectives() -> bool:
+    """Select the gloo CPU collectives implementation when available.
+
+    Must run before ``jax.distributed.initialize`` / first backend use
+    in the process. Returns True when gloo was selected.
+    """
+    if not CPU_COLLECTIVES_AVAILABLE:
+        return False
+    import jax
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 — option renamed/absent
+        return False
+    return True

@@ -19,6 +19,7 @@ Axis conventions (the "How to Scale Your Model" recipe):
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -106,6 +107,14 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     import jax
     if num_processes in (None, 0, 1):
         return
+    # CPU gangs (virtual-device CI, JAX_PLATFORMS=cpu) need the gloo
+    # collectives selected before initialize, or every cross-process
+    # computation dies with "not implemented on the CPU backend".
+    # Checked via the env var, NOT jax.default_backend(): touching the
+    # backend here would finalize it pre-initialize.
+    if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+        from ray_tpu.parallel import _compat
+        _compat.enable_cpu_collectives()
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -1,0 +1,42 @@
+"""Podracer RL architectures (PAPERS.md: "Podracer architectures for
+scalable deep reinforcement learning").
+
+Two ways to spend a pod:
+
+- :class:`Anakin` — everything on device: rollout, GAE, and the PPO
+  update are ONE pmapped program; the driver moves scalars only.
+- :class:`Sebulba` — everything decoupled: host env-runner actors,
+  a continuously-batched inference server (on ``ray_tpu.serve``), an
+  object-store replay queue, and a learner that broadcasts
+  version-tagged int8 weight updates mid-flight.
+"""
+
+from ray_tpu.rl.podracer.anakin import (
+    Anakin,
+    AnakinConfig,
+    build_step,
+    init_shard,
+)
+from ray_tpu.rl.podracer.inference import (
+    PolicyInference,
+    broadcast_weights,
+    build_inference_app,
+    dequantize_params,
+    quantize_params,
+)
+from ray_tpu.rl.podracer.replay import (
+    DEFAULT_CAPACITY,
+    FragmentReplay,
+    ReplayActor,
+    create_replay_actor,
+)
+from ray_tpu.rl.podracer.sebulba import Sebulba, SebulbaConfig
+
+__all__ = [
+    "Anakin", "AnakinConfig", "build_step", "init_shard",
+    "PolicyInference", "broadcast_weights", "build_inference_app",
+    "dequantize_params", "quantize_params",
+    "DEFAULT_CAPACITY", "FragmentReplay", "ReplayActor",
+    "create_replay_actor",
+    "Sebulba", "SebulbaConfig",
+]

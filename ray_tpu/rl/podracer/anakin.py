@@ -1,0 +1,270 @@
+"""Anakin: multi-device fused rollout+update (Podracer architecture A).
+
+The seed's `JaxEnvRunner` already compiles a whole rollout into one
+vmapped `lax.scan`; Anakin lifts that scan INTO the update step and
+shards the fused program across every local device with `pmap`:
+
+    per device:  scan-rollout (T steps x N envs)  ->  GAE  ->  PPO loss
+                 ->  grad  ->  pmean across devices  ->  optax update
+
+Parameters are replicated and live in HBM for the entire run — the
+driver loop moves ONLY scalar metrics. One `pstep` call is one fully-
+fused XLA program per device: environment stepping, inference, and
+learning never leave the accelerator, which is the whole point of the
+architecture ("Podracer architectures for scalable RL", PAPERS.md §2).
+
+Gradient sync is `lax.pmean`, or the EQuARX int8/fp8 shared-scale
+`quantized_pmean` from ``parallel/collective`` when
+``grad_compression`` is set (PR 7) — the same wire-cheap collective the
+DDP trainer uses.
+
+`build_step` returns the PURE per-shard step function so the multi-
+device parity test can run the identical math under `jax.vmap`
+(axis_name works the same) and compare against `pmap` bitwise-ish.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rl.env import JaxEnv, make_jax_env
+from ray_tpu.rl.learner import compute_gae
+from ray_tpu.rl.rl_module import RLModuleSpec
+from ray_tpu.rl.sample_batch import (
+    ACTIONS, DONES, FINAL_OBS, LOGP, OBS, REWARDS, TRUNCATEDS, VF_PREDS)
+from ray_tpu.util import flight_recorder
+
+AXIS_NAME = "anakin"
+
+
+@dataclass
+class AnakinConfig:
+    env: str = "CartPole-v1"
+    num_envs_per_device: int = 16
+    rollout_len: int = 16
+    hidden: Tuple[int, ...] = (64, 64)
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    vf_clip_param: float = 10.0
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    grad_clip: Optional[float] = 0.5
+    # None | "int8" | "fp8": EQuARX-quantized gradient pmean (PR 7)
+    grad_compression: Optional[str] = None
+    seed: int = 0
+
+
+def make_optimizer(cfg: AnakinConfig):
+    import optax
+    tx = [optax.clip_by_global_norm(cfg.grad_clip)] if cfg.grad_clip else []
+    return optax.chain(*tx, optax.adam(cfg.lr, eps=1e-5))
+
+
+def build_step(env: JaxEnv, spec: RLModuleSpec, cfg: AnakinConfig,
+               axis_name: str = AXIS_NAME):
+    """Pure per-shard fused step.
+
+    ``step(params, opt_state, env_state, obs, key) ->
+    (params, opt_state, env_state, obs, key, metrics)`` — run it under
+    ``jax.pmap(..., axis_name=axis_name)`` for real devices or
+    ``jax.vmap(..., axis_name=axis_name)`` for the single-device parity
+    reference; the cross-shard pmean means both produce identical
+    updates on identical inputs.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    optimizer = make_optimizer(cfg)
+    num_envs = cfg.num_envs_per_device
+
+    def rollout(params, env_state, obs, key):
+        def step_fn(carry, _):
+            env_state, obs, key = carry
+            key, k_act, k_env = jax.random.split(key, 3)
+            dist, value = spec.forward(params, obs)
+            action = dist.sample(k_act)
+            logp = dist.log_prob(action)
+            env_keys = jax.random.split(k_env, num_envs)
+            env_state, step_out = jax.vmap(env.step)(
+                env_state, action, env_keys)
+            out = {OBS: obs, ACTIONS: action, LOGP: logp,
+                   VF_PREDS: value,
+                   REWARDS: jnp.asarray(step_out["reward"], jnp.float32),
+                   DONES: step_out["terminated"] | step_out["truncated"],
+                   TRUNCATEDS: step_out["truncated"],
+                   FINAL_OBS: step_out["final_obs"]}
+            return (env_state, step_out["obs"], key), out
+
+        (env_state, obs, key), cols = jax.lax.scan(
+            step_fn, (env_state, obs, key), None,
+            length=cfg.rollout_len)
+        cols["bootstrap_value"] = spec.compute_values(params, obs)
+        return env_state, obs, cols
+
+    def ppo_loss(params, batch):
+        dist, values = spec.forward(params, batch[OBS])
+        logp = dist.log_prob(batch[ACTIONS])
+        ratio = jnp.exp(logp - batch[LOGP])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        surrogate = jnp.minimum(
+            adv * ratio,
+            adv * jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param))
+        policy_loss = -surrogate.mean()
+        vf_err = (values - batch["value_targets"]) ** 2
+        vf_clipped = batch[VF_PREDS] + jnp.clip(
+            values - batch[VF_PREDS], -cfg.vf_clip_param,
+            cfg.vf_clip_param)
+        vf_loss = 0.5 * jnp.maximum(
+            vf_err, (vf_clipped - batch["value_targets"]) ** 2).mean()
+        entropy = dist.entropy().mean()
+        total = (policy_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+    def step(params, opt_state, env_state, obs, key):
+        env_state, obs, cols = rollout(params, env_state, obs, key)
+        # truncation bootstrapping (same treatment as PPO._postprocess):
+        # time-limit ends fold the next state's value into the reward
+        v_final = spec.compute_values(params, cols[FINAL_OBS])
+        rewards = (cols[REWARDS] + cfg.gamma * v_final
+                   * jnp.asarray(cols[TRUNCATEDS], jnp.float32))
+        adv, targets = compute_gae(
+            rewards, cols[VF_PREDS], cols[DONES],
+            cols["bootstrap_value"], gamma=cfg.gamma,
+            lambda_=cfg.lambda_)
+        flat = {k: cols[k].reshape((-1,) + cols[k].shape[2:])
+                for k in (OBS, ACTIONS, LOGP, VF_PREDS)}
+        flat["advantages"] = adv.reshape(-1)
+        flat["value_targets"] = targets.reshape(-1)
+
+        (loss, metrics), grads = jax.value_and_grad(
+            ppo_loss, has_aux=True)(params, flat)
+        if cfg.grad_compression:
+            from ray_tpu.parallel.collective import quantized_pmean
+            grads = jax.tree.map(
+                lambda g: quantized_pmean(
+                    g, axis_name, dtype=cfg.grad_compression), grads)
+        else:
+            grads = jax.lax.pmean(grads, axis_name)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        import optax
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics)
+        metrics["total_loss"] = loss
+        metrics["reward_mean"] = cols[REWARDS].mean()
+        metrics = jax.lax.pmean(metrics, axis_name)
+        return params, opt_state, env_state, obs, metrics
+
+    return step
+
+
+def init_shard(env: JaxEnv, spec: RLModuleSpec, cfg: AnakinConfig, key):
+    """Per-shard env state: ``key -> (env_state, obs)`` for
+    ``num_envs_per_device`` vectorized envs (vmap/pmap over shards)."""
+    import jax
+    keys = jax.random.split(key, cfg.num_envs_per_device)
+    return jax.vmap(env.reset)(keys)
+
+
+class Anakin:
+    """Driver for the pmapped fused step over the local devices.
+
+    Params/optimizer state are replicated once and never leave HBM; the
+    per-update host traffic is the metrics dict (a handful of scalars
+    per device) — everything else stays put.
+    """
+
+    def __init__(self, config: AnakinConfig, devices=None):
+        import jax
+
+        self.config = config
+        env = make_jax_env(config.env)
+        if env is None:
+            raise ValueError(
+                f"no JaxEnv registered under {config.env!r} — Anakin "
+                "needs a pure-function env (see ray_tpu.rl.env)")
+        self.env = env
+        self.spec = RLModuleSpec(env.observation_space, env.action_space,
+                                 config.hidden)
+        self.devices = list(devices or jax.local_devices())
+        D = len(self.devices)
+
+        step = build_step(env, self.spec, config)
+        self._pstep = jax.pmap(step, axis_name=AXIS_NAME,
+                               devices=self.devices)
+        self._pinit = jax.pmap(
+            lambda k: init_shard(env, self.spec, config, k),
+            devices=self.devices)
+
+        key = jax.random.PRNGKey(config.seed)
+        k_model, k_env, k_run = jax.random.split(key, 3)
+        params = self.spec.init(k_model)
+        opt_state = make_optimizer(config).init(params)
+        self._params = jax.device_put_replicated(params, self.devices)
+        self._opt_state = jax.device_put_replicated(
+            opt_state, self.devices)
+        self._env_state, self._obs = self._pinit(
+            jax.random.split(k_env, D))
+        self._key_src = k_run
+        self.env_steps = 0
+        self.env_steps_per_sec = 0.0
+
+    def _next_keys(self):
+        """One fresh PRNGKey per shard per update ([D, 2])."""
+        import jax
+        keys = jax.random.split(self._key_src, len(self.devices) + 1)
+        self._key_src = keys[0]
+        return keys[1:]
+
+    @property
+    def params(self):
+        """Shard-0 view of the replicated params (host copy)."""
+        import jax
+        return jax.tree.map(lambda x: np.asarray(x[0]), self._params)
+
+    def train(self, num_updates: int) -> Dict[str, Any]:
+        """Run fused updates; returns aggregate metrics. Only metrics
+        cross the host boundary."""
+        from ray_tpu.util import metrics as metrics_mod
+        cfg = self.config
+        D = len(self.devices)
+        steps_per_update = D * cfg.num_envs_per_device * cfg.rollout_len
+        last_metrics: Dict[str, Any] = {}
+        t_start = time.perf_counter()
+        for i in range(num_updates):
+            t0 = flight_recorder.clock_ns()
+            (self._params, self._opt_state, self._env_state, self._obs,
+             m) = self._pstep(self._params, self._opt_state,
+                              self._env_state, self._obs,
+                              self._next_keys())
+            last_metrics = {k: float(np.asarray(v)[0])
+                            for k, v in m.items()}
+            self.env_steps += steps_per_update
+            rec = flight_recorder.RECORDER
+            if rec is not None:
+                rec.record("rl", "learn_step", t0,
+                           flight_recorder.clock_ns() - t0,
+                           {"arch": "anakin", "update": i,
+                            "env_steps": steps_per_update})
+            metrics_mod.record_batch([
+                ("counter", "ray_tpu_rl_env_steps_total",
+                 {"arch": "anakin"}, float(steps_per_update), None),
+            ])
+        wall = max(time.perf_counter() - t_start, 1e-9)
+        self.env_steps_per_sec = num_updates * steps_per_update / wall
+        out = dict(last_metrics)
+        out.update({
+            "num_updates": num_updates,
+            "num_devices": D,
+            "env_steps": self.env_steps,
+            "env_steps_per_sec": self.env_steps_per_sec,
+        })
+        return out

@@ -47,7 +47,8 @@ dispatch / stream chunks), ``object`` (put/get/transfer), ``pipeline``
 (stage instructions, tagged phase=warmup/steady/drain), ``shuffle``
 (map/reduce waves), ``prefetch`` (producer/consumer waits),
 ``collective`` (allreduce &co with compression ratio), ``serve``
-(engine prefill/decode steps).
+(engine prefill/decode steps), ``rl`` (podracer spans: rollout /
+infer_batch / replay_wait / learn_step / weight_push).
 """
 
 from __future__ import annotations
