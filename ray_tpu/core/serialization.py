@@ -298,7 +298,10 @@ def _maybe_register_by_value(value: Any, _depth: int = 0) -> None:
             if i >= 64:
                 break
             _maybe_register_by_value(v, _depth + 1)
-        return
+        if type(value) in (list, tuple, set, frozenset, dict):
+            return
+        # a user-defined container SUBCLASS still needs its own class
+        # shipped by value — fall through to type registration
 
     target = value if isinstance(value, type) or callable(value) else type(value)
     mod_name = getattr(target, "__module__", None)

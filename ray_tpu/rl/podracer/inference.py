@@ -155,17 +155,43 @@ def broadcast_weights(deployment_name: str, version: int,
                       payload) -> int:
     """Push (version, int8 payload) to EVERY replica of the inference
     deployment — the router would pick one; a weight refresh must reach
-    them all. Goes straight to the replica actors' generic request
-    entry point, bypassing admission (weight pushes must never be
-    shed). Returns the number of replicas updated."""
+    them all. Goes through the replicas' control-plane entry point
+    (``handle_control_request``), which skips the max_ongoing admission
+    gate: the data-plane path returns a ``Rejected`` sentinel on a
+    saturated replica that only the router retries, so a weight push
+    through it would silently no-op exactly when the system is loaded.
+    Every reply is checked against the pushed version; returns the
+    number of replicas that confirmed the update (failures are logged,
+    not raised — the next push retries them)."""
+    import logging
+
     import ray_tpu
     from ray_tpu.core import serialization
     from ray_tpu.serve.controller import CONTROLLER_NAME
+    logger = logging.getLogger(__name__)
     controller = ray_tpu.get_actor(CONTROLLER_NAME)
     _version, replicas = ray_tpu.get(
         controller.get_replicas.remote(deployment_name))
     blob = serialization.dumps(((int(version), payload), {}))
-    refs = [handle.handle_request.remote("set_weights", blob)
-            for _rid, handle in replicas]
-    ray_tpu.get(refs)
-    return len(refs)
+    refs = [(rid, handle.handle_control_request.remote("set_weights", blob))
+            for rid, handle in replicas]
+    updated = 0
+    failed = []
+    for rid, ref in refs:
+        try:
+            confirmed = ray_tpu.get(ref)
+        except Exception:
+            logger.warning("weight push v%d failed on replica %s",
+                           version, rid, exc_info=True)
+            failed.append(rid)
+            continue
+        if confirmed == int(version):
+            updated += 1
+        else:
+            logger.warning("weight push v%d: replica %s confirmed %r",
+                           version, rid, confirmed)
+            failed.append(rid)
+    if failed:
+        logger.warning("weight push v%d reached %d/%d replicas "
+                       "(failed: %s)", version, updated, len(refs), failed)
+    return updated

@@ -108,11 +108,6 @@ class _SebulbaActorImpl:
                               for i, env in enumerate(self.envs)])
         self._ep_return = np.zeros(n)
         self._completed: List[float] = []
-        # sender-side liveness for in-queue fragments: the replay actor
-        # holds refs nested in tuples (never auto-resolved), so the
-        # producer pins the last capacity's worth until consumed
-        from collections import deque
-        self._keep_alive = deque(maxlen=kwargs["replay_capacity"] + 4)
 
     def _infer(self, obs: np.ndarray) -> Dict[str, Any]:
         """One batched-inference round trip with bounded backpressure
@@ -177,8 +172,14 @@ class _SebulbaActorImpl:
         fragment = {k: np.stack(v) for k, v in cols.items()}
         fragment["bootstrap_value"] = np.asarray(boot["values"])
         fragment["version"] = max(versions)
+        # Fragment liveness is a borrow chain, not a producer-side
+        # cache: the awaited push deserializes the nested ref inside the
+        # replay actor, which registers a borrowed reference (REF_ADD)
+        # that pins the object while queued; the pop_many reply then
+        # pins it via task-return containment until the learner's own
+        # deserialized borrow takes over. The local `ref` only needs to
+        # outlive this (synchronous) push.
         ref = ray_tpu.put(fragment)
-        self._keep_alive.append(ref)
         meta = {"actor_id": self.actor_id, "env_steps": T * N,
                 "version": fragment["version"]}
         dropped = ray_tpu.get(self._replay.push.remote((meta, [ref])))
@@ -228,6 +229,7 @@ class _SebulbaLearnerImpl:
         self.version = 0
         self.stale_dropped = 0
         self.weight_pushes = 0
+        self.push_failures = 0  # pushes that missed >=1 replica
         self.last_push_ms = 0.0
         self.env_steps = 0
 
@@ -286,15 +288,17 @@ class _SebulbaLearnerImpl:
             broadcast_weights, quantize_params)
         t0 = flight_recorder.clock_ns()
         payload = quantize_params(self.learner.get_weights())
-        replicas = broadcast_weights(
+        updated = broadcast_weights(
             self.cfg.deployment_name, self.version, payload)
         dur = flight_recorder.clock_ns() - t0
         self.weight_pushes += 1
+        if updated < self.cfg.num_replicas:
+            self.push_failures += 1
         self.last_push_ms = dur / 1e6
         rec = flight_recorder.RECORDER
         if rec is not None:
             rec.record("rl", "weight_push", t0, dur,
-                       {"version": self.version, "replicas": replicas})
+                       {"version": self.version, "replicas_updated": updated})
 
     def learn_steps(self, num_steps: int, *,
                     step_timeout_s: float = 30.0) -> Dict[str, Any]:
@@ -357,6 +361,7 @@ class _SebulbaLearnerImpl:
             "env_steps": self.env_steps,
             "stale_dropped": self.stale_dropped,
             "weight_pushes": self.weight_pushes,
+            "push_failures": self.push_failures,
             "last_push_ms": self.last_push_ms,
             "version_lag_max": max(lags) if lags else 0,
             "version_lag_mean": float(np.mean(lags)) if lags else 0.0,
@@ -419,7 +424,6 @@ class Sebulba:
                 "seed": config.seed + 1000 * (i + 1),
                 "handle": self.handle,
                 "replay_name": self._replay_name,
-                "replay_capacity": config.replay_capacity,
                 "infer_timeout_s": config.infer_timeout_s,
             })
             self.actors.append(actor_cls.remote(blob))

@@ -121,6 +121,31 @@ class Replica:
                                          time.perf_counter() - t0,
                                          ongoing)
 
+    def handle_control_request(self, method_name: str,
+                               args_blob: bytes) -> Any:
+        """Control-plane entry point: runs a method on the wrapped
+        callable WITHOUT the max_ongoing_requests gate, the Rejected
+        sentinel, or the Shed translation. For operations that must
+        reach the replica precisely when it is saturated (weight
+        pushes, reconfiguration): the data-plane path would return
+        Rejected, which only the router path retries — a direct caller
+        that ignores the sentinel silently loses the call."""
+        with self._lock:
+            self._total += 1
+        with tracing.span("handle_control_request",
+                          component="serve.replica",
+                          tags={"deployment": self.deployment_name,
+                                "replica": self.replica_id,
+                                "method": method_name}):
+            args, kwargs = serialization.loads(args_blob)
+            fn = getattr(self.callable, method_name, self.callable)
+            result = fn(*args, **kwargs)
+            import inspect
+            if inspect.iscoroutine(result):
+                import asyncio
+                result = asyncio.run(result)
+            return result
+
     def _report_request_metrics(self, outcome: str, seconds: float,
                                 ongoing: int) -> None:
         tags = {"deployment": self.deployment_name}

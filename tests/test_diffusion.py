@@ -116,6 +116,9 @@ def test_sharded_matches_unsharded():
     loss_ref = dit_loss(params, rng, x0, cfg)
     # CPU SPMD pays an involuntary full-remat pass that reorders the
     # reductions; observed spread on the 8-virtual-device CI backend is
-    # ~4e-3 relative, so gate at 1e-2 instead of the TPU-grade 1e-4.
+    # ~4e-3 relative, so gate at 1e-2 there — but ONLY there: on real
+    # accelerators the TPU-grade 1e-4 bound holds and catches sharding
+    # regressions this loose bound would mask.
+    rtol = 1e-2 if jax.default_backend() == "cpu" else 1e-4
     np.testing.assert_allclose(float(loss_sharded), float(loss_ref),
-                               rtol=1e-2)
+                               rtol=rtol)

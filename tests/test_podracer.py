@@ -64,6 +64,34 @@ def test_weight_quantize_roundtrip():
         dequantize_params(params, payload[:-1])
 
 
+def test_weight_push_reaches_saturated_replica():
+    """A replica pegged at max_ongoing_requests sheds data-plane
+    requests with the Rejected sentinel — which only the router path
+    retries — so a weight push through handle_request would silently
+    no-op exactly when admission control is active. The control-plane
+    entry point must bypass the gate."""
+    from ray_tpu.core import serialization
+    from ray_tpu.serve.replica import Rejected, Replica
+
+    class _Policy:
+        def __init__(self):
+            self.version = -1
+
+        def set_weights(self, version, payload):
+            self.version = int(version)
+            return int(version)
+
+    rep = Replica("d", "d#0", serialization.dumps(_Policy),
+                  serialization.dumps(((), {})), max_ongoing_requests=0)
+    blob = serialization.dumps(((7, None), {}))
+    # saturated data plane: the generic entry point sheds ...
+    assert isinstance(rep.handle_request("set_weights", blob), Rejected)
+    assert rep.callable.version == -1
+    # ... the control plane applies the push anyway
+    assert rep.handle_control_request("set_weights", blob) == 7
+    assert rep.callable.version == 7
+
+
 # --- Anakin: multi-device parity --------------------------------------
 
 _ANAKIN_PARITY_SCRIPT = textwrap.dedent("""
@@ -221,8 +249,10 @@ def test_sebulba_e2e_weight_refresh_and_learning(podracer_cluster):
 
     learner = out["learner"]
     assert learner["num_updates"] == 12
-    # >=2 mid-flight version-tagged weight refreshes ...
+    # >=2 mid-flight version-tagged weight refreshes, every one of
+    # them confirmed by every replica (control-plane path, never shed)
     assert learner["weight_pushes"] >= 2
+    assert learner["push_failures"] == 0
     # ... actually observed by the actors, in order, while sampling
     all_versions = set()
     for actor_id, versions in out["versions_by_actor"].items():
@@ -311,8 +341,7 @@ def test_sebulba_replay_backpressure_bounds_depth(podracer_cluster):
     blob = serialization.dumps({
         "actor_id": 0, "env_creator": _BanditEnv, "num_envs": 2,
         "rollout_len": 4, "seed": 0, "handle": handle,
-        "replay_name": "bp:replay", "replay_capacity": 3,
-        "infer_timeout_s": 30.0})
+        "replay_name": "bp:replay", "infer_timeout_s": 30.0})
     actor = ray_tpu.remote(_SebulbaActorImpl).options(
         num_cpus=0).remote(blob)
     metas = [ray_tpu.get(actor.sample_fragment.remote())
@@ -328,4 +357,46 @@ def test_sebulba_replay_backpressure_bounds_depth(podracer_cluster):
     assert len(fresh) == 3
     assert all(f["obs"].shape == (4, 2, 3) for f in fresh)
     ray_tpu.kill(actor)
+    ray_tpu.kill(replay)
+
+
+@pytest.mark.watchdog(120)
+def test_fragment_refs_survive_producer_turnover(podracer_cluster):
+    """Fragment liveness must not depend on producer-side state: a
+    producer that drops its refs the moment push() returns (and keeps
+    producing) leaves queued fragments pinned solely by the replay
+    actor's borrowed refs, and popped fragments pinned by task-return
+    containment — a late get (past the 2s borrow grace window) still
+    resolves every queued fragment."""
+    from ray_tpu.rl.podracer.replay import create_replay_actor
+
+    class _Producer:
+        def __init__(self, replay_name):
+            self._replay = ray_tpu.get_actor(replay_name)
+
+        def produce(self, n, tag0):
+            import gc
+            for i in range(n):
+                ref = ray_tpu.put({"tag": tag0 + i,
+                                   "data": np.arange(2048)})
+                ray_tpu.get(self._replay.push.remote(
+                    ({"tag": tag0 + i}, [ref])))
+                del ref  # no keep-alive: the borrow chain must pin
+            gc.collect()
+            return True
+
+    replay = create_replay_actor(4, name="pin:replay")
+    prod = ray_tpu.remote(_Producer).options(num_cpus=0).remote(
+        "pin:replay")
+    # 12 fragments through a capacity-4 queue: 8 evicted (freed — that
+    # is the point of drop-oldest), 4 survivors pinned only by borrows
+    ray_tpu.get(prod.produce.remote(12, 0))
+    items = ray_tpu.get(replay.pop_many.remote(99))
+    assert [m["tag"] for m, _refs in items] == [8, 9, 10, 11]
+    time.sleep(3.0)  # outlast the borrow grace window before the gets
+    for meta, refs in items:
+        frag = ray_tpu.get(refs[0], timeout=10)
+        assert frag["tag"] == meta["tag"]
+        assert frag["data"].shape == (2048,)
+    ray_tpu.kill(prod)
     ray_tpu.kill(replay)

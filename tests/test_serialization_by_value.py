@@ -1,0 +1,68 @@
+"""_maybe_register_by_value: driver-local modules ship by value, and
+walking a container must not swallow the container's OWN class when it
+is a user-defined subclass (a dict subclass from a driver-local module
+needs its class registered just like a bare callable does)."""
+
+import importlib.util
+import os
+import sys
+import textwrap
+
+import cloudpickle
+import pytest
+
+
+@pytest.fixture
+def driver_local_module(tmp_path):
+    """A module importable only from a driver-private path (like a
+    pytest file on a pytest-inserted sys.path entry): not under
+    sys.prefix/stdlib/site-packages, not resolvable from cwd."""
+    name = "rtpu_test_driver_local"
+    path = tmp_path / f"{name}.py"
+    path.write_text(textwrap.dedent("""
+        class FancyDict(dict):
+            pass
+
+        def fancy_fn():
+            return 42
+    """))
+    spec = importlib.util.spec_from_file_location(name, os.fspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    yield mod
+    sys.modules.pop(name, None)
+    try:
+        cloudpickle.unregister_pickle_by_value(mod)
+    except ValueError:
+        pass  # never registered, or already unregistered
+
+
+def _registered(mod) -> bool:
+    return mod.__name__ in cloudpickle.list_registry_pickle_by_value()
+
+
+def test_callable_inside_container_registers_module(driver_local_module):
+    from ray_tpu.core.serialization import _maybe_register_by_value
+
+    _maybe_register_by_value({"fn": driver_local_module.fancy_fn})
+    assert _registered(driver_local_module)
+
+
+def test_container_subclass_registers_its_own_class(driver_local_module):
+    """Regression: the container walk used to early-return after
+    visiting items, so an INSTANCE of a user-defined dict subclass
+    never got its own class registered by value."""
+    from ray_tpu.core.serialization import _maybe_register_by_value
+
+    value = driver_local_module.FancyDict({"a": 1})
+    _maybe_register_by_value(value)
+    assert _registered(driver_local_module)
+
+
+def test_builtin_containers_do_not_register(driver_local_module):
+    """Plain builtin containers of plain values register nothing."""
+    from ray_tpu.core.serialization import _maybe_register_by_value
+
+    _maybe_register_by_value({"a": 1, "b": (2, 3)})
+    assert not _registered(driver_local_module)
