@@ -27,7 +27,7 @@ from __future__ import annotations
 import logging
 import threading
 
-from ray_tpu.devtools import locktrace, threadguard
+from ray_tpu.devtools import locktrace, refsan, threadguard
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
@@ -127,16 +127,24 @@ class ClientRuntime:
         self.current_runtime_env: Optional[dict] = None
         self.on_block = None  # worker-interface compat (never blocks a pool)
         self.reference_counter = ReferenceCounter()
+        self.reference_counter.refsan_role = "borrower"
         self.reference_counter.set_on_first(
-            lambda oid: self._send({"kind": "REF_ADD",
-                                    "object_id": oid.binary()}))
+            lambda oid: self._send_borrow("REF_ADD", oid))
         self.reference_counter.set_deleter(
-            lambda oid: self._send({"kind": "REF_DROP",
-                                    "object_id": oid.binary()}))
+            lambda oid: self._send_borrow("REF_DROP", oid))
         # The blocking handshake runs on this thread; the registered
         # connection is then serviced by the shared IO loop (replies
         # and pubsub arrive via _on_msg — no dedicated reader thread).
         self._register_conn(self._connect())
+
+    def _send_borrow(self, op: str, oid) -> None:
+        """Report a borrow transition to the owner, mirrored into the
+        refsan ledger (client events fold locally; the client has no
+        push channel into the head's collector)."""
+        led = refsan.LEDGER
+        if led is not None:
+            led.record(refsan.KIND_BORROW_SEND, oid.hex(), {"op": op})
+        self._send({"kind": op, "object_id": oid.binary()})
 
     # -- transport -------------------------------------------------------
     def _register_conn(self, mconn: MessageConnection):

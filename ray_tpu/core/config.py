@@ -167,6 +167,15 @@ class Config:
     # increment push over the control channel).
     flight_flush_interval_s: float = 0.2
 
+    # --- refsan (devtools/refsan.py) ---
+    # Hostile-store mode for the object-lifetime sanitizer: collapse
+    # the owner's borrow grace window to ~0 so deferred reclaims fire
+    # at the earliest legal moment. Stress tests combine it with
+    # RAY_TPU_REFSAN / RAY_TPU_REFSAN_CANARY (env, not config: the
+    # ledger must gate before any config exists) to force
+    # evict-under-borrow races deterministically.
+    refsan_hostile_eviction: bool = False
+
     # --- rpc chaos (fault injection; reference: rpc_chaos.h) ---
     # JSON map of "method" -> failure probability in [0,1].
     testing_rpc_failure: dict = field(default_factory=dict)

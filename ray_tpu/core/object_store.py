@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.devtools import refsan
 from ray_tpu.exceptions import ObjectStoreFullError
 from ray_tpu.native import _lib
 
@@ -73,6 +74,10 @@ class SharedMemoryStore:
             rc = self._lib.shm_create(self._base, object_id.binary(), size,
                                       ctypes.byref(off))
             if rc == _lib.OK:
+                led = refsan.LEDGER
+                if led is not None:
+                    led.slot_alloc(self.name, object_id.binary(),
+                                   off.value, size)
                 return self._shm.buf[off.value : off.value + size]
             if rc == _lib.EXISTS:
                 raise FileExistsError(object_id)
@@ -99,6 +104,10 @@ class SharedMemoryStore:
         rc = self._lib.shm_get(self._base, object_id.binary(), timeout_s,
                                ctypes.byref(off), ctypes.byref(size))
         if rc == _lib.OK:
+            led = refsan.LEDGER
+            if led is not None:
+                led.slot_pin(self.name, object_id.binary(),
+                             off.value, size.value)
             return self._shm.buf[off.value : off.value + size.value]
         if rc in (_lib.NOT_FOUND, _lib.TIMEOUT, _lib.BAD_STATE):
             return None
@@ -109,6 +118,9 @@ class SharedMemoryStore:
         # rather than a native call on an unmapped arena.
         if self._base is None:
             return
+        led = refsan.LEDGER
+        if led is not None:
+            led.slot_release(self.name, object_id.binary())
         self._lib.shm_release(self._base, object_id.binary())
 
     def contains(self, object_id: ObjectID) -> bool:
@@ -119,6 +131,18 @@ class SharedMemoryStore:
     def delete(self, object_id: ObjectID) -> None:
         if self._base is None:
             return
+        led = refsan.LEDGER
+        if led is not None:
+            led.on_slot_delete(self.name, object_id.binary())
+            if led.canary and hasattr(self._lib, "shm_delete_poison"):
+                # Eviction canary: poison the payload under the store
+                # lock iff the slot is really freed (a reader-pinned
+                # slot is left intact — its free is deferred), then
+                # sweep this process's live views against the poison.
+                self._lib.shm_delete_poison(self._base, object_id.binary(),
+                                            refsan.POISON_BYTE)
+                led.verify_views()
+                return
         self._lib.shm_delete(self._base, object_id.binary())
 
     def used_bytes(self) -> int:
@@ -186,7 +210,13 @@ class SharedMemoryStore:
                     pass  # runs from GC/interpreter shutdown
 
         try:
-            value = serialization.unpack_pinned(buf, on_release)
+            if refsan.LEDGER is not None:
+                # Name the object for view registration so the canary
+                # checker can attribute dangling views to their oid.
+                with refsan.view_context(object_id.hex()):
+                    value = serialization.unpack_pinned(buf, on_release)
+            else:
+                value = serialization.unpack_pinned(buf, on_release)
         except BaseException:
             del buf
             on_release()

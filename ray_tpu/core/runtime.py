@@ -28,6 +28,7 @@ from ray_tpu.core.object_store import MemoryStore
 from ray_tpu.core.scheduler import ClusterScheduler
 from ray_tpu.core.task_manager import ObjectLocation, ReferenceCounter, TaskManager
 from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.devtools import refsan
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -113,11 +114,19 @@ class DriverRuntime:
         # when cfg.flight_recorder_enabled.
         from ray_tpu.util import flight_recorder
         flight_recorder.init_driver()
+        # Same idea for the lifetime sanitizer: fresh collector per
+        # session, ledger enabled iff RAY_TPU_REFSAN is exported.
+        refsan.init_driver()
         self.scheduler = ClusterScheduler(self.gcs)
         self.task_manager = TaskManager()
         self.reference_counter = ReferenceCounter()
         self.reference_counter.set_deleter(self._maybe_delete_object)
-        self._ref_grace_s = 2.0
+        self.reference_counter.refsan_role = "owner"
+        # Hostile-store mode collapses the borrow grace window so
+        # deferred reclaims fire at the earliest legal moment — tier-1
+        # uses it (with refsan) to force PR-13-shaped races instead of
+        # waiting for them.
+        self._ref_grace_s = 0.05 if cfg.refsan_hostile_eviction else 2.0
         # objects pinned because they are contained in a stored value
         # (task return / put): container oid -> contained oids
         self._contained_refs: Dict[ObjectID, List[ObjectID]] = {}
@@ -1600,7 +1609,11 @@ class DriverRuntime:
             return
         oids = [b if isinstance(b, ObjectID) else ObjectID(b)
                 for b in contained]
+        led = refsan.LEDGER
         for oid in oids:
+            if led is not None:
+                led.record(refsan.KIND_PIN_CONTAINED, oid.hex(),
+                           {"container": container.hex()})
             self.reference_counter.add_local_reference(oid)
         with self._contained_lock:
             self._contained_refs.setdefault(container, []).extend(oids)
@@ -1613,6 +1626,12 @@ class DriverRuntime:
             return  # shutdown: shm arenas may already be unmapped
         if not self.task_manager.is_ready(oid):
             return  # producing task still running; keep bookkeeping
+        led = refsan.LEDGER
+        if led is not None:
+            # Point of no return for this oid: any owner-side borrow
+            # registration sequenced after this event is a grace
+            # violation (the PR-13 class).
+            led.record(refsan.KIND_DELETED, oid.hex())
         self.memory_store.delete(oid)
         loc = self.task_manager.get_location(oid)
         targets = set()
@@ -2137,6 +2156,11 @@ class DriverRuntime:
             from ray_tpu.util import flight_recorder
             flight_recorder.store_push(args[0], args[1], args[2])
             return True
+        if method == "refsan_push":
+            # lifetime-ledger increment from a worker's refsan flusher;
+            # same brevity contract as flight_push
+            refsan.store_push(args[0], args[1])
+            return True
         raise ValueError(f"unknown GCS method {method}")
 
     # --- misc api --------------------------------------------------------
@@ -2313,6 +2337,10 @@ class DriverRuntime:
         self.gcs.add_task_events(events)
 
     def shutdown(self) -> None:
+        # Fold the lifetime ledger while worker journals and live-view
+        # state are still current (stores close below); findings are
+        # kept for post-shutdown refsan.report() calls.
+        refsan.on_shutdown()
         self._stopped.set()
         for hook in getattr(self, "_shutdown_hooks", ()):
             try:

@@ -23,6 +23,14 @@ from typing import Any, List, Tuple
 
 import cloudpickle
 
+from ray_tpu.devtools import refsan
+
+# Regression-fixture hook (tests only): when set, unpack_pinned takes
+# the pre-PR-11 buggy path — on_release fires while zero-copy views of
+# the arena are still live — so tier-1 can prove the refsan eviction
+# canary re-detects that bug class deterministically.
+_FIXTURE_EARLY_RELEASE = False
+
 # --- nested-ref collection -------------------------------------------------
 # While a collector is active on this thread, every ObjectRef pickled
 # reports its id here. Used to pin objects *contained in* stored values
@@ -206,6 +214,28 @@ def unpack_pinned(src, on_release) -> Any:
         value = pickle.loads(data)
         on_release()
         return value
+    if _FIXTURE_EARLY_RELEASE:
+        # Pre-PR-11 bug shape, preserved behind a test-only flag: the
+        # pin is released as soon as deserialization returns, while the
+        # value still holds zero-copy views into the arena. With the
+        # refsan canary on, the next slot free poisons the range and
+        # verify_views() flags every one of these views.
+        import ctypes
+        led = refsan.LEDGER
+        buffers = []
+        for size in sizes:
+            offset = _align(offset)
+            ct = (ctypes.c_char * size).from_buffer(src[offset:offset + size])
+            if led is not None:
+                led.register_view(ct, size)
+            buffers.append(ct)
+            offset += size
+        try:
+            value = pickle.loads(data, buffers=buffers)
+        finally:
+            del buffers
+            on_release()  # BUG under test: views outlive the pin
+        return value
     if sys.version_info < (3, 12):
         # Python classes can't export the buffer protocol before
         # PEP 688, but ctypes arrays can: hand pickle zero-copy ctypes
@@ -227,11 +257,14 @@ def unpack_pinned(src, on_release) -> Any:
                 except Exception:  # graftlint: disable=GL004
                     pass  # finalizer may run at interpreter shutdown
 
+        led = refsan.LEDGER
         buffers = []
         for size in sizes:
             offset = _align(offset)
             ct = (ctypes.c_char * size).from_buffer(src[offset:offset + size])
             weakref.finalize(ct, _dec)
+            if led is not None:
+                led.register_view(ct, size)
             buffers.append(ct)
             offset += size
         try:
@@ -244,7 +277,8 @@ def unpack_pinned(src, on_release) -> Any:
     class _PinnedBuffer:
         """Buffer provider (PEP 688) releasing the store pin at GC."""
 
-        __slots__ = ("_view",)
+        # __weakref__: the refsan view registry tracks these by weakref
+        __slots__ = ("_view", "__weakref__")
 
         def __init__(self, view):
             self._view = view
@@ -263,10 +297,14 @@ def unpack_pinned(src, on_release) -> Any:
                 except Exception:  # graftlint: disable=GL004
                     pass  # __del__ from GC context
 
+    led = refsan.LEDGER
     buffers = []
     for size in sizes:
         offset = _align(offset)
-        buffers.append(_PinnedBuffer(src[offset : offset + size]))
+        pb = _PinnedBuffer(src[offset : offset + size])
+        if led is not None:
+            led.register_view(pb, size)
+        buffers.append(pb)
         offset += size
     try:
         return pickle.loads(data, buffers=buffers)

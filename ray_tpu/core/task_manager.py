@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_tpu.core.ids import NodeID, ObjectID, TaskID
 from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.devtools import refsan
 from ray_tpu.util.metrics import Counter, Histogram
 
 logger = logging.getLogger(__name__)
@@ -77,6 +78,10 @@ class ReferenceCounter:
         self._counts: Dict[ObjectID, int] = {}
         self._deleter: Optional[Callable[[ObjectID], None]] = None
         self._on_first: Optional[Callable[[ObjectID], None]] = None
+        # refsan ledger role tag: "owner" on the head's counter,
+        # "borrower" on worker/client counters (set by their runtimes).
+        # The fold only judges grace violations against owner events.
+        self.refsan_role = "local"
 
     def set_deleter(self, fn: Callable[[ObjectID], None]) -> None:
         self._deleter = fn
@@ -93,6 +98,10 @@ class ReferenceCounter:
         with self._lock:
             count = self._counts.get(object_id, 0)
             self._counts[object_id] = count + 1
+            led = refsan.LEDGER
+            if led is not None:
+                led.ref_event(refsan.KIND_REF_ADD, object_id.binary(),
+                              count + 1, self.refsan_role)
             if count == 0 and self._on_first is not None:
                 try:
                     self._on_first(object_id)
@@ -108,12 +117,24 @@ class ReferenceCounter:
         in-flight borrows)."""
         with self._lock:
             count = self._counts.get(object_id)
+            led = refsan.LEDGER
             if count is None:
+                if led is not None:
+                    led.ref_event(refsan.KIND_REF_DROP_MISSING,
+                                  object_id.binary(), 0, self.refsan_role)
                 return
+            if led is not None:
+                led.ref_event(refsan.KIND_REF_DROP, object_id.binary(),
+                              count - 1, self.refsan_role)
             if count > 1:
                 self._counts[object_id] = count - 1
                 return
             del self._counts[object_id]
+            if led is not None:
+                led.ref_event(
+                    refsan.KIND_REF_DEFER if defer is not None
+                    else refsan.KIND_REF_ZERO,
+                    object_id.binary(), 0, self.refsan_role)
             deleter = self._deleter
             if deleter is not None and defer is None:
                 try:
@@ -151,6 +172,12 @@ class ReferenceCounter:
     def _delete_if_still_zero(self, object_id: ObjectID, deleter) -> None:
         with self._lock:
             if self._counts.get(object_id, 0) > 0:
+                led = refsan.LEDGER
+                if led is not None:
+                    led.ref_event(refsan.KIND_RECLAIM_SKIP,
+                                  object_id.binary(),
+                                  self._counts.get(object_id, 0),
+                                  self.refsan_role)
                 return  # re-borrowed during the grace window
             try:
                 deleter(object_id)

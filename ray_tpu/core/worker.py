@@ -33,6 +33,7 @@ from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.protocol import MessageConnection
 from ray_tpu.core.task_manager import ReferenceCounter
 from ray_tpu.core.task_spec import Arg, TaskSpec
+from ray_tpu.devtools import refsan
 from ray_tpu.exceptions import GetTimeoutError, ObjectLostError, TaskError
 from ray_tpu.util import flight_recorder as _flight
 
@@ -68,12 +69,11 @@ class WorkerRuntime:
         # it at the owner (REF_ADD); the last drop releases it
         # (REF_DROP). reference: reference_counter.h:43 borrowing.
         self.reference_counter = ReferenceCounter()
+        self.reference_counter.refsan_role = "borrower"
         self.reference_counter.set_on_first(
-            lambda oid: self.conn.send(
-                {"kind": "REF_ADD", "object_id": oid.binary()}))
+            lambda oid: self._send_borrow("REF_ADD", oid))
         self.reference_counter.set_deleter(
-            lambda oid: self.conn.send(
-                {"kind": "REF_DROP", "object_id": oid.binary()}))
+            lambda oid: self._send_borrow("REF_DROP", oid))
         self.is_driver = False
         # set by worker_main: flushes queued specs back to the node
         # before this worker blocks on an object
@@ -100,6 +100,15 @@ class WorkerRuntime:
         # set when runtime_env setup failed: every task handed to this
         # worker fails fast with this error instead of executing
         self.setup_error: Optional[Exception] = None
+
+    def _send_borrow(self, op: str, oid) -> None:
+        """Report a borrow transition to the owner; mirrored into the
+        refsan ledger so the driver-side fold can pair each wire send
+        with the owner's add/drop."""
+        led = refsan.LEDGER
+        if led is not None:
+            led.record(refsan.KIND_BORROW_SEND, oid.hex(), {"op": op})
+        self.conn.send({"kind": op, "object_id": oid.binary()})
 
     # --- request/reply with the node manager ---------------------------
     def _next_req(self) -> Tuple[int, threading.Event, list]:
@@ -633,6 +642,9 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
     # driver turned it on (flag rides the inherited environment).
     from ray_tpu.util import flight_recorder
     flight_recorder.init_worker(rt, worker_id)
+    # Lifetime sanitizer: same inherit-the-env contract — the ledger and
+    # its push flusher start only when the driver exported RAY_TPU_REFSAN.
+    refsan.init_worker(rt, worker_id)
 
     from ray_tpu.core.protocol import PROTOCOL_VERSION
     conn.send({"kind": "REGISTER", "worker_id": worker_id.binary(),

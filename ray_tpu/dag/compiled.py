@@ -408,8 +408,11 @@ class CompiledDAG:
                 # repeated failed compiles can't leak actor-side sockets
                 for aid, token in listener_reqs:
                     try:
-                        handles[aid].__ray_call__.remote(_close_listener,
-                                                         token)
+                        # fire-and-forget close nudge: the completed
+                        # result is reclaimed by the owner after the
+                        # borrow grace window (runtime completion path)
+                        handles[aid].__ray_call__.remote(  # graftlint: disable=GL015
+                            _close_listener, token)
                     except Exception:  # noqa: BLE001 — reclaim sweep
                         logger.debug("listener reclaim failed on actor "
                                      "%s", aid, exc_info=True)

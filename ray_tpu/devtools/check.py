@@ -10,7 +10,10 @@ Runs, in order, everything a reviewer would otherwise run by hand:
 3. **threadguard** — the same smoke run with ``RAY_TPU_THREADGUARD=1``
    and an aggressive stall threshold; fails on any ``@loop_only``
    affinity violation (raises in-run) or watchdog stall report.
-4. **stress** — the native shm stress binary, plain plus ASan/TSan
+4. **refsan** — the object-lifetime sanitizer's fold over a seeded
+   leak/double-release fixture (must fire), then the smoke run with
+   ``RAY_TPU_REFSAN=1`` (must report zero ledger findings).
+5. **stress** — the native shm stress binary, plain plus ASan/TSan
    variants when the toolchain on this image can link them; each
    missing sanitizer is a clean SKIP, not a failure.
 
@@ -51,7 +54,13 @@ assert len(ray_tpu.get(blob)) == 100_000
 ray_tpu.shutdown()
 
 mode = sys.argv[1]
-if mode == "locktrace":
+if mode == "refsan":
+    from ray_tpu.devtools import refsan
+    findings = refsan.report()
+    if findings:
+        print(refsan.format_findings(findings))
+        sys.exit(3)
+elif mode == "locktrace":
     from ray_tpu.devtools import locktrace
     rep = locktrace.report()
     if rep.get("cycles"):
@@ -295,11 +304,41 @@ def step_podracer() -> Tuple[str, str]:
                   f"{out['total_loss']:.3f}, weight wire <2% err")
 
 
+def step_refsan() -> Tuple[str, str]:
+    """Object-lifetime sanitizer: the fold must flag a seeded
+    leak/double-release fixture (in-process, synthetic events), and a
+    clean end-to-end smoke under RAY_TPU_REFSAN=1 must report zero
+    ledger findings."""
+    from ray_tpu.devtools import refsan
+
+    # -- seeded fixture: the detector itself must fire -------------------
+    label = "check:seeded"
+    seeded = [
+        # oid "aa": pinned once, never released, no live view → leak
+        (0, "aa" * 8, label, refsan.KIND_SLOT_PIN, 0, {"store": "s"}),
+        # oid "bb": released with no pin outstanding → double release
+        (1, "bb" * 8, label, refsan.KIND_SLOT_RELEASE, 0, {"store": "s"}),
+    ]
+    kinds = sorted(f["kind"] for f in refsan.fold(
+        seeded, live_views={}, local_label=label))
+    if kinds != ["double_release", "leaked_pin"]:
+        return "FAIL", (f"seeded fixture misfolded: expected "
+                        f"[double_release, leaked_pin], got {kinds}")
+
+    # -- clean smoke: a correct workload must stay quiet -----------------
+    ok, out = _run_smoke("refsan", {"RAY_TPU_REFSAN": "1",
+                                    "RAY_TPU_REFSAN_CANARY": "1"})
+    if not ok:
+        return "FAIL", out[-4000:]
+    return "ok", "seeded fixture fired; clean smoke reported 0 findings"
+
+
 _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("lint", step_lint),
     ("pipeline", step_pipeline),
     ("podracer", step_podracer),
     ("recorder", step_recorder),
+    ("refsan", step_refsan),
     ("locktrace", step_locktrace),
     ("threadguard", step_threadguard),
     ("stress", step_stress),

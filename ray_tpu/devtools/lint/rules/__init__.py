@@ -1,4 +1,4 @@
 """Rule families. Importing this package registers every rule."""
 
 from ray_tpu.devtools.lint.rules import (concurrency, conventions,  # noqa: F401
-                                         hygiene, threadguard)
+                                         hygiene, ownership, threadguard)

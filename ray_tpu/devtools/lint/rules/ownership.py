@@ -1,0 +1,317 @@
+"""Object-ownership rules (GL014-GL017).
+
+The static half of refsan (``ray_tpu/devtools/refsan.py``): these rules
+catch lifetime-protocol misuse at the source level — reference
+round-trips that skip borrow registration, pins created in loops with
+no holder, out-of-band views whose release is not tied to the value's
+lifetime, and reference-count state mutated outside its lock-owning
+methods. GL015/GL016 are project rules: the drop-in-a-loop and the
+lifetime-tie may live one call away, so they walk the interprocedural
+call graph (callgraph.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.lint.annotate import (_MUTATORS, _dotted,
+                                            _is_self_attr)
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+from ray_tpu.devtools.lint.callgraph import (Key, ProjectContext, _leaf,
+                                             body_nodes)
+
+#: reference-count state owned by ReferenceCounter / the refsan Ledger;
+#: mutable only by self, under the owner's lock
+_COUNT_ATTRS = {"_counts", "_pins"}
+
+
+def _contains_binary_call(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and
+               isinstance(n.func, ast.Attribute) and
+               n.func.attr == "binary"
+               for n in ast.walk(node))
+
+
+def _loop_node_ids(func_node: ast.AST) -> Set[int]:
+    """ids of nodes lexically inside a For/While in this function's own
+    body (callgraph ``loop_ctx`` is IO-loop-THREAD context — unrelated)."""
+    out: Set[int] = set()
+    for n in body_nodes(func_node):
+        if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+            for sub in body_nodes(n):
+                out.add(id(sub))
+    return out
+
+
+@register
+class RefFromRawBinary(Rule):
+    id = "GL014"
+    name = "ref-from-raw-binary"
+    rationale = ("ObjectRef(ObjectID(x.binary())) round-trips a "
+                 "reference through raw bytes: the bytes carry no "
+                 "liveness, so nothing guarantees the object survived "
+                 "between binary() and the re-registration — "
+                 "serialize the ObjectRef itself (pickling registers "
+                 "the borrow) or keep the original ref alive")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        # per-function dataflow: names assigned from a .binary() result
+        for fn in (n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            tainted: Set[str] = set()
+            for n in body_nodes(fn):
+                if isinstance(n, ast.Assign) and \
+                        _contains_binary_call(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            tainted.add(t.id)
+            for call in body_nodes(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                if _leaf(_dotted(call.func)) != "ObjectRef":
+                    continue
+                if not call.args:
+                    continue
+                arg = call.args[0]
+                hit = _contains_binary_call(arg) or any(
+                    isinstance(n, ast.Name) and n.id in tainted
+                    for n in ast.walk(arg))
+                if hit:
+                    yield ctx.finding(
+                        self.id, call,
+                        "ObjectRef constructed from raw binary() bytes "
+                        "outside the serialization/borrow-registration "
+                        "paths — the owner-side REF_ADD is skipped for "
+                        "the window the bytes were in flight; pass the "
+                        "ObjectRef itself (pickle registers the borrow)")
+
+
+@register
+class DroppedRefInLoop(Rule):
+    id = "GL015"
+    name = "dropped-ref-in-loop"
+    project = True
+    rationale = ("a put()/task-submit result discarded inside a loop "
+                 "accumulates owner-side pins with no holder to ever "
+                 "release them — keep the refs (and drop them when "
+                 "consumed) or don't create the object")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        # (func key, drop site) for bare drops not already in a lexical
+        # loop; resolved against callers below (two-hop)
+        bare: List[Tuple[Key, ast.Call]] = []
+        loop_ids: Dict[Key, Set[int]] = {}
+        for key, info in sorted(project.functions.items()):
+            loop_ids[key] = _loop_node_ids(info.node)
+            for n in body_nodes(info.node):
+                if not isinstance(n, ast.Expr) or \
+                        not isinstance(n.value, ast.Call):
+                    continue
+                call = n.value
+                what = self._submit_kind(project, key[0], call)
+                if what is None:
+                    continue
+                if id(n) in loop_ids[key] or id(call) in loop_ids[key]:
+                    yield info.ctx.finding(
+                        self.id, call,
+                        f"{what} result dropped on the floor inside a "
+                        f"loop in {info.qualname}() — every iteration "
+                        "pins an object nobody can release")
+                else:
+                    bare.append((key, call))
+        if not bare:
+            return
+        # two-hop: the bare drop's enclosing function is itself called
+        # from inside a loop in some caller
+        callers: Dict[Key, List[Tuple[Key, ast.Call]]] = {}
+        for caller, edges in project.calls.items():
+            for callee, site in edges:
+                callers.setdefault(callee, []).append((caller, site))
+        for key, call in bare:
+            info = project.functions[key]
+            for caller, site in callers.get(key, ()):
+                if id(site) not in loop_ids.get(caller, ()):
+                    continue
+                cq = project.functions[caller].qualname
+                yield info.ctx.finding(
+                    self.id, call,
+                    f"{self._submit_kind(project, key[0], call)} result "
+                    f"dropped on the floor in {info.qualname}(), which "
+                    f"is called from a loop in {cq}() "
+                    f"({cq} -> {info.qualname}) — every iteration pins "
+                    "an object nobody can release")
+                break
+
+    @staticmethod
+    def _submit_kind(project: ProjectContext, path: str,
+                     call: ast.Call) -> Optional[str]:
+        # a `.remote(...)` leaf fires regardless of the receiver shape
+        # (subscripted receivers like pool[i].f.remote() defeat _dotted)
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "remote":
+            return "task submit"
+        dotted = _dotted(call.func)
+        if dotted is None:
+            return None
+        # only ray_tpu's put(); a bare q.put() is a queue, not a pin
+        imports = project._imports.get(path, {})
+        base = dotted.split(".", 1)[0]
+        imp = imports.get(base)
+        if imp is None:
+            return None
+        module, orig = imp
+        resolved = f"{module}.{orig}" if orig else module
+        if "." in dotted:
+            resolved = resolved + "." + dotted.split(".", 1)[1]
+        if resolved in ("ray_tpu.put", "ray_tpu.api.put",
+                        "ray_tpu.core.api.put"):
+            return "put()"
+        return None
+
+
+@register
+class UntiedPinnedView(Rule):
+    id = "GL016"
+    name = "untied-pinned-view"
+    project = True
+    rationale = ("deserializing with out-of-band buffers and then "
+                 "calling on_release() inline frees the backing store "
+                 "pin while the value still holds zero-copy views (the "
+                 "PR-11 bug) — tie the release to the value's lifetime "
+                 "(weakref.finalize on a from_buffer view, or a "
+                 "__buffer__/__del__ provider)")
+
+    #: call leaves that tie a release to a value's lifetime
+    _TIE_LEAVES = {"finalize", "from_buffer"}
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        for key, info in sorted(project.functions.items()):
+            oob_loads = [
+                c for c in project.body_calls(info.node)
+                if _leaf(_dotted(c.func)) == "loads" and
+                any(kw.arg == "buffers" for kw in c.keywords)]
+            if not oob_loads:
+                continue
+            releases = any(
+                _leaf(_dotted(c.func) or "") == "on_release"
+                for c in project.body_calls(info.node))
+            if not releases:
+                continue
+            if self._has_lifetime_tie(project, key):
+                continue
+            for c in oob_loads:
+                yield info.ctx.finding(
+                    self.id, c,
+                    f"{info.qualname}() hands out out-of-band buffers "
+                    "and calls on_release() inline: the pin dies before "
+                    "the zero-copy views do — tie the release to the "
+                    "value (weakref.finalize / from_buffer holder / "
+                    "__buffer__ provider)")
+
+    def _has_lifetime_tie(self, project: ProjectContext,
+                          key: Key) -> bool:
+        """The function (or a callee within two hops) builds a
+        value-lifetime release: a finalize/from_buffer call or a class
+        whose __del__/__buffer__ carries the release."""
+        seen: Set[Key] = set()
+        frontier = [key]
+        for _hop in range(3):   # the function itself + two hops
+            nxt: List[Key] = []
+            for k in frontier:
+                if k in seen:
+                    continue
+                seen.add(k)
+                info = project.functions.get(k)
+                if info is None:
+                    continue
+                for n in ast.walk(info.node):
+                    if isinstance(n, ast.Call) and \
+                            _leaf(_dotted(n.func)) in self._TIE_LEAVES:
+                        return True
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and \
+                            n is not info.node and \
+                            n.name in ("__del__", "__buffer__"):
+                        return True
+                nxt.extend(c for c, _site in project.calls.get(k, ()))
+            frontier = nxt
+        return False
+
+
+@register
+class CountStateMutation(Rule):
+    id = "GL017"
+    name = "count-state-mutation"
+    rationale = ("_counts/_pins are the ReferenceCounter's (and refsan "
+                 "Ledger's) private count state: every mutation must go "
+                 "through the owner's lock-holding methods, or adds and "
+                 "drops race and the deleter fires early/never")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            target_attr = self._mutated_count_attr(node)
+            if target_attr is None:
+                continue
+            attr_node, is_self = target_attr
+            if not is_self:
+                yield ctx.finding(
+                    self.id, node,
+                    f"reference-count state .{attr_node} mutated from "
+                    "outside its owning class — go through the "
+                    "counter's lock-holding methods")
+                continue
+            func = getattr(node, "_gl_func", None)
+            if func == "__init__" and self._is_rebind(node):
+                continue    # initialization of the container itself
+            if getattr(node, "_gl_lockdepth", 0) > 0:
+                continue    # mutated under the owner's lock
+            yield ctx.finding(
+                self.id, node,
+                f"self.{attr_node} mutated outside a `with self._lock:` "
+                "block — count transitions must be lock-ordered or the "
+                "deleter can fire early/never")
+
+    @staticmethod
+    def _is_rebind(node: ast.AST) -> bool:
+        """Plain attribute (re)binding, e.g. ``self._counts = {}`` —
+        allowed in __init__ as container creation."""
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            return all(not isinstance(t, ast.Subscript) for t in targets)
+        return False
+
+    @staticmethod
+    def _mutated_count_attr(
+            node: ast.AST) -> Optional[Tuple[str, bool]]:
+        """(attr name, is_self_attr) when ``node`` mutates a
+        _counts/_pins attribute; None otherwise."""
+        def classify(attr_expr: ast.AST) -> Optional[Tuple[str, bool]]:
+            if isinstance(attr_expr, ast.Attribute) and \
+                    attr_expr.attr in _COUNT_ATTRS:
+                return (attr_expr.attr,
+                        _is_self_attr(attr_expr) is not None)
+            return None
+
+        def from_target(target: ast.AST) -> Optional[Tuple[str, bool]]:
+            if isinstance(target, ast.Subscript):
+                return classify(target.value)
+            return classify(target)
+
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            return classify(node.func.value)
+        if isinstance(node, (ast.Assign, ast.Delete)):
+            for t in node.targets:
+                hit = from_target(t)
+                if hit is not None:
+                    return hit
+            return None
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            return from_target(node.target)
+        if isinstance(node, ast.AugAssign):
+            return from_target(node.target)
+        return None

@@ -36,6 +36,8 @@ def load():
         lib.shm_release.argtypes = [p, idp]
         lib.shm_delete.restype = i64
         lib.shm_delete.argtypes = [p, idp]
+        lib.shm_delete_poison.restype = i64
+        lib.shm_delete_poison.argtypes = [p, idp, i64]
         lib.shm_evict.restype = i64
         lib.shm_evict.argtypes = [p, u64]
         lib.shm_used_bytes.restype = i64

@@ -366,6 +366,31 @@ int64_t shm_delete(void* base, const uint8_t* id) {
   return kOk;
 }
 
+// refsan eviction canary: shm_delete, except that when the slot is
+// actually freed (no reader pins outstanding) the payload range is
+// first filled with `poison` — still under the store lock, so a
+// concurrent shm_create in another process cannot reuse the block
+// between the free and the poison write. A dangling zero-copy view
+// left behind by a buggy early-release path then reads a deterministic
+// canary pattern instead of stale-or-reused bytes.
+int64_t shm_delete_poison(void* base, const uint8_t* id, int64_t poison) {
+  Header* h = H(base);
+  lock(h);
+  ObjectEntry* e = find(base, id);
+  if (!e) { pthread_mutex_unlock(&h->mutex); return kNotFound; }
+  if (e->refcount > 0) e->refcount--;  // creator pin
+  if (e->refcount <= 0) {
+    memset((char*)base + e->offset, (int)poison, e->size);
+    free_block(base, e->offset - kBlockHeader);
+    e->state = kEmpty;
+    h->num_objects--;
+  } else {
+    e->lru = 0;
+  }
+  pthread_mutex_unlock(&h->mutex);
+  return kOk;
+}
+
 int64_t shm_evict(void* base, uint64_t bytes) {
   Header* h = H(base);
   lock(h);

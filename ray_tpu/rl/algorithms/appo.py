@@ -68,7 +68,9 @@ class APPO(PPO):
         weights = self.learner_group.get_weights()
         if not self._inflight:
             for idx, runner in enumerate(self.runners):
-                runner.set_weights.remote(weights)
+                # fire-and-forget weight push: the completed result is
+                # reclaimed by the owner after the borrow grace window
+                runner.set_weights.remote(weights)  # graftlint: disable=GL015
                 self._launch(idx)
 
         batches = []
@@ -120,7 +122,10 @@ class APPO(PPO):
             # resume sampling IMMEDIATELY; weights go once per runner
             # per step (they only change after the sgd below)
             if idx not in pushed:
-                self.runners[idx].set_weights.remote(weights)
+                # fire-and-forget re-push (same contract as the initial
+                # launch push above: completed results are reclaimed by
+                # the owner after the borrow grace window)
+                self.runners[idx].set_weights.remote(weights)  # graftlint: disable=GL015
                 pushed.add(idx)
             self._launch(idx)
             if payload is None:
@@ -141,8 +146,9 @@ class APPO(PPO):
             self._connector_state = (
                 self._connector_template.merge_states(
                     [self._connector_state] + deltas))
-            for r in self.runners:  # fire-and-forget broadcast
-                r.set_connector_state.remote(self._connector_state)
+            for r in self.runners:  # fire-and-forget broadcast (the
+                # completed result is reclaimed after the grace window)
+                r.set_connector_state.remote(self._connector_state)  # graftlint: disable=GL015
         metrics["fragments_consumed"] = consumed
         metrics["fragments_in_flight"] = len(self._inflight)
         return metrics

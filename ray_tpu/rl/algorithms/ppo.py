@@ -446,8 +446,9 @@ class PPO(Algorithm):
                 self._connector_state = (
                     self._connector_template.merge_states(
                         [self._connector_state] + deltas))
-                for r in self.runners:  # fire-and-forget broadcast
-                    r.set_connector_state.remote(self._connector_state)
+                for r in self.runners:  # fire-and-forget broadcast (the
+                    # completed result is reclaimed after grace)
+                    r.set_connector_state.remote(self._connector_state)  # graftlint: disable=GL015
         else:
             for runner in self.runners:
                 runner.set_weights(weights)

@@ -232,6 +232,38 @@ def bench_recorder_overhead(rt, n: int) -> dict:
             if dt_off > 0 else 1.0}
 
 
+def bench_refsan_overhead(rt, n: int) -> dict:
+    """Object-lifetime sanitizer cost on the tight trivial-task loop:
+    the same submit-then-drain run with the ledger disabled, then
+    enabled on the driver. The committed guard bound lives in
+    tests/test_refsan.py; this row is the measured ratio for PERF.md."""
+    import ray_tpu
+    from ray_tpu.devtools import refsan
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(1000)])
+    saved = refsan.LEDGER
+    try:
+        refsan.disable()
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        dt_off = time.perf_counter() - t0
+        refsan.enable("driver:bench", canary=False)
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        dt_on = time.perf_counter() - t0
+    finally:
+        refsan.LEDGER = saved
+    return {"bench": "refsan_overhead", "n": n,
+            "seconds_disabled": round(dt_off, 3),
+            "seconds_enabled": round(dt_on, 3),
+            "enabled_over_disabled": round(dt_on / dt_off, 3)
+            if dt_off > 0 else 1.0}
+
+
 def bench_process_threads(rt) -> dict:
     """Thread topology after a warm workload: with the selector IO
     loop, socket service is ONE rtpu-io-loop thread regardless of
@@ -282,6 +314,10 @@ def main(argv=None) -> None:
     parser.add_argument("--recorder", action="store_true",
                         help="measure flight-recorder overhead on the "
                              "trivial-task loop (enabled vs disabled)")
+    parser.add_argument("--refsan", action="store_true",
+                        help="measure object-lifetime-sanitizer ledger "
+                             "overhead on the trivial-task loop "
+                             "(enabled vs disabled)")
     args = parser.parse_args(argv)
 
     import ray_tpu
@@ -304,6 +340,10 @@ def main(argv=None) -> None:
     print(json.dumps(results[-1]), flush=True)
     if args.recorder:
         out = bench_recorder_overhead(rt, args.tasks)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    if args.refsan:
+        out = bench_refsan_overhead(rt, args.tasks)
         results.append(out)
         print(json.dumps(out), flush=True)
     if args.compare_wire:

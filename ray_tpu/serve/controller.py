@@ -90,7 +90,9 @@ class ServeController:
                             and d["config"].user_config
                             != old_config.user_config):
                         for h in existing.replicas.values():
-                            h.reconfigure.remote(d["config"].user_config)
+                            # fire-and-forget reconfigure broadcast; the
+                            # completed result is reclaimed after grace
+                            h.reconfigure.remote(d["config"].user_config)  # graftlint: disable=GL015
                     existing.status = "UPDATING"
                 else:
                     self._deployments[name] = _DeploymentState(
@@ -396,7 +398,9 @@ class ServeController:
                 self._bump_locked()
             for h in doomed:
                 try:
-                    h.prepare_for_shutdown.remote()
+                    # fire-and-forget pre-kill drain nudge; the replica
+                    # dies right after, so nobody can hold the result
+                    h.prepare_for_shutdown.remote()  # graftlint: disable=GL015
                     ray_tpu.kill(h)
                 except Exception:
                     logger.exception("downscale shutdown failed for a "

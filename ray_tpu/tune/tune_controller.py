@@ -135,7 +135,9 @@ class TuneController:
         actor = self._actors.pop(trial.trial_id, None)
         if actor is not None:
             try:
-                actor.stop.remote()
+                # fire-and-forget pre-kill stop nudge; the actor dies
+                # right after, so nobody can hold the result
+                actor.stop.remote()  # graftlint: disable=GL015
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001 — trial actor already dead
                 logger.debug("trial teardown kill failed", exc_info=True)

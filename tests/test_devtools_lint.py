@@ -727,3 +727,276 @@ def test_cli_github_format(tmp_path, capsys):
     assert lint.main([str(good), "--no-baseline",
                       "--format=github"]) == 0
     assert "::notice" in capsys.readouterr().out
+
+
+# -- GL014 ObjectRef from raw binary() ---------------------------------
+
+def test_gl014_fires_on_raw_binary_roundtrip():
+    findings = run("""
+        from ray_tpu.core.object_ref import ObjectRef
+
+        def rehydrate(ref):
+            return ObjectRef(ObjectID(ref.binary()))
+    """, select=["GL014"])
+    assert [f.rule for f in findings] == ["GL014"]
+    assert "binary()" in findings[0].message
+
+
+def test_gl014_tracks_tainted_name():
+    # the bytes flow through a local name before the re-wrap
+    assert rules_hit("""
+        from ray_tpu.core.object_ref import ObjectRef
+
+        def rehydrate(ref):
+            raw = ref.binary()
+            return ObjectRef(raw)
+    """, select=["GL014"]) == {"GL014"}
+
+
+def test_gl014_quiet_on_legit_construction():
+    # constructing from an ObjectID (the serialization path) is fine,
+    # as is calling .binary() for logging without re-wrapping it
+    assert rules_hit("""
+        from ray_tpu.core.object_ref import ObjectRef
+
+        def make(oid):
+            return ObjectRef(oid)
+
+        def describe(ref):
+            return ref.binary().hex()
+    """, select=["GL014"]) == set()
+
+
+def test_gl014_per_line_disable():
+    src = ("from ray_tpu.core.object_ref import ObjectRef\n"
+           "def f(ref):\n"
+           "    return ObjectRef(ref.binary())"
+           "  # graftlint: disable=GL014\n")
+    assert rules_hit(src, select=["GL014"]) == set()
+
+
+# -- GL015 put()/submit result dropped in a loop -----------------------
+
+GL015_POS_DIRECT = """
+    def broadcast(workers, blob):
+        for w in workers:
+            w.ping.remote(blob)
+"""
+
+GL015_POS_TWO_HOP = """
+    def push(w):
+        w.ping.remote(1)
+
+    def run(workers):
+        for w in workers:
+            push(w)
+"""
+
+
+def test_gl015_fires_on_direct_loop_drop():
+    findings = run(GL015_POS_DIRECT, select=["GL015"])
+    assert [f.rule for f in findings] == ["GL015"]
+    assert "inside a loop in broadcast()" in findings[0].message
+
+
+def test_gl015_fires_on_subscripted_receiver():
+    # pool[i].f.remote() defeats plain dotted-name resolution; the
+    # .remote leaf must still fire
+    assert rules_hit("""
+        def repush(self, idxs):
+            while idxs:
+                idx = idxs.pop()
+                if idx >= 0:
+                    self.runners[idx].set_weights.remote(1)
+    """, select=["GL015"]) == {"GL015"}
+
+
+def test_gl015_fires_on_ray_tpu_put_in_loop():
+    assert rules_hit("""
+        import ray_tpu
+
+        def fill(items):
+            for it in items:
+                ray_tpu.put(it)
+    """, select=["GL015"]) == {"GL015"}
+
+
+def test_gl015_two_hop_names_the_chain():
+    findings = run(GL015_POS_TWO_HOP, select=["GL015"])
+    assert [f.rule for f in findings] == ["GL015"]
+    assert "run -> push" in findings[0].message
+
+
+def test_gl015_quiet_when_ref_is_kept_or_not_a_pin():
+    # refs kept: the holder can release them
+    assert rules_hit("""
+        def broadcast(workers, blob):
+            refs = []
+            for w in workers:
+                refs.append(w.ping.remote(blob))
+            return refs
+    """, select=["GL015"]) == set()
+    # a bare q.put() is a queue, not ray_tpu.put: no pin is created
+    assert rules_hit("""
+        def drain(q, items):
+            for it in items:
+                q.put(it)
+    """, select=["GL015"]) == set()
+    # a drop outside any loop, never called from one: bounded, quiet
+    assert rules_hit("""
+        def nudge(w):
+            w.stop.remote()
+    """, select=["GL015"]) == set()
+
+
+def test_gl015_per_line_disable():
+    src = GL015_POS_DIRECT.replace(
+        "w.ping.remote(blob)",
+        "w.ping.remote(blob)  # graftlint: disable=GL015")
+    assert rules_hit(src, select=["GL015"]) == set()
+
+
+# -- GL016 untied pinned view ------------------------------------------
+
+GL016_POS = """
+    import pickle
+
+    def unpack(payload, buffers, on_release):
+        value = pickle.loads(payload, buffers=buffers)
+        on_release()
+        return value
+"""
+
+GL016_NEG_FINALIZE = """
+    import pickle
+    import weakref
+
+    def unpack(payload, buffers, on_release):
+        value = pickle.loads(payload, buffers=buffers)
+        holder = buffers[0]
+        weakref.finalize(holder, on_release)
+        return value
+"""
+
+
+def test_gl016_fires_on_inline_release():
+    findings = run(GL016_POS, select=["GL016"])
+    assert [f.rule for f in findings] == ["GL016"]
+    assert "on_release" in findings[0].message
+
+
+def test_gl016_quiet_when_release_tied_to_value():
+    assert rules_hit(GL016_NEG_FINALIZE, select=["GL016"]) == set()
+    # a holder class carrying the release in __del__ also counts
+    assert rules_hit("""
+        import pickle
+
+        def unpack(payload, buffers, on_release):
+            class _Holder:
+                def __del__(self):
+                    on_release()
+            value = pickle.loads(payload, buffers=buffers)
+            return value, _Holder()
+    """, select=["GL016"]) == set()
+
+
+def test_gl016_sees_tie_two_hops_away():
+    # the finalize lives in a helper the unpacker calls via a wrapper
+    assert rules_hit("""
+        import pickle
+        import weakref
+
+        def _tie(holder, on_release):
+            weakref.finalize(holder, on_release)
+
+        def _wire(buffers, on_release):
+            _tie(buffers[0], on_release)
+
+        def unpack(payload, buffers, on_release):
+            value = pickle.loads(payload, buffers=buffers)
+            _wire(buffers, on_release)
+            on_release()
+            return value
+    """, select=["GL016"]) == set()
+
+
+def test_gl016_quiet_without_oob_buffers():
+    # in-band loads with an unrelated on_release call: not a view
+    assert rules_hit("""
+        import pickle
+
+        def unpack(payload, on_release):
+            value = pickle.loads(payload)
+            on_release()
+            return value
+    """, select=["GL016"]) == set()
+
+
+# -- GL017 count-state mutation outside the lock -----------------------
+
+GL017_POS_UNLOCKED = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._counts = {}
+
+        def add(self, oid):
+            self._counts[oid] = self._counts.get(oid, 0) + 1
+"""
+
+GL017_NEG_LOCKED = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._counts = {}
+
+        def add(self, oid):
+            with self._lock:
+                self._counts[oid] = self._counts.get(oid, 0) + 1
+"""
+
+
+def test_gl017_fires_on_unlocked_self_mutation():
+    findings = run(GL017_POS_UNLOCKED, select=["GL017"])
+    assert [f.rule for f in findings] == ["GL017"]
+    assert "_counts" in findings[0].message
+
+
+def test_gl017_fires_on_foreign_mutation_even_under_lock():
+    # reaching into another object's count state is never OK
+    assert rules_hit("""
+        def poke(counter, oid):
+            with counter._lock:
+                counter._pins[oid] = 0
+    """, select=["GL017"]) == {"GL017"}
+    assert rules_hit("""
+        def wipe(counter):
+            counter._counts.clear()
+    """, select=["GL017"]) == {"GL017"}
+
+
+def test_gl017_quiet_when_locked_or_initializing():
+    assert rules_hit(GL017_NEG_LOCKED, select=["GL017"]) == set()
+    # __init__ container creation is the allowed rebind
+    assert rules_hit("""
+        class Counter:
+            def __init__(self):
+                self._pins = {}
+    """, select=["GL017"]) == set()
+    # reads are free
+    assert rules_hit("""
+        class Counter:
+            def peek(self, oid):
+                return self._counts.get(oid, 0)
+    """, select=["GL017"]) == set()
+
+
+def test_gl017_per_line_disable():
+    src = GL017_POS_UNLOCKED.replace(
+        "self._counts[oid] = self._counts.get(oid, 0) + 1",
+        "self._counts[oid] = 1  # graftlint: disable=GL017")
+    assert rules_hit(src, select=["GL017"]) == set()

@@ -21,6 +21,16 @@ import time
 import pytest
 
 import ray_tpu
+from ray_tpu.devtools import refsan as _refsan
+
+# A runtime sanitizer adds per-task-bookkeeping cost on only ONE side
+# of the calibration ratio (the pure-Python calibration loop pays
+# nothing), so the floors below would measure the sanitizer, not a
+# regression — same reason perf guards skip under ASan.
+pytestmark = pytest.mark.skipif(
+    _refsan.enabled(),
+    reason="calibrated throughput floors are not meaningful under "
+           "RAY_TPU_REFSAN (ledger cost skews the calibration ratio)")
 
 # Quiet-box measurements (2026-07-30): submit/calib 0.0047,
 # end-to-end/calib 0.0018 with calibration ~5-6M ops/s. Guards at
