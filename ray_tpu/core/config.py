@@ -149,6 +149,13 @@ class Config:
     # --- logging / events ---
     task_events_enabled: bool = True
     task_events_buffer_size: int = 100_000
+    # Cluster lifecycle event plane (core/events.py): node/worker/actor
+    # transitions, lease grants, reconstruction spans — always-on and
+    # cheap (one tuple append under the GCS lock per event). The buffer
+    # bounds GCS memory; recovery_report() and the /api/events surfaces
+    # read from it.
+    cluster_events_enabled: bool = True
+    cluster_events_buffer_size: int = 100_000
     log_to_driver: bool = True
     # Distinct traces retained in the GCS trace store — LRU-evicted by
     # last-span arrival time so a loadgen run can't grow the store

@@ -1,0 +1,183 @@
+"""Cluster lifecycle event plane.
+
+Capability parity with the reference's GCS-side event stores feeding
+the state API and dashboard (reference: gcs_task_manager / the
+node/actor/job event tables behind ``ray list cluster-events``): every
+lifecycle transition — node register / heartbeat-miss / declared-dead,
+worker spawn/exit, actor create/restart/dead, lease grant/retry/spill,
+lineage-reconstruction start/done, serve replica start/stop, train
+elastic resize — appends one bounded record to a GCS-side deque
+(``Gcs.cluster_events``, same shape as the task-event buffer).
+
+Death events mint a sequence id that the reschedule / reconstruction
+events they trigger carry in ``caused_by``, so the recovery timeline of
+an incident is a queryable causal chain rooted at the death event
+(``devtools/recovery.py`` folds it into per-incident MTTR reports).
+
+Emission is always-on and cheap: one tuple build plus a deque append
+under the GCS lock. The hot-path record is a plain tuple::
+
+    (seq, ts, severity, kind, node_id, worker_id, actor_id, task_id,
+     message, caused_by, data)
+
+with ids stored as hex strings (JSON-ready; ``list_cluster_events``
+materializes :class:`ClusterEvent` views lazily). Config knobs:
+``cluster_events_enabled`` / ``cluster_events_buffer_size``.
+
+MTTR metrics (GL006-clean; ``*_local`` variants are used on IO-loop
+paths): ``ray_tpu_core_recovery_seconds{phase}``,
+``ray_tpu_core_node_deaths_total``,
+``ray_tpu_core_reconstructions_total``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
+
+#: kind -> one-line description (the README kinds table is generated
+#: from the same vocabulary; recovery.py keys its fold off these).
+KINDS: Dict[str, str] = {
+    "NODE_ADDED": "node registered with the control plane",
+    "NODE_HEARTBEAT_MISS": "remote node overdue on heartbeats "
+                           "(not yet declared dead)",
+    "NODE_DEAD": "node declared dead (heartbeat timeout, connection "
+                 "loss, or removal)",
+    "WORKER_STARTED": "worker process spawned into a node's pool",
+    "WORKER_EXIT": "worker process exited unexpectedly",
+    "ACTOR_CREATED": "actor registered (creation task pending)",
+    "ACTOR_ALIVE": "actor constructor finished; actor serving",
+    "ACTOR_RESTARTING": "actor lost its worker; restart in flight",
+    "ACTOR_DEAD": "actor permanently dead",
+    "ACTOR_ORPHANED": "actor record restored without a live worker "
+                      "(head restart)",
+    "LEASE_GRANTED": "task leased onto a node for execution",
+    "TASK_RETRY": "task resubmitted after a worker/node death",
+    "OBJECT_SPILLED": "objects spilled to disk under arena pressure",
+    "RECONSTRUCT_START": "lineage reconstruction of a lost object began",
+    "RECONSTRUCT_DONE": "lineage reconstruction finished",
+    "REPLICA_STARTED": "serve replica passed its construction health "
+                       "check",
+    "REPLICA_STOPPED": "serve replica stopped (downscale or health "
+                       "failure)",
+    "TRAIN_RESIZED": "elastic trainer chose a new world size after a "
+                     "failure",
+}
+
+#: kinds that root a recovery incident (everything chained from one of
+#: these via caused_by belongs to its timeline)
+DEATH_KINDS = ("NODE_DEAD", "WORKER_EXIT", "ACTOR_DEAD")
+
+
+@dataclass
+class ClusterEvent:
+    """Materialized view of one stored event tuple."""
+
+    seq: int
+    timestamp: float
+    severity: str
+    kind: str
+    node_id: Optional[str] = None
+    worker_id: Optional[str] = None
+    actor_id: Optional[str] = None
+    task_id: Optional[str] = None
+    message: str = ""
+    caused_by: Optional[int] = None
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "seq": self.seq, "timestamp": self.timestamp,
+            "severity": self.severity, "kind": self.kind,
+            "node_id": self.node_id, "worker_id": self.worker_id,
+            "actor_id": self.actor_id, "task_id": self.task_id,
+            "message": self.message, "caused_by": self.caused_by,
+            "data": self.data,
+        }
+
+    @classmethod
+    def from_tuple(cls, row: tuple) -> "ClusterEvent":
+        (seq, ts, severity, kind, node_id, worker_id, actor_id,
+         task_id, message, caused_by, data) = row
+        return cls(seq=seq, timestamp=ts, severity=severity, kind=kind,
+                   node_id=node_id, worker_id=worker_id,
+                   actor_id=actor_id, task_id=task_id, message=message,
+                   caused_by=caused_by, data=dict(data or {}))
+
+
+def ent_hex(entity) -> Optional[str]:
+    """Normalize an entity id (NodeID/WorkerID/... or str) to hex."""
+    if entity is None or isinstance(entity, str):
+        return entity
+    to_hex = getattr(entity, "hex", None)
+    if to_hex is not None:
+        return to_hex() if callable(to_hex) else to_hex
+    return str(entity)
+
+
+def emit(kind: str, severity: str = "INFO", *, node_id=None,
+         worker_id=None, actor_id=None, task_id=None, message: str = "",
+         caused_by: Optional[int] = None,
+         data: Optional[dict] = None) -> Optional[int]:
+    """Emit one lifecycle event from anywhere: a driver appends
+    directly to the GCS store; a worker routes over the control channel
+    (``gcs_call("add_cluster_event")``). No-op (returns None) without a
+    runtime or with ``cluster_events_enabled`` off. Driver-side core
+    code on the IO loop should call ``rt.gcs.add_cluster_event``
+    directly instead — same cost, no runtime lookup."""
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime_or_none()
+    if rt is None:
+        return None
+    if getattr(rt, "is_driver", False):
+        return rt.gcs.add_cluster_event(
+            kind, severity, node_id=node_id, worker_id=worker_id,
+            actor_id=actor_id, task_id=task_id, message=message,
+            caused_by=caused_by, data=data)
+    try:
+        return rt.gcs_call(
+            "add_cluster_event", kind, severity, ent_hex(node_id),
+            ent_hex(worker_id), ent_hex(actor_id), ent_hex(task_id),
+            message, caused_by, data)
+    except Exception:  # noqa: BLE001 — observability never propagates
+        return None
+
+
+# --- MTTR metrics (built once, on first access) -----------------------
+# gcs.py imports this module, so eager construction would recurse into
+# ray_tpu.util (whose package __init__ imports gcs back). PEP 562
+# module __getattr__ defers the Histogram/Counter builds to the first
+# emit site touching them — after the package graph settles.
+_metrics_lock = __import__("threading").Lock()
+_METRIC_NAMES = ("RECOVERY_SECONDS", "NODE_DEATHS", "RECONSTRUCTIONS")
+
+
+def _init_metrics():
+    from ray_tpu.util.metrics import Counter, Histogram
+    with _metrics_lock:
+        g = globals()
+        if "NODE_DEATHS" in g:
+            return
+        g["RECOVERY_SECONDS"] = Histogram(
+            "ray_tpu_core_recovery_seconds",
+            "Recovery phase durations (detect: last heartbeat -> "
+            "declared dead; reschedule: death -> caused lease grant; "
+            "reconstruct: lineage re-execution span)",
+            boundaries=[0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0],
+            tag_keys=("phase",))
+        g["NODE_DEATHS"] = Counter(
+            "ray_tpu_core_node_deaths_total",
+            "Nodes declared dead (heartbeat timeout, connection loss, "
+            "or removal)")
+        g["RECONSTRUCTIONS"] = Counter(
+            "ray_tpu_core_reconstructions_total",
+            "Lineage reconstructions completed")
+
+
+def __getattr__(name: str):
+    if name in _METRIC_NAMES:
+        _init_metrics()
+        return globals()[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

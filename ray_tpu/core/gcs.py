@@ -25,7 +25,9 @@ from collections import OrderedDict, defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu.core import events as events_mod
 from ray_tpu.core.config import get_config
+from ray_tpu.core.events import ClusterEvent, ent_hex
 from ray_tpu.core.ids import ActorID, JobID, NodeID, PlacementGroupID, WorkerID
 from ray_tpu.core.task_spec import TaskEvent, TaskSpec
 from ray_tpu.exceptions import PlacementGroupUnschedulableError
@@ -55,6 +57,9 @@ class ActorRecord:
     max_restarts: int = 0
     num_restarts: int = 0
     death_cause: Optional[str] = None
+    #: seq of the ACTOR_DEAD cluster event, so late submissions to the
+    #: dead actor can attach its recovery-incident timeline
+    death_event_seq: Optional[int] = None
 
 
 @dataclass
@@ -232,6 +237,14 @@ class Gcs:
         self.functions: Dict[str, bytes] = {}  # function/class store
         cfg = get_config()
         self.task_events: deque = deque(maxlen=cfg.task_events_buffer_size)
+        # Cluster lifecycle events (core/events.py): bounded like the
+        # task-event buffer, appended from every lifecycle transition.
+        # Tuple layout (seq, ts, severity, kind, node_id, worker_id,
+        # actor_id, task_id, message, caused_by, data); materialized
+        # lazily in list_cluster_events.
+        self.cluster_events: deque = deque(
+            maxlen=cfg.cluster_events_buffer_size)
+        self._cluster_event_seq = 0
         # Distributed-trace spans (proxy/router/replica/engine hops and
         # user tracing.span() blocks) — tuple layout (trace_id, span_id,
         # parent_span_id, name, component, t_start, duration, tags).
@@ -271,6 +284,9 @@ class Gcs:
             if record.name:
                 self.named_actors[(record.namespace, record.name)] = (
                     record.actor_id)
+            self.add_cluster_event(
+                "ACTOR_ORPHANED", "WARNING", actor_id=record.actor_id,
+                message="restored from journal; awaiting node re-report")
 
     # --- nodes ---------------------------------------------------------
     def register_node(self, record: NodeRecord,
@@ -281,24 +297,51 @@ class Gcs:
         publish after release."""
         with self.lock:
             self.nodes[record.node_id] = record
+        self.add_cluster_event(
+            "NODE_ADDED", node_id=record.node_id,
+            message=record.address or "in-process node",
+            data={"resources": dict(record.resources_total)})
         if publish:
             self.pubsub.publish("node", ("ALIVE", record.node_id))
 
     def mark_node_dead(self, node_id: NodeID,
-                       expected_manager=None) -> None:
+                       expected_manager=None) -> Optional[int]:
         """``expected_manager`` pins the call to one node incarnation:
         if a re-registration has already replaced the record (same id,
         new node_manager), the death is stale — skip both the flip and
         the DEAD publish so subscribers never see DEAD after the new
-        incarnation's ALIVE."""
+        incarnation's ALIVE.
+
+        Returns the NODE_DEAD cluster-event seq (the incident root the
+        reschedule/reconstruction events it triggers chain from via
+        ``caused_by``), or None for a stale/disabled call. May run on
+        the IO-loop thread (EOF death path) — metrics use the
+        ``*_local`` variants."""
+        detect_s = None
         with self.lock:
             rec = self.nodes.get(node_id)
             if (expected_manager is not None and rec is not None
                     and rec.node_manager is not expected_manager):
-                return
+                return None
             if rec:
                 rec.alive = False
+            # detect latency: last heartbeat seen -> declared dead (only
+            # meaningful for heartbeat-monitored remote nodes)
+            last_hb = getattr(expected_manager, "last_heartbeat", None)
+            if last_hb is not None:
+                detect_s = max(0.0, time.time() - last_hb)
+        data = {} if detect_s is None else {"detect_s": round(detect_s, 6)}
+        seq = self.add_cluster_event(
+            "NODE_DEAD", "ERROR", node_id=node_id,
+            message="node declared dead",
+            caused_by=getattr(expected_manager, "_hb_miss_seq", None),
+            data=data)
+        events_mod.NODE_DEATHS.inc_local()
+        if detect_s is not None:
+            events_mod.RECOVERY_SECONDS.observe_local(
+                detect_s, tags={"phase": "detect"})
         self.pubsub.publish("node", ("DEAD", node_id))
+        return seq
 
     def alive_nodes(self) -> List[NodeRecord]:
         with self.lock:
@@ -323,6 +366,7 @@ class Gcs:
 
     # --- actors --------------------------------------------------------
     def register_actor(self, record: ActorRecord) -> None:
+        superseded = None
         with self.lock:
             if record.name:
                 key = (record.namespace, record.name)
@@ -337,6 +381,7 @@ class Gcs:
                         existing.state = "DEAD"
                         existing.death_cause = "superseded by re-creation"
                         self._persist_actor(existing)
+                        superseded = existing.actor_id
                     elif existing and existing.state != "DEAD":
                         raise ValueError(
                             f"actor name {record.name!r} already taken in "
@@ -345,6 +390,13 @@ class Gcs:
                 self.named_actors[key] = record.actor_id
             self.actors[record.actor_id] = record
             self._persist_actor(record)
+        if superseded is not None:
+            self.add_cluster_event(
+                "ACTOR_DEAD", "WARNING", actor_id=superseded,
+                message="orphan superseded by re-creation")
+        self.add_cluster_event(
+            "ACTOR_CREATED", actor_id=record.actor_id,
+            message=record.name or "")
 
     def _persist_actor(self, record: ActorRecord) -> None:
         """Journal NAMED actors so a restarted head can re-attach them
@@ -369,11 +421,18 @@ class Gcs:
 
     def update_actor_state(self, actor_id: ActorID, state: str,
                            node_id: Optional[NodeID] = None,
-                           death_cause: Optional[str] = None) -> None:
+                           death_cause: Optional[str] = None,
+                           cause_seq: Optional[int] = None) -> Optional[int]:
+        """Transition an actor's lifecycle state. THE event-emitting
+        helper for actor ``state`` mutations (graftlint GL018): every
+        transition appends an ``ACTOR_<state>`` cluster event, with
+        ``cause_seq`` chaining restarts/deaths to the node/worker death
+        that triggered them. Returns the event seq (None when the actor
+        is unknown or events are disabled) so callers can thread it."""
         with self.lock:
             rec = self.actors.get(actor_id)
             if rec is None:
-                return
+                return None
             rec.state = state
             if node_id is not None:
                 rec.node_id = node_id
@@ -390,7 +449,19 @@ class Gcs:
                     self.kv.delete(rec.name.encode(),
                                    namespace="actor_handles")
             self._persist_actor(rec)
+        severity = ("ERROR" if state == "DEAD"
+                    else "WARNING" if state == "RESTARTING" else "INFO")
+        seq = self.add_cluster_event(
+            "ACTOR_" + state, severity, actor_id=actor_id,
+            node_id=node_id, message=death_cause or "",
+            caused_by=cause_seq)
+        if state == "DEAD" and seq is not None:
+            with self.lock:
+                rec = self.actors.get(actor_id)
+                if rec is not None:
+                    rec.death_event_seq = seq
         self.pubsub.publish("actor", (state, actor_id))
+        return seq
 
     def get_actor(self, actor_id: ActorID) -> Optional[ActorRecord]:
         with self.lock:
@@ -451,6 +522,69 @@ class Gcs:
                 ev.timestamp = ts
             out.append(ev)
         return out
+
+    # --- cluster lifecycle events (core/events.py) ----------------------
+    def add_cluster_event(self, kind: str, severity: str = "INFO", *,
+                          node_id=None, worker_id=None, actor_id=None,
+                          task_id=None, message: str = "",
+                          caused_by: Optional[int] = None,
+                          data: Optional[dict] = None) -> Optional[int]:
+        """Append one lifecycle event and return its seq (None when the
+        plane is disabled). Hot-path layout mirrors add_task_event: one
+        tuple build + deque append under the lock; ids normalized to
+        hex strings at emit so readers are allocation-free."""
+        if not get_config().cluster_events_enabled:
+            return None
+        row_tail = (severity, kind, ent_hex(node_id), ent_hex(worker_id),
+                    ent_hex(actor_id), ent_hex(task_id), message,
+                    caused_by, data or {})
+        with self.lock:
+            self._cluster_event_seq += 1
+            seq = self._cluster_event_seq
+            self.cluster_events.append((seq, time.time()) + row_tail)
+        return seq
+
+    def list_cluster_events(self, limit: int = 1000, kinds=None,
+                            severity: Optional[str] = None,
+                            node_id=None, worker_id=None, actor_id=None,
+                            task_id=None,
+                            since_seq: Optional[int] = None,
+                            ) -> List[ClusterEvent]:
+        """Chronological tail of the event store, materialized lazily.
+        ``kinds`` is an iterable of kind names; ``severity`` a MINIMUM
+        level (e.g. "WARNING" keeps WARNING+ERROR); entity filters
+        match on hex strings; ``since_seq`` keeps events newer than a
+        previously-seen seq (the CLI --follow cursor)."""
+        unfiltered = (kinds is None and severity is None and
+                      node_id is None and worker_id is None and
+                      actor_id is None and task_id is None and
+                      since_seq is None)
+        with self.lock:
+            if unfiltered:
+                # The periodic snapshot dump lands here every ~2s: keep
+                # only the tail instead of listing the full (up to
+                # cluster_events_buffer_size) deque under the lock every
+                # emitter contends on.
+                raw = list(deque(self.cluster_events, maxlen=limit))
+            else:
+                raw = list(self.cluster_events)
+        if unfiltered:
+            return [ClusterEvent.from_tuple(row) for row in raw]
+        if since_seq is not None:
+            raw = [row for row in raw if row[0] > since_seq]
+        if kinds is not None:
+            wanted = set(kinds)
+            raw = [row for row in raw if row[3] in wanted]
+        if severity is not None:
+            floor = events_mod.SEVERITIES.index(severity)
+            raw = [row for row in raw
+                   if events_mod.SEVERITIES.index(row[2]) >= floor]
+        for idx, ent in ((4, node_id), (5, worker_id), (6, actor_id),
+                         (7, task_id)):
+            if ent is not None:
+                want = ent_hex(ent)
+                raw = [row for row in raw if row[idx] == want]
+        return [ClusterEvent.from_tuple(row) for row in raw[-limit:]]
 
     # --- distributed-trace spans ---------------------------------------
     def add_trace_span(self, span) -> None:

@@ -272,7 +272,23 @@ class Node:
             self._workers[worker_id] = handle
             self._n_starting[profile] = self._n_starting.get(profile, 0) + 1
             self._n_live[profile] = self._n_live.get(profile, 0) + 1
+        self._emit_worker_event("WORKER_STARTED", "DEBUG", worker_id,
+                                profile)
         return handle
+
+    def _emit_worker_event(self, kind: str, severity: str, worker_id,
+                           message: str):
+        """Worker lifecycle event, driver-side only: on a remote node
+        daemon ``self.runtime`` is the HeadProxy (no GCS) — worker
+        crashes are forwarded as WORKER_CRASHED_FWD and narrated by the
+        head's on_worker_crashed fallback instead."""
+        gcs = getattr(self.runtime, "gcs", None)
+        if gcs is None:
+            return None
+        return gcs.add_cluster_event(kind, severity,
+                                     node_id=self.node_id,
+                                     worker_id=worker_id,
+                                     message=message)
 
     def prestart_workers(self, count: int, profile: str = "cpu") -> None:
         """Warm the pool (reference: worker_pool.h prestart)."""
@@ -729,6 +745,14 @@ class Node:
             self.runtime.reference_counter.remove_local_reference(oid)
         if self._stopped.is_set():
             return
+        # Root event for this worker's incident; the seq rides the
+        # handle so on_worker_crashed chains retries/actor deaths to
+        # it. Idle reclaims (nothing running, no actor) are DEBUG —
+        # they root no recovery work.
+        severity = "ERROR" if (running or was_actor) else "DEBUG"
+        worker._exit_event_seq = self._emit_worker_event(
+            "WORKER_EXIT", severity, worker.worker_id,
+            f"{len(running)} tasks in flight" if running else "")
         for profile in starved:
             self._spawn_worker(profile)
         self.runtime.on_worker_crashed(self, worker, running,

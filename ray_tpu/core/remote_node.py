@@ -77,6 +77,9 @@ class RemoteNode:
         self.store = RemoteStoreProxy(self)
         self.session_dir = None
         self.last_heartbeat = time.time()
+        # open NODE_HEARTBEAT_MISS event seq (None = no miss episode);
+        # a NODE_DEAD for this node chains to it as its cause
+        self._hb_miss_seq = None
         self.idle_workers = 0
         self.store_used = 0
         self._alive = True
@@ -472,17 +475,30 @@ class HeadServer:
         while not self._stopped.wait(cfg.heartbeat_interval_s):
             now = time.time()
             for node in list(self.runtime.nodes.values()):
-                if (isinstance(node, RemoteNode) and node.alive
-                        and now - node.last_heartbeat
-                        > cfg.heartbeat_timeout_s):
+                if not (isinstance(node, RemoteNode) and node.alive):
+                    continue
+                overdue = now - node.last_heartbeat
+                if overdue > cfg.heartbeat_timeout_s:
                     self.runtime.on_remote_node_death(node.node_id,
                                                       expected=node)
+                elif overdue > 2 * cfg.heartbeat_interval_s:
+                    # Once per miss episode: the seq rides the node so a
+                    # later NODE_DEAD chains to it (gcs.mark_node_dead
+                    # reads _hb_miss_seq); a fresh HEARTBEAT clears it.
+                    if getattr(node, "_hb_miss_seq", None) is None:
+                        node._hb_miss_seq = (
+                            self.runtime.gcs.add_cluster_event(
+                                "NODE_HEARTBEAT_MISS", "WARNING",
+                                node_id=node.node_id,
+                                message=f"last heartbeat "
+                                        f"{overdue:.2f}s ago"))
 
     def _handle(self, node: RemoteNode, msg: dict) -> None:
         rt = self.runtime
         kind = msg["kind"]
         if kind == "HEARTBEAT":
             node.last_heartbeat = time.time()
+            node._hb_miss_seq = None  # miss episode over
             node.idle_workers = msg.get("idle", 0)
             node.store_used = msg.get("store_used", 0)
         elif kind == "TASK_DONE_FWD":

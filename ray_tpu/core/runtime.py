@@ -18,6 +18,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ray_tpu.core import events as events_mod
 from ray_tpu.core import serialization
 from ray_tpu.core.config import get_config, reset_config
 from ray_tpu.core.gcs import ActorRecord, Gcs, JobRecord, NodeRecord
@@ -153,6 +154,17 @@ class DriverRuntime:
         self._lineage_by_object: Dict[ObjectID, TaskID] = {}
         self._lineage_lock = threading.Lock()
         self._reconstructing: set = set()
+        # Recovery attribution (core/events.py): death-triggered work
+        # carries the death event's seq so incident timelines chain.
+        # _cause_by_task: resubmitted task -> (retry_event_seq,
+        # death_ts); its next lease grant closes the reschedule phase.
+        # _last_death_seq seeds reconstruction chains (lineage recovery
+        # has no per-object death attribution); _reconstruct_events
+        # tracks open RECONSTRUCT_START spans per requested object.
+        self._event_chain_lock = threading.Lock()
+        self._cause_by_task: Dict[TaskID, tuple] = {}
+        self._last_death_seq: Optional[int] = None
+        self._reconstruct_events: Dict[ObjectID, tuple] = {}
         # single expiry thread for deferred ref drops (no Timer churn)
         self._expiry_items: List[tuple] = []
         self._expiry_cv = threading.Condition()
@@ -422,17 +434,22 @@ class DriverRuntime:
         def tail():
             # expected_manager keeps a late tail (death thread paused
             # past the lock) from marking a re-registered record dead.
-            self.gcs.mark_node_dead(node_id, expected_manager=node)
+            death_seq = self.gcs.mark_node_dead(node_id,
+                                                expected_manager=node)
+            if death_seq is not None:
+                self._last_death_seq = death_seq
             node.close()
             for oid, new_primary in promote:
                 self.task_manager.set_location(
                     oid, ObjectLocation("shm", new_primary))
             # In-flight tasks the daemon can no longer report on.
-            self.reap_node_specs(node, node.take_inflight(), actor_ids)
+            self.reap_node_specs(node, node.take_inflight(), actor_ids,
+                                 death_seq=death_seq)
 
         return tail
 
-    def reap_node_specs(self, node, specs, actor_ids=None) -> None:
+    def reap_node_specs(self, node, specs, actor_ids=None,
+                        death_seq=None) -> None:
         """Retry-or-fail specs stranded on a dead RemoteNode object.
 
         Called from the death harvest above, and from RemoteNode.dispatch
@@ -452,6 +469,7 @@ class DriverRuntime:
             retry = (None if spec.num_returns == -1
                      else self.task_manager.consume_retry(spec.task_id))
             if retry is not None:
+                self._emit_task_retry(retry, death_seq)
                 self._resubmit(retry)
                 continue
             err: Exception = WorkerCrashedError(
@@ -463,8 +481,44 @@ class DriverRuntime:
                                error=str(err))
             self._fail_task(spec, err)
         for aid in actor_ids:
-            self._handle_actor_death(aid, node)
+            self._handle_actor_death(aid, node, cause_seq=death_seq)
         self._signal_scheduler()
+
+    def _emit_task_retry(self, spec: TaskSpec,
+                         cause_seq: Optional[int]) -> None:
+        """Chain a death-triggered resubmit into its incident: the
+        TASK_RETRY event hangs off the death event, and the next lease
+        grant for this task id closes the reschedule phase (see
+        _emit_lease_grant). Runs on node reader / monitor threads."""
+        seq = self.gcs.add_cluster_event(
+            "TASK_RETRY", "WARNING", task_id=spec.task_id,
+            message=spec.name or str(spec.function_id),
+            caused_by=cause_seq)
+        if seq is not None:
+            with self._event_chain_lock:
+                self._cause_by_task[spec.task_id] = (seq, time.time())
+
+    def _emit_lease_grant(self, spec: TaskSpec, node_id: NodeID) -> None:
+        """Cluster-event mirror of the SCHEDULED task event. Routine
+        grants are DEBUG-severity noise; a grant rescheduling a
+        death-triggered retry chains to its TASK_RETRY event and
+        observes the incident's reschedule latency (*_local: reachable
+        from node reader threads / the IO loop via reap paths)."""
+        with self._event_chain_lock:
+            cause = self._cause_by_task.pop(spec.task_id, None)
+        if cause is None:
+            self.gcs.add_cluster_event(
+                "LEASE_GRANTED", "DEBUG", node_id=node_id,
+                task_id=spec.task_id, message=spec.name or "")
+            return
+        retry_seq, death_ts = cause
+        reschedule_s = max(0.0, time.time() - death_ts)
+        self.gcs.add_cluster_event(
+            "LEASE_GRANTED", node_id=node_id, task_id=spec.task_id,
+            message=spec.name or "", caused_by=retry_seq,
+            data={"reschedule_s": round(reschedule_s, 6)})
+        events_mod.RECOVERY_SECONDS.observe_local(
+            reschedule_s, tags={"phase": "reschedule"})
 
     def add_object_replica(self, oid: ObjectID, node_id: NodeID) -> None:
         with self._replica_lock:
@@ -510,7 +564,9 @@ class DriverRuntime:
         if node is None:
             return
         self.scheduler.remove_node(node_id)
-        self.gcs.mark_node_dead(node_id)
+        death_seq = self.gcs.mark_node_dead(node_id)
+        if death_seq is not None:
+            self._last_death_seq = death_seq
         from ray_tpu.core.node import ACTOR as ACTOR_STATE
         with node._lock:
             casualties = [
@@ -522,6 +578,8 @@ class DriverRuntime:
         node.stop()
         for worker, running, actor_id in casualties:
             if running or actor_id is not None:
+                # chain each worker's exit event to the node death
+                worker._exit_cause_seq = death_seq
                 self.on_worker_crashed(node, worker, running, actor_id)
         # Tasks queued but never started are rescheduled without consuming
         # a retry (the lease was never granted).
@@ -734,6 +792,13 @@ class DriverRuntime:
             # Claim under the same lock as the membership check so a
             # concurrent getter can't resubmit the same producer twice.
             self._reconstructing.add(oid)
+        start_seq = self.gcs.add_cluster_event(
+            "RECONSTRUCT_START", "WARNING",
+            message=f"object {oid.hex()[:12]} lost; re-executing lineage",
+            caused_by=self._last_death_seq, data={"oid": oid.hex()})
+        if start_seq is not None:
+            with self._event_chain_lock:
+                self._reconstruct_events[oid] = (start_seq, time.time())
         # Collect the transitive set of lost producers.
         to_resubmit: List[TaskSpec] = []
         stack = [root]
@@ -772,6 +837,21 @@ class DriverRuntime:
     def _reconstruction_done(self, oid: ObjectID) -> None:
         with self._lineage_lock:
             self._reconstructing.discard(oid)
+        with self._event_chain_lock:
+            start = self._reconstruct_events.pop(oid, None)
+        if start is None:
+            return  # not a tracked span (transitive output / no events)
+        start_seq, t0 = start
+        reconstruct_s = max(0.0, time.time() - t0)
+        self.gcs.add_cluster_event(
+            "RECONSTRUCT_DONE",
+            message=f"object {oid.hex()[:12]} reconstruction finished",
+            caused_by=start_seq,
+            data={"reconstruct_s": round(reconstruct_s, 6),
+                  "oid": oid.hex()})
+        events_mod.RECONSTRUCTIONS.inc_local()
+        events_mod.RECOVERY_SECONDS.observe_local(
+            reconstruct_s, tags={"phase": "reconstruct"})
 
     # --- submission ----------------------------------------------------
     def submit_spec(self, spec: TaskSpec) -> None:
@@ -852,6 +932,7 @@ class DriverRuntime:
                 info.resources_node = node_id
         self.task_manager.mark_dispatched(spec.task_id, node_id)
         self._record_event(spec, "SCHEDULED", node_id=node_id)
+        self._emit_lease_grant(spec, node_id)
         node.dispatch(spec)
         return True
 
@@ -932,6 +1013,7 @@ class DriverRuntime:
                     continue
                 self.task_manager.mark_dispatched(spec.task_id, node_id)
                 self._record_event(spec, "SCHEDULED", node_id=node_id)
+                self._emit_lease_grant(spec, node_id)
                 node.dispatch(spec)
                 made_progress = True
                 # Burst grant (reference: owner-side lease reuse,
@@ -982,6 +1064,7 @@ class DriverRuntime:
                             follower.task_id, node_id)
                         self._record_event(follower, "SCHEDULED",
                                            node_id=node_id)
+                        self._emit_lease_grant(follower, node_id)
                         node.dispatch(follower)
                         budget -= 1
             self._backlog_view = list(backlog)
@@ -1121,10 +1204,14 @@ class DriverRuntime:
             return
         with info.lock:
             if record.state == "DEAD":
+                from ray_tpu.devtools import recovery
                 self._fail_task(
                     spec,
-                    ActorDiedError(spec.actor_id,
-                                   f"actor is dead: {record.death_cause}"))
+                    ActorDiedError(
+                        spec.actor_id,
+                        f"actor is dead: {record.death_cause}"
+                        + recovery.incident_tail_text(
+                            record.death_event_seq)))
                 return
             if not info.ready_for_dispatch or info.worker_id is None:
                 info.buffered.append(spec)
@@ -1307,6 +1394,19 @@ class DriverRuntime:
         cfg = get_config()
         self._drop_worker_subscriptions(node.node_id,
                                         worker.worker_id.binary())
+        # node.py's death observer emits WORKER_EXIT and stashes the seq
+        # on the handle; paths that bypass it (remove_node kills after
+        # stop()) emit here so the incident always has a root event.
+        exit_seq = getattr(worker, "_exit_event_seq", None)
+        if exit_seq is None:
+            exit_seq = self.gcs.add_cluster_event(
+                "WORKER_EXIT", "ERROR", node_id=node.node_id,
+                worker_id=worker.worker_id,
+                caused_by=getattr(worker, "_exit_cause_seq", None),
+                message="worker killed with its node")
+        if exit_seq is not None and (running or actor_id is not None):
+            # idle reclaims carry a seq too but seed no recovery chain
+            self._last_death_seq = exit_seq
         for spec in running:
             if (not spec.is_actor_creation and spec.actor_id is None
                     and not self._consume_overcommit(spec.task_id)):
@@ -1317,6 +1417,7 @@ class DriverRuntime:
             retry = (None if spec.num_returns == -1
                      else self.task_manager.consume_retry(spec.task_id))
             if retry is not None and not spec.is_actor_creation:
+                self._emit_task_retry(retry, exit_seq)
                 self._resubmit(retry)
             elif spec.is_actor_creation:
                 pass  # handled by actor restart below
@@ -1337,7 +1438,7 @@ class DriverRuntime:
         if actor_id is not None or any(s.is_actor_creation for s in running):
             aid = actor_id or next(
                 s.actor_id for s in running if s.is_actor_creation)
-            self._handle_actor_death(aid, node)
+            self._handle_actor_death(aid, node, cause_seq=exit_seq)
         self._signal_scheduler()
 
     def _release_actor_resources(self, info: ActorInfo,
@@ -1359,7 +1460,8 @@ class DriverRuntime:
         self.scheduler.release(node_id,
                                self._spec_resources(info.creation_spec))
 
-    def _handle_actor_death(self, actor_id: ActorID, node: Node) -> None:
+    def _handle_actor_death(self, actor_id: ActorID, node: Node,
+                            cause_seq: Optional[int] = None) -> None:
         record = self.gcs.get_actor(actor_id)
         info = self.actors.get(actor_id)
         if record is None or info is None:
@@ -1404,12 +1506,14 @@ class DriverRuntime:
                 parent_span_id=info.creation_spec.parent_span_id,
             )
             info.creation_spec = new_spec
-            self.gcs.update_actor_state(actor_id, "RESTARTING")
+            self.gcs.update_actor_state(actor_id, "RESTARTING",
+                                        cause_seq=cause_seq)
             self.task_manager.add_pending(new_spec)
             self._enqueue(new_spec)
         else:
-            self.gcs.update_actor_state(actor_id, "DEAD",
-                                        death_cause="worker died")
+            death_seq = self.gcs.update_actor_state(
+                actor_id, "DEAD", death_cause="worker died",
+                cause_seq=cause_seq)
             msg = "actor worker died"
             if dead_worker is not None:
                 # post-mortem: the collector still holds the dead
@@ -1418,6 +1522,10 @@ class DriverRuntime:
                 from ray_tpu.util import flight_recorder
                 msg += flight_recorder.store_tail_text(
                     f"worker:{dead_worker.hex()[:12]}")
+            # ... and the incident timeline the death belongs to, in
+            # the same attach-the-tail mold
+            from ray_tpu.devtools import recovery
+            msg += recovery.incident_tail_text(death_seq)
             self._fail_actor_buffer(
                 actor_id, ActorDiedError(actor_id, msg))
 
@@ -1776,15 +1884,30 @@ class DriverRuntime:
         for oid, path, _size in results:
             self.task_manager.set_location(
                 oid, ObjectLocation("spilled", node.node_id, path))
-        return sum(size for _, _, size in results)
+        freed = sum(size for _, _, size in results)
+        if results:
+            self.gcs.add_cluster_event(
+                "OBJECT_SPILLED", "WARNING", node_id=node.node_id,
+                message=f"{len(results)} objects spilled under arena "
+                        "pressure",
+                data={"bytes": freed, "count": len(results)})
+        return freed
 
     def on_objects_spilled(self, node, msg: dict) -> None:
         """A daemon spilled objects on our request: record locations and
         unblock the waiting worker."""
-        for oid_bytes, path, _size in msg.get("results", ()):
+        results = msg.get("results", ())
+        for oid_bytes, path, _size in results:
             self.task_manager.set_location(
                 ObjectID(oid_bytes),
                 ObjectLocation("spilled", node.node_id, path))
+        if results:
+            self.gcs.add_cluster_event(
+                "OBJECT_SPILLED", "WARNING", node_id=node.node_id,
+                message=f"{len(results)} objects spilled under arena "
+                        "pressure",
+                data={"bytes": sum(r[2] for r in results),
+                      "count": len(results)})
         reply_worker = msg.get("reply_worker")
         if reply_worker is not None:
             from ray_tpu.core.remote_node import RemoteWorkerStub
@@ -2161,6 +2284,17 @@ class DriverRuntime:
             # same brevity contract as flight_push
             refsan.store_push(args[0], args[1])
             return True
+        if method == "add_cluster_event":
+            # lifecycle event from a worker process (serve controller /
+            # replicas route here via events.emit); brief/lock-only
+            (kind, severity, node_id, worker_id, actor_id, task_id,
+             message, caused_by, data) = args
+            return gcs.add_cluster_event(
+                kind, severity, node_id=node_id, worker_id=worker_id,
+                actor_id=actor_id, task_id=task_id, message=message,
+                caused_by=caused_by, data=data)
+        if method == "list_cluster_events":
+            return [e.to_dict() for e in gcs.list_cluster_events(*args)]
         raise ValueError(f"unknown GCS method {method}")
 
     # --- misc api --------------------------------------------------------

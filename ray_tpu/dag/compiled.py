@@ -200,13 +200,18 @@ class CompiledDAGRef:
             self._fetched = True
         if isinstance(self._value, _ErrorToken):
             from ray_tpu.util import flight_recorder
+            from ray_tpu.devtools import recovery
             # post-mortem: the failing node attached its flight-
             # recorder tail at raise time (it rode the pickled
-            # exception's __dict__) — surface what the stage was doing
+            # exception's __dict__) — surface what the stage was doing,
+            # plus any cluster incident (node/worker death) that just
+            # happened: a DAG stage dying with its host can't name the
+            # event seq that killed it, but the timing attributes it
             raise DAGExecutionError(
                 f"node {self._value.node_name!r} failed: "
                 f"{self._value.error!r}"
                 + flight_recorder.tail_text(self._value.error)
+                + recovery.recent_incident_text()
             ) from self._value.error
         return self._value
 

@@ -14,6 +14,9 @@ job, log, metrics; state aggregation via state_aggregator.py → the
   GET /api/objects           — referenced objects
   GET /api/placement_groups  — placement groups
   GET /api/jobs              — driver + submitted jobs
+  GET /api/events            — cluster lifecycle events
+                               (?kind=A,B&severity=MIN&limit=N&
+                                node_id=&actor_id=&since_seq=)
   GET /api/logs              — log files per node log dir
   GET /api/logs/tail?file=F&lines=N[&follow=1] — tail (SSE when follow)
   GET /metrics               — Prometheus exposition text
@@ -180,6 +183,18 @@ class DashboardServer:
             return self._send_json(req, state_api.list_placement_groups())
         if path == "/api/jobs":
             return self._send_json(req, state_api.list_jobs())
+        if path == "/api/events":
+            kinds = query.get("kind")
+            return self._send_json(req, state_api.list_cluster_events(
+                limit=int(query.get("limit", 1000)),
+                kinds=kinds.split(",") if kinds else None,
+                severity=query.get("severity"),
+                node_id=query.get("node_id"),
+                worker_id=query.get("worker_id"),
+                actor_id=query.get("actor_id"),
+                task_id=query.get("task_id"),
+                since_seq=(int(query["since_seq"])
+                           if "since_seq" in query else None)))
         if path == "/api/timeline":
             from ray_tpu.util.timeline import chrome_trace_events
             return self._send_json(

@@ -266,6 +266,67 @@ def step_recorder() -> Tuple[str, str]:
         fr.RECORDER, fr._STORE = saved
 
 
+def step_events() -> Tuple[str, str]:
+    """Recovery-timeline fold smoke, fully in-process: a synthetic
+    lifecycle event stream with known phase durations (heartbeat miss →
+    node death → retry → lease grant → reconstruction) must fold into
+    ONE incident with exactly those durations and the full causal
+    chain; an idle DEBUG worker reclaim must not root an incident."""
+    from ray_tpu.devtools import recovery
+
+    t0 = 1000.0
+    ev = [
+        {"seq": 1, "timestamp": t0 - 3.0, "severity": "WARNING",
+         "kind": "NODE_HEARTBEAT_MISS", "node_id": "n1",
+         "message": "last heartbeat 2.0s ago", "caused_by": None},
+        {"seq": 2, "timestamp": t0, "severity": "ERROR",
+         "kind": "NODE_DEAD", "node_id": "n1", "caused_by": 1,
+         "data": {"detect_s": 3.0}},
+        {"seq": 3, "timestamp": t0 + 0.1, "severity": "ERROR",
+         "kind": "WORKER_EXIT", "worker_id": "w1", "caused_by": 2},
+        {"seq": 4, "timestamp": t0 + 0.2, "severity": "WARNING",
+         "kind": "TASK_RETRY", "task_id": "t1", "caused_by": 2},
+        {"seq": 5, "timestamp": t0 + 1.5, "severity": "INFO",
+         "kind": "LEASE_GRANTED", "task_id": "t1", "node_id": "n2",
+         "caused_by": 4, "data": {"reschedule_s": 1.5}},
+        {"seq": 6, "timestamp": t0 + 1.6, "severity": "WARNING",
+         "kind": "RECONSTRUCT_START", "caused_by": 2,
+         "data": {"oid": "aa" * 8}},
+        {"seq": 7, "timestamp": t0 + 4.1, "severity": "INFO",
+         "kind": "RECONSTRUCT_DONE", "caused_by": 6,
+         "data": {"oid": "aa" * 8, "reconstruct_s": 2.5}},
+        # idle reclaim: DEBUG, nothing chained — must NOT be an incident
+        {"seq": 8, "timestamp": t0 + 5.0, "severity": "DEBUG",
+         "kind": "WORKER_EXIT", "worker_id": "w9", "caused_by": None},
+    ]
+    report = recovery.recovery_report(events=ev, journals={})
+    incs = report["incidents"]
+    if len(incs) != 1:
+        return "FAIL", (f"expected 1 incident, got {len(incs)} "
+                        f"(idle reclaim must not root one)")
+    inc = incs[0]
+    want = {"root_kind": "NODE_DEAD", "detect_s": 3.0,
+            "reschedule_s": 1.5, "reconstruct_s": 2.5,
+            "mttr_s": 3.0 + 4.1}
+    for key, expect in want.items():
+        got = inc[key]
+        if isinstance(expect, float):
+            if abs(got - expect) > 1e-6:
+                return "FAIL", f"{key}: expected {expect}, got {got}"
+        elif got != expect:
+            return "FAIL", f"{key}: expected {expect}, got {got}"
+    if {e["seq"] for e in inc["chain"]} != {2, 3, 4, 5, 6, 7}:
+        return "FAIL", (f"causal chain wrong: "
+                        f"{sorted(e['seq'] for e in inc['chain'])}")
+    if (inc["precursor"] or {}).get("kind") != "NODE_HEARTBEAT_MISS":
+        return "FAIL", f"precursor not attributed: {inc['precursor']}"
+    if inc["affected"]["objects"] != ["aa" * 8]:
+        return "FAIL", f"affected objects wrong: {inc['affected']}"
+    recovery.render(report)  # must not raise
+    return "ok", ("1 incident folded: detect 3.0s, reschedule 1.5s, "
+                  "reconstruct 2.5s, MTTR 7.1s, 6-event chain")
+
+
 def step_podracer() -> Tuple[str, str]:
     """Podracer RL smoke, fully in-process (no actors, no cluster): the
     replay queue's bounded drop-oldest semantics, the int8 weight-push
@@ -335,6 +396,7 @@ def step_refsan() -> Tuple[str, str]:
 
 _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("lint", step_lint),
+    ("events", step_events),
     ("pipeline", step_pipeline),
     ("podracer", step_podracer),
     ("recorder", step_recorder),

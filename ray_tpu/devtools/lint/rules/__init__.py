@@ -1,4 +1,5 @@
 """Rule families. Importing this package registers every rule."""
 
 from ray_tpu.devtools.lint.rules import (concurrency, conventions,  # noqa: F401
-                                         hygiene, ownership, threadguard)
+                                         hygiene, lifecycle, ownership,
+                                         threadguard)

@@ -1,0 +1,109 @@
+"""Lifecycle-event rules (GL018).
+
+The static half of the cluster event plane (``core/events.py``):
+lifecycle state on GCS records (actor/node ``.state``) is what the
+event stream narrates, so a bare ``record.state = ...`` outside an
+event-emitting helper silently advances the lifecycle with no event —
+the recovery timeline (``devtools/recovery.py``) then shows a gap
+where the transition happened. Mutations must go through (or sit in a
+function that also calls) one of the emitting helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ray_tpu.devtools.lint.annotate import _dotted
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+from ray_tpu.devtools.lint.callgraph import _leaf
+
+#: GCS tables whose records carry narrated lifecycle state. Placement
+#: groups are deliberately out of scope: their state machine predates
+#: the event plane and transitions in the scheduler hot path.
+_GCS_TABLES = {"actors", "nodes"}
+
+#: a function that calls any of these is an event-emitting helper (or
+#: delegates to one) — its .state writes are narrated
+_EMITTERS = {"add_cluster_event", "emit", "update_actor_state",
+             "mark_node_dead"}
+
+
+def _table_attr(node: ast.AST) -> bool:
+    """``<anything>.actors`` / ``<anything>.nodes`` attribute access."""
+    return isinstance(node, ast.Attribute) and node.attr in _GCS_TABLES
+
+
+def _record_source(value: ast.AST) -> bool:
+    """Expression yielding a record out of a GCS table: subscript
+    (``self.actors[aid]``) or ``.get(...)`` call on a table attr."""
+    if isinstance(value, ast.Subscript) and _table_attr(value.value):
+        return True
+    if isinstance(value, ast.Call) and \
+            isinstance(value.func, ast.Attribute) and \
+            value.func.attr == "get" and _table_attr(value.func.value):
+        return True
+    return False
+
+
+@register
+class SilentLifecycleMutation(Rule):
+    id = "GL018"
+    name = "silent-lifecycle-mutation"
+    rationale = ("actor/node record .state is the lifecycle the cluster "
+                 "event plane narrates: a bare `record.state = ...` "
+                 "outside an event-emitting helper advances the "
+                 "lifecycle with no ClusterEvent, leaving a hole in "
+                 "recovery timelines — route the transition through "
+                 "gcs.update_actor_state/mark_node_dead or emit the "
+                 "event alongside the write")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for fn in (n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            emits = any(
+                isinstance(n, ast.Call) and
+                _leaf(_dotted(n.func) or "") in _EMITTERS
+                for n in ast.walk(fn))
+            if emits:
+                continue
+            # names bound from a GCS-table record in this function
+            tracked: Set[str] = set()
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Assign) and \
+                        _record_source(n.value):
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            tracked.add(t.id)
+                elif isinstance(n, (ast.For, ast.AsyncFor)) and \
+                        isinstance(n.iter, ast.Call) and \
+                        isinstance(n.iter.func, ast.Attribute) and \
+                        n.iter.func.attr in ("values", "items") and \
+                        _table_attr(n.iter.func.value):
+                    tgt = n.target
+                    if n.iter.func.attr == "items" and \
+                            isinstance(tgt, ast.Tuple) and \
+                            len(tgt.elts) == 2:
+                        tgt = tgt.elts[1]
+                    if isinstance(tgt, ast.Name):
+                        tracked.add(tgt.id)
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.Assign):
+                    continue
+                for t in n.targets:
+                    if not (isinstance(t, ast.Attribute) and
+                            t.attr == "state"):
+                        continue
+                    direct = _record_source(t.value)
+                    via_name = (isinstance(t.value, ast.Name) and
+                                t.value.id in tracked)
+                    if direct or via_name:
+                        yield ctx.finding(
+                            self.id, n,
+                            "lifecycle .state mutated on a GCS record "
+                            f"in {fn.name}() with no event emitted — "
+                            "the transition is invisible to recovery "
+                            "timelines; go through update_actor_state/"
+                            "mark_node_dead or emit a ClusterEvent "
+                            "alongside")

@@ -1,0 +1,315 @@
+"""recovery: per-incident MTTR timelines from the cluster event plane.
+
+Answers "what died, when did we notice, and how long did recovery
+take" by folding the lifecycle events (``core/events.py``) into
+incidents: each death event (``NODE_DEAD`` / ``WORKER_EXIT`` /
+``ACTOR_DEAD``) roots a causal chain — the retries, lease grants,
+actor restarts and lineage reconstructions that carry its seq in
+``caused_by`` — and the fold extracts the recovery phases:
+
+* **detect**      — last heartbeat → declared dead (stamped on the
+  NODE_DEAD event by ``gcs.mark_node_dead``),
+* **reschedule**  — death → the caused lease grant landing the retried
+  work on a surviving node,
+* **reconstruct** — lineage re-execution span of each lost object,
+* **MTTR**        — detect + (last chained event − death).
+
+PR-12 flight journals, when the recorder is on, are correlated by time
+window so the report shows what each process was doing around the
+incident. The incident tail is attached to ``ActorDiedError`` /
+``DAGExecutionError`` the same way the flight recorder attaches
+journal tails.
+
+Usage::
+
+    ray_tpu.devtools.recovery.recovery_report()   # live, dict
+    print(recovery.render(recovery.recovery_report()))
+    python -m ray_tpu.devtools.recovery [--json] [state.json]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.core.events import DEATH_KINDS
+
+#: chain lines included in exception-attached incident tails
+TAIL_EVENTS = 12
+
+
+def _as_dicts(events) -> List[Dict[str, Any]]:
+    return [ev.to_dict() if hasattr(ev, "to_dict") else dict(ev)
+            for ev in events]
+
+
+def _live_events(limit: int = 100_000) -> Optional[List[Dict[str, Any]]]:
+    from ray_tpu.core import runtime as runtime_mod
+    rt = runtime_mod.get_runtime_or_none()
+    if rt is None or not getattr(rt, "is_driver", False):
+        return None
+    return _as_dicts(rt.gcs.list_cluster_events(limit=limit))
+
+
+def _snapshot_events() -> List[Dict[str, Any]]:
+    """Events from the last session's state.json (the out-of-process
+    path the CLI uses)."""
+    import os
+    import tempfile
+    pointer = os.path.join(tempfile.gettempdir(),
+                           "ray_tpu_last_session.json")
+    with open(pointer) as f:
+        state_path = json.load(f)["state_path"]
+    with open(state_path) as f:
+        return json.load(f).get("events", [])
+
+
+def _entity(ev: Dict[str, Any]) -> str:
+    for key in ("node_id", "actor_id", "worker_id", "task_id"):
+        if ev.get(key):
+            return f"{key.split('_')[0]}={ev[key][:12]}"
+    return ""
+
+
+def recovery_report(events=None, journals=None) -> Dict[str, Any]:
+    """Fold lifecycle events (+ flight journals) into per-incident
+    recovery timelines. ``events``: ClusterEvent objects or dicts;
+    None reads the live GCS store. ``journals``: label -> aligned
+    event tuples (``flight_recorder.merged_journals()`` shape); None
+    reads the live recorder; pass ``{}`` to skip correlation."""
+    if events is None:
+        events = _live_events() or []
+    events = _as_dicts(events)
+    by_seq = {ev["seq"]: ev for ev in events}
+    children: Dict[int, List[dict]] = {}
+    counts: Dict[str, int] = {}
+    for ev in events:
+        counts[ev["kind"]] = counts.get(ev["kind"], 0) + 1
+        if ev.get("caused_by") is not None:
+            children.setdefault(ev["caused_by"], []).append(ev)
+
+    incidents: List[Dict[str, Any]] = []
+    for root in events:
+        if root["kind"] not in DEATH_KINDS:
+            continue
+        parent = by_seq.get(root.get("caused_by"))
+        if parent is not None and parent["kind"] in DEATH_KINDS:
+            continue  # chained death: belongs to the parent's incident
+        chain: List[dict] = []
+        seen: set = set()
+        stack = [root]
+        while stack:
+            cur = stack.pop()
+            if cur["seq"] in seen:
+                continue
+            seen.add(cur["seq"])
+            chain.append(cur)
+            stack.extend(children.get(cur["seq"], ()))
+        if root["severity"] == "DEBUG" and len(chain) == 1:
+            continue  # idle worker reclaim: no recovery rooted here
+        chain.sort(key=lambda e: (e["timestamp"], e["seq"]))
+        data = root.get("data") or {}
+        detect_s = float(data.get("detect_s") or 0.0)
+        reschedule_s = max(
+            (float((e.get("data") or {}).get("reschedule_s") or 0.0)
+             for e in chain if e["kind"] == "LEASE_GRANTED"),
+            default=0.0)
+        reconstruct_s = max(
+            (float((e.get("data") or {}).get("reconstruct_s") or 0.0)
+             for e in chain if e["kind"] == "RECONSTRUCT_DONE"),
+            default=0.0)
+        last_ts = chain[-1]["timestamp"]
+        mttr_s = detect_s + max(0.0, last_ts - root["timestamp"])
+
+        def _ids(key: str) -> List[str]:
+            return sorted({e[key] for e in chain if e.get(key)})
+
+        incidents.append({
+            "root_seq": root["seq"],
+            "root_kind": root["kind"],
+            "root_ts": root["timestamp"],
+            "severity": root["severity"],
+            "entity": _entity(root),
+            "precursor": (None if parent is None else
+                          {"seq": parent["seq"], "kind": parent["kind"],
+                           "message": parent.get("message", "")}),
+            "detect_s": round(detect_s, 6),
+            "reschedule_s": round(reschedule_s, 6),
+            "reconstruct_s": round(reconstruct_s, 6),
+            "mttr_s": round(mttr_s, 6),
+            "affected": {
+                "tasks": _ids("task_id"),
+                "actors": _ids("actor_id"),
+                "workers": _ids("worker_id"),
+                "nodes": _ids("node_id"),
+                "objects": sorted({(e.get("data") or {}).get("oid")
+                                   for e in chain
+                                   if (e.get("data") or {}).get("oid")}),
+            },
+            "chain": chain,
+            "journal": _correlate_journals(
+                journals, root["timestamp"] - detect_s - 0.5,
+                last_ts + 0.5),
+        })
+    incidents.sort(key=lambda inc: inc["root_ts"])
+    return {"generated_at": time.time(),
+            "events_scanned": len(events),
+            "counts": counts,
+            "incidents": incidents}
+
+
+def _correlate_journals(journals, t_lo: float, t_hi: float
+                        ) -> Dict[str, List[str]]:
+    """Flight-journal lines overlapping the incident window [t_lo,
+    t_hi] (wall-clock seconds), per label — what each process was
+    doing around the death. Best-effort: empty on any trouble."""
+    try:
+        from ray_tpu.util import flight_recorder
+        if journals is None:
+            journals = flight_recorder.merged_journals()
+        if not journals:
+            return {}
+        anchor_wall, anchor_ns = flight_recorder._get_anchor()
+        lo_ns = anchor_ns + int((t_lo - anchor_wall) * 1e9)
+        hi_ns = anchor_ns + int((t_hi - anchor_wall) * 1e9)
+        out: Dict[str, List[str]] = {}
+        for label, evs in journals.items():
+            window = [ev for ev in evs
+                      if lo_ns <= ev[1] + ev[2] and ev[1] <= hi_ns]
+            if window:
+                out[label] = flight_recorder.format_events(
+                    window[-flight_recorder.TAIL_EVENTS:])
+        return out
+    except Exception:  # noqa: BLE001 — correlation is best-effort
+        return {}
+
+
+def _chain_lines(inc: Dict[str, Any],
+                 limit: int = TAIL_EVENTS) -> List[str]:
+    t0 = inc["root_ts"]
+    lines = []
+    for ev in inc["chain"][:limit]:
+        line = (f"+{ev['timestamp'] - t0:7.3f}s #{ev['seq']} "
+                f"{ev['kind']}")
+        ent = _entity(ev)
+        if ent:
+            line += f" {ent}"
+        if ev.get("message"):
+            line += f" — {ev['message']}"
+        lines.append(line)
+    dropped = len(inc["chain"]) - limit
+    if dropped > 0:
+        lines.append(f"... {dropped} more chained events")
+    return lines
+
+
+def render(report: Dict[str, Any]) -> str:
+    lines = ["recovery report (cluster event plane)"]
+    lines.append(f"  events scanned: {report['events_scanned']}  "
+                 f"incidents: {len(report['incidents'])}")
+    for n, inc in enumerate(report["incidents"], 1):
+        lines.append(
+            f"  incident {n}: {inc['root_kind']} {inc['entity']} "
+            f"(event #{inc['root_seq']}, {inc['severity']})")
+        if inc.get("precursor"):
+            pre = inc["precursor"]
+            lines.append(f"    precursor: #{pre['seq']} {pre['kind']} "
+                         f"{pre['message']}")
+        lines.append(
+            f"    detect {inc['detect_s']:.3f}s  "
+            f"reschedule {inc['reschedule_s']:.3f}s  "
+            f"reconstruct {inc['reconstruct_s']:.3f}s  "
+            f"MTTR {inc['mttr_s']:.3f}s")
+        aff = inc["affected"]
+        lines.append(
+            f"    affected: {len(aff['tasks'])} tasks, "
+            f"{len(aff['actors'])} actors, "
+            f"{len(aff['objects'])} objects, "
+            f"{len(aff['workers'])} workers")
+        lines.append("    chain:")
+        for line in _chain_lines(inc, limit=40):
+            lines.append("      " + line)
+        for label, jlines in (inc.get("journal") or {}).items():
+            lines.append(f"    journal {label}:")
+            for jline in jlines:
+                lines.append("      " + jline)
+    return "\n".join(lines)
+
+
+def _tail(inc: Dict[str, Any]) -> str:
+    lines = _chain_lines(inc)
+    return (f"\n  recovery timeline (incident #{inc['root_seq']} "
+            f"{inc['root_kind']}, MTTR {inc['mttr_s']:.3f}s):\n    "
+            + "\n    ".join(lines))
+
+
+def incident_tail_text(seq: Optional[int]) -> str:
+    """Compact incident timeline for attaching to an exception message
+    (the ActorDiedError path), located by any event seq in the chain.
+    Empty string when events are off or anything goes wrong."""
+    if seq is None:
+        return ""
+    try:
+        events = _live_events()
+        if not events:
+            return ""
+        report = recovery_report(events=events, journals={})
+        for inc in report["incidents"]:
+            if any(e["seq"] == seq for e in inc["chain"]):
+                return _tail(inc)
+    except Exception:  # graftlint: disable=GL004
+        pass  # best-effort decoration: never worsen a death report
+    return ""
+
+
+def recent_incident_text(window_s: float = 30.0) -> str:
+    """Tail of the most recent incident rooted within ``window_s`` —
+    the DAGExecutionError attachment (a DAG failure can't name the
+    event seq that killed it, but the timing does)."""
+    try:
+        events = _live_events()
+        if not events:
+            return ""
+        report = recovery_report(events=events, journals={})
+        cutoff = time.time() - window_s
+        recent = [inc for inc in report["incidents"]
+                  if inc["root_ts"] >= cutoff]
+        if recent:
+            return _tail(recent[-1])
+    except Exception:  # graftlint: disable=GL004
+        pass  # best-effort decoration: never worsen a death report
+    return ""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    events = None
+    if paths:
+        with open(paths[0]) as f:
+            payload = json.load(f)
+        events = (payload.get("events", payload)
+                  if isinstance(payload, dict) else payload)
+    else:
+        events = _live_events()
+        if events is None:
+            try:
+                events = _snapshot_events()
+            except (OSError, KeyError, ValueError):
+                print("no live driver and no session snapshot found; "
+                      "pass a state.json path", file=sys.stderr)
+                return 2
+    report = recovery_report(events=events,
+                             journals=None if not paths else {})
+    if as_json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,6 +8,7 @@ read the session state snapshot the driver dumps every ~2s
     python -m ray_tpu.scripts.cli status
     python -m ray_tpu.scripts.cli list tasks|actors|nodes|jobs|pgs
     python -m ray_tpu.scripts.cli summary
+    python -m ray_tpu.scripts.cli events [--follow] [--kind K,K]
     python -m ray_tpu.scripts.cli timeline -o trace.json
     python -m ray_tpu.scripts.cli submit -- python my_driver.py
     python -m ray_tpu.scripts.cli version
@@ -106,6 +107,60 @@ def cmd_timeline(args) -> None:
     print(f"wrote {len(trace)} events to {args.output}")
 
 
+def _event_line(ev: Dict[str, Any]) -> str:
+    stamp = time.strftime("%H:%M:%S",
+                          time.localtime(ev.get("timestamp", 0)))
+    ent = ""
+    for key in ("node_id", "actor_id", "worker_id", "task_id"):
+        if ev.get(key):
+            ent = f" {key.split('_')[0]}={str(ev[key])[:12]}"
+            break
+    caused = (f" caused_by=#{ev['caused_by']}"
+              if ev.get("caused_by") is not None else "")
+    msg = f" — {ev['message']}" if ev.get("message") else ""
+    return (f"[{stamp}] #{ev['seq']:<5} {ev['severity']:<7} "
+            f"{ev['kind']}{ent}{caused}{msg}")
+
+
+def cmd_events(args) -> None:
+    """Print (and optionally follow) the cluster lifecycle event
+    stream from the session snapshot (reference: `ray list
+    cluster-events`)."""
+    def _select(state: Dict[str, Any], since: Optional[int]):
+        rows = state.get("events", [])
+        if args.kind:
+            wanted = set(args.kind.split(","))
+            rows = [e for e in rows if e.get("kind") in wanted]
+        if args.severity:
+            order = ("DEBUG", "INFO", "WARNING", "ERROR")
+            floor = order.index(args.severity)
+            rows = [e for e in rows
+                    if order.index(e.get("severity", "INFO")) >= floor]
+        if since is not None:
+            rows = [e for e in rows if e.get("seq", 0) > since]
+        return rows[-args.limit:]
+
+    state = _require_state()
+    rows = _select(state, None)
+    for ev in rows:
+        print(_event_line(ev))
+    if not args.follow:
+        return
+    cursor = max((e.get("seq", 0) for e in rows), default=0)
+    try:
+        while True:
+            time.sleep(1.0)  # snapshot dump tick is ~2s
+            state = _load_state()
+            if state is None:
+                continue
+            fresh = _select(state, cursor)
+            for ev in fresh:
+                print(_event_line(ev), flush=True)
+                cursor = max(cursor, ev.get("seq", 0))
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_submit(args) -> None:
     entry = " ".join(args.entrypoint)
     if not entry:
@@ -196,6 +251,19 @@ def main(argv=None) -> None:
                    choices=["tasks", "actors", "nodes", "jobs", "pgs"])
     p.set_defaults(fn=cmd_list)
     sub.add_parser("summary").set_defaults(fn=cmd_summary)
+    p = sub.add_parser(
+        "events", help="cluster lifecycle events from the live session "
+        "(reference: `ray list cluster-events`)")
+    p.add_argument("--follow", action="store_true",
+                   help="poll the snapshot and stream new events")
+    p.add_argument("--kind", default=None,
+                   help="comma-separated kind filter (e.g. "
+                   "NODE_DEAD,TASK_RETRY)")
+    p.add_argument("--severity", default=None,
+                   choices=["DEBUG", "INFO", "WARNING", "ERROR"],
+                   help="minimum severity")
+    p.add_argument("--limit", type=int, default=200)
+    p.set_defaults(fn=cmd_events)
     p = sub.add_parser("timeline")
     p.add_argument("-o", "--output", default="timeline.json")
     p.set_defaults(fn=cmd_timeline)

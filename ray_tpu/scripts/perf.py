@@ -264,6 +264,42 @@ def bench_refsan_overhead(rt, n: int) -> dict:
             if dt_off > 0 else 1.0}
 
 
+def bench_events_overhead(rt, n: int) -> dict:
+    """Cluster-event-plane cost on the tight trivial-task loop:
+    interleaved best-of-3 A/B toggling ``cluster_events_enabled`` (the
+    hot-path emit is one LEASE_GRANTED append per grant). The committed
+    guard bound lives in tests/test_recovery.py; this row is the
+    measured ratio for PERF.md / BENCH_core.json."""
+    import ray_tpu
+    from ray_tpu.core.config import get_config
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(1000)])
+    cfg = get_config()
+    saved = cfg.cluster_events_enabled
+    best = {False: None, True: None}
+    try:
+        for _ in range(3):
+            for enabled in (False, True):
+                cfg.cluster_events_enabled = enabled
+                t0 = time.perf_counter()
+                ray_tpu.get([nop.remote() for _ in range(n)])
+                dt = time.perf_counter() - t0
+                if best[enabled] is None or dt < best[enabled]:
+                    best[enabled] = dt
+    finally:
+        cfg.cluster_events_enabled = saved
+    dt_off, dt_on = best[False], best[True]
+    return {"bench": "events_overhead", "n": n,
+            "seconds_disabled": round(dt_off, 3),
+            "seconds_enabled": round(dt_on, 3),
+            "enabled_over_disabled": round(dt_on / dt_off, 3)
+            if dt_off > 0 else 1.0}
+
+
 def bench_process_threads(rt) -> dict:
     """Thread topology after a warm workload: with the selector IO
     loop, socket service is ONE rtpu-io-loop thread regardless of
@@ -318,6 +354,10 @@ def main(argv=None) -> None:
                         help="measure object-lifetime-sanitizer ledger "
                              "overhead on the trivial-task loop "
                              "(enabled vs disabled)")
+    parser.add_argument("--events", action="store_true",
+                        help="measure cluster-event-plane overhead on "
+                             "the trivial-task loop (interleaved "
+                             "best-of-3, enabled vs disabled)")
     args = parser.parse_args(argv)
 
     import ray_tpu
@@ -344,6 +384,10 @@ def main(argv=None) -> None:
         print(json.dumps(out), flush=True)
     if args.refsan:
         out = bench_refsan_overhead(rt, args.tasks)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    if args.events:
+        out = bench_events_overhead(rt, args.tasks)
         results.append(out)
         print(json.dumps(out), flush=True)
     if args.compare_wire:

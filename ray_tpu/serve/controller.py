@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core import events
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 
 logger = logging.getLogger(__name__)
@@ -113,7 +114,9 @@ class ServeController:
     def _remove_deployment_locked(self, name: str) -> None:
         # caller holds self._lock (the _locked suffix is the contract)
         st = self._deployments.pop(name)  # graftlint: disable=GL001
-        for h in st.replicas.values():
+        for rid, h in st.replicas.items():
+            events.emit("REPLICA_STOPPED",
+                        message=f"{name}/{rid} deployment removed")
             try:
                 ray_tpu.kill(h)
             except Exception:
@@ -345,6 +348,9 @@ class ServeController:
                 for rid in dead:
                     h = st.replicas.pop(rid, None)
                     if h is not None:
+                        events.emit("REPLICA_STOPPED", "WARNING",
+                                    message=f"{st.name}/{rid} failed "
+                                    "health check")
                         try:
                             ray_tpu.kill(h)
                         except Exception:
@@ -376,6 +382,8 @@ class ServeController:
             for rid, h in list(new.items()):  # failures pop from `new`
                 try:
                     ray_tpu.get(h.check_health.remote(), timeout=60.0)
+                    events.emit("REPLICA_STARTED",
+                                message=f"{st.name}/{rid}")
                 except Exception:
                     logger.exception(
                         "replica %s failed construction health check; "
@@ -396,6 +404,9 @@ class ServeController:
                 doomed = [st.replicas.pop(rid) for rid in victims]
                 st.status = "HEALTHY"
                 self._bump_locked()
+            for rid in victims:
+                events.emit("REPLICA_STOPPED",
+                            message=f"{st.name}/{rid} downscaled")
             for h in doomed:
                 try:
                     # fire-and-forget pre-kill drain nudge; the replica

@@ -307,6 +307,10 @@ class JaxTrainer:
                 return None
         if new_world != world:
             self._transition("RESIZING")
+            from ray_tpu.core import events
+            events.emit("TRAIN_RESIZED", "WARNING",
+                        message=f"elastic resize {world} -> {new_world}",
+                        data={"from": world, "to": new_world})
         return new_world
 
     def _rank_datasets_blobs(self, world: int) -> List[Optional[bytes]]:

@@ -115,6 +115,26 @@ def list_placement_groups() -> List[dict]:
     } for r in records]
 
 
+def list_cluster_events(limit: int = 1000,
+                        kinds: Optional[List[str]] = None,
+                        severity: Optional[str] = None,
+                        node_id: Optional[str] = None,
+                        worker_id: Optional[str] = None,
+                        actor_id: Optional[str] = None,
+                        task_id: Optional[str] = None,
+                        since_seq: Optional[int] = None) -> List[dict]:
+    """Cluster lifecycle events (core/events.py), chronological.
+    ``kinds`` filters to an iterable of kind names; ``severity`` is a
+    MINIMUM level ("WARNING" keeps WARNING+ERROR); entity filters match
+    hex-string ids; ``since_seq`` keeps events newer than a seq (the
+    --follow cursor). Reference: ``ray list cluster-events``."""
+    rt = _runtime()
+    return [ev.to_dict() for ev in rt.gcs.list_cluster_events(
+        limit=limit, kinds=kinds, severity=severity, node_id=node_id,
+        worker_id=worker_id, actor_id=actor_id, task_id=task_id,
+        since_seq=since_seq)]
+
+
 def list_jobs() -> List[dict]:
     rt = _runtime()
     with rt.gcs.lock:
@@ -161,17 +181,19 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
                     if e.state in ("FINISHED", "FAILED")), None)
         node = next((e.node_id.hex()[:8] for e in events if e.node_id),
                     "pending")
-        if end is None:
-            continue
+        # In-flight tasks become open spans clipped at now — a hung or
+        # leaked task must be visible in the trace, not silently absent.
+        end_state = end.state if end is not None else "RUNNING"
+        end_ts = end.timestamp if end is not None else time.time()
         trace.append({
             "name": events[0].name,
             "cat": "task",
             "ph": "X",
             "ts": start.timestamp * 1e6,
-            "dur": max((end.timestamp - start.timestamp) * 1e6, 1.0),
+            "dur": max((end_ts - start.timestamp) * 1e6, 1.0),
             "pid": node,
             "tid": tid[:8],
-            "args": {"state": end.state, "task_id": tid},
+            "args": {"state": end_state, "task_id": tid},
         })
     if filename:
         with open(filename, "w") as f:
@@ -186,8 +208,22 @@ def timeline(filename: Optional[str] = None) -> List[dict]:
 def state_snapshot() -> dict:
     from ray_tpu.core import runtime as runtime_mod
     rt = runtime_mod.get_runtime_or_none()
+    if rt is None or not getattr(rt, "is_driver", False):
+        # No driver in this process: degrade to a partial snapshot
+        # instead of raising out of every caller (the CLI and dashboard
+        # render the empty tables).
+        return {
+            "timestamp": time.time(),
+            "driver": False,
+            "dashboard_url": None,
+            "nodes": [], "actors": [], "tasks": [],
+            "task_summary": {}, "placement_groups": [], "jobs": [],
+            "events": [],
+            "resources_total": {}, "resources_available": {},
+        }
     return {
         "timestamp": time.time(),
+        "driver": True,
         "dashboard_url": getattr(rt, "dashboard_url", None),
         "nodes": list_nodes(),
         "actors": list_actors(),
@@ -195,6 +231,7 @@ def state_snapshot() -> dict:
         "task_summary": summarize_tasks(),
         "placement_groups": list_placement_groups(),
         "jobs": list_jobs(),
+        "events": list_cluster_events(limit=500),
         "resources_total": _totals("resources_total"),
         "resources_available": _totals("resources_available"),
     }

@@ -64,6 +64,17 @@ def test_dashboard_state_routes(dash_runtime):
     _, body = _get(base + "/api/jobs")
     assert isinstance(json.loads(body), list)
 
+    _, body = _get(base + "/api/events")
+    events = json.loads(body)
+    assert {e["kind"] for e in events} >= {"NODE_ADDED", "LEASE_GRANTED"}
+    # query params thread through to the store's filters
+    _, body = _get(base + "/api/events?kind=LEASE_GRANTED&limit=3")
+    rows = json.loads(body)
+    assert 0 < len(rows) <= 3
+    assert all(e["kind"] == "LEASE_GRANTED" for e in rows)
+    _, body = _get(base + "/api/events?severity=ERROR")
+    assert all(e["severity"] == "ERROR" for e in json.loads(body))
+
     status, body = _get(base + "/metrics")
     assert status == 200
 
@@ -240,8 +251,8 @@ def test_web_ui_spa_served(ray_start_shared):
                                       timeout=30).read().decode()
         # nav covers the reference dashboard's module views
         for view in ("#/overview", "#/nodes", "#/actors", "#/tasks",
-                     "#/objects", "#/pgs", "#/jobs", "#/serve",
-                     "#/train", "#/logs"):
+                     "#/objects", "#/pgs", "#/jobs", "#/events",
+                     "#/serve", "#/train", "#/logs"):
             assert view in html, view
         # rendering is textContent-only (no injection surface); the
         # word appears in a comment stating the rule, never as code
@@ -249,7 +260,8 @@ def test_web_ui_spa_served(ray_start_shared):
         # every API the SPA polls answers
         import json as _json
         for route in ("/api/cluster", "/api/nodes", "/api/summary",
-                      "/api/serve", "/api/train", "/api/logs"):
+                      "/api/events", "/api/serve", "/api/train",
+                      "/api/logs"):
             _json.load(urllib.request.urlopen(dash.url + route,
                                               timeout=30))
     finally:

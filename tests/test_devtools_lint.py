@@ -1000,3 +1000,76 @@ def test_gl017_per_line_disable():
         "self._counts[oid] = self._counts.get(oid, 0) + 1",
         "self._counts[oid] = 1  # graftlint: disable=GL017")
     assert rules_hit(src, select=["GL017"]) == set()
+
+
+# -- GL018 silent lifecycle mutation ----------------------------------
+
+GL018_POS_SUBSCRIPT = """
+    class Gcs:
+        def kill(self, actor_id):
+            rec = self.actors[actor_id]
+            rec.state = "DEAD"
+"""
+
+GL018_NEG_EMITS = """
+    class Gcs:
+        def kill(self, actor_id):
+            rec = self.actors[actor_id]
+            rec.state = "DEAD"
+            self.add_cluster_event("ACTOR_DEAD", "ERROR",
+                                   actor_id=actor_id)
+"""
+
+
+def test_gl018_fires_on_silent_state_flip():
+    findings = run(GL018_POS_SUBSCRIPT, select=["GL018"])
+    assert [f.rule for f in findings] == ["GL018"]
+    assert "state" in findings[0].message
+
+
+def test_gl018_fires_on_table_loops_and_direct_subscript():
+    assert rules_hit("""
+        class Gcs:
+            def sweep(self):
+                for rec in self.nodes.values():
+                    rec.state = "DEAD"
+    """, select=["GL018"]) == {"GL018"}
+    assert rules_hit("""
+        class Gcs:
+            def flip(self, aid):
+                self.actors[aid].state = "DEAD"
+    """, select=["GL018"]) == {"GL018"}
+    # .get() is record-sourced too
+    assert rules_hit("""
+        class Gcs:
+            def flip(self, aid):
+                rec = self.actors.get(aid)
+                if rec is not None:
+                    rec.state = "DEAD"
+    """, select=["GL018"]) == {"GL018"}
+
+
+def test_gl018_quiet_when_emitting_or_off_table():
+    assert rules_hit(GL018_NEG_EMITS, select=["GL018"]) == set()
+    # update_actor_state / mark_node_dead emit internally
+    assert rules_hit("""
+        class Gcs:
+            def kill(self, actor_id):
+                rec = self.actors[actor_id]
+                rec.state = "DEAD"
+                self.update_actor_state(actor_id, "DEAD")
+    """, select=["GL018"]) == set()
+    # non-table records carry no event contract
+    assert rules_hit("""
+        class App:
+            def flip(self, name):
+                rec = self.deployments[name]
+                rec.state = "STOPPED"
+    """, select=["GL018"]) == set()
+
+
+def test_gl018_per_line_disable():
+    src = GL018_POS_SUBSCRIPT.replace(
+        'rec.state = "DEAD"',
+        'rec.state = "DEAD"  # graftlint: disable=GL018')
+    assert rules_hit(src, select=["GL018"]) == set()

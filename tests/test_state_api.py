@@ -180,3 +180,104 @@ def test_job_table_shared_between_clients(ray_start_regular):
     jobs = state.list_jobs()
     assert any(j.get("job_id") == job_id and j["type"] == "submission"
                for j in jobs)
+
+
+def test_list_cluster_events_and_filters(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    evs = state.list_cluster_events()
+    kinds = {e["kind"] for e in evs}
+    assert {"NODE_ADDED", "WORKER_STARTED", "LEASE_GRANTED"} <= kinds
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+
+    # kind filter
+    only = state.list_cluster_events(kinds=["LEASE_GRANTED"])
+    assert only and all(e["kind"] == "LEASE_GRANTED" for e in only)
+    # severity is a MINIMUM: routine grants are DEBUG noise
+    warn_up = state.list_cluster_events(severity="WARNING")
+    assert all(e["severity"] in ("WARNING", "ERROR") for e in warn_up)
+    # entity filter round-trips the hex id
+    node_id = only[-1]["node_id"]
+    assert node_id
+    scoped = state.list_cluster_events(node_id=node_id)
+    assert scoped and all(e["node_id"] == node_id for e in scoped)
+    # --follow cursor semantics
+    cursor = evs[-1]["seq"]
+    assert state.list_cluster_events(since_seq=cursor) == []
+
+
+def test_cli_events_reads_snapshot(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    t0 = time.time()
+    ray_tpu.get(f.remote())
+    from ray_tpu.scripts.cli import _load_state
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        snap = _load_state()
+        # a stale snapshot from a previous session may still be on
+        # disk: require a dump from THIS session
+        if snap and snap.get("events") and snap["timestamp"] >= t0:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail("events never reached the state snapshot")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "events"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert "LEASE_GRANTED" in proc.stdout
+    assert "WORKER_STARTED" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "events",
+         "--kind", "NODE_ADDED", "--limit", "5"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines and all("NODE_ADDED" in ln for ln in lines)
+
+
+def test_state_snapshot_without_driver():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import json; from ray_tpu.util import state; "
+         "print(json.dumps(state.state_snapshot()))"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    snap = json.loads(proc.stdout)
+    assert snap["driver"] is False
+    assert snap["nodes"] == [] and snap["events"] == []
+    assert snap["timestamp"] > 0
+
+
+def test_timeline_inflight_open_span(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    ref = slow.remote()
+    # in-flight tasks report SCHEDULED (RUNNING is recorded with the
+    # worker's result message); the timeline must still show them
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        rows = [t for t in state.list_tasks()
+                if t["state"] == "SCHEDULED"]
+        if rows:
+            break
+        time.sleep(0.05)
+    else:
+        pytest.fail("task never reached SCHEDULED")
+    trace = state.timeline()
+    open_spans = [ev for ev in trace
+                  if ev["args"]["state"] == "RUNNING"]
+    assert open_spans, "in-flight task missing from the timeline"
+    span = open_spans[0]
+    assert span["ph"] == "X" and span["dur"] >= 1.0
+    # clipped at now: the span must not extend into the future
+    assert span["ts"] + span["dur"] <= time.time() * 1e6 + 1e6
+    ray_tpu.cancel(ref)
