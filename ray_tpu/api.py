@@ -182,6 +182,10 @@ class _RuntimeContext:
         if rt is None:
             return None
         if rt.is_driver:
+            from ray_tpu.core.virtual_node import current_virtual_node_id
+            vnode_id = current_virtual_node_id()
+            if vnode_id is not None:  # executing ON a virtual member
+                return vnode_id.hex()
             return rt.head_node_id.hex()
         node_id = getattr(rt, "node_id", None)
         return node_id.hex() if node_id is not None else None  # client

@@ -246,26 +246,22 @@ class ClientRuntime:
         workers reconnecting to a restarted GCS). Pre-restart
         ObjectRefs are recorded as lost — the new head never owned
         them — then the session resumes for NEW work."""
-        import time as _time
-
         from ray_tpu.core.config import get_config
+        from ray_tpu.util.backoff import Backoff
         window = get_config().client_reconnect_s
         if window <= 0 or self._closed.is_set():
             return False
-        deadline = _time.monotonic() + window
-        delay = 0.25
+        # Jittered so a fleet of clients losing the same head does not
+        # redial it in lockstep (util/backoff.py).
+        backoff = Backoff(initial_s=0.25, max_s=2.0, deadline_s=window)
         while not self._closed.is_set():
-            remaining = deadline - _time.monotonic()
-            if remaining <= 0:
-                return False
             try:
                 conn = self._connect()
             except (OSError, ConnectionError):
                 # back off on the closed event (not time.sleep) so
                 # close() interrupts the reconnect wait immediately
-                if self._closed.wait(min(delay, max(0.0, remaining))):
+                if not backoff.wait(self._closed):
                     return False
-                delay = min(delay * 2, 2.0)
                 continue
             # every ref minted before the restart is gone for good.
             # Single-writer: only the reader thread reconnects, and
@@ -364,7 +360,11 @@ class ClientRuntime:
         if status == "inline":
             return serialization.unpack(reply["data"])
         if status == "pull":
+            import time as _time
+
             from ray_tpu.core.object_transfer import get_pull_manager
+            from ray_tpu.util.backoff import Backoff
+            backoff = Backoff(initial_s=0.01, max_s=0.1)
             for _attempt in range(3):
                 if not get_pull_manager().pull(tuple(reply["addr"]), oid,
                                                self._pull_store):
@@ -373,7 +373,9 @@ class ClientRuntime:
                 if data is not None:
                     return serialization.unpack(data)
                 # a concurrent get of the same ref consumed the buffer
-                # between seal and take: pull again
+                # between seal and take: pull again after a short
+                # jittered pause (the peer needs time to re-seal)
+                _time.sleep(backoff.next_delay())
             raise ObjectLostError(oid)
         if status == "error":
             raise serialization.loads(reply["error"])

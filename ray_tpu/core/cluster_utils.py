@@ -32,6 +32,7 @@ class Cluster:
             system_config=system_config)
         runtime_mod.set_runtime(self.runtime)
         self.head_node_id = self.runtime.head_node_id
+        self.virtual_pool = None  # created on first add_virtual_nodes()
 
     def add_node(self, num_cpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
@@ -96,9 +97,51 @@ class Cluster:
         proc.kill()
         raise TimeoutError("node daemon did not register in time")
 
+    def add_virtual_nodes(self, count: int,
+                          resources: Optional[Dict[str, float]] = None,
+                          labels: Optional[Dict[str, str]] = None,
+                          store_bytes: Optional[int] = None,
+                          timeout: float = 60.0):
+        """Spin up ``count`` virtual nodes (core/virtual_node.py):
+        in-process cluster members that register over the head's real
+        TCP listener but share one thread pool and one object server,
+        so 64-128 of them fit on one box with O(1) extra threads —
+        the chaos-plane envelope substrate. Requires ``head_port >= 0``.
+        Returns the list of VirtualNode handles (``.node_id``,
+        ``.kill()``, ``.freeze()``/``.thaw()``)."""
+        import time
+
+        if self.runtime.head_address is None:
+            raise RuntimeError(
+                "head has no TCP listener; pass head_port=0 via "
+                "system_config")
+        pool = self.virtual_pool
+        if pool is None:
+            from ray_tpu.core.virtual_node import VirtualNodePool
+            pool = VirtualNodePool(self.runtime.head_address)
+            self.virtual_pool = pool
+        nodes = pool.start_nodes(count, resources=resources,
+                                 labels=labels, store_bytes=store_bytes)
+        # registration is synchronous (blocking handshake), but the
+        # head installs the node from its IO loop — wait until all ids
+        # are visible to the scheduler before handing them out
+        deadline = time.monotonic() + timeout
+        wanted = {n.node_id for n in nodes}
+        while time.monotonic() < deadline:
+            if wanted <= set(self.runtime.nodes):
+                return nodes
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"{len(wanted - set(self.runtime.nodes))} virtual nodes "
+            "did not register in time")
+
     def remove_node(self, node_id: NodeID) -> None:
         """Kill a node (its workers die; chaos path)."""
         self.runtime.remove_node(node_id)
 
     def shutdown(self) -> None:
+        pool = self.virtual_pool
+        if pool is not None:
+            self.virtual_pool = None
+            pool.shutdown()
         self.runtime.shutdown()

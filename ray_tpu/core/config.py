@@ -131,6 +131,17 @@ class Config:
     # limit). A lone pull may exceed it so oversize objects still move.
     object_pull_inflight_bytes: int = 256 * 1024 * 1024
 
+    # --- virtual nodes (chaos-plane scale-out; core/virtual_node.py) ---
+    # In-process lightweight nodes that register over the head's real
+    # TCP listener but execute tasks on one shared thread pool and
+    # heartbeat via IO-loop timers, so head-node threads stay O(1) in
+    # node count (64-128 virtual nodes on one box for envelope drills).
+    # Per-virtual-node object store capacity (plain bytearrays, not
+    # shm) — small by default so spill paths exercise under drills.
+    virtual_node_store_bytes: int = 8 * 1024 * 1024
+    # Task-execution threads SHARED by every virtual node in a pool.
+    virtual_node_executor_threads: int = 8
+
     # --- GCS durability ---
     # Journal file for control-plane state (KV, jobs, functions): a new
     # head started with the same path replays it (reference:

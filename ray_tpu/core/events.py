@@ -64,6 +64,10 @@ KINDS: Dict[str, str] = {
                        "failure)",
     "TRAIN_RESIZED": "elastic trainer chose a new world size after a "
                      "failure",
+    "CHAOS_INJECTED": "deterministic fault injected by the chaos "
+                      "controller (devtools/chaos.py)",
+    "PG_RESCHEDULED": "placement group lost a member node; bundles "
+                      "released and the gang re-queued for placement",
 }
 
 #: kinds that root a recovery incident (everything chained from one of

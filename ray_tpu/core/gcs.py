@@ -331,10 +331,17 @@ class Gcs:
             if last_hb is not None:
                 detect_s = max(0.0, time.time() - last_hb)
         data = {} if detect_s is None else {"detect_s": round(detect_s, 6)}
+        # Causal chain preference: an open heartbeat-miss episode is the
+        # closest precursor; failing that, an injected chaos fault
+        # (devtools/chaos.py stashes its CHAOS_INJECTED seq on the node
+        # manager) roots the incident at its deliberate cause.
+        cause = getattr(expected_manager, "_hb_miss_seq", None)
+        if cause is None:
+            cause = getattr(expected_manager, "_chaos_cause_seq", None)
         seq = self.add_cluster_event(
             "NODE_DEAD", "ERROR", node_id=node_id,
             message="node declared dead",
-            caused_by=getattr(expected_manager, "_hb_miss_seq", None),
+            caused_by=cause,
             data=data)
         events_mod.NODE_DEATHS.inc_local()
         if detect_s is not None:
@@ -487,6 +494,10 @@ class Gcs:
     def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroupRecord]:
         with self.lock:
             return self.placement_groups.get(pg_id)
+
+    def list_placement_groups(self) -> List[PlacementGroupRecord]:
+        with self.lock:
+            return list(self.placement_groups.values())
 
     # --- task events (observability) -----------------------------------
     def add_task_event(self, event) -> None:

@@ -88,14 +88,29 @@ def use_native_wire() -> bool:
     return _lib.try_load() is not None
 
 
+# Chaos seam: when set, every new connection's codec is passed through
+# this wrapper (devtools/chaos.py installs a fault-injecting shim that
+# delays or drops inbound frames deterministically). Test-only — None
+# in production, and the indirection costs one None-check per
+# connection setup, never per frame.
+_codec_wrapper = None
+
+
 def _make_codec(native: Optional[bool] = None):
     if native is None:
         native = use_native_wire()
     if native:
         lib = _lib.try_load()
         if lib is not None:
-            return _NativeCodec(lib)
-    return _PyCodec()
+            codec = _NativeCodec(lib)
+        else:
+            codec = _PyCodec()
+    else:
+        codec = _PyCodec()
+    wrapper = _codec_wrapper
+    if wrapper is not None:
+        codec = wrapper(codec)
+    return codec
 
 
 class _NativeCodec:
@@ -710,7 +725,9 @@ class IOLoop:
         # Pull stream chunks while there's room: the stream never
         # outruns the socket by more than ~low_water bytes.
         rec = _flight.RECORDER  # lock-free journal; no RPC (GL013)
-        while conn._streams and remaining < conn._low_water:
+        # not a retry loop: each except-continue pops the finished
+        # stream first, so every re-entry makes progress
+        while conn._streams and remaining < conn._low_water:  # graftlint: disable=GL019
             gen, on_done = conn._streams[0]
             t0_ns = rec.clock() if rec is not None else 0
             try:

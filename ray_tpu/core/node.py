@@ -277,7 +277,7 @@ class Node:
         return handle
 
     def _emit_worker_event(self, kind: str, severity: str, worker_id,
-                           message: str):
+                           message: str, caused_by=None):
         """Worker lifecycle event, driver-side only: on a remote node
         daemon ``self.runtime`` is the HeadProxy (no GCS) — worker
         crashes are forwarded as WORKER_CRASHED_FWD and narrated by the
@@ -288,6 +288,7 @@ class Node:
         return gcs.add_cluster_event(kind, severity,
                                      node_id=self.node_id,
                                      worker_id=worker_id,
+                                     caused_by=caused_by,
                                      message=message)
 
     def prestart_workers(self, count: int, profile: str = "cpu") -> None:
@@ -752,7 +753,8 @@ class Node:
         severity = "ERROR" if (running or was_actor) else "DEBUG"
         worker._exit_event_seq = self._emit_worker_event(
             "WORKER_EXIT", severity, worker.worker_id,
-            f"{len(running)} tasks in flight" if running else "")
+            f"{len(running)} tasks in flight" if running else "",
+            caused_by=getattr(worker, "_chaos_cause_seq", None))
         for profile in starved:
             self._spawn_worker(profile)
         self.runtime.on_worker_crashed(self, worker, running,

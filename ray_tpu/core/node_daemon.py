@@ -255,32 +255,33 @@ class NodeDaemon:
         completions are dropped by the head as unknown tasks. The dead
         flag stays set for the whole attempt, so nothing else writes to
         the half-established connection."""
-        import time as _time
+        from ray_tpu.util.backoff import Backoff
 
         window = get_config().node_reconnect_s
         if window <= 0 or self._stop_requested:
             return False
-        deadline = _time.monotonic() + window
-        delay = 0.5
+        # Jittered (util/backoff.py): after a head restart EVERY daemon
+        # in the fleet redials at once, and identical timers would slam
+        # the fresh listener in synchronized waves.
+        backoff = Backoff(initial_s=0.5, max_s=3.0, deadline_s=window)
         old = self.conn
         while not self._stop_requested:
-            remaining = deadline - _time.monotonic()
-            if remaining <= 0:
+            if backoff.expired():
                 return False
+            remaining = backoff.remaining() or 0.0
             try:
                 conn = self._dial()
             except OSError:
-                _time.sleep(min(delay, max(0.0, remaining)))
-                delay = min(delay * 2, 3.0)
+                if not backoff.wait():
+                    return False
                 continue
             try:
                 reply = self._register_on(conn,
                                           timeout_s=min(15.0, remaining))
             except (RuntimeError, OSError):
                 conn.close()  # every failed attempt frees its socket
-                _time.sleep(min(delay, max(0.0,
-                                           deadline - _time.monotonic())))
-                delay = min(delay * 2, 3.0)
+                if not backoff.wait():
+                    return False
                 continue
             self._adopt(conn, reply)
             try:

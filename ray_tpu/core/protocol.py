@@ -130,21 +130,25 @@ def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
     For IDEMPOTENT calls only (reference:
     src/ray/rpc/retryable_grpc_client.h — retries are the caller's
     promise that the server can see the request twice). Re-raises the
-    last error once attempts are exhausted.
+    last error once attempts are exhausted. Delays are jittered
+    (util/backoff.py) so concurrent callers hitting the same dead link
+    decorrelate instead of retrying in lockstep.
     """
     import logging
-    delay = backoff_s
+
+    from ray_tpu.util.backoff import Backoff
+    backoff = Backoff(initial_s=backoff_s, max_s=max_backoff_s)
     for i in range(attempts):
         try:
             return fn()
         except retry_on as err:
             if i == attempts - 1:
                 raise
+            delay = backoff.next_delay()
             logging.getLogger("ray_tpu.rpc").debug(
                 "%s failed (%s), retry %d/%d in %.2fs",
                 description, err, i + 1, attempts - 1, delay)
             time.sleep(delay)
-            delay = min(delay * 2, max_backoff_s)
 
 
 def _send_all(sock: socket.socket, data: bytes) -> None:

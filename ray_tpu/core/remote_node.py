@@ -479,19 +479,31 @@ class HeadServer:
                     continue
                 overdue = now - node.last_heartbeat
                 if overdue > cfg.heartbeat_timeout_s:
+                    # On a starved box (or when the timeout is barely
+                    # over 2 intervals) the monitor's first wake past
+                    # the miss threshold can already be past the death
+                    # threshold, skipping the miss episode entirely and
+                    # leaving the NODE_DEAD incident without its
+                    # precursor. Open the episode first — never widen
+                    # drill tolerances to paper over the gap.
+                    self._note_heartbeat_miss(node, overdue)
                     self.runtime.on_remote_node_death(node.node_id,
                                                       expected=node)
                 elif overdue > 2 * cfg.heartbeat_interval_s:
-                    # Once per miss episode: the seq rides the node so a
-                    # later NODE_DEAD chains to it (gcs.mark_node_dead
-                    # reads _hb_miss_seq); a fresh HEARTBEAT clears it.
-                    if getattr(node, "_hb_miss_seq", None) is None:
-                        node._hb_miss_seq = (
-                            self.runtime.gcs.add_cluster_event(
-                                "NODE_HEARTBEAT_MISS", "WARNING",
-                                node_id=node.node_id,
-                                message=f"last heartbeat "
-                                        f"{overdue:.2f}s ago"))
+                    self._note_heartbeat_miss(node, overdue)
+
+    def _note_heartbeat_miss(self, node: RemoteNode,
+                             overdue: float) -> None:
+        """Once per miss episode: the seq rides the node so a later
+        NODE_DEAD chains to it (gcs.mark_node_dead reads _hb_miss_seq);
+        a fresh HEARTBEAT clears it. A chaos-injected fault (freeze
+        drill) becomes the episode's cause when one is pending."""
+        if getattr(node, "_hb_miss_seq", None) is not None:
+            return
+        node._hb_miss_seq = self.runtime.gcs.add_cluster_event(
+            "NODE_HEARTBEAT_MISS", "WARNING", node_id=node.node_id,
+            caused_by=getattr(node, "_chaos_cause_seq", None),
+            message=f"last heartbeat {overdue:.2f}s ago")
 
     def _handle(self, node: RemoteNode, msg: dict) -> None:
         rt = self.runtime

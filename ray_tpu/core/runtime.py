@@ -370,7 +370,8 @@ class DriverRuntime:
             # Re-debit the creation resources so the fresh ledger
             # reflects the worker the actor still occupies.
             if record.spec is not None and self.scheduler.try_acquire(
-                    node.node_id, self._spec_resources(record.spec)):
+                    node.node_id, self._spec_resources(record.spec),
+                    token=record.spec.task_id):
                 info.resources_node = node.node_id
             self.actors[aid] = info
             self.gcs.update_actor_state(aid, "ALIVE",
@@ -445,6 +446,7 @@ class DriverRuntime:
             # In-flight tasks the daemon can no longer report on.
             self.reap_node_specs(node, node.take_inflight(), actor_ids,
                                  death_seq=death_seq)
+            self._handle_pg_node_death(node_id, death_seq)
 
         return tail
 
@@ -586,8 +588,10 @@ class DriverRuntime:
         for spec in queued:
             if not self._consume_overcommit(spec.task_id):
                 self.scheduler.release(node_id,
-                                       self._spec_resources(spec))
+                                       self._spec_resources(spec),
+                                       token=spec.task_id)
             self._enqueue(spec)
+        self._handle_pg_node_death(node_id, death_seq)
 
     # --- streaming generators -------------------------------------------
     # reference: _raylet.pyx:299 ObjectRefGenerator owner-side protocol.
@@ -904,7 +908,7 @@ class DriverRuntime:
             cache_key = tuple(sorted(spec.resources.items()))
             cached = self._dispatch_cache.get(cache_key)
             if cached is not None and self.scheduler.try_acquire(
-                    cached, spec.resources):
+                    cached, spec.resources, token=spec.task_id):
                 node_id = cached
         if node_id is None:
             try:
@@ -913,7 +917,8 @@ class DriverRuntime:
             except ValueError:
                 return False  # infeasible: let the slow path park it
             if node_id is None or not self.scheduler.try_acquire(
-                    node_id, self._spec_resources(spec)):
+                    node_id, self._spec_resources(spec),
+                    token=spec.task_id):
                 if cache_key is not None:
                     # scheduler-thread-only state; see __init__ comment
                     self._dispatch_cache.pop(  # graftlint: disable=GL001
@@ -924,7 +929,8 @@ class DriverRuntime:
                 self._dispatch_cache[cache_key] = node_id  # graftlint: disable=GL001
         node = self.nodes.get(node_id)
         if node is None:
-            self.scheduler.release(node_id, self._spec_resources(spec))
+            self.scheduler.release(node_id, self._spec_resources(spec),
+                                   token=spec.task_id)
             return False
         if spec.is_actor_creation:
             info = self.actors.get(spec.actor_id)
@@ -997,7 +1003,8 @@ class DriverRuntime:
                         self._infeasible.append(spec)
                     continue
                 if node_id is None or not self.scheduler.try_acquire(
-                        node_id, self._spec_resources(spec)):
+                        node_id, self._spec_resources(spec),
+                        token=spec.task_id):
                     blocked_sigs.add(sig)
                     backlog.append(spec)
                     continue
@@ -1115,14 +1122,24 @@ class DriverRuntime:
             return
         with self._pg_lock:
             remaining = []
+            progressed = False
             for record in self._pending_pgs:
                 if record.state != "PENDING":
                     continue
                 try:
                     self.scheduler.reserve_placement_group(record)
+                    progressed = True
                 except PlacementGroupUnschedulableError:
                     remaining.append(record)
             self._pending_pgs = remaining
+        if progressed:
+            # Fresh pg-scoped resources may unpark gang tasks that went
+            # infeasible while the group was re-pending (node death
+            # stripped its custom resources from every ledger).
+            with self._sched_cond:
+                self._schedulable.extend(self._infeasible)
+                self._infeasible.clear()
+                self._sched_cond.notify_all()
 
     def remove_placement_group_record(self, record) -> None:
         """Release or cancel a PG in any state (idempotent)."""
@@ -1137,6 +1154,40 @@ class DriverRuntime:
                 record.state = "REMOVED"
         if released:
             # Freed capacity may satisfy a queued gang.
+            self.retry_pending_placement_groups()
+
+    def _handle_pg_node_death(self, node_id: NodeID,
+                              death_seq: Optional[int] = None) -> None:
+        """A gang lost a member node: release its reservation exactly
+        once and re-queue it for placement (reference:
+        GcsPlacementGroupManager::OnNodeDead rescheduling). Runs in the
+        death tail outside _node_reg_lock. _pg_lock orders it against
+        user removes; the CREATED check plus return_placement_group's
+        REMOVED guard make a racing remove release the bundles exactly
+        once. Survivor bundles are credited back here — the dead node's
+        ledger is already gone (scheduler.remove_node), so its bundle
+        release is a no-op rather than a double credit."""
+        hit = []
+        with self._pg_lock:
+            for record in self.gcs.list_placement_groups():
+                if record.state != "CREATED":
+                    continue
+                if not any(b.node_id == node_id for b in record.bundles):
+                    continue
+                self.scheduler.return_placement_group(record)
+                record.state = "PENDING"
+                if record not in self._pending_pgs:
+                    self._pending_pgs.append(record)
+                hit.append(record)
+        for record in hit:
+            self.gcs.add_cluster_event(
+                "PG_RESCHEDULED", "WARNING", node_id=node_id,
+                caused_by=death_seq,
+                message=f"placement group {record.pg_id.hex()[:8]} lost "
+                        f"a member node; gang re-queued for placement",
+                data={"pg_id": record.pg_id.hex(),
+                      "strategy": record.strategy})
+        if hit:
             self.retry_pending_placement_groups()
 
     def pending_pg_demand(self) -> List:
@@ -1357,7 +1408,8 @@ class DriverRuntime:
             return
         if self._consume_overcommit(spec.task_id):
             return
-        self.scheduler.release(node_id, self._spec_resources(spec))
+        self.scheduler.release(node_id, self._spec_resources(spec),
+                               token=spec.task_id)
 
     def _signal_scheduler(self) -> None:
         # cheap unlocked read: only completions that may unblock a
@@ -1399,10 +1451,18 @@ class DriverRuntime:
         # stop()) emit here so the incident always has a root event.
         exit_seq = getattr(worker, "_exit_event_seq", None)
         if exit_seq is None:
+            cause = getattr(worker, "_exit_cause_seq", None)
+            if cause is None:
+                # Remote/virtual worker kills: the stub is minted per
+                # message, so chaos stashes its CHAOS_INJECTED seq on
+                # the head-side node keyed by worker id (one-shot).
+                causes = getattr(node, "_chaos_worker_causes", None)
+                if causes:
+                    cause = causes.pop(worker.worker_id, None)
             exit_seq = self.gcs.add_cluster_event(
                 "WORKER_EXIT", "ERROR", node_id=node.node_id,
                 worker_id=worker.worker_id,
-                caused_by=getattr(worker, "_exit_cause_seq", None),
+                caused_by=cause,
                 message="worker killed with its node")
         if exit_seq is not None and (running or actor_id is not None):
             # idle reclaims carry a seq too but seed no recovery chain
@@ -1410,7 +1470,9 @@ class DriverRuntime:
         for spec in running:
             if (not spec.is_actor_creation and spec.actor_id is None
                     and not self._consume_overcommit(spec.task_id)):
-                self.scheduler.release(node.node_id, self._spec_resources(spec))
+                self.scheduler.release(node.node_id,
+                                       self._spec_resources(spec),
+                                       token=spec.task_id)
             # Streaming tasks never retry: already-consumed yields would
             # replay (reference keeps generator retries behind a flag for
             # the same reason).
@@ -1458,7 +1520,8 @@ class DriverRuntime:
                 and self.nodes.get(node_id) is not dead_node):
             return
         self.scheduler.release(node_id,
-                               self._spec_resources(info.creation_spec))
+                               self._spec_resources(info.creation_spec),
+                               token=info.creation_spec.task_id)
 
     def _handle_actor_death(self, actor_id: ActorID, node: Node,
                             cause_seq: Optional[int] = None) -> None:

@@ -68,6 +68,14 @@ class ClusterScheduler:
         self._lock = threading.RLock()
         self._nodes: Dict[NodeID, NodeResources] = {}
         self._rr_counter = 0
+        # Outstanding leases keyed by caller token (task id). A tokened
+        # release is idempotent: the completion path and the node-death
+        # harvest can both observe the same task under a chaos drill
+        # (TASK_DONE racing the heartbeat-miss kill), and only the first
+        # credits the ledger. remove_node purges a node's tokens, so a
+        # late by-id release after the id re-registers cannot credit the
+        # NEW incarnation's ledger with capacity it never granted.
+        self._leases: Dict[object, Tuple[NodeID, Dict[str, float]]] = {}
 
     # --- node membership ----------------------------------------------
     def add_node(self, node_id: NodeID, resources: Dict[str, float],
@@ -80,6 +88,10 @@ class ClusterScheduler:
     def remove_node(self, node_id: NodeID) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)
+            stale = [t for t, (nid, _) in self._leases.items()
+                     if nid == node_id]
+            for t in stale:
+                del self._leases[t]
 
     def add_node_resources(self, node_id: NodeID, resources: Dict[str, float]) -> None:
         """Dynamically extend a node's totals (e.g. placement-group bundle
@@ -100,7 +112,8 @@ class ClusterScheduler:
                 view.available.pop(k, None)
 
     # --- accounting ----------------------------------------------------
-    def try_acquire(self, node_id: NodeID, need: Dict[str, float]) -> bool:
+    def try_acquire(self, node_id: NodeID, need: Dict[str, float],
+                    token: object = None) -> bool:
         with self._lock:
             view = self._nodes.get(node_id)
             if view is None or not _fits(view.available, need):
@@ -108,10 +121,20 @@ class ClusterScheduler:
             for k, v in need.items():
                 view.available[k] = view.available.get(k, 0.0) - v
             view.queue_depth += 1
+            if token is not None:
+                self._leases[token] = (node_id, dict(need))
             return True
 
-    def release(self, node_id: NodeID, need: Dict[str, float]) -> None:
+    def release(self, node_id: NodeID, need: Dict[str, float],
+                token: object = None) -> None:
         with self._lock:
+            if token is not None:
+                lease = self._leases.pop(token, None)
+                if lease is None:
+                    return  # already released, or purged by remove_node
+                # Trust the ledger over the caller: release exactly what
+                # was acquired, onto the node it was acquired from.
+                node_id, need = lease
             view = self._nodes.get(node_id)
             if view is None:
                 return
@@ -119,6 +142,15 @@ class ClusterScheduler:
                 view.available[k] = min(view.total.get(k, 0.0),
                                         view.available.get(k, 0.0) + v)
             view.queue_depth = max(0, view.queue_depth - 1)
+
+    def outstanding_leases(self, node_id: Optional[NodeID] = None) -> int:
+        """Count of tokened leases (optionally for one node) — drill
+        assertions use this to prove the ledger drains to zero."""
+        with self._lock:
+            if node_id is None:
+                return len(self._leases)
+            return sum(1 for nid, _ in self._leases.values()
+                       if nid == node_id)
 
     def available(self, node_id: NodeID) -> Dict[str, float]:
         with self._lock:
@@ -163,6 +195,17 @@ class ClusterScheduler:
                         return strategy.node_id
                     if not strategy.soft:
                         return None  # feasible but busy: wait for capacity
+            if (strategy.kind == "NODE_ANTI_AFFINITY"
+                    and strategy.node_id is not None):
+                others = [(nid, v) for nid, v in candidates
+                          if nid != strategy.node_id]
+                if strategy.soft:
+                    # Prefer other nodes; the avoided node stays eligible
+                    # only when it is the sole feasible host.
+                    if any(_feasible(v.total, need) for _, v in others):
+                        candidates = others
+                else:
+                    candidates = others
             if strategy.kind == "NODE_LABEL" and strategy.labels:
                 candidates = [
                     (nid, v) for nid, v in candidates
@@ -223,7 +266,13 @@ class ClusterScheduler:
             pg.state = "CREATED"
 
     def return_placement_group(self, pg: PlacementGroupRecord) -> None:
+        """Release every reserved bundle. Idempotent: a second call
+        (user remove racing the node-death re-pend under a drill) sees
+        the bundles already cleared and no-ops, so pg-scoped resources
+        are credited back exactly once per reservation."""
         with self._lock:
+            if pg.state == "REMOVED":
+                return
             pgid = pg.pg_id.hex()
             for bundle in pg.bundles:
                 if bundle.node_id is None:

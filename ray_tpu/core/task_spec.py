@@ -31,7 +31,7 @@ class SchedulingStrategy:
     DEFAULT (hybrid pack/spread), SPREAD, node affinity, node labels,
     placement-group bundles.
     """
-    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | NODE_LABEL | PLACEMENT_GROUP
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | NODE_ANTI_AFFINITY | NODE_LABEL | PLACEMENT_GROUP
     node_id: Optional[NodeID] = None
     soft: bool = False
     # label selector: {key: value} exact-match requirements

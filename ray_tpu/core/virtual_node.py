@@ -1,0 +1,683 @@
+"""Virtual nodes: in-process lightweight cluster members for envelope drills.
+
+Capability parity with the reference's many-hundred-node control plane
+tested on one box (reference: the raylet/GCS scale axis —
+gcs_node_manager.h node table sized for hundreds of raylets). A
+``VirtualNode`` registers with the head over its REAL TCP listener with
+the REAL node-daemon handshake (``node_daemon.py`` wire protocol:
+AUTH preamble, NODE_REGISTER/REGISTERED, heartbeats, DISPATCH /
+TASK_DONE_FWD), so the head sees a genuine ``RemoteNode`` and every
+head-side path — scheduler ledger, heartbeat monitor, death reap,
+lineage reconstruction, recovery events — is exercised unmodified.
+
+What makes it *virtual* is the daemon side: no process, no worker pool,
+no shm arena. All nodes in a :class:`VirtualNodePool` share
+
+* ONE thread pool (``config.virtual_node_executor_threads``) that runs
+  dispatched tasks,
+* ONE :class:`~ray_tpu.core.object_transfer.ObjectServer` that serves
+  every node's store (riding the PR-8 IO loop, zero threads),
+* the process-wide IO loop for all sockets and heartbeat timers,
+
+so head-node thread count stays O(1) in node count: 64-128 virtual
+nodes cost two sockets each and nothing else. ``tests/
+test_cluster_envelope.py`` asserts that envelope; ``devtools/chaos.py``
+drives ``kill()`` / ``freeze()`` faults against these nodes.
+
+Intentional infidelities (documented, asserted nowhere):
+
+* task arguments that are not inline resolve through the driver's own
+  ``get`` (same process) instead of a worker-side GET_OBJECT round trip;
+* streaming tasks (``num_returns=-1``) are rejected;
+* a running task cannot be force-killed (threads), only queued ones
+  cancel — matching ``CANCEL_TASK`` best-effort semantics.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core import serialization
+from ray_tpu.core.config import get_config
+from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.protocol import (
+    MessageConnection,
+    connect_tcp,
+    parse_address,
+    send_frame,
+)
+from ray_tpu.core.task_spec import TaskSpec
+from ray_tpu.exceptions import ObjectStoreFullError, TaskError
+
+logger = logging.getLogger(__name__)
+
+
+class VirtualStore:
+    """Per-virtual-node object store: plain bytearrays behind a lock.
+
+    Implements both store contracts the transfer layer needs —
+    ObjectServer's serve side (``get_buffer``/``release``) and
+    ``pull_object``'s destination side (``contains``/``create``/
+    ``seal``/``delete``) — plus the packing helpers the node uses for
+    task results. Capacity is enforced at ``create`` so drills exercise
+    the spill path (``ObjectStoreFullError`` -> spill -> retry).
+    """
+
+    def __init__(self, capacity: int):
+        self._lock = threading.Lock()
+        self._bufs: Dict[ObjectID, bytearray] = {}
+        self._sealed: set = set()
+        self._capacity = capacity
+
+    # -- raw object ops (pull_object / ObjectServer contract) -----------
+    def create(self, object_id: ObjectID, size: int) -> memoryview:
+        with self._lock:
+            if object_id in self._bufs:
+                raise FileExistsError(object_id)
+            used = sum(len(b) for b in self._bufs.values())
+            if used + size > self._capacity:
+                raise ObjectStoreFullError(
+                    f"virtual store full: need {size} bytes, "
+                    f"{self._capacity - used} free")
+            buf = bytearray(size)
+            self._bufs[object_id] = buf
+        return memoryview(buf)
+
+    def seal(self, object_id: ObjectID) -> None:
+        with self._lock:
+            if object_id in self._bufs:
+                self._sealed.add(object_id)
+
+    def get_buffer(self, object_id: ObjectID,
+                   timeout_s: float = 0.0) -> Optional[memoryview]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if object_id in self._sealed:
+                    buf = self._bufs.get(object_id)
+                    if buf is not None:
+                        return memoryview(buf)
+                    return None
+                absent = object_id not in self._bufs
+            # unsealed (concurrent create) or absent: poll within timeout
+            if absent or time.monotonic() >= deadline:
+                return None
+            time.sleep(0.005)
+
+    def release(self, object_id: ObjectID) -> None:
+        pass  # bytearrays are GC-owned; no reader pins to drop
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._sealed
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._bufs.pop(object_id, None)
+            self._sealed.discard(object_id)
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._bufs.values())
+
+    def total_bytes(self) -> int:
+        return self._capacity
+
+    def sealed_ids(self) -> List[ObjectID]:
+        """Sealed objects, oldest first (dict order) — spill candidates."""
+        with self._lock:
+            return [oid for oid in self._bufs if oid in self._sealed]
+
+    # -- packing helpers -------------------------------------------------
+    def put_packed(self, object_id: ObjectID, packed: bytes) -> int:
+        dest = self.create(object_id, len(packed))
+        try:
+            dest[:] = packed
+        finally:
+            del dest
+        self.seal(object_id)
+        return len(packed)
+
+    def get_packed(self, object_id: ObjectID) -> Optional[bytes]:
+        with self._lock:
+            if object_id in self._sealed:
+                buf = self._bufs.get(object_id)
+                if buf is not None:
+                    return bytes(buf)
+        return None
+
+
+#: the virtual node an executor thread is currently running a task for.
+#: Virtual members share the head process, so without this, user code
+#: asking "where am I?" would see the head on every member.
+_EXEC_CTX = threading.local()
+
+
+def current_virtual_node_id() -> Optional[NodeID]:
+    """NodeID of the virtual node executing on this thread, if any."""
+    return getattr(_EXEC_CTX, "node_id", None)
+
+
+class _ActorCell:
+    """One virtual actor: instance + FIFO dispatch queue. Method tasks
+    drain in arrival (seq) order on the shared executor — at most one
+    drain job per cell is in flight, so ordering holds without a
+    dedicated thread."""
+
+    def __init__(self, actor_id: ActorID, instance: Any):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.queue: collections.deque = collections.deque()
+        self.running: Dict[TaskID, TaskSpec] = {}
+        self.active = False  # drain job submitted / running
+
+
+class VirtualNode:
+    """One virtual cluster member. Created via :class:`VirtualNodePool`.
+
+    ``kill()`` and ``freeze()``/``thaw()`` are the chaos-plane fault
+    surface: kill severs the control connection (EOF death at the
+    head), freeze withholds heartbeats and delays all other traffic —
+    like SIGSTOP on a daemon — until thaw or heartbeat-timeout death.
+    """
+
+    def __init__(self, pool: "VirtualNodePool",
+                 resources: Dict[str, float], labels: Dict[str, str],
+                 store_bytes: int):
+        cfg = get_config()
+        self.pool = pool
+        self.node_id = NodeID.from_random()
+        # synthetic stable worker identity for plain (non-actor) tasks
+        self.worker_id = WorkerID.from_random()
+        self.resources = dict(resources)
+        self.labels = dict(labels)
+        self.store = VirtualStore(store_bytes)
+        self.dead = False
+        self._frozen = False
+        self._frozen_in: List[bytes] = []   # inbound frames held by freeze
+        self._frozen_out: List[dict] = []   # outbound messages held
+        self._lock = threading.Lock()
+        self._actors: Dict[WorkerID, _ActorCell] = {}
+        self._pending: Dict[TaskID, tuple] = {}  # tid -> (future, spec)
+        self._hb_interval = cfg.heartbeat_interval_s
+        self._conn = self._register()
+        self.pool._io.call_later(self._hb_interval, self._hb_tick)
+
+    # --- wire -----------------------------------------------------------
+    def _register(self):
+        cfg = get_config()
+        host, port = parse_address(self.pool.head_address)
+        conn = MessageConnection(connect_tcp(host, port, timeout=30.0))
+        try:
+            if cfg.auth_token:
+                # plaintext auth frame BEFORE any pickled message
+                # (node_daemon._dial does the same)
+                send_frame(conn.sock, b"AUTH" + cfg.auth_token.encode("utf-8"))
+            from ray_tpu.core.protocol import PROTOCOL_MINOR, PROTOCOL_VERSION
+            conn.sock.settimeout(30.0)
+            conn.send({
+                "kind": "NODE_REGISTER",
+                "proto_version": PROTOCOL_VERSION,
+                "proto_minor": PROTOCOL_MINOR,
+                "node_id": self.node_id.binary(),
+                "resources": self.resources,
+                "labels": dict(self.labels),
+                "object_addr": [self.pool.object_host,
+                                self.pool.object_port],
+                "address": f"virtual:{os.getpid()}",
+                "actors": [],
+            })
+            reply = conn.recv()
+            if reply is None or reply.get("kind") != "REGISTERED":
+                reason = (reply or {}).get("reason", "connection closed")
+                raise RuntimeError(
+                    f"head rejected virtual node registration: {reason}")
+            conn.sock.settimeout(None)
+        except BaseException:
+            conn.close()
+            raise
+        # Steady state rides the shared IO loop: the raw socket is
+        # adopted by the loop (recv() reads exactly one frame, so no
+        # handshake bytes are buffered past this point) — zero threads
+        # per node from here on.
+        return self.pool._io.register(
+            conn.sock, self._on_frames, self._on_close,
+            label=f"vnode:{self.node_id.hex()[:8]}")
+
+    def _send(self, msg: dict) -> bool:
+        if self.dead:
+            return False
+        if self._frozen:
+            with self._lock:
+                if self._frozen:
+                    self._frozen_out.append(msg)
+                    return True
+        try:
+            self._conn.send(msg)
+            return True
+        except OSError:
+            return False
+
+    def _hb_tick(self) -> None:
+        if self.dead:
+            return
+        if not self._frozen:
+            try:
+                self._conn.send({"kind": "HEARTBEAT", "idle": 1,
+                                 "store_used": self.store.used_bytes()})
+            except OSError:
+                return  # connection gone; _on_close handles the rest
+        self.pool._io.call_later(self._hb_interval, self._hb_tick)
+
+    def _on_close(self, conn) -> None:
+        self.dead = True
+
+    def _on_frames(self, conn, frames) -> None:
+        for frame in frames:
+            if self._frozen:
+                with self._lock:
+                    if self._frozen:
+                        self._frozen_in.append(frame)
+                        continue
+            self._dispatch_frame(frame)
+
+    def _dispatch_frame(self, frame: bytes) -> None:
+        try:
+            msg = serialization.loads(frame)
+            self._handle(msg)
+        except Exception:  # noqa: BLE001 — keep the node link alive
+            traceback.print_exc()
+
+    # --- daemon protocol (node_daemon._handle mirror) --------------------
+    def _handle(self, msg: dict) -> None:
+        kind = msg["kind"]
+        if kind == "DISPATCH":
+            spec = serialization.loads(msg["spec"])
+            with self._lock:
+                fut = self.pool._executor.submit(self._run_plain, spec)
+                self._pending[spec.task_id] = (fut, spec)
+        elif kind == "DISPATCH_ACTOR":
+            self._dispatch_actor(WorkerID(msg["worker_id"]),
+                                 serialization.loads(msg["spec"]))
+        elif kind == "TO_WORKER":
+            pass  # vnode tasks resolve objects in-process, never via
+            # GET_OBJECT, so there is no worker to route payloads to
+        elif kind == "KILL_WORKER":
+            self._kill_worker(WorkerID(msg["worker_id"]))
+        elif kind == "PRESTART":
+            pass  # no worker pool to warm
+        elif kind == "DELETE_OBJECT":
+            oid = ObjectID(msg["object_id"])
+            self.store.delete(oid)
+            self.pool.delete_spilled(oid)
+        elif kind == "SPILL_OBJECTS":
+            self.pool._executor.submit(self._spill, msg)
+        elif kind == "CANCEL_TASK":
+            self._cancel_task(TaskID(msg["task_id"]))
+        elif kind == "STOP":
+            self.kill()
+        elif kind == "UNSUPPORTED":
+            pass  # answer to OUR probe; never re-answered (echo loop)
+        elif msg.get("req_id") is not None:
+            self._send({"kind": "UNSUPPORTED", "req_id": msg["req_id"],
+                        "unsupported_kind": kind})
+
+    def _dispatch_actor(self, worker_id: WorkerID, spec: TaskSpec) -> None:
+        with self._lock:
+            cell = self._actors.get(worker_id)
+            if cell is not None:
+                cell.queue.append(spec)
+                if not cell.active:
+                    cell.active = True
+                    self.pool._executor.submit(self._drain_actor,
+                                               worker_id, cell)
+                return
+        self._send({"kind": "ACTOR_DISPATCH_FAILED",
+                    "spec": serialization.dumps_fast(spec)})
+
+    def _kill_worker(self, worker_id: WorkerID) -> None:
+        with self._lock:
+            cell = self._actors.pop(worker_id, None)
+            if cell is None:
+                return
+            running = list(cell.running.values())
+            cell.queue.clear()
+        self._send({"kind": "WORKER_CRASHED_FWD",
+                    "worker_id": worker_id.binary(),
+                    "running": [serialization.dumps_fast(s)
+                                for s in running],
+                    "actor_id": cell.actor_id.binary()})
+
+    def _cancel_task(self, task_id: TaskID) -> None:
+        with self._lock:
+            entry = self._pending.get(task_id)
+        if entry is None:
+            return
+        fut, spec = entry
+        if fut.cancel():
+            with self._lock:
+                self._pending.pop(task_id, None)
+            self._send({"kind": "TASK_CANCELLED_FWD",
+                        "spec": serialization.dumps_fast(spec)})
+        # else: already running — threads can't be force-killed; the
+        # head's force path falls back to node-level recovery
+
+    def _spill(self, msg: dict) -> None:
+        from ray_tpu.core.object_store import spill_objects
+        needed = int(msg.get("bytes", 0)) or 1
+        wanted = [ObjectID(b) for b in msg.get("object_ids", ())]
+        results = spill_objects(self.store, self.pool.spill_dir,
+                                wanted or self.store.sealed_ids(), needed)
+        self._send({"kind": "SPILLED",
+                    "results": [(oid.binary(), path, size)
+                                for oid, path, size in results],
+                    "freed": sum(size for _, _, size in results),
+                    "reply_worker": msg.get("reply_worker"),
+                    "req_id": msg.get("req_id")})
+
+    # --- task execution (worker._execute mirror) -------------------------
+    def _run_plain(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._pending.pop(spec.task_id, None)
+        self._run_task(spec, self.worker_id)
+
+    def _drain_actor(self, worker_id: WorkerID, cell: _ActorCell) -> None:
+        while True:
+            with self._lock:
+                if self._actors.get(worker_id) is not cell or not cell.queue:
+                    cell.active = False
+                    return
+                spec = cell.queue.popleft()
+                cell.running[spec.task_id] = spec
+            try:
+                self._run_task(spec, worker_id, cell=cell)
+            finally:
+                with self._lock:
+                    cell.running.pop(spec.task_id, None)
+
+    def _run_task(self, spec: TaskSpec, worker_id: WorkerID,
+                  cell: Optional[_ActorCell] = None) -> None:
+        # the shared executor thread impersonates this member for the
+        # duration of the call, so user code introspecting its placement
+        # (get_runtime_context().get_node_id()) sees the virtual node
+        _EXEC_CTX.node_id = self.node_id
+        try:
+            self._run_task_on_node(spec, worker_id, cell)
+        finally:
+            _EXEC_CTX.node_id = None
+
+    def _run_task_on_node(self, spec: TaskSpec, worker_id: WorkerID,
+                          cell: Optional[_ActorCell] = None) -> None:
+        if self.dead:
+            return
+        reply: dict = {"kind": "TASK_DONE",
+                       "task_id": spec.task_id.binary(),
+                       "spec_is_actor_creation": spec.is_actor_creation,
+                       "t_start": time.time()}
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if spec.is_actor_creation:
+                cls = self.pool.get_function(spec.function_id)
+                instance = cls(*args, **kwargs)
+                new_wid = WorkerID.from_random()
+                with self._lock:
+                    self._actors[new_wid] = _ActorCell(spec.actor_id,
+                                                       instance)
+                worker_id = new_wid
+                result_values = [None]
+            else:
+                if spec.num_returns == -1:
+                    raise RuntimeError(
+                        "virtual nodes do not support streaming tasks "
+                        "(num_returns=-1); run them on a real node")
+                result = self._call_target(spec, cell, args, kwargs)
+                result_values = _split_returns(result, spec.num_returns)
+            results = []
+            for oid, value in zip(spec.return_ids(), result_values):
+                results.append(self._pack_result(oid, value))
+            reply["results"] = results
+            reply["error"] = None
+        except Exception:  # noqa: BLE001 — user code may raise anything
+            tb = traceback.format_exc()
+            import sys
+            exc = sys.exc_info()[1]
+            try:
+                blob = serialization.dumps(
+                    TaskError(spec.name or spec.function_id, tb, exc))
+            except Exception:  # noqa: BLE001 — unpicklable user exception
+                blob = serialization.dumps(
+                    TaskError(spec.name or spec.function_id, tb, None))
+            reply["results"] = []
+            reply["error"] = blob
+            reply["error_str"] = tb
+        reply["t_end"] = time.time()
+        self._send({"kind": "TASK_DONE_FWD",
+                    "worker_id": worker_id.binary(),
+                    "spec": serialization.dumps_fast(spec),
+                    "msg": reply})
+
+    def _call_target(self, spec: TaskSpec, cell: Optional[_ActorCell],
+                     args, kwargs) -> Any:
+        if cell is not None and spec.actor_id is not None:
+            if spec.method_name == "__ray_call__":
+                fn = args[0]
+                return fn(cell.instance, *args[1:], **kwargs)
+            return getattr(cell.instance, spec.method_name)(*args, **kwargs)
+        fn = self.pool.get_function(spec.function_id)
+        return fn(*args, **kwargs)
+
+    def _resolve_args(self, spec: TaskSpec):
+        args = [self._resolve_arg(a) for a in spec.args]
+        kwargs = {k: self._resolve_arg(a) for k, a in spec.kwargs.items()}
+        return args, kwargs
+
+    def _resolve_arg(self, arg) -> Any:
+        if arg.value_bytes is not None:
+            return serialization.unpack(arg.value_bytes)
+        oid = arg.object_id
+        packed = self.store.get_packed(oid)
+        if packed is not None:
+            return serialization.unpack(packed)
+        # Same process as the driver: resolve through the owner directly
+        # (pulls/reconstruction included) instead of a GET_OBJECT round
+        # trip a real worker would make.
+        return self.pool.driver_get(oid)
+
+    def _pack_result(self, oid: ObjectID, value: Any) -> tuple:
+        with serialization.collect_contained_refs() as contained:
+            data, buffers = serialization.serialize(value)
+        contained_bin = [o.binary() for o in contained]
+        if not buffers and len(data) < get_config().max_inline_object_size:
+            return (oid.binary(), "inline",
+                    serialization.pack_parts(data, buffers), contained_bin)
+        sizes = [b.nbytes for b in buffers]
+        packed_len = serialization.packed_size(data, sizes)
+        self._store_with_spill(oid, data, buffers, sizes, packed_len)
+        return (oid.binary(), "shm", None, contained_bin)
+
+    def _store_with_spill(self, oid: ObjectID, data, buffers, sizes,
+                          packed_len: int) -> None:
+        """Pack a result into the store; on pressure, spill the oldest
+        sealed objects to disk (reporting SPILLED so the head re-points
+        their locations) and retry once."""
+        for attempt in (0, 1):
+            try:
+                dest = self.store.create(oid, packed_len)
+                try:
+                    serialization.pack_into(dest, data, buffers, sizes)
+                finally:
+                    del dest
+                self.store.seal(oid)
+                return
+            except ObjectStoreFullError:
+                if attempt:
+                    raise
+                self._spill({"bytes": packed_len})
+
+    # --- chaos fault surface ---------------------------------------------
+    def freeze(self) -> None:
+        """Suspend the node, SIGSTOP-style: heartbeats stop, inbound and
+        outbound control traffic is held (not dropped). The head
+        declares the node dead after ``heartbeat_timeout_s``."""
+        self._frozen = True
+
+    def thaw(self) -> None:
+        """Resume a frozen node, delivering traffic held during the
+        freeze (both directions) in order."""
+        with self._lock:
+            if not self._frozen:
+                return
+            self._frozen = False
+            inbound = self._frozen_in
+            outbound = self._frozen_out
+            self._frozen_in = []
+            self._frozen_out = []
+        for msg in outbound:
+            if self.dead:
+                break
+            try:
+                self._conn.send(msg)
+            except OSError:
+                break
+        if inbound:
+            # inbound frames were captured on the loop thread; replay
+            # them there so handler threading invariants hold
+            def _replay():
+                for frame in inbound:
+                    if self.dead:
+                        return
+                    self._dispatch_frame(frame)
+            self.pool._io.call_soon(_replay)
+
+    def kill(self) -> None:
+        """Sever the control connection abruptly (process-kill analog).
+        The head observes EOF and runs its node-death path."""
+        self.dead = True
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+class VirtualNodePool:
+    """Shared substrate for a fleet of virtual nodes: one executor, one
+    object server, one spill directory, one function cache. Thread and
+    socket cost is O(nodes) sockets but O(1) threads."""
+
+    def __init__(self, head_address: str,
+                 spill_dir: Optional[str] = None):
+        import tempfile
+
+        from ray_tpu.core.io_loop import get_io_loop
+        from ray_tpu.core.object_transfer import ObjectServer
+        cfg = get_config()
+        self.head_address = head_address
+        self._io = get_io_loop()
+        self._executor = ThreadPoolExecutor(
+            max_workers=cfg.virtual_node_executor_threads,
+            thread_name_prefix="vnode-exec")
+        self.spill_dir = spill_dir or tempfile.mkdtemp(prefix="rtpu_vnode_")
+        self.nodes: List[VirtualNode] = []
+        self._nodes_lock = threading.Lock()
+        self._fn_cache: Dict[str, Any] = {}
+        self._server = ObjectServer(self._resolve, host=cfg.head_host)
+        self.object_host, self.object_port = self._server.address
+
+    # --- node lifecycle --------------------------------------------------
+    def start_node(self, resources: Optional[Dict[str, float]] = None,
+                   labels: Optional[Dict[str, str]] = None,
+                   store_bytes: Optional[int] = None) -> VirtualNode:
+        cfg = get_config()
+        resources = dict(resources or {})
+        resources.setdefault("CPU", 1.0)
+        node = VirtualNode(self, resources, dict(labels or {}),
+                           store_bytes or cfg.virtual_node_store_bytes)
+        with self._nodes_lock:
+            self.nodes.append(node)
+        return node
+
+    def start_nodes(self, count: int, **kw) -> List[VirtualNode]:
+        return [self.start_node(**kw) for _ in range(count)]
+
+    def node_by_id(self, node_id: NodeID) -> Optional[VirtualNode]:
+        with self._nodes_lock:
+            for node in self.nodes:
+                if node.node_id == node_id:
+                    return node
+        return None
+
+    def live_nodes(self) -> List[VirtualNode]:
+        with self._nodes_lock:
+            return [n for n in self.nodes if not n.dead]
+
+    def shutdown(self) -> None:
+        with self._nodes_lock:
+            nodes = list(self.nodes)
+            self.nodes.clear()
+        for node in nodes:
+            node.kill()
+        self._executor.shutdown(wait=False)
+        self._server.stop()
+
+    # --- shared services -------------------------------------------------
+    def _resolve(self, oid: ObjectID):
+        """ObjectServer callback: find any node's store (or a spill
+        file) holding ``oid`` — one server fronts the whole pool."""
+        with self._nodes_lock:
+            nodes = list(self.nodes)
+        for node in nodes:
+            # a killed member's memory died with it — serving its store
+            # would let fetches dodge lineage reconstruction. (A frozen
+            # member still serves: SIGSTOP keeps host memory intact.)
+            if not node.dead and node.store.contains(oid):
+                return node.store
+        path = os.path.join(self.spill_dir, oid.hex())
+        if os.path.exists(path):
+            return ("file", path)
+        return None
+
+    def delete_spilled(self, oid: ObjectID) -> None:
+        path = os.path.join(self.spill_dir, oid.hex())
+        if os.path.exists(path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def get_function(self, function_id: str):
+        fn = self._fn_cache.get(function_id)
+        if fn is None:
+            from ray_tpu.core import runtime as runtime_mod
+            rt = runtime_mod.get_runtime()
+            blob = rt.gcs.get_function(function_id)
+            if blob is None:
+                raise RuntimeError(
+                    f"function {function_id} not found in GCS")
+            fn = serialization.loads(blob)
+            # benign race: concurrent misses deserialize the same blob
+            self._fn_cache[function_id] = fn  # graftlint: disable=GL001
+        return fn
+
+    def driver_get(self, oid: ObjectID, timeout: float = 60.0) -> Any:
+        from ray_tpu.core import runtime as runtime_mod
+        from ray_tpu.core.object_ref import ObjectRef
+        rt = runtime_mod.get_runtime()
+        return rt.get(ObjectRef(oid), timeout=timeout)
+
+
+def _split_returns(result: Any, num_returns: int) -> List[Any]:
+    if num_returns == 1:
+        return [result]
+    result = list(result)
+    if len(result) != num_returns:
+        raise ValueError(
+            f"task declared num_returns={num_returns} but returned "
+            f"{len(result)} values")
+    return result

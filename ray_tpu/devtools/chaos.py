@@ -1,0 +1,393 @@
+"""Deterministic fault injection for cluster envelope drills.
+
+Capability parity with the reference's chaos tooling (reference:
+src/ray/common/test/testing chaos hooks + the nightly chaos-test suite
+killing raylets on a schedule). A :class:`ChaosSchedule` is an explicit,
+seed-reproducible timeline of :class:`ChaosFault` entries; a
+:class:`ChaosController` executes it against live cluster members —
+virtual nodes (``core/virtual_node.py``), daemon subprocesses (via
+:class:`DaemonHandle`), or the head's in-process workers — from ONE
+timer thread.
+
+Every injected fault is recorded as a ``CHAOS_INJECTED`` cluster event
+*before* the fault lands, and its seq is stashed on the head-side node
+object (``_chaos_cause_seq`` for node faults, ``_chaos_worker_causes``
+for worker kills), so the death events the fault triggers chain to it
+via ``caused_by`` and ``devtools/recovery.py`` attributes each incident
+to its injected root cause::
+
+    CHAOS_INJECTED -> NODE_DEAD                      (kill drill)
+    CHAOS_INJECTED -> NODE_HEARTBEAT_MISS -> NODE_DEAD  (freeze drill)
+    CHAOS_INJECTED -> WORKER_EXIT                    (worker kill)
+
+Fault vocabulary (``ChaosFault.kind``):
+
+==============  ========================================================
+kind            effect on the target node
+==============  ========================================================
+kill_node       sever/SIGKILL — abrupt EOF death at the head
+freeze_node     SIGSTOP analog — heartbeats stop, traffic held; the
+                head declares death after ``heartbeat_timeout_s``
+thaw_node       resume a frozen node (SIGCONT analog)
+kill_worker     kill one worker/actor process on the node
+shrink_store    multiply the node's object-store capacity by
+                ``factor`` (spill-pressure drill; virtual nodes only)
+delay_wire      install a codec shim delaying inbound frames by
+                ``delay_s`` on NEW connections (``io_loop._codec_wrapper``)
+drop_wire       codec shim dropping inbound frames with probability
+                ``drop_p`` (seeded) on NEW connections
+clear_wire      remove any installed codec shim
+==============  ========================================================
+
+Schedules serialize to/from plain dicts (JSON-ready) so drills can pin
+them in fixtures; ``ChaosSchedule.from_seed`` derives a reproducible
+kill/freeze mix from one integer.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+FAULT_KINDS = ("kill_node", "freeze_node", "thaw_node", "kill_worker",
+               "shrink_store", "delay_wire", "drop_wire", "clear_wire")
+
+
+@dataclass
+class ChaosFault:
+    """One timed fault. ``target`` indexes the controller's target
+    list (int) — stable across runs for a fixed schedule — or names a
+    node id hex prefix (str). Wire faults need no target."""
+
+    at_s: float
+    kind: str
+    target: Optional[Any] = None
+    args: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"at_s": self.at_s, "kind": self.kind,
+                "target": self.target, "args": dict(self.args)}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChaosFault":
+        return cls(at_s=float(d["at_s"]), kind=d["kind"],
+                   target=d.get("target"), args=dict(d.get("args") or {}))
+
+
+@dataclass
+class ChaosSchedule:
+    """An ordered fault timeline (relative to controller start)."""
+
+    faults: List[ChaosFault] = field(default_factory=list)
+    seed: Optional[int] = None
+
+    def __post_init__(self):
+        self.faults.sort(key=lambda f: f.at_s)
+        for fault in self.faults:
+            if fault.kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind: {fault.kind!r}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed,
+                "faults": [f.to_dict() for f in self.faults]}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ChaosSchedule":
+        return cls(faults=[ChaosFault.from_dict(f)
+                           for f in d.get("faults", ())],
+                   seed=d.get("seed"))
+
+    @classmethod
+    def from_seed(cls, seed: int, *, n_targets: int, duration_s: float,
+                  kills: int = 1, freezes: int = 0,
+                  worker_kills: int = 0,
+                  start_s: float = 0.1) -> "ChaosSchedule":
+        """Derive a reproducible schedule: ``kills``/``freezes``/
+        ``worker_kills`` faults spread uniformly over ``duration_s``
+        against distinct targets drawn without replacement (so a node
+        is not killed twice)."""
+        rng = random.Random(seed)
+        total = kills + freezes + worker_kills
+        if total > n_targets:
+            raise ValueError(
+                f"{total} faults need {total} distinct targets, "
+                f"have {n_targets}")
+        targets = rng.sample(range(n_targets), total)
+        times = sorted(rng.uniform(start_s, duration_s)
+                       for _ in range(total))
+        kinds = (["kill_node"] * kills + ["freeze_node"] * freezes
+                 + ["kill_worker"] * worker_kills)
+        rng.shuffle(kinds)
+        return cls(faults=[ChaosFault(at_s=t, kind=k, target=i)
+                           for t, k, i in zip(times, kinds, targets)],
+                   seed=seed)
+
+
+class DaemonHandle:
+    """Adapter presenting a real node-daemon subprocess as a chaos
+    target: kill/freeze/thaw map to SIGKILL/SIGSTOP/SIGCONT."""
+
+    def __init__(self, node_id, proc):
+        self.node_id = node_id
+        self.proc = proc
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except ProcessLookupError:
+            pass
+
+    def freeze(self) -> None:
+        import signal
+        try:
+            self.proc.send_signal(signal.SIGSTOP)
+        except ProcessLookupError:
+            pass
+
+    def thaw(self) -> None:
+        import signal
+        try:
+            self.proc.send_signal(signal.SIGCONT)
+        except ProcessLookupError:
+            pass
+
+
+class ChaosCodec:
+    """Codec shim injecting wire faults on the inbound path. Wraps the
+    real codec chosen by ``io_loop._make_codec``; outbound passes
+    through untouched. ``delay_s`` holds decoded frames until their
+    release time (delivered on a later read — delivery granularity is
+    the socket's read cadence, fine for drills); ``drop_p`` drops
+    frames with seeded probability."""
+
+    def __init__(self, inner, delay_s: float = 0.0, drop_p: float = 0.0,
+                 rng: Optional[random.Random] = None):
+        self._inner = inner
+        self.native = getattr(inner, "native", False)
+        self._delay_s = delay_s
+        self._drop_p = drop_p
+        self._rng = rng or random.Random(0)
+        self._held: List[Tuple[float, bytes]] = []
+
+    def read(self, sock):
+        frames, status = self._inner.read(sock)
+        if self._drop_p > 0.0:
+            frames = [f for f in frames
+                      if self._rng.random() >= self._drop_p]
+        if self._delay_s > 0.0:
+            now = time.monotonic()
+            self._held.extend((now + self._delay_s, f) for f in frames)
+            ready = []
+            while self._held and self._held[0][0] <= now:
+                ready.append(self._held.pop(0)[1])
+            frames = ready
+        return frames, status
+
+    # outbound/writer surface: pure pass-through
+    def enqueue(self, payload):
+        return self._inner.enqueue(payload)
+
+    def flush(self, sock):
+        return self._inner.flush(sock)
+
+    def queued(self):
+        return self._inner.queued()
+
+    def feed(self, data):
+        return self._inner.feed(data)
+
+    def leftover(self):
+        return self._inner.leftover()
+
+
+class ChaosController:
+    """Executes a :class:`ChaosSchedule` against live targets.
+
+    ``targets`` is an ordered list of handles — any object with
+    ``node_id`` plus ``kill()``/``freeze()``/``thaw()``
+    (:class:`~ray_tpu.core.virtual_node.VirtualNode`,
+    :class:`DaemonHandle`) — or head-side NodeIDs for in-process nodes
+    (kill_node then maps to ``runtime.remove_node``). One daemon thread
+    walks the timeline; ``injected`` collects ``(fault, seq,
+    node_id_hex)`` for drill assertions.
+    """
+
+    def __init__(self, runtime, schedule: ChaosSchedule,
+                 targets: List[Any]):
+        self.runtime = runtime
+        self.schedule = schedule
+        self.targets = list(targets)
+        self.injected: List[Tuple[ChaosFault, Optional[int],
+                                  Optional[str]]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle -------------------------------------------------------
+    def start(self) -> "ChaosController":
+        self._thread = threading.Thread(target=self._run,
+                                        name="chaos-controller",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def run_sync(self) -> None:
+        """Execute the whole schedule on the calling thread."""
+        self._run()
+
+    def __enter__(self) -> "ChaosController":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+        self.join(timeout=5.0)
+        clear_wire_faults()
+
+    # --- execution -------------------------------------------------------
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for fault in self.schedule.faults:
+            delay = fault.at_s - (time.monotonic() - t0)
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                self._inject(fault)
+            except Exception:  # noqa: BLE001 — a failed injection must
+                # not kill the drill thread mid-schedule
+                import traceback
+                traceback.print_exc()
+
+    def _resolve(self, fault: ChaosFault):
+        """(handle, head_node_obj, node_id) for the fault's target."""
+        target = fault.target
+        handle = None
+        if isinstance(target, int):
+            if not self.targets:
+                return None, None, None
+            handle = self.targets[target % len(self.targets)]
+        elif isinstance(target, str):
+            for cand in self.targets:
+                if cand.node_id.hex().startswith(target):
+                    handle = cand
+                    break
+            if handle is None:
+                return None, None, None
+        elif target is not None:
+            handle = target
+        if handle is None:
+            return None, None, None
+        node_id = getattr(handle, "node_id", handle)
+        return handle, self.runtime.nodes.get(node_id), node_id
+
+    def _emit(self, fault: ChaosFault, node_id,
+              extra: Optional[dict] = None) -> Optional[int]:
+        data = {"fault": fault.kind, "at_s": round(fault.at_s, 3)}
+        if self.schedule.seed is not None:
+            data["seed"] = self.schedule.seed
+        if extra:
+            data.update(extra)
+        seq = self.runtime.gcs.add_cluster_event(
+            "CHAOS_INJECTED", "WARNING", node_id=node_id,
+            message=f"injected {fault.kind}", data=data)
+        self.injected.append(
+            (fault, seq, node_id.hex() if node_id is not None else None))
+        return seq
+
+    def _inject(self, fault: ChaosFault) -> None:
+        kind = fault.kind
+        if kind in ("delay_wire", "drop_wire", "clear_wire"):
+            self._emit(fault, None, dict(fault.args))
+            if kind == "clear_wire":
+                clear_wire_faults()
+            else:
+                install_wire_faults(
+                    delay_s=float(fault.args.get("delay_s", 0.0)),
+                    drop_p=float(fault.args.get("drop_p", 0.0)),
+                    seed=self.schedule.seed or 0)
+            return
+        handle, head_node, node_id = self._resolve(fault)
+        if handle is None:
+            return
+        # Record BEFORE injecting: the death observers read the stashed
+        # seq when the fault lands, never before.
+        seq = self._emit(fault, node_id)
+        if kind in ("kill_node", "freeze_node"):
+            if head_node is not None:
+                head_node._chaos_cause_seq = seq
+        if kind == "kill_node":
+            if hasattr(handle, "kill"):
+                handle.kill()
+            else:
+                self.runtime.remove_node(node_id)
+        elif kind == "freeze_node":
+            handle.freeze()
+        elif kind == "thaw_node":
+            handle.thaw()
+        elif kind == "kill_worker":
+            self._kill_worker(handle, head_node, node_id, seq)
+        elif kind == "shrink_store":
+            store = getattr(handle, "store", None)
+            if store is not None and hasattr(store, "_capacity"):
+                factor = float(fault.args.get("factor", 0.5))
+                store._capacity = max(1, int(store._capacity * factor))
+
+    def _kill_worker(self, handle, head_node, node_id, seq) -> None:
+        """Kill one worker on the target node, stashing the cause seq
+        where the matching WORKER_EXIT emit site will find it."""
+        # virtual node: actor cells are its only long-lived workers
+        actors = getattr(handle, "_actors", None)
+        if actors is not None:
+            with handle._lock:
+                wids = list(actors)
+            if not wids or head_node is None:
+                return
+            wid = wids[0]
+            causes = getattr(head_node, "_chaos_worker_causes", None)
+            if causes is None:
+                causes = head_node._chaos_worker_causes = {}
+            causes[wid] = seq
+            head_node.kill_worker(wid)
+            return
+        # in-process node: pick a live worker handle, tag it, kill it
+        node = self.runtime.nodes.get(node_id)
+        workers = getattr(node, "_workers", None)
+        if not workers:
+            return
+        with node._lock:
+            items = list(workers.items())
+        for wid, worker in items:
+            worker._chaos_cause_seq = seq
+            node.kill_worker(wid)
+            return
+
+
+# --- wire-fault installation (io_loop._codec_wrapper seam) --------------
+
+def install_wire_faults(delay_s: float = 0.0, drop_p: float = 0.0,
+                        seed: int = 0) -> None:
+    """Install a :class:`ChaosCodec` shim for NEW connections. Existing
+    connections keep their codec — point drills at reconnect paths or
+    install before dialing."""
+    from ray_tpu.core import io_loop
+    rng = random.Random(seed)
+
+    def wrapper(inner):
+        return ChaosCodec(inner, delay_s=delay_s, drop_p=drop_p, rng=rng)
+
+    io_loop._codec_wrapper = wrapper
+
+
+def clear_wire_faults() -> None:
+    from ray_tpu.core import io_loop
+    io_loop._codec_wrapper = None

@@ -13,7 +13,11 @@ Runs, in order, everything a reviewer would otherwise run by hand:
 4. **refsan** — the object-lifetime sanitizer's fold over a seeded
    leak/double-release fixture (must fire), then the smoke run with
    ``RAY_TPU_REFSAN=1`` (must report zero ledger findings).
-5. **stress** — the native shm stress binary, plain plus ASan/TSan
+5. **chaos** — an 8-virtual-node drill (core/virtual_node.py +
+   devtools/chaos.py): one seeded node kill mid-fanout; every task
+   must still complete and the recovery report must fold exactly one
+   incident attributed to the injected fault.
+6. **stress** — the native shm stress binary, plain plus ASan/TSan
    variants when the toolchain on this image can link them; each
    missing sanitizer is a clean SKIP, not a failure.
 
@@ -394,6 +398,90 @@ def step_refsan() -> Tuple[str, str]:
     return "ok", "seeded fixture fired; clean smoke reported 0 findings"
 
 
+# Chaos drill smoke: 8 virtual nodes, a sustained fan-out, one SEEDED
+# node kill landing mid-flight. Asserts every task still completes
+# (retry/reconstruction), the recovery report folds exactly one
+# NODE_DEAD incident, and that incident's precursor is the injected
+# CHAOS_INJECTED event (causal attribution end to end). Actor-free.
+_CHAOS_SRC = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.devtools.chaos import ChaosSchedule, ChaosController
+from ray_tpu.devtools import recovery
+
+cluster = Cluster(system_config={"head_port": 0})
+try:
+    cluster.add_virtual_nodes(8, resources={"CPU": 1.0})
+    pool = cluster.virtual_pool
+
+    @ray_tpu.remote
+    def produce(i):
+        time.sleep(0.05)
+        return i * 3
+
+    @ray_tpu.remote
+    def consume(x):
+        return x + 1
+
+    refs = [consume.remote(produce.remote(i)) for i in range(64)]
+    sched = ChaosSchedule.from_seed(
+        7, n_targets=8, duration_s=0.3, kills=1, start_s=0.15)
+    ctrl = ChaosController(cluster.runtime, sched,
+                           targets=pool.live_nodes())
+    ctrl.run_sync()
+    assert len(ctrl.injected) == 1, ctrl.injected
+
+    got = ray_tpu.get(refs, timeout=90)
+    assert got == [i * 3 + 1 for i in range(64)], "lost results"
+
+    report = recovery.recovery_report()
+    incs = [i for i in report["incidents"]
+            if i["root_kind"] == "NODE_DEAD"]
+    assert len(incs) == 1, (
+        f"expected one NODE_DEAD incident, got {len(incs)}")
+    pre = incs[0]["precursor"] or {}
+    assert pre.get("kind") == "CHAOS_INJECTED", (
+        f"kill not attributed to injection: {pre}")
+    counts = report["counts"]
+    assert counts.get("TASK_RETRY", 0) + counts.get(
+        "RECONSTRUCT_DONE", 0) > 0, f"no recovery activity: {counts}"
+    print("CHAOS-OK")
+finally:
+    cluster.shutdown()
+"""
+
+
+def step_chaos() -> Tuple[str, str]:
+    """8-virtual-node seeded kill drill: tasks survive, one attributed
+    incident in the recovery report."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix="_rtpu_chaos.py", delete=False) as f:
+        f.write(_CHAOS_SRC)
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, path], env=env,
+            capture_output=True, text=True, timeout=180)
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    out = (proc.stdout or "") + (proc.stderr or "")
+    if proc.returncode == 0 and "CHAOS-OK" in proc.stdout:
+        return "ok", ("8 vnodes, seeded kill mid-fanout: 64/64 tasks, "
+                      "1 attributed incident")
+    return "FAIL", out[-4000:]
+
+
 _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("lint", step_lint),
     ("events", step_events),
@@ -401,6 +489,7 @@ _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("podracer", step_podracer),
     ("recorder", step_recorder),
     ("refsan", step_refsan),
+    ("chaos", step_chaos),
     ("locktrace", step_locktrace),
     ("threadguard", step_threadguard),
     ("stress", step_stress),

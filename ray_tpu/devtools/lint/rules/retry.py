@@ -1,0 +1,101 @@
+"""Retry-loop rules (GL019).
+
+The static half of the backoff unification (``util/backoff.py``): a
+retry loop that re-enters itself from an except handler without any
+bounded wait spins hot on a dead link, and a fleet of them (128 node
+daemons redialing a restarted head) synchronizes into a thundering
+herd. Every such loop must pace itself — ``Backoff``/``jittered`` from
+``util/backoff.py``, an Event ``wait``, or at minimum a ``sleep``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.devtools.lint.annotate import _dotted
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+from ray_tpu.devtools.lint.callgraph import _leaf
+
+#: a call to any of these (leaf name) paces the loop: stdlib sleeps,
+#: Event/Condition waits, selector/socket readiness blocking, and the
+#: util/backoff surface
+_WAIT_CALLS = {"sleep", "wait", "wait_for", "next_delay", "jittered",
+               "Backoff", "select"}
+
+_LOOPS = (ast.While, ast.For)
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _iter_same_loop(loop: ast.While, include_test: bool = True):
+    """Nodes belonging to THIS loop iteration: the body (and test)
+    without descending into nested loops or function definitions — a
+    wait or continue in those does not pace/re-enter this loop."""
+    stack: list = list(loop.body) + list(loop.orelse)
+    if include_test:
+        stack.append(loop.test)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _LOOPS + _FUNCS):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _handler_reenters(handler: ast.ExceptHandler) -> bool:
+    """True when the except handler can re-enter the loop: an explicit
+    ``continue`` at this loop's level (not inside a nested loop)."""
+    stack: list = list(handler.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Continue):
+            return True
+        if isinstance(node, _LOOPS + _FUNCS):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _loop_waits(loop: ast.While) -> bool:
+    for node in _iter_same_loop(loop):
+        if not isinstance(node, ast.Call):
+            continue
+        if _leaf(_dotted(node.func)) in _WAIT_CALLS:
+            return True
+        # any blocking call given an explicit timeout paces the loop
+        # (queue.put(timeout=...), gcs_call(timeout=...), ...)
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            return True
+    return False
+
+
+@register
+class UnboundedRetry(Rule):
+    id = "GL019"
+    name = "unbounded-retry"
+    rationale = ("a retry loop whose except handler re-enters it with "
+                 "no sleep/wait/backoff anywhere in the loop spins hot "
+                 "on a persistent failure, and a fleet of identical "
+                 "loops (node daemons redialing a restarted head) "
+                 "synchronizes into a thundering herd — pace the loop "
+                 "with ray_tpu.util.backoff (Backoff.wait/next_delay or "
+                 "jittered), an Event wait, or a deadline-bounded sleep")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While):
+                continue
+            reenters = any(
+                _handler_reenters(h)
+                for sub in _iter_same_loop(node, include_test=False)
+                if isinstance(sub, ast.Try)
+                for h in sub.handlers)
+            if not reenters or _loop_waits(node):
+                continue
+            yield Finding(
+                rule=self.id, path=ctx.path, line=node.lineno,
+                col=node.col_offset,
+                message="retry loop re-enters from its except handler "
+                        "with no backoff, wait, or sleep — pace it via "
+                        "ray_tpu.util.backoff",
+                scope=getattr(node, "_gl_scope", "<module>"))

@@ -417,15 +417,25 @@ class _Flusher(threading.Thread):
         self._sent += len(events)
 
     def run(self) -> None:
+        from ray_tpu.util.backoff import Backoff
+
+        # Failed pushes back off with jitter (util/backoff.py) instead
+        # of re-hammering a struggling control channel every interval.
+        backoff = Backoff(initial_s=self._interval,
+                          max_s=8 * self._interval)
         failures = 0
-        while not self._stop.wait(self._interval):
+        delay = self._interval
+        while not self._stop.wait(delay):
             try:
                 self.flush_once()
                 failures = 0
+                backoff.reset()
+                delay = self._interval
             except Exception:  # noqa: BLE001 — channel gone at shutdown
                 failures += 1
                 if failures >= 3:
                     return
+                delay = backoff.next_delay()
 
     def stop(self) -> None:
         self._stop.set()

@@ -59,6 +59,7 @@ from ray_tpu.core import runtime as runtime_mod
 from ray_tpu.core import serialization
 from ray_tpu.exceptions import GetTimeoutError
 from ray_tpu.util import flight_recorder as _flight
+from ray_tpu.util.backoff import jittered
 
 logger = logging.getLogger(__name__)
 
@@ -129,7 +130,9 @@ def _kv_wait(key: str, timeout: float, what: Optional[str] = None) -> bytes:
                 f"collective rendezvous timed out after {timeout:.1f}s "
                 f"waiting for {who}; that rank likely died or never "
                 f"entered the same collective round (key {key!r})")
-        slice_s = min(chunk, remaining)
+        # Jitter each re-arm slice (util/backoff.py) so a whole gang
+        # re-registering after a head hiccup staggers its kv_wait storm.
+        slice_s = min(jittered(chunk, jitter=0.25), remaining)
         if rt.is_driver:
             value = rt.gcs.kv.wait(key.encode(), namespace="collective",
                                    timeout=slice_s)

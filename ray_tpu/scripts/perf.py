@@ -334,6 +334,59 @@ def _compare_wire(n: int) -> list:
              "native_default": io_loop_mod.use_native_wire()}]
 
 
+def bench_envelope(ns=(16, 64, 128), tasks_per_node: int = 16) -> dict:
+    """Cluster-envelope scaling (ISSUE 17): scheduling throughput,
+    head-process thread count, and RSS as virtual node count grows.
+    Virtual nodes register over the head's real TCP listener but share
+    one executor and one object server (core/virtual_node.py), so the
+    numbers isolate CONTROL-plane cost per node, and head_threads
+    flat across 16->128 is the O(1)-threads claim, measured."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu.core.cluster_utils import Cluster
+
+    def rss_mb():
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return round(int(line.split()[1]) / 1024.0, 1)
+        except OSError:
+            pass
+        return None
+
+    cluster = Cluster(system_config={"head_port": 0,
+                                     "log_to_driver": False})
+    out = {"bench": "envelope", "nodes": {}}
+    try:
+        @ray_tpu.remote
+        def nop():
+            return None
+
+        have = 0
+        for n in ns:
+            t_join = time.perf_counter()
+            cluster.add_virtual_nodes(n - have, resources={"CPU": 2.0})
+            join_s = time.perf_counter() - t_join
+            have = n
+            ntasks = tasks_per_node * n
+            ray_tpu.get([nop.remote() for _ in range(64)])  # warm
+            t0 = time.perf_counter()
+            ray_tpu.get([nop.remote() for _ in range(ntasks)])
+            dt = time.perf_counter() - t0
+            out["nodes"][str(n)] = {
+                "tasks": ntasks,
+                "per_second": _rate(ntasks, dt),
+                "join_seconds": round(join_s, 3),
+                "head_threads": threading.active_count(),
+                "rss_mb": rss_mb(),
+            }
+    finally:
+        cluster.shutdown()
+    return out
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--tasks", type=int, default=20000)
@@ -358,7 +411,20 @@ def main(argv=None) -> None:
                         help="measure cluster-event-plane overhead on "
                              "the trivial-task loop (interleaved "
                              "best-of-3, enabled vs disabled)")
+    parser.add_argument("--envelope", action="store_true",
+                        help="cluster-envelope scaling: throughput, "
+                             "head thread count, and RSS at 16/64/128 "
+                             "virtual nodes (runs instead of the "
+                             "standard suite)")
     args = parser.parse_args(argv)
+
+    if args.envelope:
+        out = bench_envelope()
+        print(json.dumps(out), flush=True)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump([out], f, indent=1)
+        return
 
     import ray_tpu
     rt = ray_tpu.init(num_cpus=4, ignore_reinit_error=True,

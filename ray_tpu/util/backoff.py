@@ -1,0 +1,113 @@
+"""Capped exponential backoff with jitter — the one retry clock.
+
+Every reconnect/retry loop in the tree (client reconnect, node-daemon
+reconnect, collective KV waits, object pulls, flight/refsan flushers)
+shares this helper so a 128-node cluster does not thundering-herd the
+head after a drill: jitter decorrelates the retry storms that a fleet
+of identical timers would otherwise synchronize (reference: AWS
+architecture blog "Exponential Backoff And Jitter"; the reference's
+retryable_grpc_client.h exposes the same base/max knobs).
+
+Two surfaces:
+
+* :class:`Backoff` — stateful; ``wait()`` sleeps the next jittered
+  delay (interruptible via an Event, bounded by an optional deadline)
+  and returns False once retrying should stop.
+* :func:`jittered` — stateless one-shot: jitter a single delay value
+  (for loops that manage their own schedule).
+
+graftlint GL019 (``UnboundedRetry``) flags retry loops that use
+neither this module nor an explicit sleep/deadline.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+
+def jittered(delay: float, jitter: float = 0.5,
+             rng: Optional[random.Random] = None) -> float:
+    """Equal-jitter a delay: keep ``(1-jitter)`` of it deterministic
+    and randomize the rest, so retries stay near the intended cadence
+    but a fleet of peers decorrelates. ``jitter=0`` is a no-op."""
+    if jitter <= 0.0 or delay <= 0.0:
+        return delay
+    jitter = min(jitter, 1.0)
+    r = (rng or _rng).random()
+    return delay * (1.0 - jitter) + delay * jitter * r
+
+
+_rng = random.Random()
+
+
+class Backoff:
+    """Capped exponential backoff with equal jitter.
+
+    ``initial_s`` doubles (``multiplier``) up to ``max_s``; each
+    ``wait()`` sleeps the next jittered delay. With ``deadline_s`` set,
+    ``wait()`` returns False (without sleeping past it) once the
+    deadline is reached — the caller's signal to stop retrying. An
+    optional Event interrupts the sleep (shutdown paths); a set event
+    also returns False.
+
+    Not thread-safe: one Backoff per retry loop.
+    """
+
+    def __init__(self, initial_s: float = 0.05, max_s: float = 2.0,
+                 multiplier: float = 2.0, jitter: float = 0.5,
+                 deadline_s: Optional[float] = None,
+                 rng: Optional[random.Random] = None):
+        self.initial_s = initial_s
+        self.max_s = max_s
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self._rng = rng or _rng
+        self._deadline = (None if deadline_s is None
+                          else time.monotonic() + deadline_s)
+        self._delay = initial_s
+        self.attempts = 0
+
+    def reset(self) -> None:
+        """Back to the initial delay (e.g. after a successful call)."""
+        self._delay = self.initial_s
+        self.attempts = 0
+
+    def remaining(self) -> Optional[float]:
+        """Seconds until the deadline; None when unbounded."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def expired(self) -> bool:
+        remaining = self.remaining()
+        return remaining is not None and remaining <= 0.0
+
+    def next_delay(self) -> float:
+        """Advance the schedule and return the next jittered delay
+        (without sleeping). Clamped to the deadline when one is set."""
+        delay = jittered(self._delay, self.jitter, self._rng)
+        self._delay = min(self._delay * self.multiplier, self.max_s)
+        self.attempts += 1
+        remaining = self.remaining()
+        if remaining is not None:
+            delay = min(delay, remaining)
+        return max(0.0, delay)
+
+    def wait(self, event: Optional[threading.Event] = None) -> bool:
+        """Sleep the next jittered delay. Returns False when retrying
+        should stop: the deadline passed, or ``event`` was set while
+        waiting (or before)."""
+        if self.expired():
+            return False
+        if event is not None and event.is_set():
+            return False
+        delay = self.next_delay()
+        if event is not None:
+            if event.wait(delay):
+                return False
+        elif delay > 0.0:
+            time.sleep(delay)
+        return not self.expired()

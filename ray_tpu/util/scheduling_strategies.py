@@ -17,6 +17,7 @@ from ray_tpu.util.placement_group import PlacementGroupSchedulingStrategy
 __all__ = [
     "SchedulingStrategy",
     "NodeAffinitySchedulingStrategy",
+    "NodeAntiAffinitySchedulingStrategy",
     "NodeLabelSchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
 ]
@@ -34,6 +35,24 @@ class NodeAffinitySchedulingStrategy(SchedulingStrategy):
         if isinstance(node_id, str):
             node_id = NodeID.from_hex(node_id)
         super().__init__(kind="NODE_AFFINITY", node_id=node_id, soft=soft)
+
+
+class NodeAntiAffinitySchedulingStrategy(SchedulingStrategy):
+    """Keep a task/actor OFF one node (stated divergence: the reference
+    expresses anti-affinity through label ``!in`` operators; here it is
+    a first-class strategy because drills routinely need "anywhere but
+    the node under chaos").
+
+    ``soft=True`` prefers other nodes but allows the avoided node when
+    it is the only feasible host; hard anti-affinity parks the task as
+    infeasible until another capable node exists.
+    """
+
+    def __init__(self, node_id: Union[str, NodeID], soft: bool = False):
+        if isinstance(node_id, str):
+            node_id = NodeID.from_hex(node_id)
+        super().__init__(kind="NODE_ANTI_AFFINITY", node_id=node_id,
+                         soft=soft)
 
 
 class NodeLabelSchedulingStrategy(SchedulingStrategy):

@@ -1,0 +1,504 @@
+"""Chaos-plane envelope drills: 64-128 virtual nodes on one box.
+
+The tentpole of the chaos plane (core/virtual_node.py + devtools/chaos.py):
+a 128-member cluster must register through the head's REAL wire path with
+O(1) extra threads, survive deterministic seeded fault schedules
+(kill/freeze/gang drills), and leave per-incident recovery timelines that
+chain every consequence back to the injected CHAOS_INJECTED root cause.
+
+Reference models: python/ray/tests/test_multinode_failures.py and
+test_placement_group_failover.py — here the whole envelope runs in-process
+on virtual nodes so the drills are deterministic and tier-1-fast.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.devtools import recovery
+from ray_tpu.devtools.chaos import ChaosController, ChaosFault, ChaosSchedule
+from ray_tpu.util import state
+
+
+def _pin(node_id, soft=True):
+    from ray_tpu.core.task_spec import SchedulingStrategy
+    return SchedulingStrategy(kind="NODE_AFFINITY", node_id=node_id,
+                              soft=soft)
+
+
+def _make_cluster(**system_config):
+    from ray_tpu.core.cluster_utils import Cluster
+    cfg = {"head_port": 0, "log_to_driver": False}
+    cfg.update(system_config)
+    return Cluster(head_node_args={"resources": {"CPU": 2}},
+                   system_config=cfg)
+
+
+@pytest.fixture
+def envelope_cluster():
+    cluster = _make_cluster()
+    yield cluster
+    cluster.shutdown()
+
+
+@pytest.fixture
+def drill_cluster():
+    cluster = _make_cluster(heartbeat_timeout_s=2.5)
+    yield cluster
+    cluster.shutdown()
+
+
+def _wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _node_dead_incidents(report, node_hex=None):
+    return [i for i in report["incidents"]
+            if i["root_kind"] == "NODE_DEAD"
+            and (node_hex is None or i["entity"] == f"node={node_hex[:12]}")]
+
+
+# --- scale-out: 128 members, O(1) threads ------------------------------
+
+
+@pytest.mark.watchdog(300)
+def test_envelope_128_vnodes_o1_threads(envelope_cluster):
+    """64 then 128 virtual nodes join over the real TCP listener; a
+    fan-out lands on the new capacity; and the head's thread count is
+    FLAT between 64 and 128 members — the virtual pool multiplexes all
+    of them onto one executor + one IO loop (the reference needs a
+    raylet process per member; perf.py --envelope records the curve)."""
+    cluster = envelope_cluster
+
+    @ray_tpu.remote(num_cpus=1)
+    def bump(i):
+        return i * 7 + 1
+
+    cluster.add_virtual_nodes(64, resources={"CPU": 2.0})
+    assert len(cluster.runtime.nodes) == 65
+    got = ray_tpu.get([bump.remote(i) for i in range(256)], timeout=60)
+    assert got == [i * 7 + 1 for i in range(256)]
+    threads_64 = threading.active_count()
+
+    cluster.add_virtual_nodes(64, resources={"CPU": 2.0})
+    assert len(cluster.runtime.nodes) == 129
+    got = ray_tpu.get([bump.remote(i) for i in range(512)], timeout=60)
+    assert got == [i * 7 + 1 for i in range(512)]
+    threads_128 = threading.active_count()
+
+    # doubling the membership must not grow the head: same pool, same
+    # loop. Allow +2 for lazily-spawned executor threads still warming.
+    assert threads_128 - threads_64 <= 2, (threads_64, threads_128)
+
+
+# --- kill drill: seeded schedule, per-fault attribution ----------------
+
+
+@pytest.mark.watchdog(300)
+def test_kill_drill_attribution_64(drill_cluster):
+    """A seeded 2-kill schedule against 64 nodes mid-fan-out: every task
+    still completes (retry + lineage), the lease ledger drains to zero,
+    and recovery_report() holds one NODE_DEAD incident per injected kill
+    whose precursor IS that kill's CHAOS_INJECTED event."""
+    cluster = drill_cluster
+    vnodes = cluster.add_virtual_nodes(64, resources={"CPU": 1.0})
+
+    @ray_tpu.remote(num_cpus=1, max_retries=4)
+    def work(i):
+        import time as t
+        t.sleep(0.02)
+        return i * 3
+
+    refs = [work.remote(i) for i in range(256)]
+    schedule = ChaosSchedule.from_seed(
+        1217, n_targets=64, duration_s=1.0, kills=2, start_s=0.3)
+    ctrl = ChaosController(cluster.runtime, schedule, vnodes)
+    ctrl.run_sync()
+    assert len(ctrl.injected) == 2
+
+    got = ray_tpu.get(refs, timeout=90)
+    assert got == [i * 3 for i in range(256)]
+
+    killed = {hex_id for _, _, hex_id in ctrl.injected}
+    _wait_for(lambda: {e["node_id"] for e in state.list_cluster_events(
+        kinds=["NODE_DEAD"])} >= killed, 20, "NODE_DEAD for both kills")
+
+    report = recovery.recovery_report()
+    for fault, seq, hex_id in ctrl.injected:
+        mine = _node_dead_incidents(report, hex_id)
+        assert mine, f"no NODE_DEAD incident for injected {fault.kind}"
+        inc = mine[0]
+        assert inc["precursor"] is not None
+        assert inc["precursor"]["kind"] == "CHAOS_INJECTED"
+        assert inc["precursor"]["seq"] == seq
+        assert inc["detect_s"] is not None and inc["detect_s"] >= 0.0
+
+    # exactly-once release: every lease handed out during the churn —
+    # including those on the two dead nodes — is back in the ledger
+    _wait_for(lambda: cluster.runtime.scheduler.outstanding_leases() == 0,
+              15, "lease ledger to drain")
+
+
+# --- freeze drill: heartbeat-miss chain + episode re-arm ---------------
+
+
+@pytest.mark.watchdog(300)
+def test_freeze_drill_chains_through_heartbeat_miss(drill_cluster):
+    """An injected freeze is detected as silence: the NODE_DEAD
+    incident's precursor is the NODE_HEARTBEAT_MISS episode, and THAT
+    event chains to the injected CHAOS_INJECTED — two-hop attribution."""
+    cluster = drill_cluster
+    vnodes = cluster.add_virtual_nodes(8, resources={"CPU": 1.0})
+    victim_hex = vnodes[3].node_id.hex()
+
+    schedule = ChaosSchedule(
+        faults=[ChaosFault(at_s=0.1, kind="freeze_node", target=3)],
+        seed=99)
+    ctrl = ChaosController(cluster.runtime, schedule, vnodes)
+    ctrl.run_sync()
+    (fault, chaos_seq, hex_id), = ctrl.injected
+    assert hex_id == victim_hex
+
+    _wait_for(lambda: any(e["node_id"] == victim_hex
+                          for e in state.list_cluster_events(
+                              kinds=["NODE_DEAD"])),
+              20, "frozen node declared dead")
+
+    report = recovery.recovery_report()
+    inc = _node_dead_incidents(report, victim_hex)[0]
+    assert inc["precursor"] is not None
+    assert inc["precursor"]["kind"] == "NODE_HEARTBEAT_MISS"
+    misses = [e for e in state.list_cluster_events(
+        kinds=["NODE_HEARTBEAT_MISS"]) if e["seq"] == inc["precursor"]["seq"]]
+    assert misses and misses[0]["caused_by"] == chaos_seq
+
+
+@pytest.mark.watchdog(300)
+def test_freeze_thaw_rearms_heartbeat_episode():
+    """A freeze shorter than the timeout must NOT kill the node, and a
+    LATER freeze must still attribute through a fresh miss episode —
+    the episode re-arms when heartbeats resume (the SIGSTOP-drill flake
+    fix: a stale half-open episode neither kills a recovered node nor
+    swallows the next episode's precursor)."""
+    cluster = _make_cluster(heartbeat_timeout_s=4.0)
+    try:
+        vnodes = cluster.add_virtual_nodes(4, resources={"CPU": 1.0})
+        victim = vnodes[1]
+        victim_hex = victim.node_id.hex()
+
+        # phase 1: sub-timeout freeze, then thaw — node must survive
+        victim.freeze()
+        time.sleep(1.5)
+        victim.thaw()
+
+        @ray_tpu.remote(num_cpus=1)
+        def where():
+            import ray_tpu as rt
+            return rt.get_runtime_context().get_node_id()
+
+        ref = where.options(
+            scheduling_strategy=_pin(victim.node_id, soft=False)).remote()
+        assert ray_tpu.get(ref, timeout=30) == victim_hex
+        assert not any(e["node_id"] == victim_hex
+                       for e in state.list_cluster_events(
+                           kinds=["NODE_DEAD"]))
+        # recovery = the head SEEING a fresh heartbeat (that is what
+        # closes the miss episode); wait for it before re-freezing
+        mgr = cluster.runtime.nodes[victim.node_id]
+        _wait_for(lambda: getattr(mgr, "_hb_miss_seq", None) is None
+                  and time.time() - mgr.last_heartbeat < 1.0,
+                  10, "head to observe a post-thaw heartbeat")
+
+        # phase 2: a real freeze-to-death — the recovered episode must
+        # not leak into this one's attribution
+        schedule = ChaosSchedule(
+            faults=[ChaosFault(at_s=0.05, kind="freeze_node", target=1)])
+        ctrl = ChaosController(cluster.runtime, schedule, vnodes)
+        ctrl.run_sync()
+        (_, chaos_seq, _), = ctrl.injected
+
+        _wait_for(lambda: any(e["node_id"] == victim_hex
+                              for e in state.list_cluster_events(
+                                  kinds=["NODE_DEAD"])),
+                  20, "second freeze declared dead")
+        inc = _node_dead_incidents(recovery.recovery_report(),
+                                   victim_hex)[0]
+        assert inc["precursor"] is not None
+        assert inc["precursor"]["kind"] == "NODE_HEARTBEAT_MISS"
+        misses = [e for e in state.list_cluster_events(
+            kinds=["NODE_HEARTBEAT_MISS"])
+            if e["seq"] == inc["precursor"]["seq"]]
+        assert misses and misses[0]["caused_by"] == chaos_seq
+    finally:
+        cluster.shutdown()
+
+
+# --- gang drill: PG member death -> release-once -> re-placement -------
+
+
+@pytest.mark.watchdog(300)
+def test_gang_drill_pg_rescheduled(drill_cluster):
+    """Kill a STRICT_SPREAD gang member: the surviving bundles release
+    exactly once, the gang re-pends and re-places atomically on the
+    survivors, a PG_RESCHEDULED event chains to the NODE_DEAD, and a
+    bundle-pinned task lands on the recovered gang."""
+    from ray_tpu.util.placement_group import (
+        PlacementGroupSchedulingStrategy, placement_group,
+        remove_placement_group)
+
+    cluster = drill_cluster
+    vnodes = cluster.add_virtual_nodes(5, resources={"CPU": 2.0, "gang": 1.0})
+    vnode_ids = {v.node_id for v in vnodes}
+
+    pg = placement_group([{"CPU": 2.0, "gang": 1.0}] * 2,
+                         strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=10)
+    members = pg.bundle_node_ids()
+    victim_id = next(n for n in members if n in vnode_ids)
+    victim_hex = victim_id.hex()
+
+    schedule = ChaosSchedule(faults=[ChaosFault(
+        at_s=0.05, kind="kill_node", target=victim_hex[:12])])
+    ChaosController(cluster.runtime, schedule, vnodes).run_sync()
+
+    _wait_for(lambda: any(e["node_id"] == victim_hex
+                          for e in state.list_cluster_events(
+                              kinds=["NODE_DEAD"])),
+              20, "gang member declared dead")
+
+    def _replaced():
+        rec = cluster.runtime.gcs.get_placement_group(pg.id)
+        return (rec is not None and rec.state == "CREATED"
+                and victim_id not in [b.node_id for b in rec.bundles])
+    _wait_for(_replaced, 20, "gang re-placed on survivors")
+
+    resched = [e for e in state.list_cluster_events(
+        kinds=["PG_RESCHEDULED"]) if e["data"].get("pg_id") or True]
+    assert resched, "no PG_RESCHEDULED event after member death"
+    dead_seqs = {e["seq"] for e in state.list_cluster_events(
+        kinds=["NODE_DEAD"]) if e["node_id"] == victim_hex}
+    assert any(e["caused_by"] in dead_seqs for e in resched)
+
+    @ray_tpu.remote(num_cpus=1,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=0))
+    def on_gang():
+        import ray_tpu as rt
+        return rt.get_runtime_context().get_node_id()
+
+    landed = ray_tpu.get(on_gang.remote(), timeout=30)
+    rec = cluster.runtime.gcs.get_placement_group(pg.id)
+    assert landed in [b.node_id.hex() for b in rec.bundles]
+
+    remove_placement_group(pg)
+    # release-exactly-once: nothing double-credited, nothing leaked
+    _wait_for(lambda: cluster.runtime.scheduler.outstanding_leases() == 0,
+              15, "lease ledger to drain after gang drill")
+
+
+# --- lineage + spilling under a kill -----------------------------------
+
+
+@pytest.mark.watchdog(300)
+def test_lineage_reconstruction_and_spill_hold(drill_cluster):
+    """Outputs living on a killed member come back via lineage
+    reconstruction, spilled driver objects stay readable through the
+    churn, and the incident timeline records the reconstruction."""
+    cluster = drill_cluster
+    vnodes = cluster.add_virtual_nodes(16, resources={"CPU": 1.0})
+    victim = vnodes[0]
+
+    @ray_tpu.remote(num_cpus=1, max_retries=4)
+    def produce(i):
+        return np.full(50_000, float(i))  # shm-sized: lives in a store
+
+    refs = [produce.options(
+        scheduling_strategy=_pin(victim.node_id)).remote(i)
+        for i in range(6)]
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
+    spilled = [ray_tpu.put(np.full(50_000, 100.0 + i)) for i in range(4)]
+
+    schedule = ChaosSchedule(
+        faults=[ChaosFault(at_s=0.05, kind="kill_node", target=0)], seed=5)
+    ctrl = ChaosController(cluster.runtime, schedule, vnodes)
+    ctrl.run_sync()
+    (_, chaos_seq, victim_hex), = ctrl.injected
+
+    # reconstruction: the dead node's outputs re-materialize on demand
+    for i, ref in enumerate(refs):
+        out = ray_tpu.get(ref, timeout=60)
+        assert float(out[0]) == float(i)
+    # spill hold: driver-held objects are untouched by the node death
+    for i, ref in enumerate(spilled):
+        assert float(ray_tpu.get(ref, timeout=30)[0]) == 100.0 + i
+
+    report = recovery.recovery_report()
+    inc = _node_dead_incidents(report, victim_hex)[0]
+    assert inc["precursor"]["kind"] == "CHAOS_INJECTED"
+    assert inc["precursor"]["seq"] == chaos_seq
+    counts = report["counts"]
+    assert (counts.get("RECONSTRUCT_DONE", 0)
+            + counts.get("TASK_RETRY", 0)) > 0
+
+
+# --- full churn under refsan -------------------------------------------
+
+_CHURN_SRC = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ray_tpu
+from ray_tpu.core.cluster_utils import Cluster
+from ray_tpu.devtools.chaos import ChaosController, ChaosFault, ChaosSchedule
+
+cluster = Cluster(head_node_args={"resources": {"CPU": 2}},
+                  system_config={"head_port": 0, "log_to_driver": False,
+                                 "heartbeat_timeout_s": 2.0})
+vnodes = cluster.add_virtual_nodes(24, resources={"CPU": 1.0})
+
+@ray_tpu.remote(num_cpus=1, max_retries=4)
+def produce(i):
+    import time
+    time.sleep(0.01)
+    return i * 3
+
+@ray_tpu.remote(num_cpus=1, max_retries=4)
+def consume(x):
+    return x + 1
+
+refs = [consume.remote(produce.remote(i)) for i in range(96)]
+schedule = ChaosSchedule(faults=[
+    ChaosFault(at_s=0.2, kind="kill_node", target=5),
+    ChaosFault(at_s=0.4, kind="freeze_node", target=11),
+], seed=2026)
+ChaosController(cluster.runtime, schedule, vnodes).run_sync()
+got = ray_tpu.get(refs, timeout=120)
+assert got == [i * 3 + 1 for i in range(96)], got[:8]
+cluster.shutdown()
+
+from ray_tpu.devtools import refsan
+findings = refsan.report()
+if findings:
+    print(refsan.format_findings(findings))
+    sys.exit(3)
+print("CHURN-OK")
+"""
+
+
+@pytest.mark.watchdog(300)
+def test_full_churn_refsan_zero_findings():
+    """Kill + freeze churn over 24 nodes with chained lineage under
+    RAY_TPU_REFSAN=1: every result correct and ZERO ledger findings —
+    recovery does not leak, double-free, or resurrect object refs. Runs
+    in a subprocess because refsan must instrument before import."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["RAY_TPU_REFSAN"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix="_rtpu_churn.py", delete=False) as f:
+        f.write(_CHURN_SRC)
+        path = f.name
+    try:
+        proc = subprocess.run([sys.executable, path], env=env,
+                              capture_output=True, text=True, timeout=240)
+    finally:
+        os.unlink(path)
+    out = (proc.stdout or "") + (proc.stderr or "")
+    assert proc.returncode == 0 and "CHURN-OK" in proc.stdout, out
+
+
+# --- scheduler-level regressions (satellite: release exactly once) -----
+
+
+def _fresh_scheduler_with(*nodes):
+    from ray_tpu.core.gcs import Gcs
+    from ray_tpu.core.ids import NodeID
+    from ray_tpu.core.scheduler import ClusterScheduler
+    sched = ClusterScheduler(Gcs())
+    ids = []
+    for total in nodes:
+        nid = NodeID.from_random()
+        sched.add_node(nid, dict(total), {})
+        ids.append(nid)
+    return sched, ids
+
+
+def test_release_exactly_once_token():
+    """A tokened release is idempotent: the second call (worker crash
+    racing node reap racing drill kill) must not double-credit."""
+    sched, (nid,) = _fresh_scheduler_with({"CPU": 4.0})
+    assert sched.try_acquire(nid, {"CPU": 3.0}, token="t1")
+    assert sched.outstanding_leases() == 1
+    sched.release(nid, {"CPU": 3.0}, token="t1")
+    assert sched.available(nid)["CPU"] == 4.0
+    sched.release(nid, {"CPU": 3.0}, token="t1")  # duplicate: no-op
+    assert sched.available(nid)["CPU"] == 4.0
+    assert sched.outstanding_leases() == 0
+
+
+def test_release_trusts_recorded_lease_over_caller_args():
+    """The ledger releases what was ACQUIRED, even when the caller's
+    need dict has since been mutated (pg-stripped resources)."""
+    sched, (nid,) = _fresh_scheduler_with({"CPU": 4.0})
+    assert sched.try_acquire(nid, {"CPU": 1.0}, token="t")
+    sched.release(nid, {"CPU": 4.0}, token="t")  # lying caller
+    assert sched.available(nid)["CPU"] == 4.0  # credited 1.0, not 4.0
+
+
+def test_remove_node_purges_leases_across_incarnations():
+    """Node death purges its leases, so a late release cannot credit a
+    re-registered incarnation's fresh ledger."""
+    sched, (nid,) = _fresh_scheduler_with({"CPU": 4.0})
+    assert sched.try_acquire(nid, {"CPU": 2.0}, token="stale")
+    sched.remove_node(nid)
+    assert sched.outstanding_leases() == 0
+    sched.add_node(nid, {"CPU": 4.0}, {})  # same id, new incarnation
+    sched.release(nid, {"CPU": 2.0}, token="stale")
+    assert sched.available(nid)["CPU"] == 4.0  # untouched
+
+
+def test_node_anti_affinity_hard_and_soft():
+    from ray_tpu.core.ids import TaskID
+    from ray_tpu.core.task_spec import SchedulingStrategy, TaskSpec
+
+    sched, (a, b) = _fresh_scheduler_with({"CPU": 2.0}, {"CPU": 2.0})
+
+    def spec(node_id, soft):
+        return TaskSpec(task_id=TaskID.from_random(), function_id="f",
+                        args=[], resources={"CPU": 1.0},
+                        strategy=SchedulingStrategy(
+                            kind="NODE_ANTI_AFFINITY", node_id=node_id,
+                            soft=soft))
+
+    # hard: never the avoided node
+    for _ in range(8):
+        assert sched.pick_node(spec(a, soft=False)) == b
+    # hard with no alternative: infeasible, parked (ValueError)
+    sched.remove_node(b)
+    with pytest.raises(ValueError):
+        sched.pick_node(spec(a, soft=False))
+    # soft with no alternative: the avoided node is still usable
+    assert sched.pick_node(spec(a, soft=True)) == a
+
+
+def test_node_anti_affinity_public_strategy():
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAntiAffinitySchedulingStrategy)
+    from ray_tpu.core.ids import NodeID
+    nid = NodeID.from_random()
+    s = NodeAntiAffinitySchedulingStrategy(node_id=nid, soft=True)
+    assert s.kind == "NODE_ANTI_AFFINITY" and s.soft and s.node_id == nid

@@ -1073,3 +1073,91 @@ def test_gl018_per_line_disable():
         'rec.state = "DEAD"',
         'rec.state = "DEAD"  # graftlint: disable=GL018')
     assert rules_hit(src, select=["GL018"]) == set()
+
+
+# -- GL019 unbounded retry ---------------------------------------------
+
+GL019_POS_HOT_SPIN = """
+    def redial(self):
+        while not self._stopped:
+            try:
+                return self._connect()
+            except OSError:
+                continue
+"""
+
+GL019_NEG_BACKOFF = """
+    def redial(self):
+        from ray_tpu.util.backoff import Backoff
+        backoff = Backoff(initial_s=0.1, max_s=2.0, deadline_s=30.0)
+        while not self._stopped:
+            try:
+                return self._connect()
+            except OSError:
+                if not backoff.wait():
+                    return None
+                continue
+"""
+
+
+def test_gl019_fires_on_hot_retry_loop():
+    findings = run(GL019_POS_HOT_SPIN, select=["GL019"])
+    assert [f.rule for f in findings] == ["GL019"]
+    assert "backoff" in findings[0].message
+
+
+def test_gl019_quiet_with_pacing():
+    assert rules_hit(GL019_NEG_BACKOFF, select=["GL019"]) == set()
+    # a plain sleep also paces the loop
+    assert rules_hit("""
+        import time
+        def poll(self):
+            while True:
+                try:
+                    return self._fetch()
+                except OSError:
+                    time.sleep(0.5)
+                    continue
+    """, select=["GL019"]) == set()
+    # an explicit timeout kwarg on a blocking call paces the loop
+    assert rules_hit("""
+        def drain(self):
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(1, timeout=0.1)
+                    return True
+                except Full:
+                    continue
+    """, select=["GL019"]) == set()
+
+
+def test_gl019_nested_scopes_do_not_leak():
+    # continue inside a NESTED loop does not re-enter the outer one
+    assert rules_hit("""
+        def pump(self):
+            while self._streams:
+                for item in self._batch():
+                    try:
+                        self._emit(item)
+                    except ValueError:
+                        continue
+                self._streams.pop()
+    """, select=["GL019"]) == set()
+    # a wait inside a nested function does not pace the outer loop
+    assert rules_hit("""
+        def redial(self):
+            while True:
+                def pause():
+                    time.sleep(1)
+                try:
+                    return self._connect()
+                except OSError:
+                    continue
+    """, select=["GL019"]) == {"GL019"}
+
+
+def test_gl019_per_line_disable():
+    src = GL019_POS_HOT_SPIN.replace(
+        "while not self._stopped:",
+        "while not self._stopped:  # graftlint: disable=GL019")
+    assert rules_hit(src, select=["GL019"]) == set()

@@ -547,7 +547,10 @@ def test_head_restart_user_contract(tmp_path):
 
         rt2 = ray_tpu.init(num_cpus=1, head_port=port,
                            system_config=dict(sys_cfg))
-        deadline = time.time() + 40
+        # The daemon's reconnect window is 60s (env above); the observer
+        # must outwait it — a starved box can burn a full 15s register
+        # timeout per redial attempt before the rejoin lands.
+        deadline = time.time() + 70
         while len(rt2.nodes) < 2 and time.time() < deadline:
             time.sleep(0.2)
         assert len(rt2.nodes) == 2, "daemon did not rejoin"
