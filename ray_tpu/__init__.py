@@ -24,6 +24,7 @@ from ray_tpu.api import (
     is_initialized,
     kill,
     nodes,
+    profile_dump,
     put,
     remote,
     shutdown,
@@ -52,6 +53,7 @@ __all__ = [
     "nodes",
     "ObjectRef",
     "ObjectRefGenerator",
+    "profile_dump",
     "put",
     "remote",
     "RuntimeEnv",

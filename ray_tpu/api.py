@@ -221,17 +221,27 @@ def timeline(filename: Optional[str] = None,
     return _timeline(filename, trace_id=trace_id)
 
 
-def whereis(journal_file: Optional[str] = None, render: bool = True):
+def whereis(journal_file: Optional[str] = None, render: bool = True,
+            task_path: bool = False):
     """Step-time attribution from the flight-recorder journal: folds
     the merged per-process journals into compute / comms / data-wait /
     pipeline-bubble / idle fractions per step and compares the measured
     bubble against the schedule's theoretical one. Reads the live
     journal store by default, or a ``flight_journal()`` dump when
     ``journal_file`` is given. Returns the report dict (and prints the
-    rendered table unless ``render=False``)."""
+    rendered table unless ``render=False``).
+
+    ``task_path=True`` switches to the submit-path phase budget: the
+    sampled spec-build → result-return chains (core/task_phase.py)
+    folded into a per-phase µs table with chain coverage."""
     from ray_tpu.devtools import whereis as _whereis
     journals = (_whereis._load_journals(journal_file)
                 if journal_file else None)
+    if task_path:
+        report = _whereis.task_path_attribution(journals)
+        if render:
+            print(_whereis.render_task_path(report))
+        return report
     report = _whereis.attribution(journals)
     if render:
         print(_whereis.render(report))
@@ -244,3 +254,15 @@ def flight_journal(filename: Optional[str] = None):
     Writes JSON when ``filename`` is given; returns the payload dict."""
     from ray_tpu.util import flight_recorder
     return flight_recorder.dump_journals(filename)
+
+
+def profile_dump(filename: Optional[str] = None,
+                 proc: Optional[str] = None) -> str:
+    """Folded-text dump of the cluster-wide sampling profiler
+    (``proc;role;frame;frame count`` per line — flamegraph.pl and
+    speedscope both import it). Requires a run with RAY_TPU_PROFILER=1;
+    ``proc`` narrows to one process label. Writes the text when
+    ``filename`` is given; returns it either way. See
+    ray_tpu/devtools/profiler.py."""
+    from ray_tpu.devtools import profiler
+    return profiler.dump(filename, proc=proc)

@@ -31,6 +31,7 @@ from ray_tpu.devtools import locktrace, refsan, threadguard
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
+from ray_tpu.core import task_phase as _task_phase
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.protocol import (
@@ -432,6 +433,16 @@ class ClientRuntime:
 
     # -- control plane ---------------------------------------------------
     def submit_spec(self, spec) -> None:
+        if _task_phase._TRACKED:
+            # Client mode records only the submit-side legs: the head
+            # process owns scheduling/dispatch and cannot see this
+            # process's sampled-chain table (core/task_phase.py).
+            payload = serialization.dumps_fast(spec)
+            _task_phase.mark(spec.task_id, "frame-encode")
+            self._send({"kind": "SUBMIT", "spec": payload})
+            _task_phase.mark(spec.task_id, "wire-write")
+            _task_phase.discard(spec.task_id)
+            return
         self._send({"kind": "SUBMIT",
                     "spec": serialization.dumps_fast(spec)})
 

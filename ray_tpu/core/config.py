@@ -185,6 +185,19 @@ class Config:
     # increment push over the control channel).
     flight_flush_interval_s: float = 0.2
 
+    # --- perf observatory (devtools/profiler.py, core/task_phase.py) ---
+    # Submit-path phase attribution: when the flight recorder is on,
+    # 1-in-N submissions get their full spec-build → result-return
+    # chain bracketed into ``task_phase`` events (whereis --task-path
+    # folds them into a per-phase µs budget). 0 disables sampling.
+    task_phase_sample_n: int = 64
+    # Sampling profiler wall-clock rate. The profiler itself is gated
+    # by the RAY_TPU_PROFILER env (not config: it must be inheritable
+    # by spawned workers before any config exists), like refsan.
+    profiler_hz: int = 101
+    # Cadence of the worker-side profile push to the driver store.
+    profiler_push_interval_s: float = 1.0
+
     # --- refsan (devtools/refsan.py) ---
     # Hostile-store mode for the object-lifetime sanitizer: collapse
     # the owner's borrow grace window to ~0 so deferred reclaims fire

@@ -24,6 +24,7 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from ray_tpu.core import serialization
+from ray_tpu.core import task_phase as _task_phase
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ActorID, NodeID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import SharedMemoryStore
@@ -535,7 +536,16 @@ class Node:
         worker.state = BUSY
         worker.running[spec.task_id] = spec
         kind = "CREATE_ACTOR" if spec.is_actor_creation else "EXECUTE"
-        if not worker.send({"kind": kind, "spec": serialization.dumps_fast(spec)}):
+        if _task_phase._TRACKED:  # sampled-chain brackets (task_phase.py)
+            _task_phase.mark(spec.task_id, "lease-dispatch")
+            payload = serialization.dumps_fast(spec)
+            _task_phase.mark(spec.task_id, "frame-encode")
+            ok = worker.send({"kind": kind, "spec": payload})
+            _task_phase.mark(spec.task_id, "wire-write")
+        else:
+            ok = worker.send({"kind": kind,
+                              "spec": serialization.dumps_fast(spec)})
+        if not ok:
             # This spec never reached the worker: requeue without
             # consuming a retry, then run the FULL death path so other
             # in-flight (pipelined) specs on this worker are retried too
@@ -674,8 +684,19 @@ class Node:
                     return  # worker died; the crash path retried it
                 self._send_task(worker, batch[0])
             return
-        if not worker.send({"kind": "EXECUTE_BATCH",
-                            "specs": serialization.dumps_fast(batch)}):
+        if _task_phase._TRACKED:  # sampled-chain brackets (task_phase.py)
+            for spec in batch:
+                _task_phase.mark(spec.task_id, "lease-dispatch")
+            payload = serialization.dumps_fast(batch)
+            for spec in batch:
+                _task_phase.mark(spec.task_id, "frame-encode")
+            ok = worker.send({"kind": "EXECUTE_BATCH", "specs": payload})
+            for spec in batch:
+                _task_phase.mark(spec.task_id, "wire-write")
+        else:
+            ok = worker.send({"kind": "EXECUTE_BATCH",
+                              "specs": serialization.dumps_fast(batch)})
+        if not ok:
             with self._lock:
                 for spec in batch:
                     if worker.running.pop(spec.task_id, None) is not None:

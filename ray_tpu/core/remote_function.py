@@ -16,8 +16,10 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization
+from ray_tpu.core import task_phase as _task_phase
 from ray_tpu.core.config import get_config
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.task_spec import Arg, SchedulingStrategy, TaskSpec
 
@@ -202,6 +204,14 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         rt = _get_runtime()
+        # Sampled submit-path attribution (core/task_phase.py): args are
+        # converted before the spec so the arg-serialize leg brackets
+        # cleanly; recorder-off cost is two loads and a compare.
+        t_phase = (_task_phase.sample_begin()
+                   if _flight.RECORDER is not None else 0)
+        task_args = [value_to_arg(a, rt) for a in args]
+        task_kwargs = {k: value_to_arg(v, rt) for k, v in kwargs.items()}
+        t_args_done = _flight.clock_ns() if t_phase else 0
         function_id = self._ensure_registered(rt)
         opts = self._options
         num_returns = opts.get("num_returns", 1)
@@ -214,8 +224,8 @@ class RemoteFunction:
         spec = TaskSpec(
             task_id=rt.next_task_id(),
             function_id=function_id,
-            args=[value_to_arg(a, rt) for a in args],
-            kwargs={k: value_to_arg(v, rt) for k, v in kwargs.items()},
+            args=task_args,
+            kwargs=task_kwargs,
             num_returns=num_returns,
             resources=dict(self._resources),
             strategy=self._strategy,
@@ -229,6 +239,8 @@ class RemoteFunction:
             parent_span_id=parent_span_id,
         )
         refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        if t_phase:
+            _task_phase.begin_chain(spec.task_id, t_phase, t_args_done)
         rt.submit_spec(spec)
         if num_returns == -1:
             from ray_tpu.core.generator import ObjectRefGenerator

@@ -27,6 +27,7 @@ from ray_tpu.core.node import Node
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.object_store import MemoryStore
 from ray_tpu.core.scheduler import ClusterScheduler
+from ray_tpu.core import task_phase as _task_phase
 from ray_tpu.core.task_manager import ObjectLocation, ReferenceCounter, TaskManager
 from ray_tpu.core.task_spec import TaskSpec
 from ray_tpu.devtools import refsan
@@ -118,6 +119,11 @@ class DriverRuntime:
         # Same idea for the lifetime sanitizer: fresh collector per
         # session, ledger enabled iff RAY_TPU_REFSAN is exported.
         refsan.init_driver()
+        # ... and the sampling profiler (RAY_TPU_PROFILER): fresh
+        # store per session, driver sampler started when enabled.
+        from ray_tpu.devtools import profiler
+        profiler.init_driver()
+        _task_phase.reset()
         self.scheduler = ClusterScheduler(self.gcs)
         self.task_manager = TaskManager()
         self.reference_counter = ReferenceCounter()
@@ -936,6 +942,8 @@ class DriverRuntime:
             info = self.actors.get(spec.actor_id)
             if info is not None:
                 info.resources_node = node_id
+        if _task_phase._TRACKED:
+            _task_phase.mark(spec.task_id, "scheduler-queue")
         self.task_manager.mark_dispatched(spec.task_id, node_id)
         self._record_event(spec, "SCHEDULED", node_id=node_id)
         self._emit_lease_grant(spec, node_id)
@@ -1018,6 +1026,8 @@ class DriverRuntime:
                     # heartbeat monitor removes nodes concurrently).
                     backlog.append(spec)
                     continue
+                if _task_phase._TRACKED:
+                    _task_phase.mark(spec.task_id, "scheduler-queue")
                 self.task_manager.mark_dispatched(spec.task_id, node_id)
                 self._record_event(spec, "SCHEDULED", node_id=node_id)
                 self._emit_lease_grant(spec, node_id)
@@ -1067,6 +1077,9 @@ class DriverRuntime:
                             break
                         self._overcommitted.add(  # graftlint: disable=GL001
                             follower.task_id)  # GIL-atomic; see _consume_overcommit
+                        if _task_phase._TRACKED:
+                            _task_phase.mark(follower.task_id,
+                                             "scheduler-queue")
                         self.task_manager.mark_dispatched(
                             follower.task_id, node_id)
                         self._record_event(follower, "SCHEDULED",
@@ -1333,6 +1346,9 @@ class DriverRuntime:
                                           submitted_at=submitted_at)
             self._fail_task(spec, err)
             self._release_task_resources(spec, node.node_id)
+            if _task_phase._TRACKED:
+                _task_phase.finish(spec.task_id, msg.get("t_start"),
+                                   msg.get("t_end"))
             self._signal_scheduler()
             return
         for result in msg.get("results", ()):
@@ -1387,6 +1403,9 @@ class DriverRuntime:
             self._release_task_resources(spec, node.node_id)
         self._record_execution_events(spec, node, worker, msg, "FINISHED",
                                       submitted_at=submitted_at)
+        if _task_phase._TRACKED:
+            _task_phase.finish(spec.task_id, msg.get("t_start"),
+                               msg.get("t_end"))
         self._signal_scheduler()
 
     def _consume_overcommit(self, task_id: TaskID) -> bool:
@@ -2347,6 +2366,12 @@ class DriverRuntime:
             # same brevity contract as flight_push
             refsan.store_push(args[0], args[1])
             return True
+        if method == "profile_push":
+            # cumulative profile snapshot from a worker's sampler;
+            # replace-on-push, same brevity contract as flight_push
+            from ray_tpu.devtools import profiler
+            profiler.store_push(args[0], args[1], args[2], args[3])
+            return True
         if method == "add_cluster_event":
             # lifecycle event from a worker process (serve controller /
             # replicas route here via events.emit); brief/lock-only
@@ -2538,6 +2563,14 @@ class DriverRuntime:
         # state are still current (stores close below); findings are
         # kept for post-shutdown refsan.report() calls.
         refsan.on_shutdown()
+        # Stop the driver's sampler; park its counts in the store so
+        # post-shutdown profile_dump()/profdiff captures still see it.
+        from ray_tpu.devtools import profiler
+        sampler = profiler.disable()
+        if sampler is not None:
+            profiler.store_push(sampler.label, sampler.counts,
+                                sampler.samples, sampler.hz)
+        _task_phase.reset()
         self._stopped.set()
         for hook in getattr(self, "_shutdown_hooks", ()):
             try:

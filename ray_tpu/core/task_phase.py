@@ -1,0 +1,135 @@
+"""Submit-path phase attribution: a per-task µs budget for the hot path.
+
+BENCH_core.json says a trivial submit costs ~150 µs end to end; nothing
+in the repo says where those µs go. This module brackets 1-in-N
+submissions (``task_phase_sample_n``, recorder-on only) into a
+contiguous chain of named flight-recorder spans:
+
+    arg-serialize   value_to_arg over args/kwargs (remote())
+    spec-build      registration + TaskSpec construction (remote())
+    scheduler-queue submit entry -> lease acquisition (runtime)
+    lease-dispatch  lease bookkeeping + node dispatch queue (node)
+    frame-encode    serialization.dumps_fast of the wire frame (node)
+    wire-write      socket handoff to the worker (node)
+    worker-pickup   wire-write end -> worker ``t_start`` (on_task_done)
+    execute         worker ``t_start`` -> ``t_end`` (informative)
+    result-return   worker ``t_end`` -> driver completion processed
+
+Each phase starts exactly where the previous one ended (``mark``
+advances a per-task boundary), so a sampled task's lifetime is fully
+tiled — gaps between instrumented call sites attribute to the adjacent
+phase instead of vanishing. ``devtools/whereis.py --task-path`` folds
+the events into the per-phase table; the union of the chains over the
+bench window is the coverage figure the ≥85% acceptance bar checks.
+
+Cost discipline (PERF.md): when the recorder is off, call sites gate on
+``flight_recorder.RECORDER is not None`` or on the module-level
+``_TRACKED`` dict being empty — two loads and a compare. For unsampled
+tasks while a sampled chain is in flight, ``mark`` is one dict-get miss.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional
+
+from ray_tpu.core.config import get_config
+from ray_tpu.util import flight_recorder as _flight
+
+PHASES = ("arg-serialize", "spec-build", "scheduler-queue",
+          "lease-dispatch", "frame-encode", "wire-write",
+          "worker-pickup", "execute", "result-return")
+
+# task_id -> last phase boundary (driver perf-ns). Driver-process only.
+# Bounded: abandoned chains (client mode, dropped tasks) are cleared
+# wholesale at the cap instead of LRU-tracked — sampling makes the dict
+# tiny (in-flight sampled tasks only) so the cap is a leak backstop.
+_TRACKED: Dict[object, int] = {}
+_MAX_TRACKED = 4096
+_counter = itertools.count()
+
+
+def sample_begin() -> int:
+    """Call at submit entry. Returns the chain-start ns when this
+    submission is sampled (recorder on + 1-in-N), else 0."""
+    if _flight.RECORDER is None:
+        return 0
+    n = get_config().task_phase_sample_n
+    if n <= 0 or next(_counter) % n:
+        return 0
+    return _flight.clock_ns()
+
+
+def begin_chain(task_id, t0_ns: int, t_args_done_ns: int) -> None:
+    """Record the two submit-side phases remote() measured itself
+    (args were converted before the spec existed, so the bracket is
+    arg-serialize first, then spec-build) and start tracking."""
+    rec = _flight.RECORDER
+    if rec is None:
+        return
+    now = _flight.clock_ns()
+    tag = {"task": task_id.hex()[:12]}
+    rec.record("task_phase", "arg-serialize", t0_ns,
+               t_args_done_ns - t0_ns, tag)
+    rec.record("task_phase", "spec-build", t_args_done_ns,
+               now - t_args_done_ns, tag)
+    if len(_TRACKED) >= _MAX_TRACKED:
+        _TRACKED.clear()
+    _TRACKED[task_id] = now
+
+
+def mark(task_id, phase: str) -> None:
+    """Close the span from the task's last boundary to now under
+    ``phase`` and advance the boundary. No-op (one dict-get miss) for
+    untracked tasks — callers gate on ``_TRACKED`` being non-empty."""
+    t0 = _TRACKED.get(task_id)
+    if t0 is None:
+        return
+    rec = _flight.RECORDER
+    if rec is None:           # recorder torn down mid-chain
+        _TRACKED.pop(task_id, None)
+        return
+    now = _flight.clock_ns()
+    rec.record("task_phase", phase, t0, now - t0,
+               {"task": task_id.hex()[:12]})
+    _TRACKED[task_id] = now
+
+
+def finish(task_id, t_start_wall: Optional[float],
+           t_end_wall: Optional[float]) -> None:
+    """Close the chain at completion. The worker stamped ``t_start`` /
+    ``t_end`` with time.time() (same machine); the flight anchor maps
+    them into the driver perf-ns domain so worker-pickup / execute /
+    result-return stay contiguous with the driver-side spans."""
+    t0 = _TRACKED.pop(task_id, None)
+    if t0 is None:
+        return
+    rec = _flight.RECORDER
+    if rec is None:
+        return
+    now = _flight.clock_ns()
+    tag = {"task": task_id.hex()[:12]}
+    if t_start_wall is not None and t_end_wall is not None:
+        wall_anchor, perf_anchor = _flight._get_anchor()
+        s = perf_anchor + int((t_start_wall - wall_anchor) * 1e9)
+        e = perf_anchor + int((t_end_wall - wall_anchor) * 1e9)
+        # clamp into [t0, now]: wall/perf clock disagreement must not
+        # produce negative spans or break chain contiguity
+        s = min(max(s, t0), now)
+        e = min(max(e, s), now)
+        rec.record("task_phase", "worker-pickup", t0, s - t0, tag)
+        rec.record("task_phase", "execute", s, e - s, tag)
+        rec.record("task_phase", "result-return", e, now - e, tag)
+    else:
+        rec.record("task_phase", "result-return", t0, now - t0, tag)
+
+
+def discard(task_id) -> None:
+    """Drop a chain without recording (client mode hands the rest of
+    the path to the head process, which can't see this task's entry)."""
+    _TRACKED.pop(task_id, None)
+
+
+def reset() -> None:
+    """Test/bench hook: forget all in-flight chains."""
+    _TRACKED.clear()

@@ -199,6 +199,20 @@ class DashboardServer:
             from ray_tpu.util.timeline import chrome_trace_events
             return self._send_json(
                 req, chrome_trace_events(self._runtime))
+        if path == "/api/profile":
+            # sampling-profiler snapshot (devtools/profiler.py):
+            # per-process folded stacks for the SPA flamegraph;
+            # ?proc=<label> narrows to one process
+            from ray_tpu.devtools import profiler
+            proc = query.get("proc")
+            profiles = profiler.merged_profiles()
+            return self._send_json(req, {
+                "enabled": profiler.enabled() or bool(profiles),
+                "procs": sorted(profiles),
+                "samples": {label: snap.get("samples", 0)
+                            for label, snap in profiles.items()},
+                "folded": profiler.folded(proc),
+            })
         if path == "/api/traces":
             return self._send_json(req, self._trace_index())
         if path.startswith("/api/traces/"):

@@ -256,18 +256,93 @@ def step_recorder() -> Tuple[str, str]:
         merged = fr.merged_journals()
         if set(merged) != {"driver:check", "worker:check"}:
             return "FAIL", f"merge lost a journal: {sorted(merged)}"
-        events = fr.chrome_events()
-        payload = _json.loads(_json.dumps(events))
-        if len(payload) != 9:
-            return "FAIL", f"expected 9 trace events, got {len(payload)}"
-        for ev in payload:
+        payload = _json.loads(_json.dumps(fr.chrome_events()))
+        meta = [ev for ev in payload if ev["ph"] == "M"]
+        events = [ev for ev in payload if ev["ph"] != "M"]
+        if len(events) != 9:
+            return "FAIL", f"expected 9 trace events, got {len(events)}"
+        # each track must lead with role-naming metadata (PR 18)
+        named = {ev["pid"] for ev in meta if ev["name"] == "process_name"}
+        if named != {"flight:driver:check", "flight:worker:check"}:
+            return "FAIL", f"tracks missing process_name meta: {named}"
+        for ev in events:
             if not {"name", "ph", "ts", "pid", "tid"} <= set(ev):
                 return "FAIL", f"malformed trace event: {ev}"
             if ev["ph"] == "X" and not isinstance(ev["dur"], (int, float)):
                 return "FAIL", f"X event without numeric dur: {ev}"
-        return "ok", f"{len(payload)} events merged across 2 journals"
+        return "ok", (f"{len(events)} events + {len(meta)} metadata "
+                      f"across 2 journals")
     finally:
         fr.RECORDER, fr._STORE = saved
+
+
+def step_profile() -> Tuple[str, str]:
+    """Perf-observatory smoke, fully in-process: (1) the sampling
+    profiler over a seeded busy loop must attribute ≥50% of this
+    thread's samples to it; (2) the whereis task-path fold over a
+    synthetic phase journal must reproduce its known µs table exactly
+    (coverage 1.0 — the chain is contiguous by construction)."""
+    import sys as _sys
+    if not hasattr(_sys, "_current_frames"):
+        return "SKIP", "platform lacks sys._current_frames"
+    import threading as _threading
+    import time as _time
+    from ray_tpu.devtools import profiler
+    from ray_tpu.devtools import whereis as whereis_mod
+
+    # (1) sampler attribution: burn CPU in THIS frame while a fast
+    # sampler watches; our role's samples must mostly land here.
+    sampler = profiler.Sampler("driver:check", hz=250)
+    sampler.start()
+    deadline = _time.monotonic() + 0.4
+    x = 0
+    while _time.monotonic() < deadline:
+        for i in range(5000):
+            x += i * i
+    sampler.stop()
+    sampler.join(timeout=2.0)
+    role = profiler._role(_threading.current_thread().name)
+    mine = total = 0
+    for stack, n in sampler.counts.items():
+        if not stack.startswith(role + ";"):
+            continue
+        total += n
+        if "step_profile" in stack:
+            mine += n
+    if total == 0:
+        return "FAIL", f"sampler took no samples of role {role!r}"
+    frac = mine / total
+    if frac < 0.5:
+        return "FAIL", (f"busy function got {frac:.0%} of {total} "
+                        f"samples (need >=50%)")
+
+    # (2) phase fold: contiguous synthetic chain with a known table
+    base = 1_000_000_000
+    spans = [("arg-serialize", 80_000), ("spec-build", 120_000),
+             ("scheduler-queue", 500_000), ("lease-dispatch", 30_000),
+             ("frame-encode", 40_000), ("wire-write", 25_000),
+             ("worker-pickup", 200_000), ("execute", 50_000),
+             ("result-return", 90_000)]
+    events, t = [], base
+    for seq, (name, dur) in enumerate(spans):
+        events.append((seq, t, dur, "task_phase", name, {"task": "ab"}))
+        t += dur
+    report = whereis_mod.task_path_attribution({"driver:check": events})
+    for name, dur in spans:
+        got = report["phases"][name]["mean_us"]
+        if abs(got - dur / 1e3) > 1e-6:
+            return "FAIL", (f"phase {name}: folded mean {got}us != "
+                            f"{dur / 1e3}us")
+    if report["coverage"] != 1.0:
+        return "FAIL", f"contiguous chain coverage {report['coverage']}"
+    if report["tasks_sampled"] != 1:
+        return "FAIL", f"tasks_sampled {report['tasks_sampled']} != 1"
+    total_us = sum(d for _, d in spans) / 1e3
+    if abs(report["mean_chain_us"] - total_us) > 0.1:
+        return "FAIL", (f"chain total {report['mean_chain_us']}us != "
+                        f"{total_us}us")
+    return "ok", (f"sampler: {frac:.0%} of {total} samples on the busy "
+                  f"fn; phase fold reproduced {len(spans)}-row table")
 
 
 def step_events() -> Tuple[str, str]:
@@ -488,6 +563,7 @@ _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("pipeline", step_pipeline),
     ("podracer", step_podracer),
     ("recorder", step_recorder),
+    ("profile", step_profile),
     ("refsan", step_refsan),
     ("chaos", step_chaos),
     ("locktrace", step_locktrace),

@@ -2,4 +2,4 @@
 
 from ray_tpu.devtools.lint.rules import (concurrency, conventions,  # noqa: F401
                                          hygiene, lifecycle, ownership,
-                                         retry, threadguard)
+                                         phases, retry, threadguard)

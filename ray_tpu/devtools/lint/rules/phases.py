@@ -1,0 +1,110 @@
+"""Phase-bracket rules (GL020).
+
+``flight_recorder.phase_begin(cat, name)`` opens an explicit span that
+only exists in the journal once the matching ``phase_end`` records it.
+A code path that leaves the function between the two (early ``return``
+or ``raise``) silently drops the span — the profile table then
+under-counts exactly the branch that bailed out, which is usually the
+interesting one. The end call belongs in a ``finally`` block (or the
+function must have no exit between the pair).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from ray_tpu.devtools.lint.annotate import _dotted
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+from ray_tpu.devtools.lint.callgraph import _leaf
+
+_BEGIN = "phase_begin"
+_END = "phase_end"
+
+
+def _calls_named(fn: ast.AST, name: str) -> List[ast.Call]:
+    return [n for n in ast.walk(fn)
+            if isinstance(n, ast.Call)
+            and _leaf(_dotted(n.func) or "") == name]
+
+
+def _direct_exits(fn: ast.AST) -> List[ast.AST]:
+    """Return/Raise statements belonging to this function (nested
+    function bodies excluded — their exits don't leave this frame)."""
+    exits: List[ast.AST] = []
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, (ast.Return, ast.Raise)):
+                exits.append(child)
+            visit(child)
+
+    for stmt in fn.body:
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            exits.append(stmt)
+        visit(stmt)
+    return exits
+
+
+def _finally_linenos(fn: ast.AST) -> Set[int]:
+    """Linenos of statements inside any ``finally`` block of this
+    function — a phase_end there runs on every path out."""
+    out: Set[int] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Try) and n.finalbody:
+            for stmt in n.finalbody:
+                for sub in ast.walk(stmt):
+                    if hasattr(sub, "lineno"):
+                        out.add(sub.lineno)
+    return out
+
+
+@register
+class UnclosedPhaseBracket(Rule):
+    id = "GL020"
+    name = "unclosed-phase-bracket"
+    rationale = ("a flight-recorder span opened with phase_begin only "
+                 "reaches the journal when phase_end records it; an "
+                 "early return/raise between the pair silently drops "
+                 "the span for exactly the bailing path — close it in "
+                 "a finally block")
+
+    def check(self, ctx) -> Iterator[Finding]:
+        for fn in (n for n in ast.walk(ctx.tree)
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))):
+            begins = _calls_named(fn, _BEGIN)
+            if not begins:
+                continue
+            end_lines = [c.lineno for c in _calls_named(fn, _END)]
+            in_finally = _finally_linenos(fn)
+            if any(line in in_finally for line in end_lines):
+                continue  # closed on every path out
+            first_begin = min(c.lineno for c in begins)
+            if not end_lines:
+                yield ctx.finding(
+                    self.id, begins[0],
+                    f"phase_begin at line {first_begin} has no "
+                    f"phase_end anywhere in `{fn.name}` — the span "
+                    f"never reaches the journal")
+                continue
+            first_end = min(line for line in end_lines
+                            if line >= first_begin) \
+                if any(line >= first_begin for line in end_lines) \
+                else None
+            for node in _direct_exits(fn):
+                if node.lineno <= first_begin:
+                    continue
+                if first_end is not None and node.lineno >= first_end:
+                    continue
+                kind = ("return" if isinstance(node, ast.Return)
+                        else "raise")
+                yield ctx.finding(
+                    self.id, node,
+                    f"early {kind} between phase_begin (line "
+                    f"{first_begin}) and its phase_end drops the span "
+                    f"on this path — move phase_end into a finally "
+                    f"block")

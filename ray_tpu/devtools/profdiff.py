@@ -1,0 +1,191 @@
+"""profdiff: regression diffing for profile / phase captures.
+
+Compares two captures and reports per-phase and per-frame deltas with
+PERF.md's ratio-based guard philosophy — absolute µs vary wildly across
+machines, ratios between two captures taken on the SAME machine do not.
+This is how the upcoming submit-path PRs land with "frame-encode
+41 µs → 9 µs" evidence instead of a single end-to-end number.
+
+Accepted capture formats (auto-detected, mix-and-match):
+
+* phase tables — ``whereis.task_path_attribution()`` report dicts
+  (``{"phases": {...}}``), ``perf.py --phases --json`` BENCH rows,
+  or a whole BENCH_core.json list (the ``task_phases`` row is used);
+* profiles — ``profiler.capture()`` dumps
+  (``{"kind": "rtpu-profile", "procs": {...}}``);
+* flight journals — ``ray_tpu.flight_journal()`` dumps (their
+  ``task_phase`` events are folded on the fly).
+
+Usage::
+
+    python -m ray_tpu.devtools.profdiff A.json B.json
+    python -m ray_tpu.devtools.profdiff A.json B.json --fail-ratio 1.3
+
+``--fail-ratio R`` exits non-zero when any phase's B/A mean-µs ratio
+exceeds R (phases under ``--min-count`` samples are ignored — a
+5-sample phase's mean is noise, not a regression).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Frames with fewer self-samples than this in BOTH captures are noise.
+MIN_FRAME_SAMPLES = 5
+
+
+def _load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        payload = json.load(f)
+    return normalize(payload)
+
+
+def normalize(payload: Any) -> Dict[str, Any]:
+    """Fold any accepted capture shape into
+    ``{"phases": {name: mean_us}, "counts": {name: n},
+       "frames": {frame: self_samples}, "samples": total}``."""
+    phases: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    frames: Dict[str, int] = {}
+    samples = 0
+
+    if isinstance(payload, list):
+        # BENCH_core.json: use the task_phases row
+        row = next((r for r in payload
+                    if isinstance(r, dict)
+                    and r.get("bench") == "task_phases"), None)
+        payload = row or {}
+
+    if isinstance(payload, dict) and "journals" in payload:
+        from ray_tpu.devtools import whereis
+        payload = whereis.task_path_attribution(
+            {label: [tuple(ev) for ev in events]
+             for label, events in payload["journals"].items()})
+
+    if isinstance(payload, dict):
+        for name, row in (payload.get("phases") or {}).items():
+            if isinstance(row, dict):
+                if row.get("mean_us") is not None:
+                    phases[name] = float(row["mean_us"])
+                counts[name] = int(row.get("count", 0))
+            else:  # bare {phase: mean_us} tables are fine too
+                phases[name] = float(row)
+        for snap in (payload.get("procs") or {}).values():
+            for stack, n in (snap.get("counts") or {}).items():
+                leaf = stack.rsplit(";", 1)[-1]
+                frames[leaf] = frames.get(leaf, 0) + int(n)
+                samples += int(n)
+    return {"phases": phases, "counts": counts, "frames": frames,
+            "samples": samples}
+
+
+def diff(a: Dict[str, Any], b: Dict[str, Any],
+         min_count: int = 0) -> Dict[str, Any]:
+    """Per-phase mean-µs deltas (+ ratios) and per-frame self-sample
+    share deltas between two normalized captures."""
+    phase_rows: List[Dict[str, Any]] = []
+    for name in sorted(set(a["phases"]) | set(b["phases"])):
+        va, vb = a["phases"].get(name), b["phases"].get(name)
+        row: Dict[str, Any] = {"phase": name, "a_us": va, "b_us": vb,
+                               "count_a": a["counts"].get(name, 0),
+                               "count_b": b["counts"].get(name, 0)}
+        if va is not None and vb is not None:
+            row["delta_us"] = round(vb - va, 2)
+            row["ratio"] = round(vb / va, 3) if va > 0 else None
+        phase_rows.append(row)
+
+    frame_rows: List[Dict[str, Any]] = []
+    sa, sb = a["samples"], b["samples"]
+    if sa and sb:
+        for frame in set(a["frames"]) | set(b["frames"]):
+            na, nb = a["frames"].get(frame, 0), b["frames"].get(frame, 0)
+            if max(na, nb) < MIN_FRAME_SAMPLES:
+                continue
+            fa, fb = na / sa, nb / sb
+            frame_rows.append({
+                "frame": frame, "a_pct": round(fa * 100, 2),
+                "b_pct": round(fb * 100, 2),
+                "delta_pct": round((fb - fa) * 100, 2),
+            })
+        frame_rows.sort(key=lambda r: -abs(r["delta_pct"]))
+
+    worst = None
+    for row in phase_rows:
+        if row.get("ratio") is None:
+            continue
+        if min_count and min(row["count_a"], row["count_b"]) < min_count:
+            continue
+        if worst is None or row["ratio"] > worst["ratio"]:
+            worst = row
+    return {"phases": phase_rows, "frames": frame_rows, "worst": worst}
+
+
+def render(report: Dict[str, Any], fail_ratio: Optional[float] = None
+           ) -> str:
+    lines = ["profdiff: B vs A (ratio > 1 means B is slower)"]
+    if report["phases"]:
+        lines.append("  %-16s %10s %10s %10s %8s"
+                     % ("phase", "A_us", "B_us", "delta_us", "ratio"))
+        for row in report["phases"]:
+            fmt = lambda v: "—" if v is None else f"{v:.2f}"  # noqa: E731
+            ratio = row.get("ratio")
+            flag = ""
+            if fail_ratio is not None and ratio is not None:
+                if ratio > fail_ratio:
+                    flag = "  << REGRESSION"
+                elif ratio < 1.0 / fail_ratio:
+                    flag = "  << improved"
+            lines.append("  %-16s %10s %10s %10s %8s%s"
+                         % (row["phase"], fmt(row["a_us"]),
+                            fmt(row["b_us"]),
+                            fmt(row.get("delta_us")),
+                            "—" if ratio is None else f"{ratio:.3f}",
+                            flag))
+    if report["frames"]:
+        lines.append("  top frame movers (self-sample share):")
+        for row in report["frames"][:15]:
+            lines.append("    %-48s %6.2f%% -> %6.2f%%  (%+.2f%%)"
+                         % (row["frame"][:48], row["a_pct"],
+                            row["b_pct"], row["delta_pct"]))
+    if not report["phases"] and not report["frames"]:
+        lines.append("  (captures share no comparable phases or frames)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    args: List[str] = []
+    fail_ratio: Optional[float] = None
+    min_count = 0
+    it = iter(argv)
+    for tok in it:
+        if tok == "--fail-ratio":
+            fail_ratio = float(next(it))
+        elif tok == "--min-count":
+            min_count = int(next(it))
+        elif tok.startswith("--"):
+            args = []           # unknown flag: force the usage message
+            break
+        else:
+            args.append(tok)
+    if len(args) != 2:
+        print("usage: python -m ray_tpu.devtools.profdiff A.json B.json"
+              " [--fail-ratio R] [--min-count N]", file=sys.stderr)
+        return 2
+    a, b = _load(args[0]), _load(args[1])
+    report = diff(a, b, min_count=min_count)
+    print(render(report, fail_ratio=fail_ratio))
+    worst = report["worst"]
+    if (fail_ratio is not None and worst is not None
+            and worst["ratio"] is not None
+            and worst["ratio"] > fail_ratio):
+        print(f"FAIL: {worst['phase']} ratio {worst['ratio']:.3f} > "
+              f"{fail_ratio}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,324 @@
+"""Cluster-wide sampling profiler (reference: py-spy-backed stack
+sampling behind the reference dashboard's per-worker flamegraphs).
+
+A per-process daemon thread samples every live thread's stack via
+``sys._current_frames()`` at ``profiler_hz`` (default 101 — a prime, so
+the sampler doesn't phase-lock with 10ms/100ms periodic work) and folds
+each stack into a collapsed-stack count keyed by thread *role*
+(io-loop / executor / main / flight-flush / …). Workers ship their
+cumulative counts to the driver over the same control channel the
+flight recorder uses (``profile_push``); the driver store keeps the
+latest snapshot per process, so pushes are idempotent and a lost one
+costs staleness, not correctness.
+
+Exports: ``ray_tpu.profile_dump()`` (folded text — every flamegraph
+tool eats it), ``util/timeline.speedscope_profile()`` (speedscope JSON),
+``GET /api/profile`` + the dashboard's #/profiler flamegraph view.
+
+Gating (PERF.md discipline): opt-in via ``RAY_TPU_PROFILER=1`` (env,
+not config — it must ride the inherited environment into spawned
+workers, like refsan). When off, nothing runs — no thread, no
+per-sample cost; the only residue is the module-level ``PROFILER is
+None`` gate on the read paths.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+DEFAULT_HZ = 101
+MAX_STACK_DEPTH = 64
+# Distinct collapsed stacks kept per process before folding new ones
+# into an <overflow> bucket — bounds sampler memory on pathological
+# (deep-recursion / codegen) workloads.
+MAX_UNIQUE_STACKS = 20_000
+
+_ENV_FLAG = "RAY_TPU_PROFILER"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV_FLAG, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def _role(thread_name: str) -> str:
+    """Fold raw thread names into the stable role keys the folded
+    output and the dashboard group by."""
+    name = thread_name or ""
+    if name.startswith("rtpu-io-loop"):
+        return "io-loop"
+    if (name.startswith("task-runner") or name.startswith("actor-loop")
+            or name.startswith("ThreadPoolExecutor")):
+        return "executor"
+    if name == "MainThread":
+        return "main"
+    if name == "flight-flush":
+        return "flight-flush"
+    return name or "other"
+
+
+class Sampler(threading.Thread):
+    """Per-process sampling daemon. ``counts`` maps a collapsed stack
+    (``role;frame;frame;…`` root-first) to how many samples landed in
+    it; reads are racy-but-safe (dict ops are atomic under the GIL and
+    a torn read only miscounts the snapshot by one sample)."""
+
+    def __init__(self, label: str, hz: int = DEFAULT_HZ):
+        super().__init__(name="rtpu-profiler", daemon=True)
+        self.label = label
+        self.hz = max(1, int(hz))
+        self.counts: Dict[str, int] = {}
+        self.samples = 0
+        self.started_at = time.time()
+        self._stop_ev = threading.Event()
+
+    def run(self) -> None:
+        interval = 1.0 / self.hz
+        while not self._stop_ev.wait(interval):
+            try:
+                self.sample_once()
+            except Exception:  # graftlint: disable=GL004
+                pass  # a torn frame walk must never kill the sampler
+
+    def stop(self) -> None:
+        self._stop_ev.set()
+
+    def sample_once(self) -> None:
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        me = threading.get_ident()
+        counts = self.counts
+        for tid, frame in frames.items():
+            if tid == me:
+                continue  # never profile the profiler
+            stack: List[str] = []
+            f = frame
+            while f is not None and len(stack) < MAX_STACK_DEPTH:
+                code = f.f_code
+                stack.append("%s:%s" % (
+                    os.path.basename(code.co_filename), code.co_name))
+                f = f.f_back
+            stack.reverse()  # folded convention: root first
+            key = _role(names.get(tid, "")) + ";" + ";".join(stack)
+            if key not in counts and len(counts) >= MAX_UNIQUE_STACKS:
+                key = _role(names.get(tid, "")) + ";<overflow>"
+            counts[key] = counts.get(key, 0) + 1
+            self.samples += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"counts": dict(self.counts), "samples": self.samples,
+                "hz": self.hz, "started_at": self.started_at}
+
+
+# Module gate — read paths check ``PROFILER is not None``.
+PROFILER: Optional[Sampler] = None
+
+
+def enabled() -> bool:
+    return PROFILER is not None
+
+
+def enable(label: Optional[str] = None, hz: Optional[int] = None) -> Sampler:
+    """Start (or restart) the in-process sampler."""
+    global PROFILER
+    disable()
+    if hz is None:
+        from ray_tpu.core.config import get_config
+        hz = get_config().profiler_hz
+    sampler = Sampler(label or f"proc:{os.getpid()}", hz=hz)
+    sampler.start()
+    PROFILER = sampler
+    return sampler
+
+
+def disable() -> Optional[Sampler]:
+    """Stop the sampler; returns it (counts intact) for late reads."""
+    global PROFILER
+    sampler = PROFILER
+    PROFILER = None
+    if sampler is not None:
+        sampler.stop()
+    return sampler
+
+
+# --- driver-side store ---------------------------------------------------
+
+class ProfileStore:
+    """Latest profile snapshot per process label. Replace-on-push:
+    workers send cumulative counts, so the newest push is the whole
+    truth for that process and dedup/ordering logic is unnecessary."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._procs: Dict[str, Dict[str, Any]] = {}
+
+    def push(self, label: str, counts: Dict[str, int], samples: int,
+             hz: int) -> None:
+        with self._lock:
+            self._procs[label] = {
+                "counts": dict(counts), "samples": int(samples),
+                "hz": int(hz), "updated_at": time.time(),
+            }
+
+    def profiles(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {label: dict(snap)
+                    for label, snap in self._procs.items()}
+
+
+_STORE: Optional[ProfileStore] = None
+
+
+def get_store() -> ProfileStore:
+    global _STORE
+    if _STORE is None:
+        _STORE = ProfileStore()
+    return _STORE
+
+
+def store_push(label: str, counts: Dict[str, int], samples: int,
+               hz: int) -> None:
+    get_store().push(label, counts, samples, hz)
+
+
+def merged_profiles() -> Dict[str, Dict[str, Any]]:
+    """label -> {counts, samples, hz}: pushed worker snapshots plus the
+    live local sampler (driver samples never cross a channel)."""
+    out = get_store().profiles()
+    sampler = PROFILER
+    if sampler is not None:
+        out[sampler.label] = sampler.snapshot()
+    return out
+
+
+# --- process wiring ------------------------------------------------------
+
+def init_driver() -> None:
+    """Reset the store and start the driver's sampler when the env flag
+    is set. Called from DriverRuntime.__init__ (the env flag itself is
+    what spawned workers inherit — nothing to mirror)."""
+    global _STORE
+    _STORE = ProfileStore()
+    disable()
+    stop_pusher()
+    if _env_enabled():
+        enable(label=f"driver:{os.getpid()}")
+
+
+def init_worker(rt, worker_id) -> None:
+    """Start the sampler + the push thread in a worker process (no-op
+    unless the driver ran with RAY_TPU_PROFILER=1)."""
+    if not _env_enabled():
+        return
+    from ray_tpu.core.config import get_config
+    sampler = enable(label=f"worker:{worker_id.hex()[:12]}:pid:{os.getpid()}")
+    start_pusher(rt, sampler,
+                 interval_s=get_config().profiler_push_interval_s)
+
+
+class _Pusher(threading.Thread):
+    """Worker-side daemon shipping cumulative counts to the driver
+    store every interval (flight-recorder _Flusher discipline: backoff
+    on failure, give up after 3 consecutive — the channel is gone)."""
+
+    def __init__(self, rt, sampler: Sampler, interval_s: float):
+        super().__init__(name="profile-push", daemon=True)
+        self._rt = rt
+        self._sampler = sampler
+        self._interval = max(0.1, float(interval_s))
+        self._stop = threading.Event()
+
+    def push_once(self) -> None:
+        snap = self._sampler.snapshot()
+        self._rt.gcs_call("profile_push", self._sampler.label,
+                          snap["counts"], snap["samples"], snap["hz"])
+
+    def run(self) -> None:
+        from ray_tpu.util.backoff import Backoff
+
+        backoff = Backoff(initial_s=self._interval,
+                          max_s=8 * self._interval)
+        failures = 0
+        delay = self._interval
+        while not self._stop.wait(delay):
+            try:
+                self.push_once()
+                failures = 0
+                backoff.reset()
+                delay = self._interval
+            except Exception:  # noqa: BLE001
+                failures += 1
+                if failures >= 3:
+                    return
+                delay = backoff.next_delay()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.push_once()  # final snapshot, best effort
+        except Exception:  # graftlint: disable=GL004
+            pass  # shutdown race: the control channel may be gone
+
+
+_pusher: Optional[_Pusher] = None
+
+
+def start_pusher(rt, sampler: Sampler, interval_s: float) -> None:
+    global _pusher
+    _pusher = _Pusher(rt, sampler, interval_s)
+    _pusher.start()
+
+
+def stop_pusher() -> None:
+    global _pusher
+    if _pusher is not None:
+        _pusher.stop()
+        _pusher = None
+
+
+# --- export --------------------------------------------------------------
+
+def folded(proc: Optional[str] = None) -> Dict[str, int]:
+    """Merged collapsed-stack counts (``proc;role;frame;… -> n``),
+    optionally narrowed to one process label."""
+    out: Dict[str, int] = {}
+    for label, snap in merged_profiles().items():
+        if proc is not None and label != proc:
+            continue
+        for stack, n in snap.get("counts", {}).items():
+            key = f"{label};{stack}"
+            out[key] = out.get(key, 0) + int(n)
+    return out
+
+
+def dump(filename: Optional[str] = None,
+         proc: Optional[str] = None) -> str:
+    """Folded text: one ``proc;role;frame;frame count`` line per stack
+    — feed it to any flamegraph/speedscope importer."""
+    lines = [f"{stack} {n}"
+             for stack, n in sorted(folded(proc).items())]
+    text = "\n".join(lines) + ("\n" if lines else "")
+    if filename:
+        with open(filename, "w") as f:
+            f.write(text)
+    return text
+
+
+def capture(filename: Optional[str] = None) -> Dict[str, Any]:
+    """JSON capture for ``profdiff``: per-process cumulative counts."""
+    payload = {
+        "kind": "rtpu-profile",
+        "procs": {label: {"counts": snap.get("counts", {}),
+                          "samples": snap.get("samples", 0),
+                          "hz": snap.get("hz", 0)}
+                  for label, snap in merged_profiles().items()},
+    }
+    if filename:
+        import json
+        with open(filename, "w") as f:
+            json.dump(payload, f, indent=1)
+    return payload

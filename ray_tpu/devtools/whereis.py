@@ -197,6 +197,134 @@ def attribution(journals: Optional[Dict[str, List[tuple]]] = None
     }
 
 
+# --- submit-path phase attribution (PR 18) ---------------------------
+# core/task_phase.py brackets 1-in-N submissions into a contiguous
+# spec-build → result-return chain of ``task_phase`` events; this fold
+# turns them into the per-phase µs budget ROADMAP item 2 is judged
+# against. ``coverage`` is the union of the sampled chains' spans over
+# the window — the fraction of submit+drain wall time the table
+# accounts for (acceptance bar: ≥ 0.85 on the 20k-task harness).
+
+def task_path_attribution(
+        journals: Optional[Dict[str, List[tuple]]] = None,
+        window_ns: Optional[tuple] = None) -> Dict[str, Any]:
+    """Fold ``task_phase`` events into {phase: {count, total_us,
+    mean_us, p50_us, p99_us}} plus chain-level coverage. ``window_ns``
+    is an optional (lo, hi) pair in the driver clock domain (the bench
+    harness passes its measured submit+drain window); without it the
+    span of the phase events themselves is used."""
+    if journals is None:
+        from ray_tpu.util import flight_recorder
+        journals = flight_recorder.merged_journals()
+
+    from ray_tpu.core.task_phase import PHASES
+    per: Dict[str, List[int]] = {}
+    intervals: List[tuple] = []
+    for label, events in journals.items():
+        for seq, t0, dur, cat, name, args in events:
+            if cat != "task_phase":
+                continue
+            per.setdefault(name, []).append(dur)
+            intervals.append((t0, t0 + dur))
+
+    if window_ns is not None:
+        lo, hi = window_ns
+    elif intervals:
+        lo = min(iv[0] for iv in intervals)
+        hi = max(iv[1] for iv in intervals)
+    else:
+        lo = hi = 0
+
+    # union of chain spans, clipped to the window
+    covered = 0
+    cur_lo = cur_hi = None
+    for s, e in sorted(intervals):
+        s, e = max(s, lo), min(e, hi)
+        if e <= s:
+            continue
+        if cur_hi is None or s > cur_hi:
+            if cur_hi is not None:
+                covered += cur_hi - cur_lo
+            cur_lo, cur_hi = s, e
+        else:
+            cur_hi = max(cur_hi, e)
+    if cur_hi is not None:
+        covered += cur_hi - cur_lo
+    window = hi - lo
+    coverage = covered / window if window > 0 else None
+
+    def _q(durs: List[int], q: float) -> Optional[float]:
+        if not durs:
+            return None
+        i = min(len(durs) - 1, int(q * len(durs)))
+        return durs[i] / 1e3
+
+    phases: Dict[str, Dict[str, Any]] = {}
+    order = [p for p in PHASES if p in per] + sorted(
+        p for p in per if p not in PHASES)
+    for name in order:
+        durs = sorted(per[name])
+        total = sum(durs)
+        phases[name] = {
+            "count": len(durs),
+            "total_us": round(total / 1e3, 1),
+            "mean_us": round(total / len(durs) / 1e3, 2),
+            "p50_us": round(_q(durs, 0.50), 2),
+            "p99_us": round(_q(durs, 0.99), 2),
+        }
+
+    tasks = len(per.get("result-return", ()))
+    chain_total = sum(v["total_us"] for v in phases.values())
+    # e2e percentiles off the live histogram when available — tolerant
+    # of empty/None snapshots (util/metrics.py returns None, never
+    # raises, on an unobserved series)
+    e2e = {}
+    try:
+        from ray_tpu.core.task_manager import TASK_E2E_SECONDS
+        for q in (0.5, 0.99):
+            value = TASK_E2E_SECONDS.percentile(q)
+            if value is not None:
+                e2e[f"p{int(q * 100)}_ms"] = round(value * 1e3, 3)
+    except Exception:  # graftlint: disable=GL004
+        pass  # offline dumps have no runtime/registry to read from
+
+    return {
+        "phases": phases,
+        "tasks_sampled": tasks,
+        "mean_chain_us": (round(chain_total / tasks, 1)
+                          if tasks else None),
+        "window_s": round(window / 1e9, 6),
+        "coverage": (round(coverage, 4)
+                     if coverage is not None else None),
+        "task_e2e": e2e or None,
+    }
+
+
+def render_task_path(report: Dict[str, Any]) -> str:
+    lines = ["submit-path phase budget (flight recorder, sampled)"]
+    lines.append(
+        f"  tasks sampled: {report['tasks_sampled']}  "
+        f"window: {report['window_s'] * 1e3:.1f}ms  "
+        + (f"coverage: {report['coverage'] * 100:.1f}%"
+           if report["coverage"] is not None else "coverage: n/a"))
+    lines.append("  %-16s %8s %10s %10s %10s %12s"
+                 % ("phase", "count", "mean_us", "p50_us", "p99_us",
+                    "total_ms"))
+    for name, row in report["phases"].items():
+        lines.append("  %-16s %8d %10.2f %10.2f %10.2f %12.2f"
+                     % (name, row["count"], row["mean_us"],
+                        row["p50_us"], row["p99_us"],
+                        row["total_us"] / 1e3))
+    if report["mean_chain_us"] is not None:
+        lines.append(f"  mean sampled chain: "
+                     f"{report['mean_chain_us']:.1f}us/task")
+    e2e = report.get("task_e2e")
+    if e2e:
+        lines.append("  task e2e: " + "  ".join(
+            f"{k}={v}" for k, v in e2e.items()))
+    return "\n".join(lines)
+
+
 def render(report: Dict[str, Any]) -> str:
     lines = ["step-time attribution (flight recorder)"]
     lines.append(f"  pipeline stages: {report['stages']}  "
@@ -259,14 +387,19 @@ def _load_journals(path: str) -> Dict[str, List[tuple]]:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    task_path = "--task-path" in argv
+    argv = [a for a in argv if a != "--task-path"]
     if not argv:
         print("usage: python -m ray_tpu.devtools.whereis "
-              "<journal.json>\n(write one with "
+              "[--task-path] <journal.json>\n(write one with "
               "ray_tpu.flight_journal('journal.json'))",
               file=sys.stderr)
         return 2
-    report = attribution(_load_journals(argv[0]))
-    print(render(report))
+    journals = _load_journals(argv[0])
+    if task_path:
+        print(render_task_path(task_path_attribution(journals)))
+    else:
+        print(render(attribution(journals)))
     return 0
 
 

@@ -300,6 +300,103 @@ def bench_events_overhead(rt, n: int) -> dict:
             if dt_off > 0 else 1.0}
 
 
+def bench_phases(rt, n: int, sample_n: int = 64) -> dict:
+    """Submit-path phase budget (PR 18): recorder on + 1-in-N task
+    sampling over the 20k-trivial-task harness, folded by
+    whereis.task_path_attribution against the independently measured
+    submit+drain wall window. ``coverage`` is the fraction of that
+    window tiled by the sampled chains (acceptance bar: >= 0.85);
+    the per-phase µs means are the baseline ROADMAP item 2 attacks."""
+    import ray_tpu
+    from ray_tpu.core import task_phase
+    from ray_tpu.core.config import get_config
+    from ray_tpu.devtools import whereis as whereis_mod
+    from ray_tpu.util import flight_recorder as fr
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(1000)])
+    cfg = get_config()
+    saved = (fr.RECORDER, cfg.task_phase_sample_n)
+    task_phase.reset()
+    try:
+        cfg.task_phase_sample_n = sample_n
+        # capacity: 9 events x n/sample_n chains, with headroom
+        fr.enable("driver:phases", capacity=max(4096,
+                                                12 * n // sample_n))
+        lo_ns = fr.clock_ns()
+        t0 = time.perf_counter()
+        refs = [nop.remote() for _ in range(n)]
+        ray_tpu.get(refs)
+        wall = time.perf_counter() - t0
+        hi_ns = fr.clock_ns()
+        report = whereis_mod.task_path_attribution(
+            fr.merged_journals(), window_ns=(lo_ns, hi_ns))
+    finally:
+        fr.RECORDER, cfg.task_phase_sample_n = saved
+        task_phase.reset()
+    return {"bench": "task_phases", "n": n, "sample_n": sample_n,
+            "wall_s": round(wall, 3),
+            "tasks_sampled": report["tasks_sampled"],
+            "coverage": report["coverage"],
+            "mean_chain_us": report["mean_chain_us"],
+            "phases": report["phases"]}
+
+
+def bench_profiler_overhead(rt, n: int) -> dict:
+    """Sampling-profiler cost on the tight trivial-task loop:
+    interleaved best-of-2 A/B — gates off vs the full observatory on
+    (driver sampler at the configured Hz + recorder + phase sampling).
+    The committed guard bounds live in tests/test_profiler.py; this
+    row is the measured ratio for PERF.md / BENCH_core.json."""
+    import ray_tpu
+    from ray_tpu.core import task_phase
+    from ray_tpu.core.config import get_config
+    from ray_tpu.devtools import profiler
+    from ray_tpu.util import flight_recorder as fr
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(1000)])
+    cfg = get_config()
+    saved = (fr.RECORDER, profiler.PROFILER, cfg.task_phase_sample_n)
+    best = {False: None, True: None}
+    try:
+        for _ in range(2):
+            for enabled in (False, True):
+                if enabled:
+                    cfg.task_phase_sample_n = 64
+                    fr.enable("driver:bench")
+                    profiler.enable("driver:bench")
+                else:
+                    cfg.task_phase_sample_n = saved[2]
+                    fr.disable()
+                    profiler.disable()
+                task_phase.reset()
+                t0 = time.perf_counter()
+                ray_tpu.get([nop.remote() for _ in range(n)])
+                dt = time.perf_counter() - t0
+                if best[enabled] is None or dt < best[enabled]:
+                    best[enabled] = dt
+    finally:
+        profiler.disable()
+        fr.RECORDER, _, cfg.task_phase_sample_n = saved
+        if saved[1] is not None:   # restart a preexisting sampler
+            profiler.enable(saved[1].label, hz=saved[1].hz)
+        task_phase.reset()
+    dt_off, dt_on = best[False], best[True]
+    return {"bench": "profiler_overhead", "n": n,
+            "hz": get_config().profiler_hz,
+            "seconds_disabled": round(dt_off, 3),
+            "seconds_enabled": round(dt_on, 3),
+            "enabled_over_disabled": round(dt_on / dt_off, 3)
+            if dt_off > 0 else 1.0}
+
+
 def bench_process_threads(rt) -> dict:
     """Thread topology after a warm workload: with the selector IO
     loop, socket service is ONE rtpu-io-loop thread regardless of
@@ -411,6 +508,15 @@ def main(argv=None) -> None:
                         help="measure cluster-event-plane overhead on "
                              "the trivial-task loop (interleaved "
                              "best-of-3, enabled vs disabled)")
+    parser.add_argument("--phases", action="store_true",
+                        help="submit-path phase budget: recorder + 1-in-"
+                             "64 task sampling over the trivial-task "
+                             "loop, folded per phase (coverage target "
+                             ">= 0.85 of submit+drain wall time)")
+    parser.add_argument("--profiler", action="store_true",
+                        help="measure sampling-profiler overhead on the "
+                             "trivial-task loop (full observatory on vs "
+                             "off, interleaved best-of-2)")
     parser.add_argument("--envelope", action="store_true",
                         help="cluster-envelope scaling: throughput, "
                              "head thread count, and RSS at 16/64/128 "
@@ -454,6 +560,14 @@ def main(argv=None) -> None:
         print(json.dumps(out), flush=True)
     if args.events:
         out = bench_events_overhead(rt, args.tasks)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    if args.phases:
+        out = bench_phases(rt, args.tasks)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    if args.profiler:
+        out = bench_profiler_overhead(rt, args.tasks)
         results.append(out)
         print(json.dumps(out), flush=True)
     if args.compare_wire:

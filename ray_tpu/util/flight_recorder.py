@@ -176,6 +176,24 @@ def instant(cat: str, name: str, args: Optional[dict] = None) -> None:
         rec.record(cat, name, clock_ns(), 0, args)
 
 
+def phase_begin(cat: str, name: str) -> Optional[int]:
+    """Open an explicit span: returns the start ns (None when the
+    recorder is off — phase_end treats None as a no-op). The matching
+    ``phase_end`` MUST run on every code path out of the function;
+    wrap the body in try/finally, or graftlint GL020 flags the early
+    return/raise that would silently drop the span."""
+    rec = RECORDER
+    return rec.clock() if rec is not None else None
+
+
+def phase_end(cat: str, name: str, t0: Optional[int],
+              args: Optional[dict] = None) -> None:
+    """Close a span opened by ``phase_begin``."""
+    rec = RECORDER
+    if rec is not None and t0 is not None:
+        rec.record(cat, name, t0, clock_ns() - t0, args)
+
+
 # --- wall-clock anchoring -----------------------------------------------
 # perf_counter_ns has an arbitrary per-process epoch. The driver pins
 # one (wall, perf) pair; every aligned journal timestamp is rendered as
@@ -390,14 +408,34 @@ def merged_journals() -> Dict[str, List[tuple]]:
     return out
 
 
+def _role_for_label(label: str) -> str:
+    """Human track name for a journal label: ``driver:4242`` → driver,
+    ``worker:ab12cd34ef56:pid:77`` → worker-ab12cd34."""
+    if label.startswith("driver"):
+        return "driver"
+    if label.startswith("worker:"):
+        return "worker-" + label.split(":")[1][:8]
+    return label.split(":")[0] or label
+
+
 def chrome_events() -> List[Dict[str, Any]]:
     """Merged journals as Chrome-trace/Perfetto events: one ``pid``
     track per process, one ``tid`` row per category, complete ``X``
-    slices for spans and ``i`` instants for point events."""
+    slices for spans and ``i`` instants for point events. Each track
+    leads with ``process_name``/``thread_name`` metadata (``ph: M``) so
+    Perfetto labels rows by role (driver / worker-N / io-loop) instead
+    of bare journal labels."""
     wall_anchor, perf_anchor = _get_anchor()
     out: List[Dict[str, Any]] = []
     for label, events in merged_journals().items():
         pid = f"flight:{label}"
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0,
+                    "args": {"name": _role_for_label(label),
+                             "label": label}})
+        for cat in sorted({ev[3] for ev in events}):
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": cat, "args": {"name": cat}})
         for seq, t0, dur, cat, name, args in events:
             ts_us = (wall_anchor + (t0 - perf_anchor) / 1e9) * 1e6
             ev: Dict[str, Any] = {

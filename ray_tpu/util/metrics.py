@@ -242,9 +242,12 @@ def percentile_from_counts(bounds: Sequence[float],
     """Interpolated quantile from histogram bucket counts. ``buckets``
     has len(bounds)+1 entries (last = overflow). Linear interpolation
     inside the containing bucket; the unbounded overflow bucket reports
-    the top boundary (the histogram can't resolve beyond it)."""
+    the top boundary (the histogram can't resolve beyond it). Returns
+    None — never raises — on an empty/all-zero snapshot or a series
+    with no finite boundaries, so control loops (SLO autoscaler,
+    whereis) can poll before traffic exists."""
     count = sum(buckets)
-    if count <= 0:
+    if count <= 0 or not bounds:
         return None
     q = min(1.0, max(0.0, q))
     rank = q * count

@@ -124,3 +124,55 @@ def timeline(filename: Optional[str] = None, runtime=None,
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+def speedscope_profile(filename: Optional[str] = None,
+                       profiles: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+    """Render the sampling profiler's collapsed stacks
+    (devtools/profiler.py) in the speedscope file format — one sampled
+    profile per process, frames shared — loadable at
+    https://www.speedscope.app (File → Import) or via ``speedscope
+    file.json``. ``profiles`` defaults to the live merged store."""
+    if profiles is None:
+        from ray_tpu.devtools import profiler
+        profiles = profiler.merged_profiles()
+    frame_index: Dict[str, int] = {}
+    frames: List[Dict[str, str]] = []
+
+    def _frame(name: str) -> int:
+        idx = frame_index.get(name)
+        if idx is None:
+            idx = frame_index[name] = len(frames)
+            frames.append({"name": name})
+        return idx
+
+    rendered = []
+    for label in sorted(profiles):
+        snap = profiles[label]
+        samples: List[List[int]] = []
+        weights: List[int] = []
+        for stack, n in sorted(snap.get("counts", {}).items()):
+            samples.append([_frame(part)
+                            for part in stack.split(";") if part])
+            weights.append(int(n))
+        rendered.append({
+            "type": "sampled",
+            "name": label,
+            "unit": "none",        # weights are sample counts
+            "startValue": 0,
+            "endValue": sum(weights),
+            "samples": samples,
+            "weights": weights,
+        })
+    payload = {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": rendered,
+        "name": "ray_tpu profile",
+        "exporter": "ray_tpu",
+    }
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(payload, f)
+    return payload

@@ -1161,3 +1161,85 @@ def test_gl019_per_line_disable():
         "while not self._stopped:",
         "while not self._stopped:  # graftlint: disable=GL019")
     assert rules_hit(src, select=["GL019"]) == set()
+
+
+# -- GL020 unclosed phase bracket -------------------------------------
+
+GL020_POS_EARLY_RETURN = """
+    from ray_tpu.util import flight_recorder as fr
+
+    def send(self, spec):
+        t0 = fr.phase_begin("net", "wire-write")
+        if self._closed:
+            return None
+        self._sock.send(spec)
+        fr.phase_end("net", "wire-write", t0)
+"""
+
+GL020_POS_RAISE = """
+    from ray_tpu.util import flight_recorder as fr
+
+    def encode(self, spec):
+        t0 = fr.phase_begin("ser", "frame-encode")
+        if spec is None:
+            raise ValueError("no spec")
+        out = dumps(spec)
+        fr.phase_end("ser", "frame-encode", t0)
+        return out
+"""
+
+GL020_POS_NO_END = """
+    from ray_tpu.util import flight_recorder as fr
+
+    def leak(self):
+        t0 = fr.phase_begin("net", "never-closed")
+        self._work()
+"""
+
+GL020_NEG_FINALLY = """
+    from ray_tpu.util import flight_recorder as fr
+
+    def send(self, spec):
+        t0 = fr.phase_begin("net", "wire-write")
+        try:
+            if self._closed:
+                return None
+            self._sock.send(spec)
+        finally:
+            fr.phase_end("net", "wire-write", t0)
+"""
+
+GL020_NEG_STRAIGHT_LINE = """
+    from ray_tpu.util import flight_recorder as fr
+
+    def send(self, spec):
+        t0 = fr.phase_begin("net", "wire-write")
+        self._sock.send(spec)
+        fr.phase_end("net", "wire-write", t0)
+        return True
+"""
+
+
+def test_gl020_fires_on_early_return_and_raise():
+    findings = run(GL020_POS_EARLY_RETURN, select=["GL020"])
+    assert [f.rule for f in findings] == ["GL020"]
+    assert "finally" in findings[0].message
+    assert rules_hit(GL020_POS_RAISE, select=["GL020"]) == {"GL020"}
+
+
+def test_gl020_fires_when_end_missing_entirely():
+    findings = run(GL020_POS_NO_END, select=["GL020"])
+    assert [f.rule for f in findings] == ["GL020"]
+    assert "no phase_end" in findings[0].message
+
+
+def test_gl020_quiet_on_finally_and_straight_line():
+    assert rules_hit(GL020_NEG_FINALLY, select=["GL020"]) == set()
+    assert rules_hit(GL020_NEG_STRAIGHT_LINE, select=["GL020"]) == set()
+
+
+def test_gl020_per_line_disable():
+    src = GL020_POS_EARLY_RETURN.replace(
+        "return None",
+        "return None  # graftlint: disable=GL020")
+    assert rules_hit(src, select=["GL020"]) == set()

@@ -63,7 +63,9 @@ def test_chrome_events_schema(fresh_recorder):
     rec.instant("object", "serve_out", {"bytes": 64})
     fr.store_push("worker:bb", [(0, t0, 1_000, "shuffle", "map_wave",
                                  {"order": 0})], 0)
-    events = json.loads(json.dumps(fr.chrome_events()))
+    all_events = json.loads(json.dumps(fr.chrome_events()))
+    meta = [ev for ev in all_events if ev["ph"] == "M"]
+    events = [ev for ev in all_events if ev["ph"] != "M"]
     assert len(events) == 3
     pids = {ev["pid"] for ev in events}
     assert pids == {"flight:test:export", "flight:worker:bb"}
@@ -74,6 +76,16 @@ def test_chrome_events_schema(fresh_recorder):
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
         else:
             assert ev["ph"] == "i" and ev.get("s") == "t"
+    # Perfetto polish: every track leads with process_name/thread_name
+    # metadata naming the role instead of the bare journal label.
+    proc_names = {ev["pid"]: ev["args"]["name"] for ev in meta
+                  if ev["name"] == "process_name"}
+    assert set(proc_names) == pids
+    assert proc_names["flight:worker:bb"] == "worker-bb"
+    thread_rows = {(ev["pid"], ev["tid"]) for ev in meta
+                   if ev["name"] == "thread_name"}
+    assert ("flight:test:export", "pipeline") in thread_rows
+    assert ("flight:worker:bb", "shuffle") in thread_rows
 
 
 def test_whereis_attribution_from_synthetic_journal(fresh_recorder,

@@ -1,0 +1,361 @@
+"""Perf observatory (PR 18): sampling profiler attribution, folded /
+speedscope export, submit-path phase chains, profdiff round-trip,
+percentile None-contract, and the overhead ratio guards."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from ray_tpu.devtools import profdiff, profiler
+from ray_tpu.util import flight_recorder as fr
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import timeline
+
+
+@pytest.fixture
+def fresh_profiler():
+    """Isolate module-level sampler/store state per test."""
+    saved = (profiler.PROFILER, profiler._STORE)
+    profiler.PROFILER = None
+    profiler._STORE = profiler.ProfileStore()
+    yield
+    sampler = profiler.PROFILER
+    if sampler is not None:
+        sampler.stop()
+    profiler.PROFILER, profiler._STORE = saved
+
+
+# --- sampler ----------------------------------------------------------
+
+def _busy_spin(deadline: float) -> int:
+    acc = 0
+    while time.perf_counter() < deadline:
+        acc += 1
+    return acc
+
+
+@pytest.mark.skipif(not hasattr(__import__("sys"), "_current_frames"),
+                    reason="no sys._current_frames on this interpreter")
+def test_sampler_attributes_busy_function(fresh_profiler):
+    """>= 50% of main-thread samples must land in the seeded busy
+    function — the whole point of the profiler is attribution."""
+    sampler = profiler.enable("driver:test", hz=250)
+    try:
+        _busy_spin(time.perf_counter() + 0.4)
+    finally:
+        profiler.disable()
+    snap = sampler.snapshot()
+    assert snap["samples"] > 0 and snap["hz"] == 250
+    main = {s: n for s, n in snap["counts"].items()
+            if s.startswith("main;")}
+    assert main, f"no main-thread samples in {list(snap['counts'])[:5]}"
+    mine = sum(n for s, n in main.items() if "_busy_spin" in s)
+    frac = mine / sum(main.values())
+    assert frac >= 0.5, f"only {frac:.0%} attributed to _busy_spin"
+    # folded convention: root first, role prefix, file:func frames
+    stack = next(s for s in main if "_busy_spin" in s)
+    assert stack.split(";")[-1] == "test_profiler.py:_busy_spin"
+
+
+def test_sampler_never_samples_itself(fresh_profiler):
+    sampler = profiler.Sampler("t", hz=50)
+    # not started: drive one sample from this thread and check the
+    # sampler's own thread id is excluded by construction
+    sampler.sample_once()
+    assert all("rtpu-profiler" not in s for s in sampler.counts)
+
+
+def test_role_folding():
+    assert profiler._role("rtpu-io-loop-0") == "io-loop"
+    assert profiler._role("task-runner-3") == "executor"
+    assert profiler._role("actor-loop-1") == "executor"
+    assert profiler._role("ThreadPoolExecutor-0_1") == "executor"
+    assert profiler._role("MainThread") == "main"
+    assert profiler._role("flight-flush") == "flight-flush"
+    assert profiler._role("") == "other"
+    assert profiler._role("my-thread") == "my-thread"
+
+
+def test_enable_disable_gate(fresh_profiler):
+    assert not profiler.enabled()
+    sampler = profiler.enable("driver:gate", hz=97)
+    assert profiler.enabled() and profiler.PROFILER is sampler
+    back = profiler.disable()
+    assert back is sampler and not profiler.enabled()
+    assert profiler.disable() is None          # idempotent
+
+
+def test_env_gate_off_means_no_thread(fresh_profiler, monkeypatch):
+    monkeypatch.delenv(profiler._ENV_FLAG, raising=False)
+    profiler.init_driver()
+    assert not profiler.enabled()
+    monkeypatch.setenv(profiler._ENV_FLAG, "1")
+    try:
+        profiler.init_driver()
+        assert profiler.enabled()
+    finally:
+        profiler.disable()
+
+
+# --- store + export ---------------------------------------------------
+
+def test_store_replace_on_push(fresh_profiler):
+    profiler.store_push("worker:aa", {"main;f": 3}, 3, 101)
+    profiler.store_push("worker:aa", {"main;f": 9, "main;g": 1}, 10, 101)
+    procs = profiler.get_store().profiles()
+    assert procs["worker:aa"]["samples"] == 10
+    assert procs["worker:aa"]["counts"] == {"main;f": 9, "main;g": 1}
+
+
+def test_folded_dump_and_speedscope(fresh_profiler, tmp_path):
+    profiler.store_push("worker:aa", {"main;a.py:f;a.py:g": 4}, 4, 101)
+    profiler.store_push("worker:bb", {"executor;b.py:h": 2}, 2, 101)
+
+    folded = profiler.folded()
+    assert folded == {"worker:aa;main;a.py:f;a.py:g": 4,
+                      "worker:bb;executor;b.py:h": 2}
+    assert profiler.folded(proc="worker:bb") == {
+        "worker:bb;executor;b.py:h": 2}
+
+    out = tmp_path / "prof.folded"
+    text = profiler.dump(str(out))
+    assert out.read_text() == text
+    assert "worker:aa;main;a.py:f;a.py:g 4" in text.splitlines()
+
+    scope = timeline.speedscope_profile(
+        profiles=profiler.merged_profiles())
+    assert scope["$schema"].startswith("https://www.speedscope.app")
+    by_name = {p["name"]: p for p in scope["profiles"]}
+    assert set(by_name) == {"worker:aa", "worker:bb"}
+    frames = [f["name"] for f in scope["shared"]["frames"]]
+    prof = by_name["worker:aa"]
+    assert prof["endValue"] == sum(prof["weights"]) == 4
+    # frame indices resolve through the shared table, root first
+    (stack,) = prof["samples"]
+    assert [frames[i] for i in stack] == ["main", "a.py:f", "a.py:g"]
+
+
+def test_profile_dump_api(fresh_profiler):
+    import ray_tpu
+    profiler.store_push("worker:aa", {"main;f": 1}, 1, 101)
+    assert "worker:aa;main;f 1" in ray_tpu.profile_dump()
+
+
+# --- profdiff ---------------------------------------------------------
+
+def _phase_table(us):
+    return {"phases": {name: {"count": 100, "mean_us": v}
+                       for name, v in us.items()}}
+
+
+def test_profdiff_roundtrip_and_exit_codes(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(_phase_table(
+        {"frame-encode": 40.0, "wire-write": 25.0})))
+    b.write_text(json.dumps(_phase_table(
+        {"frame-encode": 9.0, "wire-write": 60.0})))
+
+    assert profdiff.main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "frame-encode" in out and "wire-write" in out
+
+    # wire-write regressed 2.4x: --fail-ratio 1.3 must exit 1
+    assert profdiff.main([str(a), str(b), "--fail-ratio", "1.3"]) == 1
+    captured = capsys.readouterr()
+    assert "REGRESSION" in captured.out
+    assert "wire-write" in captured.err
+
+    # identical captures pass any ratio
+    assert profdiff.main([str(a), str(a), "--fail-ratio", "1.01"]) == 0
+    capsys.readouterr()
+
+    assert profdiff.main([str(a)]) == 2      # usage
+    capsys.readouterr()
+
+
+def test_profdiff_normalizes_bench_rows_and_profiles(tmp_path):
+    bench = [{"bench": "trivial_tasks", "per_second": 6500.0},
+             {"bench": "task_phases",
+              "phases": {"spec-build": {"count": 300, "mean_us": 12.5}}}]
+    norm = profdiff.normalize(bench)
+    assert norm["phases"] == {"spec-build": 12.5}
+    assert norm["counts"] == {"spec-build": 300}
+
+    cap = {"kind": "rtpu-profile",
+           "procs": {"driver:1": {"counts": {"main;a;f": 8, "main;b;f": 4},
+                                  "samples": 12, "hz": 101}}}
+    norm = profdiff.normalize(cap)
+    assert norm["frames"] == {"f": 12} and norm["samples"] == 12
+
+    report = profdiff.diff(profdiff.normalize(cap),
+                           profdiff.normalize(cap))
+    assert report["frames"][0]["delta_pct"] == 0.0
+
+
+def test_profdiff_min_count_ignores_noise_phases():
+    a = {"phases": {"x": 10.0}, "counts": {"x": 3}, "frames": {},
+         "samples": 0}
+    b = {"phases": {"x": 100.0}, "counts": {"x": 3}, "frames": {},
+         "samples": 0}
+    report = profdiff.diff(a, b, min_count=5)
+    assert report["worst"] is None           # 3 samples: noise, not fail
+
+
+# --- percentile None-contract (satellite b) ---------------------------
+
+def test_percentile_from_counts_never_raises_on_empty():
+    assert metrics_mod.percentile_from_counts([], [], 0.99) is None
+    assert metrics_mod.percentile_from_counts([], [0], 0.99) is None
+    assert metrics_mod.percentile_from_counts([], [5], 0.99) is None
+    assert metrics_mod.percentile_from_counts([1.0, 2.0],
+                                              [0, 0, 0], 0.5) is None
+
+
+def test_histogram_percentile_none_when_unobserved():
+    h = metrics_mod.Histogram("test_prof_unobserved_hist",
+                              boundaries=[0.1, 1.0])
+    assert h.percentile(0.5) is None
+    assert h.snapshot() is None
+
+
+# --- e2e: phase chain over a live runtime -----------------------------
+
+@pytest.mark.watchdog(120)
+def test_phase_chain_records_all_phases(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.core import task_phase
+    from ray_tpu.core.config import get_config
+    from ray_tpu.devtools import whereis
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    cfg = get_config()
+    saved = (fr.RECORDER, cfg.task_phase_sample_n)
+    task_phase.reset()
+    try:
+        cfg.task_phase_sample_n = 1          # sample every task
+        fr.enable("driver:phase-test", capacity=4096)
+        lo = fr.clock_ns()
+        ray_tpu.get([nop.remote() for _ in range(50)])
+        hi = fr.clock_ns()
+        report = whereis.task_path_attribution(
+            fr.merged_journals(), window_ns=(lo, hi))
+    finally:
+        fr.RECORDER, cfg.task_phase_sample_n = saved
+        task_phase.reset()
+
+    assert set(report["phases"]) == set(task_phase.PHASES)
+    assert report["tasks_sampled"] >= 40     # ring may shed the oldest
+    for name, row in report["phases"].items():
+        assert row["count"] > 0 and row["mean_us"] >= 0.0, name
+    assert report["mean_chain_us"] > 0
+    # sample-every-task chains tile nearly the whole window
+    assert report["coverage"] is not None and report["coverage"] > 0.5
+    # rendering must not raise and must carry the table
+    text = whereis.render_task_path(report)
+    assert "wire-write" in text and "coverage" in text
+
+
+@pytest.mark.watchdog(120)
+def test_phase_sampling_gate_is_cheap_when_untracked(ray_start_regular):
+    """With the recorder off, sample_begin returns 0 and _TRACKED stays
+    empty — the unsampled hot path must leave no chains behind."""
+    import ray_tpu
+    from ray_tpu.core import task_phase
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    saved = fr.RECORDER
+    try:
+        fr.disable()
+        task_phase.reset()
+        ray_tpu.get([nop.remote() for _ in range(200)])
+        assert task_phase._TRACKED == {}
+        assert task_phase.sample_begin() == 0
+    finally:
+        fr.RECORDER = saved
+
+
+# --- overhead guards (satellite e) ------------------------------------
+
+@pytest.mark.watchdog(300)
+def test_profiler_overhead_disabled_ratio(ray_start_regular):
+    """With every observatory gate off, interleaved runs of the same
+    loop must agree within 5% — the disabled path is two loads and a
+    compare, so any drift here is a gate that grew a body."""
+    import ray_tpu
+    from ray_tpu.core import task_phase
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(500)])   # warmup
+
+    def run_loop(n=1500):
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        return time.perf_counter() - t0
+
+    saved = (fr.RECORDER, profiler.PROFILER)
+    try:
+        fr.disable()
+        profiler.disable()
+        task_phase.reset()
+        timings = {"a": [], "b": []}
+        for arm in ("a", "b", "a", "b", "a", "b"):
+            timings[arm].append(run_loop())
+        ratio = min(timings["b"]) / min(timings["a"])
+    finally:
+        fr.RECORDER, profiler.PROFILER = saved
+    assert ratio < 1.05, f"disabled-path drift ratio {ratio:.3f} >= 1.05"
+
+
+@pytest.mark.watchdog(300)
+def test_profiler_overhead_enabled_ratio(ray_start_regular):
+    """Full observatory on — sampler at 101 Hz + recorder + 1-in-64
+    phase sampling — vs everything off, interleaved best-of: the
+    enabled loop must stay under 1.5x."""
+    import ray_tpu
+    from ray_tpu.core import task_phase
+    from ray_tpu.core.config import get_config
+
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return None
+
+    ray_tpu.get([nop.remote() for _ in range(500)])   # warmup
+
+    def run_loop(n=1500):
+        t0 = time.perf_counter()
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        return time.perf_counter() - t0
+
+    cfg = get_config()
+    saved = (fr.RECORDER, profiler.PROFILER, cfg.task_phase_sample_n)
+    try:
+        timings = {}
+        for mode in ("off", "on", "off", "on"):    # interleave: best-of
+            if mode == "on":
+                cfg.task_phase_sample_n = 64
+                fr.enable("driver:overhead")
+                profiler.enable("driver:overhead", hz=101)
+            else:
+                cfg.task_phase_sample_n = saved[2]
+                fr.disable()
+                profiler.disable()
+            task_phase.reset()
+            timings.setdefault(mode, []).append(run_loop())
+        ratio = min(timings["on"]) / min(timings["off"])
+    finally:
+        profiler.disable()
+        fr.RECORDER, profiler.PROFILER, cfg.task_phase_sample_n = saved
+        task_phase.reset()
+    assert ratio < 1.5, f"observatory overhead ratio {ratio:.2f} >= 1.5"
