@@ -119,6 +119,11 @@ class DriverRuntime:
         # Same idea for the lifetime sanitizer: fresh collector per
         # session, ledger enabled iff RAY_TPU_REFSAN is exported.
         refsan.init_driver()
+        # ... and the collective-program sanitizer (RAY_TPU_COLLSAN):
+        # fresh fingerprint store per session, stall watchdog started
+        # when enabled.
+        from ray_tpu.devtools import collsan
+        collsan.init_driver()
         # ... and the sampling profiler (RAY_TPU_PROFILER): fresh
         # store per session, driver sampler started when enabled.
         from ray_tpu.devtools import profiler
@@ -2366,6 +2371,12 @@ class DriverRuntime:
             # same brevity contract as flight_push
             refsan.store_push(args[0], args[1])
             return True
+        if method == "collsan_push":
+            # collective-fingerprint increment from a worker's collsan
+            # flusher; same brevity contract as flight_push
+            from ray_tpu.devtools import collsan
+            collsan.store_push(args[0], args[1])
+            return True
         if method == "profile_push":
             # cumulative profile snapshot from a worker's sampler;
             # replace-on-push, same brevity contract as flight_push
@@ -2563,6 +2574,10 @@ class DriverRuntime:
         # state are still current (stores close below); findings are
         # kept for post-shutdown refsan.report() calls.
         refsan.on_shutdown()
+        # Same for the collective-program sanitizer: one fold over the
+        # merged fingerprint journals, kept for collsan.report().
+        from ray_tpu.devtools import collsan
+        collsan.on_shutdown()
         # Stop the driver's sampler; park its counts in the store so
         # post-shutdown profile_dump()/profdiff captures still see it.
         from ray_tpu.devtools import profiler

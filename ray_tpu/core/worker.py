@@ -645,6 +645,10 @@ def worker_main(socket_path: str, node_id_hex: str, worker_id_hex: str,
     # Lifetime sanitizer: same inherit-the-env contract — the ledger and
     # its push flusher start only when the driver exported RAY_TPU_REFSAN.
     refsan.init_worker(rt, worker_id)
+    # Collective-program sanitizer: fingerprint ledger + pusher start
+    # only when the driver exported RAY_TPU_COLLSAN.
+    from ray_tpu.devtools import collsan
+    collsan.init_worker(rt, worker_id)
     # Sampling profiler: sampler + profile pusher start only when the
     # driver ran with RAY_TPU_PROFILER (env rides into this process).
     from ray_tpu.devtools import profiler

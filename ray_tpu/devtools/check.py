@@ -473,6 +473,109 @@ def step_refsan() -> Tuple[str, str]:
     return "ok", "seeded fixture fired; clean smoke reported 0 findings"
 
 
+# Collective-sanitizer smoke: a 3-rank actor group runs a clean
+# multi-op collective program under RAY_TPU_COLLSAN=1; after the
+# journals flush, the driver-side fold must report zero findings.
+_COLLSAN_SRC = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import ray_tpu
+from ray_tpu.devtools import collsan
+
+ray_tpu.init(num_cpus=4)
+try:
+    WORLD = 3
+
+    @ray_tpu.remote(num_cpus=0)
+    class Member:
+        def __init__(self, rank):
+            from ray_tpu.parallel import collective
+            self.rank = rank
+            collective.init_collective_group(WORLD, rank, "csan-smoke")
+
+        def rounds(self):
+            from ray_tpu.parallel import collective
+            x = np.arange(64, dtype=np.float32) + self.rank
+            s = collective.allreduce(x, "sum", "csan-smoke")
+            shard, off = collective.reduce_scatter_flat(
+                x, "sum", "csan-smoke")
+            full = collective.allgather_flat(shard, "csan-smoke")
+            collective.barrier("csan-smoke")
+            b = collective.broadcast(x if self.rank == 0 else
+                                     np.zeros(64, np.float32),
+                                     src_rank=0, group_name="csan-smoke")
+            collective.destroy_collective_group("csan-smoke")
+            return float(s.sum() + full.sum() + b.sum())
+
+    members = [Member.remote(r) for r in range(WORLD)]
+    vals = ray_tpu.get([m.rounds.remote() for m in members], timeout=90)
+    assert len(set(vals)) == 1, f"ranks disagree: {vals}"
+    time.sleep(1.0)  # let the worker flushers push the final journals
+    findings = collsan.report()
+    if findings:
+        print(collsan.format_findings(findings))
+        sys.exit(3)
+    assert collsan.merged_events(), "no fingerprints reached the driver"
+    print("COLLSAN-OK")
+finally:
+    ray_tpu.shutdown()
+"""
+
+
+def step_collsan() -> Tuple[str, str]:
+    """Collective sanitizer: the fold must flag a seeded 4-rank
+    order-divergence fixture at the known seq (in-process, synthetic
+    events), and a clean 3-rank collective smoke under
+    RAY_TPU_COLLSAN=1 must report zero findings."""
+    from ray_tpu.devtools import collsan
+
+    # -- seeded fixture: the detector itself must fire -------------------
+    def ev(idx, rank, seq, op):
+        return (idx, "enter", "g", rank, 4, seq,
+                collsan.fingerprint(op, "float32", 64, (64,)), 0.0)
+
+    fixture = []
+    for rank in range(4):
+        # rank 3 swaps barrier/broadcast at seqs 1-2: one divergence
+        ops = (["allreduce", "broadcast", "barrier"] if rank == 3
+               else ["allreduce", "barrier", "broadcast"])
+        for seq, op in enumerate(ops):
+            fixture.append(ev(len(fixture), rank, seq, op))
+    findings = collsan.fold(fixture, expect_complete=True)
+    if [(f["kind"], f["seq"]) for f in findings] \
+            != [("order_divergence", 1)]:
+        return "FAIL", (f"seeded fixture misfolded: expected one "
+                        f"order_divergence at seq 1, got {findings}")
+
+    # -- clean smoke: a correct workload must stay quiet -----------------
+    env = dict(os.environ)
+    env["RAY_TPU_COLLSAN"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix="_rtpu_collsan.py", delete=False) as f:
+        f.write(_COLLSAN_SRC)
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, path], env=env,
+            capture_output=True, text=True, timeout=180)
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    out = (proc.stdout or "") + (proc.stderr or "")
+    if proc.returncode != 0 or "COLLSAN-OK" not in proc.stdout:
+        return "FAIL", out[-4000:]
+    return "ok", ("seeded order-divergence folded at seq 1; clean "
+                  "3-rank smoke reported 0 findings")
+
+
 # Chaos drill smoke: 8 virtual nodes, a sustained fan-out, one SEEDED
 # node kill landing mid-flight. Asserts every task still completes
 # (retry/reconstruction), the recovery report folds exactly one
@@ -565,6 +668,7 @@ _STEPS: List[Tuple[str, Callable[[], Tuple[str, str]]]] = [
     ("recorder", step_recorder),
     ("profile", step_profile),
     ("refsan", step_refsan),
+    ("collsan", step_collsan),
     ("chaos", step_chaos),
     ("locktrace", step_locktrace),
     ("threadguard", step_threadguard),

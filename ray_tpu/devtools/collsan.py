@@ -1,0 +1,722 @@
+"""collsan: opt-in cross-rank collective-program sanitizer.
+
+The static half of the collective contract lives in graftlint's
+GL021-GL023 (``ray_tpu/devtools/lint/rules/collectives.py``); this
+module is the runtime half, in the locktrace/threadguard/refsan mold:
+every host-collective entry point in ``parallel/collective.py``
+(``allreduce``, ``reduce_scatter_flat``, ``allgather_flat`` /
+``allgather``, ``reducescatter``, ``broadcast``, ``barrier``, the p2p
+``send``/``recv`` pair) and the optimizer-level wrappers in
+``train/collective.py`` stamps a per-(group, rank) monotonically
+sequenced *fingerprint*
+
+    (seq, op_kind, dtype, flat_size, shape_hash,
+     compression, ef_key, algorithm)
+
+into a per-process ledger. Worker ledgers flush to the driver over the
+same control channel the flight recorder uses
+(``gcs_call("collsan_push", ...)``); the driver-side ``fold()``
+cross-checks fingerprints at equal seq across ranks and reports:
+
+* **op_mismatch**          — ranks issued different collectives at the
+  same seq (and the programs do not look merely reordered),
+* **order_divergence**     — the per-rank programs diverge but contain
+  the same ops nearby: one rank reordered/skipped a collective; the
+  finding names the first diverging seq and both ranks' surrounding
+  windows,
+* **shape_mismatch**       — same op, different flat size / shape,
+* **dtype_mismatch**       — same op, different element dtype,
+* **compression_mismatch** — same op/shape, different compression,
+  ``ef_key`` or algorithm (error-feedback residuals cross-contaminate),
+* **missing_rank**         — a rank of the group's world never issued
+  (or stopped issuing) collectives while its peers progressed; only
+  judged when the caller asserts the journals are complete
+  (``expect_complete=True``) so flush lag cannot fabricate it.
+
+A **hung-collective watchdog** (driver thread, threshold
+``RTPU_COLLSAN_STALL_S``, default 30s) turns today's silent
+``_kv_wait`` timeout into a one-line diagnosis: which ranks are parked
+inside which collective seq, and which ranks never arrived.
+
+``verify_program(program, world)`` is the pure half: an explicit
+checker for a list-of-collective-ops "program" (per-rank group-op
+order equality, FIFO send/recv pairing per channel, peak-live-bytes
+bound) shared by pipeline ``validate_schedule`` and targeted by the
+resharding planner as its output contract.
+
+Enable with::
+
+    RAY_TPU_COLLSAN=1 python my_driver.py
+    RAY_TPU_COLLSAN=1 RTPU_COLLSAN_STALL_S=5 pytest ...
+
+With ``RAY_TPU_COLLSAN`` unset every hook is two loads and a compare::
+
+    led = collsan.LEDGER
+    if led is not None:
+        led.record_enter(...)
+
+Like everything in devtools, importing this module must stay cheap:
+no jax, no numpy, no runtime imports.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_ENV_FLAG = "RAY_TPU_COLLSAN"
+_STALL_ENV = "RTPU_COLLSAN_STALL_S"
+STALL_DEFAULT_S = 30.0
+
+#: groups with this prefix hold point-to-point ops (send/recv); their
+#: programs legitimately differ across ranks, so the cross-rank order
+#: fold skips them — the stall watchdog still covers a parked recv.
+P2P_PREFIX = "p2p:"
+
+#: how many fingerprints either side of the first diverging seq are
+#: quoted in an order_divergence finding.
+WINDOW = 3
+
+#: how far ahead a "missing" op may reappear before a divergence is
+#: classified as reordering rather than a plain op_mismatch.
+_REORDER_LOOKAHEAD = 8
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8, "complex64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV_FLAG, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def stall_threshold_s() -> float:
+    try:
+        return float(os.environ.get(_STALL_ENV, STALL_DEFAULT_S))
+    except ValueError:
+        return STALL_DEFAULT_S
+
+
+def shape_hash(shape) -> int:
+    """Deterministic FNV-1a over the dims — stable across processes
+    (unlike ``hash`` on str-bearing values under hash randomization)."""
+    h = 0xCBF29CE484222325
+    for dim in shape:
+        h ^= int(dim) & 0xFFFFFFFFFFFFFFFF
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h & 0xFFFFFFFF
+
+
+#: str(np.dtype) costs ~7µs — memoized, it is ~0.2µs on the stamp path
+#: (the set of distinct dtype objects a process reduces is tiny)
+_DTYPE_STR_CACHE: Dict[Any, str] = {}
+
+
+def fingerprint(op_kind: str, dtype: Any = "", flat_size: int = 0,
+                shape=(), compression: Optional[str] = None,
+                ef_key: Optional[str] = None,
+                algorithm: Optional[str] = None) -> tuple:
+    """The cross-rank comparable identity of one collective call."""
+    if type(dtype) is not str:
+        s = _DTYPE_STR_CACHE.get(dtype)
+        if s is None:
+            s = _DTYPE_STR_CACHE.setdefault(dtype, str(dtype))
+        dtype = s
+    return (op_kind, dtype, int(flat_size), shape_hash(shape),
+            compression, ef_key, algorithm)
+
+
+def _dtype_bytes(dtype: str) -> int:
+    return _DTYPE_BYTES.get(str(dtype), 4)
+
+
+class Ledger:
+    """Per-process collective ledger. Each entry/exit appends one tuple
+
+        (idx, kind, group, rank, world, seq, fp, t_wall)
+
+    where ``idx`` is the process-wide push ticket, ``kind`` is
+    ``"enter"``/``"exit"``, ``seq`` is the per-group logical collective
+    counter and ``fp`` is the :func:`fingerprint`. ``list.append`` is
+    atomic under the GIL; readers only slice the append-only list
+    (flight-recorder discipline)."""
+
+    def __init__(self, label: str = ""):
+        self.label = label or f"pid:{os.getpid()}"
+        self._events: List[tuple] = []
+        self._idx = itertools.count()
+        self._seqs: Dict[str, int] = {}
+
+    # -- event stream ---------------------------------------------------
+
+    def record_enter(self, group: str, rank: int, world: int,
+                     fp: tuple) -> int:
+        """Stamp entry into a collective; returns the seq token the
+        matching :meth:`record_exit` must echo."""
+        seq = self._seqs.get(group, 0)
+        self._seqs[group] = seq + 1
+        self._events.append((next(self._idx), "enter", group, rank,  # graftlint: disable=GL001
+                             world, seq, fp, time.time()))
+        return seq
+
+    def record_exit(self, group: str, rank: int, world: int,
+                    seq: int, op_kind: str) -> None:
+        self._events.append((next(self._idx), "exit", group, rank,  # graftlint: disable=GL001
+                             world, seq, (op_kind,), time.time()))
+
+    def snapshot(self, since: int = 0) -> List[tuple]:
+        """Events with index >= ``since`` (the list is append-only)."""
+        return self._events[since:]
+
+    def event_count(self) -> int:
+        return len(self._events)
+
+
+# The module-level gate. Hot paths read this once and None-check it;
+# rebinding is atomic under the GIL so enable/disable race nothing.
+LEDGER: Optional[Ledger] = None
+
+
+def enable(label: str = "") -> Ledger:
+    global LEDGER
+    LEDGER = Ledger(label=label)
+    return LEDGER
+
+
+def disable() -> None:
+    global LEDGER
+    LEDGER = None
+
+
+# --- driver-side collector ----------------------------------------------
+
+class _CollsanStore:
+    """Driver-held worker ledgers pushed over ``collsan_push``."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._procs: Dict[str, List[tuple]] = {}
+
+    def push(self, label: str, events: List[tuple]) -> None:
+        # Brief and lock-only: runs in the GCS dispatch path, which may
+        # be the head's IO-loop thread.
+        with self.lock:
+            bucket = self._procs.setdefault(label, [])
+            last = bucket[-1][0] if bucket else -1
+            for ev in events:
+                if ev[0] > last:
+                    bucket.append(tuple(ev))
+                    last = ev[0]
+
+    def journals(self) -> Dict[str, List[tuple]]:
+        with self.lock:
+            return {label: list(evs)
+                    for label, evs in sorted(self._procs.items())}
+
+
+_STORE: Optional[_CollsanStore] = None
+_final_findings: Optional[List[dict]] = None
+_watchdog_findings: List[dict] = []
+
+
+def get_store() -> _CollsanStore:
+    global _STORE
+    if _STORE is None:
+        _STORE = _CollsanStore()
+    return _STORE
+
+
+def store_push(label: str, events: List[tuple]) -> None:
+    get_store().push(label, events)
+
+
+def merged_events() -> List[tuple]:
+    """Every collected worker event plus the local ledger's."""
+    out: List[tuple] = []
+    store = _STORE
+    if store is not None:
+        for events in store.journals().values():
+            out.extend(events)
+    led = LEDGER
+    if led is not None:
+        out.extend(led.snapshot())
+    return out
+
+
+# --- process wiring ------------------------------------------------------
+
+def init_driver() -> None:
+    """Reset collector state and (when ``RAY_TPU_COLLSAN`` is set)
+    enable the driver's ledger plus the stall watchdog. Called from
+    ``Runtime.__init__``; the env flag rides into workers untouched."""
+    global _STORE, _final_findings, _watchdog_findings
+    _STORE = _CollsanStore()
+    _final_findings = None
+    _watchdog_findings = []
+    stop_flusher()
+    stop_watchdog()
+    if enabled():
+        enable(label=f"driver:{os.getpid()}")
+        start_watchdog()
+    else:
+        disable()
+
+
+def init_worker(rt, worker_id) -> None:
+    """Enable the ledger and start the push flusher in a worker process
+    (no-op unless the driver session runs with ``RAY_TPU_COLLSAN``)."""
+    if not enabled():
+        return
+    led = enable(label=f"worker:{worker_id.hex()[:12]}:pid:{os.getpid()}")
+    start_flusher(rt, led)
+
+
+class _Flusher(threading.Thread):
+    """Worker-side daemon: periodically push the ledger increment to
+    the driver over the control channel (same route as flight_push)."""
+
+    def __init__(self, rt, ledger: Ledger, interval_s: float = 0.25):
+        super().__init__(name="collsan-flush", daemon=True)
+        self._rt = rt
+        self._ledger = ledger
+        self._interval = max(0.02, float(interval_s))
+        self._sent = 0
+        self._stop = threading.Event()
+
+    def flush_once(self) -> None:
+        events = self._ledger.snapshot(since=self._sent)
+        if not events:
+            return
+        self._rt.gcs_call("collsan_push", self._ledger.label, events)
+        self._sent += len(events)
+
+    def run(self) -> None:
+        from ray_tpu.util.backoff import Backoff
+
+        # Failed pushes back off with jitter (util/backoff.py) instead
+        # of re-hammering a struggling control channel every interval.
+        backoff = Backoff(initial_s=self._interval,
+                          max_s=8 * self._interval)
+        failures = 0
+        delay = self._interval
+        while not self._stop.wait(delay):
+            try:
+                self.flush_once()
+                failures = 0
+                backoff.reset()
+                delay = self._interval
+            except Exception:  # noqa: BLE001 — channel gone at shutdown
+                failures += 1
+                if failures >= 3:
+                    return
+                delay = backoff.next_delay()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.flush_once()  # final increment, best effort
+        except Exception:  # graftlint: disable=GL004
+            pass  # shutdown race: the control channel may be gone
+
+
+_flusher: Optional[_Flusher] = None
+
+
+def start_flusher(rt, ledger: Ledger) -> None:
+    global _flusher
+    _flusher = _Flusher(rt, ledger)
+    _flusher.start()
+
+
+def stop_flusher() -> None:
+    global _flusher
+    if _flusher is not None:
+        _flusher.stop()
+        _flusher = None
+
+
+class _Watchdog(threading.Thread):
+    """Driver-side daemon: periodically scan the merged journals for
+    collectives some ranks entered more than ``RTPU_COLLSAN_STALL_S``
+    ago and never left, and log the one-line diagnosis (which ranks
+    are parked at which seq; which ranks never arrived)."""
+
+    def __init__(self, stall_s: Optional[float] = None):
+        super().__init__(name="collsan-watchdog", daemon=True)
+        self.stall_s = stall_threshold_s() if stall_s is None else stall_s
+        self._stop = threading.Event()
+        self._reported: set = set()
+
+    def scan_once(self, now: Optional[float] = None) -> List[dict]:
+        fresh = []
+        for f in stall_findings(merged_events(), stall_s=self.stall_s,
+                                now=now):
+            key = (f["group"], f["seq"])
+            if key in self._reported:
+                continue
+            self._reported.add(key)
+            _watchdog_findings.append(f)
+            fresh.append(f)
+            logger.warning("collsan: %s", f["detail"])
+        return fresh
+
+    def run(self) -> None:
+        interval = max(0.25, self.stall_s / 4.0)
+        while not self._stop.wait(interval):
+            try:
+                self.scan_once()
+            except Exception:  # noqa: BLE001 — scan must never kill us
+                logger.debug("collsan watchdog scan failed",
+                             exc_info=True)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+_watchdog: Optional[_Watchdog] = None
+
+
+def start_watchdog(stall_s: Optional[float] = None) -> _Watchdog:
+    global _watchdog
+    _watchdog = _Watchdog(stall_s=stall_s)
+    _watchdog.start()
+    return _watchdog
+
+
+def stop_watchdog() -> None:
+    global _watchdog
+    if _watchdog is not None:
+        _watchdog.stop()
+        _watchdog = None
+
+
+# --- the fold -------------------------------------------------------------
+
+def _programs(events: List[tuple]
+              ) -> Dict[str, Dict[int, List[tuple]]]:
+    """group -> rank -> seq-sorted list of enter events."""
+    out: Dict[str, Dict[int, List[tuple]]] = {}
+    for ev in events:
+        if ev[1] != "enter":
+            continue
+        out.setdefault(ev[2], {}).setdefault(ev[3], []).append(ev)
+    for ranks in out.values():
+        for evs in ranks.values():
+            evs.sort(key=lambda e: e[5])
+    return out
+
+
+def _window(evs: List[tuple], seq: int) -> List[str]:
+    lo, hi = seq - WINDOW, seq + WINDOW
+    return [f"seq {e[5]}: {e[6][0]}" for e in evs if lo <= e[5] <= hi]
+
+
+def _mismatch(group: str, seq: int, ref_ev: tuple, ev: tuple,
+              kind: str, what: str) -> dict:
+    r0, r1 = ref_ev[3], ev[3]
+    return {"kind": kind, "group": group, "seq": seq,
+            "ranks": sorted((r0, r1)),
+            "detail": f"group '{group}' seq {seq}: {what} — "
+                      f"rank {r0} issued {ref_ev[6]!r}, "
+                      f"rank {r1} issued {ev[6]!r}"}
+
+
+def fold(events: List[tuple],
+         expect_complete: bool = False) -> List[dict]:
+    """Cross-check the merged fingerprint stream. Each finding is a
+    dict ``{"kind", "group", "seq", "ranks", "detail"}``.
+
+    ``expect_complete=True`` asserts every rank's journal is final
+    (synthetic fixtures, post-barrier folds): only then are shorter or
+    absent per-rank programs reported as ``missing_rank`` — a live
+    fold must not read flush lag as a vanished rank."""
+    findings: List[dict] = []
+    for group, ranks in sorted(_programs(events).items()):
+        if group.startswith(P2P_PREFIX):
+            continue  # p2p programs legitimately differ across ranks
+        world = max((ev[4] for evs in ranks.values() for ev in evs),
+                    default=0)
+        if expect_complete and world > len(ranks):
+            peak = max(ev[5] for evs in ranks.values() for ev in evs)
+            for rank in range(world):
+                if rank not in ranks:
+                    findings.append({
+                        "kind": "missing_rank", "group": group,
+                        "seq": 0, "ranks": [rank],
+                        "detail": f"group '{group}': rank {rank} never "
+                                  f"issued a collective while peers "
+                                  f"reached seq {peak}"})
+        ordered = sorted(ranks)
+        ref = ordered[0]
+        ref_evs = ranks[ref]
+        ref_by_seq = {ev[5]: ev for ev in ref_evs}
+        for rank in ordered[1:]:
+            evs = ranks[rank]
+            diverged = False
+            for ev in evs:
+                seq = ev[5]
+                ref_ev = ref_by_seq.get(seq)
+                if ref_ev is None or ref_ev[6] == ev[6]:
+                    continue
+                rfp, fp = ref_ev[6], ev[6]
+                if rfp[0] != fp[0]:
+                    # op kinds differ: reordered program, or flatly
+                    # different ops at this slot?
+                    near = [e[6][0] for e in evs
+                            if seq < e[5] <= seq + _REORDER_LOOKAHEAD]
+                    ref_near = [e[6][0] for e in ref_evs
+                                if seq < e[5] <= seq + _REORDER_LOOKAHEAD]
+                    if rfp[0] in near or fp[0] in ref_near:
+                        findings.append({
+                            "kind": "order_divergence", "group": group,
+                            "seq": seq, "ranks": sorted((ref, rank)),
+                            "detail": (
+                                f"group '{group}': programs of rank "
+                                f"{ref} and rank {rank} diverge at seq "
+                                f"{seq} ({rfp[0]} vs {fp[0]}); rank "
+                                f"{ref} window: {_window(ref_evs, seq)}; "
+                                f"rank {rank} window: "
+                                f"{_window(evs, seq)}")})
+                    else:
+                        findings.append(_mismatch(
+                            group, seq, ref_ev, ev, "op_mismatch",
+                            "different collectives at the same seq"))
+                    diverged = True
+                    break  # everything after the first op-level
+                    # divergence is cascade noise for this pair
+                elif rfp[1] != fp[1]:
+                    findings.append(_mismatch(
+                        group, seq, ref_ev, ev, "dtype_mismatch",
+                        "same op, different dtype"))
+                elif rfp[2] != fp[2] or rfp[3] != fp[3]:
+                    findings.append(_mismatch(
+                        group, seq, ref_ev, ev, "shape_mismatch",
+                        "same op, different tensor shape"))
+                else:
+                    findings.append(_mismatch(
+                        group, seq, ref_ev, ev, "compression_mismatch",
+                        "same op/shape, different compression, ef_key "
+                        "or algorithm"))
+            if expect_complete and not diverged:
+                peak = max(e[5] for e in ref_evs + evs)
+                short, other = ((rank, ref)
+                                if evs[-1][5] < ref_evs[-1][5]
+                                else (ref, rank))
+                if ranks[short][-1][5] < peak:
+                    findings.append({
+                        "kind": "missing_rank", "group": group,
+                        "seq": ranks[short][-1][5] + 1,
+                        "ranks": [short],
+                        "detail": f"group '{group}': rank {short} "
+                                  f"stopped after seq "
+                                  f"{ranks[short][-1][5]} while rank "
+                                  f"{other} reached seq {peak}"})
+    return findings
+
+
+def stall_findings(events: List[tuple],
+                   stall_s: Optional[float] = None,
+                   now: Optional[float] = None) -> List[dict]:
+    """Collectives some rank entered more than ``stall_s`` ago and
+    never exited: the hung-collective diagnosis. One finding per
+    (group, seq) names the parked ranks (with their op) and the ranks
+    that never arrived."""
+    stall_s = stall_threshold_s() if stall_s is None else stall_s
+    now = time.time() if now is None else now
+    open_enters: Dict[Tuple[str, int], Dict[int, tuple]] = {}
+    exits: set = set()
+    last_seq: Dict[Tuple[str, int], int] = {}
+    world_of: Dict[str, int] = {}
+    for ev in events:
+        _idx, kind, group, rank, world, seq, _fp, _t = ev
+        world_of[group] = max(world_of.get(group, 0), world)
+        if kind == "enter":
+            open_enters.setdefault((group, seq), {})[rank] = ev
+            key = (group, rank)
+            last_seq[key] = max(last_seq.get(key, -1), seq)
+        elif kind == "exit":
+            exits.add((group, seq, rank))
+    findings: List[dict] = []
+    for (group, seq), entered in sorted(open_enters.items()):
+        parked = {rank: ev for rank, ev in entered.items()
+                  if (group, seq, rank) not in exits
+                  and now - ev[7] >= stall_s}
+        if not parked:
+            continue
+        age = max(now - ev[7] for ev in parked.values())
+        missing = [r for r in range(world_of.get(group, 0))
+                   if r not in entered
+                   and last_seq.get((group, r), -1) < seq]
+        ops = sorted({ev[6][0] for ev in parked.values()})
+        detail = (f"group '{group}' seq {seq}: rank(s) "
+                  f"{sorted(parked)} parked inside "
+                  f"{'/'.join(ops)} for {age:.1f}s")
+        if missing:
+            detail += f"; rank(s) {missing} never arrived"
+        findings.append({
+            "kind": "stall", "group": group, "seq": seq,
+            "ranks": sorted(parked), "missing": missing,
+            "ops": ops, "age_s": round(age, 3),
+            "parked_since": min(ev[7] for ev in parked.values()),
+            "detail": detail})
+    return findings
+
+
+def report(expect_complete: bool = False) -> List[dict]:
+    """Fold the merged journals into findings — cross-rank mismatches
+    plus currently stalled collectives plus anything the watchdog or a
+    shutdown-time fold already caught. Empty when collsan is off."""
+    if LEDGER is None and _STORE is None:
+        return list(_final_findings or [])
+    events = merged_events()
+    findings = fold(events, expect_complete=expect_complete)
+    seen = {(f["kind"], f["group"], f["seq"]) for f in findings}
+    for f in stall_findings(events) + _watchdog_findings + list(
+            _final_findings or []):
+        key = (f["kind"], f["group"], f["seq"])
+        if key not in seen:
+            seen.add(key)
+            findings.append(f)
+    return findings
+
+
+def on_shutdown() -> None:
+    """Runtime shutdown hook: fold once while worker journals are
+    still current, and keep the result for late ``report()`` calls
+    (the ledger itself is torn down with the session)."""
+    global _final_findings, _STORE
+    stop_watchdog()
+    if LEDGER is None:
+        return
+    findings = report()
+    _final_findings = findings
+    disable()
+    _STORE = None
+    for f in findings:
+        logger.warning("collsan: %s group=%s seq=%s: %s",
+                       f["kind"], f["group"], f["seq"], f["detail"])
+
+
+def format_findings(findings: List[dict]) -> str:
+    return "\n".join(
+        f"collsan: {f['kind']} group={f['group']} seq={f['seq']}: "
+        f"{f['detail']}" for f in findings)
+
+
+# --- capture (profdiff input) --------------------------------------------
+
+def capture(events: Optional[List[tuple]] = None) -> Dict[str, Any]:
+    """Fold dump for ``profdiff``: per-group collective call counts
+    and traffic, auto-detected by ``profdiff.normalize`` the same way
+    phase tables are."""
+    events = merged_events() if events is None else events
+    groups: Dict[str, Dict[str, Dict[str, int]]] = {}
+    for ev in events:
+        if ev[1] != "enter":
+            continue
+        _idx, _kind, group, _rank, _world, _seq, fp, _t = ev
+        ops = groups.setdefault(group, {})
+        row = ops.setdefault(fp[0], {"count": 0, "bytes": 0})
+        row["count"] += 1
+        row["bytes"] += int(fp[2]) * _dtype_bytes(fp[1])
+    return {"kind": "rtpu-collsan", "groups": groups}
+
+
+# --- the pure program checker --------------------------------------------
+
+def verify_program(program: Dict[int, List[dict]],
+                   world: Optional[int] = None,
+                   max_live_bytes=None) -> List[str]:
+    """Pure checker for an explicit multi-rank collective program.
+
+    ``program`` maps rank -> ordered op list; each op is a dict:
+
+    * group-wide collective: ``{"op": "allreduce"|..., "key": any}`` —
+      the ``(op, key)`` sequence must be identical on every rank,
+    * point-to-point: ``{"op": "send"/"recv", "chan": hashable,
+      "key": any}`` — per channel, the send key order must equal the
+      recv key order (FIFO pairing),
+    * memory: ``{"op": "alloc"/"free", "bytes": int}`` — per rank,
+      peak live bytes must stay within ``max_live_bytes`` (an int, or
+      a rank -> int mapping).
+
+    Returns a list of violation strings; empty means the program is a
+    valid single-program-multiple-rank collective schedule. This is
+    the contract ``pipeline.schedule.validate_schedule`` checks its
+    schedules against and the resharding planner will emit into.
+    """
+    violations: List[str] = []
+    ranks = sorted(program)
+    if world is not None:
+        for r in range(world):
+            if r not in program:
+                violations.append(f"rank {r} missing from program "
+                                  f"(world {world})")
+        for r in ranks:
+            if not 0 <= r < world:
+                violations.append(f"rank {r} outside world {world}")
+        ranks = [r for r in ranks if 0 <= r < world]
+
+    def _sig(rank: int) -> List[tuple]:
+        return [(op.get("op"), op.get("key")) for op in program[rank]
+                if op.get("op") not in ("send", "recv", "alloc", "free")]
+
+    if ranks:
+        ref = ranks[0]
+        ref_sig = _sig(ref)
+        for r in ranks[1:]:
+            sig = _sig(r)
+            if sig == ref_sig:
+                continue
+            n = min(len(sig), len(ref_sig))
+            i = next((k for k in range(n) if sig[k] != ref_sig[k]), n)
+            a = ref_sig[i] if i < len(ref_sig) else "<end>"
+            b = sig[i] if i < len(sig) else "<end>"
+            violations.append(
+                f"group-op order diverges between rank {ref} and rank "
+                f"{r} at op #{i}: {a!r} vs {b!r}")
+
+    sends: Dict[Any, List[Any]] = {}
+    recvs: Dict[Any, List[Any]] = {}
+    for r in ranks:
+        for op in program[r]:
+            if op.get("op") == "send":
+                sends.setdefault(op.get("chan"), []).append(op.get("key"))
+            elif op.get("op") == "recv":
+                recvs.setdefault(op.get("chan"), []).append(op.get("key"))
+    for chan in sorted(set(sends) | set(recvs), key=repr):
+        s, v = sends.get(chan, []), recvs.get(chan, [])
+        if s != v:
+            violations.append(
+                f"chan {chan!r}: unpaired or reordered send/recv "
+                f"(sends {s} vs recvs {v})")
+
+    if max_live_bytes is not None:
+        for r in ranks:
+            bound = (max_live_bytes.get(r)
+                     if isinstance(max_live_bytes, dict)
+                     else max_live_bytes)
+            if bound is None:
+                continue
+            live = peak = 0
+            for op in program[r]:
+                if op.get("op") == "alloc":
+                    live += int(op.get("bytes", 0))
+                    peak = max(peak, live)
+                elif op.get("op") == "free":
+                    live -= int(op.get("bytes", 0))
+            if peak > bound:
+                violations.append(
+                    f"rank {r}: peak live bytes {peak} exceeds bound "
+                    f"{bound}")
+    return violations

@@ -1,5 +1,6 @@
 """Rule families. Importing this package registers every rule."""
 
-from ray_tpu.devtools.lint.rules import (concurrency, conventions,  # noqa: F401
-                                         hygiene, lifecycle, ownership,
-                                         phases, retry, threadguard)
+from ray_tpu.devtools.lint.rules import (collectives,  # noqa: F401
+                                         concurrency, conventions, hygiene,
+                                         lifecycle, ownership, phases, retry,
+                                         threadguard)

@@ -1,0 +1,297 @@
+"""Collective-program rules (GL021-GL023).
+
+The static half of collsan (``ray_tpu/devtools/collsan.py``): these
+rules catch cross-rank divergence bugs at the source level — the
+classic desync (a collective issued on some ranks only because the
+call is guarded by a rank comparison), error-feedback residual
+cross-contamination (two collective call sites sharing one literal
+``ef_key`` for different tensors), and half-finished ZeRO steps (a
+reduce-scatter whose matching all-gather exists on no path of the same
+function family). All three are project rules: the guard, the
+colliding site, or the missing all-gather may live one call away, so
+they walk the interprocedural call graph (callgraph.py) in the GL015
+mold.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ray_tpu.devtools.lint.annotate import _dotted
+from ray_tpu.devtools.lint.base import Finding, Rule, register
+from ray_tpu.devtools.lint.callgraph import (Key, ProjectContext, _leaf,
+                                             body_nodes)
+
+#: host-collective entry points (parallel/collective.py surface)
+_HOST_COLLECTIVES = {
+    "allreduce", "reduce_scatter_flat", "allgather_flat", "allgather",
+    "reducescatter", "broadcast", "barrier",
+}
+_REDUCE_SCATTER_OPS = {"reduce_scatter_flat", "reducescatter"}
+_ALLGATHER_OPS = {"allgather_flat", "allgather"}
+
+#: names whose comparison in a branch condition marks the branch as
+#: rank-dependent (ctx.world_rank, self.rank, get_rank()...)
+_RANK_NAMES = {"rank", "world_rank", "local_rank", "stage_rank"}
+_RANK_CALL_LEAVES = {"get_rank"}
+
+
+def _collective_op(project: ProjectContext, path: str,
+                   call: ast.Call) -> Optional[str]:
+    """The host-collective op name when this call site targets the
+    collective module (``collective.allreduce(...)`` or a name imported
+    from a ``*collective*`` module); None for unrelated same-named
+    calls (a ``q.barrier()`` is not a collective)."""
+    dotted = _dotted(call.func)
+    if dotted is None:
+        return None
+    leaf = _leaf(dotted)
+    if leaf not in _HOST_COLLECTIVES:
+        return None
+    imports = project._imports.get(path, {})
+    if "." in dotted:
+        base = dotted.rsplit(".", 1)[0]
+        if "collective" in base:
+            return leaf
+        imp = imports.get(base.split(".", 1)[0])
+        if imp is not None and "collective" in (
+                (imp[0] or "") + "." + (imp[1] or "")):
+            return leaf
+        return None
+    imp = imports.get(leaf)
+    if imp is not None and "collective" in (imp[0] or ""):
+        return leaf
+    return None
+
+
+def _is_rank_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return node.id in _RANK_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _RANK_NAMES
+    if isinstance(node, ast.Call):
+        return _leaf(_dotted(node.func)) in _RANK_CALL_LEAVES
+    return False
+
+
+def _rank_condition(test: ast.AST) -> Optional[bool]:
+    """None when the If test does not condition on a rank; otherwise
+    True for a broadcast-root-style guard (``rank == <const>`` /
+    ``not rank``) and False for any other rank comparison."""
+    for n in ast.walk(test):
+        if isinstance(n, ast.Compare):
+            sides = [n.left] + list(n.comparators)
+            if any(_is_rank_expr(s) for s in sides):
+                return (len(n.ops) == 1 and
+                        isinstance(n.ops[0], ast.Eq) and
+                        any(isinstance(s, ast.Constant) for s in sides))
+    if _is_rank_expr(test):
+        return False        # bare truthiness: `if rank:`
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+            and _is_rank_expr(test.operand):
+        return True         # `if not rank:` ≡ rank == 0
+    return None
+
+
+def _branch_node_ids(if_node: ast.If) -> Set[int]:
+    """ids of nodes lexically inside either branch of the If (the test
+    itself excluded; nested defs excluded — defining a function under a
+    rank guard is not executing a collective there)."""
+    out: Set[int] = set()
+    for child in if_node.body + if_node.orelse:
+        out.add(id(child))
+        for sub in body_nodes(child):
+            out.add(id(sub))
+    return out
+
+
+def _callers_map(project: ProjectContext
+                 ) -> Dict[Key, List[Tuple[Key, ast.Call]]]:
+    callers: Dict[Key, List[Tuple[Key, ast.Call]]] = {}
+    for caller, edges in project.calls.items():
+        for callee, site in edges:
+            callers.setdefault(callee, []).append((caller, site))
+    return callers
+
+
+@register
+class RankDependentCollective(Rule):
+    id = "GL021"
+    name = "rank-dependent-collective"
+    project = True
+    rationale = ("a collective inside a branch conditioned on the rank "
+                 "runs on some ranks only — the others never enter the "
+                 "round and the group hangs (or silently desyncs); "
+                 "hoist the collective out of the guard (rank==0-rooted "
+                 "broadcast idioms are exempt)")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        # per function: rank-conditioned If regions (node-id set +
+        # whether the guard is the rank==0 broadcast-root shape)
+        regions: Dict[Key, List[Tuple[Set[int], bool]]] = {}
+        # functions containing unguarded collective calls, candidates
+        # for the two-hop pass
+        bare: List[Tuple[Key, ast.Call, str]] = []
+        for key, info in sorted(project.functions.items()):
+            regs: List[Tuple[Set[int], bool]] = []
+            for n in body_nodes(info.node):
+                if isinstance(n, ast.If):
+                    root = _rank_condition(n.test)
+                    if root is not None:
+                        regs.append((_branch_node_ids(n), root))
+            regions[key] = regs
+            for call in project.body_calls(info.node):
+                op = _collective_op(project, key[0], call)
+                if op is None:
+                    continue
+                guard = next((root for ids, root in regs
+                              if id(call) in ids), None)
+                if guard is None:
+                    bare.append((key, call, op))
+                elif not (op == "broadcast" and guard):
+                    yield info.ctx.finding(
+                        self.id, call,
+                        f"collective {op}() guarded by a rank-dependent "
+                        f"branch in {info.qualname}() — the other ranks "
+                        "never enter this round and the group hangs; "
+                        "issue the collective on every rank")
+        if not bare:
+            return
+        # two-hop: an unguarded collective in f, where f is called from
+        # inside a rank-conditioned branch of some caller g
+        callers = _callers_map(project)
+        for key, call, op in bare:
+            info = project.functions[key]
+            for caller, site in callers.get(key, ()):
+                guard = next((root for ids, root in regions.get(caller, ())
+                              if id(site) in ids), None)
+                if guard is None or (op == "broadcast" and guard):
+                    continue
+                cq = project.functions[caller].qualname
+                yield info.ctx.finding(
+                    self.id, call,
+                    f"collective {op}() in {info.qualname}() is reached "
+                    f"through a rank-dependent branch in {cq}() "
+                    f"({cq} -> {info.qualname}) — only some ranks enter "
+                    "this round; issue the collective on every rank")
+                break
+
+
+@register
+class EfKeyCollision(Rule):
+    id = "GL022"
+    name = "ef-key-collision"
+    project = True
+    rationale = ("the error-feedback residual persists per (group, "
+                 "ef_key): two call sites reducing different tensors "
+                 "under one literal key add one tensor's quantization "
+                 "error onto the other — give every logical tensor its "
+                 "own ef_key")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        # (group literal, ef_key literal) -> call sites with the first
+        # positional arg's structural dump as the tensor identity
+        sites: Dict[Tuple[str, str],
+                    List[Tuple[object, ast.Call, str]]] = {}
+        for key, info in sorted(project.functions.items()):
+            for call in project.body_calls(info.node):
+                if _collective_op(project, key[0], call) is None:
+                    continue
+                ef = self._const_kw(call, "ef_key")
+                if ef is None or not call.args:
+                    continue
+                group = self._const_kw(call, "group_name") or "default"
+                sites.setdefault((group, ef), []).append(
+                    (info, call, ast.dump(call.args[0])))
+        for (group, ef), hits in sorted(
+                sites.items(), key=lambda kv: kv[0]):
+            exprs = {expr for _info, _call, expr in hits}
+            if len(hits) < 2 or len(exprs) < 2:
+                continue
+            ordered = sorted(hits, key=lambda h: (h[0].ctx.path,
+                                                  h[1].lineno))
+            first = ordered[0]
+            for info, call, expr in ordered[1:]:
+                if expr == first[2]:
+                    continue
+                yield info.ctx.finding(
+                    self.id, call,
+                    f"ef_key {ef!r} (group {group!r}) is shared with "
+                    f"the collective at {first[0].ctx.path}:"
+                    f"{first[1].lineno} but reduces a different tensor "
+                    "— error-feedback residuals cross-contaminate; use "
+                    "a distinct ef_key per logical tensor")
+
+    @staticmethod
+    def _const_kw(call: ast.Call, name: str) -> Optional[str]:
+        for kw in call.keywords:
+            if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        return None
+
+
+@register
+class UnpairedCollective(Rule):
+    id = "GL023"
+    name = "unpaired-collective"
+    project = True
+    rationale = ("a reduce-scatter leaves every rank holding 1/world "
+                 "of the result: without the matching all-gather "
+                 "somewhere in the same function family the full "
+                 "tensor is never reassembled and ranks silently "
+                 "train on shards")
+
+    def check_project(self, project: ProjectContext) -> Iterator[Finding]:
+        rs_sites: Dict[Key, List[Tuple[ast.Call, str]]] = {}
+        ag_funcs: Set[Key] = set()
+        for key, info in sorted(project.functions.items()):
+            for call in project.body_calls(info.node):
+                op = _collective_op(project, key[0], call)
+                if op in _REDUCE_SCATTER_OPS:
+                    rs_sites.setdefault(key, []).append((call, op))
+                elif op in _ALLGATHER_OPS:
+                    ag_funcs.add(key)
+        if not rs_sites:
+            return
+        callers = _callers_map(project)
+        for key in sorted(rs_sites):
+            if self._family_gathers(project, key, ag_funcs, callers):
+                continue
+            info = project.functions[key]
+            for call, op in rs_sites[key]:
+                yield info.ctx.finding(
+                    self.id, call,
+                    f"{op}() in {info.qualname}() has no matching "
+                    "allgather on any path in its function family "
+                    "(itself, callees within two hops, direct callers "
+                    "and their helpers) — every rank keeps only its "
+                    "1/world shard; pair it with "
+                    "allgather_flat()/allgather()")
+
+    @staticmethod
+    def _family_gathers(project: ProjectContext, key: Key,
+                        ag_funcs: Set[Key],
+                        callers: Dict[Key, List[Tuple[Key, ast.Call]]]
+                        ) -> bool:
+        """Does the function family around ``key`` reach an allgather:
+        the function itself, its callees within two hops, its direct
+        callers, or those callers' direct callees (siblings)?"""
+        def callee_closure(start: Key, hops: int) -> Set[Key]:
+            seen: Set[Key] = set()
+            frontier = [start]
+            for _hop in range(hops + 1):
+                nxt: List[Key] = []
+                for k in frontier:
+                    if k in seen:
+                        continue
+                    seen.add(k)
+                    nxt.extend(c for c, _site in project.calls.get(k, ()))
+                frontier = nxt
+            return seen
+
+        family = callee_closure(key, 2)
+        for caller, _site in callers.get(key, ()):
+            family |= callee_closure(caller, 1)
+        return bool(family & ag_funcs)

@@ -14,7 +14,12 @@ Accepted capture formats (auto-detected, mix-and-match):
 * profiles — ``profiler.capture()`` dumps
   (``{"kind": "rtpu-profile", "procs": {...}}``);
 * flight journals — ``ray_tpu.flight_journal()`` dumps (their
-  ``task_phase`` events are folded on the fly).
+  ``task_phase`` events are folded on the fly);
+* collsan fold dumps — ``collsan.capture()``
+  (``{"kind": "rtpu-collsan", "groups": {...}}``): each
+  ``group/op`` becomes a row whose magnitude is its total payload
+  bytes and whose count is the number of rounds, so two runs'
+  per-group collective traffic diffs like any phase table.
 
 Usage::
 
@@ -57,6 +62,16 @@ def normalize(payload: Any) -> Dict[str, Any]:
                     if isinstance(r, dict)
                     and r.get("bench") == "task_phases"), None)
         payload = row or {}
+
+    if isinstance(payload, dict) and payload.get("kind") == "rtpu-collsan":
+        # collsan capture: group/op rows, magnitude = payload bytes
+        for group, ops in sorted((payload.get("groups") or {}).items()):
+            for op, row in sorted(ops.items()):
+                name = f"{group}/{op}"
+                phases[name] = float(row.get("bytes", 0))
+                counts[name] = int(row.get("count", 0))
+        return {"phases": phases, "counts": counts, "frames": frames,
+                "samples": samples}
 
     if isinstance(payload, dict) and "journals" in payload:
         from ray_tpu.devtools import whereis

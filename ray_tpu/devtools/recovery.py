@@ -154,10 +154,45 @@ def recovery_report(events=None, journals=None) -> Dict[str, Any]:
                 last_ts + 0.5),
         })
     incidents.sort(key=lambda inc: inc["root_ts"])
+    collsan_findings = _collsan_findings()
+    _attach_collsan(incidents, collsan_findings)
     return {"generated_at": time.time(),
             "events_scanned": len(events),
             "counts": counts,
+            "collsan": collsan_findings,
             "incidents": incidents}
+
+
+def _collsan_findings() -> List[Dict[str, Any]]:
+    """Current collsan findings (cross-rank mismatches + stalled
+    collectives). Best-effort: empty when collsan is off or broken."""
+    try:
+        from ray_tpu.devtools import collsan
+        return collsan.report()
+    except Exception:  # noqa: BLE001 — correlation is best-effort
+        return []
+
+
+def _attach_collsan(incidents: List[Dict[str, Any]],
+                    findings: List[Dict[str, Any]]) -> None:
+    """Chain stalled-collective findings onto the node death that
+    parked them: a stall whose ranks parked within a stall-window of a
+    NODE_DEAD root is that incident's symptom (the dead member never
+    arrived, so the survivors wait forever inside the collective)."""
+    if not findings:
+        return
+    dead = [inc for inc in incidents if inc["root_kind"] == "NODE_DEAD"]
+    if not dead:
+        return
+    from ray_tpu.devtools import collsan
+    window = collsan.stall_threshold_s() + 30.0
+    for finding in findings:
+        parked = finding.get("parked_since")
+        if parked is None:
+            continue
+        inc = min(dead, key=lambda i: abs(parked - i["root_ts"]))
+        if abs(parked - inc["root_ts"]) <= window:
+            inc.setdefault("collsan", []).append(finding)
 
 
 def _correlate_journals(journals, t_lo: float, t_hi: float
@@ -228,6 +263,9 @@ def render(report: Dict[str, Any]) -> str:
             f"{len(aff['actors'])} actors, "
             f"{len(aff['objects'])} objects, "
             f"{len(aff['workers'])} workers")
+        for f in inc.get("collsan", ()):
+            lines.append("    collsan: "
+                         + (f.get("detail") or f.get("kind", "finding")))
         lines.append("    chain:")
         for line in _chain_lines(inc, limit=40):
             lines.append("      " + line)

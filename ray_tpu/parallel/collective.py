@@ -57,6 +57,7 @@ import numpy as np
 
 from ray_tpu.core import runtime as runtime_mod
 from ray_tpu.core import serialization
+from ray_tpu.devtools import collsan as _collsan
 from ray_tpu.exceptions import GetTimeoutError
 from ray_tpu.util import flight_recorder as _flight
 from ray_tpu.util.backoff import jittered
@@ -256,7 +257,12 @@ def _payload_nbytes(payload) -> int:
 # time-averaged reduced value converges to the true reduction at O(1/T)
 # instead of carrying a constant quantization bias.
 
-_ef_buffers: Dict[Tuple[str, str], np.ndarray] = {}
+# Keyed by (group, leaf key, flat size): a re-created group whose leaf
+# happens to land on a different tensor size must not inherit (or trip
+# over) the previous run's residual, and init/destroy both clear the
+# group's residuals outright — a rank that skipped destroy (killed and
+# restarted) still starts its new incarnation clean.
+_ef_buffers: Dict[Tuple[str, str, int], np.ndarray] = {}
 
 
 def reset_error_feedback(group_name: Optional[str] = None) -> None:
@@ -271,15 +277,18 @@ def reset_error_feedback(group_name: Optional[str] = None) -> None:
 def error_feedback_residual(group_name: str,
                             ef_key: str) -> Optional[np.ndarray]:
     """The current residual for a leaf (copy; None if never used)."""
-    buf = _ef_buffers.get((group_name, ef_key))
-    return None if buf is None else buf.copy()
+    for (g, k, _size), buf in _ef_buffers.items():
+        if g == group_name and k == ef_key:
+            return buf.copy()
+    return None
 
 
 def _ef_buffer(group_name: str, ef_key: str, size: int) -> np.ndarray:
-    buf = _ef_buffers.get((group_name, ef_key))
-    if buf is None or buf.size != size:
+    key = (group_name, ef_key, size)
+    buf = _ef_buffers.get(key)
+    if buf is None:
         buf = np.zeros(size, dtype=np.float32)
-        _ef_buffers[(group_name, ef_key)] = buf
+        _ef_buffers[key] = buf
     return buf
 
 
@@ -341,6 +350,10 @@ def init_collective_group(world_size: int, rank: int,
     """Join a collective group (each rank calls once)."""
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world {world_size}")
+    # A fresh group must not inherit residuals from a previous
+    # same-named incarnation (this rank may have skipped destroy —
+    # killed mid-run and restarted at a different world/tensor size).
+    reset_error_feedback(group_name)
     _groups[group_name] = GroupInfo(world_size, rank, group_name)
     _kv_put(f"grp/{group_name}/{rank}", str(world_size).encode())
 
@@ -364,11 +377,13 @@ def destroy_collective_group(group_name: str = "default",
         barrier(group_name=group_name, timeout=timeout)
     finally:
         _groups.pop(group_name, None)
+        # even when the closing barrier fails (a peer died), this
+        # rank's residuals are stale the moment the group is gone
+        reset_error_feedback(group_name)
     for seq in list(group.pending_gc):
         if seq < barrier_seq:
             _gc_round(group, seq)
     _kv_del(f"grp/{group.name}/{group.rank}")
-    reset_error_feedback(group_name)
 
 
 def _gc_round(group: GroupInfo, seq: int) -> None:
@@ -634,61 +649,71 @@ def allreduce(tensor, op: str = "sum", group_name: str = "default",
     world = group.world_size
     acc = np.asarray(tensor)
     _check_compression(compression, op, acc.dtype)
-    if world == 1:
-        return acc / world if op == "mean" else acc.copy()
-    _rec = _flight.RECORDER
-    flight_t0 = _rec.clock() if _rec is not None else None
-    if algorithm is None:
-        algorithm = ("ring" if compression is not None
-                     or acc.nbytes >= _RING_MIN_BYTES else "tree")
-    if algorithm == "tree":
-        if compression is not None:
-            raise ValueError("compression requires algorithm='ring'")
-        out = _tree_allreduce(group, acc, op, timeout)
-        if _rec is not None:
-            _rec.record("collective", "allreduce", flight_t0,
-                        _rec.clock() - flight_t0,
-                        {"algorithm": "tree", "dtype": str(acc.dtype),
-                         "ratio": 1.0})
-        return out / world if op == "mean" else out
-    if algorithm != "ring":
-        raise ValueError(f"unknown algorithm {algorithm!r}")
+    led = _collsan.LEDGER
+    cs = None if led is None else led.record_enter(
+        group.name, group.rank, world,
+        _collsan.fingerprint("allreduce", acc.dtype, acc.size, acc.shape,
+                             compression, ef_key, algorithm))
+    try:
+        if world == 1:
+            return acc / world if op == "mean" else acc.copy()
+        _rec = _flight.RECORDER
+        flight_t0 = _rec.clock() if _rec is not None else None
+        if algorithm is None:
+            algorithm = ("ring" if compression is not None
+                         or acc.nbytes >= _RING_MIN_BYTES else "tree")
+        if algorithm == "tree":
+            if compression is not None:
+                raise ValueError("compression requires algorithm='ring'")
+            out = _tree_allreduce(group, acc, op, timeout)
+            if _rec is not None:
+                _rec.record("collective", "allreduce", flight_t0,
+                            _rec.clock() - flight_t0,
+                            {"algorithm": "tree",
+                             "dtype": str(acc.dtype), "ratio": 1.0})
+            return out / world if op == "mean" else out
+        if algorithm != "ring":
+            raise ValueError(f"unknown algorithm {algorithm!r}")
 
-    orig_shape, orig_dtype = acc.shape, acc.dtype
-    flat = acc.ravel()
-    residual = None
-    if compression is not None:
-        flat = flat.astype(np.float32)
-        if ef_key is not None:
-            residual = _ef_buffer(group.name, ef_key, flat.size)
-            flat = flat + residual
-            residual[:] = 0.0  # re-filled with this round's errors
-    stats = {"wire": 0, "raw": 0}
-    own, bounds = _ring_reduce_scatter_flat(
-        group, flat, op, timeout, compression, residual, stats)
-    # stats deliberately excluded here: this encode is not itself a
-    # send — the all-gather below counts it when it first travels
-    own_payload = _encode_chunk(own, compression, residual,
-                                bounds[group.rank],
-                                {"wire": 0, "raw": 0})
-    # the all-gather moves each payload world-1 hops in total around the
-    # ring; this rank forwards whatever arrives, verbatim
-    payloads = _ring_allgather_payloads(
-        group, own_payload, timeout, stats,
-        int(own.size) * 4 if compression else int(own.nbytes))
-    parts = [_decode_chunk(p) for p in payloads]
-    out = (np.concatenate([np.asarray(p, dtype=np.float32 if compression
-                                      else orig_dtype)
-                           for p in parts])
-           if world > 1 else parts[0])
-    if op == "mean":
-        out = out / world
-    out = out.reshape(orig_shape)
-    if compression is not None and np.issubdtype(orig_dtype, np.floating):
-        out = out.astype(orig_dtype)
-    _note_bytes("allreduce", compression or str(orig_dtype),
-                stats["wire"], stats["raw"], t0_ns=flight_t0)
-    return out
+        orig_shape, orig_dtype = acc.shape, acc.dtype
+        flat = acc.ravel()
+        residual = None
+        if compression is not None:
+            flat = flat.astype(np.float32)
+            if ef_key is not None:
+                residual = _ef_buffer(group.name, ef_key, flat.size)
+                flat = flat + residual
+                residual[:] = 0.0  # re-filled with this round's errors
+        stats = {"wire": 0, "raw": 0}
+        own, bounds = _ring_reduce_scatter_flat(
+            group, flat, op, timeout, compression, residual, stats)
+        # stats deliberately excluded here: this encode is not itself a
+        # send — the all-gather below counts it when it first travels
+        own_payload = _encode_chunk(own, compression, residual,
+                                    bounds[group.rank],
+                                    {"wire": 0, "raw": 0})
+        # the all-gather moves each payload world-1 hops in total around
+        # the ring; this rank forwards whatever arrives, verbatim
+        payloads = _ring_allgather_payloads(
+            group, own_payload, timeout, stats,
+            int(own.size) * 4 if compression else int(own.nbytes))
+        parts = [_decode_chunk(p) for p in payloads]
+        out = (np.concatenate([np.asarray(p, dtype=np.float32
+                                          if compression else orig_dtype)
+                               for p in parts])
+               if world > 1 else parts[0])
+        if op == "mean":
+            out = out / world
+        out = out.reshape(orig_shape)
+        if compression is not None and np.issubdtype(orig_dtype,
+                                                     np.floating):
+            out = out.astype(orig_dtype)
+        _note_bytes("allreduce", compression or str(orig_dtype),
+                    stats["wire"], stats["raw"], t0_ns=flight_t0)
+        return out
+    finally:
+        if led is not None:
+            led.record_exit(group.name, group.rank, world, cs, "allreduce")
 
 
 def reduce_scatter_flat(tensor, op: str = "sum",
@@ -707,26 +732,36 @@ def reduce_scatter_flat(tensor, op: str = "sum",
     world = group.world_size
     flat = np.asarray(tensor).ravel()
     _check_compression(compression, op, flat.dtype)
-    if world == 1:
-        out = flat.astype(np.float32) if compression else flat.copy()
-        return (out / world if op == "mean" else out), 0
-    residual = None
-    _rec = _flight.RECORDER
-    flight_t0 = _rec.clock() if _rec is not None else None
-    if compression is not None:
-        flat = flat.astype(np.float32)
-        if ef_key is not None:
-            residual = _ef_buffer(group.name, ef_key, flat.size)
-            flat = flat + residual
-            residual[:] = 0.0
-    stats = {"wire": 0, "raw": 0}
-    own, bounds = _ring_reduce_scatter_flat(
-        group, flat, op, timeout, compression, residual, stats)
-    if op == "mean":
-        own = own / world
-    _note_bytes("reduce_scatter", compression or str(flat.dtype),
-                stats["wire"], stats["raw"], t0_ns=flight_t0)
-    return own, bounds[group.rank]
+    led = _collsan.LEDGER
+    cs = None if led is None else led.record_enter(
+        group.name, group.rank, world,
+        _collsan.fingerprint("reduce_scatter_flat", flat.dtype, flat.size,
+                             flat.shape, compression, ef_key, None))
+    try:
+        if world == 1:
+            out = flat.astype(np.float32) if compression else flat.copy()
+            return (out / world if op == "mean" else out), 0
+        residual = None
+        _rec = _flight.RECORDER
+        flight_t0 = _rec.clock() if _rec is not None else None
+        if compression is not None:
+            flat = flat.astype(np.float32)
+            if ef_key is not None:
+                residual = _ef_buffer(group.name, ef_key, flat.size)
+                flat = flat + residual
+                residual[:] = 0.0
+        stats = {"wire": 0, "raw": 0}
+        own, bounds = _ring_reduce_scatter_flat(
+            group, flat, op, timeout, compression, residual, stats)
+        if op == "mean":
+            own = own / world
+        _note_bytes("reduce_scatter", compression or str(flat.dtype),
+                    stats["wire"], stats["raw"], t0_ns=flight_t0)
+        return own, bounds[group.rank]
+    finally:
+        if led is not None:
+            led.record_exit(group.name, group.rank, world, cs,
+                            "reduce_scatter_flat")
 
 
 def allgather_flat(shard, group_name: str = "default",
@@ -737,22 +772,45 @@ def allgather_flat(shard, group_name: str = "default",
     shard and receives the full parameter vector."""
     group = _group(group_name)
     shard = np.ascontiguousarray(np.asarray(shard).ravel())
-    if group.world_size == 1:
-        return shard.copy()
-    stats = {"wire": 0, "raw": 0}
-    _rec = _flight.RECORDER
-    flight_t0 = _rec.clock() if _rec is not None else None
-    payloads = _ring_allgather_payloads(group, shard, timeout, stats,
-                                        int(shard.nbytes))
-    _note_bytes("allgather", str(shard.dtype), stats["wire"],
-                stats["raw"], t0_ns=flight_t0)
-    return np.concatenate([np.asarray(p) for p in payloads])
+    led = _collsan.LEDGER
+    # per-rank shard sizes legitimately differ by one element
+    # (np.array_split chunking) — size/shape stay out of the fingerprint
+    cs = None if led is None else led.record_enter(
+        group.name, group.rank, group.world_size,
+        _collsan.fingerprint("allgather_flat", shard.dtype))
+    try:
+        if group.world_size == 1:
+            return shard.copy()
+        stats = {"wire": 0, "raw": 0}
+        _rec = _flight.RECORDER
+        flight_t0 = _rec.clock() if _rec is not None else None
+        payloads = _ring_allgather_payloads(group, shard, timeout, stats,
+                                            int(shard.nbytes))
+        _note_bytes("allgather", str(shard.dtype), stats["wire"],
+                    stats["raw"], t0_ns=flight_t0)
+        return np.concatenate([np.asarray(p) for p in payloads])
+    finally:
+        if led is not None:
+            led.record_exit(group.name, group.rank, group.world_size,
+                            cs, "allgather_flat")
 
 
 def allgather(tensor, group_name: str = "default",
               timeout: float = _DEFAULT_TIMEOUT) -> List[np.ndarray]:
     group = _group(group_name)
-    return [np.asarray(p) for p in _exchange(group, np.asarray(tensor), timeout)]
+    arr = np.asarray(tensor)
+    led = _collsan.LEDGER
+    # _exchange carries arbitrary per-rank payloads; only op/dtype are
+    # part of the cross-rank contract here
+    cs = None if led is None else led.record_enter(
+        group.name, group.rank, group.world_size,
+        _collsan.fingerprint("allgather", arr.dtype))
+    try:
+        return [np.asarray(p) for p in _exchange(group, arr, timeout)]
+    finally:
+        if led is not None:
+            led.record_exit(group.name, group.rank, group.world_size,
+                            cs, "allgather")
 
 
 def reducescatter(tensor, op: str = "sum", group_name: str = "default",
@@ -761,41 +819,94 @@ def reducescatter(tensor, op: str = "sum", group_name: str = "default",
     axis 0 (reference-compatible shape semantics; for the flat ZeRO-1
     chunking use reduce_scatter_flat)."""
     group = _group(group_name)
-    reduced = allreduce(tensor, op=op, group_name=group_name, timeout=timeout)
-    shards = np.array_split(reduced, group.world_size, axis=0)
-    return shards[group.rank]
+    arr = np.asarray(tensor)
+    led = _collsan.LEDGER
+    cs = None if led is None else led.record_enter(
+        group.name, group.rank, group.world_size,
+        _collsan.fingerprint("reducescatter", arr.dtype, arr.size,
+                             arr.shape))
+    try:
+        reduced = allreduce(arr, op=op, group_name=group_name,
+                            timeout=timeout)
+        shards = np.array_split(reduced, group.world_size, axis=0)
+        return shards[group.rank]
+    finally:
+        if led is not None:
+            led.record_exit(group.name, group.rank, group.world_size,
+                            cs, "reducescatter")
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
               timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
     group = _group(group_name)
-    payload = np.asarray(tensor) if group.rank == src_rank else None
-    parts = _exchange(group, payload, timeout)
-    return np.asarray(parts[src_rank])
+    led = _collsan.LEDGER
+    # only the src rank holds the payload, so the cross-rank-comparable
+    # identity is the op + agreed root (carried in the ef_key slot)
+    cs = None if led is None else led.record_enter(
+        group.name, group.rank, group.world_size,
+        _collsan.fingerprint("broadcast", ef_key=f"src={src_rank}"))
+    try:
+        payload = np.asarray(tensor) if group.rank == src_rank else None
+        parts = _exchange(group, payload, timeout)
+        return np.asarray(parts[src_rank])
+    finally:
+        if led is not None:
+            led.record_exit(group.name, group.rank, group.world_size,
+                            cs, "broadcast")
 
 
 def barrier(group_name: str = "default",
             timeout: float = _DEFAULT_TIMEOUT) -> None:
     group = _group(group_name)
-    _exchange(group, np.zeros((), dtype=np.int8), timeout)
+    led = _collsan.LEDGER
+    cs = None if led is None else led.record_enter(
+        group.name, group.rank, group.world_size,
+        _collsan.fingerprint("barrier"))
+    try:
+        _exchange(group, np.zeros((), dtype=np.int8), timeout)
+    finally:
+        if led is not None:
+            led.record_exit(group.name, group.rank, group.world_size,
+                            cs, "barrier")
 
 
 def send(tensor, dst_rank: int, group_name: str = "default",
          tag: int = 0) -> None:
     group = _group(group_name)
+    arr = np.asarray(tensor)
+    led = _collsan.LEDGER
+    # p2p programs legitimately differ per rank: recorded under the
+    # p2p: pseudo-group, which fold() skips and the watchdog still scans
+    cs = None if led is None else led.record_enter(
+        _collsan.P2P_PREFIX + group.name, group.rank, group.world_size,
+        _collsan.fingerprint("send", arr.dtype, arr.size, arr.shape,
+                             ef_key=f"{group.rank}->{dst_rank}/{tag}"))
     key = f"p2p/{group.name}/{group.rank}->{dst_rank}/{tag}"
-    _kv_put(key, serialization.pack(np.asarray(tensor)))
+    _kv_put(key, serialization.pack(arr))
+    if led is not None:
+        led.record_exit(_collsan.P2P_PREFIX + group.name, group.rank,
+                        group.world_size, cs, "send")
 
 
 def recv(src_rank: int, group_name: str = "default", tag: int = 0,
          timeout: float = _DEFAULT_TIMEOUT) -> np.ndarray:
     group = _group(group_name)
-    key = f"p2p/{group.name}/{src_rank}->{group.rank}/{tag}"
-    blob = _kv_wait(key, timeout,
-                    what=f"rank {src_rank} of group {group.name!r} "
-                         f"(p2p send tag {tag})")
-    _kv_del(key)
-    return serialization.unpack(blob)
+    led = _collsan.LEDGER
+    cs = None if led is None else led.record_enter(
+        _collsan.P2P_PREFIX + group.name, group.rank, group.world_size,
+        _collsan.fingerprint("recv",
+                             ef_key=f"{src_rank}->{group.rank}/{tag}"))
+    try:
+        key = f"p2p/{group.name}/{src_rank}->{group.rank}/{tag}"
+        blob = _kv_wait(key, timeout,
+                        what=f"rank {src_rank} of group {group.name!r} "
+                             f"(p2p send tag {tag})")
+        _kv_del(key)
+        return serialization.unpack(blob)
+    finally:
+        if led is not None:
+            led.record_exit(_collsan.P2P_PREFIX + group.name, group.rank,
+                            group.world_size, cs, "recv")
 
 
 # --- in-graph SPMD collectives (the TPU hot path) -----------------------

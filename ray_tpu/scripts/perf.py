@@ -264,6 +264,48 @@ def bench_refsan_overhead(rt, n: int) -> dict:
             if dt_off > 0 else 1.0}
 
 
+def bench_collsan_overhead(rt, n: int) -> dict:
+    """Collective-sanitizer cost on the host-collective hot path: a
+    solo (world-1) group allreduces a 65536-f32 tensor in a tight loop
+    — the world==1 short-circuit isolates the fingerprint stamp from
+    wire time, so the ratio bounds the per-op ledger cost. Interleaved
+    best-of-3 toggling the ledger; the committed guard bound lives in
+    tests/test_collsan.py."""
+    import numpy as np
+    from ray_tpu.devtools import collsan
+    from ray_tpu.parallel import collective
+
+    collective.init_collective_group(1, 0, "collsan-bench")
+    x = np.arange(65536, dtype=np.float32)
+    rounds = max(200, n // 40)
+    for _ in range(50):
+        collective.allreduce(x, "sum", "collsan-bench")
+    saved = collsan.LEDGER
+    best = {False: None, True: None}
+    try:
+        for _ in range(3):
+            for enabled in (False, True):
+                if enabled:
+                    collsan.enable("driver:bench")
+                else:
+                    collsan.disable()
+                t0 = time.perf_counter()
+                for _ in range(rounds):
+                    collective.allreduce(x, "sum", "collsan-bench")
+                dt = time.perf_counter() - t0
+                if best[enabled] is None or dt < best[enabled]:
+                    best[enabled] = dt
+    finally:
+        collsan.LEDGER = saved
+        collective.destroy_collective_group("collsan-bench")
+    dt_off, dt_on = best[False], best[True]
+    return {"bench": "collsan_overhead", "n": rounds,
+            "seconds_disabled": round(dt_off, 3),
+            "seconds_enabled": round(dt_on, 3),
+            "enabled_over_disabled": round(dt_on / dt_off, 3)
+            if dt_off > 0 else 1.0}
+
+
 def bench_events_overhead(rt, n: int) -> dict:
     """Cluster-event-plane cost on the tight trivial-task loop:
     interleaved best-of-3 A/B toggling ``cluster_events_enabled`` (the
@@ -504,6 +546,11 @@ def main(argv=None) -> None:
                         help="measure object-lifetime-sanitizer ledger "
                              "overhead on the trivial-task loop "
                              "(enabled vs disabled)")
+    parser.add_argument("--collsan", action="store_true",
+                        help="measure collective-sanitizer fingerprint "
+                             "overhead on a solo-group allreduce loop "
+                             "(interleaved best-of-3, enabled vs "
+                             "disabled)")
     parser.add_argument("--events", action="store_true",
                         help="measure cluster-event-plane overhead on "
                              "the trivial-task loop (interleaved "
@@ -556,6 +603,10 @@ def main(argv=None) -> None:
         print(json.dumps(out), flush=True)
     if args.refsan:
         out = bench_refsan_overhead(rt, args.tasks)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    if args.collsan:
+        out = bench_collsan_overhead(rt, args.tasks)
         results.append(out)
         print(json.dumps(out), flush=True)
     if args.events:

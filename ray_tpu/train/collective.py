@@ -27,9 +27,37 @@ from typing import Any, Optional
 import numpy as np
 
 from ray_tpu.core import serialization
+from ray_tpu.devtools import collsan as _collsan
 from ray_tpu.parallel import collective
 from ray_tpu.train.context import get_context
 from ray_tpu.util import flight_recorder as _flight
+
+
+def _csan_enter(group: str, op_kind: str, leaves: int,
+                compression: Optional[str]):
+    """Envelope fingerprint for an optimizer-level gradient sync — the
+    per-leaf collectives inside stamp their own, this one asserts every
+    rank runs the same *wrapper* with the same leaf count and
+    compression. None (and nothing recorded) when collsan is off."""
+    led = _collsan.LEDGER
+    if led is None:
+        return None
+    info = collective._groups.get(group)
+    if info is None:
+        return None
+    return led.record_enter(
+        group, info.rank, info.world_size,
+        _collsan.fingerprint(op_kind, "", leaves, (), compression))
+
+
+def _csan_exit(group: str, token, op_kind: str) -> None:
+    led = _collsan.LEDGER
+    if led is None or token is None:
+        return
+    info = collective._groups.get(group)
+    if info is not None:
+        led.record_exit(group, info.rank, info.world_size, token,
+                        op_kind)
 
 
 def barrier() -> None:
@@ -70,13 +98,19 @@ def allreduce_gradients(grads, op: str = "mean",
     flat, treedef = jax.tree_util.tree_flatten(grads)
     rec = _flight.RECORDER
     t0_ns = rec.clock() if rec is not None else 0
-    reduced = [
-        collective.allreduce(np.asarray(leaf), op=op,
-                             group_name=group,
-                             compression=compression,
-                             ef_key=f"grad/{i}" if compression else None)
-        for i, leaf in enumerate(flat)
-    ]
+    token = _csan_enter(group, "allreduce_gradients", len(flat),
+                        compression)
+    try:
+        reduced = [
+            collective.allreduce(np.asarray(leaf), op=op,
+                                 group_name=group,
+                                 compression=compression,
+                                 ef_key=f"grad/{i}" if compression
+                                 else None)
+            for i, leaf in enumerate(flat)
+        ]
+    finally:
+        _csan_exit(group, token, "allreduce_gradients")
     if rec is not None:
         # envelope over the whole gradient sync (per-leaf hop spans are
         # recorded inside collective.allreduce)
@@ -136,13 +170,19 @@ class DDPOptimizer:
         import jax
         import optax
         flat, treedef = jax.tree_util.tree_flatten(grads)
-        reduced = [
-            collective.allreduce(
-                np.asarray(leaf), op="mean", group_name=self.group_name,
-                compression=self.grad_compression,
-                ef_key=f"ddp/{i}" if self.grad_compression else None)
-            for i, leaf in enumerate(flat)
-        ]
+        token = _csan_enter(self.group_name, "ddp_step", len(flat),
+                            self.grad_compression)
+        try:
+            reduced = [
+                collective.allreduce(
+                    np.asarray(leaf), op="mean",
+                    group_name=self.group_name,
+                    compression=self.grad_compression,
+                    ef_key=f"ddp/{i}" if self.grad_compression else None)
+                for i, leaf in enumerate(flat)
+            ]
+        finally:
+            _csan_exit(self.group_name, token, "ddp_step")
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
         updates, self._opt_state = self.optimizer.update(
             grads, self._opt_state, params)
@@ -188,23 +228,28 @@ class Zero1Optimizer:
     def step(self, params, grads):
         import optax
         gvec, treedef, shapes, dtypes = _flatten_to_vector(grads)
-        grad_shard, off = collective.reduce_scatter_flat(
-            gvec, op="mean", group_name=self.group_name,
-            compression=self.grad_compression,
-            ef_key="zero1/grads" if self.grad_compression else None)
-        if off != self._lo or off + grad_shard.size != self._hi:
-            raise ValueError(
-                "gradient pytree size changed under Zero1Optimizer "
-                f"(shard [{off}, {off + grad_shard.size}) vs optimizer "
-                f"state for [{self._lo}, {self._hi}))")
-        pvec, _, _, _ = _flatten_to_vector(params)
-        pshard = pvec[self._lo:self._hi]
-        updates, self._opt_state = self.optimizer.update(
-            np.asarray(grad_shard, dtype=np.float32), self._opt_state,
-            pshard)
-        new_shard = optax.apply_updates(pshard, updates)
-        full = collective.allgather_flat(np.asarray(new_shard),
-                                         group_name=self.group_name)
+        token = _csan_enter(self.group_name, "zero1_step", gvec.size,
+                            self.grad_compression)
+        try:
+            grad_shard, off = collective.reduce_scatter_flat(
+                gvec, op="mean", group_name=self.group_name,
+                compression=self.grad_compression,
+                ef_key="zero1/grads" if self.grad_compression else None)
+            if off != self._lo or off + grad_shard.size != self._hi:
+                raise ValueError(
+                    "gradient pytree size changed under Zero1Optimizer "
+                    f"(shard [{off}, {off + grad_shard.size}) vs "
+                    f"optimizer state for [{self._lo}, {self._hi}))")
+            pvec, _, _, _ = _flatten_to_vector(params)
+            pshard = pvec[self._lo:self._hi]
+            updates, self._opt_state = self.optimizer.update(
+                np.asarray(grad_shard, dtype=np.float32),
+                self._opt_state, pshard)
+            new_shard = optax.apply_updates(pshard, updates)
+            full = collective.allgather_flat(np.asarray(new_shard),
+                                             group_name=self.group_name)
+        finally:
+            _csan_exit(self.group_name, token, "zero1_step")
         return _unflatten_from_vector(full, treedef, shapes, dtypes)
 
 

@@ -37,6 +37,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List
 
+from ray_tpu.devtools import collsan
+
 SCHEDULES = ("1f1b", "gpipe")
 
 # instruction ops
@@ -217,17 +219,32 @@ def validate_schedule(num_stages: int, num_microbatches: int,
                 (f"stage {stage}: in-flight {max_in_flight(instrs)} != "
                  f"warmup depth {warm}")
     # channel-order invariant: the SEND sequence on every edge matches
-    # the RECV sequence of its peer (channels are FIFO per edge)
-    for stage in range(s - 1):
-        sends = [i.mb for i in per_stage[stage]
-                 if i.op == SEND and i.kind == ACT]
-        recvs = [i.mb for i in per_stage[stage + 1]
-                 if i.op == RECV and i.kind == ACT]
-        assert sends == recvs, \
-            f"act edge {stage}->{stage + 1}: send/recv order mismatch"
-        sends = [i.mb for i in per_stage[stage + 1]
-                 if i.op == SEND and i.kind == GRAD]
-        recvs = [i.mb for i in per_stage[stage]
-                 if i.op == RECV and i.kind == GRAD]
-        assert sends == recvs, \
-            f"grad edge {stage + 1}->{stage}: send/recv order mismatch"
+    # the RECV sequence of its peer (channels are FIFO per edge).
+    # Checked through collsan's pure program checker — the same
+    # contract the resharding planner emits into.
+    violations = collsan.verify_program(
+        schedule_program(per_stage), world=s)
+    assert not violations, "; ".join(violations)
+
+
+def schedule_program(per_stage: List[List[Instruction]]):
+    """Lower a built schedule into the ``collsan.verify_program``
+    op-list form: SEND/RECV become p2p ops on a per-edge FIFO channel
+    (``"act 0->1"``, ``"grad 1->0"``) keyed by microbatch id; compute
+    ops carry no cross-rank contract and are omitted."""
+    program = {}
+    for stage, instrs in enumerate(per_stage):
+        ops = []
+        for ins in instrs:
+            if ins.op not in (SEND, RECV):
+                continue
+            if ins.kind == ACT:
+                src = stage if ins.op == SEND else stage - 1
+                chan = f"act {src}->{src + 1}"
+            else:
+                src = stage if ins.op == SEND else stage + 1
+                chan = f"grad {src}->{src - 1}"
+            ops.append({"op": ins.op.lower(), "chan": chan,
+                        "key": ins.mb})
+        program[stage] = ops
+    return program

@@ -502,3 +502,88 @@ def test_node_anti_affinity_public_strategy():
     nid = NodeID.from_random()
     s = NodeAntiAffinitySchedulingStrategy(node_id=nid, soft=True)
     assert s.kind == "NODE_ANTI_AFFINITY" and s.soft and s.node_id == nid
+
+
+# --- collsan drill: dead rank named by the hung-collective watchdog ----
+
+
+@pytest.mark.watchdog(300)
+def test_collsan_watchdog_names_dead_rank_in_drill(monkeypatch):
+    """Kill a vnode holding one rank of a collective group while the
+    survivors are parked inside an allreduce: the collsan watchdog must
+    name the parked ranks + seq and the rank that never arrived, and
+    recovery_report() must chain that finding onto the NODE_DEAD
+    incident (the stall is the death's symptom)."""
+    monkeypatch.setenv("RAY_TPU_COLLSAN", "1")
+    monkeypatch.setenv("RTPU_COLLSAN_STALL_S", "2")
+    cluster = _make_cluster(heartbeat_timeout_s=2.5)
+    try:
+        from ray_tpu.devtools import collsan
+        vnodes = cluster.add_virtual_nodes(1, resources={"CPU": 1.0})
+
+        @ray_tpu.remote(num_cpus=1)
+        class Member:
+            def __init__(self, rank):
+                from ray_tpu.parallel import collective
+                self.rank = rank
+                collective.init_collective_group(3, rank, "drill")
+
+            def ready(self):
+                return self.rank
+
+            def sync(self):
+                from ray_tpu.parallel import collective
+                x = np.ones(128, dtype=np.float32)
+                return collective.allreduce(x, "sum", "drill",
+                                            timeout=25.0)[0]
+
+        # ranks 0/1 live in real worker processes on the head node
+        # (virtual nodes share ONE process, and a collective group
+        # needs one process per rank); rank 2 — the victim — rides the
+        # vnode, whose death the watchdog must name
+        head_id = cluster.head_node_id
+        members = [
+            Member.options(scheduling_strategy=_pin(
+                head_id if r < 2 else vnodes[0].node_id,
+                soft=False)).remote(r)
+            for r in range(3)]
+        assert ray_tpu.get([m.ready.remote() for m in members],
+                           timeout=30) == [0, 1, 2]
+
+        # ranks 0 and 1 enter the round; rank 2 never does — its node
+        # dies first, so the survivors park deterministically
+        pending = [members[0].sync.remote(), members[1].sync.remote()]
+        time.sleep(0.3)
+        victim_hex = vnodes[0].node_id.hex()
+        schedule = ChaosSchedule(
+            faults=[ChaosFault(at_s=0.05, kind="kill_node", target=0)])
+        ctrl = ChaosController(cluster.runtime, schedule, vnodes)
+        ctrl.run_sync()
+        assert [hex_id for _, _, hex_id in ctrl.injected] == [victim_hex]
+
+        def _stalls():
+            return [f for f in collsan.report()
+                    if f["kind"] == "stall" and f["group"] == "drill"]
+
+        _wait_for(_stalls, 20, "collsan watchdog stall finding")
+        finding = _stalls()[0]
+        assert finding["seq"] == 0
+        assert finding["ranks"] == [0, 1]
+        assert finding["missing"] == [2]
+        assert "allreduce" in str(finding["ops"])
+        assert "never arrived" in finding["detail"]
+
+        _wait_for(lambda: any(e["node_id"] == victim_hex
+                              for e in state.list_cluster_events(
+                                  kinds=["NODE_DEAD"])),
+                  20, "killed node declared dead")
+        report = recovery.recovery_report()
+        assert any(f["kind"] == "stall" and f["group"] == "drill"
+                   for f in report["collsan"])
+        inc = _node_dead_incidents(report, victim_hex)[0]
+        chained = inc.get("collsan") or []
+        assert any(f["kind"] == "stall" and f["group"] == "drill"
+                   and 2 in f["missing"] for f in chained), chained
+        del pending  # survivors abandon their 25s rendezvous at teardown
+    finally:
+        cluster.shutdown()

@@ -1243,3 +1243,193 @@ def test_gl020_per_line_disable():
         "return None",
         "return None  # graftlint: disable=GL020")
     assert rules_hit(src, select=["GL020"]) == set()
+
+
+# -- GL021 rank-dependent collective ----------------------------------
+
+GL021_POS_DIRECT = """
+    from ray_tpu.parallel import collective
+
+    def sync(arr, rank):
+        if rank == 0:
+            collective.allreduce(arr)
+"""
+
+GL021_POS_TWO_HOP = """
+    from ray_tpu.parallel import collective
+
+    def _sync(arr):
+        collective.allreduce(arr)
+
+    def step(arr, rank):
+        if rank != 0:
+            _sync(arr)
+"""
+
+GL021_NEG_BROADCAST_ROOT = """
+    from ray_tpu.parallel import collective
+    import numpy as np
+
+    def share(arr, rank):
+        if rank == 0:
+            payload = arr
+        else:
+            payload = np.zeros_like(arr)
+        return collective.broadcast(payload, src_rank=0)
+
+    def share_guarded(arr, rank):
+        if rank == 0:
+            collective.broadcast(arr, src_rank=0)
+"""
+
+GL021_NEG_UNGUARDED = """
+    from ray_tpu.parallel import collective
+
+    def sync(arr, rank):
+        out = collective.allreduce(arr)
+        if rank == 0:
+            print(out[:4])
+        return out
+"""
+
+
+def test_gl021_fires_on_rank_guarded_collective():
+    findings = run(GL021_POS_DIRECT, select=["GL021"])
+    assert [f.rule for f in findings] == ["GL021"]
+    assert "allreduce" in findings[0].message
+    assert "rank" in findings[0].message
+
+
+def test_gl021_fires_through_a_call_hop():
+    findings = run(GL021_POS_TWO_HOP, select=["GL021"])
+    assert [f.rule for f in findings] == ["GL021"]
+    assert "step -> _sync" in findings[0].message
+
+
+def test_gl021_quiet_on_broadcast_root_and_unguarded():
+    assert rules_hit(GL021_NEG_BROADCAST_ROOT, select=["GL021"]) == set()
+    assert rules_hit(GL021_NEG_UNGUARDED, select=["GL021"]) == set()
+    # a barrier() on some unrelated object is not a collective
+    assert rules_hit("""
+        def flush(q, rank):
+            if rank == 0:
+                q.barrier()
+    """, select=["GL021"]) == set()
+
+
+def test_gl021_per_line_disable():
+    src = GL021_POS_DIRECT.replace(
+        "collective.allreduce(arr)",
+        "collective.allreduce(arr)  # graftlint: disable=GL021")
+    assert rules_hit(src, select=["GL021"]) == set()
+
+
+# -- GL022 ef_key collision -------------------------------------------
+
+GL022_POS = """
+    from ray_tpu.parallel import collective
+
+    def sync(g1, g2):
+        collective.allreduce(g1, compression="int8", ef_key="grad")
+        collective.allreduce(g2, compression="int8", ef_key="grad")
+"""
+
+GL022_NEG_DISTINCT_KEYS = """
+    from ray_tpu.parallel import collective
+
+    def sync(g1, g2):
+        collective.allreduce(g1, compression="int8", ef_key="grad/1")
+        collective.allreduce(g2, compression="int8", ef_key="grad/2")
+"""
+
+GL022_NEG_SAME_TENSOR = """
+    from ray_tpu.parallel import collective
+
+    def sync(g1):
+        collective.allreduce(g1, compression="int8", ef_key="grad")
+        collective.allreduce(g1, compression="int8", ef_key="grad")
+"""
+
+GL022_NEG_DIFFERENT_GROUPS = """
+    from ray_tpu.parallel import collective
+
+    def sync(g1, g2):
+        collective.allreduce(g1, group_name="a", compression="int8",
+                             ef_key="grad")
+        collective.allreduce(g2, group_name="b", compression="int8",
+                             ef_key="grad")
+"""
+
+
+def test_gl022_fires_on_shared_key_different_tensors():
+    findings = run(GL022_POS, select=["GL022"])
+    assert [f.rule for f in findings] == ["GL022"]
+    assert "'grad'" in findings[0].message
+    assert "different tensor" in findings[0].message
+
+
+def test_gl022_quiet_on_distinct_keys_tensor_or_group():
+    assert rules_hit(GL022_NEG_DISTINCT_KEYS, select=["GL022"]) == set()
+    assert rules_hit(GL022_NEG_SAME_TENSOR, select=["GL022"]) == set()
+    assert rules_hit(GL022_NEG_DIFFERENT_GROUPS,
+                     select=["GL022"]) == set()
+
+
+def test_gl022_per_line_disable():
+    src = GL022_POS.replace(
+        'collective.allreduce(g2, compression="int8", ef_key="grad")',
+        'collective.allreduce(g2, compression="int8", ef_key="grad")'
+        '  # graftlint: disable=GL022')
+    assert rules_hit(src, select=["GL022"]) == set()
+
+
+# -- GL023 unpaired reduce-scatter ------------------------------------
+
+GL023_POS = """
+    from ray_tpu.parallel import collective
+
+    def step(vec):
+        shard, off = collective.reduce_scatter_flat(vec)
+        return shard
+"""
+
+GL023_NEG_SAME_FN = """
+    from ray_tpu.parallel import collective
+
+    def step(vec):
+        shard, off = collective.reduce_scatter_flat(vec)
+        return collective.allgather_flat(shard)
+"""
+
+GL023_NEG_SIBLING = """
+    from ray_tpu.parallel import collective
+
+    def _scatter(vec):
+        return collective.reduce_scatter_flat(vec)
+
+    def _gather(shard):
+        return collective.allgather_flat(shard)
+
+    def step(vec):
+        shard, off = _scatter(vec)
+        return _gather(shard)
+"""
+
+
+def test_gl023_fires_on_unpaired_reduce_scatter():
+    findings = run(GL023_POS, select=["GL023"])
+    assert [f.rule for f in findings] == ["GL023"]
+    assert "allgather" in findings[0].message
+
+
+def test_gl023_quiet_when_paired_directly_or_via_family():
+    assert rules_hit(GL023_NEG_SAME_FN, select=["GL023"]) == set()
+    assert rules_hit(GL023_NEG_SIBLING, select=["GL023"]) == set()
+
+
+def test_gl023_per_line_disable():
+    src = GL023_POS.replace(
+        "collective.reduce_scatter_flat(vec)",
+        "collective.reduce_scatter_flat(vec)"
+        "  # graftlint: disable=GL023")
+    assert rules_hit(src, select=["GL023"]) == set()

@@ -99,3 +99,26 @@ def test_devtools_check_lint_step():
     from ray_tpu.devtools import check
     status, detail = check.step_lint()
     assert status == "ok", detail
+
+
+def test_collective_rules_registered():
+    """The GL021-GL023 collective-program family rides the same plain
+    package import."""
+    assert {"GL021", "GL022", "GL023"} <= set(lint.RULES)
+
+
+def test_collective_findings_need_no_baseline():
+    """Acceptance gate (PR 20): GL021-GL023 over ray_tpu/ are clean
+    WITHOUT any baseline — the checked-in baseline stays empty for the
+    family."""
+    package = os.path.join(REPO_ROOT, "ray_tpu")
+    findings = lint.lint_paths(
+        [package], select=["GL021", "GL022", "GL023"])
+    assert not findings, (
+        "collective-program findings must be fixed or justified "
+        "inline, not baselined:\n" + "\n".join(f"  {f}" for f in findings))
+    baseline = lint.load_baseline(
+        os.path.join(REPO_ROOT, lint.BASELINE_DEFAULT))
+    grandfathered = [k for k in baseline
+                     if any(f"::GL02{d}::" in k for d in "123")]
+    assert not grandfathered, grandfathered
